@@ -1,0 +1,97 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle-Fluid
+capability parity.
+
+Built from scratch on jax/XLA/pallas/pjit — NOT a port of the reference
+(qjing666/Paddle). See SURVEY.md for the capability map and the architecture
+stance: programs lower to single XLA computations; parallelism is mesh +
+sharding; grads come from jax.vjp; the reference's CUDA/allocator/executor
+machinery is subsumed by the XLA runtime.
+
+Layout:
+    framework/   Program IR, Executor (block -> jitted XLA), autodiff, Scope
+    ops/         op registry + JAX lowerings (the ~706-op surface, growing)
+    layers/      fluid.layers.* graph-building API
+    nn/          paddle.nn Layer stack (dygraph-first)
+    dygraph/     eager tracer + tape autograd
+    tensor/      paddle.tensor functional API
+    parallel/    mesh, shardings, collectives, pipeline & strategy transforms
+    distributed/ fleet facade, launch, env contract
+    models/      flagship model zoo (LeNet, ResNet, BERT, ERNIE, Wide&Deep)
+"""
+from __future__ import annotations
+
+# --- fluid-style core -------------------------------------------------------
+from .framework.program import (Program, program_guard, default_main_program,
+                                default_startup_program, in_dygraph_mode,
+                                Variable, Parameter)
+from .framework.executor import Executor
+from .framework.scope import global_scope, Scope
+from .framework.backward import append_backward, gradients
+from .framework import unique_name
+from .layer_helper import ParamAttr
+from . import initializer
+from . import layers
+from . import optimizer
+from . import regularizer
+from . import clip
+from . import io
+
+# ops must import so registrations run
+from .ops import math_ops, nn_ops, tensor_ops, optimizer_ops, metric_ops  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+# Device placeholders (reference platform/place.h) — devices are owned by the
+# JAX runtime; these exist for source compatibility.
+class CPUPlace:
+    def __repr__(self):
+        return "CPUPlace"
+
+
+class CUDAPlace:
+    def __init__(self, id=0):
+        self.id = id
+
+
+class TPUPlace:
+    def __init__(self, id=0):
+        self.id = id
+
+
+def CUDAPinnedPlace():
+    return CPUPlace()
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_tpu():
+    import jax
+    try:
+        return any(d.platform == "tpu" for d in jax.devices())
+    except RuntimeError:
+        return False
+
+
+def seed(value: int):
+    """paddle.seed / fluid random seed: resets the global PRNG state."""
+    import jax
+    default_main_program().random_seed = value
+    default_startup_program().random_seed = value
+    global_scope().set("__rng_state__", jax.random.key(value))
+
+
+def enable_static():
+    from .framework.program import _set_dygraph_tracer
+    _set_dygraph_tracer(None)
+
+
+def disable_static():
+    from .dygraph.tracer import enable_dygraph
+    enable_dygraph()
+
+
+# fluid alias module-style access: paddle_tpu.fluid
+from . import fluid  # noqa: E402,F401

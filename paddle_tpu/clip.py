@@ -1,0 +1,60 @@
+"""Gradient clipping (reference python/paddle/fluid/clip.py)."""
+from __future__ import annotations
+
+import math
+
+from . import layers
+
+__all__ = ["GradientClipByValue", "GradientClipByNorm",
+           "GradientClipByGlobalNorm", "ClipGradByValue", "ClipGradByNorm",
+           "ClipGradByGlobalNorm"]
+
+
+class GradientClipBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class GradientClipByValue(GradientClipBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, params_grads):
+        return [(p, layers.clip(g, self.min, self.max))
+                for p, g in params_grads]
+
+
+class GradientClipByNorm(GradientClipBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params_grads):
+        return [(p, layers.clip_by_norm(g, self.clip_norm))
+                for p, g in params_grads]
+
+
+class GradientClipByGlobalNorm(GradientClipBase):
+    """Scale all grads by clip_norm / max(global_norm, clip_norm) — one fused
+    XLA reduction over every grad, no per-tensor host sync."""
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params_grads):
+        helper_sums = []
+        for _, g in params_grads:
+            sq = layers.reduce_sum(layers.square(g))
+            helper_sums.append(layers.reshape(sq, [1]))
+        global_sq = layers.sums(helper_sums)
+        global_norm = layers.sqrt(global_sq)
+        clip_var = layers.fill_constant([1], "float32", self.clip_norm)
+        denom = layers.elementwise_max(global_norm, clip_var)
+        scale = layers.elementwise_div(clip_var, denom)
+        return [(p, layers.elementwise_mul(g, scale))
+                for p, g in params_grads]
+
+
+ClipGradByValue = GradientClipByValue
+ClipGradByNorm = GradientClipByNorm
+ClipGradByGlobalNorm = GradientClipByGlobalNorm

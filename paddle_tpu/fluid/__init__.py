@@ -1,0 +1,34 @@
+"""fluid-compatibility namespace: `import paddle_tpu.fluid as fluid`.
+
+Mirrors python/paddle/fluid/__init__.py's public surface for the covered
+subset so reference-style user code runs unchanged.
+"""
+from ..framework.program import (Program, program_guard, default_main_program,
+                                 default_startup_program, in_dygraph_mode,
+                                 Variable, Parameter)
+from ..framework.executor import Executor
+from ..framework.scope import global_scope, Scope
+from ..framework.backward import append_backward, gradients
+from ..framework import unique_name
+from ..layer_helper import ParamAttr
+from .. import initializer
+from .. import layers
+from .. import optimizer
+from .. import regularizer
+from .. import clip
+from .. import io
+from .. import framework
+from ..__init__ import (CPUPlace, CUDAPlace, TPUPlace, is_compiled_with_cuda,
+                        is_compiled_with_tpu)
+
+
+class core:
+    """Stand-in for the pybind core module (reference pybind/pybind.cc). The
+    'native core' here is jaxlib/XLA itself."""
+
+    from ..framework.scope import Scope, global_scope
+
+    @staticmethod
+    def get_all_op_names():
+        from ..ops import registry
+        return registry.all_ops()

@@ -1,0 +1,9 @@
+from .program import (Program, Block, Operator, Variable, Parameter, OpRole,
+                      program_guard, default_main_program,
+                      default_startup_program, in_dygraph_mode,
+                      grad_var_name)
+from .executor import Executor
+from .scope import Scope, global_scope
+from .backward import append_backward, gradients
+from .dtype import convert_dtype, dtype_name
+from . import unique_name

@@ -1,0 +1,62 @@
+"""Dtype system.
+
+Mirrors the reference's VarType.Type dtype enum (reference:
+paddle/fluid/framework/framework.proto:104-163) but maps directly onto numpy/jax
+dtypes. bfloat16 is first-class (TPU native compute type).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+# Canonical names accepted across the API (paddle-style strings or numpy dtypes).
+_ALIASES = {
+    "float32": jnp.float32,
+    "fp32": jnp.float32,
+    "float": jnp.float32,
+    "float64": jnp.float64,
+    "fp64": jnp.float64,
+    "double": jnp.float64,
+    "float16": jnp.float16,
+    "fp16": jnp.float16,
+    "half": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "bf16": jnp.bfloat16,
+    "int8": jnp.int8,
+    "uint8": jnp.uint8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int": jnp.int32,
+    "int64": jnp.int64,
+    "long": jnp.int64,
+    "bool": jnp.bool_,
+}
+
+FLOAT_DTYPES = (jnp.float16, jnp.bfloat16, jnp.float32, jnp.float64)
+
+
+def convert_dtype(dtype):
+    """Normalize any dtype spec (string / numpy / jax) to a numpy dtype object."""
+    if dtype is None:
+        return np.dtype("float32")
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key not in _ALIASES:
+            raise TypeError(f"Unsupported dtype string: {dtype!r}")
+        return np.dtype(_ALIASES[key])
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    d = convert_dtype(dtype)
+    return d.name
+
+
+def is_floating(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return d in (np.dtype(t) for t in FLOAT_DTYPES)
+
+
+def is_integer(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return np.issubdtype(d, np.integer)

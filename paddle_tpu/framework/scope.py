@@ -1,0 +1,66 @@
+"""Scope: run-time name -> value store.
+
+Capability parity with reference Scope/Variable (paddle/fluid/framework/scope.h,
+variable.h) — but values are jax.Arrays (device-resident, XLA-managed HBM)
+rather than allocator-backed tensors; the reference's memory layer
+(memory/allocation/*) is subsumed by the XLA runtime + buffer donation.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, object] = {}
+        self.parent = parent
+        self._kids = []
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(self)
+        self._kids.append(kid)
+        return kid
+
+    def set(self, name: str, value) -> None:
+        self._vars[name] = value
+
+    def find(self, name: str):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def has(self, name: str) -> bool:
+        return self.find(name) is not None
+
+    def erase(self, name: str) -> None:
+        self._vars.pop(name, None)
+
+    def local_names(self):
+        return list(self._vars)
+
+    def drop_kids(self):
+        self._kids.clear()
+
+    def numpy(self, name: str) -> np.ndarray:
+        v = self.find(name)
+        if v is None:
+            raise KeyError(f"variable {name!r} not found in scope")
+        return np.asarray(v)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+def _reset_global_scope():
+    global _global_scope
+    _global_scope = Scope()
+    return _global_scope

@@ -1,0 +1,42 @@
+"""Unique name generator.
+
+Equivalent capability to reference python/paddle/fluid/unique_name.py: per-prefix
+monotone counters with a `guard` to scope name spaces (used heavily by layers and
+optimizers to name parameters and temporaries deterministically).
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+
+
+class NameGenerator:
+    def __init__(self, prefix: str = ""):
+        self._prefix = prefix
+        self._ids = defaultdict(int)
+
+    def __call__(self, key: str) -> str:
+        tmp = self._ids[key]
+        self._ids[key] += 1
+        return f"{self._prefix}{key}_{tmp}"
+
+
+_generator_stack = [NameGenerator()]
+
+
+def generate(key: str) -> str:
+    return _generator_stack[-1](key)
+
+
+@contextlib.contextmanager
+def guard(prefix: str = ""):
+    _generator_stack.append(NameGenerator(prefix))
+    try:
+        yield
+    finally:
+        _generator_stack.pop()
+
+
+def switch():
+    """Reset the current generator (used between tests/programs)."""
+    _generator_stack[-1] = NameGenerator(_generator_stack[-1]._prefix)

@@ -1,0 +1,151 @@
+"""Parameter initializers.
+
+Reference counterpart: python/paddle/fluid/initializer.py (Constant, Uniform,
+Normal, TruncatedNormal, Xavier, MSRA, Bilinear, NumpyArrayInitializer). Each
+initializer appends ONE op to the startup program; the whole startup program
+compiles to a single XLA computation, so init is one device launch.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .framework.program import default_startup_program
+
+
+class Initializer:
+    def __call__(self, var, block=None):
+        raise NotImplementedError
+
+
+def _startup_block(var):
+    sp = default_startup_program()
+    b = sp.global_block()
+    if var.name not in b.vars:
+        b.create_var(name=var.name, shape=var.shape, dtype=var.dtype,
+                     persistable=True)
+    return b
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, var, block=None):
+        b = block if block is not None else _startup_block(var)
+        b.append_op("fill_constant", outputs={"Out": [var.name]},
+                    attrs={"shape": list(var.shape), "dtype": str(var.dtype),
+                           "value": float(self.value)})
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0, seed=0):
+        self.low, self.high, self.seed = low, high, seed
+
+    def __call__(self, var, block=None):
+        b = block if block is not None else _startup_block(var)
+        b.append_op("uniform_random", outputs={"Out": [var.name]},
+                    attrs={"shape": list(var.shape), "dtype": str(var.dtype),
+                           "min": self.low, "max": self.high})
+
+
+class Normal(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, var, block=None):
+        b = block if block is not None else _startup_block(var)
+        b.append_op("gaussian_random", outputs={"Out": [var.name]},
+                    attrs={"shape": list(var.shape), "dtype": str(var.dtype),
+                           "mean": self.loc, "std": self.scale})
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, var, block=None):
+        b = block if block is not None else _startup_block(var)
+        b.append_op("truncated_gaussian_random", outputs={"Out": [var.name]},
+                    attrs={"shape": list(var.shape), "dtype": str(var.dtype),
+                           "mean": self.loc, "std": self.scale})
+
+
+def _fans(var):
+    shape = var.shape
+    if len(shape) == 2:
+        fan_in, fan_out = shape[0], shape[1]
+    elif len(shape) >= 3:
+        rf = int(np.prod(shape[2:]))
+        fan_in, fan_out = shape[1] * rf, shape[0] * rf
+    else:
+        fan_in = fan_out = int(np.prod(shape))
+    return fan_in, fan_out
+
+
+class Xavier(Initializer):
+    """Glorot init (reference initializer.py XavierInitializer)."""
+
+    def __init__(self, uniform=True, fan_in=None, fan_out=None, seed=0):
+        self.uniform, self.fan_in, self.fan_out = uniform, fan_in, fan_out
+
+    def __call__(self, var, block=None):
+        fi, fo = _fans(var)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        b = block if block is not None else _startup_block(var)
+        if self.uniform:
+            limit = math.sqrt(6.0 / (fi + fo))
+            b.append_op("uniform_random", outputs={"Out": [var.name]},
+                        attrs={"shape": list(var.shape), "dtype": str(var.dtype),
+                               "min": -limit, "max": limit})
+        else:
+            std = math.sqrt(2.0 / (fi + fo))
+            b.append_op("gaussian_random", outputs={"Out": [var.name]},
+                        attrs={"shape": list(var.shape), "dtype": str(var.dtype),
+                               "mean": 0.0, "std": std})
+
+
+class MSRA(Initializer):
+    """Kaiming init (reference initializer.py MSRAInitializer)."""
+
+    def __init__(self, uniform=True, fan_in=None, seed=0):
+        self.uniform, self.fan_in = uniform, fan_in
+
+    def __call__(self, var, block=None):
+        fi, _ = _fans(var)
+        fi = self.fan_in if self.fan_in is not None else fi
+        b = block if block is not None else _startup_block(var)
+        if self.uniform:
+            limit = math.sqrt(6.0 / fi)
+            b.append_op("uniform_random", outputs={"Out": [var.name]},
+                        attrs={"shape": list(var.shape), "dtype": str(var.dtype),
+                               "min": -limit, "max": limit})
+        else:
+            std = math.sqrt(2.0 / fi)
+            b.append_op("gaussian_random", outputs={"Out": [var.name]},
+                        attrs={"shape": list(var.shape), "dtype": str(var.dtype),
+                               "mean": 0.0, "std": std})
+
+
+class NumpyArrayInitializer(Initializer):
+    def __init__(self, value):
+        self.value = np.asarray(value)
+
+    def __call__(self, var, block=None):
+        b = block if block is not None else _startup_block(var)
+        b.append_op("assign_value", outputs={"Out": [var.name]},
+                    attrs={"shape": list(self.value.shape),
+                           "dtype": str(var.dtype),
+                           "values": self.value.reshape(-1).tolist()})
+
+
+# paddle.nn.initializer-style aliases
+ConstantInitializer = Constant
+UniformInitializer = Uniform
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+XavierInitializer = Xavier
+MSRAInitializer = MSRA
+KaimingUniform = MSRA

@@ -1,0 +1,126 @@
+"""Save/load: persistables, whole programs, inference models.
+
+Reference counterpart: python/paddle/fluid/io.py (save/load_persistables :598,
+:966; save/load_inference_model :1164,:1669) backed by C++ save_op/load_op.
+TPU-native: tensors serialize via numpy .npz (threaded orbax checkpointing is
+used by the higher-level paddle.distributed path); programs serialize as JSON
+descs (framework/program.py to_desc/from_desc).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+from .framework.program import Program, default_main_program
+from .framework.scope import global_scope
+
+__all__ = ["save_persistables", "load_persistables", "save_params",
+           "load_params", "save_inference_model", "load_inference_model",
+           "save", "load"]
+
+
+def _persistable_names(program: Program, scope):
+    names = []
+    for v in program.list_vars():
+        if v.persistable and scope.has(v.name):
+            names.append(v.name)
+    return names
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    program = main_program or default_main_program()
+    scope = global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    arrays = {n: np.asarray(scope.find(n))
+              for n in _persistable_names(program, scope)}
+    path = os.path.join(dirname, filename or "persistables.npz")
+    np.savez(path, **arrays)
+    return path
+
+
+def load_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    path = os.path.join(dirname, filename or "persistables.npz")
+    scope = global_scope()
+    with np.load(path) as data:
+        for n in data.files:
+            scope.set(n, data[n])
+
+
+save_params = save_persistables
+load_params = load_persistables
+
+
+def save(program: Optional[Program] = None, model_path: str = "model"):
+    """Whole-model save: program desc JSON + persistables npz
+    (reference io.py:1669 save)."""
+    program = program or default_main_program()
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    with open(model_path + ".pdmodel", "w") as f:
+        json.dump(program.to_desc(), f)
+    scope = global_scope()
+    arrays = {n: np.asarray(scope.find(n))
+              for n in _persistable_names(program, scope)}
+    np.savez(model_path + ".pdparams", **arrays)
+
+
+def load(program: Optional[Program] = None, model_path: str = "model"):
+    scope = global_scope()
+    with np.load(model_path + ".pdparams" if not model_path.endswith(".npz")
+                 else model_path) as data:
+        for n in data.files:
+            scope.set(n, data[n])
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor=None,
+                         main_program=None, model_filename=None,
+                         params_filename=None):
+    """Prune program to the inference slice feed->fetch and save
+    (reference io.py:1164)."""
+    program = main_program or default_main_program()
+    inference_program = program.clone(for_test=True)
+    _prune_to_targets(inference_program,
+                      [v.name if hasattr(v, "name") else v
+                       for v in target_vars])
+    os.makedirs(dirname, exist_ok=True)
+    meta = {"feed": list(feeded_var_names),
+            "fetch": [v.name if hasattr(v, "name") else v
+                      for v in target_vars]}
+    with open(os.path.join(dirname, model_filename or "__model__"), "w") as f:
+        json.dump({"program": inference_program.to_desc(), "meta": meta}, f)
+    scope = global_scope()
+    arrays = {n: np.asarray(scope.find(n))
+              for n in _persistable_names(inference_program, scope)}
+    np.savez(os.path.join(dirname, params_filename or "params.npz"), **arrays)
+    return meta["fetch"]
+
+
+def _prune_to_targets(program: Program, target_names):
+    """Dead-op elimination backwards from targets (reference Program._prune)."""
+    block = program.global_block()
+    needed = set(target_names)
+    kept = []
+    for op in reversed(block.ops):
+        if set(op.output_names()) & needed:
+            kept.append(op)
+            needed.update(op.input_names())
+    block.ops = list(reversed(kept))
+    program.bump_version()
+
+
+def load_inference_model(dirname, executor=None, model_filename=None,
+                         params_filename=None):
+    with open(os.path.join(dirname, model_filename or "__model__")) as f:
+        payload = json.load(f)
+    program = Program.from_desc(payload["program"])
+    scope = global_scope()
+    with np.load(os.path.join(dirname, params_filename or "params.npz")) as d:
+        for n in d.files:
+            scope.set(n, d[n])
+    meta = payload["meta"]
+    fetch_vars = [program.global_block().var(n) for n in meta["fetch"]]
+    return program, meta["feed"], fetch_vars

@@ -1,0 +1,10 @@
+"""fluid.layers API surface: functions that emit ops into the current program.
+
+Reference counterpart: python/paddle/fluid/layers/nn.py (15k LoC),
+layers/tensor.py, layers/loss.py, layers/control_flow.py. Same call signatures
+for the covered subset; ops lower to JAX/XLA (see paddle_tpu/ops/*).
+"""
+from .nn import *          # noqa: F401,F403
+from .tensor import *      # noqa: F401,F403
+from .loss import *        # noqa: F401,F403
+from .control_flow import *  # noqa: F401,F403

@@ -1,0 +1,131 @@
+"""Control-flow layers.
+
+Reference counterparts: fluid/layers/control_flow.py (While, cond, StaticRNN —
+reference operators/controlflow/while_op.cc runs a sub-block via a nested
+Executor). TPU-native plan (SURVEY §7 hard parts): sub-blocks lower to
+lax.while_loop / lax.cond / lax.scan with explicit carried state. Round 1 ships
+`cond` with both branches as sub-programs lowered to lax.cond; While/StaticRNN
+land with the sequence stack in a later round.
+"""
+from __future__ import annotations
+
+from ..framework.program import OpRole
+from ..layer_helper import LayerHelper
+from ..ops.registry import register
+import jax
+
+__all__ = ["cond", "increment", "array_write", "array_read", "While",
+           "StaticRNN", "Switch"]
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """paddle.static.nn.cond parity: capture both branches as sub-blocks and
+    lower to lax.cond. Branch outputs must match in shape/dtype."""
+    helper = LayerHelper("cond")
+    program = helper.main_program
+    parent = program.current_block()
+
+    true_block = program.create_block()
+    true_out = true_fn() if true_fn is not None else None
+    program.rollback()
+    false_block = program.create_block()
+    false_out = false_fn() if false_fn is not None else None
+    program.rollback()
+
+    t_outs = true_out if isinstance(true_out, (list, tuple)) else [true_out]
+    f_outs = false_out if isinstance(false_out, (list, tuple)) else [false_out]
+    assert len(t_outs) == len(f_outs), "cond branches must match arity"
+
+    # free vars read by each branch = inputs defined outside the branch block
+    def _free_vars(block):
+        defined = set()
+        free = []
+        for op in block.ops:
+            for n in op.input_names():
+                if n not in defined and n not in free and n != "@EMPTY@":
+                    if n not in block.vars:
+                        free.append(n)
+            defined.update(op.output_names())
+        return free
+
+    t_free = _free_vars(true_block)
+    f_free = _free_vars(false_block)
+    all_free = sorted(set(t_free) | set(f_free))
+
+    outs = [helper.create_variable_for_type_inference(v.dtype)
+            for v in t_outs]
+    parent.append_op(
+        "__cond__",
+        inputs={"Cond": [pred], "Free": all_free},
+        outputs={"Out": [o.name for o in outs]},
+        attrs={"true_block": true_block.idx, "false_block": false_block.idx,
+               "true_outs": [v.name for v in t_outs],
+               "false_outs": [v.name for v in f_outs],
+               "free_names": all_free})
+    return outs[0] if len(outs) == 1 else outs
+
+
+@register("__cond__")
+def _lower_cond(ctx, ins, attrs):
+    from ..framework.executor import _run_block  # late import, avoids cycle
+    pred = ins["Cond"][0]
+    free_names = attrs["free_names"]
+    free_vals = ins["Free"]
+
+    # NOTE: block objects are looked up through a thread-local set by the
+    # executor when lowering programs with sub-blocks.
+    from ..framework import executor as _ex
+    program = _ex._current_lowering_program()
+    tb = program.blocks[attrs["true_block"]]
+    fb = program.blocks[attrs["false_block"]]
+
+    def make_branch(block, out_names):
+        def branch(free):
+            env = dict(zip(free_names, free))
+            fetches, _ = _run_block(block, [], out_names, [], [], [],
+                                    env, {}, {}, ctx.rng_key)
+            return fetches
+        return branch
+
+    outs = jax.lax.cond(pred.reshape(()) if hasattr(pred, "reshape") else pred,
+                        make_branch(tb, attrs["true_outs"]),
+                        make_branch(fb, attrs["false_outs"]),
+                        free_vals)
+    return {"Out": outs}
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    out = x if in_place else helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("increment", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"step": value})
+    return out
+
+
+def array_write(x, i, array=None):
+    raise NotImplementedError(
+        "LoDTensorArray ops land with the sequence stack (bounded-size "
+        "buffers over lax.dynamic_update_slice); use dygraph mode meanwhile")
+
+
+def array_read(array, i):
+    raise NotImplementedError(
+        "LoDTensorArray ops land with the sequence stack; use dygraph mode")
+
+
+class While:
+    def __init__(self, cond, is_test=False, name=None):
+        raise NotImplementedError(
+            "static While lands with the control-flow stack (lax.while_loop "
+            "lowering); use dygraph mode or lax-style layers meanwhile")
+
+
+class StaticRNN:
+    def __init__(self, name=None):
+        raise NotImplementedError(
+            "StaticRNN lands with the control-flow stack (lax.scan lowering)")
+
+
+class Switch:
+    def __init__(self, name=None):
+        raise NotImplementedError("use layers.cond")

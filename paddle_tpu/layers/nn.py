@@ -1,0 +1,797 @@
+"""Neural-net layer functions (reference python/paddle/fluid/layers/nn.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.dtype import convert_dtype, dtype_name
+from ..layer_helper import LayerHelper, ParamAttr
+from .. import initializer as init_mod
+
+__all__ = [
+    "data", "fc", "conv2d", "conv2d_transpose", "pool2d", "batch_norm",
+    "layer_norm", "group_norm", "instance_norm", "dropout", "embedding",
+    "relu", "sigmoid", "tanh", "softmax", "log_softmax", "gelu", "leaky_relu",
+    "elementwise_add", "elementwise_sub", "elementwise_mul", "elementwise_div",
+    "elementwise_max", "elementwise_min", "elementwise_pow",
+    "matmul", "mul", "reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
+    "reduce_prod", "mean", "scale", "cast", "reshape", "transpose", "concat",
+    "split", "stack", "unstack", "squeeze", "unsqueeze", "flatten", "slice",
+    "gather", "gather_nd", "scatter", "expand", "one_hot", "topk", "argmax",
+    "argmin", "argsort", "accuracy", "auc", "clip", "clip_by_norm", "sums",
+    "elementwise_mod", "elementwise_floordiv", "l2_normalize", "pad", "pad2d",
+    "image_resize", "resize_nearest", "resize_bilinear", "relu6",
+    "softplus", "swish", "hard_swish", "hard_sigmoid", "exp", "sqrt", "abs",
+    "square", "log", "floor", "ceil", "round", "sign", "pow", "cos", "sin",
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "where", "cond_take", "unique", "cumsum", "prelu", "brelu",
+]
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
+         stop_gradient=True):
+    """Declare an input variable (reference layers/data_feeder/data op).
+
+    append_batch_size=True prepends a -1 batch dim (fluid 1.x convention).
+    """
+    helper = LayerHelper("data")
+    full_shape = list(shape)
+    if append_batch_size and (not full_shape or full_shape[0] != -1):
+        full_shape = [-1] + full_shape
+    block = helper.main_program.global_block()
+    return block.create_var(name=name, shape=full_shape,
+                            dtype=convert_dtype(dtype), is_data=True,
+                            stop_gradient=stop_gradient)
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """Fully-connected (reference layers/nn.py fc → mul + elementwise_add)."""
+    helper = LayerHelper("fc")
+    in_shape = input.shape
+    in_features = int(np.prod([d for d in in_shape[num_flatten_dims:]]))
+    w = helper.create_parameter(param_attr, [in_features, size],
+                                dtype=dtype_name(input.dtype))
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("mul", inputs={"X": [input], "Y": [w]},
+                     outputs={"Out": [out]},
+                     attrs={"x_num_col_dims": num_flatten_dims,
+                            "y_num_col_dims": 1})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [size],
+                                    dtype=dtype_name(input.dtype), is_bias=True)
+        tmp = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("elementwise_add", inputs={"X": [out], "Y": [b]},
+                         outputs={"Out": [tmp]},
+                         attrs={"axis": num_flatten_dims})
+        out = tmp
+    return helper.append_activation(out, act)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           use_cudnn=True, name=None, data_format="NCHW"):
+    helper = LayerHelper("conv2d")
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    stride = [stride, stride] if isinstance(stride, int) else list(stride)
+    padding = [padding, padding] if isinstance(padding, int) else list(padding)
+    dilation = [dilation, dilation] if isinstance(dilation, int) else list(dilation)
+    c_in = input.shape[1]
+    groups = groups or 1
+    w_shape = [num_filters, c_in // groups] + list(filter_size)
+    fan_in = (c_in // groups) * filter_size[0] * filter_size[1]
+    default_init = init_mod.Normal(0.0, (2.0 / fan_in) ** 0.5)
+    w = helper.create_parameter(param_attr, w_shape,
+                                dtype=dtype_name(input.dtype),
+                                default_initializer=default_init)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("conv2d",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": stride, "paddings": padding,
+                            "dilations": dilation, "groups": groups})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters],
+                                    dtype=dtype_name(input.dtype), is_bias=True)
+        tmp = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("elementwise_add", inputs={"X": [out], "Y": [b]},
+                         outputs={"Out": [tmp]}, attrs={"axis": 1})
+        out = tmp
+    return helper.append_activation(out, act)
+
+
+def conv2d_transpose(input, num_filters, filter_size, stride=1, padding=0,
+                     dilation=1, param_attr=None, bias_attr=None, act=None,
+                     name=None):
+    helper = LayerHelper("conv2d_transpose")
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    stride = [stride, stride] if isinstance(stride, int) else list(stride)
+    padding = [padding, padding] if isinstance(padding, int) else list(padding)
+    dilation = [dilation, dilation] if isinstance(dilation, int) else list(dilation)
+    c_in = input.shape[1]
+    w = helper.create_parameter(param_attr, [c_in, num_filters] + filter_size,
+                                dtype=dtype_name(input.dtype))
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("conv2d_transpose",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": stride, "paddings": padding,
+                            "dilations": dilation})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters],
+                                    dtype=dtype_name(input.dtype), is_bias=True)
+        tmp = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("elementwise_add", inputs={"X": [out], "Y": [b]},
+                         outputs={"Out": [tmp]}, attrs={"axis": 1})
+        out = tmp
+    return helper.append_activation(out, act)
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
+           global_pooling=False, ceil_mode=False, exclusive=True, name=None,
+           adaptive=False):
+    helper = LayerHelper("pool2d")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("pool2d", inputs={"X": [input]}, outputs={"Out": [out]},
+                     attrs={"pooling_type": pool_type,
+                            "ksize": [pool_size, pool_size] if isinstance(pool_size, int) else list(pool_size),
+                            "strides": [pool_stride, pool_stride] if isinstance(pool_stride, int) else list(pool_stride),
+                            "paddings": [pool_padding, pool_padding] if isinstance(pool_padding, int) else list(pool_padding),
+                            "global_pooling": global_pooling,
+                            "exclusive": exclusive, "adaptive": adaptive})
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               name=None, moving_mean_name=None, moving_variance_name=None,
+               use_global_stats=False):
+    helper = LayerHelper("batch_norm")
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    dtype = "float32"
+    scale = helper.create_parameter(param_attr, [c], dtype=dtype,
+                                    default_initializer=init_mod.Constant(1.0))
+    bias = helper.create_parameter(bias_attr, [c], dtype=dtype, is_bias=True)
+    mean = helper.create_parameter(
+        ParamAttr(name=moving_mean_name, initializer=init_mod.Constant(0.0),
+                  trainable=False), [c], dtype=dtype)
+    var = helper.create_parameter(
+        ParamAttr(name=moving_variance_name, initializer=init_mod.Constant(1.0),
+                  trainable=False), [c], dtype=dtype)
+    y = helper.create_variable_for_type_inference(input.dtype)
+    saved_mean = helper.create_variable_for_type_inference(dtype)
+    saved_var = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "batch_norm",
+        inputs={"X": [input], "Scale": [scale], "Bias": [bias],
+                "Mean": [mean], "Variance": [var]},
+        outputs={"Y": [y], "MeanOut": [mean], "VarianceOut": [var],
+                 "SavedMean": [saved_mean], "SavedVariance": [saved_var]},
+        attrs={"momentum": momentum, "epsilon": epsilon,
+               "is_test": is_test or use_global_stats,
+               "data_layout": data_layout})
+    return helper.append_activation(y, act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, epsilon=1e-5,
+               param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper("layer_norm")
+    norm_shape = [int(np.prod(input.shape[begin_norm_axis:]))]
+    inputs = {"X": [input]}
+    if scale:
+        s = helper.create_parameter(param_attr, norm_shape, dtype="float32",
+                                    default_initializer=init_mod.Constant(1.0))
+        inputs["Scale"] = [s]
+    if shift:
+        b = helper.create_parameter(bias_attr, norm_shape, dtype="float32",
+                                    is_bias=True)
+        inputs["Bias"] = [b]
+    y = helper.create_variable_for_type_inference(input.dtype)
+    m = helper.create_variable_for_type_inference("float32")
+    v = helper.create_variable_for_type_inference("float32")
+    helper.append_op("layer_norm", inputs=inputs,
+                     outputs={"Y": [y], "Mean": [m], "Variance": [v]},
+                     attrs={"epsilon": epsilon,
+                            "begin_norm_axis": begin_norm_axis})
+    return helper.append_activation(y, act)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, name=None):
+    helper = LayerHelper("group_norm")
+    c = input.shape[1]
+    inputs = {"X": [input]}
+    if param_attr is not False:
+        s = helper.create_parameter(param_attr, [c], dtype="float32",
+                                    default_initializer=init_mod.Constant(1.0))
+        inputs["Scale"] = [s]
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [c], dtype="float32", is_bias=True)
+        inputs["Bias"] = [b]
+    y = helper.create_variable_for_type_inference(input.dtype)
+    m = helper.create_variable_for_type_inference("float32")
+    v = helper.create_variable_for_type_inference("float32")
+    helper.append_op("group_norm", inputs=inputs,
+                     outputs={"Y": [y], "Mean": [m], "Variance": [v]},
+                     attrs={"groups": groups, "epsilon": epsilon})
+    return helper.append_activation(y, act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    helper = LayerHelper("instance_norm")
+    c = input.shape[1]
+    inputs = {"X": [input]}
+    if param_attr is not False:
+        s = helper.create_parameter(param_attr, [c], dtype="float32",
+                                    default_initializer=init_mod.Constant(1.0))
+        inputs["Scale"] = [s]
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [c], dtype="float32", is_bias=True)
+        inputs["Bias"] = [b]
+    y = helper.create_variable_for_type_inference(input.dtype)
+    sm = helper.create_variable_for_type_inference("float32")
+    sv = helper.create_variable_for_type_inference("float32")
+    helper.append_op("instance_norm", inputs=inputs,
+                     outputs={"Y": [y], "SavedMean": [sm], "SavedVariance": [sv]},
+                     attrs={"epsilon": epsilon})
+    return y
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    helper = LayerHelper("dropout")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    mask = helper.create_variable_for_type_inference("uint8")
+    helper.append_op("dropout", inputs={"X": [x]},
+                     outputs={"Out": [out], "Mask": [mask]},
+                     attrs={"dropout_prob": dropout_prob, "is_test": is_test,
+                            "dropout_implementation": dropout_implementation})
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """Reference layers/nn.py embedding → lookup_table op. is_sparse is a
+    no-op on TPU (grads are dense segment-sums; see SURVEY §7 hard parts)."""
+    helper = LayerHelper("embedding")
+    w = helper.create_parameter(param_attr, list(size), dtype=dtype)
+    if is_distributed:
+        w.is_distributed = True
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("lookup_table", inputs={"W": [w], "Ids": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"padding_idx": -1 if padding_idx is None else padding_idx})
+    return out
+
+
+def _unary_layer(op_type):
+    def f(x, name=None):
+        helper = LayerHelper(op_type)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(op_type, inputs={"X": [x]}, outputs={"Out": [out]})
+        return out
+    f.__name__ = op_type
+    return f
+
+
+relu = _unary_layer("relu")
+sigmoid = _unary_layer("sigmoid")
+tanh = _unary_layer("tanh")
+exp = _unary_layer("exp")
+sqrt = _unary_layer("sqrt")
+abs = _unary_layer("abs")
+square = _unary_layer("square")
+log = _unary_layer("log")
+floor = _unary_layer("floor")
+ceil = _unary_layer("ceil")
+round = _unary_layer("round")
+sign = _unary_layer("sign")
+cos = _unary_layer("cos")
+sin = _unary_layer("sin")
+softplus = _unary_layer("softplus")
+swish = _unary_layer("swish")
+hard_swish = _unary_layer("hard_swish")
+hard_sigmoid = _unary_layer("hard_sigmoid")
+relu6 = _unary_layer("relu6")
+logical_not = _unary_layer("logical_not")
+
+
+def softmax(input, axis=-1, name=None, use_cudnn=False):
+    helper = LayerHelper("softmax")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("softmax", inputs={"X": [input]}, outputs={"Out": [out]},
+                     attrs={"axis": axis})
+    return out
+
+
+def log_softmax(input, axis=-1, name=None):
+    helper = LayerHelper("log_softmax")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("log_softmax", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs={"axis": axis})
+    return out
+
+
+def gelu(x, approximate=False, name=None):
+    helper = LayerHelper("gelu")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("gelu", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"approximate": approximate})
+    return out
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    helper = LayerHelper("leaky_relu")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("leaky_relu", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"alpha": alpha})
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    helper = LayerHelper("prelu")
+    if mode == "all":
+        shape = [1]
+    elif mode == "channel":
+        shape = [x.shape[1]]
+    else:
+        shape = [int(np.prod(x.shape[1:]))]
+    alpha = helper.create_parameter(param_attr, shape, dtype="float32",
+                                    default_initializer=init_mod.Constant(0.25))
+    # prelu(x) = max(x, 0) + alpha * min(x, 0) built from primitive ops
+    pos = relu(x)
+    neg_in = elementwise_sub(x, pos)
+    neg = elementwise_mul(neg_in, alpha, axis=1 if mode == "channel" else -1)
+    return elementwise_add(pos, neg)
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    helper = LayerHelper("brelu")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("clip", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"min": t_min, "max": t_max})
+    return out
+
+
+def _binary_layer(op_type, out_slot="Out"):
+    def f(x, y, axis=-1, act=None, name=None):
+        helper = LayerHelper(op_type)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(op_type, inputs={"X": [x], "Y": [y]},
+                         outputs={out_slot: [out]}, attrs={"axis": axis})
+        return helper.append_activation(out, act)
+    f.__name__ = op_type
+    return f
+
+
+elementwise_add = _binary_layer("elementwise_add")
+elementwise_sub = _binary_layer("elementwise_sub")
+elementwise_mul = _binary_layer("elementwise_mul")
+elementwise_div = _binary_layer("elementwise_div")
+elementwise_max = _binary_layer("elementwise_max")
+elementwise_min = _binary_layer("elementwise_min")
+elementwise_pow = _binary_layer("elementwise_pow")
+elementwise_mod = _binary_layer("elementwise_mod")
+elementwise_floordiv = _binary_layer("elementwise_floordiv")
+
+
+def _compare_layer(op_type):
+    def f(x, y, cond=None, name=None):
+        helper = LayerHelper(op_type)
+        out = cond or helper.create_variable_for_type_inference("bool")
+        helper.append_op(op_type, inputs={"X": [x], "Y": [y]},
+                         outputs={"Out": [out]})
+        return out
+    f.__name__ = op_type
+    return f
+
+
+equal = _compare_layer("equal")
+not_equal = _compare_layer("not_equal")
+less_than = _compare_layer("less_than")
+less_equal = _compare_layer("less_equal")
+greater_than = _compare_layer("greater_than")
+greater_equal = _compare_layer("greater_equal")
+logical_and = _compare_layer("logical_and")
+logical_or = _compare_layer("logical_or")
+logical_xor = _compare_layer("logical_xor")
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper("matmul")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("matmul", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"transpose_X": transpose_x,
+                            "transpose_Y": transpose_y, "alpha": alpha})
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    helper = LayerHelper("mul")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("mul", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"x_num_col_dims": x_num_col_dims,
+                            "y_num_col_dims": y_num_col_dims})
+    return out
+
+
+def _reduce_layer(op_type):
+    def f(input, dim=None, keep_dim=False, name=None):
+        helper = LayerHelper(op_type)
+        out = helper.create_variable_for_type_inference(input.dtype)
+        if dim is None:
+            attrs = {"reduce_all": True, "dim": [0], "keep_dim": keep_dim}
+        else:
+            attrs = {"dim": dim if isinstance(dim, (list, tuple)) else [dim],
+                     "keep_dim": keep_dim, "reduce_all": False}
+        helper.append_op(op_type, inputs={"X": [input]},
+                         outputs={"Out": [out]}, attrs=attrs)
+        return out
+    f.__name__ = op_type
+    return f
+
+
+reduce_sum = _reduce_layer("reduce_sum")
+reduce_mean = _reduce_layer("reduce_mean")
+reduce_max = _reduce_layer("reduce_max")
+reduce_min = _reduce_layer("reduce_min")
+reduce_prod = _reduce_layer("reduce_prod")
+
+
+def mean(x, name=None):
+    helper = LayerHelper("mean")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("mean", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    helper = LayerHelper("scale")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("scale", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"scale": scale, "bias": bias,
+                            "bias_after_scale": bias_after_scale})
+    return helper.append_activation(out, act)
+
+
+def cast(x, dtype):
+    helper = LayerHelper("cast")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("cast", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"out_dtype": dtype_name(convert_dtype(dtype)),
+                            "in_dtype": dtype_name(x.dtype)})
+    return out
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
+    helper = LayerHelper("reshape2")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("reshape2", inputs={"X": [x]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"shape": list(shape)})
+    return helper.append_activation(out, act)
+
+
+def transpose(x, perm, name=None):
+    helper = LayerHelper("transpose2")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("transpose2", inputs={"X": [x]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axis": list(perm)})
+    return out
+
+
+def concat(input, axis=0, name=None):
+    helper = LayerHelper("concat")
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op("concat", inputs={"X": list(input)},
+                     outputs={"Out": [out]}, attrs={"axis": axis})
+    return out
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper("split")
+    axis = dim % len(input.shape)
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        attrs = {"num": n, "sections": [], "axis": axis}
+    else:
+        n = len(num_or_sections)
+        attrs = {"num": 0, "sections": list(num_or_sections), "axis": axis}
+    outs = [helper.create_variable_for_type_inference(input.dtype)
+            for _ in range(n)]
+    helper.append_op("split", inputs={"X": [input]}, outputs={"Out": outs},
+                     attrs=attrs)
+    return outs
+
+
+def stack(x, axis=0, name=None):
+    helper = LayerHelper("stack")
+    out = helper.create_variable_for_type_inference(x[0].dtype)
+    helper.append_op("stack", inputs={"X": list(x)}, outputs={"Y": [out]},
+                     attrs={"axis": axis})
+    return out
+
+
+def unstack(x, axis=0, num=None, name=None):
+    helper = LayerHelper("unstack")
+    n = num if num is not None else x.shape[axis]
+    outs = [helper.create_variable_for_type_inference(x.dtype)
+            for _ in range(n)]
+    helper.append_op("unstack", inputs={"X": [x]}, outputs={"Y": outs},
+                     attrs={"axis": axis})
+    return outs
+
+
+def squeeze(input, axes, name=None):
+    helper = LayerHelper("squeeze2")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    xshape = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("squeeze2", inputs={"X": [input]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axes": list(axes)})
+    return out
+
+
+def unsqueeze(input, axes, name=None):
+    helper = LayerHelper("unsqueeze2")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    xshape = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("unsqueeze2", inputs={"X": [input]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axes": list(axes)})
+    return out
+
+
+def flatten(x, axis=1, name=None):
+    helper = LayerHelper("flatten2")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("flatten2", inputs={"X": [x]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axis": axis})
+    return out
+
+
+def slice(input, axes, starts, ends, name=None):
+    helper = LayerHelper("slice")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("slice", inputs={"Input": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"axes": list(axes), "starts": list(starts),
+                            "ends": list(ends)})
+    return out
+
+
+def gather(input, index, overwrite=True, axis=0):
+    helper = LayerHelper("gather")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("gather", inputs={"X": [input], "Index": [index]},
+                     outputs={"Out": [out]}, attrs={"axis": axis})
+    return out
+
+
+def gather_nd(input, index, name=None):
+    helper = LayerHelper("gather_nd")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("gather_nd", inputs={"X": [input], "Index": [index]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def scatter(input, index, updates, overwrite=True, name=None):
+    helper = LayerHelper("scatter")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("scatter",
+                     inputs={"X": [input], "Ids": [index], "Updates": [updates]},
+                     outputs={"Out": [out]}, attrs={"overwrite": overwrite})
+    return out
+
+
+def expand(x, expand_times, name=None):
+    helper = LayerHelper("expand")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("expand", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"expand_times": list(expand_times)})
+    return out
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    helper = LayerHelper("one_hot")
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op("one_hot", inputs={"X": [input]}, outputs={"Out": [out]},
+                     attrs={"depth": depth})
+    return out
+
+
+def topk(input, k, name=None):
+    helper = LayerHelper("top_k")
+    vals = helper.create_variable_for_type_inference(input.dtype)
+    idxs = helper.create_variable_for_type_inference("int64")
+    helper.append_op("top_k", inputs={"X": [input]},
+                     outputs={"Out": [vals], "Indices": [idxs]},
+                     attrs={"k": k})
+    return vals, idxs
+
+
+def argmax(x, axis=0, name=None):
+    helper = LayerHelper("arg_max")
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op("arg_max", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"axis": axis})
+    return out
+
+
+def argmin(x, axis=0, name=None):
+    helper = LayerHelper("arg_min")
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op("arg_min", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"axis": axis})
+    return out
+
+
+def argsort(input, axis=-1, descending=False, name=None):
+    helper = LayerHelper("argsort")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    idxs = helper.create_variable_for_type_inference("int64")
+    helper.append_op("argsort", inputs={"X": [input]},
+                     outputs={"Out": [out], "Indices": [idxs]},
+                     attrs={"axis": axis, "descending": descending})
+    return out, idxs
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Reference layers/metric_op.py accuracy: top_k + accuracy op."""
+    helper = LayerHelper("accuracy")
+    vals, idxs = topk(input, k)
+    acc = helper.create_variable_for_type_inference("float32")
+    correct = correct or helper.create_variable_for_type_inference("int32")
+    total = total or helper.create_variable_for_type_inference("int32")
+    helper.append_op("accuracy",
+                     inputs={"Out": [vals], "Indices": [idxs],
+                             "Label": [label]},
+                     outputs={"Accuracy": [acc], "Correct": [correct],
+                              "Total": [total]})
+    return acc
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1, slide_steps=1):
+    """Reference layers/metric_op.py auc: streaming AUC with persistable stats."""
+    helper = LayerHelper("auc")
+    stat_pos = helper.create_global_variable([num_thresholds + 1], "int64")
+    stat_neg = helper.create_global_variable([num_thresholds + 1], "int64")
+    for v in (stat_pos, stat_neg):
+        init_mod.Constant(0)(v)
+    auc_out = helper.create_variable_for_type_inference("float64")
+    helper.append_op("auc",
+                     inputs={"Predict": [input], "Label": [label],
+                             "StatPos": [stat_pos], "StatNeg": [stat_neg]},
+                     outputs={"AUC": [auc_out], "StatPosOut": [stat_pos],
+                              "StatNegOut": [stat_neg]},
+                     attrs={"num_thresholds": num_thresholds})
+    return auc_out, [stat_pos, stat_neg]
+
+
+def clip(x, min, max, name=None):
+    helper = LayerHelper("clip")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("clip", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"min": min, "max": max})
+    return out
+
+
+def clip_by_norm(x, max_norm, name=None):
+    helper = LayerHelper("clip_by_norm")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("clip_by_norm", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"max_norm": max_norm})
+    return out
+
+
+def sums(input, out=None):
+    helper = LayerHelper("sum")
+    out = out or helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op("sum", inputs={"X": list(input)}, outputs={"Out": [out]})
+    return out
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    sq = square(x)
+    ssum = reduce_sum(sq, dim=axis, keep_dim=True)
+    norm = sqrt(elementwise_max(ssum, fill_constant_like(ssum, epsilon)))
+    return elementwise_div(x, norm)
+
+
+def fill_constant_like(x, value):
+    from .tensor import fill_constant
+    return fill_constant(shape=list(x.shape), dtype=dtype_name(x.dtype),
+                         value=value)
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    helper = LayerHelper("pad")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("pad", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"paddings": list(paddings), "pad_value": pad_value})
+    return out
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    helper = LayerHelper("pad2d")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("pad2d", inputs={"X": [input]}, outputs={"Out": [out]},
+                     attrs={"paddings": list(paddings), "mode": mode,
+                            "pad_value": pad_value})
+    return out
+
+
+def image_resize(input, out_shape=None, scale=None, resample="BILINEAR",
+                 name=None):
+    helper = LayerHelper("interpolate")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    method = {"BILINEAR": "bilinear", "NEAREST": "nearest",
+              "BICUBIC": "bicubic"}[resample]
+    attrs = {"interp_method": method}
+    if out_shape is not None:
+        attrs["out_h"], attrs["out_w"] = out_shape
+    else:
+        attrs["scale"] = scale
+    helper.append_op("interpolate", inputs={"X": [input]},
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None):
+    return image_resize(input, out_shape, scale, "NEAREST")
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None):
+    return image_resize(input, out_shape, scale, "BILINEAR")
+
+
+def where(condition, x, y, name=None):
+    helper = LayerHelper("where")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("where",
+                     inputs={"Condition": [condition], "X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def cond_take(condition, x):
+    raise NotImplementedError(
+        "dynamic-shape cond_take is eager-only on TPU; use dygraph mode")
+
+
+def unique(x, dtype="int64"):
+    helper = LayerHelper("unique")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    index = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("unique", inputs={"X": [x]},
+                     outputs={"Out": [out], "Index": [index]})
+    return out, index
+
+
+def cumsum(x, axis=-1, exclusive=False, reverse=False):
+    helper = LayerHelper("cumsum")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("cumsum", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"axis": axis, "exclusive": exclusive,
+                            "reverse": reverse})
+    return out
+
+
+def pow(x, factor=1.0, name=None):
+    helper = LayerHelper("pow")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("pow", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"factor": factor})
+    return out

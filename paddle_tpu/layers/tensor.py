@@ -1,0 +1,182 @@
+"""Tensor creation layer functions (reference fluid/layers/tensor.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.dtype import convert_dtype, dtype_name
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "fill_constant", "fill_constant_batch_size_like", "zeros", "ones",
+    "zeros_like", "ones_like", "assign", "create_tensor",
+    "create_global_var", "create_parameter", "linspace", "eye", "diag",
+    "range", "shape", "uniform_random", "gaussian_random", "tril", "triu",
+]
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    helper = LayerHelper("fill_constant")
+    out = out or helper.create_variable_for_type_inference(dtype)
+    helper.append_op("fill_constant", outputs={"Out": [out]},
+                     attrs={"shape": list(shape),
+                            "dtype": dtype_name(convert_dtype(dtype)),
+                            "value": float(value)})
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    helper = LayerHelper("fill_constant_batch_size_like")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("fill_constant_batch_size_like",
+                     inputs={"Input": [input]}, outputs={"Out": [out]},
+                     attrs={"shape": list(shape),
+                            "dtype": dtype_name(convert_dtype(dtype)),
+                            "value": float(value),
+                            "input_dim_idx": input_dim_idx,
+                            "output_dim_idx": output_dim_idx})
+    return out
+
+
+def zeros(shape, dtype="float32", force_cpu=False):
+    return fill_constant(shape, dtype, 0.0)
+
+
+def ones(shape, dtype="float32", force_cpu=False):
+    return fill_constant(shape, dtype, 1.0)
+
+
+def zeros_like(x, out=None):
+    helper = LayerHelper("fill_zeros_like")
+    out = out or helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("fill_zeros_like", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def ones_like(x, out=None):
+    return fill_constant(list(x.shape), dtype_name(x.dtype), 1.0, out=out)
+
+
+def assign(input, output=None):
+    helper = LayerHelper("assign")
+    if isinstance(input, np.ndarray):
+        output = output or helper.create_variable_for_type_inference(
+            str(input.dtype))
+        helper.append_op("assign_value", outputs={"Out": [output]},
+                         attrs={"shape": list(input.shape),
+                                "dtype": str(input.dtype),
+                                "values": input.reshape(-1).tolist()})
+        return output
+    output = output or helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("assign", inputs={"X": [input]},
+                     outputs={"Out": [output]})
+    return output
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper("create_tensor")
+    block = helper.main_program.current_block()
+    return block.create_var(name=name, shape=(), dtype=convert_dtype(dtype),
+                            persistable=persistable)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    helper = LayerHelper("global_var")
+    var = helper.create_global_variable(shape, dtype, persistable=persistable,
+                                        name=name)
+    from .. import initializer
+    initializer.Constant(value)(var)
+    return var
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    helper = LayerHelper("create_parameter")
+    from ..layer_helper import ParamAttr
+    attr = attr or ParamAttr(name=name)
+    return helper.create_parameter(attr, shape, dtype, is_bias,
+                                   default_initializer)
+
+
+def linspace(start, stop, num, dtype="float32"):
+    helper = LayerHelper("linspace")
+    out = helper.create_variable_for_type_inference(dtype)
+    # constant fold: emit assign_value (XLA sees a literal)
+    vals = np.linspace(start, stop, num).astype(convert_dtype(dtype))
+    helper.append_op("assign_value", outputs={"Out": [out]},
+                     attrs={"shape": [num], "dtype": dtype_name(convert_dtype(dtype)),
+                            "values": vals.tolist()})
+    return out
+
+
+def eye(num_rows, num_columns=None, batch_shape=None, dtype="float32"):
+    helper = LayerHelper("eye")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("eye", outputs={"Out": [out]},
+                     attrs={"num_rows": num_rows,
+                            "num_columns": num_columns or num_rows,
+                            "dtype": dtype_name(convert_dtype(dtype))})
+    return out
+
+
+def diag(diagonal):
+    if isinstance(diagonal, np.ndarray):
+        return assign(np.diag(diagonal))
+    raise NotImplementedError("diag of a Variable: use dygraph mode")
+
+
+def range(start, end, step, dtype="float32"):
+    helper = LayerHelper("range")
+    out = helper.create_variable_for_type_inference(dtype)
+    vals = np.arange(start, end, step).astype(convert_dtype(dtype))
+    helper.append_op("assign_value", outputs={"Out": [out]},
+                     attrs={"shape": [len(vals)],
+                            "dtype": dtype_name(convert_dtype(dtype)),
+                            "values": vals.tolist()})
+    return out
+
+
+def shape(input):
+    helper = LayerHelper("shape")
+    out = helper.create_variable_for_type_inference("int32")
+    helper.append_op("shape", inputs={"Input": [input]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper("uniform_random")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("uniform_random", outputs={"Out": [out]},
+                     attrs={"shape": list(shape),
+                            "dtype": dtype_name(convert_dtype(dtype)),
+                            "min": min, "max": max})
+    return out
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
+    helper = LayerHelper("gaussian_random")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("gaussian_random", outputs={"Out": [out]},
+                     attrs={"shape": list(shape),
+                            "dtype": dtype_name(convert_dtype(dtype)),
+                            "mean": mean, "std": std})
+    return out
+
+
+def tril(x, diagonal=0):
+    helper = LayerHelper("tril_triu")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("tril_triu", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"diagonal": diagonal, "lower": True})
+    return out
+
+
+def triu(x, diagonal=0):
+    helper = LayerHelper("tril_triu")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("tril_triu", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"diagonal": diagonal, "lower": False})
+    return out

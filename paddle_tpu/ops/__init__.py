@@ -1,0 +1,2 @@
+from . import registry
+from .registry import register, get, has, all_ops, LowerCtx

@@ -1,0 +1,349 @@
+"""Math / elementwise / reduce / matmul op lowerings.
+
+Parity targets (reference): paddle/fluid/operators/elementwise/*,
+operators/reduce_ops/*, matmul_op.cc, mul_op.cc, scale_op.cc, cast_op.cc,
+sum_op.cc, clip_op.cc, activation_op.cc. Each reference op family had separate
+CPU/CUDA kernels + hand-written grad kernels; here each is one JAX lowering
+(grads via the generic __vjp__ op) and XLA/MXU does the codegen.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+from ..framework.dtype import convert_dtype
+
+
+def _bcast_y(x, y, axis):
+    """Fluid elementwise broadcasting: Y's shape must be a contiguous
+    subsequence of X's; `axis` is where it aligns (-1 = align trailing).
+    Reference: operators/elementwise/elementwise_op_function.h."""
+    if x.ndim == y.ndim:
+        return y
+    if axis == -1 or axis is None:
+        axis = x.ndim - y.ndim
+    new_shape = (1,) * axis + y.shape + (1,) * (x.ndim - axis - y.ndim)
+    return y.reshape(new_shape)
+
+
+def _elementwise(name, fn):
+    @register(name)
+    def _lower(ctx, ins, attrs, _fn=fn):
+        x, y = ins["X"][0], ins["Y"][0]
+        y = _bcast_y(x, y, attrs.get("axis", -1))
+        return {"Out": [_fn(x, y)]}
+    return _lower
+
+
+_elementwise("elementwise_add", jnp.add)
+_elementwise("elementwise_sub", jnp.subtract)
+_elementwise("elementwise_mul", jnp.multiply)
+_elementwise("elementwise_div", jnp.divide)
+_elementwise("elementwise_min", jnp.minimum)
+_elementwise("elementwise_max", jnp.maximum)
+_elementwise("elementwise_pow", jnp.power)
+_elementwise("elementwise_mod", jnp.mod)
+_elementwise("elementwise_floordiv", jnp.floor_divide)
+
+
+def _unary(name, fn):
+    @register(name)
+    def _lower(ctx, ins, attrs, _fn=fn):
+        return {"Out": [_fn(ins["X"][0])]}
+    return _lower
+
+
+# Activations (reference operators/activation_op.cc — 30+ kernels there)
+_unary("relu", jax.nn.relu)
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("tanh", jnp.tanh)
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("log2", jnp.log2)
+_unary("log10", jnp.log10)
+_unary("log1p", jnp.log1p)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", jax.lax.rsqrt)
+_unary("abs", jnp.abs)
+_unary("square", jnp.square)
+_unary("reciprocal", jnp.reciprocal)
+_unary("floor", jnp.floor)
+_unary("ceil", jnp.ceil)
+_unary("round", jnp.round)
+_unary("sign", jnp.sign)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("asin", jnp.arcsin)
+_unary("acos", jnp.arccos)
+_unary("atan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("softsign", jax.nn.soft_sign)
+_unary("softplus", jax.nn.softplus)
+_unary("erf", jax.scipy.special.erf)
+_unary("logsigmoid", jax.nn.log_sigmoid)
+
+
+@register("gelu")
+def _gelu(ctx, ins, attrs):
+    approx = attrs.get("approximate", False)
+    return {"Out": [jax.nn.gelu(ins["X"][0], approximate=bool(approx))]}
+
+
+@register("leaky_relu")
+def _leaky_relu(ctx, ins, attrs):
+    alpha = attrs.get("alpha", 0.02)
+    return {"Out": [jax.nn.leaky_relu(ins["X"][0], negative_slope=alpha)]}
+
+
+@register("elu")
+def _elu(ctx, ins, attrs):
+    return {"Out": [jax.nn.elu(ins["X"][0], alpha=attrs.get("alpha", 1.0))]}
+
+
+@register("hard_sigmoid")
+def _hard_sigmoid(ctx, ins, attrs):
+    slope = attrs.get("slope", 0.2)
+    offset = attrs.get("offset", 0.5)
+    return {"Out": [jnp.clip(ins["X"][0] * slope + offset, 0.0, 1.0)]}
+
+
+@register("hard_swish")
+def _hard_swish(ctx, ins, attrs):
+    x = ins["X"][0]
+    t = attrs.get("threshold", 6.0)
+    s = attrs.get("scale", 6.0)
+    o = attrs.get("offset", 3.0)
+    return {"Out": [x * jnp.clip(x + o, 0.0, t) / s]}
+
+
+@register("swish")
+def _swish(ctx, ins, attrs):
+    x = ins["X"][0]
+    beta = attrs.get("beta", 1.0)
+    return {"Out": [x * jax.nn.sigmoid(beta * x)]}
+
+
+@register("relu6")
+def _relu6(ctx, ins, attrs):
+    return {"Out": [jnp.clip(ins["X"][0], 0.0, attrs.get("threshold", 6.0))]}
+
+
+@register("pow")
+def _pow(ctx, ins, attrs):
+    return {"Out": [jnp.power(ins["X"][0], attrs.get("factor", 1.0))]}
+
+
+@register("scale")
+def _scale(ctx, ins, attrs):
+    x = ins["X"][0]
+    s = attrs.get("scale", 1.0)
+    b = attrs.get("bias", 0.0)
+    if "ScaleTensor" in ins and ins["ScaleTensor"]:
+        s = ins["ScaleTensor"][0]
+    if attrs.get("bias_after_scale", True):
+        out = x * s + jnp.asarray(b, x.dtype)
+    else:
+        out = (x + jnp.asarray(b, x.dtype)) * s
+    return {"Out": [out.astype(x.dtype)]}
+
+
+@register("clip")
+def _clip(ctx, ins, attrs):
+    return {"Out": [jnp.clip(ins["X"][0], attrs.get("min"), attrs.get("max"))]}
+
+
+@register("clip_by_norm")
+def _clip_by_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": [x * scale.astype(x.dtype)]}
+
+
+@register("cast", nondiff_slots=("X",))
+def _cast(ctx, ins, attrs):
+    out_dtype = convert_dtype(attrs.get("out_dtype", "float32"))
+    return {"Out": [ins["X"][0].astype(out_dtype)]}
+
+
+@register("sum")
+def _sum(ctx, ins, attrs):
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+@register("mean")
+def _mean(ctx, ins, attrs):
+    return {"Out": [jnp.mean(ins["X"][0])]}
+
+
+def _reduce(name, fn):
+    @register(name)
+    def _lower(ctx, ins, attrs, _fn=fn):
+        x = ins["X"][0]
+        dim = attrs.get("dim", [0])
+        keep_dim = attrs.get("keep_dim", False)
+        if attrs.get("reduce_all", False) or dim is None:
+            axes = None
+        else:
+            axes = tuple(d % x.ndim for d in (dim if isinstance(dim, (list, tuple)) else [dim]))
+        return {"Out": [_fn(x, axis=axes, keepdims=keep_dim)]}
+    return _lower
+
+
+_reduce("reduce_sum", jnp.sum)
+_reduce("reduce_mean", jnp.mean)
+_reduce("reduce_max", jnp.max)
+_reduce("reduce_min", jnp.min)
+_reduce("reduce_prod", jnp.prod)
+_reduce("reduce_any", jnp.any)
+_reduce("reduce_all", jnp.all)
+
+
+@register("matmul")
+def _matmul(ctx, ins, attrs):
+    """Reference matmul_op.cc: optional transposes + alpha scaling; rides the
+    MXU via jnp.matmul (batched dims broadcast)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    if attrs.get("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if attrs.get("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y)
+    alpha = attrs.get("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": [out]}
+
+
+@register("matmul_v2")
+def _matmul_v2(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    if attrs.get("trans_x", False):
+        x = jnp.swapaxes(x, -1, -2)
+    if attrs.get("trans_y", False):
+        y = jnp.swapaxes(y, -1, -2)
+    return {"Out": [jnp.matmul(x, y)]}
+
+
+@register("mul")
+def _mul(ctx, ins, attrs):
+    """Reference mul_op.cc: flatten to 2-D by num_col_dims then GEMM."""
+    x, y = ins["X"][0], ins["Y"][0]
+    xd = attrs.get("x_num_col_dims", 1)
+    yd = attrs.get("y_num_col_dims", 1)
+    xm = x.reshape((int(np.prod(x.shape[:xd])), -1))
+    ym = y.reshape((int(np.prod(y.shape[:yd])), -1))
+    out = xm @ ym
+    out_shape = x.shape[:xd] + y.shape[yd:]
+    return {"Out": [out.reshape(out_shape)]}
+
+
+@register("bmm")
+def _bmm(ctx, ins, attrs):
+    return {"Out": [jnp.matmul(ins["X"][0], ins["Y"][0])]}
+
+
+@register("dot")
+def _dot(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.sum(x * y, axis=-1, keepdims=x.ndim == 1)]}
+
+
+@register("p_norm")
+def _p_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs.get("porder", 2.0)
+    axis = attrs.get("axis", -1)
+    keepdim = attrs.get("keepdim", False)
+    out = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+    return {"Out": [out]}
+
+
+@register("squared_l2_norm")
+def _squared_l2_norm(ctx, ins, attrs):
+    return {"Out": [jnp.sum(jnp.square(ins["X"][0])).reshape((1,))]}
+
+
+@register("cumsum")
+def _cumsum(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    if attrs.get("flatten", False):
+        x = x.reshape(-1)
+        axis = 0
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        out = out - x
+    if attrs.get("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+    return {"Out": [out]}
+
+
+@register("maximum")
+def _maximum(ctx, ins, attrs):
+    return {"Out": [jnp.maximum(ins["X"][0], ins["Y"][0])]}
+
+
+@register("minimum")
+def _minimum(ctx, ins, attrs):
+    return {"Out": [jnp.minimum(ins["X"][0], ins["Y"][0])]}
+
+
+def _compare(name, fn):
+    @register(name, nondiff_slots=("X", "Y"))
+    def _lower(ctx, ins, attrs, _fn=fn):
+        x, y = ins["X"][0], ins["Y"][0]
+        return {"Out": [_fn(x, y)]}
+    return _lower
+
+
+_compare("equal", jnp.equal)
+_compare("not_equal", jnp.not_equal)
+_compare("less_than", jnp.less)
+_compare("less_equal", jnp.less_equal)
+_compare("greater_than", jnp.greater)
+_compare("greater_equal", jnp.greater_equal)
+
+
+def _logical(name, fn, unary=False):
+    @register(name, nondiff_slots=("X", "Y"))
+    def _lower(ctx, ins, attrs, _fn=fn, _unary=unary):
+        if _unary:
+            return {"Out": [_fn(ins["X"][0])]}
+        return {"Out": [_fn(ins["X"][0], ins["Y"][0])]}
+    return _lower
+
+
+_logical("logical_and", jnp.logical_and)
+_logical("logical_or", jnp.logical_or)
+_logical("logical_xor", jnp.logical_xor)
+_logical("logical_not", jnp.logical_not, unary=True)
+
+
+@register("isfinite", nondiff_slots=("X",))
+def _isfinite(ctx, ins, attrs):
+    return {"Out": [jnp.all(jnp.isfinite(ins["X"][0]))]}
+
+
+@register("isfinite_v2", nondiff_slots=("X",))
+def _isfinite_v2(ctx, ins, attrs):
+    return {"Out": [jnp.isfinite(ins["X"][0])]}
+
+
+@register("isnan_v2", nondiff_slots=("X",))
+def _isnan(ctx, ins, attrs):
+    return {"Out": [jnp.isnan(ins["X"][0])]}
+
+
+@register("isinf_v2", nondiff_slots=("X",))
+def _isinf(ctx, ins, attrs):
+    return {"Out": [jnp.isinf(ins["X"][0])]}

@@ -1,0 +1,100 @@
+"""Metric + AMP utility op lowerings.
+
+Parity targets (reference): operators/metrics/accuracy_op.cc, auc_op.cc;
+operators/amp/check_finite_and_unscale_op.cc, update_loss_scaling_op.cc.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("accuracy", nondiff_slots=("Out", "Indices", "Label"))
+def _accuracy(ctx, ins, attrs):
+    """Reference accuracy_op.cc: fraction of rows whose top-k Indices contain
+    the Label."""
+    indices = ins["Indices"][0].astype(jnp.int64)
+    label = ins["Label"][0].astype(jnp.int64)
+    if label.ndim == indices.ndim:
+        label_col = label
+    else:
+        label_col = label[..., None]
+    correct_mat = (indices == label_col).any(axis=-1)
+    num_correct = jnp.sum(correct_mat.astype(jnp.float32))
+    total = correct_mat.size
+    acc = (num_correct / total).astype(jnp.float32)
+    return {"Accuracy": [acc],
+            "Correct": [num_correct.astype(jnp.int32)],
+            "Total": [jnp.asarray(total, jnp.int32)]}
+
+
+@register("auc", nondiff_slots=("Predict", "Label", "StatPos", "StatNeg"))
+def _auc(ctx, ins, attrs):
+    """Streaming AUC via threshold buckets (reference auc_op.cc)."""
+    pred = ins["Predict"][0]
+    label = ins["Label"][0].reshape(-1)
+    stat_pos = ins["StatPos"][0]
+    stat_neg = ins["StatNeg"][0]
+    num_thresholds = attrs.get("num_thresholds", 4095)
+    prob = pred[:, -1] if pred.ndim == 2 else pred.reshape(-1)
+    bucket = jnp.clip((prob * num_thresholds).astype(jnp.int32), 0,
+                      num_thresholds)
+    is_pos = (label > 0).astype(jnp.int64)
+    pos_add = jnp.zeros_like(stat_pos).at[bucket].add(is_pos)
+    neg_add = jnp.zeros_like(stat_neg).at[bucket].add(1 - is_pos)
+    new_pos = stat_pos + pos_add
+    new_neg = stat_neg + neg_add
+    # AUC = sum over buckets (descending threshold) of trapezoid areas
+    pos_rev = jnp.cumsum(new_pos[::-1])
+    neg_rev = jnp.cumsum(new_neg[::-1])
+    tot_pos = pos_rev[-1].astype(jnp.float64)
+    tot_neg = neg_rev[-1].astype(jnp.float64)
+    prev_pos = jnp.concatenate([jnp.zeros(1, pos_rev.dtype), pos_rev[:-1]])
+    area = jnp.sum((pos_rev + prev_pos) * new_neg[::-1] / 2.0)
+    auc = jnp.where(tot_pos * tot_neg > 0, area / (tot_pos * tot_neg), 0.0)
+    return {"AUC": [auc.astype(jnp.float64)],
+            "StatPosOut": [new_pos], "StatNegOut": [new_neg]}
+
+
+@register("check_finite_and_unscale",
+          nondiff_slots=("X", "Scale"))
+def _check_finite_and_unscale(ctx, ins, attrs):
+    """Reference check_finite_and_unscale_op.cc: divide grads by loss scale and
+    flag any non-finite value."""
+    scale = ins["Scale"][0]
+    outs = []
+    found_inf = jnp.asarray(False)
+    inv = 1.0 / scale
+    for x in ins["X"]:
+        found_inf = jnp.logical_or(found_inf, ~jnp.all(jnp.isfinite(x)))
+        outs.append((x.astype(jnp.float32) * inv).astype(x.dtype))
+    return {"Out": outs, "FoundInfinite": [found_inf]}
+
+
+@register("update_loss_scaling",
+          nondiff_slots=("X", "FoundInfinite", "PrevLossScaling",
+                         "InGoodSteps", "InBadSteps"))
+def _update_loss_scaling(ctx, ins, attrs):
+    """Reference update_loss_scaling_op.cc: dynamic loss scale state machine."""
+    found_inf = ins["FoundInfinite"][0]
+    scale = ins["PrevLossScaling"][0]
+    good = ins["InGoodSteps"][0]
+    bad = ins["InBadSteps"][0]
+    incr_every = attrs.get("incr_every_n_steps", 1000)
+    decr_every = attrs.get("decr_every_n_nan_or_inf", 2)
+    incr_ratio = attrs.get("incr_ratio", 2.0)
+    decr_ratio = attrs.get("decr_ratio", 0.5)
+
+    new_bad = jnp.where(found_inf, bad + 1, 0)
+    new_good = jnp.where(found_inf, 0, good + 1)
+    shrink = new_bad >= decr_every
+    grow = new_good >= incr_every
+    new_scale = jnp.where(shrink, jnp.maximum(scale * decr_ratio, 1.0),
+                          jnp.where(grow, scale * incr_ratio, scale))
+    new_bad = jnp.where(shrink, 0, new_bad)
+    new_good = jnp.where(grow, 0, new_good)
+    # zero out grads when non-finite (reference zeroes X outputs on overflow)
+    outs = [jnp.where(found_inf, jnp.zeros_like(x), x) for x in ins["X"]]
+    return {"Out": outs, "LossScaling": [new_scale],
+            "OutGoodSteps": [new_good], "OutBadSteps": [new_bad]}

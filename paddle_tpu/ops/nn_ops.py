@@ -1,0 +1,414 @@
+"""NN op lowerings: conv, pool, norms, softmax, losses, dropout, embedding.
+
+Parity targets (reference): operators/conv_op.cc, pool_op.cc, batch_norm_op.cc,
+layer_norm_op.cc, softmax_op.cc, cross_entropy_op.cc,
+softmax_with_cross_entropy_op.cc, dropout_op.cc, lookup_table_op.cc — each of
+which has separate CUDA/cuDNN kernels and hand-written grads there. Here:
+single JAX lowerings; conv/matmul map onto the MXU; grads via __vjp__.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(v) if len(v) == n else tuple(v) * n
+    return (v,) * n
+
+
+@register("conv2d")
+def _conv2d(ctx, ins, attrs):
+    """NCHW / OIHW convolution (reference conv_op.cc). XLA retiles for the MXU;
+    groups supported (depthwise = groups == C_in)."""
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = _pair(attrs.get("strides", [1, 1]))
+    paddings = _pair(attrs.get("paddings", [0, 0]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1) or 1
+    out = jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=strides,
+        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+    )
+    return {"Output": [out.astype(x.dtype)]}
+
+
+@register("depthwise_conv2d")
+def _depthwise_conv2d(ctx, ins, attrs):
+    attrs = dict(attrs)
+    attrs["groups"] = ins["Input"][0].shape[1]
+    return _conv2d.__wrapped__(ctx, ins, attrs) if hasattr(_conv2d, "__wrapped__") \
+        else _conv2d(ctx, ins, attrs)
+
+
+@register("conv2d_transpose")
+def _conv2d_transpose(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = _pair(attrs.get("strides", [1, 1]))
+    paddings = _pair(attrs.get("paddings", [0, 0]))
+    dilations = _pair(attrs.get("dilations", [1, 1]))
+    out = jax.lax.conv_transpose(
+        x, w,
+        strides=strides,
+        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        transpose_kernel=True,
+    )
+    return {"Output": [out]}
+
+
+def _extract_windows(x, ksize, strides, pad_value):
+    """Gather all pooling windows: (N,C,H,W) -> (N,C,H',kh,W',kw).
+
+    Gather-based (not reduce_window) because jax.vjp of reduce_window-max
+    fails under jit in jax 0.9; gathers differentiate cleanly and XLA still
+    fuses the subsequent reduce.
+    """
+    kh, kw = ksize
+    sh, sw = strides
+    oh = (x.shape[2] - kh) // sh + 1
+    ow = (x.shape[3] - kw) // sw + 1
+    idx_h = (np.arange(oh)[:, None] * sh + np.arange(kh)[None, :])  # (oh,kh)
+    idx_w = (np.arange(ow)[:, None] * sw + np.arange(kw)[None, :])  # (ow,kw)
+    return x[:, :, idx_h[:, :, None, None], idx_w[None, None, :, :]]
+
+
+@register("pool2d")
+def _pool2d(ctx, ins, attrs):
+    x = ins["X"][0]
+    ptype = attrs.get("pooling_type", "max")
+    ksize = _pair(attrs.get("ksize", [2, 2]))
+    strides = _pair(attrs.get("strides", ksize))
+    paddings = _pair(attrs.get("paddings", [0, 0]))
+    if attrs.get("global_pooling", False):
+        fn = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": [fn(x, axis=(2, 3), keepdims=True)]}
+    if attrs.get("adaptive", False):
+        # adaptive pooling to output size `ksize` (reference pool_op adaptive)
+        oh, ow = ksize
+        h, w = x.shape[2], x.shape[3]
+        assert h % oh == 0 and w % ow == 0, "adaptive pool needs divisible dims"
+        ksize = (h // oh, w // ow)
+        strides = ksize
+        paddings = (0, 0)
+
+    n, c, h, w = x.shape
+    aligned = (tuple(ksize) == tuple(strides) and paddings == (0, 0)
+               and h % ksize[0] == 0 and w % ksize[1] == 0)
+    if aligned:
+        # fast path: pure reshape + reduce (XLA lowers this tightly on TPU)
+        xr = x.reshape(n, c, h // ksize[0], ksize[0], w // ksize[1], ksize[1])
+        out = (jnp.max if ptype == "max" else jnp.mean)(xr, axis=(3, 5))
+        return {"Out": [out]}
+
+    if ptype == "max":
+        pad_val = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                   else jnp.iinfo(x.dtype).min)
+        xp = jnp.pad(x, ((0, 0), (0, 0),
+                         (paddings[0], paddings[0]),
+                         (paddings[1], paddings[1])),
+                     constant_values=pad_val)
+        win = _extract_windows(xp, ksize, strides, pad_val)
+        out = jnp.max(win, axis=(3, 5))
+    else:
+        xp = jnp.pad(x, ((0, 0), (0, 0),
+                         (paddings[0], paddings[0]),
+                         (paddings[1], paddings[1])))
+        win = _extract_windows(xp, ksize, strides, 0.0)
+        summed = jnp.sum(win, axis=(3, 5))
+        if attrs.get("exclusive", True) and (paddings[0] or paddings[1]):
+            ones = jnp.ones((1, 1, h, w), x.dtype)
+            onesp = jnp.pad(ones, ((0, 0), (0, 0),
+                                   (paddings[0], paddings[0]),
+                                   (paddings[1], paddings[1])))
+            counts = jnp.sum(_extract_windows(onesp, ksize, strides, 0.0),
+                             axis=(3, 5))
+            out = summed / counts
+        else:
+            out = summed / float(ksize[0] * ksize[1])
+    return {"Out": [out]}
+
+
+@register("softmax")
+def _softmax(ctx, ins, attrs):
+    axis = attrs.get("axis", -1)
+    return {"Out": [jax.nn.softmax(ins["X"][0], axis=axis)]}
+
+
+@register("log_softmax")
+def _log_softmax(ctx, ins, attrs):
+    axis = attrs.get("axis", -1)
+    return {"Out": [jax.nn.log_softmax(ins["X"][0], axis=axis)]}
+
+
+@register("cross_entropy", nondiff_slots=("Label",))
+def _cross_entropy(ctx, ins, attrs):
+    """Reference cross_entropy_op.cc: X are probabilities. Hard labels are int
+    indices with a trailing 1-dim; soft labels are distributions."""
+    x, label = ins["X"][0], ins["Label"][0]
+    eps = 1e-12
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(jnp.maximum(x, eps)), axis=-1,
+                        keepdims=True)
+    else:
+        idx = label.astype(jnp.int32)
+        if idx.ndim == x.ndim:
+            idx = jnp.squeeze(idx, -1)
+        picked = jnp.take_along_axis(x, idx[..., None], axis=-1)
+        loss = -jnp.log(jnp.maximum(picked, eps))
+    return {"Y": [loss]}
+
+
+@register("softmax_with_cross_entropy", nondiff_slots=("Label",))
+def _softmax_with_cross_entropy(ctx, ins, attrs):
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    axis = attrs.get("axis", -1)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        idx = label.astype(jnp.int32)
+        if idx.ndim == logits.ndim:
+            idx = jnp.squeeze(idx, axis)
+        picked = jnp.take_along_axis(logp, idx[..., None], axis=axis)
+        loss = -picked
+    return {"Softmax": [jnp.exp(logp)], "Loss": [loss]}
+
+
+@register("sigmoid_cross_entropy_with_logits", nondiff_slots=("Label",))
+def _sigmoid_ce(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return {"Out": [loss]}
+
+
+@register("square_error_cost", nondiff_slots=())
+def _square_error_cost(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.square(x - y)]}
+
+
+@register("huber_loss", nondiff_slots=("Y",))
+def _huber_loss(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    d = attrs.get("delta", 1.0)
+    r = y - x
+    a = jnp.abs(r)
+    loss = jnp.where(a <= d, 0.5 * r * r, d * (a - 0.5 * d))
+    return {"Out": [loss], "Residual": [r]}
+
+
+@register("batch_norm", nondiff_slots=("Mean", "Variance"),
+          stateful_outputs=("MeanOut", "VarianceOut"))
+def _batch_norm(ctx, ins, attrs):
+    """Reference batch_norm_op.cc. NCHW; running stats are functional outputs
+    (MeanOut/VarianceOut) rather than in-place mutation."""
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean, var = ins["Mean"][0], ins["Variance"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False)
+    layout = attrs.get("data_layout", "NCHW")
+    c_axis = 1 if layout == "NCHW" else x.ndim - 1
+    red_axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = [1] * x.ndim
+    bshape[c_axis] = x.shape[c_axis]
+
+    if is_test:
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = mean
+        saved_var = var
+    else:
+        cf = jnp.float32
+        xf = x.astype(cf)
+        use_mean = jnp.mean(xf, axis=red_axes)
+        use_var = jnp.var(xf, axis=red_axes)
+        mean_out = mean * momentum + use_mean * (1 - momentum)
+        var_out = var * momentum + use_var * (1 - momentum)
+        saved_mean = use_mean
+        saved_var = 1.0 / jnp.sqrt(use_var + eps)
+    inv = (1.0 / jnp.sqrt(use_var.astype(jnp.float32) + eps))
+    y = (x - use_mean.reshape(bshape).astype(x.dtype)) * \
+        (inv.reshape(bshape) * scale.reshape(bshape)).astype(x.dtype) + \
+        bias.reshape(bshape).astype(x.dtype)
+    return {"Y": [y], "MeanOut": [mean_out], "VarianceOut": [var_out],
+            "SavedMean": [saved_mean], "SavedVariance": [saved_var]}
+
+
+@register("layer_norm")
+def _layer_norm(ctx, ins, attrs):
+    """Reference layer_norm_op.cc: normalize over dims >= begin_norm_axis."""
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    bna = attrs.get("begin_norm_axis", 1)
+    axes = tuple(range(bna, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - mean) / jnp.sqrt(var + eps)
+    if "Scale" in ins and ins["Scale"]:
+        y = y * ins["Scale"][0].astype(jnp.float32)
+    if "Bias" in ins and ins["Bias"]:
+        y = y + ins["Bias"][0].astype(jnp.float32)
+    return {"Y": [y.astype(x.dtype)],
+            "Mean": [jnp.squeeze(mean, axes)],
+            "Variance": [jnp.squeeze(var, axes)]}
+
+
+@register("instance_norm")
+def _instance_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + eps)
+    if "Scale" in ins and ins["Scale"]:
+        c = x.shape[1]
+        y = y * ins["Scale"][0].reshape((1, c) + (1,) * (x.ndim - 2))
+    if "Bias" in ins and ins["Bias"]:
+        c = x.shape[1]
+        y = y + ins["Bias"][0].reshape((1, c) + (1,) * (x.ndim - 2))
+    return {"Y": [y], "SavedMean": [jnp.squeeze(mean)],
+            "SavedVariance": [jnp.squeeze(var)]}
+
+
+@register("group_norm")
+def _group_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    g = attrs.get("groups", 32)
+    eps = attrs.get("epsilon", 1e-5)
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    y = ((xg - mean) / jnp.sqrt(var + eps)).reshape(x.shape)
+    if "Scale" in ins and ins["Scale"]:
+        y = y * ins["Scale"][0].reshape((1, c) + (1,) * (x.ndim - 2))
+    if "Bias" in ins and ins["Bias"]:
+        y = y + ins["Bias"][0].reshape((1, c) + (1,) * (x.ndim - 2))
+    return {"Y": [y], "Mean": [jnp.squeeze(mean)], "Variance": [jnp.squeeze(var)]}
+
+
+@register("dropout", is_random=True)
+def _dropout(ctx, ins, attrs):
+    """Reference dropout_op.cc. Mask is recomputed from the op's stable seed in
+    the backward pass (__vjp__ re-runs this lowering with identical attrs), so
+    no mask tensor needs saving — a memory win over the reference."""
+    x = ins["X"][0]
+    p = attrs.get("dropout_prob", 0.5)
+    is_test = attrs.get("is_test", False)
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if is_test or p == 0.0:
+        out = x if impl == "upscale_in_train" else x * (1.0 - p)
+        return {"Out": [out], "Mask": [jnp.ones(x.shape, jnp.uint8)]}
+    key = ctx.op_key(attrs)
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    else:
+        out = jnp.where(keep, x, 0.0).astype(x.dtype)
+    return {"Out": [out], "Mask": [keep.astype(jnp.uint8)]}
+
+
+@register("lookup_table", nondiff_slots=("Ids",))
+def _lookup_table(ctx, ins, attrs):
+    """Reference lookup_table_op.cc: Ids carry a trailing 1-dim."""
+    w, ids = ins["W"][0], ins["Ids"][0]
+    idx = ids.astype(jnp.int32)
+    if idx.shape and idx.shape[-1] == 1:
+        idx = jnp.squeeze(idx, -1)
+    out = jnp.take(w, idx, axis=0)
+    pad = attrs.get("padding_idx", -1)
+    if pad is not None and pad >= 0:
+        out = jnp.where((idx == pad)[..., None], 0.0, out)
+    return {"Out": [out]}
+
+
+@register("lookup_table_v2", nondiff_slots=("Ids",))
+def _lookup_table_v2(ctx, ins, attrs):
+    w, ids = ins["W"][0], ins["Ids"][0]
+    idx = ids.astype(jnp.int32)
+    out = jnp.take(w, idx, axis=0)
+    pad = attrs.get("padding_idx", -1)
+    if pad is not None and pad >= 0:
+        out = jnp.where((idx == pad)[..., None], 0.0, out)
+    return {"Out": [out]}
+
+
+@register("one_hot", nondiff_slots=("X",))
+def _one_hot(ctx, ins, attrs):
+    x = ins["X"][0].astype(jnp.int32)
+    depth = attrs["depth"]
+    if x.shape and x.shape[-1] == 1:
+        x = jnp.squeeze(x, -1)
+    return {"Out": [jax.nn.one_hot(x, depth, dtype=jnp.float32)]}
+
+
+@register("one_hot_v2", nondiff_slots=("X",))
+def _one_hot_v2(ctx, ins, attrs):
+    x = ins["X"][0].astype(jnp.int32)
+    return {"Out": [jax.nn.one_hot(x, attrs["depth"], dtype=jnp.float32)]}
+
+
+@register("pad")
+def _pad(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs["paddings"]
+    pads = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": [jnp.pad(x, pads, constant_values=attrs.get("pad_value", 0.0))]}
+
+
+@register("pad2d")
+def _pad2d(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs["paddings"]  # [top, bottom, left, right]
+    mode = attrs.get("mode", "constant")
+    pads = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if mode == "constant":
+        return {"Out": [jnp.pad(x, pads, constant_values=attrs.get("pad_value", 0.0))]}
+    jmode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return {"Out": [jnp.pad(x, pads, mode=jmode)]}
+
+
+@register("interpolate")
+def _interpolate(ctx, ins, attrs):
+    x = ins["X"][0]
+    method = attrs.get("interp_method", "nearest")
+    out_h = attrs.get("out_h", -1)
+    out_w = attrs.get("out_w", -1)
+    scale = attrs.get("scale", 0.0)
+    n, c, h, w = x.shape
+    if out_h <= 0:
+        out_h = int(h * scale)
+        out_w = int(w * scale)
+    jm = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic"}[method]
+    out = jax.image.resize(x, (n, c, out_h, out_w), method=jm)
+    return {"Out": [out.astype(x.dtype)]}
+
+
+@register("nearest_interp")
+def _nearest_interp(ctx, ins, attrs):
+    attrs = dict(attrs)
+    attrs["interp_method"] = "nearest"
+    return _interpolate(ctx, ins, attrs)
+
+
+@register("bilinear_interp")
+def _bilinear_interp(ctx, ins, attrs):
+    attrs = dict(attrs)
+    attrs["interp_method"] = "bilinear"
+    return _interpolate(ctx, ins, attrs)

@@ -1,0 +1,200 @@
+"""Optimizer op lowerings — device-side parameter update rules.
+
+Parity targets (reference): operators/optimizers/sgd_op.cc, momentum_op.cc,
+adam_op.cc, adamax_op.cc, adagrad_op.cc, rmsprop_op.cc, lamb_op.cc,
+lars_momentum_op.cc, ftrl_op.cc. The reference mutates Param in place; here
+updates are functional outputs (ParamOut etc.) that the Executor writes back to
+the Scope — which lets XLA donate the old buffers (true in-place on TPU).
+All optimizer ops are nondifferentiable (OpRole.Optimize).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+_OPT = dict(nondiff_slots=("Param", "Grad", "LearningRate", "Moment", "Moment1",
+                           "Moment2", "Beta1Pow", "Beta2Pow", "Velocity",
+                           "MeanSquare", "MeanGrad", "InfNorm", "MasterParam"))
+
+
+@register("sgd", **_OPT)
+def _sgd(ctx, ins, attrs):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    return {"ParamOut": [p - lr.astype(p.dtype) * g.astype(p.dtype)]}
+
+
+@register("momentum", **_OPT)
+def _momentum(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    v, lr = ins["Velocity"][0], ins["LearningRate"][0]
+    mu = attrs.get("mu", 0.9)
+    rd = attrs.get("regularization_coeff", 0.0)
+    if attrs.get("regularization_method", "") == "l2_decay" and rd:
+        g = g + rd * p
+    v_out = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_out = p - lr * (g + mu * v_out)
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": [p_out.astype(p.dtype)], "VelocityOut": [v_out]}
+
+
+@register("lars_momentum", **_OPT)
+def _lars_momentum(ctx, ins, attrs):
+    """LARS (reference lars_momentum_op.cc): layer-wise trust-ratio scaled LR."""
+    p, g = ins["Param"][0], ins["Grad"][0]
+    v, lr = ins["Velocity"][0], ins["LearningRate"][0]
+    mu = attrs.get("mu", 0.9)
+    coeff = attrs.get("lars_coeff", 0.001)
+    decay = attrs.get("lars_weight_decay", 0.0005)
+    eps = attrs.get("epsilon", 0.0)
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = lr * coeff * p_norm / (g_norm + decay * p_norm + eps)
+    v_out = mu * v + local_lr * (g + decay * p)
+    return {"ParamOut": [(p - v_out).astype(p.dtype)], "VelocityOut": [v_out]}
+
+
+@register("adam", **_OPT)
+def _adam(ctx, ins, attrs):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    gf = g.astype(m1.dtype)
+    m1_out = b1 * m1 + (1 - b1) * gf
+    m2_out = b2 * m2 + (1 - b2) * jnp.square(gf)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_out = p - (lr_t * m1_out / (jnp.sqrt(m2_out) + eps)).astype(p.dtype)
+    return {"ParamOut": [p_out], "Moment1Out": [m1_out], "Moment2Out": [m2_out],
+            "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2]}
+
+
+@register("adamw", **_OPT)
+def _adamw(ctx, ins, attrs):
+    p = ins["Param"][0]
+    coeff = attrs.get("coeff", 0.01)
+    lr = ins["LearningRate"][0]
+    res = _adam(ctx, ins, attrs)
+    if not attrs.get("with_decay", True):
+        return res
+    res["ParamOut"] = [res["ParamOut"][0] - (lr * coeff * p).astype(p.dtype)]
+    return res
+
+
+@register("adamax", **_OPT)
+def _adamax(ctx, ins, attrs):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    m, u = ins["Moment"][0], ins["InfNorm"][0]
+    b1p = ins["Beta1Pow"][0]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m_out = b1 * m + (1 - b1) * g
+    u_out = jnp.maximum(b2 * u, jnp.abs(g))
+    p_out = p - (lr / (1 - b1p)) * m_out / (u_out + eps)
+    return {"ParamOut": [p_out], "MomentOut": [m_out], "InfNormOut": [u_out]}
+
+
+@register("adagrad", **_OPT)
+def _adagrad(ctx, ins, attrs):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    m = ins["Moment"][0]
+    eps = attrs.get("epsilon", 1e-6)
+    m_out = m + jnp.square(g)
+    p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": [p_out], "MomentOut": [m_out]}
+
+
+@register("adadelta", **_OPT)
+def _adadelta(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    avg_sq_g = ins["AvgSquaredGrad"][0]
+    avg_sq_u = ins["AvgSquaredUpdate"][0]
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    g2 = rho * avg_sq_g + (1 - rho) * jnp.square(g)
+    upd = jnp.sqrt(avg_sq_u + eps) / jnp.sqrt(g2 + eps) * g
+    u2 = rho * avg_sq_u + (1 - rho) * jnp.square(upd)
+    return {"ParamOut": [p - upd], "AvgSquaredGradOut": [g2],
+            "AvgSquaredUpdateOut": [u2]}
+
+
+@register("rmsprop", **_OPT)
+def _rmsprop(ctx, ins, attrs):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    ms = ins["MeanSquare"][0]
+    mom = ins["Moment"][0]
+    rho = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    mu = attrs.get("momentum", 0.0)
+    centered = attrs.get("centered", False)
+    ms_out = rho * ms + (1 - rho) * jnp.square(g)
+    if centered:
+        mg = ins["MeanGrad"][0]
+        mg_out = rho * mg + (1 - rho) * g
+        denom = jnp.sqrt(ms_out - jnp.square(mg_out) + eps)
+    else:
+        mg_out = jnp.zeros_like(g)
+        denom = jnp.sqrt(ms_out + eps)
+    mom_out = mu * mom + lr * g / denom
+    return {"ParamOut": [p - mom_out], "MeanSquareOut": [ms_out],
+            "MomentOut": [mom_out], "MeanGradOut": [mg_out]}
+
+
+@register("lamb", **_OPT)
+def _lamb(ctx, ins, attrs):
+    """LAMB (reference lamb_op.cc): Adam update scaled by trust ratio."""
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    m1_out = b1 * m1 + (1 - b1) * g
+    m2_out = b2 * m2 + (1 - b2) * jnp.square(g)
+    m1_hat = m1_out / (1 - b1p)
+    m2_hat = m2_out / (1 - b2p)
+    r = m1_hat / (jnp.sqrt(m2_hat) + eps) + wd * p
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    p_out = p - lr * trust * r
+    return {"ParamOut": [p_out], "Moment1Out": [m1_out], "Moment2Out": [m2_out],
+            "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2]}
+
+
+@register("ftrl", **_OPT)
+def _ftrl(ctx, ins, attrs):
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    power = attrs.get("lr_power", -0.5)
+    new_sq = sq + jnp.square(g)
+    sigma = (new_sq ** (-power) - sq ** (-power)) / lr
+    new_lin = lin + g - sigma * p
+    pre = jnp.clip(new_lin, -l1, l1) - new_lin
+    denom = new_sq ** (-power) / lr + 2 * l2
+    p_out = pre / denom
+    return {"ParamOut": [p_out], "SquaredAccumOut": [new_sq],
+            "LinearAccumOut": [new_lin]}
+
+
+@register("dpsgd", is_random=True, **_OPT)
+def _dpsgd(ctx, ins, attrs):
+    """Differentially-private SGD (reference dpsgd_op.cc): clip + noise."""
+    import jax
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    clip = attrs.get("clip", 10.0)
+    batch_size = attrs.get("batch_size", 16.0)
+    sigma = attrs.get("sigma", 1.0)
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    scale = jnp.minimum(1.0, clip / jnp.maximum(g_norm, 1e-12))
+    noise = jax.random.normal(ctx.op_key(attrs), g.shape) * sigma * clip
+    g_out = (g * scale + noise / batch_size)
+    return {"ParamOut": [p - lr * g_out]}

@@ -1,0 +1,203 @@
+"""Op registry: op name -> JAX lowering + static shape inference.
+
+TPU-native replacement for the reference's operator registry & kernel dispatch
+(reference: paddle/fluid/framework/op_registry.h:230, operator.cc:1017-1141).
+Where the reference selects a (place, dtype, layout, library) kernel at run time,
+here each op has ONE lowering — a pure JAX function — and XLA owns code
+generation, fusion and layout. Gradients do not need hand-written grad kernels:
+`append_backward` emits a generic `__vjp__` op whose lowering calls `jax.vjp`
+on the forward lowering (reference grad-op makers: grad_op_desc_maker.h).
+
+Lowering signature:
+    lower(ctx, ins: Dict[slot, List[jax.Array]], attrs: dict)
+        -> Dict[slot, List[jax.Array]]
+
+Build-time shape inference runs the lowering under `jax.eval_shape` with a
+sentinel substituted for unknown (-1) batch dims, then maps the sentinel back.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..framework.dtype import convert_dtype
+
+# Sentinel concrete size standing in for -1 dims during build-time inference.
+_DYN_SENTINEL = 8191
+
+
+class LowerCtx:
+    """Per-execution context handed to lowerings (rng base key, mesh info)."""
+
+    __slots__ = ("rng_key", "mesh", "is_eval_shape")
+
+    def __init__(self, rng_key=None, mesh=None, is_eval_shape=False):
+        self.rng_key = rng_key
+        self.mesh = mesh
+        self.is_eval_shape = is_eval_shape
+
+    def op_key(self, attrs):
+        """Deterministic per-op PRNG key: fold the op's stable seed attr into the
+        run key. Grad re-execution with the same attrs reproduces the same
+        randomness (so dropout masks match between forward and __vjp__)."""
+        seed = attrs.get("__rng_seed__", 0)
+        return jax.random.fold_in(self.rng_key, seed)
+
+
+class OpDef:
+    def __init__(self, name: str, lower: Callable, infer: Optional[Callable] = None,
+                 is_random: bool = False, nondiff_slots=(), stateful_outputs=()):
+        self.name = name
+        self.lower = lower
+        self.infer = infer          # optional custom infer(block, op)
+        self.is_random = is_random  # gets a stable __rng_seed__ attr at build
+        self.nondiff_slots = frozenset(nondiff_slots)
+        # output slots aliasing an input (e.g. optimizer ParamOut) — excluded
+        # from autodiff bookkeeping
+        self.stateful_outputs = frozenset(stateful_outputs)
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+_rng_seed_counter = [1]
+
+
+def register(name: str, *, infer=None, is_random=False, nondiff_slots=(),
+             stateful_outputs=()):
+    def deco(fn):
+        _REGISTRY[name] = OpDef(name, fn, infer=infer, is_random=is_random,
+                                nondiff_slots=nondiff_slots,
+                                stateful_outputs=stateful_outputs)
+        return fn
+    return deco
+
+
+def get(name: str) -> OpDef:
+    if name not in _REGISTRY:
+        raise NotImplementedError(f"op {name!r} is not registered")
+    return _REGISTRY[name]
+
+
+def has(name: str) -> bool:
+    return name in _REGISTRY
+
+
+def all_ops() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Build-time shape/dtype inference (reference: InferShape, shape_inference.h)
+# ---------------------------------------------------------------------------
+
+def infer_op(block, op) -> None:
+    block.program.bump_version()  # before any early return: compiled caches
+    # key on the version, so every structural change must invalidate them
+    opdef = _REGISTRY.get(op.type)
+    if opdef is None:
+        return  # tolerate unregistered ops at build; execution will fail loudly
+    if opdef.is_random and "__rng_seed__" not in op.attrs:
+        op.attrs["__rng_seed__"] = _rng_seed_counter[0]
+        _rng_seed_counter[0] += 1
+    if opdef.infer is not None:
+        opdef.infer(block, op)
+        return
+    try:
+        _generic_infer(block, op, opdef)
+    except Exception:
+        # Build-time inference is advisory; execution specializes on real
+        # shapes. Leave unknown shapes in place rather than failing the build.
+        pass
+
+
+def _generic_infer(block, op, opdef) -> None:
+    ins = {}
+    for slot, names in op.inputs.items():
+        specs = []
+        for n in names:
+            v = block.var(n)
+            shape = tuple(_DYN_SENTINEL if d in (-1, None) else d for d in v.shape)
+            specs.append(jax.ShapeDtypeStruct(shape, v.dtype))
+        ins[slot] = specs
+    def _run(i, key):
+        ctx = LowerCtx(rng_key=key, is_eval_shape=True)
+        return opdef.lower(ctx, i, op.attrs)
+
+    outs = jax.eval_shape(_run, ins, jax.random.key(0))
+    for slot, names in op.outputs.items():
+        if slot not in outs:
+            continue
+        for n, spec in zip(names, outs[slot]):
+            if n == "@EMPTY@":
+                continue
+            v = block.find_var_recursive(n)
+            if v is None:
+                continue
+            v.shape = tuple(-1 if d == _DYN_SENTINEL else int(d)
+                            for d in spec.shape)
+            v.dtype = convert_dtype(spec.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Generic VJP grad op (replaces per-op grad kernels; reference grad makers)
+# ---------------------------------------------------------------------------
+
+def make_vjp_attrs(fwd_op, diff_entries, out_slots_order):
+    """diff_entries: list of (slot, index) of forward inputs to differentiate."""
+    return {
+        "fwd_type": fwd_op.type,
+        "fwd_attrs": dict(fwd_op.attrs),
+        "fwd_input_slots": {k: len(v) for k, v in fwd_op.inputs.items()},
+        "fwd_output_slots": list(out_slots_order),
+        "fwd_output_counts": {s: len(fwd_op.outputs.get(s, []))
+                              for s in out_slots_order},
+        "diff_entries": [list(e) for e in diff_entries],
+        "op_role": 1,  # OpRole.Backward
+    }
+
+
+def _lower_vjp(ctx, ins, attrs):
+    fwd = get(attrs["fwd_type"])
+    fwd_attrs = attrs["fwd_attrs"]
+    in_slot_counts = attrs["fwd_input_slots"]
+    out_slots = attrs["fwd_output_slots"]
+    diff = [tuple(e) for e in attrs["diff_entries"]]
+
+    fwd_ins = {slot: list(ins[slot]) for slot in in_slot_counts}
+    primals = [fwd_ins[s][i] for (s, i) in diff]
+
+    def f(*diff_vals):
+        cur = {s: list(vs) for s, vs in fwd_ins.items()}
+        for (s, i), v in zip(diff, diff_vals):
+            cur[s][i] = v
+        outs = fwd.lower(ctx, cur, fwd_attrs)
+        return [v for s in out_slots for v in outs[s]]
+
+    out_flat, vjp_fn = jax.vjp(f, *primals)
+    # Cotangents arrive in slot "OG:<slot>", aligned with the forward op's
+    # output lists; entries for unused outputs are missing and become zeros.
+    cts = []
+    idx = 0
+    for s in out_slots:
+        ogs = ins.get(f"OG:{s}", [])
+        n_outs = attrs["fwd_output_counts"][s]
+        for j in range(n_outs):
+            if j < len(ogs) and ogs[j] is not None:
+                cts.append(ogs[j])
+            else:
+                ref = out_flat[idx + j]
+                cts.append(jax.numpy.zeros(ref.shape, ref.dtype))
+        idx += n_outs
+    grads = vjp_fn(list(cts))
+    by_slot = {}
+    for (s, i), g in zip(diff, grads):
+        by_slot.setdefault(s, {})[i] = g
+    result = {}
+    for s, m in by_slot.items():
+        result[f"IG:{s}"] = [m.get(i) for i in range(in_slot_counts[s])]
+    return result
+
+
+_REGISTRY["__vjp__"] = OpDef("__vjp__", _lower_vjp)

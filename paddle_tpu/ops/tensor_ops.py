@@ -1,0 +1,413 @@
+"""Tensor creation / manipulation op lowerings.
+
+Parity targets (reference): fill_constant_op.cc, uniform_random_op.cc,
+gaussian_random_op.cc, reshape_op.cc, transpose_op.cc, concat_op.cc,
+split_op.cc, gather_op.cc, slice_op.cc, top_k_op.cc, arg_max_op.cc,
+stack_op.cc, squeeze_op.cc, unsqueeze_op.cc, expand_op.cc, assign_op.cc.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+from ..framework.dtype import convert_dtype
+
+
+@register("fill_constant")
+def _fill_constant(ctx, ins, attrs):
+    shape = attrs.get("shape", [1])
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    value = attrs.get("value", 0.0)
+    return {"Out": [jnp.full(tuple(shape), value, dtype=dtype)]}
+
+
+@register("fill_constant_batch_size_like")
+def _fill_constant_bsl(ctx, ins, attrs):
+    ref = ins["Input"][0]
+    shape = list(attrs.get("shape", [1]))
+    in_idx = attrs.get("input_dim_idx", 0)
+    out_idx = attrs.get("output_dim_idx", 0)
+    shape[out_idx] = ref.shape[in_idx]
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    return {"Out": [jnp.full(tuple(shape), attrs.get("value", 0.0), dtype=dtype)]}
+
+
+@register("fill_zeros_like")
+def _fill_zeros_like(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.zeros(x.shape, x.dtype)]}
+
+
+@register("assign")
+def _assign(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+@register("assign_value")
+def _assign_value(ctx, ins, attrs):
+    shape = attrs["shape"]
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    values = attrs.get("values", attrs.get("fp32_values", []))
+    return {"Out": [jnp.asarray(np.array(values), dtype=dtype).reshape(shape)]}
+
+
+@register("shape", nondiff_slots=("Input",))
+def _shape(ctx, ins, attrs):
+    x = ins["Input"][0]
+    return {"Out": [jnp.asarray(x.shape, jnp.int32)]}
+
+
+@register("uniform_random", is_random=True)
+def _uniform_random(ctx, ins, attrs):
+    shape = tuple(attrs.get("shape", [1]))
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    lo = attrs.get("min", -1.0)
+    hi = attrs.get("max", 1.0)
+    key = ctx.op_key(attrs)
+    return {"Out": [jax.random.uniform(key, shape, dtype=jnp.float32,
+                                       minval=lo, maxval=hi).astype(dtype)]}
+
+
+@register("gaussian_random", is_random=True)
+def _gaussian_random(ctx, ins, attrs):
+    shape = tuple(attrs.get("shape", [1]))
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    key = ctx.op_key(attrs)
+    out = jax.random.normal(key, shape, dtype=jnp.float32) * std + mean
+    return {"Out": [out.astype(dtype)]}
+
+
+@register("truncated_gaussian_random", is_random=True)
+def _truncated_gaussian_random(ctx, ins, attrs):
+    shape = tuple(attrs.get("shape", [1]))
+    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    key = ctx.op_key(attrs)
+    out = jax.random.truncated_normal(key, -2.0, 2.0, shape) * std + mean
+    return {"Out": [out.astype(dtype)]}
+
+
+@register("randint", is_random=True, nondiff_slots=("X",))
+def _randint(ctx, ins, attrs):
+    shape = tuple(attrs.get("shape", [1]))
+    key = ctx.op_key(attrs)
+    out = jax.random.randint(key, shape, attrs.get("low", 0), attrs.get("high", 100))
+    return {"Out": [out.astype(convert_dtype(attrs.get("dtype", "int64")))]}
+
+
+@register("reshape2")
+def _reshape2(ctx, ins, attrs):
+    x = ins["X"][0]
+    shape = list(attrs["shape"])
+    # fluid semantics: 0 copies the input dim at that position; -1 infers
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    out = x.reshape(tuple(shape))
+    return {"Out": [out], "XShape": [jnp.zeros((0,), x.dtype)]}
+
+
+@register("reshape")
+def _reshape(ctx, ins, attrs):
+    r = _reshape2(ctx, ins, attrs)
+    return {"Out": r["Out"]}
+
+
+@register("transpose2")
+def _transpose2(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs["axis"]
+    return {"Out": [jnp.transpose(x, axis)],
+            "XShape": [jnp.zeros((0,), x.dtype)]}
+
+
+@register("transpose")
+def _transpose(ctx, ins, attrs):
+    return {"Out": [jnp.transpose(ins["X"][0], attrs["axis"])]}
+
+
+@register("flatten2")
+def _flatten2(ctx, ins, attrs):
+    x = ins["X"][0]
+    ax = attrs.get("axis", 1)
+    out = x.reshape((int(np.prod(x.shape[:ax])), -1))
+    return {"Out": [out], "XShape": [jnp.zeros((0,), x.dtype)]}
+
+
+@register("flatten_contiguous_range")
+def _flatten_contiguous_range(ctx, ins, attrs):
+    x = ins["X"][0]
+    start = attrs.get("start_axis", 1)
+    stop = attrs.get("stop_axis", -1)
+    if stop < 0:
+        stop += x.ndim
+    shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+    return {"Out": [x.reshape(shape)], "XShape": [jnp.zeros((0,), x.dtype)]}
+
+
+@register("squeeze2")
+def _squeeze2(ctx, ins, attrs):
+    x = ins["X"][0]
+    axes = attrs.get("axes", [])
+    if axes:
+        axes = tuple(a % x.ndim for a in axes if x.shape[a % x.ndim] == 1)
+        out = jnp.squeeze(x, axes) if axes else x
+    else:
+        out = jnp.squeeze(x)
+    return {"Out": [out], "XShape": [jnp.zeros((0,), x.dtype)]}
+
+
+@register("unsqueeze2")
+def _unsqueeze2(ctx, ins, attrs):
+    x = ins["X"][0]
+    for a in sorted(attrs["axes"]):
+        x = jnp.expand_dims(x, a)
+    return {"Out": [x], "XShape": [jnp.zeros((0,), x.dtype)]}
+
+
+@register("concat")
+def _concat(ctx, ins, attrs):
+    return {"Out": [jnp.concatenate(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register("split")
+def _split(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", [])
+    if sections:
+        idxs = np.cumsum(sections)[:-1].tolist()
+        outs = jnp.split(x, idxs, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register("stack")
+def _stack(ctx, ins, attrs):
+    return {"Y": [jnp.stack(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register("unstack")
+def _unstack(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    n = x.shape[axis]
+    outs = [jnp.squeeze(a, axis) for a in jnp.split(x, n, axis=axis)]
+    return {"Y": outs}
+
+
+@register("tile")
+def _tile(ctx, ins, attrs):
+    return {"Out": [jnp.tile(ins["X"][0], attrs["repeat_times"])]}
+
+
+@register("expand")
+def _expand(ctx, ins, attrs):
+    return {"Out": [jnp.tile(ins["X"][0], attrs["expand_times"])]}
+
+
+@register("expand_v2")
+def _expand_v2(ctx, ins, attrs):
+    x = ins["X"][0]
+    shape = list(attrs["shape"])
+    for i, s in enumerate(shape):
+        if s == -1:
+            shape[i] = x.shape[i - len(shape) + x.ndim]
+    return {"Out": [jnp.broadcast_to(x, tuple(shape))]}
+
+
+@register("expand_as_v2")
+def _expand_as_v2(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [jnp.broadcast_to(x, ins["Y"][0].shape)]}
+
+
+@register("gather", nondiff_slots=("Index",))
+def _gather(ctx, ins, attrs):
+    x, idx = ins["X"][0], ins["Index"][0]
+    return {"Out": [jnp.take(x, idx.astype(jnp.int32), axis=attrs.get("axis", 0))]}
+
+
+@register("gather_nd", nondiff_slots=("Index",))
+def _gather_nd(ctx, ins, attrs):
+    x, idx = ins["X"][0], ins["Index"][0].astype(jnp.int32)
+    k = idx.shape[-1]
+    flat_idx = tuple(idx[..., i] for i in range(k))
+    return {"Out": [x[flat_idx]]}
+
+
+@register("scatter", nondiff_slots=("Ids",))
+def _scatter(ctx, ins, attrs):
+    x, ids, upd = ins["X"][0], ins["Ids"][0].astype(jnp.int32), ins["Updates"][0]
+    if attrs.get("overwrite", True):
+        out = x.at[ids].set(upd)
+    else:
+        out = x.at[ids].add(upd)
+    return {"Out": [out]}
+
+
+@register("slice")
+def _slice(ctx, ins, attrs):
+    x = ins["Input"][0]
+    axes = attrs["axes"]
+    starts = attrs["starts"]
+    ends = attrs["ends"]
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    out = x[tuple(idx)]
+    for a in sorted(attrs.get("decrease_axis", []), reverse=True):
+        out = jnp.squeeze(out, a)
+    return {"Out": [out]}
+
+
+@register("strided_slice")
+def _strided_slice(ctx, ins, attrs):
+    x = ins["Input"][0]
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(attrs["axes"], attrs["starts"], attrs["ends"],
+                           attrs.get("strides", [1] * len(attrs["axes"]))):
+        idx[a] = slice(s, e, st)
+    return {"Out": [x[tuple(idx)]]}
+
+
+@register("top_k", nondiff_slots=())
+def _top_k(ctx, ins, attrs):
+    x = ins["X"][0]
+    k = attrs.get("k", 1)
+    vals, idxs = jax.lax.top_k(x, k)
+    return {"Out": [vals], "Indices": [idxs.astype(jnp.int64)]}
+
+
+@register("top_k_v2", nondiff_slots=())
+def _top_k_v2(ctx, ins, attrs):
+    x = ins["X"][0]
+    k = attrs.get("k", 1)
+    axis = attrs.get("axis", -1)
+    if axis not in (-1, x.ndim - 1):
+        x = jnp.moveaxis(x, axis, -1)
+    vals, idxs = jax.lax.top_k(x, k)
+    if axis not in (-1, x.ndim - 1):
+        vals = jnp.moveaxis(vals, -1, axis)
+        idxs = jnp.moveaxis(idxs, -1, axis)
+    return {"Out": [vals], "Indices": [idxs.astype(jnp.int64)]}
+
+
+@register("arg_max", nondiff_slots=("X",))
+def _arg_max(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    out = jnp.argmax(x, axis=axis)
+    if attrs.get("keepdims", False):
+        out = jnp.expand_dims(out, axis)
+    return {"Out": [out.astype(convert_dtype(attrs.get("dtype", "int64")))]}
+
+
+@register("arg_min", nondiff_slots=("X",))
+def _arg_min(ctx, ins, attrs):
+    x = ins["X"][0]
+    out = jnp.argmin(x, axis=attrs.get("axis", -1))
+    return {"Out": [out.astype(jnp.int64)]}
+
+
+@register("argsort", nondiff_slots=())
+def _argsort(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    desc = attrs.get("descending", False)
+    idx = jnp.argsort(-x if desc else x, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": [out], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register("where", nondiff_slots=("Condition",))
+def _where(ctx, ins, attrs):
+    return {"Out": [jnp.where(ins["Condition"][0], ins["X"][0], ins["Y"][0])]}
+
+
+@register("where_index", nondiff_slots=("Condition",))
+def _where_index(ctx, ins, attrs):
+    # Dynamic output shape — only usable outside jit (eager/dygraph mode).
+    cond = ins["Condition"][0]
+    return {"Out": [jnp.stack(jnp.nonzero(cond), axis=-1).astype(jnp.int64)]}
+
+
+@register("masked_select", nondiff_slots=("Mask",))
+def _masked_select(ctx, ins, attrs):
+    # Dynamic output shape — eager only.
+    return {"Y": [ins["X"][0][ins["Mask"][0]]]}
+
+
+@register("index_select", nondiff_slots=("Index",))
+def _index_select(ctx, ins, attrs):
+    x, idx = ins["X"][0], ins["Index"][0].astype(jnp.int32)
+    return {"Out": [jnp.take(x, idx, axis=attrs.get("dim", 0))]}
+
+
+@register("range", nondiff_slots=("Start", "End", "Step"))
+def _range(ctx, ins, attrs):
+    # Static only when invoked eagerly with concrete scalars.
+    s, e, st = ins["Start"][0], ins["End"][0], ins["Step"][0]
+    return {"Out": [jnp.arange(float(s), float(e), float(st)).astype(s.dtype)]}
+
+
+@register("linspace", nondiff_slots=("Start", "Stop", "Num"))
+def _linspace(ctx, ins, attrs):
+    s, e, n = ins["Start"][0], ins["Stop"][0], ins["Num"][0]
+    return {"Out": [jnp.linspace(float(s), float(e), int(n))]}
+
+
+@register("eye")
+def _eye(ctx, ins, attrs):
+    n = attrs["num_rows"]
+    m = attrs.get("num_columns", n)
+    return {"Out": [jnp.eye(n, m, dtype=convert_dtype(attrs.get("dtype", "float32")))]}
+
+
+@register("tril_triu")
+def _tril_triu(ctx, ins, attrs):
+    x = ins["X"][0]
+    diag = attrs.get("diagonal", 0)
+    if attrs.get("lower", True):
+        return {"Out": [jnp.tril(x, diag)]}
+    return {"Out": [jnp.triu(x, diag)]}
+
+
+@register("meshgrid")
+def _meshgrid(ctx, ins, attrs):
+    outs = jnp.meshgrid(*ins["X"], indexing="ij")
+    return {"Out": list(outs)}
+
+
+@register("flip")
+def _flip(ctx, ins, attrs):
+    return {"Out": [jnp.flip(ins["X"][0], attrs["axis"])]}
+
+
+@register("roll")
+def _roll(ctx, ins, attrs):
+    return {"Out": [jnp.roll(ins["X"][0], attrs["shifts"],
+                             tuple(attrs["axis"]) if attrs.get("axis") else None)]}
+
+
+@register("unique", nondiff_slots=("X",))
+def _unique(ctx, ins, attrs):
+    # Dynamic shape — eager only.
+    x = ins["X"][0]
+    u, inv = jnp.unique(x, return_inverse=True)
+    return {"Out": [u], "Index": [inv.astype(jnp.int64)]}
+
+
+@register("increment")
+def _increment(ctx, ins, attrs):
+    return {"Out": [ins["X"][0] + attrs.get("step", 1.0)]}

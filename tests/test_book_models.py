@@ -1,0 +1,120 @@
+"""End-to-end "book" model tests (reference fluid/tests/book/): full
+build -> train -> save -> infer loops on tiny synthetic datasets.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+
+def _synthetic_digits(n, seed=0):
+    """Tiny separable 'digit' problem: class = argmax of 10 fixed projections."""
+    rng = np.random.RandomState(seed)
+    proj = rng.rand(784, 10).astype(np.float32)
+    x = rng.rand(n, 1, 28, 28).astype(np.float32)
+    y = (x.reshape(n, -1) @ proj).argmax(1).astype(np.int64)[:, None]
+    return x, y
+
+
+def _lenet(img, label):
+    conv1 = fluid.layers.conv2d(img, num_filters=6, filter_size=5, padding=2,
+                                act="relu")
+    pool1 = fluid.layers.pool2d(conv1, pool_size=2, pool_stride=2)
+    conv2 = fluid.layers.conv2d(pool1, num_filters=16, filter_size=5,
+                                act="relu")
+    pool2 = fluid.layers.pool2d(conv2, pool_size=2, pool_stride=2)
+    fc1 = fluid.layers.fc(pool2, size=120, act="relu")
+    fc2 = fluid.layers.fc(fc1, size=84, act="relu")
+    logits = fluid.layers.fc(fc2, size=10)
+    loss = fluid.layers.softmax_with_cross_entropy(logits, label)
+    avg_loss = fluid.layers.mean(loss)
+    acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
+    return logits, avg_loss, acc
+
+
+def test_recognize_digits_lenet_train_save_infer(tmp_path):
+    paddle.seed(7)
+    img = fluid.layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    logits, avg_loss, acc = _lenet(img, label)
+    opt = paddle.optimizer.Adam(learning_rate=2e-3)
+    opt.minimize(avg_loss)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+
+    x, y = _synthetic_digits(256)
+    bs = 32
+    losses, accs = [], []
+    for epoch in range(8):
+        for i in range(0, len(x), bs):
+            lv, av = exe.run(feed={"img": x[i:i + bs], "label": y[i:i + bs]},
+                             fetch_list=[avg_loss, acc])
+            losses.append(float(lv))
+            accs.append(float(av))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+    assert np.mean(accs[-8:]) > np.mean(accs[:8]), "accuracy should improve"
+
+    # save inference model, reload, check parity with direct logits
+    fluid.io.save_inference_model(str(tmp_path), ["img"], [logits], exe)
+    direct, = exe.run(fluid.default_main_program().clone(for_test=True),
+                      feed={"img": x[:8], "label": y[:8]},
+                      fetch_list=[logits])
+
+    infer_prog, feed_names, fetch_vars = fluid.io.load_inference_model(
+        str(tmp_path), exe)
+    assert feed_names == ["img"]
+    loaded, = exe.run(infer_prog, feed={"img": x[:8]}, fetch_list=fetch_vars)
+    np.testing.assert_allclose(direct, loaded, rtol=1e-4, atol=1e-5)
+
+
+def test_fit_a_line():
+    """Reference book/test_fit_a_line.py: linear regression converges."""
+    paddle.seed(3)
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(x, size=1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    paddle.optimizer.SGD(learning_rate=0.01).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    true_w = rng.rand(13, 1).astype(np.float32)
+    xv = rng.rand(64, 13).astype(np.float32)
+    yv = xv @ true_w + 0.1
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    first = last = None
+    for _ in range(100):
+        lv, = exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+        first = first if first is not None else float(lv)
+        last = float(lv)
+    assert last < 0.05 * first
+
+
+def test_word2vec_embeddings():
+    """Reference book/test_word2vec.py: embedding + fc skip-gram-ish model."""
+    paddle.seed(11)
+    vocab, emb_dim = 50, 16
+    w_in = fluid.layers.data(name="w_in", shape=[1], dtype="int64")
+    w_out = fluid.layers.data(name="w_out", shape=[1], dtype="int64")
+    emb = fluid.layers.embedding(w_in, size=[vocab, emb_dim])
+    emb = fluid.layers.reshape(emb, [-1, emb_dim])
+    logits = fluid.layers.fc(emb, size=vocab)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, w_out))
+    paddle.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    pairs_in = rng.randint(0, vocab, (128, 1)).astype(np.int64)
+    pairs_out = (pairs_in + 1) % vocab  # deterministic "context"
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    first = last = None
+    for _ in range(60):
+        lv, = exe.run(feed={"w_in": pairs_in, "w_out": pairs_out},
+                      fetch_list=[loss])
+        first = first if first is not None else float(lv)
+        last = float(lv)
+    assert last < 0.5 * first
