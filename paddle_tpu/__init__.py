@@ -33,7 +33,8 @@ from . import initializer
 from . import layers
 from . import optimizer
 from . import regularizer
-from . import clip
+from . import clip as _clip_module  # paddle.clip (the name) is the tensor fn;
+# the gradient-clip classes live at paddle.nn.ClipGradBy* and fluid.clip
 from . import io
 
 # ops must import so registrations run
@@ -95,3 +96,21 @@ def disable_static():
 
 # fluid alias module-style access: paddle_tpu.fluid
 from . import fluid  # noqa: E402,F401
+
+# --- paddle 2.0-style API ---------------------------------------------------
+from . import nn  # noqa: E402
+from . import dygraph  # noqa: E402
+from .dygraph import (Tensor, to_tensor, to_variable, no_grad, grad)  # noqa: E402
+from .tensor import *  # noqa: E402,F401,F403
+from . import tensor  # noqa: E402
+from .tensor import __all__ as _tensor_all
+
+static = fluid  # paddle.static namespace parity
+
+
+def get_default_dtype():
+    return "float32"
+
+
+def set_default_dtype(d):
+    pass

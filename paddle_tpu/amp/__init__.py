@@ -1,0 +1,13 @@
+"""paddle.amp: automatic mixed precision (bfloat16-first on TPU).
+
+Reference counterpart: python/paddle/amp/auto_cast.py:20 + grad_scaler.py
+(wrapping fluid/dygraph/amp/loss_scaler.py:119,156) and the C++ autocast hook
+imperative/amp_auto_cast.cc. TPU-native notes: the native compute type is
+bfloat16, whose dynamic range matches float32 — loss scaling is a no-op
+mathematically but the GradScaler API is kept for source parity (and works
+with float16 if selected).
+"""
+from .auto_cast import auto_cast, amp_guard, white_list, black_list
+from .grad_scaler import GradScaler, AmpScaler
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "AmpScaler"]

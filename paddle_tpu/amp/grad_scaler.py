@@ -1,0 +1,81 @@
+"""Dynamic loss scaling (reference amp/grad_scaler.py:20,78,106 wrapping
+fluid/dygraph/amp/loss_scaler.py:119,156: unscale + check_finite + dynamic
+scale update). On TPU with bfloat16 the scale stays at 1.0-equivalent behavior
+unless float16 is in play; the state machine matches the reference.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class GradScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = jnp.asarray(init_loss_scaling, jnp.float32)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good = 0
+        self._bad = 0
+        self._found_inf = False
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * float(self._scale)
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        params = optimizer._parameter_list or []
+        inv = 1.0 / self._scale
+        found = False
+        for p in params:
+            if p._grad is None:
+                continue
+            g = p._grad
+            finite = bool(jnp.all(jnp.isfinite(g)))
+            found = found or not finite
+            p._grad = (g.astype(jnp.float32) * inv).astype(g.dtype)
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad += 1
+            self._good = 0
+            if self._bad >= self._decr_every:
+                self._scale = jnp.maximum(self._scale * self._decr_ratio, 1.0)
+                self._bad = 0
+        else:
+            self._good += 1
+            self._bad = 0
+            if self._good >= self._incr_every:
+                self._scale = self._scale * self._incr_ratio
+                self._good = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        return float(self._scale)
+
+
+AmpScaler = GradScaler

@@ -1,0 +1,15 @@
+"""Dygraph (eager) mode — reference python/paddle/fluid/dygraph/."""
+from .tracer import (Tensor, EagerParamBase, Tracer, current_tracer,
+                     enable_dygraph, disable_dygraph, to_tensor, to_variable,
+                     no_grad, grad)
+from contextlib import contextmanager
+
+
+@contextmanager
+def guard(place=None):
+    """fluid.dygraph.guard context (reference dygraph/base.py)."""
+    enable_dygraph(place)
+    try:
+        yield
+    finally:
+        disable_dygraph()

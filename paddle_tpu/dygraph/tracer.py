@@ -1,0 +1,583 @@
+"""Dygraph tracer: eager op execution + tape autograd.
+
+Reference counterpart: paddle/fluid/imperative/tracer.cc:50 (TraceOp),
+basic_engine.cc:161 (autograd engine), gradient_accumulator.h. TPU-native
+design: ops execute eagerly through the SAME lowering registry as the static
+path; when grads are required the forward runs under jax.vjp, so the tape
+stores each node's ready-made vjp_fn (residuals live on device) — no grad-op
+descs and no re-execution at backward time.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import unique_name
+from ..framework.dtype import convert_dtype, is_floating
+from ..ops import registry
+
+
+def _apply(op_type, inputs, attrs, out_slot="Out"):
+    """Run one op eagerly and return its single output tensor."""
+    tracer = current_tracer()
+    out = Tensor(None)
+    tracer.trace_op(op_type, inputs, {out_slot: [out]}, attrs)
+    return out
+
+
+class TapeNode:
+    """One recorded op. Owned by its output tensors (grad_node attr) — when
+    outputs are garbage-collected the node and its vjp residuals free too, so
+    inference loops don't accumulate graph (reference frees via refcounting;
+    same semantics here, no global tape list)."""
+
+    __slots__ = ("vjp_fn", "in_tensors", "out_tensors", "op_type", "idx")
+
+    def __init__(self, op_type, vjp_fn, in_tensors, out_tensors, idx):
+        self.op_type = op_type
+        self.vjp_fn = vjp_fn
+        self.in_tensors = in_tensors      # tensors we need grads for
+        self.out_tensors = out_tensors    # tensors whose grads feed vjp
+        self.idx = idx                    # topological order stamp
+
+
+class Tensor:
+    """Eager tensor (reference VarBase, imperative/layer.h). Wraps jax.Array."""
+
+    def __init__(self, value=None, name=None, stop_gradient=True,
+                 persistable=False, trainable=None):
+        if value is not None and not isinstance(value, jax.Array):
+            value = jnp.asarray(value)
+        self.value = value
+        self.name = name or unique_name.generate("eager_tmp")
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.trainable = (not stop_gradient) if trainable is None else trainable
+        self.grad_node: Optional[TapeNode] = None
+        self._grad: Optional[jax.Array] = None
+        self.is_leaf = True
+
+    # -- metadata -----------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self.value.shape) if self.value is not None else ()
+
+    @property
+    def dtype(self):
+        return np.dtype(self.value.dtype) if self.value is not None else None
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def grad(self):
+        return self._grad
+
+    def clear_gradient(self):
+        self._grad = None
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    def item(self):
+        return self.numpy().item()
+
+    def detach(self):
+        t = Tensor(self.value, stop_gradient=True)
+        return t
+
+    def clone(self):
+        return Tensor(self.value, stop_gradient=self.stop_gradient)
+
+    def astype(self, dtype):
+        return _apply("cast", {"X": [self]},
+                      {"out_dtype": str(convert_dtype(dtype))})
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        current_tracer().run_backward(self, grad_tensor,
+                                      retain_graph=retain_graph)
+
+    def set_value(self, value):
+        self.value = jnp.asarray(value, self.value.dtype if self.value is not None else None)
+
+    # -- python protocol ----------------------------------------------------
+    def __len__(self):
+        return self.shape[0]
+
+    def __repr__(self):
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype}, "
+                f"stop_gradient={self.stop_gradient},\n{self.numpy()})")
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __getitem__(self, idx):
+        # direct jax indexing; differentiable via tape on slice op would be
+        # better, but basic indexing is mostly used on data tensors
+        out = Tensor(self.value[idx], stop_gradient=self.stop_gradient)
+        if not self.stop_gradient and _grad_enabled():
+            tracer = current_tracer()
+            shape, dtype = self.value.shape, self.value.dtype
+
+            def vjp_fn(ct):
+                return (jnp.zeros(shape, dtype).at[idx].set(ct[0]),)
+            node = TapeNode("getitem", vjp_fn, [self], [out],
+                            tracer.next_node_idx())
+            out.grad_node = node
+            out.stop_gradient = False
+            out.is_leaf = False
+        return out
+
+    def _binary(self, other, op, reverse=False):
+        if not isinstance(other, Tensor):
+            other = Tensor(jnp.asarray(other, self.value.dtype))
+        a, b = (other, self) if reverse else (self, other)
+        return _apply(op, {"X": [a], "Y": [b]}, {"axis": -1})
+
+    def __add__(self, o):
+        return self._binary(o, "elementwise_add")
+
+    __radd__ = lambda self, o: self._binary(o, "elementwise_add", True)
+
+    def __sub__(self, o):
+        return self._binary(o, "elementwise_sub")
+
+    __rsub__ = lambda self, o: self._binary(o, "elementwise_sub", True)
+
+    def __mul__(self, o):
+        return self._binary(o, "elementwise_mul")
+
+    __rmul__ = lambda self, o: self._binary(o, "elementwise_mul", True)
+
+    def __truediv__(self, o):
+        return self._binary(o, "elementwise_div")
+
+    __rtruediv__ = lambda self, o: self._binary(o, "elementwise_div", True)
+
+    def __pow__(self, o):
+        return self._binary(o, "elementwise_pow")
+
+    def __matmul__(self, o):
+        return _apply("matmul", {"X": [self], "Y": [o]}, {})
+
+    def __neg__(self):
+        return _apply("scale", {"X": [self]}, {"scale": -1.0})
+
+    def __eq__(self, o):
+        return self._binary(o, "equal")
+
+    def __lt__(self, o):
+        return self._binary(o, "less_than")
+
+    def __le__(self, o):
+        return self._binary(o, "less_equal")
+
+    def __gt__(self, o):
+        return self._binary(o, "greater_than")
+
+    def __ge__(self, o):
+        return self._binary(o, "greater_equal")
+
+    def __hash__(self):
+        return id(self)
+
+
+# Parameter in dygraph = persistable trainable Tensor
+class EagerParamBase(Tensor):
+    def __init__(self, value=None, name=None, trainable=True):
+        super().__init__(value, name=name, stop_gradient=not trainable,
+                         persistable=True, trainable=trainable)
+
+
+_no_grad_depth = [0]
+
+
+def _grad_enabled():
+    return _no_grad_depth[0] == 0
+
+
+class no_grad:
+    """paddle.no_grad context/decorator."""
+
+    def __enter__(self):
+        _no_grad_depth[0] += 1
+        return self
+
+    def __exit__(self, *a):
+        _no_grad_depth[0] -= 1
+        return False
+
+    def __call__(self, fn):
+        def wrapped(*args, **kw):
+            with no_grad():
+                return fn(*args, **kw)
+        return wrapped
+
+
+class Tracer:
+    """Eager execution engine (reference imperative/tracer.cc)."""
+
+    def __init__(self, seed: int = 0):
+        self._node_counter = 0
+        self._rng_key = jax.random.key(seed)
+        self._amp_level = "O0"
+        self._amp_dtype = jnp.bfloat16
+
+    def next_node_idx(self):
+        self._node_counter += 1
+        return self._node_counter
+
+    def seed(self, s):
+        self._rng_key = jax.random.key(s)
+
+    def next_key(self):
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        return sub
+
+    # ------------------------------------------------------------------
+    def trace_op(self, type, inputs=None, outputs=None, attrs=None):
+        """Execute op eagerly; record tape node if autodiff is needed.
+
+        inputs: {slot: [Tensor]}; outputs: {slot: [Tensor placeholders]} whose
+        .value gets filled. Returns nothing (placeholders are mutated), which
+        matches the LayerHelper protocol shared with graph mode.
+        """
+        attrs = dict(attrs or {})
+        opdef = registry.get(type)
+        in_map: Dict[str, List[Tensor]] = {
+            k: [t for t in v] for k, v in (inputs or {}).items()}
+        out_map: Dict[str, List[Tensor]] = {
+            k: [t for t in v] for k, v in (outputs or {}).items()}
+
+        if self._amp_level == "O1":
+            from ..amp.auto_cast import maybe_autocast_inputs
+            in_map = maybe_autocast_inputs(type, in_map, self._amp_dtype)
+
+        ins = {k: [t.value for t in v] for k, v in in_map.items()}
+        ctx = registry.LowerCtx(rng_key=self.next_key())
+        if opdef.is_random:
+            attrs.setdefault("__rng_seed__", 0)
+
+        diff_entries = []
+        if _grad_enabled():
+            for slot, ts in in_map.items():
+                if slot in opdef.nondiff_slots:
+                    continue
+                for i, t in enumerate(ts):
+                    if not t.stop_gradient and is_floating(t.dtype):
+                        diff_entries.append((slot, i))
+
+        out_slots = sorted(out_map)
+        if not diff_entries:
+            outs = opdef.lower(ctx, ins, attrs)
+        else:
+            primals = [ins[s][i] for (s, i) in diff_entries]
+
+            def f(*dvals):
+                cur = {s: list(vs) for s, vs in ins.items()}
+                for (s, i), v in zip(diff_entries, dvals):
+                    cur[s][i] = v
+                o = opdef.lower(ctx, cur, attrs)
+                return [v for s in out_slots for v in o.get(s, [])]
+
+            out_flat, vjp_fn = jax.vjp(f, *primals)
+            outs = {}
+            k = 0
+            for s in out_slots:
+                n = len(out_map[s])
+                outs[s] = out_flat[k:k + n]
+                k += n
+
+        produced = []
+        for slot in out_map:
+            vals = outs.get(slot, [])
+            for t, v in zip(out_map[slot], vals):
+                t.value = v
+                produced.append(t)
+
+        if diff_entries:
+            in_tensors = [in_map[s][i] for (s, i) in diff_entries]
+            flat_out_tensors = [t for s in out_slots for t in out_map[s]]
+            node = TapeNode(type, vjp_fn, in_tensors, flat_out_tensors,
+                            self.next_node_idx())
+            for t in flat_out_tensors:
+                if slot_is_stateful(opdef, t, out_map):
+                    continue
+                t.stop_gradient = False
+                t.is_leaf = False
+                t.grad_node = node
+        return None
+
+    # ------------------------------------------------------------------
+    def run_backward(self, loss: Tensor, grad_tensor=None,
+                     retain_graph=False, extra_targets=None,
+                     write_leaf_grads=True):
+        """Reverse topological walk of the autograd graph reachable from loss
+        (reference basic_engine.cc:161). Returns the raw grads dict keyed by
+        id(tensor) so paddle.grad can read non-leaf grads too."""
+        grads: Dict[int, jax.Array] = {}
+        seed = (jnp.ones(loss.value.shape, loss.value.dtype)
+                if grad_tensor is None else jnp.asarray(grad_tensor))
+        grads[id(loss)] = seed
+
+        # collect nodes reachable from loss, then order newest-first
+        nodes: Dict[int, TapeNode] = {}
+        stack = [loss.grad_node] if loss.grad_node is not None else []
+        while stack:
+            node = stack.pop()
+            if node is None or node.idx in nodes:
+                continue
+            nodes[node.idx] = node
+            for t in node.in_tensors:
+                if t.grad_node is not None:
+                    stack.append(t.grad_node)
+
+        keep_ids = {id(t) for t in (extra_targets or [])}
+        for idx in sorted(nodes, reverse=True):
+            node = nodes[idx]
+            cts = []
+            any_ct = False
+            for t in node.out_tensors:
+                g = grads.get(id(t))
+                if g is None:
+                    g = jnp.zeros(t.value.shape, t.value.dtype)
+                else:
+                    any_ct = True
+                cts.append(g)
+            if not any_ct:
+                continue
+            in_grads = node.vjp_fn(cts)
+            for t, g in zip(node.in_tensors, in_grads):
+                if g is None:
+                    continue
+                prev = grads.get(id(t))
+                grads[id(t)] = g if prev is None else prev + g
+            # free intermediate grads eagerly (not leaves / requested)
+            for t in node.out_tensors:
+                if not t.is_leaf and id(t) not in keep_ids:
+                    grads.pop(id(t), None)
+
+        # write leaf grads into .grad (accumulate like the reference)
+        if write_leaf_grads:
+            leaves = {}
+            for node in nodes.values():
+                for t in node.in_tensors:
+                    if t.is_leaf and not t.stop_gradient and id(t) in grads:
+                        leaves[id(t)] = t
+            for t in leaves.values():
+                g = grads[id(t)]
+                t._grad = g if t._grad is None else t._grad + g
+
+        if not retain_graph:
+            for node in nodes.values():
+                for t in node.out_tensors:
+                    t.grad_node = None
+        return grads
+
+    # -- LayerHelper protocol ----------------------------------------------
+    def create_temp(self, dtype):
+        return Tensor(None, stop_gradient=True)
+
+    def create_parameter(self, name, shape, dtype, initializer, trainable=True,
+                         regularizer=None):
+        # run the initializer op directly to produce the value
+        from ..framework.program import Program, program_guard
+        from ..framework.dtype import convert_dtype as cd
+        tmp_prog = Program()
+        tmp_start = Program()
+        with program_guard(tmp_prog, tmp_start):
+            b = tmp_start.global_block()
+            v = b.create_var(name=name, shape=shape, dtype=cd(dtype),
+                             persistable=True)
+            initializer(v, block=b)
+            op = b.ops[-1]
+            opdef = registry.get(op.type)
+            ctx = registry.LowerCtx(rng_key=self.next_key())
+            attrs = dict(op.attrs)
+            if opdef.is_random:
+                # eager randomness comes from the tracer key stream alone;
+                # the graph-mode __rng_seed__ counter is process-global and
+                # would break seed() determinism here
+                attrs["__rng_seed__"] = 0
+            outs = opdef.lower(ctx, {}, attrs)
+        p = EagerParamBase(outs["Out"][0], name=name, trainable=trainable)
+        p.regularizer = regularizer
+        return p
+
+    # -- optimizer support --------------------------------------------------
+    def optimizer_step(self, opt):
+        """Apply opt's update rule eagerly to all tracked params."""
+        params = opt._parameter_list or []
+        if not hasattr(opt, "_eager_acc"):
+            opt._eager_acc = {}
+        lr = opt._learning_rate
+        lr_val = jnp.asarray([lr() if callable(lr) else lr], jnp.float32)
+        clipped = _eager_grad_clip(opt._grad_clip, params)
+        for p in params:
+            if p._grad is None or not p.trainable:
+                continue
+            g = clipped.get(id(p), p._grad)
+            reg = getattr(p, "regularizer", None) or opt.regularization
+            if reg is not None:
+                coeff = getattr(reg, "_coeff", 0.0)
+                from ..regularizer import L1DecayRegularizer
+                if isinstance(reg, L1DecayRegularizer):
+                    g = g + coeff * jnp.sign(p.value)
+                else:
+                    g = g + coeff * p.value
+            _eager_apply_update(opt, p, g, lr_val)
+
+    def clear_grads(self, params):
+        for p in params or []:
+            p._grad = None
+
+
+def slot_is_stateful(opdef, tensor, out_map):
+    # identity comparison: Tensor.__eq__ is the elementwise `equal` op
+    for slot in opdef.stateful_outputs:
+        if any(t is tensor for t in out_map.get(slot, [])):
+            return True
+    return False
+
+
+def _eager_grad_clip(grad_clip, params):
+    """Eager equivalents of the fluid clip classes (clip.py applies them via
+    graph ops on the static path)."""
+    if grad_clip is None:
+        return {}
+    from ..clip import (GradientClipByValue, GradientClipByNorm,
+                        GradientClipByGlobalNorm)
+    pairs = [(p, p._grad) for p in params
+             if p._grad is not None and p.trainable]
+    out = {}
+    if isinstance(grad_clip, GradientClipByValue):
+        for p, g in pairs:
+            out[id(p)] = jnp.clip(g, grad_clip.min, grad_clip.max)
+    elif isinstance(grad_clip, GradientClipByNorm):
+        for p, g in pairs:
+            n = jnp.sqrt(jnp.sum(jnp.square(g)))
+            scale = jnp.where(n > grad_clip.clip_norm,
+                              grad_clip.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+            out[id(p)] = g * scale
+    elif isinstance(grad_clip, GradientClipByGlobalNorm):
+        gn = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for _, g in pairs))
+        scale = grad_clip.clip_norm / jnp.maximum(gn, grad_clip.clip_norm)
+        for p, g in pairs:
+            out[id(p)] = g * scale
+    else:
+        raise TypeError(f"unsupported grad_clip {type(grad_clip).__name__}")
+    return out
+
+
+def _eager_apply_update(opt, p, g, lr_val):
+    """Run the optimizer's device-side op lowering on eager values."""
+    acc = opt._eager_acc.setdefault(p.name, {})
+    t = opt.type
+    ctx = registry.LowerCtx()
+    if t == "sgd":
+        outs = registry.get("sgd").lower(ctx, {"Param": [p.value], "Grad": [g],
+                                              "LearningRate": [lr_val]}, {})
+        p.value = outs["ParamOut"][0]
+        return
+    if t in ("momentum", "lars_momentum"):
+        v = acc.setdefault("velocity", jnp.zeros_like(p.value))
+        attrs = ({"mu": opt._momentum, "use_nesterov": getattr(opt, "_use_nesterov", False)}
+                 if t == "momentum" else
+                 {"mu": opt._momentum, "lars_coeff": opt._lars_coeff,
+                  "lars_weight_decay": opt._lars_weight_decay})
+        outs = registry.get(t).lower(ctx, {"Param": [p.value], "Grad": [g],
+                                           "Velocity": [v],
+                                           "LearningRate": [lr_val]}, attrs)
+        p.value = outs["ParamOut"][0]
+        acc["velocity"] = outs["VelocityOut"][0]
+        return
+    if t in ("adam", "adamw", "lamb"):
+        m1 = acc.setdefault("m1", jnp.zeros_like(p.value))
+        m2 = acc.setdefault("m2", jnp.zeros_like(p.value))
+        b1p = acc.setdefault("b1p", jnp.asarray([opt._beta1], jnp.float32))
+        b2p = acc.setdefault("b2p", jnp.asarray([opt._beta2], jnp.float32))
+        attrs = {"beta1": opt._beta1, "beta2": opt._beta2,
+                 "epsilon": opt._epsilon}
+        if t == "adamw":
+            attrs.update({"coeff": opt._coeff, "with_decay": True})
+        if t == "lamb":
+            attrs["weight_decay"] = opt._weight_decay
+        outs = registry.get(t).lower(
+            ctx, {"Param": [p.value], "Grad": [g], "LearningRate": [lr_val],
+                  "Moment1": [m1], "Moment2": [m2],
+                  "Beta1Pow": [b1p], "Beta2Pow": [b2p]}, attrs)
+        p.value = outs["ParamOut"][0]
+        acc["m1"], acc["m2"] = outs["Moment1Out"][0], outs["Moment2Out"][0]
+        acc["b1p"], acc["b2p"] = outs["Beta1PowOut"][0], outs["Beta2PowOut"][0]
+        return
+    if t == "adagrad":
+        m = acc.setdefault("moment", jnp.zeros_like(p.value))
+        outs = registry.get("adagrad").lower(
+            ctx, {"Param": [p.value], "Grad": [g], "Moment": [m],
+                  "LearningRate": [lr_val]}, {"epsilon": opt._epsilon})
+        p.value = outs["ParamOut"][0]
+        acc["moment"] = outs["MomentOut"][0]
+        return
+    raise NotImplementedError(f"eager update for optimizer type {t!r}")
+
+
+_tracer: Optional[Tracer] = None
+
+
+def current_tracer() -> Tracer:
+    global _tracer
+    if _tracer is None:
+        _tracer = Tracer()
+    return _tracer
+
+
+def enable_dygraph(place=None):
+    from ..framework.program import _set_dygraph_tracer
+    _set_dygraph_tracer(current_tracer())
+
+
+def disable_dygraph():
+    from ..framework.program import _set_dygraph_tracer
+    _set_dygraph_tracer(None)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    if isinstance(data, Tensor):
+        return data
+    arr = np.asarray(data)
+    if dtype is not None:
+        arr = arr.astype(convert_dtype(dtype))
+    elif arr.dtype == np.float64:
+        arr = arr.astype(np.float32)  # paddle default_dtype
+    return Tensor(jnp.asarray(arr), stop_gradient=stop_gradient)
+
+
+def to_variable(value, name=None, zero_copy=None):
+    return to_tensor(value)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """paddle.grad for dygraph (reference partial_grad_engine.cc). Reads the
+    raw grads dict so non-leaf inputs work; does not touch .grad attrs."""
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    assert len(outputs) == 1, "v1: single output"
+    tracer = current_tracer()
+    grads = tracer.run_backward(outputs[0],
+                                retain_graph=bool(retain_graph),
+                                extra_targets=inputs,
+                                write_leaf_grads=False)
+    return [Tensor(grads[id(x)]) if id(x) in grads else None
+            for x in inputs]

@@ -1,0 +1,570 @@
+"""paddle.nn: Layer base + module zoo (dygraph-first).
+
+Reference counterpart: python/paddle/nn/layer/* and fluid/dygraph/layers.py
+(Layer base: parameter registry, sublayers, state_dict, train/eval). Params
+are EagerParamBase (jax.Array-backed); forward goes through nn.functional.
+"""
+from __future__ import annotations
+
+import math
+from collections import OrderedDict
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..dygraph.tracer import (EagerParamBase, Tensor, current_tracer,
+                              to_tensor)
+from ..framework import unique_name
+from ..framework.dtype import convert_dtype
+from .. import initializer as I
+from . import functional as F
+
+from ..clip import (ClipGradByValue, ClipGradByNorm,  # noqa: F401
+                    ClipGradByGlobalNorm)
+
+__all__ = [
+    "Layer", "Linear", "Conv2D", "Conv2DTranspose", "BatchNorm", "BatchNorm1D",
+    "BatchNorm2D", "LayerNorm", "GroupNorm", "Embedding", "Dropout",
+    "MaxPool2D", "AvgPool2D", "AdaptiveAvgPool2D", "ReLU", "GELU", "Sigmoid",
+    "Tanh", "LeakyReLU", "Softmax", "Silu", "Hardswish", "Flatten",
+    "Sequential", "LayerList", "ParameterList", "CrossEntropyLoss", "MSELoss",
+    "BCEWithLogitsLoss", "functional", "initializer", "Identity", "Pad2D",
+    "Upsample", "ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
+]
+
+initializer = I
+
+
+def _make_param(shape, dtype, initializer, trainable=True):
+    t = current_tracer()
+    return t.create_parameter(unique_name.generate("param"), list(shape),
+                              dtype, initializer, trainable=trainable)
+
+
+class Layer:
+    """Reference fluid/dygraph/layers.py Layer."""
+
+    def __init__(self, name_scope=None, dtype="float32"):
+        self._parameters: "OrderedDict[str, EagerParamBase]" = OrderedDict()
+        self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
+        self._buffers: "OrderedDict[str, Tensor]" = OrderedDict()
+        self.training = True
+        self._dtype = dtype
+        self._forward_pre_hooks = OrderedDict()
+        self._forward_post_hooks = OrderedDict()
+
+    # -- attribute magic ----------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        subs = self.__dict__.get("_sub_layers")
+        if isinstance(value, EagerParamBase) and params is not None:
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer) and subs is not None:
+            subs[name] = value
+            self.__dict__.pop(name, None)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        if "_parameters" in self.__dict__ and name in self._parameters:
+            return self._parameters[name]
+        if "_sub_layers" in self.__dict__ and name in self._sub_layers:
+            return self._sub_layers[name]
+        if "_buffers" in self.__dict__ and name in self._buffers:
+            return self._buffers[name]
+        raise AttributeError(f"{type(self).__name__} has no attribute {name!r}")
+
+    # -- registry -----------------------------------------------------------
+    def add_parameter(self, name, param):
+        self._parameters[name] = param
+        return param
+
+    def add_sublayer(self, name, layer):
+        self._sub_layers[name] = layer
+        return layer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        if tensor is not None:
+            tensor.persistable = persistable
+        self._buffers[name] = tensor
+        return tensor
+
+    def create_parameter(self, shape, attr=None, dtype="float32",
+                         is_bias=False, default_initializer=None):
+        from ..layer_helper import ParamAttr
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        init = (attr.initializer or default_initializer or
+                (I.Constant(0.0) if is_bias else I.Xavier()))
+        p = _make_param(shape, dtype, init, trainable=attr.trainable)
+        if attr.name:
+            p.name = attr.name
+        p.regularizer = attr.regularizer
+        return p
+
+    # -- traversal ----------------------------------------------------------
+    def parameters(self, include_sublayers=True) -> List[EagerParamBase]:
+        return [p for _, p in self.named_parameters()]
+
+    def named_parameters(self, prefix="") -> Iterator[Tuple[str, EagerParamBase]]:
+        seen = set()
+        for name, p in self._parameters.items():
+            if p is not None and id(p) not in seen:
+                seen.add(id(p))
+                yield (f"{prefix}{name}" if prefix else name), p
+        for lname, layer in self._sub_layers.items():
+            sub_prefix = f"{prefix}{lname}." if prefix else f"{lname}."
+            for n, p in layer.named_parameters(sub_prefix):
+                if id(p) not in seen:
+                    seen.add(id(p))
+                    yield n, p
+
+    def sublayers(self, include_self=False) -> List["Layer"]:
+        out = [self] if include_self else []
+        for l in self._sub_layers.values():
+            out.extend(l.sublayers(include_self=True))
+        return out
+
+    def named_sublayers(self, prefix=""):
+        for name, l in self._sub_layers.items():
+            full = f"{prefix}{name}" if not prefix else f"{prefix}.{name}"
+            yield full, l
+            yield from l.named_sublayers(full)
+
+    def children(self):
+        return iter(self._sub_layers.values())
+
+    def named_buffers(self, prefix=""):
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield (f"{prefix}{name}" if prefix else name), b
+        for lname, layer in self._sub_layers.items():
+            sub_prefix = f"{prefix}{lname}." if prefix else f"{lname}."
+            yield from layer.named_buffers(sub_prefix)
+
+    # -- mode ---------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self._sub_layers.values():
+            l.train()
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self._sub_layers.values():
+            l.eval()
+        return self
+
+    # -- state --------------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   prefix="") -> Dict[str, np.ndarray]:
+        sd = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters():
+            sd[name] = p.numpy()
+        for name, b in self.named_buffers():
+            sd[name] = b.numpy()
+        return sd
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        import jax.numpy as jnp
+        for name, p in self.named_parameters():
+            if name in state_dict:
+                p.value = jnp.asarray(np.asarray(state_dict[name]),
+                                      dtype=p.dtype)
+        for name, b in self.named_buffers():
+            if name in state_dict:
+                b.value = jnp.asarray(np.asarray(state_dict[name]),
+                                      dtype=b.dtype)
+        return self
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    def to(self, device=None, dtype=None):
+        import jax.numpy as jnp
+        if dtype is not None:
+            d = convert_dtype(dtype)
+            for p in self.parameters():
+                if np.issubdtype(p.dtype, np.floating):
+                    p.value = p.value.astype(d)
+        return self
+
+    # -- call ---------------------------------------------------------------
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            r = hook(self, args)
+            if r is not None:
+                args = r if isinstance(r, tuple) else (r,)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            r = hook(self, args, out)
+            if r is not None:
+                out = r
+        return out
+
+    def register_forward_post_hook(self, hook):
+        key = len(self._forward_post_hooks)
+        self._forward_post_hooks[key] = hook
+        return key
+
+    def register_forward_pre_hook(self, hook):
+        key = len(self._forward_pre_hooks)
+        self._forward_pre_hooks[key] = hook
+        return key
+
+
+# ---------------------------------------------------------------------------
+# Concrete layers
+# ---------------------------------------------------------------------------
+
+class Linear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter([in_features, out_features],
+                                            attr=weight_attr)
+        self.bias = (self.create_parameter([out_features], attr=bias_attr,
+                                           is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class Conv2D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        k = ([kernel_size] * 2 if isinstance(kernel_size, int)
+             else list(kernel_size))
+        fan_in = in_channels // groups * k[0] * k[1]
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups] + k, attr=weight_attr,
+            default_initializer=I.Normal(0.0, math.sqrt(2.0 / fan_in)))
+        self.bias = (self.create_parameter([out_channels], attr=bias_attr,
+                                           is_bias=True)
+                     if bias_attr is not False else None)
+        self._stride, self._padding = stride, padding
+        self._dilation, self._groups = dilation, groups
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self.bias, self._stride,
+                        self._padding, self._dilation, self._groups)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, weight_attr=None, bias_attr=None):
+        super().__init__()
+        k = ([kernel_size] * 2 if isinstance(kernel_size, int)
+             else list(kernel_size))
+        self.weight = self.create_parameter([in_channels, out_channels] + k,
+                                            attr=weight_attr)
+        self.bias = (self.create_parameter([out_channels], attr=bias_attr,
+                                           is_bias=True)
+                     if bias_attr is not False else None)
+        self._stride, self._padding = stride, padding
+
+    def forward(self, x):
+        s = ([self._stride] * 2 if isinstance(self._stride, int)
+             else list(self._stride))
+        p = ([self._padding] * 2 if isinstance(self._padding, int)
+             else list(self._padding))
+        out = Tensor(None)
+        current_tracer().trace_op(
+            "conv2d_transpose", {"Input": [x], "Filter": [self.weight]},
+            {"Output": [out]},
+            {"strides": s, "paddings": p, "dilations": [1, 1]})
+        if self.bias is not None:
+            from ..dygraph.tracer import _apply
+            out = _apply("elementwise_add", {"X": [out], "Y": [self.bias]},
+                         {"axis": 1})
+        return out
+
+
+class BatchNorm2D(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [num_features], attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter([num_features], attr=bias_attr,
+                                          is_bias=True)
+        import jax.numpy as jnp
+        # running stats are buffers, not parameters (reference batch_norm_op
+        # Mean/Variance persistable non-trainable vars)
+        self.register_buffer("_mean",
+                             Tensor(jnp.zeros(num_features, jnp.float32),
+                                    persistable=True))
+        self.register_buffer("_variance",
+                             Tensor(jnp.ones(num_features, jnp.float32),
+                                    persistable=True))
+        self._momentum, self._epsilon = momentum, epsilon
+
+    def forward(self, x):
+        return F.batch_norm(x, self._mean, self._variance, self.weight,
+                            self.bias, training=self.training,
+                            momentum=self._momentum, epsilon=self._epsilon)
+
+
+BatchNorm = BatchNorm2D
+BatchNorm1D = BatchNorm2D
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        ns = ([normalized_shape] if isinstance(normalized_shape, int)
+              else list(normalized_shape))
+        self._normalized_shape = ns
+        n = int(np.prod(ns))
+        self.weight = (self.create_parameter([n], attr=weight_attr,
+                                             default_initializer=I.Constant(1.0))
+                       if weight_attr is not False else None)
+        self.bias = (self.create_parameter([n], attr=bias_attr, is_bias=True)
+                     if bias_attr is not False else None)
+        self._epsilon = epsilon
+
+    def forward(self, x):
+        return F.layer_norm(x, self._normalized_shape, self.weight, self.bias,
+                            self._epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [num_channels], attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter([num_channels], attr=bias_attr,
+                                          is_bias=True)
+        self._groups, self._epsilon = num_groups, epsilon
+
+    def forward(self, x):
+        y, m, v = Tensor(None), Tensor(None), Tensor(None)
+        current_tracer().trace_op(
+            "group_norm",
+            {"X": [x], "Scale": [self.weight], "Bias": [self.bias]},
+            {"Y": [y], "Mean": [m], "Variance": [v]},
+            {"groups": self._groups, "epsilon": self._epsilon})
+        return y
+
+
+class Embedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
+                 sparse=False, weight_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.Normal(0.0, 1.0 / math.sqrt(embedding_dim)))
+        self._padding_idx = padding_idx
+
+    def forward(self, x):
+        return F.embedding(x, self.weight, self._padding_idx)
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, mode="upscale_in_train"):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x):
+        return F.dropout(x, self.p, training=self.training, mode=self.mode)
+
+
+class MaxPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self.k, self.s, self.p = kernel_size, stride, padding
+
+    def forward(self, x):
+        return F.max_pool2d(x, self.k, self.s, self.p)
+
+
+class AvgPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self.k, self.s, self.p = kernel_size, stride, padding
+
+    def forward(self, x):
+        return F.avg_pool2d(x, self.k, self.s, self.p)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self.output_size)
+
+
+def _act_layer(fn_name):
+    class _Act(Layer):
+        def __init__(self, *a, **kw):
+            super().__init__()
+            self._args = a
+            self._kw = kw
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, *self._args, **self._kw)
+    _Act.__name__ = fn_name.capitalize()
+    return _Act
+
+
+ReLU = _act_layer("relu")
+GELU = _act_layer("gelu")
+Sigmoid = _act_layer("sigmoid")
+Tanh = _act_layer("tanh")
+LeakyReLU = _act_layer("leaky_relu")
+Softmax = _act_layer("softmax")
+Silu = _act_layer("silu")
+Hardswish = _act_layer("hardswish")
+
+
+class Identity(Layer):
+    def forward(self, x):
+        return x
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self.start, self.stop = start_axis, stop_axis
+
+    def forward(self, x):
+        out, xs = Tensor(None), Tensor(None)
+        current_tracer().trace_op(
+            "flatten_contiguous_range", {"X": [x]},
+            {"Out": [out], "XShape": [xs]},
+            {"start_axis": self.start, "stop_axis": self.stop})
+        return out
+
+
+class Pad2D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0):
+        super().__init__()
+        self.padding, self.mode, self.value = padding, mode, value
+
+    def forward(self, x):
+        return F.pad(x, self.padding, self.mode, self.value)
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest"):
+        super().__init__()
+        self.size, self.scale, self.mode = size, scale_factor, mode
+
+    def forward(self, x):
+        return F.interpolate(x, self.size, self.scale, self.mode)
+
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and \
+                layers[0] and isinstance(layers[0][0], tuple):
+            for name, l in layers[0]:
+                self.add_sublayer(name, l)
+        else:
+            for i, l in enumerate(layers):
+                self.add_sublayer(str(i), l)
+
+    def forward(self, x):
+        for l in self._sub_layers.values():
+            x = l(x)
+        return x
+
+    def __getitem__(self, idx):
+        return list(self._sub_layers.values())[idx]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        for i, l in enumerate(sublayers or []):
+            self.add_sublayer(str(i), l)
+
+    def append(self, layer):
+        self.add_sublayer(str(len(self._sub_layers)), layer)
+        return self
+
+    def __getitem__(self, idx):
+        return list(self._sub_layers.values())[idx]
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        for i, p in enumerate(parameters or []):
+            self.add_parameter(str(i), p)
+
+    def append(self, p):
+        self.add_parameter(str(len(self._parameters)), p)
+        return self
+
+    def __getitem__(self, idx):
+        return list(self._parameters.values())[idx]
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+    def __len__(self):
+        return len(self._parameters)
+
+
+class CrossEntropyLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", soft_label=False,
+                 axis=-1, ignore_index=-100):
+        super().__init__()
+        self.reduction, self.soft_label, self.axis = reduction, soft_label, axis
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, self.soft_label, self.axis,
+                               self.reduction)
+
+
+class MSELoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.mse_loss(input, label, self.reduction)
+
+
+class BCEWithLogitsLoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, logit, label):
+        return F.binary_cross_entropy_with_logits(logit, label, self.reduction)

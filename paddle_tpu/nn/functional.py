@@ -1,0 +1,220 @@
+"""paddle.nn.functional: eager functional ops on dygraph Tensors.
+
+Reference counterpart: python/paddle/nn/functional/* (which dispatch to
+core.ops.* fast paths — pybind/op_function_generator.cc). Here each function
+invokes the op lowering through the tracer (one host dispatch; the lowering
+itself is jax, so math runs on device).
+"""
+from __future__ import annotations
+
+from ..dygraph.tracer import Tensor, _apply, current_tracer
+from ..framework.dtype import convert_dtype
+
+__all__ = [
+    "relu", "gelu", "sigmoid", "tanh", "softmax", "log_softmax", "dropout",
+    "linear", "conv2d", "max_pool2d", "avg_pool2d", "adaptive_avg_pool2d",
+    "batch_norm", "layer_norm", "embedding", "cross_entropy", "mse_loss",
+    "binary_cross_entropy_with_logits", "one_hot", "pad", "interpolate",
+    "leaky_relu", "softplus", "swish", "hardswish", "silu", "square_error_cost",
+]
+
+
+def relu(x):
+    return _apply("relu", {"X": [x]}, {})
+
+
+def gelu(x, approximate=False):
+    return _apply("gelu", {"X": [x]}, {"approximate": approximate})
+
+
+def sigmoid(x):
+    return _apply("sigmoid", {"X": [x]}, {})
+
+
+def tanh(x):
+    return _apply("tanh", {"X": [x]}, {})
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return _apply("leaky_relu", {"X": [x]}, {"alpha": negative_slope})
+
+
+def softplus(x):
+    return _apply("softplus", {"X": [x]}, {})
+
+
+def swish(x):
+    return _apply("swish", {"X": [x]}, {})
+
+
+silu = swish
+
+
+def hardswish(x):
+    return _apply("hard_swish", {"X": [x]}, {})
+
+
+def softmax(x, axis=-1):
+    return _apply("softmax", {"X": [x]}, {"axis": axis})
+
+
+def log_softmax(x, axis=-1):
+    return _apply("log_softmax", {"X": [x]}, {"axis": axis})
+
+
+def dropout(x, p=0.5, training=True, mode="upscale_in_train"):
+    out = Tensor(None)
+    mask = Tensor(None)
+    current_tracer().trace_op(
+        "dropout", {"X": [x]}, {"Out": [out], "Mask": [mask]},
+        {"dropout_prob": p, "is_test": not training,
+         "dropout_implementation": mode})
+    return out
+
+
+def linear(x, weight, bias=None):
+    out = _apply("matmul_v2", {"X": [x], "Y": [weight]}, {})
+    if bias is not None:
+        out = _apply("elementwise_add", {"X": [out], "Y": [bias]}, {"axis": -1})
+    return out
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    s = [stride, stride] if isinstance(stride, int) else list(stride)
+    p = [padding, padding] if isinstance(padding, int) else list(padding)
+    d = [dilation, dilation] if isinstance(dilation, int) else list(dilation)
+    out = Tensor(None)
+    current_tracer().trace_op(
+        "conv2d", {"Input": [x], "Filter": [weight]}, {"Output": [out]},
+        {"strides": s, "paddings": p, "dilations": d, "groups": groups})
+    if bias is not None:
+        out = _apply("elementwise_add", {"X": [out], "Y": [bias]}, {"axis": 1})
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0):
+    k = [kernel_size] * 2 if isinstance(kernel_size, int) else list(kernel_size)
+    s = k if stride is None else ([stride] * 2 if isinstance(stride, int)
+                                  else list(stride))
+    p = [padding] * 2 if isinstance(padding, int) else list(padding)
+    return _apply("pool2d", {"X": [x]},
+                  {"pooling_type": "max", "ksize": k, "strides": s,
+                   "paddings": p})
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, exclusive=True):
+    k = [kernel_size] * 2 if isinstance(kernel_size, int) else list(kernel_size)
+    s = k if stride is None else ([stride] * 2 if isinstance(stride, int)
+                                  else list(stride))
+    p = [padding] * 2 if isinstance(padding, int) else list(padding)
+    return _apply("pool2d", {"X": [x]},
+                  {"pooling_type": "avg", "ksize": k, "strides": s,
+                   "paddings": p, "exclusive": exclusive})
+
+
+def adaptive_avg_pool2d(x, output_size):
+    o = ([output_size] * 2 if isinstance(output_size, int)
+         else list(output_size))
+    if o == [1, 1]:
+        return _apply("pool2d", {"X": [x]},
+                      {"pooling_type": "avg", "global_pooling": True,
+                       "ksize": [1, 1]})
+    return _apply("pool2d", {"X": [x]},
+                  {"pooling_type": "avg", "ksize": o, "adaptive": True})
+
+
+def batch_norm(x, running_mean, running_var, weight, bias, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW"):
+    y, mo, vo, sm, sv = (Tensor(None) for _ in range(5))
+    current_tracer().trace_op(
+        "batch_norm",
+        {"X": [x], "Scale": [weight], "Bias": [bias],
+         "Mean": [running_mean], "Variance": [running_var]},
+        {"Y": [y], "MeanOut": [mo], "VarianceOut": [vo],
+         "SavedMean": [sm], "SavedVariance": [sv]},
+        {"momentum": momentum, "epsilon": epsilon, "is_test": not training,
+         "data_layout": data_format})
+    if training:
+        # functional state update back into the running-stat tensors
+        running_mean.value = mo.value
+        running_var.value = vo.value
+    return y
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    import numpy as np
+    bna = x.ndim - len(normalized_shape if isinstance(normalized_shape,
+                                                      (list, tuple)) else [normalized_shape])
+    y, m, v = Tensor(None), Tensor(None), Tensor(None)
+    ins = {"X": [x]}
+    if weight is not None:
+        ins["Scale"] = [weight]
+    if bias is not None:
+        ins["Bias"] = [bias]
+    current_tracer().trace_op(
+        "layer_norm", ins, {"Y": [y], "Mean": [m], "Variance": [v]},
+        {"epsilon": epsilon, "begin_norm_axis": bna})
+    return y
+
+
+def embedding(x, weight, padding_idx=None, sparse=False):
+    return _apply("lookup_table_v2", {"W": [weight], "Ids": [x]},
+                  {"padding_idx": -1 if padding_idx is None else padding_idx})
+
+
+def cross_entropy(input, label, soft_label=False, axis=-1, reduction="mean",
+                  ignore_index=-100):
+    loss = Tensor(None)
+    sm = Tensor(None)
+    current_tracer().trace_op(
+        "softmax_with_cross_entropy",
+        {"Logits": [input], "Label": [label]},
+        {"Softmax": [sm], "Loss": [loss]},
+        {"soft_label": soft_label, "axis": axis})
+    if reduction == "mean":
+        return _apply("mean", {"X": [loss]}, {})
+    if reduction == "sum":
+        return _apply("reduce_sum", {"X": [loss]}, {"reduce_all": True})
+    return loss
+
+
+def square_error_cost(input, label):
+    return _apply("square_error_cost", {"X": [input], "Y": [label]}, {})
+
+
+def mse_loss(input, label, reduction="mean"):
+    se = square_error_cost(input, label)
+    if reduction == "mean":
+        return _apply("mean", {"X": [se]}, {})
+    if reduction == "sum":
+        return _apply("reduce_sum", {"X": [se]}, {"reduce_all": True})
+    return se
+
+
+def binary_cross_entropy_with_logits(logit, label, reduction="mean"):
+    out = _apply("sigmoid_cross_entropy_with_logits",
+                 {"X": [logit], "Label": [label]}, {})
+    if reduction == "mean":
+        return _apply("mean", {"X": [out]}, {})
+    if reduction == "sum":
+        return _apply("reduce_sum", {"X": [out]}, {"reduce_all": True})
+    return out
+
+
+def one_hot(x, num_classes):
+    return _apply("one_hot_v2", {"X": [x]}, {"depth": num_classes})
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    return _apply("pad2d", {"X": [x]},
+                  {"paddings": list(pad), "mode": mode, "pad_value": value})
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest"):
+    attrs = {"interp_method": mode}
+    if size is not None:
+        attrs["out_h"], attrs["out_w"] = size
+    else:
+        attrs["scale"] = scale_factor
+    return _apply("interpolate", {"X": [x]}, attrs)

@@ -1,0 +1,548 @@
+"""paddle.tensor: 2.0-style functional API, dual-mode (dygraph + static).
+
+Reference counterpart: python/paddle/tensor/* (7.9k LoC). Each function
+dispatches: dygraph -> eager op through the tracer; static -> fluid.layers
+graph building. Covers the core math/manipulation/creation surface.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.program import in_dygraph_mode
+from ..framework.dtype import convert_dtype, dtype_name
+
+__all__ = [
+    "to_tensor", "add", "subtract", "multiply", "divide", "matmul", "mean",
+    "sum", "max", "min", "prod", "reshape", "transpose", "concat", "split",
+    "stack", "unsqueeze", "squeeze", "cast", "abs", "sqrt", "square", "exp",
+    "log", "pow", "tanh", "sigmoid", "relu", "maximum", "minimum", "clip",
+    "zeros", "ones", "full", "zeros_like", "ones_like", "full_like", "arange",
+    "argmax", "argmin", "equal", "greater_than", "less_than", "where",
+    "gather", "scatter", "flatten", "sqrt", "rsqrt", "sin", "cos", "floor",
+    "ceil", "round", "sign", "cumsum", "topk", "sort", "argsort", "tril",
+    "triu", "expand", "tile", "flip", "roll", "norm", "randn", "rand",
+    "randint", "uniform", "normal", "numel", "isnan", "isinf", "isfinite",
+    "bmm", "dot", "t", "logsumexp", "softmax", "log_softmax",
+]
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    from ..dygraph.tracer import to_tensor as _tt
+    return _tt(data, dtype, place, stop_gradient)
+
+
+def _eager(op, ins, attrs, out_slot="Out"):
+    from ..dygraph.tracer import _apply
+    return _apply(op, ins, attrs, out_slot)
+
+
+def _unary(op):
+    def f(x, name=None):
+        if in_dygraph_mode():
+            return _eager(op, {"X": [x]}, {})
+        from .. import layers
+        return getattr(layers, op)(x)
+    f.__name__ = op
+    return f
+
+
+abs = _unary("abs")
+sqrt = _unary("sqrt")
+square = _unary("square")
+exp = _unary("exp")
+log = _unary("log")
+tanh = _unary("tanh")
+sigmoid = _unary("sigmoid")
+relu = _unary("relu")
+sin = _unary("sin")
+cos = _unary("cos")
+floor = _unary("floor")
+ceil = _unary("ceil")
+round = _unary("round")
+sign = _unary("sign")
+
+
+def rsqrt(x, name=None):
+    if in_dygraph_mode():
+        return _eager("rsqrt", {"X": [x]}, {})
+    from .. import layers
+    return layers.elementwise_div(
+        layers.fill_constant_like(x, 1.0), layers.sqrt(x))
+
+
+def _binary(op):
+    def f(x, y, name=None):
+        if in_dygraph_mode():
+            from ..dygraph.tracer import Tensor
+            import jax.numpy as jnp
+            if not isinstance(y, Tensor):
+                y = Tensor(jnp.asarray(y, x.value.dtype))
+            return _eager(op, {"X": [x], "Y": [y]}, {"axis": -1})
+        from .. import layers
+        return getattr(layers, op)(x, y)
+    f.__name__ = op
+    return f
+
+
+add = _binary("elementwise_add")
+subtract = _binary("elementwise_sub")
+multiply = _binary("elementwise_mul")
+divide = _binary("elementwise_div")
+maximum = _binary("elementwise_max")
+minimum = _binary("elementwise_min")
+equal = _binary("equal")
+greater_than = _binary("greater_than")
+less_than = _binary("less_than")
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    if in_dygraph_mode():
+        return _eager("matmul_v2", {"X": [x], "Y": [y]},
+                      {"trans_x": transpose_x, "trans_y": transpose_y})
+    from .. import layers
+    return layers.matmul(x, y, transpose_x, transpose_y)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    if in_dygraph_mode():
+        return _eager("dot", {"X": [x], "Y": [y]}, {})
+    raise NotImplementedError
+
+
+def t(x, name=None):
+    return transpose(x, list(reversed(range(x.ndim))))
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    if in_dygraph_mode():
+        if axis is None:
+            return _eager("mean", {"X": [x]}, {})
+        return _eager("reduce_mean", {"X": [x]},
+                      {"dim": axis if isinstance(axis, (list, tuple)) else [axis],
+                       "keep_dim": keepdim})
+    from .. import layers
+    return layers.mean(x) if axis is None else layers.reduce_mean(x, axis, keepdim)
+
+
+def _reduce(op, lname):
+    def f(x, axis=None, keepdim=False, name=None):
+        attrs = ({"reduce_all": True, "dim": [0], "keep_dim": keepdim}
+                 if axis is None else
+                 {"dim": axis if isinstance(axis, (list, tuple)) else [axis],
+                  "keep_dim": keepdim})
+        if in_dygraph_mode():
+            return _eager(op, {"X": [x]}, attrs)
+        from .. import layers
+        return getattr(layers, op)(x, axis, keepdim)
+    f.__name__ = lname
+    return f
+
+
+sum = _reduce("reduce_sum", "sum")
+max = _reduce("reduce_max", "max")
+min = _reduce("reduce_min", "min")
+prod = _reduce("reduce_prod", "prod")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    import jax.numpy as jnp
+    if in_dygraph_mode():
+        from ..dygraph.tracer import Tensor
+        m = max(x, axis, True)
+        return add(log(sum(exp(subtract(x, m)), axis, keepdim)),
+                   m if keepdim else reshape(m, [-1]))
+    raise NotImplementedError
+
+
+def softmax(x, axis=-1, name=None):
+    if in_dygraph_mode():
+        return _eager("softmax", {"X": [x]}, {"axis": axis})
+    from .. import layers
+    return layers.softmax(x, axis)
+
+
+def log_softmax(x, axis=-1, name=None):
+    if in_dygraph_mode():
+        return _eager("log_softmax", {"X": [x]}, {"axis": axis})
+    from .. import layers
+    return layers.log_softmax(x, axis)
+
+
+def reshape(x, shape, name=None):
+    if in_dygraph_mode():
+        from ..dygraph.tracer import Tensor, current_tracer
+        out, xs = Tensor(None), Tensor(None)
+        current_tracer().trace_op("reshape2", {"X": [x]},
+                                  {"Out": [out], "XShape": [xs]},
+                                  {"shape": list(shape)})
+        return out
+    from .. import layers
+    return layers.reshape(x, shape)
+
+
+def transpose(x, perm, name=None):
+    if in_dygraph_mode():
+        from ..dygraph.tracer import Tensor, current_tracer
+        out, xs = Tensor(None), Tensor(None)
+        current_tracer().trace_op("transpose2", {"X": [x]},
+                                  {"Out": [out], "XShape": [xs]},
+                                  {"axis": list(perm)})
+        return out
+    from .. import layers
+    return layers.transpose(x, perm)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    if in_dygraph_mode():
+        from ..dygraph.tracer import Tensor, current_tracer
+        out, xs = Tensor(None), Tensor(None)
+        current_tracer().trace_op("flatten_contiguous_range", {"X": [x]},
+                                  {"Out": [out], "XShape": [xs]},
+                                  {"start_axis": start_axis,
+                                   "stop_axis": stop_axis})
+        return out
+    from .. import layers
+    return layers.flatten(x, start_axis)
+
+
+def concat(x, axis=0, name=None):
+    if in_dygraph_mode():
+        return _eager("concat", {"X": list(x)}, {"axis": axis})
+    from .. import layers
+    return layers.concat(x, axis)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if in_dygraph_mode():
+        from ..dygraph.tracer import Tensor, current_tracer
+        if isinstance(num_or_sections, int):
+            n = num_or_sections
+            attrs = {"num": n, "sections": [], "axis": axis}
+        else:
+            n = len(num_or_sections)
+            attrs = {"num": 0, "sections": list(num_or_sections), "axis": axis}
+        outs = [Tensor(None) for _ in range(n)]
+        current_tracer().trace_op("split", {"X": [x]}, {"Out": outs}, attrs)
+        return outs
+    from .. import layers
+    return layers.split(x, num_or_sections, axis)
+
+
+def stack(x, axis=0, name=None):
+    if in_dygraph_mode():
+        return _eager("stack", {"X": list(x)}, {"axis": axis}, out_slot="Y")
+    from .. import layers
+    return layers.stack(x, axis)
+
+
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    if in_dygraph_mode():
+        from ..dygraph.tracer import Tensor, current_tracer
+        out, xs = Tensor(None), Tensor(None)
+        current_tracer().trace_op("unsqueeze2", {"X": [x]},
+                                  {"Out": [out], "XShape": [xs]},
+                                  {"axes": list(axes)})
+        return out
+    from .. import layers
+    return layers.unsqueeze(x, axes)
+
+
+def squeeze(x, axis=None, name=None):
+    axes = ([] if axis is None else
+            (axis if isinstance(axis, (list, tuple)) else [axis]))
+    if in_dygraph_mode():
+        from ..dygraph.tracer import Tensor, current_tracer
+        out, xs = Tensor(None), Tensor(None)
+        current_tracer().trace_op("squeeze2", {"X": [x]},
+                                  {"Out": [out], "XShape": [xs]},
+                                  {"axes": list(axes)})
+        return out
+    from .. import layers
+    return layers.squeeze(x, axes)
+
+
+def cast(x, dtype):
+    if in_dygraph_mode():
+        return _eager("cast", {"X": [x]},
+                      {"out_dtype": dtype_name(convert_dtype(dtype))})
+    from .. import layers
+    return layers.cast(x, dtype)
+
+
+def pow(x, y, name=None):
+    if in_dygraph_mode():
+        if isinstance(y, (int, float)):
+            return _eager("pow", {"X": [x]}, {"factor": float(y)})
+        return _eager("elementwise_pow", {"X": [x], "Y": [y]}, {"axis": -1})
+    from .. import layers
+    return layers.pow(x, y) if isinstance(y, (int, float)) \
+        else layers.elementwise_pow(x, y)
+
+
+def clip(x, min=None, max=None, name=None):
+    if in_dygraph_mode():
+        return _eager("clip", {"X": [x]}, {"min": min, "max": max})
+    from .. import layers
+    return layers.clip(x, min, max)
+
+
+# -- creation ----------------------------------------------------------------
+
+def zeros(shape, dtype="float32", name=None):
+    return full(shape, 0.0, dtype)
+
+
+def ones(shape, dtype="float32", name=None):
+    return full(shape, 1.0, dtype)
+
+
+def full(shape, fill_value, dtype="float32", name=None):
+    if in_dygraph_mode():
+        import jax.numpy as jnp
+        from ..dygraph.tracer import Tensor
+        return Tensor(jnp.full(tuple(shape), fill_value,
+                               dtype=convert_dtype(dtype)))
+    from .. import layers
+    return layers.fill_constant(shape, dtype, fill_value)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return full_like(x, 0.0, dtype)
+
+
+def ones_like(x, dtype=None, name=None):
+    return full_like(x, 1.0, dtype)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    d = dtype_name(convert_dtype(dtype)) if dtype else dtype_name(x.dtype)
+    if in_dygraph_mode():
+        return full(x.shape, fill_value, d)
+    from .. import layers
+    return layers.fill_constant_like(x, fill_value) if fill_value != 0 \
+        else layers.zeros_like(x)
+
+
+def arange(start=0, end=None, step=1, dtype="int64", name=None):
+    if end is None:
+        start, end = 0, start
+    if in_dygraph_mode():
+        import jax.numpy as jnp
+        from ..dygraph.tracer import Tensor
+        return Tensor(jnp.arange(start, end, step,
+                                 dtype=convert_dtype(dtype)))
+    from .. import layers
+    return layers.range(start, end, step, dtype)
+
+
+def randn(shape, dtype="float32", name=None):
+    if in_dygraph_mode():
+        import jax.random as jr
+        from ..dygraph.tracer import Tensor, current_tracer
+        return Tensor(jr.normal(current_tracer().next_key(), tuple(shape),
+                                dtype=convert_dtype(dtype)))
+    from .. import layers
+    return layers.gaussian_random(shape, dtype=dtype)
+
+
+def rand(shape, dtype="float32", name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0, name=None):
+    if in_dygraph_mode():
+        import jax.random as jr
+        from ..dygraph.tracer import Tensor, current_tracer
+        return Tensor(jr.uniform(current_tracer().next_key(), tuple(shape),
+                                 minval=min, maxval=max,
+                                 dtype=convert_dtype(dtype)))
+    from .. import layers
+    return layers.uniform_random(shape, dtype, min, max)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if in_dygraph_mode():
+        import jax.random as jr
+        from ..dygraph.tracer import Tensor, current_tracer
+        return Tensor(jr.normal(current_tracer().next_key(),
+                                tuple(shape)) * std + mean)
+    from .. import layers
+    return layers.gaussian_random(shape, mean, std)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    if in_dygraph_mode():
+        import jax.random as jr
+        from ..dygraph.tracer import Tensor, current_tracer
+        return Tensor(jr.randint(current_tracer().next_key(), tuple(shape),
+                                 low, high).astype(convert_dtype(dtype)))
+    raise NotImplementedError
+
+
+# -- indexing / search -------------------------------------------------------
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    if in_dygraph_mode():
+        if axis is None:
+            return _eager("arg_max", {"X": [flatten(x)]},
+                          {"axis": -1, "keepdims": keepdim})
+        return _eager("arg_max", {"X": [x]}, {"axis": axis, "keepdims": keepdim})
+    from .. import layers
+    return layers.argmax(x, axis if axis is not None else 0)
+
+
+def argmin(x, axis=None, keepdim=False, name=None):
+    if in_dygraph_mode():
+        return _eager("arg_min", {"X": [x]},
+                      {"axis": axis if axis is not None else -1})
+    from .. import layers
+    return layers.argmin(x, axis if axis is not None else 0)
+
+
+def where(condition, x, y, name=None):
+    if in_dygraph_mode():
+        return _eager("where", {"Condition": [condition], "X": [x], "Y": [y]}, {})
+    from .. import layers
+    return layers.where(condition, x, y)
+
+
+def gather(x, index, axis=0, name=None):
+    if in_dygraph_mode():
+        return _eager("gather", {"X": [x], "Index": [index]}, {"axis": axis})
+    from .. import layers
+    return layers.gather(x, index, axis=axis)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    if in_dygraph_mode():
+        return _eager("scatter",
+                      {"X": [x], "Ids": [index], "Updates": [updates]},
+                      {"overwrite": overwrite})
+    from .. import layers
+    return layers.scatter(x, index, updates, overwrite)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    from ..dygraph.tracer import Tensor, current_tracer
+    if in_dygraph_mode():
+        vals, idxs = Tensor(None), Tensor(None)
+        current_tracer().trace_op("top_k_v2", {"X": [x]},
+                                  {"Out": [vals], "Indices": [idxs]},
+                                  {"k": k, "axis": axis})
+        return vals, idxs
+    from .. import layers
+    return layers.topk(x, k)
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    out, _ = argsort_pair(x, axis, descending)
+    return out
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    _, idx = argsort_pair(x, axis, descending)
+    return idx
+
+
+def argsort_pair(x, axis=-1, descending=False):
+    from ..dygraph.tracer import Tensor, current_tracer
+    if in_dygraph_mode():
+        out, idxs = Tensor(None), Tensor(None)
+        current_tracer().trace_op("argsort", {"X": [x]},
+                                  {"Out": [out], "Indices": [idxs]},
+                                  {"axis": axis, "descending": descending})
+        return out, idxs
+    from .. import layers
+    return layers.argsort(x, axis, descending)
+
+
+def cumsum(x, axis=None, name=None):
+    if in_dygraph_mode():
+        return _eager("cumsum", {"X": [x]},
+                      {"axis": axis if axis is not None else -1,
+                       "flatten": axis is None})
+    from .. import layers
+    return layers.cumsum(x, axis if axis is not None else -1)
+
+
+def tril(x, diagonal=0, name=None):
+    if in_dygraph_mode():
+        return _eager("tril_triu", {"X": [x]},
+                      {"diagonal": diagonal, "lower": True})
+    from .. import layers
+    return layers.tril(x, diagonal)
+
+
+def triu(x, diagonal=0, name=None):
+    if in_dygraph_mode():
+        return _eager("tril_triu", {"X": [x]},
+                      {"diagonal": diagonal, "lower": False})
+    from .. import layers
+    return layers.triu(x, diagonal)
+
+
+def expand(x, shape, name=None):
+    if in_dygraph_mode():
+        return _eager("expand_v2", {"X": [x]}, {"shape": list(shape)})
+    # static: paddle-2.0 broadcast-to-shape semantics (expand_v2 op), NOT the
+    # fluid layers.expand repeat-times semantics
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper("expand_v2")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("expand_v2", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"shape": list(shape)})
+    return out
+
+
+def tile(x, repeat_times, name=None):
+    if in_dygraph_mode():
+        return _eager("tile", {"X": [x]}, {"repeat_times": list(repeat_times)})
+    from .. import layers
+    return layers.expand(x, repeat_times)
+
+
+def flip(x, axis, name=None):
+    ax = axis if isinstance(axis, (list, tuple)) else [axis]
+    if in_dygraph_mode():
+        return _eager("flip", {"X": [x]}, {"axis": list(ax)})
+    raise NotImplementedError
+
+
+def roll(x, shifts, axis=None, name=None):
+    if in_dygraph_mode():
+        return _eager("roll", {"X": [x]}, {"shifts": shifts, "axis": axis})
+    raise NotImplementedError
+
+
+def norm(x, p=2, axis=None, keepdim=False, name=None):
+    if in_dygraph_mode():
+        if p == 2 and axis is None:
+            return sqrt(sum(square(x)))
+        return _eager("p_norm", {"X": [x]},
+                      {"porder": float(p), "axis": axis if axis is not None else -1,
+                       "keepdim": keepdim})
+    from .. import layers
+    return layers.sqrt(layers.reduce_sum(layers.square(x)))
+
+
+def numel(x, name=None):
+    return int(np.prod(x.shape))
+
+
+def isnan(x, name=None):
+    return _eager("isnan_v2", {"X": [x]}, {})
+
+
+def isinf(x, name=None):
+    return _eager("isinf_v2", {"X": [x]}, {})
+
+
+def isfinite(x, name=None):
+    return _eager("isfinite_v2", {"X": [x]}, {})

@@ -127,7 +127,7 @@ def test_gradient_clip_by_global_norm():
         default_initializer=paddle.initializer.NumpyArrayInitializer(w0))
     loss = fluid.layers.mean(fluid.layers.square(w))
     opt = paddle.optimizer.SGD(
-        learning_rate=1.0, grad_clip=paddle.clip.GradientClipByGlobalNorm(1.0))
+        learning_rate=1.0, grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
     opt.minimize(loss)
     exe = fluid.Executor()
     exe.run(fluid.default_startup_program())
