@@ -1,0 +1,83 @@
+"""Benchmark: BERT-base train-step throughput on one TPU chip.
+
+Run by the driver on real TPU hardware each round; prints ONE JSON line.
+The reference publishes no numbers (BASELINE.md), so vs_baseline compares
+against the previous round's recording in BENCH_r*.json when present
+(ratio > 1.0 = faster than last round), else 1.0.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+import time
+
+import numpy as np
+
+
+def build_train_step(batch=32, seq_len=128):
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import bert
+    from paddle_tpu.distributed import fleet
+
+    paddle.seed(0)
+    cfg = bert.BertConfig()          # BERT-base geometry
+    cfg.seq_len = seq_len
+    ids, labels, loss = bert.build_pretrain_program(cfg)
+    fleet.init(is_collective=True)
+    strategy = fleet.DistributedStrategy()
+    strategy.amp = True              # bf16 matmuls on the MXU
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.Adam(learning_rate=1e-4), strategy)
+    opt.minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {
+        "input_ids": rng.randint(0, cfg.vocab_size,
+                                 (batch, seq_len)).astype(np.int64),
+        "mlm_labels": rng.randint(0, cfg.vocab_size,
+                                  (batch, seq_len, 1)).astype(np.int64),
+    }
+    return exe, feed, loss
+
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    seq_len = int(os.environ.get("BENCH_SEQ", "128"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+
+    exe, feed, loss = build_train_step(batch, seq_len)
+    # warmup (compile)
+    for _ in range(3):
+        lv, = exe.run(feed=feed, fetch_list=[loss])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        lv, = exe.run(feed=feed, fetch_list=[loss])
+    dt = time.perf_counter() - t0
+    tokens_per_sec = batch * seq_len * steps / dt
+
+    prev = None
+    recs = sorted(glob.glob("BENCH_r*.json"),
+                  key=lambda p: int(re.search(r"r(\d+)", p).group(1)))
+    if recs:
+        try:
+            with open(recs[-1]) as f:
+                prev = json.load(f).get("value")
+        except Exception:
+            prev = None
+    vs = (tokens_per_sec / prev) if prev else 1.0
+    print(json.dumps({
+        "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

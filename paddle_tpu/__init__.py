@@ -38,7 +38,8 @@ from . import clip as _clip_module  # paddle.clip (the name) is the tensor fn;
 from . import io
 
 # ops must import so registrations run
-from .ops import math_ops, nn_ops, tensor_ops, optimizer_ops, metric_ops  # noqa: F401
+from .ops import (math_ops, nn_ops, tensor_ops, optimizer_ops,  # noqa: F401
+                  metric_ops, attention)  # noqa: F401
 
 __version__ = "0.1.0"
 

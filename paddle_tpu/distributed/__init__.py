@@ -1,0 +1,32 @@
+"""paddle.distributed: collectives, fleet, launch, env contract.
+
+Reference counterpart: python/paddle/distributed/ (~14k LoC; SURVEY §2.8).
+TPU-native architecture: parallelism is expressed as mesh axes + shardings
+(paddle_tpu/parallel/), not inserted communication ops. This package provides
+the user-facing API surface: fleet.init / distributed_optimizer,
+DistributedStrategy, collective functions, and the process launcher.
+"""
+from .collective import (all_reduce, all_gather, broadcast, reduce, scatter,
+                         barrier, ReduceOp, get_rank, get_world_size,
+                         split_batch)
+from .parallel import init_parallel_env, DataParallel, ParallelEnv
+from . import fleet
+from ..parallel.mesh import build_mesh, set_mesh, get_mesh, default_mesh
+from ..parallel.spmd import DistConfig, attach
+
+__all__ = [
+    "all_reduce", "all_gather", "broadcast", "reduce", "scatter", "barrier",
+    "ReduceOp", "get_rank", "get_world_size", "init_parallel_env",
+    "DataParallel", "ParallelEnv", "fleet", "build_mesh", "set_mesh",
+    "get_mesh", "DistConfig", "attach", "launch", "spawn",
+]
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """paddle.distributed.spawn parity (reference distributed/spawn.py).
+
+    On a single-controller TPU runtime every device is visible to one process,
+    so 'spawn' runs func once with the full mesh (the sharding inside func
+    spans the devices). For true multi-host, use the launcher + env contract.
+    """
+    return func(*args)

@@ -1,0 +1,22 @@
+"""fleet: the distributed-training facade.
+
+Reference counterpart: python/paddle/distributed/fleet/ — fleet.init
+(fleet_base.py:125), distributed_optimizer (:544), minimize (:926),
+DistributedStrategy (proto-backed, distributed_strategy.proto:106-146),
+RoleMaker env contract (role_maker.py:673-737), and the 14 meta-optimizers
+(fleet/meta_optimizers/*). TPU-native: meta-optimizers become program/config
+transforms — amp ⇒ bf16 lowering policy, recompute ⇒ jax.checkpoint segment
+ops, gradient merge ⇒ gated accumulator rewrite, DP/TP/sharding ⇒ mesh +
+sharding rules on the Executor's pjit — instead of inserted communication ops.
+"""
+from .base import (fleet, init, is_first_worker, worker_index, worker_num,
+                   is_worker, barrier_worker, distributed_optimizer,
+                   DistributedStrategy, PaddleCloudRoleMaker,
+                   UserDefinedRoleMaker, Role)
+from ..collective import get_rank, get_world_size
+
+__all__ = [
+    "init", "is_first_worker", "worker_index", "worker_num", "is_worker",
+    "barrier_worker", "distributed_optimizer", "DistributedStrategy",
+    "PaddleCloudRoleMaker", "UserDefinedRoleMaker", "Role", "fleet",
+]

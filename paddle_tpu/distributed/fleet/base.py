@@ -1,0 +1,279 @@
+"""fleet core: RoleMaker, DistributedStrategy, fleet singleton, and the
+strategy compiler that applies meta-transforms.
+
+Reference: fleet/base/fleet_base.py, role_maker.py, distributed_strategy.py,
+strategy_compiler.py:91 (meta-optimizer chaining).
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+
+from ...framework.program import default_main_program
+from ...parallel import mesh as mesh_mod
+from ...parallel.mesh import ShardingRules
+from ...parallel.spmd import DistConfig, attach
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class PaddleCloudRoleMaker:
+    """Reads the reference's env-var contract (role_maker.py:673-737):
+    PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ENDPOINTS,
+    TRAINING_ROLE. On TPU, intra-host devices need no env at all."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._size = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                        str(max(jax.process_count(), 1))))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._endpoints = eps.split(",") if eps else []
+        self._role = (Role.SERVER
+                      if os.environ.get("TRAINING_ROLE") == "PSERVER"
+                      else Role.WORKER)
+
+    def worker_index(self):
+        return self._rank
+
+    def worker_num(self):
+        return self._size
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_first_worker(self):
+        return self._rank == 0 and self.is_worker()
+
+    def get_trainer_endpoints(self):
+        return self._endpoints
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    def __init__(self, current_id=0, role=Role.WORKER, worker_num=1,
+                 server_endpoints=None, **kw):
+        super().__init__()
+        self._rank = current_id
+        self._size = worker_num
+        self._role = role
+
+
+@dataclass
+class DistributedStrategy:
+    """Typed mirror of the reference's proto
+    (framework/distributed_strategy.proto:106-146). Every field is honored by
+    the strategy compiler below or documented as a no-op on TPU."""
+
+    amp: bool = False
+    amp_configs: dict = field(default_factory=lambda: {
+        "init_loss_scaling": 32768.0, "use_pure_bf16": True})
+    recompute: bool = False
+    recompute_configs: dict = field(default_factory=lambda: {"checkpoints": []})
+    gradient_merge: bool = False
+    gradient_merge_configs: dict = field(default_factory=lambda: {"k_steps": 1})
+    localsgd: bool = False
+    localsgd_configs: dict = field(default_factory=lambda: {"k_steps": 1})
+    dgc: bool = False                      # no-op on TPU: no wire to compress
+    fp16_allreduce: bool = False           # no-op: XLA picks collective dtype
+    lars: bool = False
+    lars_configs: dict = field(default_factory=dict)
+    lamb: bool = False
+    lamb_configs: dict = field(default_factory=dict)
+    pipeline: bool = False
+    pipeline_configs: dict = field(default_factory=lambda: {
+        "micro_batch_size": 1, "accumulate_steps": 1})
+    sharding: bool = False                 # ZeRO-1: shard optimizer state
+    sharding_configs: dict = field(default_factory=dict)
+    # mesh geometry (beyond-reference: TP/SP/EP are new capabilities)
+    tensor_parallel_degree: int = 1
+    pipeline_parallel_degree: int = 1
+    sequence_parallel_degree: int = 1
+    expert_parallel_degree: int = 1
+    tensor_parallel_rules: Optional[ShardingRules] = None
+    # reference knobs kept for source compat (scheduling is XLA's job)
+    nccl_comm_num: int = 1
+    use_hierarchical_allreduce: bool = False
+    sync_batch_norm: bool = False
+    execution_strategy: dict = field(default_factory=dict)
+    build_strategy: dict = field(default_factory=dict)
+    a_sync: bool = False                   # PS async mode (host KV path)
+    a_sync_configs: dict = field(default_factory=dict)
+
+
+class _Fleet:
+    def __init__(self):
+        self._role_maker = None
+        self._strategy = None
+        self._mesh = None
+
+    # -- lifecycle (reference fleet_base.py:125) ---------------------------
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective=is_collective)
+        self._strategy = strategy or DistributedStrategy()
+        mesh_mod.init_parallel_env()
+        self._build_mesh(self._strategy)
+        return self
+
+    def _build_mesh(self, s: DistributedStrategy):
+        self._mesh = mesh_mod.build_mesh(
+            dp=-1, tp=s.tensor_parallel_degree,
+            pp=s.pipeline_parallel_degree,
+            sp=s.sequence_parallel_degree,
+            ep=s.expert_parallel_degree)
+        mesh_mod.set_mesh(self._mesh)
+
+    # -- info --------------------------------------------------------------
+    def worker_index(self):
+        return self._role_maker.worker_index() if self._role_maker else 0
+
+    def worker_num(self):
+        return self._role_maker.worker_num() if self._role_maker else 1
+
+    def is_worker(self):
+        return self._role_maker.is_worker() if self._role_maker else True
+
+    def is_first_worker(self):
+        return self._role_maker.is_first_worker() if self._role_maker else True
+
+    def is_server(self):
+        return self._role_maker.is_server() if self._role_maker else False
+
+    def barrier_worker(self):
+        from ..collective import barrier
+        barrier()
+
+    @property
+    def worker_endpoints(self):
+        return self._role_maker.get_trainer_endpoints() if self._role_maker else []
+
+    # -- the meta-optimizer entry (reference fleet_base.py:544,926) --------
+    def distributed_optimizer(self, optimizer, strategy=None):
+        if strategy is not None:
+            self._strategy = strategy
+            self._build_mesh(strategy)
+        return DistributedOptimizer(optimizer, self._strategy or
+                                    DistributedStrategy(), self)
+
+    # -- save/load ---------------------------------------------------------
+    def save_persistables(self, executor, dirname, main_program=None):
+        from ... import io
+        if self.is_first_worker():
+            io.save_persistables(executor, dirname, main_program)
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None):
+        from ... import io
+        if self.is_first_worker():
+            io.save_inference_model(dirname, feeded_var_names, target_vars,
+                                    executor, main_program)
+
+    def init_worker(self):
+        pass
+
+    def init_server(self, *args):
+        pass
+
+    def run_server(self):
+        from ...ps.server import run_server
+        run_server()
+
+    def stop_worker(self):
+        pass
+
+
+class DistributedOptimizer:
+    """Applies the strategy as program transforms then delegates to the inner
+    optimizer. Mirrors StrategyCompiler.generate_optimizer chaining
+    (strategy_compiler.py:91): amp -> recompute -> lars/lamb swap ->
+    gradient_merge -> SPMD attach."""
+
+    def __init__(self, inner_opt, strategy: DistributedStrategy, fleet_obj):
+        self.inner_opt = inner_opt
+        self.user_defined_strategy = strategy
+        self._fleet = fleet_obj
+
+    def __getattr__(self, item):
+        return getattr(self.inner_opt, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        s = self.user_defined_strategy
+        program = loss.block.program
+        opt = self.inner_opt
+
+        # lars/lamb meta-optimizers swap the update rule (reference
+        # fleet/meta_optimizers/{lars,lamb}_optimizer.py)
+        from ... import optimizer as opt_mod
+        if s.lars and isinstance(opt, opt_mod.MomentumOptimizer):
+            opt = opt_mod.LarsMomentumOptimizer(
+                learning_rate=opt._learning_rate,
+                momentum=opt._momentum, **s.lars_configs)
+        if s.lamb and isinstance(opt, opt_mod.AdamOptimizer):
+            opt = opt_mod.LambOptimizer(
+                learning_rate=opt._learning_rate, **s.lamb_configs)
+
+        if s.amp:
+            program._amp = True
+            program._amp_dtype = ("bfloat16"
+                                  if s.amp_configs.get("use_pure_bf16", True)
+                                  else "float16")
+            program.bump_version()
+
+        if s.recompute and s.recompute_configs.get("checkpoints"):
+            from ...parallel.transforms import apply_recompute
+            apply_recompute(program, s.recompute_configs["checkpoints"])
+
+        if s.gradient_merge and s.gradient_merge_configs.get("k_steps", 1) > 1:
+            from ...parallel.transforms import GradientMergeWrapper
+            opt = GradientMergeWrapper(opt,
+                                       s.gradient_merge_configs["k_steps"])
+
+        result = opt.minimize(loss, startup_program, parameter_list,
+                              no_grad_set)
+
+        # SPMD attach: data axis + TP rules (+ ZeRO-1 optimizer-state sharding)
+        rules = s.tensor_parallel_rules or ShardingRules()
+        if s.sharding:
+            import re
+            from jax.sharding import PartitionSpec as P
+            zero1 = (re.compile(r"_(moment\d?|velocity|mean_square|mean_grad"
+                                r"|momentum)_\d+$"), P("dp"))
+            rules = ShardingRules()
+            rules._rules = list((s.tensor_parallel_rules or
+                                 ShardingRules())._rules) + [zero1]
+        attach(program, DistConfig(mesh=self._fleet._mesh, param_rules=rules))
+        return result
+
+    def apply_gradients(self, params_grads):
+        return self.inner_opt.apply_gradients(params_grads)
+
+    def backward(self, *a, **kw):
+        return self.inner_opt.backward(*a, **kw)
+
+    def step(self):
+        return self.inner_opt.step()
+
+    def clear_grad(self):
+        return self.inner_opt.clear_grad()
+
+
+fleet = _Fleet()
+
+# module-level API (paddle.distributed.fleet.init style)
+init = fleet.init
+is_first_worker = fleet.is_first_worker
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+is_worker = fleet.is_worker
+barrier_worker = fleet.barrier_worker
+distributed_optimizer = fleet.distributed_optimizer

@@ -1,0 +1,68 @@
+"""Process launcher: `python -m paddle_tpu.distributed.launch train.py`.
+
+Reference counterpart: distributed/launch.py:221 + fleet/launch.py:300
+(`fleetrun`): spawn one process per GPU with the PADDLE_* env contract. On
+TPU, devices within a host belong to ONE process (single-controller), so the
+launcher spawns one process per HOST (for multi-host pods, driven by
+TPU_WORKER_HOSTNAMES or --ips) and sets both the reference env contract and
+the jax.distributed coordinator variables.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def _parse_args():
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--ips", type=str, default="127.0.0.1",
+                   help="comma-separated host ips (reference --ips)")
+    p.add_argument("--port", type=int, default=6170)
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="kept for parity; on TPU one process drives all "
+                        "local chips, so this is normally 1")
+    p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args()
+
+
+def launch():
+    args = _parse_args()
+    ips = args.ips.split(",")
+    nnodes = len(ips)
+    procs = []
+    coordinator = f"{ips[0]}:{args.port}"
+    endpoints = ",".join(f"{ip}:{args.port + i}"
+                         for i, ip in enumerate(ips))
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+    for rank in range(args.nproc_per_node if nnodes == 1 else nnodes):
+        env = dict(os.environ)
+        env.update({
+            # reference env contract (role_maker.py:673-737)
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(max(nnodes, args.nproc_per_node)),
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_CURRENT_ENDPOINT": f"{ips[min(rank, nnodes - 1)]}:{args.port + rank}",
+            "TRAINING_ROLE": "TRAINER",
+            # jax.distributed bootstrap (DCN)
+            "JAX_COORDINATOR_ADDRESS": coordinator,
+            "JAX_NUM_PROCESSES": str(max(nnodes, 1)),
+            "JAX_PROCESS_ID": str(rank),
+        })
+        log = (open(os.path.join(args.log_dir, f"worker.{rank}.log"), "w")
+               if args.log_dir else None)
+        procs.append(subprocess.Popen(
+            [sys.executable, args.training_script] + args.training_script_args,
+            env=env, stdout=log, stderr=subprocess.STDOUT if log else None))
+    rc = 0
+    for p in procs:
+        rc |= p.wait()
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    launch()

@@ -1,0 +1,96 @@
+"""Dygraph data parallelism.
+
+Reference counterpart: fluid/dygraph/parallel.py:335 (DataParallel: loss
+scaling + coalesced NCCL allreduce of grads, parallel.py:229,284 +
+imperative/all_reduce.cc). TPU-native: DataParallel shards the input batch
+over the 'dp' mesh axis and keeps params replicated; jax computes on sharded
+arrays directly, and the gradient all-reduce emerges from the sharding math
+(GSPMD) — there is no coalescing code because there are no per-grad NCCL
+launches to amortize.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from ..nn import Layer
+from ..parallel import mesh as mesh_mod
+from .collective import split_batch
+
+
+class ParallelEnv:
+    """Reference ParallelEnv (env-var contract, role_maker.py:673-737)."""
+
+    def __init__(self):
+        self.rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.world_size = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                             str(jax.process_count())))
+        self.device_id = 0
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self.trainer_endpoints = eps.split(",") if eps else []
+        self.current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def local_rank(self):
+        return self.rank
+
+
+def init_parallel_env():
+    """reference distributed/parallel.py:46 init_parallel_env."""
+    mesh_mod.init_parallel_env()
+    if mesh_mod.get_mesh() is None:
+        mesh_mod.set_mesh(mesh_mod.build_mesh())
+    return ParallelEnv()
+
+
+class DataParallel(Layer):
+    """Wraps a Layer for data-parallel training.
+
+    Usage parity with the reference (model = DataParallel(model); loss
+    scaling + apply_collective_grads are no-ops kept for source compat).
+    """
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1):
+        super().__init__()
+        self._layers = layers
+        if mesh_mod.get_mesh() is None:
+            mesh_mod.set_mesh(mesh_mod.build_mesh())
+        self._mesh = mesh_mod.get_mesh()
+        # replicate parameters across the mesh once
+        from jax.sharding import NamedSharding, PartitionSpec
+        repl = NamedSharding(self._mesh, PartitionSpec())
+        for p in layers.parameters():
+            p.value = jax.device_put(p.value, repl)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        # grads are mean over the data axis automatically (loss mean over the
+        # sharded batch) — reference scales by 1/nranks before allreduce
+        return loss
+
+    def apply_collective_grads(self):
+        # no-op: GSPMD already reduced grads during backward
+        pass
+
+    def shard_input(self, array):
+        return split_batch(array)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def named_parameters(self, *a, **k):
+        return self._layers.named_parameters(*a, **k)

@@ -29,7 +29,9 @@ class _CompiledBlock:
 
     def __init__(self, program: Program, block_idx: int,
                  feed_names: Sequence[str], fetch_names: Sequence[str],
-                 state_names: Sequence[str], donate: bool = True):
+                 state_names: Sequence[str], donate: bool = True,
+                 feed_shapes: Optional[dict] = None,
+                 state_shapes: Optional[dict] = None):
         self.program = program
         self.block = program.blocks[block_idx]
         self.feed_names = list(feed_names)
@@ -44,7 +46,41 @@ class _CompiledBlock:
         fn = functools.partial(_run_block, self.block, self.feed_names,
                                self.fetch_names, self.mut_names, self.ro_names,
                                self.written_state)
-        self.jitted = jax.jit(fn, donate_argnums=(0,) if donate else ())
+        jit_kw = {}
+        dist = getattr(program, "_dist_config", None)
+        if dist is not None:
+            # SPMD: shard feeds over the data axes, params per TP rules; XLA
+            # GSPMD inserts every collective (the grad allreduce included)
+            mesh = dist.resolve_mesh()
+            self.mesh = mesh
+
+            def state_shard(names):
+                return {n: dist.state_sharding(
+                    mesh, n, (state_shapes or {}).get(n)) for n in names}
+
+            feeds_shard = {n: dist.feed_sharding(
+                mesh, n, (feed_shapes or {}).get(n, ()))
+                for n in self.feed_names}
+            from jax.sharding import NamedSharding, PartitionSpec
+            repl = NamedSharding(mesh, PartitionSpec())
+            mut_shard = state_shard(self.mut_names)
+            jit_kw["in_shardings"] = (mut_shard, state_shard(self.ro_names),
+                                      feeds_shard, repl)
+            # pin written-state outputs to their declared shardings so the
+            # arrays written back to the Scope match in_shardings next call
+            # (fetches stay unconstrained = None → GSPMD chooses)
+            written_shard = {
+                n: dist.state_sharding(
+                    mesh, n,
+                    (state_shapes or {}).get(
+                        n, tuple(self.block.var(n).shape)))
+                for n in self.written_state}
+            jit_kw["out_shardings"] = ([None] * len(self.fetch_names),
+                                       written_shard)
+        else:
+            self.mesh = None
+        self.jitted = jax.jit(fn, donate_argnums=(0,) if donate else (),
+                              **jit_kw)
 
     def _written_persistables(self) -> List[str]:
         written = []
@@ -92,11 +128,19 @@ def _run_block(block, feed_names, fetch_names, mut_names, ro_names,
 
 
 def _run_block_inner(block, fetch_names, written_state, env, ctx):
+    amp_dtype = None
+    if getattr(block.program, "_amp", False):
+        import jax.numpy as jnp
+        amp_dtype = (jnp.bfloat16
+                     if getattr(block.program, "_amp_dtype", "bfloat16")
+                     == "bfloat16" else jnp.float16)
     for op in block.ops:
         opdef = registry.get(op.type)
         ins = {}
         for slot, names in op.inputs.items():
             ins[slot] = [None if n == "@EMPTY@" else env[n] for n in names]
+        if amp_dtype is not None:
+            ins = _amp_cast(op, ins, amp_dtype)
         outs = opdef.lower(ctx, ins, op.attrs)
         for slot, names in op.outputs.items():
             if slot not in outs:
@@ -109,6 +153,32 @@ def _run_block_inner(block, fetch_names, written_state, env, ctx):
     fetches = [env[n] for n in fetch_names]
     new_state = {n: env[n] for n in written_state if n in env}
     return fetches, new_state
+
+
+def _amp_cast(op, ins, low_dtype):
+    """Static-graph AMP: white-list compute ops run in bf16/fp16, black-list
+    ops in f32 (reference contrib/mixed_precision/fp16_utils.py cast
+    insertion — here done at lowering time, zero extra graph ops). Grad ops
+    (__vjp__) re-derive the policy from their wrapped forward type."""
+    import jax.numpy as jnp
+    from ..amp.auto_cast import white_list, black_list
+    op_type = op.attrs.get("fwd_type", op.type) if op.type == "__vjp__" \
+        else op.type
+    if op_type in white_list:
+        target = low_dtype
+    elif op_type in black_list:
+        target = jnp.float32
+    else:
+        return ins
+    out = {}
+    for slot, vals in ins.items():
+        out[slot] = [
+            v.astype(target)
+            if (v is not None and hasattr(v, "dtype")
+                and jnp.issubdtype(v.dtype, jnp.floating)
+                and v.dtype != target) else v
+            for v in vals]
+    return out
 
 
 class Executor:
@@ -164,8 +234,11 @@ class Executor:
                tuple(state_names))
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
-            compiled = _CompiledBlock(program, 0, list(feed_vals), fetch_names,
-                                      state_names)
+            compiled = _CompiledBlock(
+                program, 0, list(feed_vals), fetch_names, state_names,
+                feed_shapes={k: tuple(v.shape) for k, v in feed_vals.items()},
+                state_shapes={n: tuple(scope.find(n).shape)
+                              for n in state_names})
             if use_program_cache:
                 self._cache[key] = compiled
 

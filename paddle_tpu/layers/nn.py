@@ -25,6 +25,7 @@ __all__ = [
     "equal", "not_equal", "less_than", "less_equal", "greater_than",
     "greater_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
     "where", "cond_take", "unique", "cumsum", "prelu", "brelu",
+    "fused_attention",
 ]
 
 
@@ -794,4 +795,21 @@ def pow(x, factor=1.0, name=None):
     out = helper.create_variable_for_type_inference(x.dtype)
     helper.append_op("pow", inputs={"X": [x]}, outputs={"Out": [out]},
                      attrs={"factor": factor})
+    return out
+
+
+def fused_attention(q, k, v, mask=None, scale=None, dropout=0.0,
+                    causal=False, name=None):
+    """Fused multi-head attention on [B, nh, S, hd] tensors (reference
+    fused/multihead_matmul_op.cu); pallas flash kernel on TPU."""
+    helper = LayerHelper("fused_attention")
+    out = helper.create_variable_for_type_inference(q.dtype)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if mask is not None:
+        inputs["Mask"] = [mask]
+    attrs = {"dropout": dropout, "causal": causal, "is_test": False}
+    if scale is not None:
+        attrs["scale"] = scale
+    helper.append_op("fused_attention", inputs=inputs,
+                     outputs={"Out": [out]}, attrs=attrs)
     return out

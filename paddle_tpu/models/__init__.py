@@ -1,0 +1,7 @@
+"""Model zoo covering the BASELINE workload configs (BASELINE.md):
+1. LeNet (MNIST, static graph)      -> lenet.py
+2. ResNet-50 (dygraph paddle.nn)    -> resnet.py
+3/4. BERT/ERNIE transformer (static, SPMD-ready with TP rules) -> bert.py
+5. Wide&Deep CTR (sparse embeddings) -> wide_deep.py
+"""
+from . import lenet, resnet, bert, wide_deep

@@ -1,0 +1,170 @@
+"""BERT/ERNIE-family transformer encoder, static-graph builder.
+
+Reference counterpart: the fluid.layers transformer used by the reference's
+dist_transformer.py test model and ERNIE pretraining (BASELINE configs 3/4).
+Built TPU-first: bf16-friendly, batch-major [B, S, H], and ships Megatron
+sharding rules (column-parallel QKV/FFN-in, row-parallel proj/FFN-out) as
+data for the SPMD executor. Attention lowers to the fused `attention` op
+(pallas flash-attention kernel on TPU when available, ops/attention.py).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from jax.sharding import PartitionSpec as P
+
+from .. import layers
+from ..layer_helper import ParamAttr
+from .. import initializer as I
+from ..parallel.mesh import ShardingRules
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    seq_len: int = 128
+
+    @staticmethod
+    def base():
+        return BertConfig()
+
+    @staticmethod
+    def large():
+        return BertConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                          intermediate_size=4096)
+
+    @staticmethod
+    def tiny():
+        return BertConfig(vocab_size=1024, hidden_size=64, num_layers=2,
+                          num_heads=4, intermediate_size=128,
+                          max_position=128, seq_len=32)
+
+
+def _attr(name):
+    return ParamAttr(name=name, initializer=I.TruncatedNormal(0.0, 0.02))
+
+
+def encoder_layer(x, cfg: BertConfig, idx: int, attn_mask=None):
+    """One transformer block. Param names carry qkv/proj/ffn markers that the
+    TP sharding rules key on."""
+    h = cfg.hidden_size
+    nh = cfg.num_heads
+    hd = h // nh
+    pre = x
+
+    # fused QKV projection (one MXU matmul instead of three)
+    qkv = layers.fc(x, 3 * h, num_flatten_dims=2,
+                    param_attr=_attr(f"enc{idx}_attn_qkv_w"),
+                    bias_attr=ParamAttr(name=f"enc{idx}_attn_qkv_b"))
+    q, k, v = layers.split(qkv, 3, dim=2)
+
+    def heads(t):
+        t = layers.reshape(t, [0, 0, nh, hd])
+        return layers.transpose(t, [0, 2, 1, 3])  # [B, nh, S, hd]
+
+    q, k, v = heads(q), heads(k), heads(v)
+    ctx = layers.fused_attention(q, k, v, mask=attn_mask,
+                                 scale=1.0 / math.sqrt(hd),
+                                 dropout=cfg.attention_dropout)
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])
+    ctx = layers.reshape(ctx, [0, 0, h])
+    proj = layers.fc(ctx, h, num_flatten_dims=2,
+                     param_attr=_attr(f"enc{idx}_attn_proj_w"),
+                     bias_attr=ParamAttr(name=f"enc{idx}_attn_proj_b"))
+    if cfg.hidden_dropout:
+        proj = layers.dropout(proj, cfg.hidden_dropout,
+                              dropout_implementation="upscale_in_train")
+    x = layers.layer_norm(layers.elementwise_add(pre, proj),
+                          begin_norm_axis=2,
+                          param_attr=ParamAttr(name=f"enc{idx}_ln1_scale"),
+                          bias_attr=ParamAttr(name=f"enc{idx}_ln1_bias"))
+
+    pre = x
+    ffn = layers.fc(x, cfg.intermediate_size, num_flatten_dims=2, act="gelu",
+                    param_attr=_attr(f"enc{idx}_ffn_in_w"),
+                    bias_attr=ParamAttr(name=f"enc{idx}_ffn_in_b"))
+    ffn = layers.fc(ffn, h, num_flatten_dims=2,
+                    param_attr=_attr(f"enc{idx}_ffn_out_w"),
+                    bias_attr=ParamAttr(name=f"enc{idx}_ffn_out_b"))
+    if cfg.hidden_dropout:
+        ffn = layers.dropout(ffn, cfg.hidden_dropout,
+                             dropout_implementation="upscale_in_train")
+    return layers.layer_norm(layers.elementwise_add(pre, ffn),
+                             begin_norm_axis=2,
+                             param_attr=ParamAttr(name=f"enc{idx}_ln2_scale"),
+                             bias_attr=ParamAttr(name=f"enc{idx}_ln2_bias"))
+
+
+def bert_encoder(input_ids, cfg: BertConfig, position_ids=None,
+                 attn_mask=None):
+    """Embeddings + N encoder layers -> sequence output [B, S, H]."""
+    word_emb = layers.embedding(
+        layers.unsqueeze(input_ids, [2]), [cfg.vocab_size, cfg.hidden_size],
+        param_attr=_attr("word_embedding"))
+    word_emb = layers.reshape(word_emb, [0, 0, cfg.hidden_size])
+    pos_emb_table = layers.create_parameter(
+        [cfg.max_position, cfg.hidden_size], "float32",
+        attr=_attr("pos_embedding"))
+    pos_emb = layers.slice(pos_emb_table, [0], [0], [cfg.seq_len])
+    pos_emb = layers.unsqueeze(pos_emb, [0])
+    x = layers.elementwise_add(word_emb, pos_emb)
+    x = layers.layer_norm(x, begin_norm_axis=2,
+                          param_attr=ParamAttr(name="emb_ln_scale"),
+                          bias_attr=ParamAttr(name="emb_ln_bias"))
+    if cfg.hidden_dropout:
+        x = layers.dropout(x, cfg.hidden_dropout,
+                           dropout_implementation="upscale_in_train")
+    for i in range(cfg.num_layers):
+        x = encoder_layer(x, cfg, i, attn_mask)
+    return x
+
+
+def bert_pretrain_loss(seq_out, mlm_labels, cfg: BertConfig):
+    """Masked-LM head + loss (ERNIE pretraining objective)."""
+    logits = layers.fc(seq_out, cfg.vocab_size, num_flatten_dims=2,
+                       param_attr=_attr("mlm_head_w"),
+                       bias_attr=ParamAttr(name="mlm_head_b"))
+    loss = layers.softmax_with_cross_entropy(logits, mlm_labels)
+    return layers.mean(loss)
+
+
+def build_pretrain_program(cfg: BertConfig):
+    """Declare data vars + full pretrain graph; returns (ids, labels, loss)."""
+    input_ids = layers.data(name="input_ids", shape=[cfg.seq_len],
+                            dtype="int64")
+    mlm_labels = layers.data(name="mlm_labels", shape=[cfg.seq_len, 1],
+                             dtype="int64")
+    seq = bert_encoder(input_ids, cfg)
+    loss = bert_pretrain_loss(seq, mlm_labels, cfg)
+    return input_ids, mlm_labels, loss
+
+
+def tp_sharding_rules() -> ShardingRules:
+    """Megatron-style tensor-parallel rules for this model's param names:
+    column-parallel QKV & FFN-in (shard output dim over tp), row-parallel
+    attn-proj & FFN-out (shard input dim), vocab-sharded embeddings/head."""
+    return ShardingRules([
+        (r"_attn_qkv_w$", P(None, "tp")),
+        (r"_attn_qkv_b$", P("tp")),
+        (r"_ffn_in_w$", P(None, "tp")),
+        (r"_ffn_in_b$", P("tp")),
+        (r"_attn_proj_w$", P("tp", None)),
+        (r"_ffn_out_w$", P("tp", None)),
+        (r"^word_embedding$", P("tp", None)),
+        (r"^mlm_head_w$", P(None, "tp")),
+        (r"^mlm_head_b$", P("tp")),
+    ])
+
+
+# ERNIE is architecture-compatible (BASELINE config 4)
+ErnieConfig = BertConfig
+ernie_encoder = bert_encoder

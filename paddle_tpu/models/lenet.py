@@ -1,0 +1,39 @@
+"""LeNet-5 (BASELINE config 1: MNIST static-graph Executor).
+
+Reference counterpart: the model in fluid/tests/book/test_recognize_digits.py.
+"""
+from __future__ import annotations
+
+from .. import layers
+from .. import nn
+
+
+def build_static(img, label):
+    """Static-graph LeNet; returns (logits, avg_loss, accuracy)."""
+    c1 = layers.conv2d(img, num_filters=6, filter_size=5, padding=2,
+                       act="relu")
+    p1 = layers.pool2d(c1, pool_size=2, pool_stride=2)
+    c2 = layers.conv2d(p1, num_filters=16, filter_size=5, act="relu")
+    p2 = layers.pool2d(c2, pool_size=2, pool_stride=2)
+    f1 = layers.fc(p2, size=120, act="relu")
+    f2 = layers.fc(f1, size=84, act="relu")
+    logits = layers.fc(f2, size=10)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    acc = layers.accuracy(layers.softmax(logits), label)
+    return logits, loss, acc
+
+
+class LeNet(nn.Layer):
+    """Dygraph LeNet (paddle.vision.models.LeNet parity)."""
+
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(1, 6, 5, padding=2), nn.ReLU(), nn.MaxPool2D(2, 2),
+            nn.Conv2D(6, 16, 5), nn.ReLU(), nn.MaxPool2D(2, 2))
+        self.fc = nn.Sequential(
+            nn.Flatten(), nn.Linear(400, 120), nn.ReLU(),
+            nn.Linear(120, 84), nn.ReLU(), nn.Linear(84, num_classes))
+
+    def forward(self, x):
+        return self.fc(self.features(x))

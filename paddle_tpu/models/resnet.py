@@ -1,0 +1,104 @@
+"""ResNet family, dygraph paddle.nn (BASELINE config 2: ResNet-50 ImageNet).
+
+Reference counterpart: the reference's se_resnext/resnet dist test models and
+paddle.vision.models.resnet. TPU note: NCHW is kept for API parity; XLA
+re-lays out convs for the MXU.
+"""
+from __future__ import annotations
+
+from .. import nn
+
+
+class BottleneckBlock(nn.Layer):
+    expansion = 4
+
+    def __init__(self, in_ch, ch, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = nn.Conv2D(in_ch, ch, 1, bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(ch)
+        self.conv2 = nn.Conv2D(ch, ch, 3, stride=stride, padding=1,
+                               bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(ch)
+        self.conv3 = nn.Conv2D(ch, ch * 4, 1, bias_attr=False)
+        self.bn3 = nn.BatchNorm2D(ch * 4)
+        self.relu = nn.ReLU()
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class BasicBlock(nn.Layer):
+    expansion = 1
+
+    def __init__(self, in_ch, ch, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = nn.Conv2D(in_ch, ch, 3, stride=stride, padding=1,
+                               bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(ch)
+        self.conv2 = nn.Conv2D(ch, ch, 3, padding=1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(ch)
+        self.relu = nn.ReLU()
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class ResNet(nn.Layer):
+    def __init__(self, block, depths, num_classes=1000, in_ch=3):
+        super().__init__()
+        self.inplanes = 64
+        self.conv1 = nn.Conv2D(in_ch, 64, 7, stride=2, padding=3,
+                               bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(64)
+        self.relu = nn.ReLU()
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        self.layer1 = self._make_layer(block, 64, depths[0])
+        self.layer2 = self._make_layer(block, 128, depths[1], stride=2)
+        self.layer3 = self._make_layer(block, 256, depths[2], stride=2)
+        self.layer4 = self._make_layer(block, 512, depths[3], stride=2)
+        self.avgpool = nn.AdaptiveAvgPool2D(1)
+        self.flatten = nn.Flatten()
+        self.fc = nn.Linear(512 * block.expansion, num_classes)
+
+    def _make_layer(self, block, ch, depth, stride=1):
+        downsample = None
+        if stride != 1 or self.inplanes != ch * block.expansion:
+            downsample = nn.Sequential(
+                nn.Conv2D(self.inplanes, ch * block.expansion, 1,
+                          stride=stride, bias_attr=False),
+                nn.BatchNorm2D(ch * block.expansion))
+        blocks = [block(self.inplanes, ch, stride, downsample)]
+        self.inplanes = ch * block.expansion
+        for _ in range(1, depth):
+            blocks.append(block(self.inplanes, ch))
+        return nn.Sequential(*blocks)
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        return self.fc(self.flatten(self.avgpool(x)))
+
+
+def resnet18(num_classes=1000, **kw):
+    return ResNet(BasicBlock, [2, 2, 2, 2], num_classes, **kw)
+
+
+def resnet50(num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], num_classes, **kw)
+
+
+def resnet101(num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], num_classes, **kw)

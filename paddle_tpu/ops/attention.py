@@ -1,0 +1,78 @@
+"""Fused attention op.
+
+Reference counterpart: operators/fused/multihead_matmul_op.cu +
+math/bert_encoder_functor.cu (hand-written CUDA attention). TPU-native: one
+op whose lowering is either (a) the XLA path — two MXU matmuls + fused
+softmax, which XLA already schedules well — or (b) a Pallas flash-attention
+kernel (ops/pallas/flash_attention.py) when running on real TPU with
+supported shapes, cutting HBM traffic for long sequences.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _xla_attention(q, k, v, mask, scale, dropout, key):
+    # q,k,v: [B, nh, S, hd]
+    scores = jnp.einsum("bnqd,bnkd->bnqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        scores = scores + mask.astype(scores.dtype)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout), 0.0)
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bnqk,bnkd->bnqd", probs, v)
+
+
+def _use_pallas(q):
+    import os
+    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS"):
+        return False
+    try:
+        if jax.default_backend() != "tpu":
+            return False
+    except RuntimeError:
+        return False
+    b, nh, s, hd = q.shape
+    return s % 128 == 0 and hd in (64, 128, 256) and s >= 256
+
+
+@register("fused_attention", is_random=True, nondiff_slots=("Mask",))
+def _fused_attention(ctx, ins, attrs):
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    mask = ins["Mask"][0] if ins.get("Mask") else None
+    scale = attrs.get("scale", 1.0 / math.sqrt(q.shape[-1]))
+    dropout = attrs.get("dropout", 0.0)
+    if attrs.get("is_test", False):
+        dropout = 0.0
+    key = ctx.op_key(attrs) if dropout else None
+    causal = attrs.get("causal", False)
+    if not ctx.is_eval_shape and dropout == 0.0 and mask is None \
+            and not isinstance(q, jax.ShapeDtypeStruct) and _use_pallas(q):
+        try:
+            from .pallas.flash_attention import flash_attention
+            return {"Out": [flash_attention(q, k, v, scale=scale,
+                                            causal=causal)]}
+        except Exception as e:  # pragma: no cover - kernel/platform specific
+            global _warned_fallback
+            if not _warned_fallback:
+                import warnings
+                warnings.warn(
+                    f"pallas flash attention unavailable ({e!r}); "
+                    f"using the XLA attention path")
+                _warned_fallback = True
+    if causal and mask is None:
+        s = q.shape[2]
+        mask = jnp.triu(jnp.full((s, s), -1e9, jnp.float32), 1)[None, None]
+    return {"Out": [_xla_attention(q, k, v, mask, scale, dropout, key)]}
+
+
+_warned_fallback = False

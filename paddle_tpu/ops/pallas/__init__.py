@@ -1,0 +1,7 @@
+"""Pallas TPU kernels — the hand-written-kernel layer.
+
+Reference counterpart: operators/math/*.cu, operators/fused/*.cu,
+operators/jit/ (xbyak x86 codegen). On TPU, XLA fuses most elementwise work
+already; kernels live here only where manual tiling beats the compiler —
+flash attention first (HBM-bound softmax(QK^T)V).
+"""

@@ -1,0 +1,136 @@
+"""Flash attention (Pallas, TPU).
+
+Replaces the reference's fused CUDA attention (fused/multihead_matmul_op.cu,
+math/bert_encoder_functor.cu) with an online-softmax tiled kernel: Q blocks
+stay resident in VMEM while K/V stream through, so the S×S score matrix never
+touches HBM. Forward-only custom kernel; backward uses the XLA path via
+jax.custom_vjp (recompute — still O(S) memory).
+
+Layout: [B, nh, S, hd]; grid over (batch*heads, q_blocks); K/V iterated with
+lax.fori_loop inside the kernel (KV fully resident per head — fine up to
+S~8k at hd 64-128 in 16MB VMEM; longer sequences use the ring path in
+parallel/ring_attention.py).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 512
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
+                      seq_len):
+    # q_ref: [block_q, hd]; k_ref/v_ref: [S, hd]; o_ref: [block_q, hd]
+    block_q = q_ref.shape[0]
+    hd = q_ref.shape[1]
+    q_idx = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32) * scale
+
+    m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, hd), jnp.float32)
+
+    num_k_blocks = seq_len // block_k
+
+    def body(kb, carry):
+        m_prev, l_prev, acc = carry
+        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # guard -inf rows (fully-masked): exp(-inf - -inf) -> use safe sub
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe)
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    if causal:
+        # only iterate k blocks that intersect the causal triangle
+        last = (q_idx + 1) * block_q
+        n_blocks = jnp.minimum(num_k_blocks,
+                               (last + block_k - 1) // block_k)
+    else:
+        n_blocks = num_k_blocks
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)
+    o_ref[:] = out.astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    b, nh, s, hd = q.shape
+    bq = min(block_q, s)
+    bk = min(block_k, s)
+    q3 = q.reshape(b * nh, s, hd)
+    k3 = k.reshape(b * nh, s, hd)
+    v3 = v.reshape(b * nh, s, hd)
+    kernel = functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
+                               block_k=bk, seq_len=s)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * nh, s // bq),
+        in_specs=[
+            pl.BlockSpec((None, bq, hd), lambda h, i: (h, i, 0)),
+            pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
+            pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, bq, hd), lambda h, i: (h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * nh, s, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+    )(q3, k3, v3)
+    return out.reshape(b, nh, s, hd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, scale=None, causal=False,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+
+
+def _fwd(q, k, v, scale, causal, block_q, block_k):
+    out = flash_attention(q, k, v, scale, causal, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _bwd(scale, causal, block_q, block_k, res, do):
+    q, k, v = res
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+
+    def ref_attn(q, k, v):
+        s = jnp.einsum("bnqd,bnkd->bnqk", q, k,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            sl = q.shape[2]
+            mask = jnp.tril(jnp.ones((sl, sl), bool))[None, None]
+            s = jnp.where(mask, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+        return jnp.einsum("bnqk,bnkd->bnqd", p, v)
+
+    _, vjp = jax.vjp(ref_attn, q, k, v)
+    return vjp(do)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -61,7 +61,6 @@ class OpDef:
 
 
 _REGISTRY: Dict[str, OpDef] = {}
-_rng_seed_counter = [1]
 
 
 def register(name: str, *, infer=None, is_random=False, nondiff_slots=(),
@@ -99,8 +98,18 @@ def infer_op(block, op) -> None:
     if opdef is None:
         return  # tolerate unregistered ops at build; execution will fail loudly
     if opdef.is_random and "__rng_seed__" not in op.attrs:
-        op.attrs["__rng_seed__"] = _rng_seed_counter[0]
-        _rng_seed_counter[0] += 1
+        # per-program counter: two identically-built programs draw identical
+        # init values under the same paddle.seed (a process-global counter
+        # would silently break determinism/loss-parity tests)
+        ctr = getattr(block.program, "_rng_op_counter", None)
+        if ctr is None:
+            # cloned/deserialized programs lack the attr: resume above the
+            # highest seed already present so new random ops never collide
+            ctr = 1 + max((o.attrs.get("__rng_seed__", 0)
+                           for b in block.program.blocks for o in b.ops),
+                          default=0)
+        op.attrs["__rng_seed__"] = ctr
+        block.program._rng_op_counter = ctr + 1
     if opdef.infer is not None:
         opdef.infer(block, op)
         return
@@ -184,10 +193,15 @@ def _lower_vjp(ctx, ins, attrs):
         ogs = ins.get(f"OG:{s}", [])
         n_outs = attrs["fwd_output_counts"][s]
         for j in range(n_outs):
+            ref = out_flat[idx + j]
             if j < len(ogs) and ogs[j] is not None:
-                cts.append(ogs[j])
+                ct = ogs[j]
+                # AMP may deliver cotangents in a different float dtype than
+                # this op's output (e.g. bf16 grads into an f32 op) — align
+                if ct.dtype != ref.dtype:
+                    ct = ct.astype(ref.dtype)
+                cts.append(ct)
             else:
-                ref = out_flat[idx + j]
                 cts.append(jax.numpy.zeros(ref.shape, ref.dtype))
         idx += n_outs
     grads = vjp_fn(list(cts))

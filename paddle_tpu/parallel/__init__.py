@@ -1,0 +1,11 @@
+"""parallel: mesh, shardings, SPMD config, program transforms, pipeline.
+
+The TPU-native replacement for the reference's entire distributed execution
+machinery (SURVEY §2.8/2.9): ParallelExecutor SSA graphs, collective op
+insertion, NCCL rings — all collapse into mesh axes + sharding annotations on
+the Executor's single jitted computation.
+"""
+from .mesh import (build_mesh, set_mesh, get_mesh, default_mesh,
+                   ShardingRules, init_parallel_env, named_sharding, P)
+from .spmd import DistConfig, attach
+from .transforms import apply_recompute, GradientMergeWrapper

@@ -1,0 +1,59 @@
+"""SPMD program execution config.
+
+Reference counterpart: the entire ParallelExecutor/SSA-graph machinery
+(parallel_executor.cc:461, details/*_op_handle.cc) and the program-rewrite
+collective transpiler (transpiler/collective.py:178 GradAllReduce, which
+inserts scale + c_allreduce_sum + sync ops per gradient). TPU-native: NONE of
+those ops exist. A DistConfig attached to a Program tells the Executor to jit
+the SAME lowered function with shardings — batch dims sharded over 'dp',
+params sharded per TP rules — and XLA GSPMD inserts all collectives (the
+gradient allreduce materializes automatically from the sharding math).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .mesh import ShardingRules, REPLICATED, default_mesh
+
+P = PartitionSpec
+
+
+@dataclass
+class DistConfig:
+    mesh: Optional[Mesh] = None
+    param_rules: ShardingRules = field(default_factory=ShardingRules)
+    batch_axes: Sequence[str] = ("dp",)
+    # vars never sharded on the batch axis (e.g. global stats)
+    replicated_feeds: Sequence[str] = ()
+
+    def resolve_mesh(self) -> Mesh:
+        return self.mesh if self.mesh is not None else default_mesh()
+
+    def feed_sharding(self, mesh, name, shape):
+        ndim = len(shape)
+        if name in self.replicated_feeds or ndim == 0:
+            return NamedSharding(mesh, P())
+        axes = tuple(a for a in self.batch_axes if mesh.shape.get(a, 1) > 1)
+        n = 1
+        for a in axes:
+            n *= mesh.shape[a]
+        if not axes or shape[0] % n != 0:
+            # batch not divisible by the data axes: replicate (slow but
+            # correct) rather than erroring — pad upstream for performance
+            return NamedSharding(mesh, P())
+        spec = [None] * ndim
+        spec[0] = axes if len(axes) > 1 else axes[0]
+        return NamedSharding(mesh, P(*spec))
+
+    def state_sharding(self, mesh, name, shape):
+        return self.param_rules.sharding_for(mesh, name, shape)
+
+
+def attach(program, dist_config: DistConfig):
+    """Attach a DistConfig to a Program; the Executor picks it up."""
+    program._dist_config = dist_config
+    program.bump_version()
+    return program

@@ -1,0 +1,214 @@
+"""Program-level strategy transforms: recompute, gradient merge.
+
+Reference counterparts: RecomputeOptimizer (optimizer.py:4547 +
+backward.py:689 _append_backward_ops_with_checkpoints_) and
+GradientMergeOptimizer (optimizer.py:5025). TPU-native: recompute collapses a
+forward segment into ONE __segment__ op whose lowering is wrapped in
+jax.checkpoint — the generic __vjp__ then stores only segment boundaries and
+re-runs the segment in backward (XLA schedules the rematerialization).
+Gradient merge gates the (arbitrary) optimizer update ops with a step-counter
+mask using where-selects — no control-flow blocks needed.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+
+from ..framework.program import OpRole, Program
+from ..ops import registry
+from ..ops.registry import register
+
+
+# ---------------------------------------------------------------------------
+# __segment__: a fused sub-graph op (the recompute unit)
+# ---------------------------------------------------------------------------
+
+@register("__segment__")
+def _lower_segment(ctx, ins, attrs):
+    sub_ops = attrs["sub_ops"]          # list of op descs
+    in_names = attrs["in_names"]
+    out_names = attrs["out_names"]
+
+    def run(in_vals):
+        env = dict(zip(in_names, in_vals))
+        for od in sub_ops:
+            opdef = registry.get(od["type"])
+            op_ins = {s: [env[n] for n in ns]
+                      for s, ns in od["inputs"].items()}
+            outs = opdef.lower(ctx, op_ins, od["attrs"])
+            for s, ns in od["outputs"].items():
+                if s not in outs:
+                    continue
+                for n, v in zip(ns, outs[s]):
+                    env[n] = v
+        return [env[n] for n in out_names]
+
+    if attrs.get("remat", True):
+        run = jax.checkpoint(run)
+    outs = run(ins["X"])
+    return {"Out": outs}
+
+
+def apply_recompute(program: Program, checkpoints: List[str]):
+    """Fuse forward ops into __segment__ ops split at checkpoint vars.
+
+    Backward (__vjp__ of __segment__) then keeps only segment-boundary
+    activations live; everything inside is recomputed.
+    """
+    block = program.global_block()
+    ck = set(checkpoints)
+    fwd_ops = [op for op in block.ops
+               if op.attrs.get("op_role", 0) == OpRole.Forward]
+    other_ops = [op for op in block.ops if op not in fwd_ops]
+    assert not other_ops, "apply_recompute must run before append_backward"
+
+    segments: List[List] = [[]]
+    for op in fwd_ops:
+        segments[-1].append(op)
+        if ck & set(op.output_names()):
+            segments.append([])
+    if not segments[-1]:
+        segments.pop()
+
+    new_ops = []
+    produced_so_far = set()
+    for seg in segments:
+        if len(seg) <= 1:
+            new_ops.extend(seg)
+            for op in seg:
+                produced_so_far.update(op.output_names())
+            continue
+        seg_produced = set()
+        seg_inputs, seg_outputs = [], []
+        for op in seg:
+            for n in op.input_names():
+                if n not in seg_produced and n not in seg_inputs \
+                        and n != "@EMPTY@":
+                    seg_inputs.append(n)
+            seg_produced.update(op.output_names())
+        # outputs: vars visible after the segment (consumed later, fetched,
+        # or checkpoints) — conservatively every produced var that any later
+        # op reads, plus checkpoints
+        later_reads = set()
+        seen = False
+        for s2 in segments:
+            if s2 is seg:
+                seen = True
+                continue
+            if seen:
+                for op in s2:
+                    later_reads.update(op.input_names())
+        # dangling outputs (consumed by nothing yet — e.g. the loss, metric
+        # outputs; backward/fetch will reference them after this transform)
+        all_reads = set()
+        for s2 in segments:
+            for op in s2:
+                all_reads.update(op.input_names())
+        for n in seg_produced:
+            if n in later_reads or n in ck or n not in all_reads:
+                seg_outputs.append(n)
+        sub_descs = [{"type": op.type, "inputs": op.inputs,
+                      "outputs": op.outputs, "attrs": dict(op.attrs)}
+                     for op in seg]
+        from ..framework.program import Operator
+        seg_op = Operator(block, "__segment__",
+                          {"X": seg_inputs}, {"Out": seg_outputs},
+                          {"sub_ops": sub_descs, "in_names": seg_inputs,
+                           "out_names": seg_outputs, "remat": True,
+                           "op_role": OpRole.Forward})
+        new_ops.append(seg_op)
+        produced_so_far.update(seg_produced)
+    block.ops = new_ops
+    program.bump_version()
+    return program
+
+
+# ---------------------------------------------------------------------------
+# Gradient merge (micro-batch accumulation)
+# ---------------------------------------------------------------------------
+
+class GradientMergeWrapper:
+    """Wraps any optimizer; accumulates grads k steps then applies the inner
+    update, gating ALL inner-op state writes with a step mask (reference
+    GradientMergeOptimizer semantics: moments only advance on merge steps)."""
+
+    def __init__(self, inner, k_steps: int):
+        self.inner = inner
+        self.k = k_steps
+        self._step_var = None
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.inner.backward(loss, startup_program,
+                                           parameter_list, no_grad_set)
+        self.apply_gradients_merged(loss.block.program, params_grads)
+        return [], params_grads
+
+    def apply_gradients_merged(self, program, params_grads):
+        from .. import layers
+        from ..framework import unique_name
+        block = program.global_block()
+        merge_start = len(block.ops)  # everything appended below is Optimize
+
+        step = layers.create_global_var([1], 0.0, "float32", persistable=True,
+                                        name=unique_name.generate("gm_step"))
+        step_new = layers.increment(step, value=1.0, in_place=False)
+        layers.assign(step_new, step)
+        k_var = layers.fill_constant([1], "float32", float(self.k))
+        rem = layers.elementwise_mod(step, k_var)
+        zero = layers.fill_constant([1], "float32", 0.0)
+        apply_mask = layers.equal(rem, zero)           # bool [1]
+
+        merged = []
+        for p, g in params_grads:
+            acc = layers.create_global_var(
+                list(p.shape), 0.0, "float32", persistable=True,
+                name=unique_name.generate(f"{p.name}_gm_acc"))
+            acc_new = layers.sums([acc, g])
+            avg = layers.scale(acc_new, scale=1.0 / self.k)
+            merged.append((p, avg))
+            # reset accumulator on merge steps
+            zeros = layers.zeros_like(acc)
+            kept = layers.where(apply_mask, zeros, acc_new)
+            layers.assign(kept, acc)
+
+        # run inner update, then re-route its state writes through selects
+        if self.inner._grad_clip is not None:
+            merged = self.inner._grad_clip(merged)
+        merged = self.inner._append_regularization(merged)
+        self.inner._create_accumulators(block, [p for p, _ in merged])
+        self.inner._create_lr_var()
+        for p, g in merged:
+            op = self.inner._append_optimize_op(block, (p, g))
+            op.attrs["op_role"] = OpRole.Optimize
+            self._gate_outputs(block, op, apply_mask)
+        # tag exactly the ops this transform appended (counter/mask/acc/select
+        # plumbing) — never forward ops of the same types elsewhere in the
+        # graph, which clone(for_test) would then wrongly prune
+        for op in block.ops[merge_start:]:
+            if op.attrs.get("op_role", 0) == 0:
+                op.attrs["op_role"] = OpRole.Optimize
+
+    def _gate_outputs(self, block, op, mask_var):
+        """Rewrite op outputs to temps, then out = where(mask, temp, old)."""
+        from ..framework import unique_name
+        pairs = []
+        for slot, names in op.outputs.items():
+            for i, n in enumerate(names):
+                tmp = block.create_var(
+                    name=unique_name.generate(f"{n}_gated"),
+                    shape=block.var(n).shape, dtype=block.var(n).dtype,
+                    stop_gradient=True)
+                pairs.append((n, tmp.name))
+                names[i] = tmp.name
+        for orig, tmp in pairs:
+            block.append_op("where",
+                            inputs={"Condition": [mask_var.name],
+                                    "X": [tmp], "Y": [orig]},
+                            outputs={"Out": [orig]},
+                            attrs={"op_role": OpRole.Optimize})
+        block.program.bump_version()
