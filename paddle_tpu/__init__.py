@@ -39,7 +39,7 @@ from . import io
 
 # ops must import so registrations run
 from .ops import (math_ops, nn_ops, tensor_ops, optimizer_ops,  # noqa: F401
-                  metric_ops, attention)  # noqa: F401
+                  metric_ops, attention, sequence_ops)  # noqa: F401
 
 __version__ = "0.1.0"
 

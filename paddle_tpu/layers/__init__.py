@@ -8,3 +8,5 @@ from .nn import *          # noqa: F401,F403
 from .tensor import *      # noqa: F401,F403
 from .loss import *        # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
+from .sequence_lod import *  # noqa: F401,F403
+from .rnn import *      # noqa: F401,F403

@@ -1,22 +1,69 @@
 """Control-flow layers.
 
-Reference counterparts: fluid/layers/control_flow.py (While, cond, StaticRNN —
-reference operators/controlflow/while_op.cc runs a sub-block via a nested
-Executor). TPU-native plan (SURVEY §7 hard parts): sub-blocks lower to
-lax.while_loop / lax.cond / lax.scan with explicit carried state. Round 1 ships
-`cond` with both branches as sub-programs lowered to lax.cond; While/StaticRNN
-land with the sequence stack in a later round.
+Reference counterparts: fluid/layers/control_flow.py (While :181, while_loop,
+StaticRNN :414, Switch, cond) and the sub-block-running operators
+operators/controlflow/while_op.cc, conditional_block_op.cc and
+operators/recurrent_op.cc (static RNN). The reference runs sub-blocks with a
+nested Executor over kid scopes; TPU-native, a sub-block lowers into
+`lax.while_loop` / `lax.cond` / `lax.scan` with the touched outer variables as
+explicit carried state, so the whole loop compiles into the enclosing XLA
+computation (no host round-trips per iteration).
+
+Semantic notes vs the reference (XLA constraints, documented divergences):
+- Carried variables must keep a fixed shape/dtype across iterations.
+- `While` is not reverse-differentiable (lax.while_loop has no VJP); use
+  StaticRNN / `lax.scan`-based loops on the training path, While for decode.
+- LoDTensorArray is a bounded ring buffer: `array_write` materializes a
+  `capacity`-slot buffer on first write (reference grows dynamically).
 """
 from __future__ import annotations
 
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dtype import convert_dtype, dtype_name
 from ..framework.program import OpRole
 from ..layer_helper import LayerHelper
 from ..ops.registry import register
-import jax
 
-__all__ = ["cond", "increment", "array_write", "array_read", "While",
-           "StaticRNN", "Switch"]
+__all__ = ["cond", "increment", "array_write", "array_read", "array_length",
+           "create_array", "While", "while_loop", "StaticRNN", "Switch"]
 
+
+# ---------------------------------------------------------------------------
+# shared sub-block read/write analysis
+# ---------------------------------------------------------------------------
+
+def _outer_reads_writes(block):
+    """Names read from / written to enclosing blocks by `block`'s ops.
+
+    A name resolving inside `block.vars` is block-local; anything else touches
+    the outer scope (reference while_op.cc computes the same sets at run time
+    via Scope lookups; here it is a build-time analysis).
+    """
+    reads, writes = [], []
+    rset, wset = set(), set()
+    for op in block.ops:
+        for n in op.input_names():
+            if n != "@EMPTY@" and n not in block.vars and n not in rset:
+                reads.append(n)
+                rset.add(n)
+        for n in op.output_names():
+            if n != "@EMPTY@" and n not in block.vars and n not in wset:
+                writes.append(n)
+                wset.add(n)
+    return reads, writes
+
+
+def _noop_infer(block, op):
+    return None
+
+
+# ---------------------------------------------------------------------------
+# cond (lax.cond)
+# ---------------------------------------------------------------------------
 
 def cond(pred, true_fn=None, false_fn=None, name=None):
     """paddle.static.nn.cond parity: capture both branches as sub-blocks and
@@ -36,24 +83,14 @@ def cond(pred, true_fn=None, false_fn=None, name=None):
     f_outs = false_out if isinstance(false_out, (list, tuple)) else [false_out]
     assert len(t_outs) == len(f_outs), "cond branches must match arity"
 
-    # free vars read by each branch = inputs defined outside the branch block
-    def _free_vars(block):
-        defined = set()
-        free = []
-        for op in block.ops:
-            for n in op.input_names():
-                if n not in defined and n not in free and n != "@EMPTY@":
-                    if n not in block.vars:
-                        free.append(n)
-            defined.update(op.output_names())
-        return free
-
-    t_free = _free_vars(true_block)
-    f_free = _free_vars(false_block)
+    t_free, _ = _outer_reads_writes(true_block)
+    f_free, _ = _outer_reads_writes(false_block)
     all_free = sorted(set(t_free) | set(f_free))
 
     outs = [helper.create_variable_for_type_inference(v.dtype)
             for v in t_outs]
+    for o, tv in zip(outs, t_outs):
+        o.shape = tuple(tv.shape)
     parent.append_op(
         "__cond__",
         inputs={"Cond": [pred], "Free": all_free},
@@ -65,15 +102,13 @@ def cond(pred, true_fn=None, false_fn=None, name=None):
     return outs[0] if len(outs) == 1 else outs
 
 
-@register("__cond__")
+@register("__cond__", infer=_noop_infer)
 def _lower_cond(ctx, ins, attrs):
     from ..framework.executor import _run_block  # late import, avoids cycle
     pred = ins["Cond"][0]
     free_names = attrs["free_names"]
     free_vals = ins["Free"]
 
-    # NOTE: block objects are looked up through a thread-local set by the
-    # executor when lowering programs with sub-blocks.
     from ..framework import executor as _ex
     program = _ex._current_lowering_program()
     tb = program.blocks[attrs["true_block"]]
@@ -87,7 +122,7 @@ def _lower_cond(ctx, ins, attrs):
             return fetches
         return branch
 
-    outs = jax.lax.cond(pred.reshape(()) if hasattr(pred, "reshape") else pred,
+    outs = jax.lax.cond(jnp.reshape(pred, ()),
                         make_branch(tb, attrs["true_outs"]),
                         make_branch(fb, attrs["false_outs"]),
                         free_vals)
@@ -102,30 +137,475 @@ def increment(x, value=1.0, in_place=True):
     return out
 
 
-def array_write(x, i, array=None):
-    raise NotImplementedError(
-        "LoDTensorArray ops land with the sequence stack (bounded-size "
-        "buffers over lax.dynamic_update_slice); use dygraph mode meanwhile")
+# ---------------------------------------------------------------------------
+# While / while_loop (lax.while_loop)
+# ---------------------------------------------------------------------------
+
+class While:
+    """fluid.layers.While parity (reference control_flow.py:181; runtime
+    operators/controlflow/while_op.cc). Usage:
+
+        i = layers.fill_constant([1], "int32", 0)
+        n = layers.fill_constant([1], "int32", 10)
+        flag = layers.less_than(i, n)
+        w = While(flag)
+        with w.block():
+            ... ops reading/writing outer vars ...
+            layers.increment(i)
+            layers.less_than(i, n, cond=flag)   # update the loop predicate
+
+    Every outer variable written in the block becomes loop-carried state of a
+    single lax.while_loop; reads of untouched outer vars close over their
+    pre-loop values.
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.cond_var = cond
+        self.helper = LayerHelper("while")
+
+    @contextlib.contextmanager
+    def block(self):
+        program = self.helper.main_program
+        parent = program.current_block()
+        sub = program.create_block()
+        try:
+            yield
+        finally:
+            program.rollback()
+        reads, writes = _outer_reads_writes(sub)
+        carried = list(writes)
+        if self.cond_var.name not in carried:
+            carried.insert(0, self.cond_var.name)
+        free = [n for n in reads if n not in set(carried)]
+        parent.append_op(
+            "__while__",
+            inputs={"Cond": [self.cond_var], "Carried": carried,
+                    "Free": free},
+            outputs={"Out": carried},
+            attrs={"sub_block": sub.idx, "carried_names": carried,
+                   "free_names": free, "cond_name": self.cond_var.name})
+
+
+@register("__while__", infer=_noop_infer)
+def _lower_while(ctx, ins, attrs):
+    from ..framework import executor as _ex
+    from ..framework.executor import _run_block
+    program = _ex._current_lowering_program()
+    sub = program.blocks[attrs["sub_block"]]
+    carried_names = attrs["carried_names"]
+    free_names = attrs["free_names"]
+    cond_idx = carried_names.index(attrs["cond_name"])
+    free_vals = ins["Free"]
+    for name, val in zip(carried_names, ins["Carried"]):
+        if isinstance(val, tuple) and len(val) == 2 and val[0] is None:
+            raise ValueError(
+                f"TensorArray {name!r} enters a While loop un-materialized: "
+                "its buffer shape is unknown, which a lax.while_loop carry "
+                "cannot represent. Either array_write once before the loop "
+                "or pass element_shape= to create_array.")
+    carry0 = tuple(ins["Carried"])
+
+    def cond_fn(carry):
+        return jnp.reshape(carry[cond_idx], ())
+
+    def body_fn(carry):
+        env = dict(zip(free_names, free_vals))
+        env.update(zip(carried_names, carry))
+        fetches, _ = _run_block(sub, [], carried_names, [], [], [],
+                                env, {}, {}, ctx.rng_key)
+        return tuple(fetches)
+
+    out = jax.lax.while_loop(cond_fn, body_fn, carry0)
+    return {"Out": list(out)}
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Functional while (reference fluid.layers.while_loop). `cond`/`body` are
+    Python callables over Variables; lowers to one lax.while_loop."""
+    from . import tensor as tensor_layers
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise TypeError("loop_vars must be a non-empty list")
+    loop_vars = list(loop_vars)
+    pred = cond(*loop_vars)
+    w = While(pred)
+    with w.block():
+        new_vars = body(*loop_vars)
+        if not isinstance(new_vars, (list, tuple)):
+            new_vars = [new_vars]
+        assert len(new_vars) == len(loop_vars), \
+            "body must return as many values as loop_vars"
+        for old, new in zip(loop_vars, new_vars):
+            if new is not old:
+                tensor_layers.assign(new, old)
+        new_pred = cond(*loop_vars)
+        tensor_layers.assign(new_pred, pred)
+    return loop_vars
+
+
+# ---------------------------------------------------------------------------
+# LoDTensorArray (bounded buffers over scatter/gather)
+# ---------------------------------------------------------------------------
+
+_DEFAULT_ARRAY_CAPACITY = 128
+
+
+def create_array(dtype, initialized_list=None, capacity=_DEFAULT_ARRAY_CAPACITY,
+                 element_shape=None):
+    """fluid.layers.create_array parity. Runtime value is a (buffer, length)
+    pair. With `element_shape` the `capacity`-slot buffer is materialized
+    eagerly (required when the FIRST write happens inside a While loop — a
+    lax.while_loop carry cannot change pytree structure mid-loop); otherwise
+    the buffer materializes on the first `array_write`."""
+    helper = LayerHelper("create_array")
+    arr = helper.main_program.current_block().create_var(
+        dtype=dtype, type="lod_tensor_array")
+    arr._array_capacity = int(capacity)
+    helper.append_op("create_array", outputs={"Out": [arr]},
+                     attrs={"dtype": dtype_name(arr.dtype),
+                            "capacity": int(capacity),
+                            "element_shape":
+                                (None if element_shape is None
+                                 else [int(s) for s in element_shape])})
+    if initialized_list:
+        from . import tensor as tensor_layers
+        for k, v in enumerate(initialized_list):
+            i = tensor_layers.fill_constant([1], "int32", k)
+            array_write(v, i, array=arr)
+    return arr
+
+
+@register("create_array", infer=_noop_infer)
+def _lower_create_array(ctx, ins, attrs):
+    shape = attrs.get("element_shape")
+    if shape is not None:
+        buf = jnp.zeros((int(attrs["capacity"]),) + tuple(shape),
+                        convert_dtype(attrs.get("dtype", "float32")))
+        return {"Out": [(buf, jnp.zeros((), jnp.int32))]}
+    return {"Out": [(None, jnp.zeros((), jnp.int32))]}
+
+
+def array_write(x, i, array=None, capacity=None):
+    helper = LayerHelper("array_write")
+    if array is None:
+        array = create_array(dtype_name(x.dtype),
+                             capacity=capacity or _DEFAULT_ARRAY_CAPACITY)
+    cap = capacity or getattr(array, "_array_capacity",
+                              _DEFAULT_ARRAY_CAPACITY)
+    helper.append_op("array_write",
+                     inputs={"X": [x], "I": [i], "Array": [array]},
+                     outputs={"Out": [array]},
+                     attrs={"capacity": int(cap)})
+    return array
+
+
+@register("array_write", infer=_noop_infer)
+def _lower_array_write(ctx, ins, attrs):
+    x = ins["X"][0]
+    i = jnp.reshape(ins["I"][0], ()).astype(jnp.int32)
+    arr = ins["Array"][0]
+    buffer, length = (None, jnp.zeros((), jnp.int32)) if arr is None else arr
+    if buffer is None:
+        buffer = jnp.zeros((int(attrs.get("capacity",
+                                          _DEFAULT_ARRAY_CAPACITY)),)
+                           + tuple(x.shape), x.dtype)
+    buffer = buffer.at[i].set(x.astype(buffer.dtype))
+    length = jnp.maximum(length, i + 1)
+    return {"Out": [(buffer, length)]}
 
 
 def array_read(array, i):
-    raise NotImplementedError(
-        "LoDTensorArray ops land with the sequence stack; use dygraph mode")
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(array.dtype)
+    helper.append_op("array_read", inputs={"Array": [array], "I": [i]},
+                     outputs={"Out": [out]})
+    return out
 
 
-class While:
-    def __init__(self, cond, is_test=False, name=None):
-        raise NotImplementedError(
-            "static While lands with the control-flow stack (lax.while_loop "
-            "lowering); use dygraph mode or lax-style layers meanwhile")
+@register("array_read", infer=_noop_infer)
+def _lower_array_read(ctx, ins, attrs):
+    buffer, _ = ins["Array"][0]
+    if buffer is None:
+        raise ValueError("array_read from an empty LoDTensorArray; write at "
+                         "least once before reading (buffers are bounded on "
+                         "TPU — see module docstring)")
+    i = jnp.reshape(ins["I"][0], ()).astype(jnp.int32)
+    return {"Out": [jax.lax.dynamic_index_in_dim(buffer, i, axis=0,
+                                                 keepdims=False)]}
 
+
+def array_length(array):
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference("int32")
+    out.shape = (1,)
+    helper.append_op("array_length", inputs={"Array": [array]},
+                     outputs={"Out": [out]})
+    return out
+
+
+@register("array_length", infer=_noop_infer)
+def _lower_array_length(ctx, ins, attrs):
+    arr = ins["Array"][0]
+    length = jnp.zeros((), jnp.int32) if arr is None else arr[1]
+    return {"Out": [jnp.reshape(length, (1,))]}
+
+
+# ---------------------------------------------------------------------------
+# StaticRNN (lax.scan)
+# ---------------------------------------------------------------------------
 
 class StaticRNN:
-    def __init__(self, name=None):
-        raise NotImplementedError(
-            "StaticRNN lands with the control-flow stack (lax.scan lowering)")
+    """Static sequence loop (reference control_flow.py:414 StaticRNN, runtime
+    operators/recurrent_op.cc). Inputs are time-major [seq_len, ...]; the step
+    block lowers to one lax.scan whose carry is the declared memories — the
+    natural TPU form (and reverse-differentiable, unlike While).
 
+        rnn = StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)            # x: [seq, batch, d]
+            h_prev = rnn.memory(init=h0)
+            h = layers.fc(...) over (x_t, h_prev)
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        out = rnn()                            # [seq, batch, d]
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn")
+        self._sub = None
+        self._parent = None
+        self._seq_len = None
+        self._seq_inputs = []     # (outer_name, inner var)
+        self._mems = []           # dict(pre=inner var, init=outer name, upd=None)
+        self._outputs = []        # inner vars
+        self._outer_outs = None
+
+    @contextlib.contextmanager
+    def step(self):
+        program = self.helper.main_program
+        self._parent = program.current_block()
+        self._sub = program.create_block()
+        try:
+            yield
+        finally:
+            program.rollback()
+            self._complete()
+
+    def step_input(self, x):
+        assert self._sub is not None, "step_input must be called inside step()"
+        if self._seq_len is None:
+            self._seq_len = x.shape[0]
+        inner = self._sub.create_var(shape=tuple(x.shape[1:]), dtype=x.dtype)
+        self._seq_inputs.append((x.name, inner))
+        return inner
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               init_batch_dim_idx=0, ref_batch_dim_idx=1, dtype="float32"):
+        assert self._sub is not None, "memory must be called inside step()"
+        if init is None:
+            assert shape is not None and batch_ref is not None, (
+                "memory needs either init= or (shape=, batch_ref=)")
+            ref_name = batch_ref.name
+            for outer_name, inner in self._seq_inputs:
+                if inner.name == ref_name:
+                    # batch_ref is an in-block step input: the parent-level
+                    # init op must reference the outer [seq, ...] var, whose
+                    # batch dim sits one axis later
+                    ref_name = outer_name
+                    ref_batch_dim_idx = ref_batch_dim_idx + 1
+                    break
+            init_var = self._parent.create_var(
+                shape=tuple(shape), dtype=dtype)
+            self._parent.append_op(
+                "fill_constant_batch_size_like",
+                inputs={"Input": [ref_name]},
+                outputs={"Out": [init_var.name]},
+                attrs={"shape": [int(s) for s in shape],
+                       "value": float(init_value),
+                       "dtype": dtype_name(init_var.dtype),
+                       "input_dim_idx": int(ref_batch_dim_idx),
+                       "output_dim_idx": int(init_batch_dim_idx)})
+            init = init_var
+        pre = self._sub.create_var(shape=tuple(init.shape), dtype=init.dtype)
+        self._mems.append({"pre": pre, "init": init.name, "upd": None})
+        return pre
+
+    def update_memory(self, mem, var):
+        for m in self._mems:
+            if m["pre"].name == mem.name:
+                m["upd"] = var.name
+                return
+        raise ValueError(f"{mem.name} is not a memory of this StaticRNN")
+
+    def step_output(self, o):
+        self._outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _complete(self):
+        assert self._outputs, "StaticRNN needs at least one step_output"
+        for m in self._mems:
+            assert m["upd"] is not None, (
+                f"memory {m['pre'].name} was never update_memory()'d")
+        reads, _ = _outer_reads_writes(self._sub)
+        special = {n for n, _ in self._seq_inputs}
+        special |= {m["init"] for m in self._mems}
+        free = [n for n in reads if n not in special]
+        outer_outs = []
+        for o in self._outputs:
+            ov = self._parent.create_var(
+                shape=(self._seq_len,) + tuple(o.shape), dtype=o.dtype)
+            outer_outs.append(ov)
+        self._parent.append_op(
+            "__scan__",
+            inputs={"X": [n for n, _ in self._seq_inputs],
+                    "Init": [m["init"] for m in self._mems],
+                    "Free": free},
+            outputs={"Out": [v.name for v in outer_outs]},
+            attrs={"sub_block": self._sub.idx,
+                   "x_names": [v.name for _, v in self._seq_inputs],
+                   "mem_pre_names": [m["pre"].name for m in self._mems],
+                   "mem_upd_names": [m["upd"] for m in self._mems],
+                   "out_names": [o.name for o in self._outputs],
+                   "free_names": free})
+        self._outer_outs = outer_outs
+
+    def __call__(self):
+        outs = self._outer_outs
+        return outs[0] if len(outs) == 1 else outs
+
+
+@register("__scan__", infer=_noop_infer)
+def _lower_scan(ctx, ins, attrs):
+    from ..framework import executor as _ex
+    from ..framework.executor import _run_block
+    program = _ex._current_lowering_program()
+    sub = program.blocks[attrs["sub_block"]]
+    x_names = attrs["x_names"]
+    mem_pre = attrs["mem_pre_names"]
+    mem_upd = attrs["mem_upd_names"]
+    out_names = attrs["out_names"]
+    free_names = attrs["free_names"]
+    free_vals = ins["Free"]
+    xs = tuple(ins["X"])
+    init = tuple(ins["Init"])
+
+    def body(carry, x_slices):
+        env = dict(zip(free_names, free_vals))
+        env.update(zip(mem_pre, carry))
+        env.update(zip(x_names, x_slices))
+        fetches, _ = _run_block(sub, [], list(mem_upd) + list(out_names),
+                                [], [], [], env, {}, {}, ctx.rng_key)
+        new_carry = tuple(fetches[:len(mem_upd)])
+        ys = tuple(fetches[len(mem_upd):])
+        return new_carry, ys
+
+    _, ys = jax.lax.scan(body, init, xs)
+    return {"Out": list(ys)}
+
+
+# ---------------------------------------------------------------------------
+# Switch (nested lax.cond over the written outer vars)
+# ---------------------------------------------------------------------------
 
 class Switch:
+    """fluid.layers.Switch parity (used by LR schedulers): first case whose
+    condition holds executes; its writes to outer vars take effect.
+
+        with Switch() as switch:
+            with switch.case(cond1): layers.assign(a, lr)
+            with switch.default():   layers.assign(b, lr)
+    """
+
     def __init__(self, name=None):
-        raise NotImplementedError("use layers.cond")
+        self.helper = LayerHelper("switch")
+        self._cases = []          # (cond_name or None, block)
+        self._has_default = False
+
+    def __enter__(self):
+        return self
+
+    @contextlib.contextmanager
+    def case(self, condition):
+        program = self.helper.main_program
+        sub = program.create_block()
+        try:
+            yield
+        finally:
+            program.rollback()
+        self._cases.append((condition.name, sub))
+
+    @contextlib.contextmanager
+    def default(self):
+        program = self.helper.main_program
+        sub = program.create_block()
+        try:
+            yield
+        finally:
+            program.rollback()
+        self._cases.append((None, sub))
+        self._has_default = True
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        program = self.helper.main_program
+        parent = program.current_block()
+        written, free = [], []
+        wset, fset = set(), set()
+        for _, blk in self._cases:
+            r, w = _outer_reads_writes(blk)
+            for n in w:
+                if n not in wset:
+                    written.append(n)
+                    wset.add(n)
+            for n in r:
+                if n not in fset:
+                    free.append(n)
+                    fset.add(n)
+        free = [n for n in free if n not in wset]
+        cond_names = [c for c, _ in self._cases if c is not None]
+        parent.append_op(
+            "__switch__",
+            inputs={"Conds": cond_names, "Carried": written, "Free": free},
+            outputs={"Out": written},
+            attrs={"case_blocks": [b.idx for _, b in self._cases],
+                   "case_conds": [c for c, _ in self._cases],
+                   "written_names": written, "free_names": free})
+        return False
+
+
+@register("__switch__", infer=_noop_infer)
+def _lower_switch(ctx, ins, attrs):
+    from ..framework import executor as _ex
+    from ..framework.executor import _run_block
+    program = _ex._current_lowering_program()
+    written = attrs["written_names"]
+    free_names = attrs["free_names"]
+    free_vals = ins["Free"]
+    cond_vals = dict(zip([c for c in attrs["case_conds"] if c is not None],
+                         ins["Conds"]))
+    cases = list(zip(attrs["case_conds"], attrs["case_blocks"]))
+
+    def run_case(block_idx, carried_vals):
+        sub = program.blocks[block_idx]
+        env = dict(zip(free_names, free_vals))
+        env.update(zip(written, carried_vals))
+        fetches, _ = _run_block(sub, [], written, [], [], [],
+                                env, {}, {}, ctx.rng_key)
+        return list(fetches)
+
+    def build(i, carried_vals):
+        if i == len(cases):
+            return list(carried_vals)
+        cname, bidx = cases[i]
+        if cname is None:  # default: unconditional (it is last by contract)
+            return run_case(bidx, carried_vals)
+        return jax.lax.cond(
+            jnp.reshape(cond_vals[cname], ()),
+            lambda c: run_case(bidx, c),
+            lambda c: build(i + 1, c),
+            list(carried_vals))
+
+    return {"Out": build(0, list(ins["Carried"]))}

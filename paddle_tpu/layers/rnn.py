@@ -1,0 +1,157 @@
+"""Recurrent layers: dynamic_lstm / dynamic_gru / simple rnn + dynamic_decode.
+
+Reference counterpart: python/paddle/fluid/layers/rnn.py (3.4k LoC:
+dynamic_decode, RNNCell zoo) and the dynamic_lstm/dynamic_gru functions of
+layers/nn.py backed by operators/lstm_op.cc, gru_op.cc. TPU-native: each full
+recurrence is ONE registered op lowering to a single lax.scan
+(paddle_tpu/ops/sequence_ops.py), so XLA compiles the whole sequence loop —
+no per-timestep dispatch as in the reference's LoD-batched CPU/CUDA kernels.
+
+Inputs are padded-dense [batch, max_len, feature] (+ optional `length=`);
+`dynamic_lstm`/`dynamic_gru` keep the reference convention that the input is
+already gate-projected (4H / 3H) by an upstream fc.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.dtype import dtype_name
+from ..framework.program import in_dygraph_mode
+from ..layer_helper import LayerHelper
+
+__all__ = ["dynamic_lstm", "dynamic_gru", "simple_rnn", "dynamic_decode",
+           "GreedyEmbeddingDecoder"]
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=False, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None,
+                 length=None):
+    """input: [b, T, 4H] gate-projected; returns (hidden [b,T,H], cell).
+    Gate layout {candidate, input, forget, output} matches reference
+    lstm_op.cc:141-152. Peepholes are not supported on the TPU path."""
+    assert not use_peepholes, "peephole LSTM not supported on TPU build"
+    helper = LayerHelper("lstm")
+    H = size // 4
+    w = helper.create_parameter(param_attr, [H, 4 * H], dtype=dtype)
+    bias = helper.create_parameter(bias_attr, [4 * H], dtype=dtype,
+                                   is_bias=True)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    last_h = helper.create_variable_for_type_inference(dtype)
+    last_c = helper.create_variable_for_type_inference(dtype)
+    ins = {"Input": [input], "Weight": [w], "Bias": [bias]}
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    if c_0 is not None:
+        ins["C0"] = [c_0]
+    if length is not None:
+        ins["SeqLen"] = [length]
+    helper.append_op("lstm", inputs=ins,
+                     outputs={"Hidden": [hidden], "Cell": [cell],
+                              "LastH": [last_h], "LastC": [last_c]},
+                     attrs={"is_reverse": bool(is_reverse),
+                            "gate_activation": gate_activation,
+                            "cell_activation": cell_activation,
+                            "candidate_activation": candidate_activation})
+    return hidden, cell
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, origin_mode=False,
+                dtype="float32", name=None, length=None):
+    """input: [b, T, 3H] gate-projected; returns hidden [b, T, H]. Update rule
+    h=(1-u)h+um for origin_mode=False (reference gru_kernel.h:67)."""
+    assert not is_reverse, "use sequence_reverse around dynamic_gru"
+    helper = LayerHelper("gru")
+    H = size
+    w = helper.create_parameter(param_attr, [H, 3 * H], dtype=dtype)
+    bias = helper.create_parameter(bias_attr, [3 * H], dtype=dtype,
+                                   is_bias=True)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    last_h = helper.create_variable_for_type_inference(dtype)
+    ins = {"Input": [input], "Weight": [w], "Bias": [bias]}
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    if length is not None:
+        ins["SeqLen"] = [length]
+    helper.append_op("gru", inputs=ins,
+                     outputs={"Hidden": [hidden], "LastH": [last_h]},
+                     attrs={"gate_activation": gate_activation,
+                            "activation": candidate_activation,
+                            "origin_mode": bool(origin_mode)})
+    return hidden
+
+
+def simple_rnn(input, size, param_attr=None, bias_attr=None,
+               activation="tanh", h_0=None, dtype="float32", length=None):
+    """input: [b, T, H] pre-projected; vanilla rnn h=act(x+hW)."""
+    helper = LayerHelper("simple_rnn")
+    w = helper.create_parameter(param_attr, [size, size], dtype=dtype)
+    bias = helper.create_parameter(bias_attr, [size], dtype=dtype,
+                                   is_bias=True)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    last_h = helper.create_variable_for_type_inference(dtype)
+    ins = {"Input": [input], "Weight": [w], "Bias": [bias]}
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    if length is not None:
+        ins["SeqLen"] = [length]
+    helper.append_op("simple_rnn", inputs=ins,
+                     outputs={"Hidden": [hidden], "LastH": [last_h]},
+                     attrs={"activation": activation})
+    return hidden, last_h
+
+
+# ---------------------------------------------------------------------------
+# decoding (reference layers/rnn.py dynamic_decode)
+# ---------------------------------------------------------------------------
+
+class GreedyEmbeddingDecoder:
+    """Argmax token decoder over a step callable.
+
+    step_fn(token_ids [b], state) -> (logits [b, V], next_state)
+    embedding of the next input is the step_fn's own concern; this mirrors the
+    reference's Decoder protocol (layers/rnn.py Decoder.step) reduced to the
+    greedy case. Beam search lands with a later round.
+    """
+
+    def __init__(self, step_fn, start_token, end_token):
+        self.step_fn = step_fn
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, batch_size=None,
+                   **kwargs):
+    """Greedy autoregressive decode (reference layers/rnn.py dynamic_decode).
+
+    Dygraph-mode implementation: a Python loop over decoder.step_fn, stopping
+    early when every row emitted end_token. Returns int64 [b, steps] tokens.
+    Static-graph decode should use While + TensorArray directly (see
+    layers/control_flow.py) — the decode loop then compiles to lax.while_loop.
+    """
+    if not in_dygraph_mode():
+        raise NotImplementedError(
+            "static-mode dynamic_decode: build the loop with layers.While + "
+            "array_write/array_read (compiles to one lax.while_loop)")
+    import jax.numpy as jnp
+    from ..dygraph.tracer import to_tensor
+
+    assert batch_size is not None, "dynamic_decode needs batch_size in dygraph"
+    tok = np.full((batch_size,), decoder.start_token, np.int32)
+    state = inits
+    outs = []
+    finished = np.zeros((batch_size,), bool)
+    for _ in range(max_step_num):
+        logits, state = decoder.step_fn(to_tensor(tok), state)
+        nxt = np.asarray(logits.numpy()).argmax(axis=-1).astype(np.int32)
+        nxt = np.where(finished, decoder.end_token, nxt)
+        outs.append(nxt)
+        finished |= nxt == decoder.end_token
+        tok = nxt
+        if finished.all():
+            break
+    return np.stack(outs, axis=1).astype(np.int64)

@@ -568,3 +568,7 @@ class BCEWithLogitsLoss(Layer):
 
     def forward(self, logit, label):
         return F.binary_cross_entropy_with_logits(logit, label, self.reduction)
+
+
+from .rnn import (SimpleRNN, LSTM, GRU,  # noqa: E402,F401
+                  SimpleRNNCell, LSTMCell, GRUCell)

@@ -1,0 +1,283 @@
+"""paddle.nn recurrent layers: SimpleRNN / LSTM / GRU + single-step cells.
+
+Reference counterpart: python/paddle/nn (2.0 API) RNN layers backed by the
+fluid lstm/gru ops (operators/lstm_op.cc, gru_op.cc). TPU-native: the
+per-layer recurrence is ONE traced `lstm`/`gru`/`simple_rnn` op that lowers
+to a single lax.scan (paddle_tpu/ops/sequence_ops.py), so a stacked
+bidirectional LSTM is a handful of scans XLA fuses — not T×layers×2 op
+dispatches.
+
+Input convention: batch-major [batch, time, feature] (time_major=False only).
+"""
+from __future__ import annotations
+
+import math
+
+from ..dygraph.tracer import Tensor, _apply, current_tracer
+from .. import initializer as I
+from .. import tensor as pt
+
+__all__ = ["SimpleRNN", "LSTM", "GRU", "SimpleRNNCell", "LSTMCell", "GRUCell"]
+
+
+def _rnn_op(op_type, x_proj, w_hh, seq_len=None, h0=None, c0=None, attrs=None,
+            bias_hh=None):
+    """Trace one full-sequence recurrence op; returns its output tensors."""
+    tracer = current_tracer()
+    ins = {"Input": [x_proj], "Weight": [w_hh]}
+    if seq_len is not None:
+        ins["SeqLen"] = [seq_len]
+    if h0 is not None:
+        ins["H0"] = [h0]
+    if c0 is not None:
+        ins["C0"] = [c0]
+    if bias_hh is not None:
+        ins["BiasHH"] = [bias_hh]
+    if op_type == "lstm":
+        hidden, cell, last_h, last_c = (Tensor(None) for _ in range(4))
+        tracer.trace_op("lstm", ins,
+                        {"Hidden": [hidden], "Cell": [cell],
+                         "LastH": [last_h], "LastC": [last_c]}, attrs or {})
+        return hidden, last_h, last_c
+    hidden, last_h = Tensor(None), Tensor(None)
+    tracer.trace_op(op_type, ins,
+                    {"Hidden": [hidden], "LastH": [last_h]}, attrs or {})
+    return hidden, last_h, None
+
+
+def _seq_reverse(x, seq_len=None):
+    ins = {"X": [x]}
+    if seq_len is not None:
+        ins["SeqLen"] = [seq_len]
+    return _apply("sequence_reverse", ins, {}, out_slot="Y")
+
+
+class _RNNBase:
+    """Shared stacked/bidirectional plumbing. Subclasses set mode + gate count."""
+
+    MODE = None
+    GATES = 1
+    mode_op = None
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", dropout=0.0, time_major=False,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        from . import Layer  # late import: nn/__init__ imports this module
+        assert not time_major, "TPU build is batch-major ([b, T, d]) only"
+        self._layer = Layer()  # parameter registry host
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.dropout = dropout
+        self.num_directions = 2 if direction in ("bidirect",
+                                                 "bidirectional") else 1
+        G = self.GATES
+        H = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weights = []
+        for layer in range(num_layers):
+            per_dir = []
+            in_sz = input_size if layer == 0 else H * self.num_directions
+            for d in range(self.num_directions):
+                mk = self._layer.create_parameter
+                unit = {
+                    "w_ih": mk([in_sz, G * H],
+                               default_initializer=I.Uniform(-std, std)),
+                    "w_hh": mk([H, G * H],
+                               default_initializer=I.Uniform(-std, std)),
+                    "b_ih": mk([G * H], is_bias=True,
+                               default_initializer=I.Uniform(-std, std)),
+                    "b_hh": mk([G * H], is_bias=True,
+                               default_initializer=I.Uniform(-std, std)),
+                }
+                for k, p in unit.items():
+                    setattr(self._layer, f"{k}_l{layer}_d{d}", p)
+                per_dir.append(unit)
+            self.weights.append(per_dir)
+
+    # Layer protocol passthroughs so _RNNBase nests inside nn.Layer trees
+    def parameters(self):
+        return self._layer.parameters()
+
+    def named_parameters(self, prefix=""):
+        return self._layer.named_parameters(prefix)
+
+    def state_dict(self):
+        return self._layer.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._layer.set_state_dict(sd)
+
+    def train(self):
+        self._layer.train()
+
+    def eval(self):
+        self._layer.eval()
+
+    def __call__(self, *a, **kw):
+        return self.forward(*a, **kw)
+
+    def _run_direction(self, x, unit, d, seq_len, h0, c0):
+        attrs = {}
+        rev = d == 1
+        if self.MODE == "gru":
+            # candidate b_hh must sit inside the reset gate (2.0 semantics):
+            # keep it out of the input projection, hand it to the op
+            proj = pt.matmul(x, unit["w_ih"]) + unit["b_ih"]
+            bias_hh = unit["b_hh"]
+        else:
+            # LSTM/SimpleRNN gates are purely additive in both biases
+            proj = pt.matmul(x, unit["w_ih"]) + unit["b_ih"] + unit["b_hh"]
+            bias_hh = None
+        if self.MODE == "lstm":
+            attrs["is_reverse"] = rev
+            return _rnn_op("lstm", proj, unit["w_hh"], seq_len, h0, c0, attrs)
+        if rev:
+            proj = _seq_reverse(proj, seq_len)
+        hidden, last_h, _ = _rnn_op(self.mode_op, proj, unit["w_hh"],
+                                    seq_len, h0, None, attrs,
+                                    bias_hh=bias_hh)
+        if rev:
+            hidden = _seq_reverse(hidden, seq_len)
+        return hidden, last_h, None
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = inputs
+        last_hs, last_cs = [], []
+        if initial_states is not None and self.MODE == "lstm":
+            init_h, init_c = initial_states
+        else:
+            init_h, init_c = initial_states, None
+        for layer, per_dir in enumerate(self.weights):
+            if layer > 0 and self.dropout > 0.0 and self._layer.training:
+                x = _apply("dropout", {"X": [x]},
+                           {"dropout_prob": float(self.dropout),
+                            "is_test": False,
+                            "dropout_implementation": "upscale_in_train"})
+            outs = []
+            for d, unit in enumerate(per_dir):
+                idx = layer * self.num_directions + d
+                h0 = init_h[idx] if init_h is not None else None
+                c0 = init_c[idx] if init_c is not None else None
+                hidden, last_h, last_c = self._run_direction(
+                    x, unit, d, sequence_length, h0, c0)
+                outs.append(hidden)
+                last_hs.append(last_h)
+                if last_c is not None:
+                    last_cs.append(last_c)
+            x = outs[0] if len(outs) == 1 else pt.concat(outs, axis=-1)
+        h_n = pt.stack(last_hs, axis=0)
+        if self.MODE == "lstm":
+            return x, (h_n, pt.stack(last_cs, axis=0))
+        return x, h_n
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "rnn"
+    GATES = 1
+    mode_op = "simple_rnn"
+
+
+class LSTM(_RNNBase):
+    MODE = "lstm"
+    GATES = 4
+    mode_op = "lstm"
+
+
+class GRU(_RNNBase):
+    MODE = "gru"
+    GATES = 3
+    mode_op = "gru"
+
+
+# ---------------------------------------------------------------------------
+# single-step cells (reference nn LSTMCell/GRUCell/SimpleRNNCell)
+# ---------------------------------------------------------------------------
+
+class _CellBase:
+    GATES = 1
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        from . import Layer
+        self._layer = Layer()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        G, H = self.GATES, hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        mk = self._layer.create_parameter
+        self.weight_ih = mk([input_size, G * H],
+                            default_initializer=I.Uniform(-std, std))
+        self.weight_hh = mk([H, G * H],
+                            default_initializer=I.Uniform(-std, std))
+        self.bias_ih = mk([G * H], is_bias=True,
+                          default_initializer=I.Uniform(-std, std))
+        self.bias_hh = mk([G * H], is_bias=True,
+                          default_initializer=I.Uniform(-std, std))
+        self._layer.weight_ih = self.weight_ih
+        self._layer.weight_hh = self.weight_hh
+        self._layer.bias_ih = self.bias_ih
+        self._layer.bias_hh = self.bias_hh
+
+    def parameters(self):
+        return self._layer.parameters()
+
+    def __call__(self, *a, **kw):
+        return self.forward(*a, **kw)
+
+    def _gates(self, x, h):
+        return (pt.matmul(x, self.weight_ih) + self.bias_ih
+                + pt.matmul(h, self.weight_hh) + self.bias_hh)
+
+
+class SimpleRNNCell(_CellBase):
+    GATES = 1
+
+    def forward(self, inputs, states=None):
+        h = states if states is not None else pt.zeros(
+            [inputs.shape[0], self.hidden_size], inputs.dtype)
+        import paddle_tpu.nn.functional as F
+        h_new = F.tanh(self._gates(inputs, h))
+        return h_new, h_new
+
+
+class LSTMCell(_CellBase):
+    GATES = 4
+
+    def forward(self, inputs, states=None):
+        import paddle_tpu.nn.functional as F
+        b = inputs.shape[0]
+        if states is None:
+            z = pt.zeros([b, self.hidden_size], inputs.dtype)
+            states = (z, z)
+        h, c = states
+        g = self._gates(inputs, h)
+        H = self.hidden_size
+        cand = F.tanh(g[:, :H])          # {c, i, f, o}: lstm_op.cc layout
+        i = F.sigmoid(g[:, H:2 * H])
+        f = F.sigmoid(g[:, 2 * H:3 * H])
+        o = F.sigmoid(g[:, 3 * H:])
+        c_new = cand * i + c * f
+        h_new = o * F.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(_CellBase):
+    GATES = 3
+
+    def forward(self, inputs, states=None):
+        import paddle_tpu.nn.functional as F
+        b = inputs.shape[0]
+        h = states if states is not None else pt.zeros(
+            [b, self.hidden_size], inputs.dtype)
+        H = self.hidden_size
+        gx = pt.matmul(inputs, self.weight_ih) + self.bias_ih
+        gh = pt.matmul(h, self.weight_hh) + self.bias_hh
+        g = F.sigmoid(gx[:, :2 * H] + gh[:, :2 * H])
+        u, r = g[:, :H], g[:, H:]
+        m = F.tanh(gx[:, 2 * H:] + r * gh[:, 2 * H:])
+        h_new = (1.0 - u) * h + u * m    # gru_kernel.h:67 (origin_mode=False)
+        return h_new, h_new

@@ -410,4 +410,5 @@ def _unique(ctx, ins, attrs):
 
 @register("increment")
 def _increment(ctx, ins, attrs):
-    return {"Out": [ins["X"][0] + attrs.get("step", 1.0)]}
+    x = ins["X"][0]
+    return {"Out": [x + jnp.asarray(attrs.get("step", 1.0)).astype(x.dtype)]}

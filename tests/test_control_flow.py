@@ -1,0 +1,219 @@
+"""Static control flow: While, while_loop, StaticRNN, TensorArray, Switch.
+
+Mirrors reference tests test_while_op.py, test_while_loop_op.py,
+test_static_rnn (recurrent_op), test_switch.py, test_array_read_write_op.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    from paddle_tpu.framework import program as pm, scope as sm, unique_name
+    pm._main_program = pm.Program()
+    pm._startup_program = pm.Program()
+    sm._reset_global_scope()
+    unique_name.switch()
+    paddle.seed(0)
+    yield
+
+
+def test_while_sum_of_squares():
+    i = layers.fill_constant([1], "int32", 0)
+    n = layers.fill_constant([1], "int32", 10)
+    acc = layers.fill_constant([1], "float32", 0.0)
+    flag = layers.less_than(i, n)
+    w = layers.While(flag)
+    with w.block():
+        fi = layers.cast(i, "float32")
+        layers.assign(acc + fi * fi, acc)
+        layers.increment(i)
+        layers.less_than(i, n, cond=flag)
+    exe = fluid.Executor()
+    out, iv = exe.run(feed={}, fetch_list=[acc, i])
+    assert out[0] == pytest.approx(sum(k * k for k in range(10)))
+    assert iv[0] == 10
+
+
+def test_while_loop_functional():
+    def cond(i, s):
+        return layers.less_than(i, layers.fill_constant([1], "int32", 5))
+
+    def body(i, s):
+        return [i + layers.fill_constant([1], "int32", 1), s * 2.0]
+
+    i = layers.fill_constant([1], "int32", 0)
+    s = layers.fill_constant([1], "float32", 1.0)
+    i, s = layers.while_loop(cond, body, [i, s])
+    exe = fluid.Executor()
+    sv, iv = exe.run(feed={}, fetch_list=[s, i])
+    assert sv[0] == pytest.approx(32.0)
+    assert iv[0] == 5
+
+
+def test_static_rnn_accumulate_matches_numpy():
+    seq, batch, d = 6, 4, 3
+    x_np = np.random.RandomState(0).randn(seq, batch, d).astype(np.float32)
+    x = fluid.layers.data(name="x", shape=[batch, d], dtype="float32",
+                          append_batch_size=False)
+    x.shape = (seq, batch, d)
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        h_prev = rnn.memory(shape=[-1, d], batch_ref=x_t, init_value=0.0,
+                            ref_batch_dim_idx=0, init_batch_dim_idx=0)
+        h = layers.elementwise_add(h_prev, x_t)
+        rnn.update_memory(h_prev, h)
+        rnn.step_output(h)
+    out = rnn()
+    exe = fluid.Executor()
+    res, = exe.run(feed={"x": x_np}, fetch_list=[out])
+    np.testing.assert_allclose(res, np.cumsum(x_np, axis=0), rtol=1e-5)
+
+
+def test_static_rnn_is_differentiable():
+    seq, batch, d = 5, 2, 4
+    x_np = np.random.RandomState(1).randn(seq, batch, d).astype(np.float32)
+    x = fluid.layers.data(name="x", shape=[batch, d], dtype="float32",
+                          append_batch_size=False)
+    x.shape = (seq, batch, d)
+    w = layers.create_parameter([d, d], "float32", name="rnn_w")
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        h_prev = rnn.memory(shape=[-1, d], batch_ref=x_t, init_value=0.0,
+                            ref_batch_dim_idx=0, init_batch_dim_idx=0)
+        h = layers.tanh(layers.elementwise_add(layers.matmul(x_t, w), h_prev))
+        rnn.update_memory(h_prev, h)
+        rnn.step_output(h)
+    loss = layers.reduce_mean(rnn())
+    opt = paddle.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    l0, = exe.run(feed={"x": x_np}, fetch_list=[loss])
+    for _ in range(5):
+        l1, = exe.run(feed={"x": x_np}, fetch_list=[loss])
+    assert np.isfinite(l1).all()
+    assert l1 != l0  # parameters moved
+
+
+def test_array_write_read_roundtrip():
+    x = layers.fill_constant([2, 3], "float32", 7.0)
+    i0 = layers.fill_constant([1], "int32", 0)
+    i1 = layers.fill_constant([1], "int32", 1)
+    arr = layers.array_write(x, i0)
+    layers.array_write(x * 2.0, i1, array=arr)
+    n = layers.array_length(arr)
+    a0 = layers.array_read(arr, i0)
+    a1 = layers.array_read(arr, i1)
+    exe = fluid.Executor()
+    nv, v0, v1 = exe.run(feed={}, fetch_list=[n, a0, a1])
+    assert nv[0] == 2
+    np.testing.assert_allclose(v0, np.full((2, 3), 7.0, np.float32))
+    np.testing.assert_allclose(v1, np.full((2, 3), 14.0, np.float32))
+
+
+def test_array_inside_while_collects_steps():
+    n_steps = 4
+    i = layers.fill_constant([1], "int32", 0)
+    n = layers.fill_constant([1], "int32", n_steps)
+    x = layers.fill_constant([3], "float32", 1.0)
+    arr = layers.array_write(x, i)  # materialize buffer before the loop
+    layers.increment(i)
+    flag = layers.less_than(i, n)
+    w = layers.While(flag)
+    with w.block():
+        fi = layers.cast(i, "float32")
+        layers.array_write(layers.expand(fi, [3]), i, array=arr)
+        layers.increment(i)
+        layers.less_than(i, n, cond=flag)
+    i2 = layers.fill_constant([1], "int32", 2)
+    got = layers.array_read(arr, i2)
+    length = layers.array_length(arr)
+    exe = fluid.Executor()
+    g, ln = exe.run(feed={}, fetch_list=[got, length])
+    np.testing.assert_allclose(g, np.full(3, 2.0, np.float32))
+    assert ln[0] == n_steps
+
+
+def test_switch_piecewise():
+    step = fluid.layers.data(name="step", shape=[1], dtype="float32",
+                             append_batch_size=False)
+    lr = layers.fill_constant([1], "float32", 0.0)
+    b1 = layers.fill_constant([1], "float32", 100.0)
+    b2 = layers.fill_constant([1], "float32", 200.0)
+    with layers.Switch() as switch:
+        with switch.case(layers.less_than(step, b1)):
+            layers.assign(layers.fill_constant([1], "float32", 0.1), lr)
+        with switch.case(layers.less_than(step, b2)):
+            layers.assign(layers.fill_constant([1], "float32", 0.01), lr)
+        with switch.default():
+            layers.assign(layers.fill_constant([1], "float32", 0.001), lr)
+    exe = fluid.Executor()
+    for sval, want in [(50.0, 0.1), (150.0, 0.01), (500.0, 0.001)]:
+        out, = exe.run(feed={"step": np.array([sval], np.float32)},
+                       fetch_list=[lr])
+        assert out[0] == pytest.approx(want)
+
+
+def test_cond_basic_still_works():
+    x = fluid.layers.data(name="x", shape=[1], dtype="float32",
+                          append_batch_size=False)
+    pred = layers.greater_than(x, layers.fill_constant([1], "float32", 0.0))
+    out = layers.cond(pred, lambda: x * 2.0, lambda: x - 1.0)
+    exe = fluid.Executor()
+    a, = exe.run(feed={"x": np.array([3.0], np.float32)}, fetch_list=[out])
+    b, = exe.run(feed={"x": np.array([-3.0], np.float32)}, fetch_list=[out])
+    assert a[0] == pytest.approx(6.0)
+    assert b[0] == pytest.approx(-4.0)
+
+
+def test_create_array_capacity_honored():
+    x = layers.fill_constant([2], "float32", 3.0)
+    arr = layers.create_array("float32", capacity=256)
+    i = layers.fill_constant([1], "int32", 200)
+    layers.array_write(x, i, array=arr)
+    got = layers.array_read(arr, i)
+    n = layers.array_length(arr)
+    exe = fluid.Executor()
+    g, nv = exe.run(feed={}, fetch_list=[got, n])
+    np.testing.assert_allclose(g, np.full(2, 3.0, np.float32))
+    assert nv[0] == 201
+
+
+def test_array_first_write_inside_while_with_element_shape():
+    arr = layers.create_array("float32", capacity=8, element_shape=[2])
+    i = layers.fill_constant([1], "int32", 0)
+    n = layers.fill_constant([1], "int32", 4)
+    flag = layers.less_than(i, n)
+    w = layers.While(flag)
+    with w.block():
+        fi = layers.cast(i, "float32")
+        layers.array_write(layers.expand(fi, [2]), i, array=arr)
+        layers.increment(i)
+        layers.less_than(i, n, cond=flag)
+    got = layers.array_read(arr, layers.fill_constant([1], "int32", 3))
+    exe = fluid.Executor()
+    g, = exe.run(feed={}, fetch_list=[got])
+    np.testing.assert_allclose(g, np.full(2, 3.0, np.float32))
+
+
+def test_unmaterialized_array_in_while_raises_clearly():
+    arr = layers.create_array("float32")
+    i = layers.fill_constant([1], "int32", 0)
+    n = layers.fill_constant([1], "int32", 4)
+    flag = layers.less_than(i, n)
+    w = layers.While(flag)
+    with w.block():
+        fi = layers.cast(i, "float32")
+        layers.array_write(layers.expand(fi, [2]), i, array=arr)
+        layers.increment(i)
+        layers.less_than(i, n, cond=flag)
+    exe = fluid.Executor()
+    with pytest.raises(Exception, match="element_shape|materialized"):
+        exe.run(feed={}, fetch_list=[layers.array_length(arr)])

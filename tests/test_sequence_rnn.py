@@ -1,0 +1,264 @@
+"""Sequence (padded+length) ops and recurrent layers vs numpy references.
+
+Mirrors reference tests: test_sequence_pool.py, test_sequence_softmax_op.py,
+test_sequence_reverse.py, test_lstm_op.py, test_gru_op.py, rnn layer tests.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    from paddle_tpu.framework import program as pm, scope as sm, unique_name
+    pm._main_program = pm.Program()
+    pm._startup_program = pm.Program()
+    sm._reset_global_scope()
+    unique_name.switch()
+    paddle.seed(0)
+    yield
+
+
+def _feed_xy(b=3, T=5, d=4, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(b, T, d).astype(np.float32)
+    lens = np.array([5, 3, 1][:b], np.int32)
+    return x, lens
+
+
+def test_sequence_pool_types_match_numpy():
+    x_np, lens = _feed_xy()
+    x = fluid.layers.data(name="x", shape=[5, 4], dtype="float32")
+    ln = fluid.layers.data(name="len", shape=[1], dtype="int32")
+    outs = {p: layers.sequence_pool(x, p, length=ln)
+            for p in ["sum", "average", "max", "last", "first", "sqrt"]}
+    exe = fluid.Executor()
+    names = list(outs)
+    vals = exe.run(feed={"x": x_np, "len": lens},
+                   fetch_list=[outs[n] for n in names])
+    got = dict(zip(names, vals))
+    for i, L in enumerate(lens):
+        valid = x_np[i, :L]
+        np.testing.assert_allclose(got["sum"][i], valid.sum(0), rtol=1e-5)
+        np.testing.assert_allclose(got["average"][i], valid.mean(0), rtol=1e-5)
+        np.testing.assert_allclose(got["max"][i], valid.max(0), rtol=1e-5)
+        np.testing.assert_allclose(got["last"][i], valid[-1], rtol=1e-5)
+        np.testing.assert_allclose(got["first"][i], valid[0], rtol=1e-5)
+        np.testing.assert_allclose(got["sqrt"][i],
+                                   valid.sum(0) / np.sqrt(L), rtol=1e-5)
+
+
+def test_sequence_softmax_masks_padding():
+    b, T = 2, 4
+    rng = np.random.RandomState(1)
+    x_np = rng.randn(b, T).astype(np.float32)
+    lens = np.array([4, 2], np.int32)
+    x = fluid.layers.data(name="x", shape=[T], dtype="float32")
+    ln = fluid.layers.data(name="len", shape=[1], dtype="int32")
+    out = layers.sequence_softmax(x, length=ln)
+    exe = fluid.Executor()
+    p, = exe.run(feed={"x": x_np, "len": lens}, fetch_list=[out])
+    for i, L in enumerate(lens):
+        e = np.exp(x_np[i, :L] - x_np[i, :L].max())
+        np.testing.assert_allclose(p[i, :L], e / e.sum(), rtol=1e-5)
+        assert (p[i, L:] == 0).all()
+
+
+def test_sequence_reverse_and_mask():
+    x_np, lens = _feed_xy()
+    x = fluid.layers.data(name="x", shape=[5, 4], dtype="float32")
+    ln = fluid.layers.data(name="len", shape=[1], dtype="int32")
+    rev = layers.sequence_reverse(x, length=ln)
+    mask = layers.sequence_mask(ln, maxlen=5, dtype="float32")
+    exe = fluid.Executor()
+    r, m = exe.run(feed={"x": x_np, "len": lens}, fetch_list=[rev, mask])
+    for i, L in enumerate(lens):
+        np.testing.assert_allclose(r[i, :L], x_np[i, :L][::-1], rtol=1e-6)
+        np.testing.assert_allclose(m[i], (np.arange(5) < L).astype(np.float32))
+
+
+def test_sequence_expand_as_and_unpad():
+    b, T, d = 2, 3, 2
+    x_np = np.arange(b * d, dtype=np.float32).reshape(b, d)
+    y_np = np.zeros((b, T, d), np.float32)
+    lens = np.array([3, 1], np.int32)
+    x = fluid.layers.data(name="x", shape=[d], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[T, d], dtype="float32")
+    ln = fluid.layers.data(name="len", shape=[1], dtype="int32")
+    out = layers.sequence_expand_as(x, y, length=ln)
+    exe = fluid.Executor()
+    o, = exe.run(feed={"x": x_np, "y": y_np, "len": lens}, fetch_list=[out])
+    np.testing.assert_allclose(o[0], np.tile(x_np[0], (T, 1)))
+    np.testing.assert_allclose(o[1, 0], x_np[1])
+    assert (o[1, 1:] == 0).all()
+
+
+def test_sequence_concat_splices_rows():
+    b, d = 2, 2
+    a_np = np.ones((b, 3, d), np.float32)
+    b_np = np.full((b, 2, d), 2.0, np.float32)
+    la = np.array([2, 3], np.int32)
+    lb = np.array([2, 1], np.int32)
+    a = fluid.layers.data(name="a", shape=[3, d], dtype="float32")
+    bb = fluid.layers.data(name="b", shape=[2, d], dtype="float32")
+    lav = fluid.layers.data(name="la", shape=[1], dtype="int32")
+    lbv = fluid.layers.data(name="lb", shape=[1], dtype="int32")
+    out = layers.sequence_concat([a, bb], lengths=[lav, lbv])
+    exe = fluid.Executor()
+    o, = exe.run(feed={"a": a_np, "b": b_np, "la": la, "lb": lb},
+                 fetch_list=[out])
+    # row 0: 2 ones then 2 twos then pad
+    np.testing.assert_allclose(o[0, :2], np.ones((2, d)))
+    np.testing.assert_allclose(o[0, 2:4], np.full((2, d), 2.0))
+    assert (o[0, 4:] == 0).all()
+    # row 1: 3 ones then 1 two
+    np.testing.assert_allclose(o[1, :3], np.ones((3, d)))
+    np.testing.assert_allclose(o[1, 3], np.full(d, 2.0))
+
+
+def test_dynamic_lstm_matches_manual_scan():
+    b, T, H = 2, 4, 3
+    rng = np.random.RandomState(2)
+    x_np = rng.randn(b, T, 4 * H).astype(np.float32)
+    lens = np.array([4, 2], np.int32)
+    x = fluid.layers.data(name="x", shape=[T, 4 * H], dtype="float32")
+    ln = fluid.layers.data(name="len", shape=[1], dtype="int32")
+    hidden, cell = layers.dynamic_lstm(x, size=4 * H, length=ln)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    from paddle_tpu.framework.scope import global_scope
+    prog = fluid.default_main_program()
+    lstm_op = [op for op in prog.global_block().ops if op.type == "lstm"][0]
+    w = np.asarray(global_scope().find(lstm_op.input("Weight")[0]))
+    bias = np.asarray(global_scope().find(lstm_op.input("Bias")[0]))
+    hv, cv = exe.run(feed={"x": x_np, "len": lens},
+                     fetch_list=[hidden, cell])
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    for i in range(b):
+        h = np.zeros(H, np.float32)
+        c = np.zeros(H, np.float32)
+        for t in range(lens[i]):
+            g = x_np[i, t] + h @ w + bias
+            cand, ig, fg, og = (np.tanh(g[:H]), sig(g[H:2*H]),
+                                sig(g[2*H:3*H]), sig(g[3*H:]))
+            c = cand * ig + c * fg
+            h = sig(g[3*H:]) * np.tanh(c)
+            np.testing.assert_allclose(hv[i, t], h, rtol=1e-2, atol=1e-3)
+            np.testing.assert_allclose(cv[i, t], c, rtol=1e-2, atol=1e-3)
+        assert (hv[i, lens[i]:] == 0).all()
+
+
+def test_dynamic_gru_update_rule():
+    b, T, H = 2, 3, 2
+    rng = np.random.RandomState(3)
+    x_np = rng.randn(b, T, 3 * H).astype(np.float32)
+    x = fluid.layers.data(name="x", shape=[T, 3 * H], dtype="float32")
+    hidden = layers.dynamic_gru(x, size=H)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    from paddle_tpu.framework.scope import global_scope
+    prog = fluid.default_main_program()
+    gru_op = [op for op in prog.global_block().ops if op.type == "gru"][0]
+    w = np.asarray(global_scope().find(gru_op.input("Weight")[0]))
+    bias = np.asarray(global_scope().find(gru_op.input("Bias")[0]))
+    hv, = exe.run(feed={"x": x_np}, fetch_list=[hidden])
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    for i in range(b):
+        h = np.zeros(H, np.float32)
+        for t in range(T):
+            gx = x_np[i, t, :2*H] + bias[:2*H]
+            cx = x_np[i, t, 2*H:] + bias[2*H:]
+            g = sig(gx + h @ w[:, :2*H])
+            u, r = g[:H], g[H:]
+            m = np.tanh(cx + (r * h) @ w[:, 2*H:])
+            h = (1.0 - u) * h + u * m
+            np.testing.assert_allclose(hv[i, t], h, rtol=1e-2, atol=1e-3)
+
+
+def test_nn_lstm_dygraph_shapes_and_grad():
+    paddle.disable_static()
+    try:
+        import paddle_tpu.nn as nn
+        rnn = nn.LSTM(input_size=5, hidden_size=6, num_layers=2,
+                      direction="bidirect")
+        x = paddle.to_tensor(
+            np.random.RandomState(4).randn(3, 7, 5).astype(np.float32))
+        out, (h, c) = rnn(x)
+        assert tuple(out.shape) == (3, 7, 12)
+        assert tuple(h.shape) == (4, 3, 6)
+        assert tuple(c.shape) == (4, 3, 6)
+        loss = paddle.tensor.mean(out)
+        loss.backward()
+        g = rnn.weights[0][0]["w_ih"].grad
+        assert g is not None and np.isfinite(np.asarray(g)).all()
+    finally:
+        paddle.enable_static()
+
+
+def test_nn_gru_cell_step_consistency():
+    paddle.disable_static()
+    try:
+        import paddle_tpu.nn as nn
+        cell = nn.GRUCell(input_size=4, hidden_size=3)
+        x = paddle.to_tensor(
+            np.random.RandomState(5).randn(2, 4).astype(np.float32))
+        h, new_state = cell(x)
+        assert tuple(h.shape) == (2, 3)
+        np.testing.assert_allclose(h.numpy(), new_state.numpy())
+    finally:
+        paddle.enable_static()
+
+
+def test_sequence_conv_full_length_matches_numpy():
+    b, T, d, nf, cl = 2, 5, 3, 4, 3
+    rng = np.random.RandomState(6)
+    x_np = rng.randn(b, T, d).astype(np.float32)
+    x = fluid.layers.data(name="x", shape=[T, d], dtype="float32")
+    out = layers.sequence_conv(x, num_filters=nf, filter_size=cl,
+                               bias_attr=False)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    from paddle_tpu.framework.scope import global_scope
+    prog = fluid.default_main_program()
+    conv_op = [op for op in prog.global_block().ops
+               if op.type == "sequence_conv"][0]
+    filt = np.asarray(global_scope().find(conv_op.input("Filter")[0]))
+    o, = exe.run(feed={"x": x_np}, fetch_list=[out])
+    pad = np.zeros((b, 1, d), np.float32)
+    xp = np.concatenate([pad, x_np, pad], axis=1)     # context_start=-1
+    for t in range(T):
+        win = xp[:, t:t + cl].reshape(b, cl * d)
+        np.testing.assert_allclose(o[:, t], win @ filt, rtol=5e-2, atol=5e-3)
+
+
+def test_nn_gru_matches_stepped_gru_cell():
+    """Regression: candidate b_hh must sit inside the reset gate in both."""
+    paddle.disable_static()
+    try:
+        import paddle_tpu.nn as nn
+        rnn = nn.GRU(input_size=4, hidden_size=5)
+        cell = nn.GRUCell(input_size=4, hidden_size=5)
+        unit = rnn.weights[0][0]
+        cell.weight_ih = unit["w_ih"]
+        cell.weight_hh = unit["w_hh"]
+        cell.bias_ih = unit["b_ih"]
+        cell.bias_hh = unit["b_hh"]
+        x = paddle.to_tensor(
+            np.random.RandomState(7).randn(2, 6, 4).astype(np.float32))
+        out, _ = rnn(x)
+        h = None
+        for t in range(6):
+            ht, h = cell(x[:, t], h)
+            np.testing.assert_allclose(out.numpy()[:, t], ht.numpy(),
+                                       rtol=1e-4, atol=1e-5)
+    finally:
+        paddle.enable_static()
