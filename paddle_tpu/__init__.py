@@ -115,3 +115,13 @@ def get_default_dtype():
 
 def set_default_dtype(d):
     pass
+
+
+# --- high-level API + metrics + data (reference hapi/, metric/, io) --------
+from . import metric  # noqa: E402
+from .hapi import Model, Input  # noqa: E402
+from . import hapi  # noqa: E402
+from . import io  # noqa: E402,F401  (paddle.io.DataLoader etc.)
+from . import dataset as _fluid_dataset  # noqa: E402,F401
+from . import jit  # noqa: E402
+from . import inference  # noqa: E402

@@ -1,0 +1,24 @@
+"""paddle.io data loading: Dataset / samplers / DataLoader / DataFeeder.
+
+Reference counterpart: python/paddle/fluid/reader.py (DataLoader,
+from_generator) + fluid/dataloader/ (multiprocess workers feeding a C++
+blocking queue via shared memory, dataloader_iter.py) + data_feeder.py.
+
+TPU-native differences:
+- device transfer is `jax.device_put` onto the chip, overlapped by a
+  double-buffer prefetch thread (the reference's BufferedReader
+  operators/reader/buffered_reader.h does the same with CUDA streams);
+- multiprocess workers ship numpy batches over pipes (fork start method);
+  the reference uses mmap shared memory — same topology, simpler transport.
+"""
+from .dataset import Dataset, IterableDataset, TensorDataset, Subset, random_split
+from .sampler import (Sampler, SequenceSampler, RandomSampler, BatchSampler,
+                      DistributedBatchSampler)
+from .dataloader import DataLoader
+from .feeder import DataFeeder
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "Subset", "random_split",
+    "Sampler", "SequenceSampler", "RandomSampler", "BatchSampler",
+    "DistributedBatchSampler", "DataLoader", "DataFeeder",
+]

@@ -1,0 +1,271 @@
+"""DataLoader: batching, multiprocess workers, device prefetch.
+
+Reference counterpart: fluid/reader.py DataLoader.from_generator /
+from_dataset and fluid/dataloader/dataloader_iter.py (worker subprocesses →
+shared-memory queue → C++ LoDTensorBlockingQueue → BufferedReader double
+buffering onto the device). Here: worker subprocesses → pipe queue →
+prefetch thread that jax.device_put's the next batch while the current one
+runs (XLA async dispatch gives the overlap).
+"""
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import queue as queue_mod
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    """list of samples -> stacked arrays (tuple-of-fields or single field)."""
+    first = batch[0]
+    if isinstance(first, (tuple, list)):
+        return tuple(np.stack([np.asarray(s[i]) for s in batch])
+                     for i in range(len(first)))
+    if isinstance(first, dict):
+        return {k: np.stack([np.asarray(s[k]) for s in batch]) for k in first}
+    return np.stack([np.asarray(s) for s in batch])
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id):
+    while True:
+        item = index_queue.get()
+        if item is None:
+            break
+        seq, indices = item
+        try:
+            batch = collate_fn([dataset[i] for i in indices])
+            data_queue.put((seq, batch, None))
+        except Exception as e:  # surface worker errors to the main process
+            data_queue.put((seq, None, f"worker {worker_id}: {e!r}"))
+
+
+class _MultiprocessIter:
+    """Ordered multiprocess fetch: batches dispatched round-robin, results
+    re-sequenced by batch index (reference _DataLoaderIterMultiProcess).
+
+    Workers come from a forkserver context — the server process is forked
+    before it touches JAX, so workers never inherit JAX's internal threads
+    and locks (forking the JAX-multithreaded parent directly can deadlock).
+    Datasets must therefore be picklable, as in the reference's multiprocess
+    mode. The bounded data queue gives backpressure: workers stall once
+    2*num_workers batches are waiting, so memory stays at a small window
+    rather than an epoch (reference: C++ blocking queue, capacity knob).
+    """
+
+    _GET_TIMEOUT = 300.0
+
+    def __init__(self, dataset, batches: List[List[int]], collate_fn,
+                 num_workers: int):
+        ctx = mp.get_context("forkserver")
+        self._data_queue = ctx.Queue(maxsize=2 * num_workers)
+        self._index_queues = []
+        self._workers = []
+        for w in range(num_workers):
+            iq = ctx.Queue()
+            p = ctx.Process(target=_worker_loop,
+                            args=(dataset, iq, self._data_queue, collate_fn, w),
+                            daemon=True)
+            p.start()
+            self._index_queues.append(iq)
+            self._workers.append(p)
+        for seq, idxs in enumerate(batches):
+            self._index_queues[seq % num_workers].put((seq, idxs))
+        for iq in self._index_queues:
+            iq.put(None)
+        self._total = len(batches)
+        self._next_seq = 0
+        self._reorder = {}
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._next_seq >= self._total:
+            self._join()
+            raise StopIteration
+        while self._next_seq not in self._reorder:
+            try:
+                seq, batch, err = self._data_queue.get(
+                    timeout=self._GET_TIMEOUT)
+            except queue_mod.Empty:
+                dead = [w for p, w in zip(self._workers,
+                                          range(len(self._workers)))
+                        if not p.is_alive()]
+                self._join()
+                raise RuntimeError(
+                    f"DataLoader timed out after {self._GET_TIMEOUT}s waiting "
+                    f"for batch {self._next_seq}"
+                    + (f"; dead workers: {dead}" if dead else ""))
+            if err is not None:
+                self._join()
+                raise RuntimeError(f"DataLoader worker failed: {err}")
+            self._reorder[seq] = batch
+        batch = self._reorder.pop(self._next_seq)
+        self._next_seq += 1
+        return batch
+
+    def _join(self):
+        for p in self._workers:
+            p.join(timeout=1)
+            if p.is_alive():
+                p.terminate()
+        self._workers = []
+
+
+class _Prefetcher:
+    """Double buffering: a thread stays `capacity` batches ahead, moving
+    arrays onto the device (reference BufferedReader, buffered_reader.h:33)."""
+
+    _END = object()
+
+    def __init__(self, it, capacity=2, device_put=True):
+        self._q = queue_mod.Queue(maxsize=capacity)
+        self._device_put = device_put
+        self._thread = threading.Thread(target=self._fill, args=(it,),
+                                        daemon=True)
+        self._err = None
+        self._thread.start()
+
+    def _fill(self, it):
+        try:
+            for item in it:
+                if self._device_put:
+                    import jax
+                    item = jax.tree_util.tree_map(jax.device_put, item)
+                self._q.put(item)
+        except Exception as e:
+            self._err = e
+        finally:
+            self._q.put(self._END)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._END:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+
+class DataLoader:
+    """2.0-style over a Dataset, or fluid-style via from_generator."""
+
+    def __init__(self, dataset: Optional[Dataset] = None, feed_list=None,
+                 places=None, return_list=True, batch_sampler=None,
+                 batch_size=1, shuffle=False, drop_last=False,
+                 collate_fn=None, num_workers=0, use_buffer_reader=True,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None):
+        self.dataset = dataset
+        self.feed_list = feed_list
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = int(num_workers)
+        self.use_buffer_reader = use_buffer_reader
+        self._iterable_src = None       # from_generator path
+        if dataset is not None and not isinstance(dataset, IterableDataset):
+            self.batch_sampler = batch_sampler or BatchSampler(
+                dataset=dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last)
+        else:
+            self.batch_sampler = None
+            self._batch_size = batch_size
+            self._drop_last = drop_last
+
+    # ---- fluid-style constructors -----------------------------------------
+    @staticmethod
+    def from_generator(feed_list=None, capacity=16, use_double_buffer=True,
+                       iterable=True, return_list=False,
+                       use_multiprocess=False, drop_last=True):
+        assert iterable, (
+            "non-iterable DataLoader (program-inserted py_reader ops) is not "
+            "part of the TPU build; iterate the loader and pass feeds")
+        dl = DataLoader.__new__(DataLoader)
+        dl.dataset = None
+        dl.batch_sampler = None
+        dl.feed_list = feed_list
+        dl.return_list = return_list
+        dl.collate_fn = default_collate_fn
+        dl.num_workers = 0
+        dl.use_buffer_reader = use_double_buffer
+        dl._capacity = capacity
+        dl._iterable_src = None
+        return dl
+
+    def set_sample_generator(self, reader, batch_size, drop_last=True,
+                             places=None):
+        def gen():
+            batch = []
+            for sample in reader():
+                batch.append(sample if isinstance(sample, (tuple, list))
+                             else (sample,))
+                if len(batch) == batch_size:
+                    yield self.collate_fn(batch)
+                    batch = []
+            if batch and not drop_last:
+                yield self.collate_fn(batch)
+        self._iterable_src = gen
+        return self
+
+    def set_sample_list_generator(self, reader, places=None):
+        def gen():
+            for sample_list in reader():
+                yield self.collate_fn(sample_list)
+        self._iterable_src = gen
+        return self
+
+    def set_batch_generator(self, reader, places=None):
+        self._iterable_src = reader
+        return self
+
+    # ---- iteration ---------------------------------------------------------
+    def _feedify(self, it):
+        """Pair batch fields with feed_list variable names -> feed dicts."""
+        names = [getattr(v, "name", str(v)) for v in self.feed_list]
+        for batch in it:
+            fields = batch if isinstance(batch, (tuple, list)) else (batch,)
+            yield dict(zip(names, fields))
+
+    def __iter__(self):
+        if self._iterable_src is not None:
+            it = self._iterable_src()
+        elif self.batch_sampler is not None:
+            batches = list(self.batch_sampler)
+            if self.num_workers > 0:
+                it = _MultiprocessIter(self.dataset, batches,
+                                       self.collate_fn, self.num_workers)
+            else:
+                ds, cf = self.dataset, self.collate_fn
+                it = (cf([ds[i] for i in idxs]) for idxs in batches)
+        else:  # IterableDataset
+            ds = self.dataset
+            bs, drop = self._batch_size, self._drop_last
+
+            def gen():
+                batch = []
+                for s in ds:
+                    batch.append(s if isinstance(s, (tuple, list)) else (s,))
+                    if len(batch) == bs:
+                        yield self.collate_fn(batch)
+                        batch = []
+                if batch and not drop:
+                    yield self.collate_fn(batch)
+            it = gen()
+        if self.feed_list is not None and not self.return_list:
+            it = self._feedify(it)
+        if self.use_buffer_reader:
+            it = _Prefetcher(it, capacity=getattr(self, "_capacity", 2))
+        return iter(it)
+
+    def __len__(self):
+        if self.batch_sampler is not None:
+            return len(self.batch_sampler)
+        raise TypeError("this DataLoader has no static length")

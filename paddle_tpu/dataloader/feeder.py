@@ -1,0 +1,27 @@
+"""DataFeeder (reference fluid/data_feeder.py): rows of python data ->
+feed dict of batched numpy arrays matching feed var dtypes/shapes."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_vars = feed_list
+        self.place = place
+
+    def feed(self, iterable):
+        rows = list(iterable)
+        out = {}
+        for i, var in enumerate(self.feed_vars):
+            name = getattr(var, "name", str(var))
+            col = [np.asarray(row[i]) for row in rows]
+            arr = np.stack(col)
+            dtype = getattr(var, "dtype", None)
+            if dtype is not None:
+                arr = arr.astype(dtype)
+            shape = getattr(var, "shape", None)
+            if shape and len(shape) == arr.ndim + 1 and shape[-1] == 1:
+                arr = arr[..., None]   # fluid label convention [b, 1]
+            out[name] = arr
+        return out

@@ -1,0 +1,157 @@
+"""fluid.dataset API: file-list datasets driving the native data plane.
+
+Reference counterpart: python/paddle/fluid/dataset.py (DatasetFactory,
+InMemoryDataset, QueueDataset) over the C++ Dataset/DataFeed stack
+(framework/data_set.h:157). The TPU build's C++ plane is
+native/dataplane.cc — multithreaded MultiSlot parsing and batch packing —
+and `Executor.train_from_dataset` drains it into the jitted train step.
+
+global_shuffle: the reference shuffles sample-wise ACROSS nodes via fleet
+RPC (data_set.h:109). Here each worker shuffles its own file shard with a
+rank-mixed seed after `set_filelist` splits files round-robin by worker —
+file-level sharding + local shuffle, the standard TPU input pipeline shape.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .native.dataplane import NativeDataPlane, SlotSpec
+
+
+class DatasetFactory:
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError(f"unknown dataset class {datafeed_class!r}")
+
+
+class DatasetBase:
+    def __init__(self):
+        self._batch_size = 1
+        self._thread = 1
+        self._filelist: List[str] = []
+        self._slots: List[SlotSpec] = []
+        self._use_vars = []
+        self._plane: Optional[NativeDataPlane] = None
+        self._shuffle_seed = 0
+
+    # -- configuration (reference dataset.py setters) -----------------------
+    def set_batch_size(self, batch_size):
+        self._batch_size = int(batch_size)
+        self._plane = None
+
+    def set_thread(self, thread_num):
+        self._thread = int(thread_num)
+        self._plane = None
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+        if self._plane is not None:
+            self._plane.set_files(self._local_files())
+
+    def set_use_var(self, var_list):
+        """Slot order/type/dim from the feed variables (reference wires
+        use_vars into the data_feed.proto)."""
+        from .framework.dtype import dtype_name
+        self._use_vars = list(var_list)
+        self._slots = []
+        for v in var_list:
+            dim = 1
+            for d in v.shape[1:] if len(v.shape) > 1 else v.shape:
+                if d and d > 0:
+                    dim *= int(d)
+            dt = dtype_name(v.dtype)
+            self._slots.append(SlotSpec(
+                v.name, "int64" if dt.startswith("int") else "float", dim))
+        self._plane = None
+
+    def set_pipe_command(self, cmd):
+        self._pipe_command = cmd   # accepted for API parity; files are
+        # parsed natively, not piped through a subprocess
+
+    def set_hdfs_config(self, fs_name, fs_ugi):
+        self._hdfs = (fs_name, fs_ugi)
+
+    def desc(self):
+        return {
+            "batch_size": self._batch_size, "thread_num": self._thread,
+            "slots": [(s.name, s.dtype, s.dim) for s in self._slots],
+            "filelist": self._filelist,
+        }
+
+    # -- plumbing ------------------------------------------------------------
+    def _local_files(self):
+        """Round-robin file shard for this worker (reference: fleet splits
+        the filelist across nodes before global shuffle)."""
+        try:
+            from .parallel.mesh import get_rank, get_world_size
+            rank, world = get_rank(), get_world_size()
+        except Exception:
+            rank, world = 0, 1
+        if world <= 1:
+            return self._filelist
+        return self._filelist[rank::world]
+
+    def _ensure_plane(self):
+        if self._plane is None:
+            assert self._slots, "call set_use_var before loading data"
+            self._plane = NativeDataPlane(self._slots, self._batch_size,
+                                          n_threads=self._thread)
+            self._plane.set_files(self._local_files())
+        return self._plane
+
+    def __iter__(self):
+        """Yields feed dicts {var_name: array[batch, dim]} reshaped to the
+        vars' trailing shapes."""
+        import numpy as np
+        plane = self._ensure_plane()
+        shapes = {}
+        for v in self._use_vars:
+            tail = [int(d) for d in v.shape[1:]] if len(v.shape) > 1 else []
+            shapes[v.name] = tail
+        for batch in plane:
+            out = {}
+            for name, arr in batch.items():
+                tail = shapes.get(name)
+                if tail and all(d > 0 for d in tail):
+                    arr = arr.reshape((arr.shape[0],) + tuple(tail))
+                out[name] = arr
+            yield out
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset (files parsed on the fly each epoch)."""
+
+
+class InMemoryDataset(DatasetBase):
+    """load once, shuffle per epoch, serve from RAM (reference data_set.h)."""
+
+    def load_into_memory(self):
+        self._ensure_plane().load_into_memory()
+
+    def local_shuffle(self):
+        self._shuffle_seed += 1
+        self._ensure_plane().local_shuffle(self._shuffle_seed)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        # rank-mixed seed: every worker gets a different permutation of its
+        # file shard (see module docstring for the divergence note)
+        try:
+            from .parallel.mesh import get_rank
+            rank = get_rank()
+        except Exception:
+            rank = 0
+        self._shuffle_seed += 1
+        self._ensure_plane().local_shuffle(self._shuffle_seed * 9973 + rank)
+
+    def release_memory(self):
+        if self._plane is not None:
+            self._plane.release_memory()
+
+    def get_memory_data_size(self, fleet=None):
+        return self._ensure_plane().memory_size()
+
+    def get_shuffle_data_size(self, fleet=None):
+        return self.get_memory_data_size(fleet)

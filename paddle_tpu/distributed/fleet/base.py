@@ -235,8 +235,14 @@ class DistributedOptimizer:
 
         if s.gradient_merge and s.gradient_merge_configs.get("k_steps", 1) > 1:
             from ...parallel.transforms import GradientMergeWrapper
-            opt = GradientMergeWrapper(opt,
-                                       s.gradient_merge_configs["k_steps"])
+            opt = GradientMergeWrapper(
+                opt, s.gradient_merge_configs["k_steps"],
+                avg=s.gradient_merge_configs.get("avg", True))
+
+        if s.pipeline and s.pipeline_configs.get("accumulate_steps", 1) > 1:
+            from ...optimizer import PipelineOptimizer
+            opt = PipelineOptimizer(
+                opt, num_microbatches=s.pipeline_configs["accumulate_steps"])
 
         result = opt.minimize(loss, startup_program, parameter_list,
                               no_grad_set)

@@ -233,6 +233,10 @@ class Tracer:
         self._rng_key = jax.random.key(seed)
         self._amp_level = "O0"
         self._amp_dtype = jnp.bfloat16
+        # dygraph->static capture hook (reference imperative/jit/
+        # program_desc_tracer.cc): when set by paddle.jit, every traced op is
+        # also recorded into a Program (see paddle_tpu/jit.py _Capture)
+        self._capture = None
 
     def next_node_idx(self):
         self._node_counter += 1
@@ -305,6 +309,9 @@ class Tracer:
             for t, v in zip(out_map[slot], vals):
                 t.value = v
                 produced.append(t)
+
+        if self._capture is not None:
+            self._capture.record(type, in_map, out_map, attrs)
 
         if diff_entries:
             in_tensors = [in_map[s][i] for (s, i) in diff_entries]

@@ -3,7 +3,8 @@
 Mirrors python/paddle/fluid/__init__.py's public surface for the covered
 subset so reference-style user code runs unchanged.
 """
-from ..framework.program import (Program, program_guard, default_main_program,
+from ..framework.program import (Program, program_guard, device_guard,  # noqa
+                                 default_main_program,
                                  default_startup_program, in_dygraph_mode,
                                  Variable, Parameter)
 from ..framework.executor import Executor
@@ -32,3 +33,7 @@ class core:
     def get_all_op_names():
         from ..ops import registry
         return registry.all_ops()
+
+
+from .. import dataset  # noqa: E402  (fluid.dataset.DatasetFactory)
+from ..dataloader import DataFeeder  # noqa: E402

@@ -43,7 +43,10 @@ class _CompiledBlock:
         # read state stays un-donated so XLA keeps it resident
         self.mut_names = [n for n in self.state_names if n in written]
         self.ro_names = [n for n in self.state_names if n not in written]
-        fn = functools.partial(_run_block, self.block, self.feed_names,
+        micro_k = getattr(program, "_microbatch_k", 0)
+        runner = (functools.partial(_run_block_microbatched, micro_k)
+                  if micro_k and micro_k > 1 else _run_block)
+        fn = functools.partial(runner, self.block, self.feed_names,
                                self.fetch_names, self.mut_names, self.ro_names,
                                self.written_state)
         jit_kw = {}
@@ -155,6 +158,111 @@ def _run_block_inner(block, fetch_names, written_state, env, ctx):
     return fetches, new_state
 
 
+def _run_block_microbatched(micro_k, block, feed_names, fetch_names,
+                            mut_names, ro_names, written_state,
+                            mut_state: dict, ro_state: dict, feeds: dict,
+                            rng_key):
+    """Pipeline/GPipe train step (reference SectionWorker::TrainFiles,
+    framework/section_worker.cc:82-172): LR-sched ops once (:113), then the
+    forward+backward ops as one lax.scan over micro_k microbatch slices of
+    the feeds accumulating gradients, then the optimizer ops once per mini-
+    batch (:172). TPU-native: the whole schedule is a single XLA program —
+    the scan bounds activation memory to one microbatch and XLA overlaps
+    each microbatch's collectives with the next one's compute.
+
+    Documented divergence: persistable writes from the fwd/bwd section (BN
+    running stats) are not threaded through the microbatch scan — they keep
+    their pre-step values (the reference's pipeline trainer has the same
+    wrinkle with per-microbatch scopes)."""
+    import jax
+    import jax.numpy as jnp
+    from .program import OpRole
+
+    sched_ops, body_ops, post_ops = [], [], []
+    for op in block.ops:
+        role = op.attrs.get("op_role", 0)
+        if role == OpRole.LRSched:
+            sched_ops.append(op)
+        elif role == OpRole.Optimize:
+            post_ops.append(op)
+        else:
+            body_ops.append(op)
+
+    body_produced = set()
+    for op in body_ops:
+        body_produced.update(op.output_names())
+    grad_names = []
+    for op in post_ops:
+        for n in op.input_names():
+            if n in body_produced and n not in grad_names and n != "@EMPTY@":
+                grad_names.append(n)
+    fetch_in_body = [n for n in fetch_names if n in body_produced]
+
+    env = dict(ro_state)
+    env.update(mut_state)
+    ctx = registry.LowerCtx(rng_key=rng_key)
+    _lowering_programs.append(block.program)
+    try:
+        # 1) LR-sched once
+        pseudo = type(block)(block.program, block.idx, block.parent_idx)
+        pseudo.vars = block.vars
+        pseudo.ops = sched_ops
+        _, _ = _run_block_inner(pseudo, [], [], env, ctx)
+
+        # 2) scan the fwd+bwd section over microbatch slices
+        micro_feeds = {}
+        for name, arr in feeds.items():
+            b = arr.shape[0]
+            if b % micro_k:
+                raise ValueError(
+                    f"pipeline: feed {name!r} batch {b} is not divisible by "
+                    f"num_microbatches={micro_k}")
+            micro_feeds[name] = jnp.reshape(
+                jnp.asarray(arr), (micro_k, b // micro_k) + arr.shape[1:])
+
+        base_env = dict(env)
+        body_block = type(block)(block.program, block.idx, block.parent_idx)
+        body_block.vars = block.vars
+        body_block.ops = body_ops
+
+        def body(carry, mf):
+            step_env = dict(base_env)
+            step_env.update(mf)
+            vals, _ = _run_block_inner(body_block, grad_names + fetch_in_body,
+                                       [], step_env, ctx)
+            grads = vals[:len(grad_names)]
+            outs = vals[len(grad_names):]
+            new_carry = tuple(c + g for c, g in zip(carry, grads))
+            return new_carry, tuple(outs)
+
+        # zero accumulators shaped like one microbatch's grads: get shapes by
+        # abstract eval of the first microbatch
+        first_mf = {k: v[0] for k, v in micro_feeds.items()}
+        shapes = jax.eval_shape(
+            lambda e: _run_block_inner(body_block, grad_names, [], dict(e),
+                                       ctx)[0],
+            {**base_env, **first_mf})
+        carry0 = tuple(jnp.zeros(s.shape, s.dtype) for s in shapes)
+
+        acc, stacked = jax.lax.scan(body, carry0, micro_feeds)
+
+        # 3) optimizer once on averaged grads
+        for n, a in zip(grad_names, acc):
+            env[n] = a / micro_k
+        for n, s in zip(fetch_in_body, stacked):
+            env[n] = (jnp.mean(s, axis=0)
+                      if jnp.issubdtype(s.dtype, jnp.floating) else s[-1])
+        post_block = type(block)(block.program, block.idx, block.parent_idx)
+        post_block.vars = block.vars
+        post_block.ops = post_ops
+        fetches, _ = _run_block_inner(post_block, fetch_names, written_state,
+                                      env, ctx)
+        new_state = {n: env[n] for n in written_state if n in env}
+        return fetches, new_state
+    finally:
+        _lowering_programs.pop()
+
+
 def _amp_cast(op, ins, low_dtype):
     """Static-graph AMP: white-list compute ops run in bf16/fp16, black-list
     ops in f32 (reference contrib/mixed_precision/fp16_utils.py cast
@@ -250,6 +358,36 @@ class Executor:
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches
+
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Drain one epoch of a fluid.dataset through the jitted train step
+        (reference executor.py:1598 -> TrainerFactory/MultiTrainer threads;
+        here the native data plane feeds the single fused XLA program)."""
+        assert dataset is not None, "train_from_dataset needs a dataset"
+        program = program or default_main_program()
+        fetch_list = fetch_list or []
+        fetched = None
+        step = 0
+        for feed in dataset:
+            fetched = self.run(program=program, feed=feed,
+                               fetch_list=fetch_list, scope=scope)
+            if debug and fetch_list and step % print_period == 0:
+                names = fetch_info or [getattr(v, "name", str(v))
+                                       for v in fetch_list]
+                print(f"step {step}: " + ", ".join(
+                    f"{n}={np.asarray(v).ravel()[:4]}"
+                    for n, v in zip(names, fetched)))
+            step += 1
+        return fetched
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        return self.train_from_dataset(program, dataset, scope, thread,
+                                       debug, fetch_list, fetch_info,
+                                       print_period)
 
     def close(self):
         self._cache.clear()

@@ -224,6 +224,11 @@ class Block:
         inputs = _normalize_slots(inputs)
         outputs = _normalize_slots(outputs)
         op = Operator(self, type, inputs, outputs, attrs)
+        stage = getattr(self.program, "_current_device_stage", None)
+        if stage is not None:
+            # set by fluid.device_guard (reference framework.py device_guard);
+            # consumed by the pipeline transform / stage sharding rules
+            op.attrs.setdefault("pipeline_stage", stage)
         self.ops.append(op)
         from ..ops import registry
         registry.infer_op(self, op)  # static shape/dtype inference at build time
@@ -403,6 +408,25 @@ def switch_startup_program(p: Program) -> Program:
     global _startup_program
     old, _startup_program = _startup_program, p
     return old
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """fluid.device_guard parity (reference framework.py device_guard: pins
+    ops to 'gpu:N' for the pipeline splitter). Records the stage index on
+    appended ops; on TPU the stage id feeds the pipeline transform's
+    metadata rather than a physical device pin (XLA owns placement)."""
+    program = default_main_program()
+    stage = None
+    if device is not None:
+        dev = str(device)
+        stage = int(dev.split(":")[1]) if ":" in dev else 0
+    old = getattr(program, "_current_device_stage", None)
+    program._current_device_stage = stage
+    try:
+        yield
+    finally:
+        program._current_device_stage = old
 
 
 @contextlib.contextmanager

@@ -1,0 +1,7 @@
+"""High-level API (reference python/paddle/hapi/)."""
+from .model import Model, Input, InputSpec
+from . import callbacks
+from .callbacks import Callback, ProgBarLogger, ModelCheckpoint, EarlyStopping
+
+__all__ = ["Model", "Input", "InputSpec", "callbacks", "Callback",
+           "ProgBarLogger", "ModelCheckpoint", "EarlyStopping"]

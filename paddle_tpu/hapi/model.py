@@ -1,0 +1,237 @@
+"""hapi.Model: fit / evaluate / predict / save / load.
+
+Reference counterpart: python/paddle/hapi/model.py (Model.fit :799,
+evaluate :1267, predict :1467, save :1017). The reference switches between a
+static-graph adapter and a dygraph adapter; the TPU build runs the dygraph
+engine (each train_batch is traced ops over jax.Arrays — XLA compiles the
+hot path per shape) and multi-device data parallelism comes from the
+collective env (DistributedBatchSampler shards data; gradients allreduce via
+the mesh, reference model.py:163-172 prepare_distributed_context).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..metric import Metric
+from .callbacks import Callback, CallbackList, ProgBarLogger, ModelCheckpoint
+
+
+class Input:
+    """Input spec (reference hapi Input / static.InputSpec)."""
+
+    def __init__(self, shape=None, dtype="float32", name=None):
+        self.shape = tuple(shape or ())
+        self.dtype = dtype
+        self.name = name
+
+
+InputSpec = Input
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+
+    # -- setup ---------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        if optimizer is not None and optimizer._parameter_list is None:
+            optimizer._parameter_list = list(self.network.parameters())
+        self._loss = loss
+        if metrics is None:
+            metrics = []
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+            else [metrics]
+
+    # -- single-batch paths (reference Model.train_batch/eval_batch) --------
+    def _forward_loss(self, inputs, labels):
+        import paddle_tpu as paddle
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outs = self.network(*[paddle.to_tensor(np.asarray(x)) for x in ins])
+        outs_list = outs if isinstance(outs, (list, tuple)) else [outs]
+        loss = None
+        if labels is not None and self._loss is not None:
+            lbs = labels if isinstance(labels, (list, tuple)) else [labels]
+            lbs = [paddle.to_tensor(np.asarray(l)) for l in lbs]
+            loss = self._loss(*outs_list, *lbs)
+        return outs_list, loss
+
+    def train_batch(self, inputs, labels=None):
+        self.network.train()
+        outs, loss = self._forward_loss(inputs, labels)
+        assert loss is not None, "prepare() a loss before train_batch"
+        loss.backward()
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        return float(np.asarray(loss.numpy())), outs
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        outs, loss = self._forward_loss(inputs, labels)
+        return (None if loss is None else float(np.asarray(loss.numpy()))), \
+            outs
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        outs, _ = self._forward_loss(inputs, None)
+        return [np.asarray(o.numpy()) for o in outs]
+
+    # -- loops ---------------------------------------------------------------
+    def _as_loader(self, data, batch_size, shuffle):
+        from ..dataloader import DataLoader, Dataset
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            from ..parallel.mesh import get_world_size
+            if get_world_size() > 1:
+                from ..dataloader import DistributedBatchSampler
+                bs = DistributedBatchSampler(data, batch_size=batch_size,
+                                             shuffle=shuffle)
+                return DataLoader(data, batch_sampler=bs)
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+        return data  # already iterable of batches
+
+    def _split_batch(self, batch):
+        """(x..., y...) split by declared inputs/labels arity."""
+        fields = batch if isinstance(batch, (tuple, list)) else (batch,)
+        n_in = len(self._inputs) if self._inputs else max(len(fields) - 1, 1)
+        return fields[:n_in], fields[n_in:] or None
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=1,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            num_iters=None):
+        loader = self._as_loader(train_data, batch_size, shuffle)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbs = [ProgBarLogger(log_freq, verbose=verbose)]
+        if save_dir:
+            cbs.append(ModelCheckpoint(save_freq, save_dir))
+        cbs.extend(callbacks or [])
+        cblist = CallbackList(cbs, self,
+                              {"epochs": epochs, "steps": steps,
+                               "verbose": verbose})
+        self.stop_training = False
+        cblist.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            cblist.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cblist.on_train_batch_begin(step)
+                xs, ys = self._split_batch(batch)
+                loss, outs = self.train_batch(list(xs), ys)
+                logs = {"loss": loss}
+                for m in self._metrics:
+                    if ys is not None:
+                        pre = m.compute(np.asarray(outs[0].numpy()),
+                                        np.asarray(ys[0]))
+                        if isinstance(pre, tuple):
+                            m.update(*pre)
+                        else:
+                            m.update(pre)
+                        logs[m.name()] = m.accumulate()
+                cblist.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    self.stop_training = True
+                    break
+            cblist.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size,
+                              verbose=verbose, _callbacks=cblist)
+            if self.stop_training:
+                break
+        cblist.on_train_end()
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=1,
+                 num_workers=0, callbacks=None, _callbacks=None):
+        loader = self._as_loader(eval_data, batch_size, shuffle=False)
+        cblist = _callbacks or CallbackList(
+            [ProgBarLogger(log_freq, verbose=0)] + list(callbacks or []),
+            self, {})
+        cblist.on_eval_begin()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step, batch in enumerate(loader):
+            xs, ys = self._split_batch(batch)
+            loss, outs = self.eval_batch(list(xs), ys)
+            if loss is not None:
+                losses.append(loss)
+            for m in self._metrics:
+                if ys is not None:
+                    pre = m.compute(np.asarray(outs[0].numpy()),
+                                    np.asarray(ys[0]))
+                    m.update(*pre) if isinstance(pre, tuple) else m.update(pre)
+            cblist.on_eval_batch_end(step)
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            logs[m.name()] = m.accumulate()
+        cblist.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._as_loader(test_data, batch_size, shuffle=False)
+        outputs = []
+        for batch in loader:
+            xs, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(list(xs)))
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([b[i] for b in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    # -- persistence (reference Model.save :1017 / load) ---------------------
+    def save(self, path, training=True):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        sd = {k: np.asarray(v) for k, v in self.network.state_dict().items()}
+        with open(path + ".pdparams", "wb") as f:
+            pickle.dump(sd, f)
+        if training and self._optimizer is not None:
+            with open(path + ".pdopt", "wb") as f:
+                pickle.dump(self._optimizer.state_dict(), f)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        with open(path + ".pdparams", "rb") as f:
+            sd = pickle.load(f)
+        self.network.set_state_dict(sd)
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)):
+            with open(opt_path, "rb") as f:
+                self._optimizer.set_state_dict(pickle.load(f))
+
+    def parameters(self):
+        return list(self.network.parameters())
+
+    def summary(self, input_size=None, dtype=None):
+        lines = [f"Model: {type(self.network).__name__}"]
+        total = 0
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape))
+            total += n
+            lines.append(f"  {name}: {tuple(p.shape)} = {n}")
+        lines.append(f"Total params: {total}")
+        s = "\n".join(lines)
+        print(s)
+        return {"total_params": total}

@@ -1,0 +1,39 @@
+"""Minimal progress bar (reference hapi/progressbar.py)."""
+from __future__ import annotations
+
+import sys
+import time
+
+
+class ProgressBar:
+    def __init__(self, num=None, width=30, verbose=1, stream=sys.stdout):
+        self._num = num
+        self._width = width
+        self._verbose = verbose
+        self._stream = stream
+        self._start = time.time()
+
+    def update(self, current_num, values=None):
+        if self._verbose == 0:
+            return
+        vals = ", ".join(f"{k}: {_fmt(v)}" for k, v in (values or []))
+        if self._num:
+            frac = min(current_num / self._num, 1.0)
+            filled = int(frac * self._width)
+            bar = "=" * filled + "." * (self._width - filled)
+            line = f"step {current_num}/{self._num} [{bar}] {vals}"
+        else:
+            line = f"step {current_num} {vals}"
+        elapsed = time.time() - self._start
+        end = "\n" if (self._verbose == 2
+                       or (self._num and current_num >= self._num)) else "\r"
+        self._stream.write(f"{line} - {elapsed:.0f}s{end}")
+        self._stream.flush()
+
+
+def _fmt(v):
+    try:
+        f = float(v)
+        return f"{f:.4f}"
+    except (TypeError, ValueError):
+        return str(v)

@@ -124,3 +124,11 @@ def load_inference_model(dirname, executor=None, model_filename=None,
     meta = payload["meta"]
     fetch_vars = [program.global_block().var(n) for n in meta["fetch"]]
     return program, meta["feed"], fetch_vars
+
+
+# data loading surface (paddle.io.* in 2.0; fluid.io.DataLoader in 1.x) —
+# reference reader.py / fluid/dataloader/
+from .dataloader import (DataLoader, Dataset, IterableDataset,  # noqa: E402
+                         TensorDataset, Subset, random_split, Sampler,
+                         SequenceSampler, RandomSampler, BatchSampler,
+                         DistributedBatchSampler, DataFeeder)

@@ -1,0 +1,365 @@
+"""paddle.jit: dygraph→static capture, save/load, TracedLayer.
+
+Reference counterpart: python/paddle/fluid/dygraph/jit.py (@declarative
+:158, TracedLayer) and dygraph_to_static/program_translator.py:691. The
+reference REWRITES PYTHON AST per control-flow construct; the TPU build
+captures by TRACING — the dygraph tracer already sees every op, so a capture
+hook (imperative/jit/program_desc_tracer.cc is the reference analog) records
+them into a Program. Python control flow is specialized at trace time
+(branches taken are baked in), the standard jax/XLA tracing contract.
+
+The captured program then runs as ONE jitted XLA computation per input
+signature — to_static is also the dygraph-mode speed path, collapsing per-op
+dispatch into a single compiled call.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .framework import unique_name
+from .framework.dtype import convert_dtype, dtype_name
+from .framework.program import Operator, Program, in_dygraph_mode
+
+__all__ = ["to_static", "declarative", "save", "load", "TracedLayer",
+           "TranslatedLayer", "ProgramTranslator", "not_to_static"]
+
+
+class _Capture:
+    """Records traced ops into a Program (set as tracer._capture)."""
+
+    def __init__(self):
+        self.program = Program()
+        self.block = self.program.global_block()
+        self.names = {}          # id(Tensor) -> current var name
+        self.param_values = {}   # persistable name -> np.ndarray
+        self.feed_names: List[str] = []
+        self.keepalive = []      # tensors must outlive capture (id reuse!)
+
+    def mark_input(self, t, name):
+        self.keepalive.append(t)
+        v = self.block.create_var(name=name, shape=tuple(t.value.shape),
+                                  dtype=str(t.value.dtype), is_data=True)
+        self.names[id(t)] = name
+        self.feed_names.append(name)
+        return v
+
+    def _name_for_input(self, t):
+        key = id(t)
+        if key in self.names:
+            return self.names[key]
+        from .dygraph.tracer import EagerParamBase
+        self.keepalive.append(t)
+        if isinstance(t, EagerParamBase):
+            name = t.name
+            self.block.create_var(name=name, shape=tuple(t.value.shape),
+                                  dtype=str(t.value.dtype), persistable=True)
+            self.param_values[name] = np.asarray(t.value)
+        else:
+            # tensor created outside the traced region: bake as constant
+            arr = np.asarray(t.value)
+            name = unique_name.generate("jit_const")
+            self.block.create_var(name=name, shape=arr.shape,
+                                  dtype=str(arr.dtype))
+            self.block.ops.append(Operator(
+                self.block, "assign_value", {}, {"Out": [name]},
+                {"shape": list(arr.shape), "dtype": str(arr.dtype),
+                 "values": arr.reshape(-1).tolist()}))
+        self.names[key] = name
+        return name
+
+    def record(self, op_type, in_map, out_map, attrs):
+        attrs = dict(attrs)
+        from .ops import registry
+        if registry.get(op_type).is_random and not attrs.get("__rng_seed__"):
+            # distinct stable seeds per captured random op (the eager path
+            # passes 0 for all of them; sharing would correlate the masks)
+            self._rng_ctr = getattr(self, "_rng_ctr", 0) + 1
+            attrs["__rng_seed__"] = self._rng_ctr
+        ins = {slot: [self._name_for_input(t) for t in ts]
+               for slot, ts in in_map.items()}
+        outs = {}
+        for slot, ts in out_map.items():
+            names = []
+            for t in ts:
+                self.keepalive.append(t)
+                name = unique_name.generate(f"{op_type}_out")
+                shape = (tuple(t.value.shape)
+                         if getattr(t, "value", None) is not None else ())
+                dtype = (str(t.value.dtype)
+                         if getattr(t, "value", None) is not None
+                         else "float32")
+                self.block.create_var(name=name, shape=shape, dtype=dtype)
+                self.names[id(t)] = name  # SSA-style rebind for in-place ops
+                names.append(name)
+            outs[slot] = names
+        self.block.ops.append(Operator(self.block, op_type, ins, outs,
+                                       dict(attrs)))
+        self.program.bump_version()
+
+
+def _capture_callable(fn, example_args):
+    """Run fn once under capture; returns (capture, out_names, outputs)."""
+    from .dygraph.tracer import Tensor, current_tracer
+    tracer = current_tracer()
+    assert tracer._capture is None, "nested jit capture is not supported"
+    cap = _Capture()
+    tensors = []
+    for i, a in enumerate(example_args):
+        t = a if isinstance(a, Tensor) else Tensor(np.asarray(a))
+        cap.mark_input(t, f"jit_input_{i}")
+        tensors.append(t)
+    tracer._capture = cap
+    try:
+        out = fn(*tensors)
+    finally:
+        tracer._capture = None
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    out_names = []
+    for o in outs:
+        if id(o) not in cap.names:
+            # output untouched by any op (identity fn) — alias via assign
+            cap.record("assign", {"X": [o]}, {"Out": [o]}, {})
+        out_names.append(cap.names[id(o)])
+    return cap, out_names, outs
+
+
+class _CompiledCapture:
+    """Runs a captured program as one jitted XLA call per input signature."""
+
+    def __init__(self, cap: _Capture, out_names: Sequence[str]):
+        self.cap = cap
+        self.out_names = list(out_names)
+        self._jitted = {}
+        self._device_params = None  # jax arrays, device-resident once
+
+    def _key(self, arrays):
+        return tuple((a.shape, str(a.dtype)) for a in arrays)
+
+    def __call__(self, *args):
+        import jax
+        from .framework.executor import _run_block
+        from .dygraph.tracer import Tensor, current_tracer
+        arrays = [np.asarray(a.value if isinstance(a, Tensor) else a)
+                  for a in args]
+        if self._device_params is None:
+            self._device_params = {k: jax.device_put(v)
+                                   for k, v in self.cap.param_values.items()}
+        key = self._key(arrays)
+        fn = self._jitted.get(key)
+        if fn is None:
+            cap = self.cap
+            feed_names = cap.feed_names
+
+            def run(feeds, params, rng):
+                env = dict(params)
+                env.update(zip(feed_names, feeds))
+                fetches, _ = _run_block(cap.block, [], self.out_names,
+                                        [], [], [], env, {}, {}, rng)
+                return fetches
+            fn = jax.jit(run)
+            self._jitted[key] = fn
+        rng = current_tracer().next_key() if in_dygraph_mode() \
+            else jax.random.key(0)
+        fetches = fn(arrays, self._device_params, rng)
+        outs = [Tensor(f) for f in fetches]
+        return outs[0] if len(outs) == 1 else outs
+
+
+class StaticFunction:
+    """@to_static wrapper: trace-capture on first call per signature, then
+    run the fused program. Inference/forward path only — train by calling
+    the layer directly (backward through the captured program lands with a
+    later round's partial_program equivalent)."""
+
+    def __init__(self, function, input_spec=None):
+        self._function = function
+        self._input_spec = input_spec
+        self._compiled = {}
+        self._last_capture = None
+
+    def __get__(self, instance, owner):
+        # support decorating methods: one capture cache PER INSTANCE — the
+        # capture snapshots parameter values, so sharing across instances
+        # would serve one object's weights to another
+        import functools
+        import weakref
+        if instance is None:
+            return self
+        if not hasattr(self, "_per_instance"):
+            self._per_instance = weakref.WeakKeyDictionary()
+        sf = self._per_instance.get(instance)
+        if sf is None:
+            bound = functools.partial(self._function, instance)
+            sf = StaticFunction(bound, self._input_spec)
+            self._per_instance[instance] = sf
+        return sf
+
+    def __call__(self, *args):
+        from .dygraph.tracer import Tensor
+        arrays = [np.asarray(a.value if isinstance(a, Tensor) else a)
+                  for a in args]
+        key = tuple((a.shape, str(a.dtype)) for a in arrays)
+        entry = self._compiled.get(key)
+        if entry is None:
+            cap, out_names, _ = _capture_callable(self._function, arrays)
+            entry = _CompiledCapture(cap, out_names)
+            self._compiled[key] = entry
+            self._last_capture = entry
+        return entry(*args)
+
+    @property
+    def program(self):
+        assert self._last_capture is not None, "call the function first"
+        return self._last_capture.cap.program
+
+
+def to_static(function=None, input_spec=None, build_strategy=None):
+    """@paddle.jit.to_static (reference @declarative, dygraph/jit.py:158)."""
+    def deco(fn):
+        return StaticFunction(fn, input_spec)
+    return deco(function) if function is not None else deco
+
+
+declarative = to_static
+
+
+def not_to_static(fn):
+    return fn
+
+
+class ProgramTranslator:
+    """API parity with reference program_translator.py ProgramTranslator."""
+
+    _instance = None
+    enable_to_static = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static=True):
+        ProgramTranslator.enable_to_static = enable_to_static
+
+
+# ---------------------------------------------------------------------------
+# save / load (reference paddle.jit.save/load, dygraph/jit.py)
+# ---------------------------------------------------------------------------
+
+def save(layer, path, input_spec=None, **config):
+    """Capture `layer` and write {path}.pdmodel (program json) +
+    {path}.pdiparams (npz params). input_spec: list of hapi.Input /
+    InputSpec / example arrays."""
+    assert input_spec, "paddle.jit.save needs input_spec on the TPU build"
+    examples = []
+    for spec in input_spec:
+        if hasattr(spec, "shape"):
+            shape = [1 if (d is None or d < 0) else int(d)
+                     for d in spec.shape]
+            dt = convert_dtype(getattr(spec, "dtype", "float32"))
+            examples.append(np.zeros(shape, dt))
+        else:
+            examples.append(np.asarray(spec))
+    fn = layer.forward if hasattr(layer, "forward") else layer
+    was_training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()
+    cap, out_names, _ = _capture_callable(fn, examples)
+    if was_training and hasattr(layer, "train"):
+        layer.train()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    payload = {"program": cap.program.to_desc(),
+               "meta": {"feed": cap.feed_names, "fetch": out_names}}
+    with open(path + ".pdmodel", "w") as f:
+        json.dump(payload, f)
+    np.savez(path + ".pdiparams", **cap.param_values)
+
+
+class TranslatedLayer:
+    """Loaded jit model, callable in dygraph (reference TranslatedLayer)."""
+
+    def __init__(self, program, feed_names, fetch_names, params):
+        self._program = program
+        self._feed = list(feed_names)
+        self._fetch = list(fetch_names)
+        self._params = dict(params)
+        cap = _Capture.__new__(_Capture)
+        cap.program = program
+        cap.block = program.global_block()
+        cap.names = {}
+        cap.param_values = self._params
+        cap.feed_names = self._feed
+        cap.keepalive = []
+        self._compiled = _CompiledCapture(cap, self._fetch)
+        self.training = False
+
+    def __call__(self, *args):
+        return self._compiled(*args)
+
+    forward = __call__
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def train(self):
+        self.training = True
+        return self
+
+    def parameters(self):
+        from .dygraph.tracer import Tensor
+        return [Tensor(v, name=k) for k, v in self._params.items()]
+
+    @property
+    def program(self):
+        return self._program
+
+
+def load(path, **config):
+    with open(path + ".pdmodel") as f:
+        payload = json.load(f)
+    program = Program.from_desc(payload["program"])
+    params = {}
+    with np.load(path + ".pdiparams.npz" if os.path.exists(
+            path + ".pdiparams.npz") else path + ".pdiparams") as d:
+        for n in d.files:
+            params[n] = d[n]
+    meta = payload["meta"]
+    return TranslatedLayer(program, meta["feed"], meta["fetch"], params)
+
+
+class TracedLayer:
+    """fluid.dygraph.TracedLayer parity: trace once, replay fast, export."""
+
+    def __init__(self, compiled: _CompiledCapture):
+        self._compiled = compiled
+
+    @staticmethod
+    def trace(layer, inputs):
+        cap, out_names, outs = _capture_callable(
+            layer.forward if hasattr(layer, "forward") else layer,
+            [np.asarray(getattr(t, "value", t)) for t in inputs])
+        tl = TracedLayer(_CompiledCapture(cap, out_names))
+        return (outs[0] if len(outs) == 1 else outs), tl
+
+    def __call__(self, *args):
+        return self._compiled(*args)
+
+    @property
+    def program(self):
+        return self._compiled.cap.program
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        cap = self._compiled.cap
+        payload = {"program": cap.program.to_desc(),
+                   "meta": {"feed": cap.feed_names,
+                            "fetch": self._compiled.out_names}}
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "__model__"), "w") as f:
+            json.dump(payload, f)
+        np.savez(os.path.join(path, "params.npz"), **cap.param_values)

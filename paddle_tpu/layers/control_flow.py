@@ -13,8 +13,11 @@ Semantic notes vs the reference (XLA constraints, documented divergences):
 - Carried variables must keep a fixed shape/dtype across iterations.
 - `While` is not reverse-differentiable (lax.while_loop has no VJP); use
   StaticRNN / `lax.scan`-based loops on the training path, While for decode.
-- LoDTensorArray is a bounded ring buffer: `array_write` materializes a
+- LoDTensorArray is a bounded buffer: `array_write` materializes a
   `capacity`-slot buffer on first write (reference grows dynamically).
+  Writes at indices >= capacity are DROPPED (XLA scatter drop mode) while
+  `array_length` still reports the high-water index — size the capacity to
+  the loop bound.
 """
 from __future__ import annotations
 
@@ -308,7 +311,9 @@ def _lower_array_write(ctx, ins, attrs):
         buffer = jnp.zeros((int(attrs.get("capacity",
                                           _DEFAULT_ARRAY_CAPACITY)),)
                            + tuple(x.shape), x.dtype)
-    buffer = buffer.at[i].set(x.astype(buffer.dtype))
+    # drop (not clamp) out-of-capacity writes: clamping would silently
+    # overwrite the last slot with later elements
+    buffer = buffer.at[i].set(x.astype(buffer.dtype), mode="drop")
     length = jnp.maximum(length, i + 1)
     return {"Out": [(buffer, length)]}
 

@@ -1,0 +1,150 @@
+"""paddle.metric: streaming metrics.
+
+Reference counterpart: python/paddle/metric/metrics.py (Metric base,
+Accuracy, Precision, Recall, Auc) and fluid/metrics.py. Host-side numpy
+accumulation over per-batch device results — the per-batch compare runs on
+device inside the jitted step when used through hapi; the accumulate is O(1)
+host work.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]
+
+
+class Metric:
+    def __init__(self, name=None):
+        self._name = name or type(self).__name__.lower()
+
+    def name(self):
+        return self._name
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def compute(self, pred, label, *args):
+        """Optional device-side pre-reduction; default passthrough."""
+        return pred, label
+
+
+class Accuracy(Metric):
+    """Top-k accuracy (reference metrics.py Accuracy)."""
+
+    def __init__(self, topk=(1,), name=None):
+        super().__init__(name or "acc")
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self.reset()
+
+    def reset(self):
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label, *args):
+        pred = np.asarray(pred)
+        label = np.asarray(label).reshape(pred.shape[0], -1)[:, :1]
+        idx = np.argsort(-pred, axis=-1)[:, :self.maxk]
+        correct = idx == label
+        return correct
+
+    def update(self, correct):
+        correct = np.asarray(correct)
+        res = []
+        for i, k in enumerate(self.topk):
+            num = correct[:, :k].sum()
+            self.total[i] += num
+            self.count[i] += correct.shape[0]
+            res.append(float(num) / correct.shape[0])
+        return res[0] if len(res) == 1 else res
+
+    def accumulate(self):
+        out = [float(t / max(c, 1)) for t, c in zip(self.total, self.count)]
+        return out[0] if len(out) == 1 else out
+
+
+class Precision(Metric):
+    """Binary precision over probability predictions (reference metrics.py)."""
+
+    def __init__(self, name=None):
+        super().__init__(name or "precision")
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = (np.asarray(preds).reshape(-1) > 0.5).astype(np.int64)
+        labels = np.asarray(labels).reshape(-1).astype(np.int64)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return float(self.tp) / denom if denom else 0.0
+
+
+class Recall(Metric):
+    def __init__(self, name=None):
+        super().__init__(name or "recall")
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = (np.asarray(preds).reshape(-1) > 0.5).astype(np.int64)
+        labels = np.asarray(labels).reshape(-1).astype(np.int64)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return float(self.tp) / denom if denom else 0.0
+
+
+class Auc(Metric):
+    """Histogram-bucketed ROC AUC (reference metrics.py Auc / auc_op.cc:
+    same thresholded stat-accumulator scheme)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        super().__init__(name or "auc")
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._pos = np.zeros(self.num_thresholds + 1, np.int64)
+        self._neg = np.zeros(self.num_thresholds + 1, np.int64)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            preds = preds[:, 1]
+        preds = preds.reshape(-1)
+        labels = np.asarray(labels).reshape(-1)
+        buckets = np.clip((preds * self.num_thresholds).astype(np.int64),
+                          0, self.num_thresholds)
+        np.add.at(self._pos, buckets[labels == 1], 1)
+        np.add.at(self._neg, buckets[labels == 0], 1)
+
+    def accumulate(self):
+        # walk thresholds high→low accumulating TPR/FPR trapezoids
+        tot_pos = self._pos.sum()
+        tot_neg = self._neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # (0,0) anchor first: without it the segment contributed by the
+        # highest bucket (preds == 1.0) is dropped from the integral
+        pos = np.concatenate([[0], np.cumsum(self._pos[::-1])])
+        neg = np.concatenate([[0], np.cumsum(self._neg[::-1])])
+        tpr = pos / tot_pos
+        fpr = neg / tot_neg
+        return float(np.trapezoid(tpr, fpr))

@@ -1,0 +1,40 @@
+"""Native (C++) components and their build glue.
+
+The reference's latency-critical host paths are C++ (data feeding, sparse KV,
+checkpoint IO — SURVEY §2.11); this package holds the TPU build's C++
+equivalents, compiled on demand with g++ into shared libraries loaded via
+ctypes (no pybind dependency). A failed toolchain falls back to pure-Python
+implementations at the call sites, with a warning.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import warnings
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_cache: dict = {}
+
+
+def load_native(name: str, extra_flags=()):
+    """Compile native/<name>.cc into lib<name>.so (mtime-cached) and dlopen it.
+    Returns the ctypes CDLL, or None when the toolchain is unavailable."""
+    if name in _cache:
+        return _cache[name]
+    src = os.path.join(_DIR, f"{name}.cc")
+    lib = os.path.join(_DIR, f"lib{name}.so")
+    try:
+        if (not os.path.exists(lib)
+                or os.path.getmtime(lib) < os.path.getmtime(src)):
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                   "-o", lib, src, "-lpthread", *extra_flags]
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        handle = ctypes.CDLL(lib)
+    except (OSError, subprocess.CalledProcessError) as e:
+        detail = getattr(e, "stderr", str(e))
+        warnings.warn(f"native component {name!r} unavailable "
+                      f"({detail}); falling back to Python implementation")
+        handle = None
+    _cache[name] = handle
+    return handle

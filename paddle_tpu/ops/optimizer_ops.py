@@ -198,3 +198,15 @@ def _dpsgd(ctx, ins, attrs):
     noise = jax.random.normal(ctx.op_key(attrs), g.shape) * sigma * clip
     g_out = (g * scale + noise / batch_size)
     return {"ParamOut": [p - lr * g_out]}
+
+
+@register("decayed_adagrad", **_OPT)
+def _decayed_adagrad(ctx, ins, attrs):
+    """Reference decayed_adagrad_op.cc: moment = decay*moment + (1-decay)*g^2."""
+    p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    m = ins["Moment"][0]
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    m_out = decay * m + (1 - decay) * jnp.square(g)
+    p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": [p_out], "MomentOut": [m_out]}

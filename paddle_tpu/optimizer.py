@@ -28,7 +28,12 @@ __all__ = [
     "Adam", "AdamOptimizer", "AdamW", "Adagrad", "AdagradOptimizer",
     "Adamax", "AdamaxOptimizer", "RMSProp", "RMSPropOptimizer",
     "Lamb", "LambOptimizer", "LarsMomentum", "LarsMomentumOptimizer",
-    "ExponentialMovingAverage", "ModelAverage",
+    "ExponentialMovingAverage", "ModelAverage", "Adadelta",
+    "AdadeltaOptimizer", "Ftrl", "FtrlOptimizer", "Dpsgd", "DpsgdOptimizer",
+    "DecayedAdagrad", "DecayedAdagradOptimizer", "DGCMomentumOptimizer",
+    "LookaheadOptimizer", "RecomputeOptimizer", "GradientMergeOptimizer",
+    "PipelineOptimizer",
+    "lr",
 ]
 
 
@@ -50,9 +55,17 @@ class Optimizer:
         if self._lr_var is not None:
             return self._lr_var
         from .framework.program import in_dygraph_mode
+        from .lr import LRScheduler
         lr = self._learning_rate
         if isinstance(lr, Variable):
             self._lr_var = lr
+        elif isinstance(lr, LRScheduler):
+            # static mode: persistable LR var the scheduler refreshes in the
+            # global scope on step() — device state, no recompiles
+            name = unique_name.generate("learning_rate")
+            self._lr_var = layers.create_global_var(
+                [1], float(lr()), "float32", persistable=True, name=name)
+            lr._bind_static_var(name)
         elif callable(lr):
             self._lr_var = lr()
         else:
@@ -145,10 +158,27 @@ class Optimizer:
     def state_dict(self):
         from .framework.scope import global_scope
         sd = {}
-        for accs in self._accumulators.values():
+        for accs in self._accumulators.values():   # static-graph accumulators
             for v in accs.values():
                 sd[v.name] = np.asarray(global_scope().find(v.name))
+        for pname, accs in getattr(self, "_eager_acc", {}).items():
+            for aname, val in accs.items():        # dygraph accumulators
+                sd[f"{pname}/{aname}"] = np.asarray(val)
         return sd
+
+    def set_state_dict(self, sd):
+        from .framework.scope import global_scope
+        import jax.numpy as jnp
+        static_names = {v.name for accs in self._accumulators.values()
+                        for v in accs.values()}
+        for key, val in sd.items():
+            if "/" in key and key not in static_names:
+                pname, aname = key.rsplit("/", 1)
+                if not hasattr(self, "_eager_acc"):
+                    self._eager_acc = {}
+                self._eager_acc.setdefault(pname, {})[aname] = jnp.asarray(val)
+            else:
+                global_scope().set(key, jnp.asarray(val))
 
 
 class SGDOptimizer(Optimizer):
@@ -424,6 +454,203 @@ class ModelAverage(ExponentialMovingAverage):
         super().__init__(decay=0.999)
 
 
+class AdadeltaOptimizer(Optimizer):
+    """Reference optimizer.py AdadeltaOptimizer (operators adadelta_op)."""
+
+    def __init__(self, learning_rate=1.0, epsilon=1e-6, rho=0.95, **kw):
+        super().__init__(learning_rate, **kw)
+        self.type = "adadelta"
+        self._rho, self._epsilon = rho, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("avg_squared_grad", p)
+            self._add_accumulator("avg_squared_update", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        asg = self._get_accumulator("avg_squared_grad", p)
+        asu = self._get_accumulator("avg_squared_update", p)
+        return block.append_op(
+            "adadelta",
+            inputs={"Param": [p], "Grad": [g], "AvgSquaredGrad": [asg],
+                    "AvgSquaredUpdate": [asu]},
+            outputs={"ParamOut": [p], "AvgSquaredGradOut": [asg],
+                     "AvgSquaredUpdateOut": [asu]},
+            attrs={"rho": self._rho, "epsilon": self._epsilon,
+                   "op_role": OpRole.Optimize})
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self.type = "decayed_adagrad"
+        self._decay, self._epsilon = decay, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            "decayed_adagrad",
+            inputs={"Param": [p], "Grad": [g], "Moment": [m],
+                    "LearningRate": [self._lr_var]},
+            outputs={"ParamOut": [p], "MomentOut": [m]},
+            attrs={"decay": self._decay, "epsilon": self._epsilon,
+                   "op_role": OpRole.Optimize})
+
+
+class FtrlOptimizer(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self.type = "ftrl"
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "ftrl",
+            inputs={"Param": [p], "Grad": [g],
+                    "SquaredAccumulator": [self._get_accumulator("squared", p)],
+                    "LinearAccumulator": [self._get_accumulator("linear", p)],
+                    "LearningRate": [self._lr_var]},
+            outputs={"ParamOut": [p],
+                     "SquaredAccumOut": [self._get_accumulator("squared", p)],
+                     "LinearAccumOut": [self._get_accumulator("linear", p)]},
+            attrs={"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power,
+                   "op_role": OpRole.Optimize})
+
+
+class DpsgdOptimizer(Optimizer):
+    """Differentially-private SGD (reference optimizer.py DpsgdOptimizer)."""
+
+    def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16.0,
+                 sigma=1.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self.type = "dpsgd"
+        self._clip, self._batch_size, self._sigma = clip, batch_size, sigma
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            "dpsgd",
+            inputs={"Param": [p], "Grad": [g],
+                    "LearningRate": [self._lr_var]},
+            outputs={"ParamOut": [p]},
+            attrs={"clip": self._clip, "batch_size": self._batch_size,
+                   "sigma": self._sigma, "op_role": OpRole.Optimize})
+
+
+class DGCMomentumOptimizer(MomentumOptimizer):
+    """Reference optimizer.py:1185. On TPU the DGC top-k sparsified allreduce
+    has no role — gradients cross chips as XLA reduce-scatter/all-reduce over
+    ICI chosen by GSPMD — so this preserves the momentum-correction update
+    semantics and accepts (ignores) the compression knobs. Documented
+    divergence: no bandwidth compression is performed."""
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step=0,
+                 rampup_step=1, sparsity=(0.999,), use_nesterov=False,
+                 local_grad_clip_norm=None, num_trainers=None, **kw):
+        super().__init__(learning_rate, momentum, use_nesterov, **kw)
+        self._rampup_begin_step = rampup_begin_step
+        self._sparsity = sparsity
+
+
+class LookaheadOptimizer:
+    """Reference optimizer.py:4853: slow/fast weights; every k steps the slow
+    copy moves toward the fast weights and the fast weights reset to it.
+    The periodic sync runs as a host-side scope update (cheap: k is small)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step = 0
+        self._slow = {}
+        self._params = None
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        res = self.inner_optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        self._params = [p for p, _ in res[1]]
+        return res
+
+    def sync(self):
+        """Call once per executor step (reference inserts the sync ops into
+        the program; host-side here keeps the jitted step donation-friendly)."""
+        from .framework.scope import global_scope
+        if self._params is None:
+            raise RuntimeError(
+                "LookaheadOptimizer.sync() before minimize(): the wrapper "
+                "must own the minimize call to know the parameter set")
+        scope = global_scope()
+        if not self._slow:
+            # seed slow weights at the window start (pre-update values)
+            for p in self._params:
+                self._slow[p.name] = np.asarray(scope.find(p.name))
+        self._step += 1
+        if self._step % self.k:
+            return
+        for p in self._params:
+            # host numpy copies: scope arrays get DONATED to the next jitted
+            # step, so cached device references would be invalidated
+            fast = np.asarray(scope.find(p.name))
+            slow = self._slow.get(p.name)
+            if slow is None:
+                slow = fast
+            slow = slow + self.alpha * (fast - slow)
+            self._slow[p.name] = slow
+            scope.set(p.name, slow)
+
+
+class PipelineOptimizer:
+    """Reference optimizer.py:3695 PipelineOptimizer + SectionWorker
+    (framework/section_worker.cc). TPU-native GPipe: minimize marks the
+    program with the microbatch count; the Executor then runs LR-sched ops
+    once, scans the fwd+bwd section over microbatch slices of every feed
+    accumulating grads, and applies the optimizer ops once — one fused XLA
+    program (see executor._run_block_microbatched). `fluid.device_guard`
+    stage annotations ride along as op metadata for stage-aware sharding."""
+
+    def __init__(self, optimizer, num_microbatches=1, start_cpu_core_id=0):
+        self.inner_optimizer = optimizer
+        self.num_microbatches = int(num_microbatches)
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        res = self.inner_optimizer.minimize(loss, startup_program,
+                                            parameter_list, no_grad_set)
+        program = loss.block.program
+        program._microbatch_k = self.num_microbatches
+        program.bump_version()
+        return res
+
+
+def RecomputeOptimizer(inner_optimizer, checkpoints=None):
+    """Reference optimizer.py:4547 — activation checkpointing. TPU-native via
+    jax.remat segments (parallel/transforms.apply_recompute)."""
+    from .parallel.transforms import RecomputeWrapper
+    return RecomputeWrapper(inner_optimizer, checkpoints or [])
+
+
+def GradientMergeOptimizer(inner_optimizer, k_steps=1, avg=True):
+    """Reference optimizer.py:5025 — micro-batch gradient accumulation."""
+    from .parallel.transforms import GradientMergeWrapper
+    return GradientMergeWrapper(inner_optimizer, k_steps, avg=avg)
+
+
 # 2.0-style aliases
 SGD = SGDOptimizer
 Momentum = MomentumOptimizer
@@ -433,3 +660,9 @@ Adamax = AdamaxOptimizer
 RMSProp = RMSPropOptimizer
 Lamb = LambOptimizer
 LarsMomentum = LarsMomentumOptimizer
+Adadelta = AdadeltaOptimizer
+Ftrl = FtrlOptimizer
+Dpsgd = DpsgdOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+
+from . import lr  # noqa: E402  (paddle.optimizer.lr.* scheduler classes)

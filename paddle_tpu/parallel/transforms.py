@@ -133,9 +133,10 @@ class GradientMergeWrapper:
     update, gating ALL inner-op state writes with a step mask (reference
     GradientMergeOptimizer semantics: moments only advance on merge steps)."""
 
-    def __init__(self, inner, k_steps: int):
+    def __init__(self, inner, k_steps: int, avg: bool = True):
         self.inner = inner
         self.k = k_steps
+        self.avg = avg
         self._step_var = None
 
     def __getattr__(self, item):
@@ -169,8 +170,9 @@ class GradientMergeWrapper:
                 list(p.shape), 0.0, "float32", persistable=True,
                 name=unique_name.generate(f"{p.name}_gm_acc"))
             acc_new = layers.sums([acc, g])
-            avg = layers.scale(acc_new, scale=1.0 / self.k)
-            merged.append((p, avg))
+            eff = (layers.scale(acc_new, scale=1.0 / self.k) if self.avg
+                   else acc_new)
+            merged.append((p, eff))
             # reset accumulator on merge steps
             zeros = layers.zeros_like(acc)
             kept = layers.where(apply_mask, zeros, acc_new)
@@ -212,3 +214,29 @@ class GradientMergeWrapper:
                             outputs={"Out": [orig]},
                             attrs={"op_role": OpRole.Optimize})
         block.program.bump_version()
+
+
+class RecomputeWrapper:
+    """Optimizer wrapper applying activation checkpointing before backward
+    (reference optimizer.py:4547 RecomputeOptimizer; fleet meta-optimizer
+    recompute_optimizer.py). Forward ops collapse into __segment__ ops with
+    remat=True, so only checkpoint activations stay live."""
+
+    def __init__(self, inner, checkpoints):
+        self._inner = inner
+        self._checkpoints = [c.name if hasattr(c, "name") else c
+                             for c in checkpoints]
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = [c.name if hasattr(c, "name") else c
+                             for c in checkpoints]
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ..framework.program import default_main_program
+        apply_recompute(default_main_program(), self._checkpoints)
+        return self._inner.minimize(loss, startup_program, parameter_list,
+                                    no_grad_set)

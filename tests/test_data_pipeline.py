@@ -1,0 +1,212 @@
+"""Data pipeline: native data plane, fluid.dataset, DataLoader, DataFeeder.
+
+Mirrors reference tests test_dataset.py, test_dataloader_*.py,
+test_multiprocess_dataloader_*.py.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.native.dataplane import NativeDataPlane, SlotSpec
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    from paddle_tpu.framework import program as pm, scope as sm, unique_name
+    pm._main_program = pm.Program()
+    pm._startup_program = pm.Program()
+    sm._reset_global_scope()
+    unique_name.switch()
+    paddle.seed(0)
+    yield
+
+
+def _write_multislot(tmp_path, n_files=2, rows=10):
+    """Each row: dense float slot dim 2 (value i, i/2) + id slot dim 3."""
+    paths = []
+    for f in range(n_files):
+        p = tmp_path / f"part-{f}"
+        with open(p, "w") as fh:
+            for i in range(rows):
+                v = f * rows + i
+                fh.write(f"2 {v} {v / 2} 3 {v} {v + 1} {v + 2}\n")
+        paths.append(str(p))
+    return paths
+
+
+def test_native_dataplane_streaming_and_memory(tmp_path):
+    paths = _write_multislot(tmp_path)
+    dp = NativeDataPlane([SlotSpec("x", "float", 2),
+                          SlotSpec("ids", "int64", 3)],
+                         batch_size=4, n_threads=2)
+    assert dp._h is not None, "native dataplane must compile (g++ available)"
+    dp.set_files(paths)
+
+    seen = []
+    for b in dp:
+        assert b["x"].dtype == np.float32 and b["ids"].dtype == np.int64
+        seen.extend(b["x"][:, 0].tolist())
+    assert sorted(seen) == [float(v) for v in range(20)]
+
+    dp.load_into_memory()
+    assert dp.memory_size() == 20
+    dp.local_shuffle(seed=7)
+    shuffled = [v for b in dp for v in b["x"][:, 0].tolist()]
+    assert sorted(shuffled) == [float(v) for v in range(20)]
+    assert shuffled != [float(v) for v in range(20)]  # actually shuffled
+    dp.release_memory()
+    assert dp.memory_size() == 0
+
+
+def test_fluid_dataset_train_from_dataset(tmp_path):
+    paths = _write_multislot(tmp_path, n_files=2, rows=16)
+    x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+    ids = fluid.layers.data(name="ids", shape=[3], dtype="int64")
+    emb = layers.embedding(ids, size=[64, 4])
+    feat = layers.concat([layers.reduce_sum(emb, dim=1), x], axis=1)
+    pred = layers.fc(feat, size=1)
+    loss = layers.reduce_mean(layers.square(pred))
+    paddle.optimizer.SGD(learning_rate=0.01).minimize(loss)
+
+    ds = fluid.dataset.DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(8)
+    ds.set_thread(2)
+    ds.set_use_var([x, ids])
+    ds.set_filelist(paths)
+    ds.load_into_memory()
+    ds.local_shuffle()
+    assert ds.get_memory_data_size() == 32
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    out = exe.train_from_dataset(fluid.default_main_program(), ds,
+                                 fetch_list=[loss])
+    assert out is not None and np.isfinite(out[0]).all()
+
+
+class _SquaresDataset(paddle.io.Dataset):
+    def __init__(self, n=23):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32([i]), np.float32([i * i])
+
+    def __len__(self):
+        return self.n
+
+
+def test_dataloader_single_process_order_and_len():
+    ds = _SquaresDataset(23)
+    dl = paddle.io.DataLoader(ds, batch_size=5, shuffle=False,
+                              drop_last=False)
+    assert len(dl) == 5
+    xs = [b[0] for b in dl]
+    flat = np.concatenate([np.asarray(x).ravel() for x in xs])
+    np.testing.assert_allclose(flat, np.arange(23, dtype=np.float32))
+
+
+def test_dataloader_multiprocess_matches_single():
+    ds = _SquaresDataset(31)
+    dl0 = paddle.io.DataLoader(ds, batch_size=4, shuffle=False,
+                               num_workers=0, use_buffer_reader=False)
+    dl2 = paddle.io.DataLoader(ds, batch_size=4, shuffle=False,
+                               num_workers=2, use_buffer_reader=False)
+    a = np.concatenate([np.asarray(b[0]).ravel() for b in dl0])
+    b = np.concatenate([np.asarray(bb[0]).ravel() for bb in dl2])
+    np.testing.assert_allclose(a, b)  # order preserved across workers
+
+
+class _BadDataset(paddle.io.Dataset):
+    """Module-level: multiprocess workers (forkserver) pickle the dataset."""
+
+    def __getitem__(self, i):
+        if i == 3:
+            raise ValueError("boom-at-3")
+        return np.float32([i])
+
+    def __len__(self):
+        return 8
+
+
+def test_dataloader_worker_error_surfaces():
+    dl = paddle.io.DataLoader(_BadDataset(), batch_size=2, num_workers=2,
+                              use_buffer_reader=False)
+    with pytest.raises(RuntimeError, match="worker"):
+        list(dl)
+
+
+def test_dataloader_shuffle_reshuffles_between_epochs():
+    ds = _SquaresDataset(32)
+    dl = paddle.io.DataLoader(ds, batch_size=4, shuffle=True,
+                              use_buffer_reader=False)
+    e1 = np.concatenate([np.asarray(b[0]).ravel() for b in dl])
+    e2 = np.concatenate([np.asarray(b[0]).ravel() for b in dl])
+    assert sorted(e1.tolist()) == sorted(e2.tolist())
+    assert not np.array_equal(e1, e2)
+
+
+def test_from_generator_feeds_training():
+    x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+    paddle.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+    w_true = np.array([[1.0], [-2.0], [0.5]], np.float32)
+
+    def batch_gen():
+        rng = np.random.RandomState(0)
+        for _ in range(20):
+            xb = rng.randn(16, 3).astype(np.float32)
+            yield xb, xb @ w_true
+
+    loader = fluid.io.DataLoader.from_generator(feed_list=[x, y], capacity=4)
+    loader.set_batch_generator(batch_gen)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for feed in loader:
+        feed = {k: np.asarray(v) for k, v in feed.items()}
+        lv, = exe.run(feed=feed, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_tensor_dataset_random_split_and_feeder():
+    xs = np.arange(20, dtype=np.float32).reshape(10, 2)
+    ys = np.arange(10, dtype=np.int64)
+    ds = paddle.io.TensorDataset([xs, ys])
+    a, b = paddle.io.random_split(ds, [7, 3], generator=0)
+    assert len(a) == 7 and len(b) == 3
+
+    x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+    feeder = fluid.DataFeeder(feed_list=[x, y])
+    feed = feeder.feed([ds[i] for i in range(4)])
+    assert feed["x"].shape == (4, 2)
+    assert feed["y"].shape == (4, 1)
+    assert feed["y"].dtype == np.int64
+
+
+def test_distributed_batch_sampler_shards():
+    ds = _SquaresDataset(20)
+    s0 = paddle.io.DistributedBatchSampler(ds, batch_size=2, num_replicas=2,
+                                           rank=0)
+    s1 = paddle.io.DistributedBatchSampler(ds, batch_size=2, num_replicas=2,
+                                           rank=1)
+    i0 = [i for b in s0 for i in b]
+    i1 = [i for b in s1 for i in b]
+    assert len(i0) == len(i1) == 10
+    assert sorted(i0 + i1) == list(range(20))
+
+
+def test_distributed_batch_sampler_len_is_per_rank():
+    ds = _SquaresDataset(1000 // 10)  # 100 samples
+    s = paddle.io.DistributedBatchSampler(ds, batch_size=10, num_replicas=4,
+                                          rank=0)
+    assert len(s) == len(list(s)) == 3  # ceil(100/4)=25 -> 3 batches of 10

@@ -1,0 +1,209 @@
+"""LR schedulers (static + 2.0 classes) and the extended optimizer zoo.
+
+Mirrors reference tests test_learning_rate_scheduler.py, test_lr_scheduler.py,
+test_adadelta_op.py, test_ftrl_op.py, etc.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    from paddle_tpu.framework import program as pm, scope as sm, unique_name
+    pm._main_program = pm.Program()
+    pm._startup_program = pm.Program()
+    sm._reset_global_scope()
+    unique_name.switch()
+    paddle.seed(0)
+    yield
+
+
+def _run_schedule(lr_var, steps):
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    vals = []
+    for _ in range(steps):
+        v, = exe.run(feed={}, fetch_list=[lr_var])
+        vals.append(float(v[0]))
+    return vals
+
+
+def test_static_exponential_decay():
+    lr = layers.exponential_decay(0.1, decay_steps=2, decay_rate=0.5)
+    got = _run_schedule(lr, 5)
+    want = [0.1 * 0.5 ** (s / 2) for s in range(5)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_static_piecewise_decay():
+    lr = layers.piecewise_decay([2, 4], [0.1, 0.01, 0.001])
+    got = _run_schedule(lr, 6)
+    np.testing.assert_allclose(got, [0.1, 0.1, 0.01, 0.01, 0.001, 0.001],
+                               rtol=1e-6)
+
+
+def test_static_noam_and_warmup():
+    lr = layers.noam_decay(d_model=64, warmup_steps=4, learning_rate=1.0)
+    got = _run_schedule(lr, 6)
+    want = [64 ** -0.5 * min(s ** -0.5, s * 4 ** -1.5)
+            for s in range(1, 7)]
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_static_linear_warmup_wraps_constant():
+    lr = layers.linear_lr_warmup(0.1, warmup_steps=3, start_lr=0.0,
+                                 end_lr=0.1)
+    got = _run_schedule(lr, 5)
+    np.testing.assert_allclose(
+        got, [0.0, 0.1 / 3, 0.2 / 3, 0.1, 0.1], rtol=1e-5, atol=1e-7)
+
+
+def test_static_cosine_polynomial_inverse_natural():
+    lrs = {
+        "cos": layers.cosine_decay(0.1, step_each_epoch=2, epochs=4),
+        "poly": layers.polynomial_decay(0.1, decay_steps=4, end_learning_rate=0.01,
+                                        power=2.0),
+        "inv": layers.inverse_time_decay(0.1, decay_steps=1, decay_rate=0.5),
+        "nat": layers.natural_exp_decay(0.1, decay_steps=1, decay_rate=0.5),
+    }
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    names = list(lrs)
+    rows = []
+    for _ in range(4):
+        rows.append([float(v[0]) for v in
+                     exe.run(feed={}, fetch_list=[lrs[n] for n in names])])
+    for s, row in enumerate(rows):
+        got = dict(zip(names, row))
+        epoch = s // 2
+        assert got["cos"] == pytest.approx(
+            0.05 * (math.cos(epoch * math.pi / 4) + 1), rel=1e-4)
+        frac = min(s, 4) / 4
+        assert got["poly"] == pytest.approx(
+            (0.1 - 0.01) * (1 - frac) ** 2 + 0.01, rel=1e-4)
+        assert got["inv"] == pytest.approx(0.1 / (1 + 0.5 * s), rel=1e-4)
+        assert got["nat"] == pytest.approx(0.1 * math.exp(-0.5 * s), rel=1e-4)
+
+
+def test_lr_scheduler_classes_math():
+    from paddle_tpu.optimizer import lr
+    s = lr.StepDecay(0.1, step_size=2, gamma=0.1)
+    vals = [s()]
+    for _ in range(3):
+        s.step()
+        vals.append(s())
+    np.testing.assert_allclose(vals, [0.1, 0.1, 0.01, 0.01], rtol=1e-6)
+
+    c = lr.CosineAnnealingDecay(0.1, T_max=10)
+    assert c() == pytest.approx(0.1)
+    m = lr.MultiStepDecay(0.1, milestones=[1, 3], gamma=0.5)
+    m.step(), m.step()
+    assert m() == pytest.approx(0.05)
+    w = lr.LinearWarmup(lr.PiecewiseDecay([5], [0.1, 0.01]),
+                        warmup_steps=2, start_lr=0.0, end_lr=0.1)
+    assert w() == pytest.approx(0.0)
+    w.step()
+    assert w() == pytest.approx(0.05)
+    w.step()
+    assert w() == pytest.approx(0.1)
+
+    r = lr.ReduceOnPlateau(0.1, patience=0, factor=0.5, cooldown=0)
+    r.step(1.0)
+    r.step(2.0)   # worse -> bad=1 > patience=0 -> reduce
+    assert r() == pytest.approx(0.05)
+
+
+def test_scheduler_drives_static_training():
+    """LRScheduler bound to a static program: step() changes the LR var."""
+    from paddle_tpu.optimizer import lr
+    x = fluid.layers.data(name="x", shape=[1], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = layers.fc(x, size=1)
+    loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+    sched = lr.StepDecay(0.5, step_size=1, gamma=0.1)
+    opt = paddle.optimizer.SGD(learning_rate=sched)
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    lr_name = opt._lr_var.name
+    from paddle_tpu.framework.scope import global_scope
+    sched._sync_static()
+    assert float(np.asarray(global_scope().find(lr_name))[0]) == \
+        pytest.approx(0.5)
+    sched.step()
+    assert float(np.asarray(global_scope().find(lr_name))[0]) == \
+        pytest.approx(0.05)
+    feed = {"x": np.ones((4, 1), np.float32), "y": np.zeros((4, 1), np.float32)}
+    l0, = exe.run(feed=feed, fetch_list=[loss])
+    assert np.isfinite(l0).all()
+
+
+@pytest.mark.parametrize("make_opt", [
+    lambda: paddle.optimizer.Adadelta(learning_rate=1.0),
+    lambda: paddle.optimizer.DecayedAdagrad(learning_rate=0.5),
+    lambda: paddle.optimizer.Ftrl(learning_rate=0.5),
+    lambda: paddle.optimizer.DGCMomentumOptimizer(learning_rate=0.2,
+                                                  momentum=0.9),
+])
+def test_new_optimizers_converge_quadratic(make_opt):
+    from paddle_tpu.framework import program as pm, scope as sm, unique_name
+    pm._main_program = pm.Program()
+    pm._startup_program = pm.Program()
+    sm._reset_global_scope()
+    unique_name.switch()
+    w = layers.create_parameter([4], "float32", name="w",
+                                default_initializer=paddle.initializer.Constant(3.0))
+    loss = layers.reduce_mean(layers.square(w))
+    opt = make_opt()
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    l0, = exe.run(feed={}, fetch_list=[loss])
+    for _ in range(50):
+        lv, = exe.run(feed={}, fetch_list=[loss])
+    assert float(lv) < float(l0) * 0.9, (float(l0), float(lv))
+
+
+def test_lookahead_sync_moves_slow_weights():
+    w = layers.create_parameter([2], "float32", name="w",
+                                default_initializer=paddle.initializer.Constant(1.0))
+    loss = layers.reduce_mean(layers.square(w))
+    inner = paddle.optimizer.SGD(learning_rate=0.1)
+    opt = paddle.optimizer.LookaheadOptimizer(inner, alpha=0.5, k=2)
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    from paddle_tpu.framework.scope import global_scope
+    for _ in range(4):
+        exe.run(feed={}, fetch_list=[loss])
+        opt.sync()
+    wv = np.asarray(global_scope().find("w"))
+    assert (np.abs(wv) < 1.0).all()   # moved toward 0
+    assert np.isfinite(wv).all()
+
+
+def test_dygraph_scheduler_with_adam():
+    paddle.disable_static()
+    try:
+        import paddle_tpu.nn as nn
+        from paddle_tpu.optimizer import lr
+        lin = nn.Linear(3, 1)
+        sched = lr.ExponentialDecay(0.1, gamma=0.5)
+        opt = paddle.optimizer.Adam(learning_rate=sched,
+                                    parameter_list=list(lin.parameters()))
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        for i in range(3):
+            loss = paddle.tensor.mean(lin(x))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            sched.step()
+        assert sched() == pytest.approx(0.1 * 0.5 ** 3)
+    finally:
+        paddle.enable_static()

@@ -1,0 +1,121 @@
+"""Pipeline parallelism: device_guard + PipelineOptimizer microbatch scan.
+
+Mirrors reference tests test_pipeline.py / fleet pipeline meta-optimizer
+tests (graph-assert style + numeric parity with plain training).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    from paddle_tpu.framework import program as pm, scope as sm, unique_name
+    pm._main_program = pm.Program()
+    pm._startup_program = pm.Program()
+    sm._reset_global_scope()
+    unique_name.switch()
+    paddle.seed(0)
+    yield
+
+
+def _build(lr=0.1):
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    with fluid.device_guard("gpu:0"):
+        h = layers.fc(x, size=8, act="tanh",
+                      param_attr=paddle.ParamAttr(name="w0"),
+                      bias_attr=paddle.ParamAttr(name="b0"))
+    with fluid.device_guard("gpu:1"):
+        pred = layers.fc(h, size=1,
+                         param_attr=paddle.ParamAttr(name="w1"),
+                         bias_attr=paddle.ParamAttr(name="b1"))
+    loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+    return x, y, loss
+
+
+def _feed(b=16):
+    rng = np.random.RandomState(0)
+    xb = rng.randn(b, 4).astype(np.float32)
+    yb = (xb.sum(1, keepdims=True) * 0.5).astype(np.float32)
+    return {"x": xb, "y": yb}
+
+
+def test_device_guard_stage_attrs():
+    _build()
+    ops = fluid.default_main_program().global_block().ops
+    stages = [op.attrs.get("pipeline_stage") for op in ops
+              if op.type == "mul"]
+    assert sorted(stages) == [0, 1]
+
+
+def test_pipeline_matches_plain_sgd():
+    """K microbatches of size b/K with averaged grads == one batch of size b
+    for a linear+MSE model trained by SGD."""
+    from paddle_tpu.framework import program as pm, scope as sm, unique_name
+
+    # plain run
+    x, y, loss = _build()
+    opt = paddle.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    feed = _feed(16)
+    plain_losses = [float(exe.run(feed=feed, fetch_list=[loss])[0])
+                    for _ in range(5)]
+    from paddle_tpu.framework.scope import global_scope
+    plain_w = np.asarray(global_scope().find("w0"))
+
+    # pipeline run (4 microbatches) on a fresh identical program
+    pm._main_program = pm.Program()
+    pm._startup_program = pm.Program()
+    sm._reset_global_scope()
+    unique_name.switch()
+    paddle.seed(0)
+    x, y, loss = _build()
+    opt = paddle.optimizer.PipelineOptimizer(
+        paddle.optimizer.SGD(learning_rate=0.1), num_microbatches=4)
+    opt.minimize(loss)
+    assert fluid.default_main_program()._microbatch_k == 4
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    pipe_losses = [float(exe.run(feed=feed, fetch_list=[loss])[0])
+                   for _ in range(5)]
+    pipe_w = np.asarray(global_scope().find("w0"))
+
+    np.testing.assert_allclose(pipe_losses, plain_losses, rtol=2e-2,
+                               atol=1e-4)
+    np.testing.assert_allclose(pipe_w, plain_w, rtol=2e-2, atol=1e-4)
+
+
+def test_pipeline_rejects_indivisible_batch():
+    x, y, loss = _build()
+    opt = paddle.optimizer.PipelineOptimizer(
+        paddle.optimizer.SGD(learning_rate=0.1), num_microbatches=3)
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    with pytest.raises(Exception, match="divisible|microbatch"):
+        exe.run(feed=_feed(16), fetch_list=[loss])
+
+
+def test_fleet_pipeline_strategy():
+    from paddle_tpu.distributed import fleet
+    x, y, loss = _build()
+    fleet.init(is_collective=True)
+    strategy = fleet.DistributedStrategy()
+    strategy.pipeline = True
+    strategy.pipeline_configs = {"accumulate_steps": 2, "micro_batch_size": 8}
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.Adam(learning_rate=1e-3), strategy)
+    opt.minimize(loss)
+    assert fluid.default_main_program()._microbatch_k == 2
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    l0 = float(exe.run(feed=_feed(16), fetch_list=[loss])[0])
+    for _ in range(10):
+        lv = float(exe.run(feed=_feed(16), fetch_list=[loss])[0])
+    assert np.isfinite(lv) and lv < l0
