@@ -125,3 +125,6 @@ from . import io  # noqa: E402,F401  (paddle.io.DataLoader etc.)
 from . import dataset as _fluid_dataset  # noqa: E402,F401
 from . import jit  # noqa: E402
 from . import inference  # noqa: E402
+from . import profiler  # noqa: E402
+from . import monitor  # noqa: E402
+from .flags import get_flags, set_flags  # noqa: E402

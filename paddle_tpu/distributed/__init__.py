@@ -30,3 +30,5 @@ def spawn(func, args=(), nprocs=-1, **kwargs):
     spans the devices). For true multi-host, use the launcher + env contract.
     """
     return func(*args)
+
+from . import ps  # noqa: E402  (sparse KV service: server/client/embedding)

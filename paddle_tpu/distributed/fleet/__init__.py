@@ -15,8 +15,16 @@ from .base import (fleet, init, is_first_worker, worker_index, worker_num,
                    UserDefinedRoleMaker, Role)
 from ..collective import get_rank, get_world_size
 
+# PS lifecycle is instance-bound on the fleet singleton
+init_server = fleet.init_server
+run_server = fleet.run_server
+stop_server = fleet.stop_server
+init_worker = fleet.init_worker
+stop_worker = fleet.stop_worker
+
 __all__ = [
     "init", "is_first_worker", "worker_index", "worker_num", "is_worker",
     "barrier_worker", "distributed_optimizer", "DistributedStrategy",
     "PaddleCloudRoleMaker", "UserDefinedRoleMaker", "Role", "fleet",
+    "init_server", "run_server", "stop_server", "init_worker", "stop_worker",
 ]

@@ -57,6 +57,10 @@ class PaddleCloudRoleMaker:
     def get_trainer_endpoints(self):
         return self._endpoints
 
+    def get_pserver_endpoints(self):
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        return eps.split(",") if eps else getattr(self, "_server_eps", [])
+
 
 class UserDefinedRoleMaker(PaddleCloudRoleMaker):
     def __init__(self, current_id=0, role=Role.WORKER, worker_num=1,
@@ -65,6 +69,7 @@ class UserDefinedRoleMaker(PaddleCloudRoleMaker):
         self._rank = current_id
         self._size = worker_num
         self._role = role
+        self._server_eps = list(server_endpoints or [])
 
 
 @dataclass
@@ -177,18 +182,72 @@ class _Fleet:
             io.save_inference_model(dirname, feeded_var_names, target_vars,
                                     executor, main_program)
 
-    def init_worker(self):
-        pass
-
-    def init_server(self, *args):
-        pass
+    # -- parameter-server lifecycle (reference fleet init_server/run_server/
+    # init_worker; our server core is native/kvstore.cc via distributed/ps.py)
+    def init_server(self, *args, tables=None, port=None):
+        from ..ps import KVServer
+        from ...framework.program import default_main_program
+        tables = tables or getattr(default_main_program(), "_ps_tables", None)
+        assert tables, ("no sparse tables: build the trainer program with "
+                        "distributed_embedding or pass tables=")
+        self._kv_server = KVServer(tables)
+        if port is None:
+            # THIS server's endpoint: PADDLE_CURRENT_ENDPOINT names it
+            # directly (the reference launch contract), else index the
+            # pserver list by PADDLE_PSERVER_ID
+            eps = (self._role_maker.get_pserver_endpoints()
+                   if self._role_maker and
+                   hasattr(self._role_maker, "get_pserver_endpoints") else [])
+            cur = os.environ.get("PADDLE_CURRENT_ENDPOINT")
+            if cur:
+                port = int(cur.rsplit(":", 1)[1])
+            elif eps:
+                idx = int(os.environ.get("PADDLE_PSERVER_ID", "0"))
+                port = int(eps[min(idx, len(eps) - 1)].rsplit(":", 1)[1])
+            else:
+                port = 0
+        self._kv_port = self._kv_server.start(port)
+        return self._kv_port
 
     def run_server(self):
-        from ...ps.server import run_server
-        run_server()
+        """Blocks serving pulls/pushes (reference ListenAndServOp loop); the
+        C++ server threads do the work, this just parks the process."""
+        import time
+        assert getattr(self, "_kv_server", None) is not None, \
+            "call init_server first"
+        while True:
+            time.sleep(1)
+
+    def stop_server(self):
+        if getattr(self, "_kv_server", None) is not None:
+            self._kv_server.stop()
+
+    def init_worker(self, endpoint=None, a_sync=None):
+        from ..ps import ShardedKVClient
+        from ...framework.program import default_main_program
+        if endpoint is None:
+            eps = (self._role_maker.get_pserver_endpoints()
+                   if self._role_maker and
+                   hasattr(self._role_maker, "get_pserver_endpoints") else [])
+            assert eps, "init_worker: no pserver endpoint configured"
+        else:
+            eps = [endpoint] if isinstance(endpoint, str) else list(endpoint)
+        if a_sync is None:
+            a_sync = bool(self._strategy and self._strategy.a_sync)
+        self._kv_client = ShardedKVClient(eps,
+                                          worker_id=self.worker_index(),
+                                          a_sync=a_sync)
+        hooks = getattr(default_main_program(), "_ps_hooks", None) or []
+        for h in hooks:
+            h.client = self._kv_client
+        return self._kv_client
 
     def stop_worker(self):
-        pass
+        if getattr(self, "_kv_client", None) is not None:
+            if self._kv_client.a_sync:
+                self._kv_client.flush()
+            self._kv_client.close()
+            self._kv_client = None
 
 
 class DistributedOptimizer:
@@ -244,8 +303,25 @@ class DistributedOptimizer:
             opt = PipelineOptimizer(
                 opt, num_microbatches=s.pipeline_configs["accumulate_steps"])
 
-        result = opt.minimize(loss, startup_program, parameter_list,
-                              no_grad_set)
+        ps_hooks = getattr(program, "_ps_hooks", None)
+        if ps_hooks:
+            # PS mode (reference PS program rewriting, trainer_pass.py):
+            # dense params update on-device; the pulled sparse rows only need
+            # their gradient materialized — the executor's post-hook pushes
+            # it to the KV service, which applies the update server-side
+            block = program.global_block()
+            pulled = [block.var(h.pulled_name) for h in ps_hooks]
+            dense = [p for p in program.all_parameters() if p.trainable]
+            pgs = opt.backward(loss, startup_program, dense + pulled,
+                               no_grad_set)
+            pulled_names = {v.name for v in pulled}
+            dense_pgs = [(p, g) for p, g in pgs
+                         if p.name not in pulled_names]
+            opt.apply_gradients(dense_pgs)
+            result = ([], dense_pgs)
+        else:
+            result = opt.minimize(loss, startup_program, parameter_list,
+                                  no_grad_set)
 
         # SPMD attach: data axis + TP rules (+ ZeRO-1 optimizer-state sharding)
         rules = s.tensor_parallel_rules or ShardingRules()

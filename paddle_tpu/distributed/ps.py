@@ -1,0 +1,306 @@
+"""Parameter-server mode: sparse embedding tables on a host KV service.
+
+Reference counterparts: the PS stack of §2.4/§2.8 —
+operators/distributed/large_scale_kv.h (huge sparse tables),
+parameter_prefetch.cc (pull rows by id before the step),
+communicator.h:268 (async merge+send), listen_and_serv_op.cc (server loop),
+heart_beat_monitor.cc (lost-worker detection), and the fleet PS runtime
+(fleet/runtime/parameter_server_runtime.py).
+
+TPU-native split (SURVEY §7): the DENSE math stays in the jitted XLA step;
+only the sparse table lives host-side in the C++ KV service
+(native/kvstore.cc). Per step the trainer:
+  1. pulls the batch's unique rows over TCP,
+  2. feeds them as a dense [uniq, dim] input to the XLA step,
+  3. fetches that input's gradient and pushes it back (sync) or hands it to
+     the client's merging flush thread (a_sync — geo/async SGD semantics).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..native import load_native
+
+
+def _lib():
+    lib = load_native("kvstore")
+    if lib is None:
+        raise RuntimeError("native kvstore failed to build (g++ required)")
+    if not getattr(lib, "_kv_configured", False):
+        lib.kvs_create.restype = ctypes.c_void_p
+        lib.kvs_create.argtypes = [ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+                                   ctypes.POINTER(ctypes.c_float),
+                                   ctypes.c_uint64]
+        lib.kvs_start.restype = ctypes.c_int
+        lib.kvs_start.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.kvs_stop.argtypes = [ctypes.c_void_p]
+        lib.kvs_lost_workers.restype = ctypes.c_int
+        lib.kvs_lost_workers.argtypes = [ctypes.c_void_p, ctypes.c_double,
+                                         ctypes.POINTER(ctypes.c_int),
+                                         ctypes.c_int]
+        lib.kvs_destroy.argtypes = [ctypes.c_void_p]
+        lib.kvc_connect.restype = ctypes.c_void_p
+        lib.kvc_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                    ctypes.c_int, ctypes.c_int]
+        for name in ("kvc_pull", "kvc_push"):
+            getattr(lib, name).restype = ctypes.c_int
+        lib.kvc_pull.argtypes = [ctypes.c_void_p, ctypes.c_uint,
+                                 ctypes.POINTER(ctypes.c_longlong),
+                                 ctypes.c_longlong,
+                                 ctypes.POINTER(ctypes.c_float), ctypes.c_uint]
+        lib.kvc_push.argtypes = [ctypes.c_void_p, ctypes.c_uint,
+                                 ctypes.POINTER(ctypes.c_longlong),
+                                 ctypes.c_longlong,
+                                 ctypes.POINTER(ctypes.c_float),
+                                 ctypes.c_uint, ctypes.c_float]
+        lib.kvc_push_async.argtypes = lib.kvc_push.argtypes
+        lib.kvc_flush.argtypes = [ctypes.c_void_p]
+        lib.kvc_ping.restype = ctypes.c_int
+        lib.kvc_ping.argtypes = [ctypes.c_void_p]
+        lib.kvc_table_size.restype = ctypes.c_longlong
+        lib.kvc_table_size.argtypes = [ctypes.c_void_p, ctypes.c_uint]
+        lib.kvc_save.restype = ctypes.c_int
+        lib.kvc_save.argtypes = [ctypes.c_void_p, ctypes.c_uint,
+                                 ctypes.c_char_p]
+        lib.kvc_load.restype = ctypes.c_int
+        lib.kvc_load.argtypes = [ctypes.c_void_p, ctypes.c_uint,
+                                 ctypes.c_char_p]
+        lib.kvc_close.argtypes = [ctypes.c_void_p]
+        lib._kv_configured = True
+    return lib
+
+
+class SparseTableConfig:
+    def __init__(self, name: str, dim: int, init_scale: float = 0.01):
+        self.name = name
+        self.dim = int(dim)
+        self.init_scale = float(init_scale)
+
+
+class KVServer:
+    """The pserver process core (reference ListenAndServOp event loop)."""
+
+    def __init__(self, tables: List[SparseTableConfig], seed: int = 0):
+        self._lib = _lib()
+        self.tables = list(tables)
+        dims = (ctypes.c_int * len(tables))(*[t.dim for t in tables])
+        scales = (ctypes.c_float * len(tables))(
+            *[t.init_scale for t in tables])
+        self._h = self._lib.kvs_create(len(tables), dims, scales, seed)
+        self.port = None
+
+    def start(self, port: int = 0) -> int:
+        self.port = int(self._lib.kvs_start(self._h, port))
+        assert self.port > 0, "kv server failed to bind"
+        return self.port
+
+    def lost_workers(self, timeout_s: float = 60.0) -> List[int]:
+        out = (ctypes.c_int * 1024)()
+        n = self._lib.kvs_lost_workers(self._h, timeout_s, out, 1024)
+        return list(out[:n])
+
+    def stop(self):
+        if self._h is not None:
+            self._lib.kvs_stop(self._h)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None) is not None:
+                self._lib.kvs_stop(self._h)
+                self._lib.kvs_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+class KVClient:
+    """Trainer-side client (reference Communicator + RPCClient)."""
+
+    def __init__(self, host: str, port: int, worker_id: int = 0,
+                 a_sync: bool = False, flush_ms: int = 50):
+        self._lib = _lib()
+        self.a_sync = a_sync
+        self._h = self._lib.kvc_connect(host.encode(), int(port),
+                                        int(worker_id),
+                                        int(flush_ms) if a_sync else 0)
+        if not self._h:
+            raise ConnectionError(f"cannot reach pserver {host}:{port}")
+
+    def pull(self, table: int, keys: np.ndarray, dim: int) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, np.int64)
+        out = np.empty((len(keys), dim), np.float32)
+        rc = self._lib.kvc_pull(
+            self._h, table,
+            keys.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)), len(keys),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), dim)
+        if rc != 0:
+            raise IOError("kv pull failed")
+        return out
+
+    def push(self, table: int, keys: np.ndarray, grads: np.ndarray,
+             lr: float):
+        keys = np.ascontiguousarray(keys, np.int64)
+        grads = np.ascontiguousarray(grads, np.float32)
+        fn = (self._lib.kvc_push_async if self.a_sync else self._lib.kvc_push)
+        rc = fn(self._h, table,
+                keys.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+                len(keys),
+                grads.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                grads.shape[1], float(lr))
+        if not self.a_sync and rc != 0:
+            raise IOError("kv push failed")
+
+    def flush(self):
+        self._lib.kvc_flush(self._h)
+
+    def ping(self) -> bool:
+        return self._lib.kvc_ping(self._h) == 0
+
+    def table_size(self, table: int) -> int:
+        return int(self._lib.kvc_table_size(self._h, table))
+
+    def save(self, table: int, path: str):
+        assert self._lib.kvc_save(self._h, table, path.encode()) == 0
+
+    def load(self, table: int, path: str):
+        assert self._lib.kvc_load(self._h, table, path.encode()) == 0
+
+    def close(self):
+        if self._h:
+            self._lib.kvc_close(self._h)
+            self._h = None
+
+
+class ShardedKVClient:
+    """Key-sharded client over multiple pservers (reference ps_dispatcher.py
+    round-robin param placement; here rows shard by key hash, the
+    large-scale-KV convention). Exposes the same pull/push surface as
+    KVClient so hooks are agnostic."""
+
+    def __init__(self, endpoints: List[str], worker_id: int = 0,
+                 a_sync: bool = False):
+        assert endpoints, "ShardedKVClient needs at least one endpoint"
+        self.clients = []
+        for ep in endpoints:
+            host, port = ep.rsplit(":", 1)
+            self.clients.append(KVClient(host, int(port), worker_id,
+                                         a_sync=a_sync))
+        self.a_sync = a_sync
+
+    def _shard(self, keys: np.ndarray):
+        return (keys % len(self.clients)).astype(np.int64)
+
+    def pull(self, table: int, keys: np.ndarray, dim: int) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, np.int64)
+        if len(self.clients) == 1:
+            return self.clients[0].pull(table, keys, dim)
+        out = np.empty((len(keys), dim), np.float32)
+        shard = self._shard(keys)
+        for s, c in enumerate(self.clients):
+            m = shard == s
+            if m.any():
+                out[m] = c.pull(table, keys[m], dim)
+        return out
+
+    def push(self, table: int, keys: np.ndarray, grads: np.ndarray,
+             lr: float):
+        keys = np.ascontiguousarray(keys, np.int64)
+        if len(self.clients) == 1:
+            return self.clients[0].push(table, keys, grads, lr)
+        shard = self._shard(keys)
+        for s, c in enumerate(self.clients):
+            m = shard == s
+            if m.any():
+                c.push(table, keys[m], np.ascontiguousarray(grads[m]), lr)
+
+    def flush(self):
+        for c in self.clients:
+            c.flush()
+
+    def ping(self):
+        return all(c.ping() for c in self.clients)
+
+    def table_size(self, table: int) -> int:
+        return sum(c.table_size(table) for c in self.clients)
+
+    def close(self):
+        for c in self.clients:
+            c.close()
+
+
+# ---------------------------------------------------------------------------
+# program-level integration: distributed embedding pulls/pushes around the
+# jitted step (reference parameter_prefetch.cc + distributed_lookup_table op)
+# ---------------------------------------------------------------------------
+
+class _PsHook:
+    """Pre/post hook pair the Executor fires around each run."""
+
+    def __init__(self, table_idx: int, ids_name: str, pulled_name: str,
+                 grad_name: str, dim: int, lr: float):
+        self.table_idx = table_idx
+        self.ids_name = ids_name
+        self.pulled_name = pulled_name
+        self.grad_name = grad_name
+        self.dim = dim
+        self.lr = lr
+        self.client: Optional[KVClient] = None
+        self._last_uniq = None
+
+    def pre(self, feed: dict) -> dict:
+        ids = np.asarray(feed[self.ids_name]).reshape(-1)
+        uniq, inverse = np.unique(ids, return_inverse=True)
+        rows = self.client.pull(self.table_idx, uniq, self.dim)
+        # pad the row count to a power-of-two bucket: the jitted step
+        # specializes on feed shapes, so raw unique counts would recompile
+        # every batch (same trick as the reference's fixed-capacity pull
+        # buffers in parameter_prefetch)
+        bucket = max(8, 1 << int(np.ceil(np.log2(max(len(uniq), 1)))))
+        padded = np.zeros((bucket, self.dim), np.float32)
+        padded[:len(uniq)] = rows
+        self._last_uniq = uniq
+        batch_shape = np.asarray(feed[self.ids_name]).shape
+        return {self.pulled_name: padded,
+                self.ids_name + "@inverse":
+                    inverse.reshape(batch_shape).astype(np.int32)}
+
+    def post(self, fetched: dict):
+        g = fetched.get(self.grad_name)
+        if g is not None and self._last_uniq is not None:
+            g = np.asarray(g)[:len(self._last_uniq)]
+            self.client.push(self.table_idx, self._last_uniq, g, self.lr)
+
+
+def distributed_embedding(ids, table_name: str, dim: int,
+                          lr: float = 0.1):
+    """Sparse embedding served by the KV service. Builds:
+    pulled[uniq, dim] (fed by the pre-hook) gathered by ids@inverse — the
+    gather runs on-device, the unique/pull on host (reference
+    distributed_lookup_table_op.cc semantics)."""
+    from ..layer_helper import LayerHelper
+    from ..framework.program import default_main_program
+    program = default_main_program()
+    helper = LayerHelper("distributed_embedding")
+    block = program.global_block()
+
+    pulled = block.create_var(name=f"{table_name}@pulled", shape=(-1, dim),
+                              dtype="float32", is_data=True)
+    pulled.stop_gradient = False
+    inverse = block.create_var(name=ids.name + "@inverse",
+                               shape=tuple(ids.shape), dtype="int32",
+                               is_data=True)
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op("gather", inputs={"X": [pulled], "Index": [inverse]},
+                     outputs={"Out": [out]})
+    hooks = getattr(program, "_ps_hooks", None)
+    if hooks is None:
+        hooks = program._ps_hooks = []
+    hooks.append(_PsHook(len(hooks), ids.name, pulled.name,
+                         pulled.name + "@GRAD", dim, lr))
+    program._ps_tables = getattr(program, "_ps_tables", [])
+    program._ps_tables.append(SparseTableConfig(table_name, dim))
+    return out

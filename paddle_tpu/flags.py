@@ -1,0 +1,67 @@
+"""Global flags registry.
+
+Reference counterpart: the gflags tier (platform/flags.cc, 30+ flags,
+re-exported via pybind/global_value_getter_setter.cc and the
+fluid/__init__.py __bootstrap__ env whitelist). One typed registry here;
+FLAGS_* environment variables seed the initial values at import, matching
+the reference's interpreter-start semantics. Device/allocator flags that XLA
+owns are accepted as documented no-ops.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_DEFS: Dict[str, tuple] = {
+    # (default, help)
+    "FLAGS_check_nan_inf": (False, "scan step outputs/state for NaN/Inf "
+                                   "(reference operator.cc:1129)"),
+    "FLAGS_check_nan_inf_level": (0, "0: raise on first non-finite; "
+                                     "1: warn only"),
+    "FLAGS_eager_delete_tensor_gb": (0.0, "no-op: XLA owns HBM lifetimes"),
+    "FLAGS_allocator_strategy": ("auto_growth", "no-op: XLA runtime "
+                                                "allocates"),
+    "FLAGS_fraction_of_gpu_memory_to_use": (0.92, "no-op on TPU"),
+    "FLAGS_paddle_num_threads": (1, "no-op: XLA threadpool"),
+    "FLAGS_use_pinned_memory": (True, "no-op"),
+    "FLAGS_benchmark": (False, "sync + time each executor run"),
+    "FLAGS_profile_start_step": (-1, "auto-start profiler at this step"),
+    "FLAGS_profile_stop_step": (-1, "auto-stop profiler at this step"),
+    "FLAGS_tensor_array_capacity": (128, "default LoDTensorArray capacity"),
+}
+
+_values: Dict[str, Any] = {}
+
+
+def _coerce(default, raw: str):
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    return type(default)(raw)
+
+
+def _init():
+    for name, (default, _help) in _DEFS.items():
+        raw = os.environ.get(name)
+        _values[name] = _coerce(default, raw) if raw is not None else default
+
+
+_init()
+
+
+def get_flags(names):
+    if isinstance(names, str):
+        names = [names]
+    return {n: _values.get(n) for n in names}
+
+
+def set_flags(flags: Dict[str, Any]):
+    for name, value in flags.items():
+        if name not in _DEFS:
+            raise KeyError(f"unknown flag {name!r}; known: {sorted(_DEFS)}")
+        default = _DEFS[name][0]
+        _values[name] = (_coerce(default, value)
+                         if isinstance(value, str) else type(default)(value))
+
+
+def flag(name: str):
+    return _values[name]

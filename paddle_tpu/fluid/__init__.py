@@ -37,3 +37,7 @@ class core:
 
 from .. import dataset  # noqa: E402  (fluid.dataset.DatasetFactory)
 from ..dataloader import DataFeeder  # noqa: E402
+
+
+from ..flags import get_flags, set_flags  # noqa: E402  (fluid.set_flags)
+from .. import profiler  # noqa: E402     (fluid.profiler.profiler context)

@@ -315,6 +315,17 @@ class Executor:
             if not gb.has_var(n):
                 raise ValueError(
                     f"fetch target {n!r} is not a variable of this program")
+
+        # parameter-server hooks (distributed_embedding): pull sparse rows
+        # before the step, push their grads after (distributed/ps.py)
+        ps_hooks = getattr(program, "_ps_hooks", None) or []
+        n_user_fetch = len(fetch_names)
+        if ps_hooks:
+            feed = dict(feed)
+            for h in ps_hooks:
+                feed.update(h.pre(feed))
+                if gb.has_var(h.grad_name) and h.grad_name not in fetch_names:
+                    fetch_names.append(h.grad_name)
         feed_vals = {}
         block = program.global_block()
         for name, value in feed.items():
@@ -352,9 +363,35 @@ class Executor:
 
         state = {n: scope.find(n) for n in state_names}
         rng_key = _next_rng_key(scope, program.random_seed)
-        fetches, new_state = compiled(state, feed_vals, rng_key)
+        from .. import profiler as _prof
+        from ..flags import flag
+        self._step_counter = getattr(self, "_step_counter", 0) + 1
+        if self._step_counter == flag("FLAGS_profile_start_step"):
+            _prof.start_profiler()
+        benchmark = flag("FLAGS_benchmark")
+        if _prof._enabled or benchmark:
+            import time as _time
+            t0 = _time.perf_counter()
+            with _prof.RecordEvent(f"executor_run#{op_count(program)}ops"):
+                fetches, new_state = compiled(state, feed_vals, rng_key)
+                if benchmark:  # sync so the wall time is the device time
+                    jax.block_until_ready(fetches)
+            if benchmark:
+                print(f"[benchmark] step {self._step_counter}: "
+                      f"{(_time.perf_counter() - t0) * 1000:.3f} ms")
+        else:
+            fetches, new_state = compiled(state, feed_vals, rng_key)
+        if self._step_counter == flag("FLAGS_profile_stop_step"):
+            _prof.stop_profiler()
         for n, v in new_state.items():
             scope.set(n, v)
+        if flag("FLAGS_check_nan_inf"):
+            _check_nan_inf(dict(zip(fetch_names, fetches)), new_state)
+        if ps_hooks:
+            fetched_by_name = dict(zip(fetch_names, fetches))
+            for h in ps_hooks:
+                h.post(fetched_by_name)
+            fetches = fetches[:n_user_fetch]
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches
@@ -391,6 +428,33 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+
+
+def op_count(program) -> int:
+    return sum(len(b.ops) for b in program.blocks)
+
+
+def _check_nan_inf(fetched: dict, new_state: dict):
+    """FLAGS_check_nan_inf (reference operator.cc:1129 post-op scan +
+    nan_inf_utils_detail.cc). The block runs as one fused program, so the
+    scan covers its observable outputs: fetches + written state, reported by
+    variable name."""
+    import jax.numpy as jnp
+    from ..flags import flag
+    bad = []
+    for group in (fetched, new_state):
+        for n, v in group.items():
+            if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating):
+                if not bool(jnp.isfinite(v).all()):
+                    bad.append(n)
+    if bad:
+        msg = (f"NaN/Inf detected in variables {bad} "
+               "(FLAGS_check_nan_inf)")
+        if flag("FLAGS_check_nan_inf_level") >= 1:
+            import warnings
+            warnings.warn(msg)
+        else:
+            raise FloatingPointError(msg)
 
 
 def _next_rng_key(scope: Scope, seed: int):

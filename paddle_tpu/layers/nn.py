@@ -799,15 +799,21 @@ def pow(x, factor=1.0, name=None):
 
 
 def fused_attention(q, k, v, mask=None, scale=None, dropout=0.0,
-                    causal=False, name=None):
+                    causal=False, name=None, sequence_parallel=False,
+                    sp_mode="ring"):
     """Fused multi-head attention on [B, nh, S, hd] tensors (reference
-    fused/multihead_matmul_op.cu); pallas flash kernel on TPU."""
+    fused/multihead_matmul_op.cu); pallas flash kernel on TPU. With
+    sequence_parallel=True the op runs ring attention (sp_mode="ring") or
+    Ulysses all-to-all (sp_mode="ulysses") over the mesh's sp axis — the
+    long-context path the reference lacks (parallel/ring_attention.py)."""
     helper = LayerHelper("fused_attention")
     out = helper.create_variable_for_type_inference(q.dtype)
     inputs = {"Q": [q], "K": [k], "V": [v]}
     if mask is not None:
         inputs["Mask"] = [mask]
-    attrs = {"dropout": dropout, "causal": causal, "is_test": False}
+    attrs = {"dropout": dropout, "causal": causal, "is_test": False,
+             "sequence_parallel": bool(sequence_parallel),
+             "sp_mode": sp_mode}
     if scale is not None:
         attrs["scale"] = scale
     helper.append_op("fused_attention", inputs=inputs,

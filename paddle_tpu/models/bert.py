@@ -32,6 +32,8 @@ class BertConfig:
     hidden_dropout: float = 0.1
     attention_dropout: float = 0.1
     seq_len: int = 128
+    sequence_parallel: bool = False   # ring attention over the sp mesh axis
+    sp_mode: str = "ring"
 
     @staticmethod
     def base():
@@ -72,9 +74,15 @@ def encoder_layer(x, cfg: BertConfig, idx: int, attn_mask=None):
         return layers.transpose(t, [0, 2, 1, 3])  # [B, nh, S, hd]
 
     q, k, v = heads(q), heads(k), heads(v)
-    ctx = layers.fused_attention(q, k, v, mask=attn_mask,
-                                 scale=1.0 / math.sqrt(hd),
-                                 dropout=cfg.attention_dropout)
+    if cfg.sequence_parallel and cfg.attention_dropout and idx == 0:
+        import warnings
+        warnings.warn("sequence_parallel attention does not support "
+                      "attention_dropout; running with dropout=0.0 "
+                      "(set attention_dropout=0.0 to silence)")
+    ctx = layers.fused_attention(
+        q, k, v, mask=attn_mask, scale=1.0 / math.sqrt(hd),
+        dropout=0.0 if cfg.sequence_parallel else cfg.attention_dropout,
+        sequence_parallel=cfg.sequence_parallel, sp_mode=cfg.sp_mode)
     ctx = layers.transpose(ctx, [0, 2, 1, 3])
     ctx = layers.reshape(ctx, [0, 0, h])
     proj = layers.fc(ctx, h, num_flatten_dims=2,

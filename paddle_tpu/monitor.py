@@ -1,0 +1,51 @@
+"""Stat registry (reference platform/monitor.h:34-154 STAT_ADD/STAT_GET:
+named int/float counters exported through pybind; e.g. GPU mem watermarks).
+Host-side counters here; device memory watermarks come from the XLA client.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_lock = threading.Lock()
+_stats: Dict[str, float] = {}
+
+
+def stat_add(name: str, value: float = 1):
+    with _lock:
+        _stats[name] = _stats.get(name, 0) + value
+
+
+def stat_set(name: str, value: float):
+    with _lock:
+        _stats[name] = value
+
+
+def stat_get(name: str) -> float:
+    with _lock:
+        return _stats.get(name, 0)
+
+
+def stat_reset(name: str = None):
+    with _lock:
+        if name is None:
+            _stats.clear()
+        else:
+            _stats.pop(name, None)
+
+
+def all_stats() -> Dict[str, float]:
+    with _lock:
+        return dict(_stats)
+
+
+def device_memory_stats() -> Dict[str, int]:
+    """HBM stats from the runtime (reference STAT_GPU mem watermark)."""
+    try:
+        import jax
+        d = jax.devices()[0]
+        ms = d.memory_stats() or {}
+        return {k: int(v) for k, v in ms.items()
+                if isinstance(v, (int, float))}
+    except Exception:
+        return {}

@@ -1,0 +1,565 @@
+// Native sparse KV service: sharded embedding tables over TCP.
+//
+// TPU-native equivalent of the reference's parameter-server core:
+// large-scale sparse tables (operators/distributed/large_scale_kv.h),
+// variable send/get RPC (grpc_client.h:211/grpc_server.cc — here a
+// dependency-free length-prefixed binary protocol over TCP; gRPC buys
+// nothing for fixed-shape tensors), the pserver event loop
+// (listen_and_serv_op.cc RunAsyncLoop), the async grad-merging client
+// (communicator.h:268 AsyncCommunicator's merge+send thread), and the
+// worker heartbeat monitor (heart_beat_monitor.cc:57). Dense training rides
+// XLA/ICI; this host-side C++ path exists exactly where the reference's
+// does — trillion-row embeddings that cannot live in HBM.
+//
+// Lazy row init: splitmix64(seed, key, col) hashed uniform in
+// [-init_scale, init_scale] — deterministic across pulls and shards, so a
+// re-pulled never-pushed row is stable (the reference initializes on first
+// access too, large_scale_kv.h entry init).
+//
+// Wire format (little-endian):
+//   request : u8 op | u32 table | u64 n | u32 dim | payload
+//   response: u64 n_bytes | payload
+//   PULL(1): keys i64[n]            -> f32[n*dim]
+//   PUSH(2): lr f32, keys i64[n], grads f32[n*dim] -> u8 ok (w -= lr*g)
+//   PING(3): worker_id u32          -> u8 ok       (heartbeat)
+//   SIZE(4):                        -> u64 rows
+//   SAVE(5)/LOAD(6): path bytes     -> u8 ok
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kShards = 32;
+
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+struct Table {
+  int dim = 0;
+  float init_scale = 0.0f;
+  uint64_t seed = 0;
+  std::unordered_map<int64_t, std::vector<float>> shard[kShards];
+  std::mutex mu[kShards];
+
+  void InitRow(int64_t key, std::vector<float>* row) const {
+    row->resize(dim);
+    for (int j = 0; j < dim; ++j) {
+      uint64_t h = splitmix64(seed ^ splitmix64((uint64_t)key) ^
+                              splitmix64((uint64_t)j + 0x1234));
+      double u = (double)(h >> 11) / (double)(1ULL << 53);  // [0,1)
+      (*row)[j] = (float)((u * 2.0 - 1.0) * init_scale);
+    }
+  }
+
+  void Pull(const int64_t* keys, uint64_t n, float* out) {
+    for (uint64_t i = 0; i < n; ++i) {
+      int64_t k = keys[i];
+      int s = (int)(splitmix64((uint64_t)k) % kShards);
+      std::lock_guard<std::mutex> lk(mu[s]);
+      auto it = shard[s].find(k);
+      if (it == shard[s].end()) {
+        std::vector<float> row;
+        InitRow(k, &row);
+        it = shard[s].emplace(k, std::move(row)).first;
+      }
+      std::memcpy(out + i * dim, it->second.data(), dim * sizeof(float));
+    }
+  }
+
+  void Push(const int64_t* keys, uint64_t n, const float* grads, float lr) {
+    for (uint64_t i = 0; i < n; ++i) {
+      int64_t k = keys[i];
+      int s = (int)(splitmix64((uint64_t)k) % kShards);
+      std::lock_guard<std::mutex> lk(mu[s]);
+      auto it = shard[s].find(k);
+      if (it == shard[s].end()) {
+        std::vector<float> row;
+        InitRow(k, &row);
+        it = shard[s].emplace(k, std::move(row)).first;
+      }
+      float* w = it->second.data();
+      const float* g = grads + i * dim;
+      for (int j = 0; j < dim; ++j) w[j] -= lr * g[j];
+    }
+  }
+
+  uint64_t Size() {
+    uint64_t total = 0;
+    for (int s = 0; s < kShards; ++s) {
+      std::lock_guard<std::mutex> lk(mu[s]);
+      total += shard[s].size();
+    }
+    return total;
+  }
+
+  bool Save(const std::string& path) {
+    std::ofstream f(path, std::ios::binary);
+    if (!f) return false;
+    uint32_t d = dim;
+    f.write((char*)&d, 4);
+    for (int s = 0; s < kShards; ++s) {
+      std::lock_guard<std::mutex> lk(mu[s]);
+      for (auto& kv : shard[s]) {
+        f.write((char*)&kv.first, 8);
+        f.write((char*)kv.second.data(), dim * sizeof(float));
+      }
+    }
+    return (bool)f;
+  }
+
+  bool Load(const std::string& path) {
+    std::ifstream f(path, std::ios::binary);
+    if (!f) return false;
+    uint32_t d = 0;
+    f.read((char*)&d, 4);
+    if (d != (uint32_t)dim) return false;
+    int64_t key;
+    std::vector<float> row(dim);
+    while (f.read((char*)&key, 8)) {
+      if (!f.read((char*)row.data(), dim * sizeof(float))) break;
+      int s = (int)(splitmix64((uint64_t)key) % kShards);
+      std::lock_guard<std::mutex> lk(mu[s]);
+      shard[s][key] = row;
+    }
+    return true;
+  }
+};
+
+static bool SendAll(int fd, const void* buf, size_t n) {
+  const char* p = (const char*)buf;
+  while (n) {
+    ssize_t w = ::send(fd, p, n, 0);
+    if (w <= 0) return false;
+    p += w;
+    n -= (size_t)w;
+  }
+  return true;
+}
+
+static bool RecvAll(int fd, void* buf, size_t n) {
+  char* p = (char*)buf;
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+class KVServer {
+ public:
+  KVServer(int n_tables, const int* dims, const float* init_scales,
+           uint64_t seed) {
+    tables_.resize(n_tables);
+    for (int t = 0; t < n_tables; ++t) {
+      tables_[t] = new Table();
+      tables_[t]->dim = dims[t];
+      tables_[t]->init_scale = init_scales ? init_scales[t] : 0.01f;
+      tables_[t]->seed = seed ^ splitmix64((uint64_t)t + 7);
+    }
+  }
+
+  ~KVServer() {
+    Stop();
+    for (auto* t : tables_) delete t;
+  }
+
+  int Start(int port) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return -1;
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);  // remote trainers must reach us
+    addr.sin_port = htons((uint16_t)port);
+    if (::bind(listen_fd_, (sockaddr*)&addr, sizeof(addr)) < 0) return -1;
+    socklen_t len = sizeof(addr);
+    getsockname(listen_fd_, (sockaddr*)&addr, &len);
+    port_ = ntohs(addr.sin_port);
+    if (::listen(listen_fd_, 64) < 0) return -1;
+    running_.store(true);
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    return port_;
+  }
+
+  void Stop() {
+    if (!running_.exchange(false)) return;
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    if (accept_thread_.joinable()) accept_thread_.join();
+    {
+      // unblock Serve threads parked in recv() on live client sockets —
+      // without this, join below deadlocks whenever a client is connected
+      std::lock_guard<std::mutex> lk(conn_mu_);
+      for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+    }
+    std::lock_guard<std::mutex> lk(conn_mu_);
+    for (auto& th : conn_threads_) {
+      if (th.joinable()) th.join();
+    }
+    conn_threads_.clear();
+    conn_fds_.clear();
+  }
+
+  int LostWorkers(double timeout_s, int* out, int cap) {
+    auto now = std::chrono::steady_clock::now();
+    std::lock_guard<std::mutex> lk(hb_mu_);
+    int n = 0;
+    for (auto& kv : heartbeats_) {
+      double silent =
+          std::chrono::duration<double>(now - kv.second).count();
+      if (silent > timeout_s && n < cap) out[n++] = kv.first;
+    }
+    return n;
+  }
+
+  Table* table(uint32_t t) {
+    return t < tables_.size() ? tables_[t] : nullptr;
+  }
+
+  int port_ = 0;
+
+ private:
+  void AcceptLoop() {
+    while (running_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        if (!running_.load()) break;
+        continue;
+      }
+      std::lock_guard<std::mutex> lk(conn_mu_);
+      conn_fds_.push_back(fd);
+      conn_threads_.emplace_back([this, fd] {
+        try {
+          Serve(fd);
+        } catch (...) {
+          ::close(fd);  // a bad request drops its connection, not the server
+        }
+      });
+    }
+  }
+
+  void Serve(int fd) {
+    constexpr uint64_t kMaxRows = 1ULL << 27;  // request-size sanity cap
+    std::vector<char> payload;
+    while (running_.load()) {
+      struct __attribute__((packed)) {
+        uint8_t op;
+        uint32_t table;
+        uint64_t n;
+        uint32_t dim;
+      } hdr;
+      if (!RecvAll(fd, &hdr, sizeof(hdr))) break;
+      if (hdr.n > kMaxRows) break;  // malformed/desynced client
+      Table* tb = table(hdr.table);
+      if (hdr.op == 1 && tb) {  // PULL
+        payload.resize(hdr.n * 8);
+        if (!RecvAll(fd, payload.data(), payload.size())) break;
+        std::vector<float> out(hdr.n * tb->dim);
+        tb->Pull((const int64_t*)payload.data(), hdr.n, out.data());
+        uint64_t nb = out.size() * sizeof(float);
+        if (!SendAll(fd, &nb, 8) || !SendAll(fd, out.data(), nb)) break;
+      } else if (hdr.op == 2 && tb) {  // PUSH
+        float lr;
+        if (!RecvAll(fd, &lr, 4)) break;
+        payload.resize(hdr.n * 8 + hdr.n * tb->dim * sizeof(float));
+        if (!RecvAll(fd, payload.data(), payload.size())) break;
+        tb->Push((const int64_t*)payload.data(), hdr.n,
+                 (const float*)(payload.data() + hdr.n * 8), lr);
+        uint64_t nb = 1;
+        uint8_t ok = 1;
+        if (!SendAll(fd, &nb, 8) || !SendAll(fd, &ok, 1)) break;
+      } else if (hdr.op == 3) {  // PING
+        uint32_t wid;
+        if (!RecvAll(fd, &wid, 4)) break;
+        {
+          std::lock_guard<std::mutex> lk(hb_mu_);
+          heartbeats_[(int)wid] = std::chrono::steady_clock::now();
+        }
+        uint64_t nb = 1;
+        uint8_t ok = 1;
+        if (!SendAll(fd, &nb, 8) || !SendAll(fd, &ok, 1)) break;
+      } else if (hdr.op == 4 && tb) {  // SIZE
+        uint64_t nb = 8, rows = tb->Size();
+        if (!SendAll(fd, &nb, 8) || !SendAll(fd, &rows, 8)) break;
+      } else if ((hdr.op == 5 || hdr.op == 6) && tb) {  // SAVE/LOAD
+        payload.resize(hdr.n);
+        if (!RecvAll(fd, payload.data(), hdr.n)) break;
+        std::string path(payload.data(), hdr.n);
+        bool ok = hdr.op == 5 ? tb->Save(path) : tb->Load(path);
+        uint64_t nb = 1;
+        uint8_t r = ok ? 1 : 0;
+        if (!SendAll(fd, &nb, 8) || !SendAll(fd, &r, 1)) break;
+      } else {
+        break;  // unknown op / bad table: drop connection
+      }
+    }
+    ::close(fd);
+  }
+
+  std::vector<Table*> tables_;
+  std::atomic<bool> running_{false};
+  int listen_fd_ = -1;
+  std::thread accept_thread_;
+  std::mutex conn_mu_;
+  std::vector<std::thread> conn_threads_;
+  std::vector<int> conn_fds_;
+  std::mutex hb_mu_;
+  std::map<int, std::chrono::steady_clock::time_point> heartbeats_;
+};
+
+class KVClient {
+ public:
+  KVClient(const char* host, int port, int worker_id, int flush_ms)
+      : worker_id_(worker_id), flush_ms_(flush_ms) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)port);
+    inet_pton(AF_INET, host, &addr.sin_addr);
+    ok_ = ::connect(fd_, (sockaddr*)&addr, sizeof(addr)) == 0;
+    if (ok_ && flush_ms_ > 0) {
+      async_running_.store(true);
+      flusher_ = std::thread([this] { FlushLoop(); });
+    }
+  }
+
+  ~KVClient() { Close(); }
+
+  void Close() {
+    if (async_running_.exchange(false)) {
+      flush_cv_.notify_all();
+      if (flusher_.joinable()) flusher_.join();
+      FlushNow();
+    }
+    if (fd_ >= 0) {
+      ::close(fd_);
+      fd_ = -1;
+    }
+  }
+
+  bool Pull(uint32_t table, const int64_t* keys, uint64_t n, float* out,
+            uint32_t dim) {
+    std::lock_guard<std::mutex> lk(io_mu_);
+    if (!Send(1, table, n, dim)) return false;
+    if (!SendAll(fd_, keys, n * 8)) return false;
+    uint64_t nb;
+    if (!RecvAll(fd_, &nb, 8)) return false;
+    if (nb != n * dim * sizeof(float)) return false;
+    return RecvAll(fd_, out, nb);
+  }
+
+  bool Push(uint32_t table, const int64_t* keys, uint64_t n,
+            const float* grads, uint32_t dim, float lr) {
+    std::lock_guard<std::mutex> lk(io_mu_);
+    return PushLocked(table, keys, n, grads, dim, lr);
+  }
+
+  // async path (reference AsyncCommunicator): merge grads by key host-side,
+  // background thread flushes every flush_ms
+  void PushAsync(uint32_t table, const int64_t* keys, uint64_t n,
+                 const float* grads, uint32_t dim, float lr) {
+    std::lock_guard<std::mutex> lk(buf_mu_);
+    auto& tb = buffer_[table];
+    tb.dim = dim;
+    tb.lr = lr;
+    for (uint64_t i = 0; i < n; ++i) {
+      auto& acc = tb.grads[keys[i]];
+      if (acc.empty()) acc.assign(dim, 0.0f);
+      const float* g = grads + i * dim;
+      for (uint32_t j = 0; j < dim; ++j) acc[j] += g[j];
+    }
+  }
+
+  void FlushNow() {
+    std::map<uint32_t, Buffer> drained;
+    {
+      std::lock_guard<std::mutex> lk(buf_mu_);
+      drained.swap(buffer_);
+    }
+    for (auto& kv : drained) {
+      auto& b = kv.second;
+      if (b.grads.empty()) continue;
+      std::vector<int64_t> keys;
+      std::vector<float> grads;
+      keys.reserve(b.grads.size());
+      grads.reserve(b.grads.size() * b.dim);
+      for (auto& g : b.grads) {
+        keys.push_back(g.first);
+        grads.insert(grads.end(), g.second.begin(), g.second.end());
+      }
+      std::lock_guard<std::mutex> lk(io_mu_);
+      PushLocked(kv.first, keys.data(), keys.size(), grads.data(), b.dim,
+                 b.lr);
+    }
+  }
+
+  bool Ping() {
+    std::lock_guard<std::mutex> lk(io_mu_);
+    if (!Send(3, 0, 0, 0)) return false;
+    uint32_t wid = (uint32_t)worker_id_;
+    if (!SendAll(fd_, &wid, 4)) return false;
+    uint64_t nb;
+    uint8_t ok;
+    return RecvAll(fd_, &nb, 8) && RecvAll(fd_, &ok, 1) && ok == 1;
+  }
+
+  uint64_t TableSize(uint32_t table) {
+    std::lock_guard<std::mutex> lk(io_mu_);
+    if (!Send(4, table, 0, 0)) return 0;
+    uint64_t nb, rows;
+    if (!RecvAll(fd_, &nb, 8) || !RecvAll(fd_, &rows, 8)) return 0;
+    return rows;
+  }
+
+  bool SaveLoad(uint8_t op, uint32_t table, const std::string& path) {
+    std::lock_guard<std::mutex> lk(io_mu_);
+    if (!Send(op, table, path.size(), 0)) return false;
+    if (!SendAll(fd_, path.data(), path.size())) return false;
+    uint64_t nb;
+    uint8_t ok;
+    return RecvAll(fd_, &nb, 8) && RecvAll(fd_, &ok, 1) && ok == 1;
+  }
+
+  bool ok_ = false;
+
+ private:
+  struct Buffer {
+    uint32_t dim = 0;
+    float lr = 0.0f;
+    std::map<int64_t, std::vector<float>> grads;
+  };
+
+  bool Send(uint8_t op, uint32_t table, uint64_t n, uint32_t dim) {
+    struct __attribute__((packed)) {
+      uint8_t op;
+      uint32_t table;
+      uint64_t n;
+      uint32_t dim;
+    } hdr{op, table, n, dim};
+    return SendAll(fd_, &hdr, sizeof(hdr));
+  }
+
+  bool PushLocked(uint32_t table, const int64_t* keys, uint64_t n,
+                  const float* grads, uint32_t dim, float lr) {
+    if (!Send(2, table, n, dim)) return false;
+    if (!SendAll(fd_, &lr, 4)) return false;
+    if (!SendAll(fd_, keys, n * 8)) return false;
+    if (!SendAll(fd_, grads, n * dim * sizeof(float))) return false;
+    uint64_t nb;
+    uint8_t ok;
+    return RecvAll(fd_, &nb, 8) && RecvAll(fd_, &ok, 1) && ok == 1;
+  }
+
+  void FlushLoop() {
+    std::unique_lock<std::mutex> lk(flush_mu_);
+    while (async_running_.load()) {
+      flush_cv_.wait_for(lk, std::chrono::milliseconds(flush_ms_));
+      if (!async_running_.load()) break;
+      FlushNow();
+    }
+  }
+
+  int fd_ = -1;
+  int worker_id_;
+  int flush_ms_;
+  std::mutex io_mu_, buf_mu_, flush_mu_;
+  std::map<uint32_t, Buffer> buffer_;
+  std::atomic<bool> async_running_{false};
+  std::condition_variable flush_cv_;
+  std::thread flusher_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kvs_create(int n_tables, const int* dims, const float* init_scales,
+                 unsigned long long seed) {
+  return new KVServer(n_tables, dims, init_scales, seed);
+}
+
+int kvs_start(void* s, int port) {
+  return static_cast<KVServer*>(s)->Start(port);
+}
+
+void kvs_stop(void* s) { static_cast<KVServer*>(s)->Stop(); }
+
+int kvs_lost_workers(void* s, double timeout_s, int* out, int cap) {
+  return static_cast<KVServer*>(s)->LostWorkers(timeout_s, out, cap);
+}
+
+void kvs_destroy(void* s) { delete static_cast<KVServer*>(s); }
+
+void* kvc_connect(const char* host, int port, int worker_id, int flush_ms) {
+  auto* c = new KVClient(host, port, worker_id, flush_ms);
+  if (!c->ok_) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+int kvc_pull(void* c, unsigned table, const long long* keys, long long n,
+             float* out, unsigned dim) {
+  return static_cast<KVClient*>(c)->Pull(table, (const int64_t*)keys,
+                                         (uint64_t)n, out, dim)
+             ? 0
+             : -1;
+}
+
+int kvc_push(void* c, unsigned table, const long long* keys, long long n,
+             const float* grads, unsigned dim, float lr) {
+  return static_cast<KVClient*>(c)->Push(table, (const int64_t*)keys,
+                                         (uint64_t)n, grads, dim, lr)
+             ? 0
+             : -1;
+}
+
+void kvc_push_async(void* c, unsigned table, const long long* keys,
+                    long long n, const float* grads, unsigned dim, float lr) {
+  static_cast<KVClient*>(c)->PushAsync(table, (const int64_t*)keys,
+                                       (uint64_t)n, grads, dim, lr);
+}
+
+void kvc_flush(void* c) { static_cast<KVClient*>(c)->FlushNow(); }
+
+int kvc_ping(void* c) { return static_cast<KVClient*>(c)->Ping() ? 0 : -1; }
+
+long long kvc_table_size(void* c, unsigned table) {
+  return (long long)static_cast<KVClient*>(c)->TableSize(table);
+}
+
+int kvc_save(void* c, unsigned table, const char* path) {
+  return static_cast<KVClient*>(c)->SaveLoad(5, table, path) ? 0 : -1;
+}
+
+int kvc_load(void* c, unsigned table, const char* path) {
+  return static_cast<KVClient*>(c)->SaveLoad(6, table, path) ? 0 : -1;
+}
+
+void kvc_close(void* c) { delete static_cast<KVClient*>(c); }
+
+}  // extern "C"

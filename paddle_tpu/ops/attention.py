@@ -55,6 +55,20 @@ def _fused_attention(ctx, ins, attrs):
         dropout = 0.0
     key = ctx.op_key(attrs) if dropout else None
     causal = attrs.get("causal", False)
+    if attrs.get("sequence_parallel") and not ctx.is_eval_shape \
+            and not isinstance(q, jax.ShapeDtypeStruct):
+        mesh = _current_mesh()
+        if mesh is not None and "sp" in mesh.axis_names \
+                and mesh.shape["sp"] > 1:
+            assert mask is None and dropout == 0.0, (
+                "sequence-parallel attention supports causal/plain masks "
+                "only (no custom mask, no dropout)")
+            from ..parallel.ring_attention import (ring_attention,
+                                                   ulysses_attention)
+            fn = (ulysses_attention
+                  if attrs.get("sp_mode") == "ulysses" else ring_attention)
+            return {"Out": [fn(q, k, v, mesh=mesh, scale=scale,
+                               causal=causal)]}
     if not ctx.is_eval_shape and dropout == 0.0 and mask is None \
             and not isinstance(q, jax.ShapeDtypeStruct) and _use_pallas(q):
         try:
@@ -76,3 +90,14 @@ def _fused_attention(ctx, ins, attrs):
 
 
 _warned_fallback = False
+
+
+def _current_mesh():
+    """Mesh for the program being lowered (SPMD attach), else the global."""
+    from ..framework import executor as _ex
+    if _ex._lowering_programs:
+        dist = getattr(_ex._current_lowering_program(), "_dist_config", None)
+        if dist is not None:
+            return dist.resolve_mesh()
+    from ..parallel.mesh import get_mesh
+    return get_mesh()

@@ -1,0 +1,147 @@
+"""Ring attention: exact attention over sequence-sharded Q/K/V.
+
+The reference has NO long-context story (SURVEY §5: "no ring attention, no
+Ulysses, no context parallel" — sequences were LoD ragged batches). This is
+a first-class NEW capability of the TPU build: Q/K/V live sharded along the
+sequence axis of the `sp` mesh dimension; each device computes blockwise
+online-softmax attention against its resident K/V chunk, then the chunks
+rotate around the ring with `jax.lax.ppermute` over ICI. After axis_size
+steps every query has attended to every key with O(S/P) memory per chip,
+and XLA overlaps each ppermute with the next chunk's MXU work.
+
+Also here: `ulysses_attention` — the all-to-all alternative (DeepSpeed
+Ulysses): re-shard sequence→heads, run dense (flash) attention on full
+sequences per head group, re-shard back. Better for head-rich models on
+all-to-all-friendly topologies; ring wins at extreme S.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _online_update(carry, q, k, v, q_off, k_off, scale, causal, sl_q, sl_k):
+    """One K/V chunk's contribution via online softmax (same math as the
+    pallas flash kernel, at chunk granularity)."""
+    m_prev, l_prev, acc = carry
+    s = jnp.einsum("bnqd,bnkd->bnqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (sl_q, sl_k), 0)
+        k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, (sl_q, sl_k), 1)
+        s = jnp.where((q_pos >= k_pos)[None, None], s, -jnp.inf)
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe), 0.0)
+    alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
+    l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * alpha + jnp.einsum(
+        "bnqk,bnkd->bnqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, acc_new
+
+
+def _ring_attention_local(q, k, v, *, axis_name, scale, causal):
+    """Per-device body under shard_map: local [B, nh, Sl, hd] blocks."""
+    p_size = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    b, nh, sl, hd = q.shape
+    qf = q.astype(jnp.float32)
+
+    m = jnp.full((b, nh, sl, 1), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, nh, sl, 1), jnp.float32)
+    acc = jnp.zeros((b, nh, sl, hd), jnp.float32)
+    q_off = rank * sl
+
+    k_cur, v_cur = k, v
+    perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+    for step in range(p_size):  # static unroll: p_size is a mesh constant
+        k_rank = (rank - step) % p_size
+        m, l, acc = _online_update((m, l, acc), qf,
+                                   k_cur.astype(jnp.float32),
+                                   v_cur, q_off, k_rank * sl,
+                                   scale, causal, sl, sl)
+        if step + 1 < p_size:
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Optional[Mesh] = None, axis: str = "sp",
+                   scale: Optional[float] = None, causal: bool = False):
+    """Exact attention with Q/K/V sharded on `axis` over the sequence dim.
+
+    q, k, v: [B, nh, S, hd] (global view). Returns [B, nh, S, hd] with the
+    same sequence sharding. Differentiable (pure jax body — XLA derives the
+    ring backward, which is itself a ring over ICI).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if mesh is None:
+        from .mesh import get_mesh
+        mesh = get_mesh()
+    assert mesh is not None and axis in mesh.axis_names, \
+        f"ring_attention needs a mesh with axis {axis!r}"
+    spec = _qkv_spec(mesh, axis)
+    body = functools.partial(_ring_attention_local, axis_name=axis,
+                             scale=scale, causal=causal)
+    return jax.shard_map(body, mesh=mesh,
+                         in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+
+def _qkv_spec(mesh, seq_axis):
+    """[B, nh, S, hd] spec keeping batch on dp and heads on tp when those
+    axes exist — resharding them away inside attention would all-gather the
+    batch and replicate head compute per tp device."""
+    dp = "dp" if "dp" in mesh.axis_names and mesh.shape["dp"] > 1 else None
+    tp = "tp" if "tp" in mesh.axis_names and mesh.shape["tp"] > 1 else None
+    return P(dp, tp, seq_axis, None)
+
+
+def ulysses_attention(q, k, v, mesh: Optional[Mesh] = None, axis: str = "sp",
+                      scale: Optional[float] = None, causal: bool = False):
+    """All-to-all sequence parallelism (Ulysses): inside shard_map, all-to-all
+    swaps the sharded dim from sequence to heads, each device runs dense
+    attention over the FULL sequence for nh/P heads, then swaps back."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if mesh is None:
+        from .mesh import get_mesh
+        mesh = get_mesh()
+    assert mesh is not None and axis in mesh.axis_names
+    p_size = mesh.shape[axis]
+    assert q.shape[1] % p_size == 0, (
+        f"ulysses needs heads ({q.shape[1]}) divisible by |{axis}|={p_size}")
+
+    def body(q, k, v):  # local [B, nh, Sl, hd]
+        def seq2head(x):
+            # [B, nh, Sl, hd] -> [B, nh/P, S, hd]
+            return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                      tiled=True)
+
+        def head2seq(x):
+            return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                      tiled=True)
+
+        qh, kh, vh = seq2head(q), seq2head(k), seq2head(v)
+        s = jnp.einsum("bnqd,bnkd->bnqk", qh, kh,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            sl = qh.shape[2]
+            mask = jnp.tril(jnp.ones((sl, sl), bool))[None, None]
+            s = jnp.where(mask, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1).astype(vh.dtype)
+        out = jnp.einsum("bnqk,bnkd->bnqd", p, vh)
+        return head2seq(out)
+
+    spec = _qkv_spec(mesh, axis)
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)

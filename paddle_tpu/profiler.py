@@ -1,0 +1,142 @@
+"""Profiler: host spans + device (XLA) trace + chrome-trace timeline.
+
+Reference counterparts: platform/profiler.cc (RAII RecordEvent spans through
+the op loop), device_tracer.cc:61-139 (CUPTI device activity),
+fluid/profiler.py (python context manager) and tools/timeline.py:115-161
+(chrome://tracing converter). TPU-native mapping:
+- device side: jax.profiler traces (xplane, viewable in TensorBoard /
+  Perfetto) — the CUPTI equivalent is the TPU runtime's own instrumentation;
+- host side: RecordEvent spans collected here and exported directly as
+  chrome-trace JSON (the reference needs the separate timeline.py step);
+- op-level names: the executor lowers whole blocks, so per-op spans exist in
+  the jitted program via jax.named_scope when profiling is on.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+_lock = threading.Lock()
+_events: List[dict] = []
+_enabled = False
+_device_logdir: Optional[str] = None
+
+
+class RecordEvent:
+    """RAII host span (reference platform/profiler.h RecordEvent)."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *a):
+        if _enabled:
+            t1 = time.perf_counter_ns()
+            with _lock:
+                _events.append({
+                    "name": self.name, "ph": "X", "pid": os.getpid(),
+                    "tid": threading.get_ident() % 10000,
+                    "ts": self._t0 / 1000.0,
+                    "dur": (t1 - self._t0) / 1000.0,
+                })
+        return False
+
+
+def record_event(name):
+    return RecordEvent(name)
+
+
+def start_profiler(state="All", tracer_option="Default", logdir=None):
+    global _enabled, _device_logdir
+    _enabled = True
+    if logdir:
+        _device_logdir = logdir
+        try:
+            import jax
+            jax.profiler.start_trace(logdir)
+        except Exception:
+            _device_logdir = None
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    global _enabled, _device_logdir
+    _enabled = False
+    if _device_logdir is not None:
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _device_logdir = None
+    if profile_path:
+        export_chrome_tracing(profile_path)
+
+
+def reset_profiler():
+    with _lock:
+        _events.clear()
+
+
+def export_chrome_tracing(path: str):
+    """Write collected host spans as chrome://tracing JSON (the reference's
+    tools/timeline.py output format, no separate conversion step)."""
+    with _lock:
+        events = list(_events)
+    payload = {"traceEvents": events, "displayTimeUnit": "ms"}
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return path
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
+             tracer_option="Default", logdir=None):
+    """fluid.profiler.profiler context (reference fluid/profiler.py)."""
+    start_profiler(state, tracer_option, logdir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+# 2.0-style API surface (paddle.profiler.Profiler)
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, logdir=None):
+        self._logdir = logdir
+
+    def start(self):
+        start_profiler(logdir=self._logdir)
+
+    def stop(self):
+        stop_profiler()
+
+    def step(self):
+        pass
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+        return False
+
+    def export(self, path, format="json"):
+        return export_chrome_tracing(path)
+
+    def summary(self, **kw):
+        with _lock:
+            n = len(_events)
+            total = sum(e["dur"] for e in _events)
+        print(f"{n} host spans, {total / 1000.0:.3f} ms total")
