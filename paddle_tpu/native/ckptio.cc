@@ -1,0 +1,259 @@
+// Native checkpoint IO: threaded tensor (de)serialization.
+//
+// TPU-native equivalent of the reference's save/load kernels
+// (framework/save_load_util.cc, operators/save_op.cc/load_op.cc) and the
+// threaded model-bank writers in fleet. One file holds N named tensors:
+//
+//   header:  u32 magic 'PTCK' | u32 version | u64 n_tensors
+//   per tensor: u32 name_len | name bytes | u8 dtype | u32 ndim |
+//               u64 dims[ndim] | u64 byte_offset | u64 n_bytes
+//   data:    raw little-endian blobs at their offsets (8-byte aligned)
+//
+// Data regions are written/read by a thread pool with pwrite/pread — large
+// checkpoints stream at disk bandwidth instead of a single-thread memcpy
+// loop. dtype codes: 0=f32 1=f64 2=i32 3=i64 4=u8 5=bf16(2-byte).
+
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x4b435450;  // 'PTCK'
+constexpr uint32_t kVersion = 1;
+
+struct Entry {
+  std::string name;
+  uint8_t dtype;
+  std::vector<uint64_t> dims;
+  uint64_t offset;
+  uint64_t nbytes;
+  const void* src = nullptr;  // save
+  void* dst = nullptr;        // load
+};
+
+bool WriteChunks(int fd, const std::vector<Entry>& entries, int n_threads) {
+  std::atomic<size_t> next{0};
+  std::atomic<bool> ok{true};
+  auto work = [&] {
+    for (;;) {
+      size_t i = next.fetch_add(1);
+      if (i >= entries.size()) break;
+      const Entry& e = entries[i];
+      uint64_t off = 0;
+      while (off < e.nbytes) {
+        ssize_t w = ::pwrite(fd, (const char*)e.src + off, e.nbytes - off,
+                             (off_t)(e.offset + off));
+        if (w <= 0) {
+          ok.store(false);
+          return;
+        }
+        off += (uint64_t)w;
+      }
+    }
+  };
+  std::vector<std::thread> ts;
+  for (int t = 0; t < n_threads; ++t) ts.emplace_back(work);
+  for (auto& th : ts) th.join();
+  return ok.load();
+}
+
+bool ReadChunks(int fd, const std::vector<Entry>& entries, int n_threads) {
+  std::atomic<size_t> next{0};
+  std::atomic<bool> ok{true};
+  auto work = [&] {
+    for (;;) {
+      size_t i = next.fetch_add(1);
+      if (i >= entries.size()) break;
+      const Entry& e = entries[i];
+      uint64_t off = 0;
+      while (off < e.nbytes) {
+        ssize_t r = ::pread(fd, (char*)e.dst + off, e.nbytes - off,
+                            (off_t)(e.offset + off));
+        if (r <= 0) {
+          ok.store(false);
+          return;
+        }
+        off += (uint64_t)r;
+      }
+    }
+  };
+  std::vector<std::thread> ts;
+  for (int t = 0; t < n_threads; ++t) ts.emplace_back(work);
+  for (auto& th : ts) th.join();
+  return ok.load();
+}
+
+template <typename T>
+void Append(std::vector<char>* buf, const T& v) {
+  const char* p = (const char*)&v;
+  buf->insert(buf->end(), p, p + sizeof(T));
+}
+
+}  // namespace
+
+extern "C" {
+
+// names: concatenated NUL-separated; dims flat with per-tensor ndim.
+int ck_save(const char* path, long long n, const char* names,
+            const unsigned char* dtypes, const int* ndims,
+            const long long* dims_flat, const void* const* ptrs,
+            const long long* nbytes, int n_threads) {
+  std::vector<Entry> entries((size_t)n);
+  std::vector<char> header;
+  Append(&header, kMagic);
+  Append(&header, kVersion);
+  Append(&header, (uint64_t)n);
+  const char* np = names;
+  size_t dim_pos = 0;
+  // first pass: compute header size
+  std::vector<std::string> name_list;
+  for (long long i = 0; i < n; ++i) {
+    name_list.emplace_back(np);
+    np += name_list.back().size() + 1;
+  }
+  uint64_t header_size = 16;
+  for (long long i = 0; i < n; ++i) {
+    header_size += 4 + name_list[i].size() + 1 + 4 +
+                   8ULL * (uint64_t)ndims[i] + 16;
+  }
+  uint64_t offset = (header_size + 7) & ~7ULL;
+  for (long long i = 0; i < n; ++i) {
+    Entry& e = entries[i];
+    e.name = name_list[i];
+    e.dtype = dtypes[i];
+    for (int d = 0; d < ndims[i]; ++d) {
+      e.dims.push_back((uint64_t)dims_flat[dim_pos++]);
+    }
+    e.nbytes = (uint64_t)nbytes[i];
+    e.offset = offset;
+    e.src = ptrs[i];
+    offset = (offset + e.nbytes + 7) & ~7ULL;
+    Append(&header, (uint32_t)e.name.size());
+    header.insert(header.end(), e.name.begin(), e.name.end());
+    Append(&header, e.dtype);
+    Append(&header, (uint32_t)e.dims.size());
+    for (uint64_t d : e.dims) Append(&header, d);
+    Append(&header, e.offset);
+    Append(&header, e.nbytes);
+  }
+  int fd = ::open(path, O_CREAT | O_TRUNC | O_WRONLY, 0644);
+  if (fd < 0) return -1;
+  uint64_t hoff = 0;
+  while (hoff < header.size()) {
+    ssize_t w = ::pwrite(fd, header.data() + hoff, header.size() - hoff,
+                         (off_t)hoff);
+    if (w <= 0) {
+      ::close(fd);
+      return -1;
+    }
+    hoff += (uint64_t)w;
+  }
+  bool ok = WriteChunks(fd, entries, n_threads < 1 ? 1 : n_threads);
+  ::fsync(fd);
+  ::close(fd);
+  return ok ? 0 : -1;
+}
+
+// Two-phase load: ck_open_header fills caller-provided arrays with metadata
+// so Python can allocate numpy buffers, then ck_read copies data in.
+struct CkHandle {
+  int fd;
+  std::vector<Entry> entries;
+};
+
+void* ck_open(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  auto read_exact = [&](void* dst, size_t nb, off_t off) -> bool {
+    size_t got = 0;
+    while (got < nb) {
+      ssize_t r = ::pread(fd, (char*)dst + got, nb - got, off + (off_t)got);
+      if (r <= 0) return false;
+      got += (size_t)r;
+    }
+    return true;
+  };
+  uint32_t magic, version;
+  uint64_t n;
+  off_t pos = 0;
+  if (!read_exact(&magic, 4, pos) || magic != kMagic) {
+    ::close(fd);
+    return nullptr;
+  }
+  pos += 4;
+  read_exact(&version, 4, pos);
+  pos += 4;
+  read_exact(&n, 8, pos);
+  pos += 8;
+  auto* h = new CkHandle{fd, {}};
+  h->entries.resize(n);
+  for (uint64_t i = 0; i < n; ++i) {
+    Entry& e = h->entries[i];
+    uint32_t nl;
+    read_exact(&nl, 4, pos);
+    pos += 4;
+    e.name.resize(nl);
+    read_exact(&e.name[0], nl, pos);
+    pos += nl;
+    read_exact(&e.dtype, 1, pos);
+    pos += 1;
+    uint32_t nd;
+    read_exact(&nd, 4, pos);
+    pos += 4;
+    e.dims.resize(nd);
+    for (uint32_t d = 0; d < nd; ++d) {
+      read_exact(&e.dims[d], 8, pos);
+      pos += 8;
+    }
+    read_exact(&e.offset, 8, pos);
+    pos += 8;
+    read_exact(&e.nbytes, 8, pos);
+    pos += 8;
+  }
+  return h;
+}
+
+long long ck_count(void* h) {
+  return (long long)static_cast<CkHandle*>(h)->entries.size();
+}
+
+// metadata for tensor i; name copied into caller buffer (cap bytes)
+int ck_meta(void* h, long long i, char* name_out, int cap,
+            unsigned char* dtype_out, int* ndim_out, long long* dims_out,
+            long long* nbytes_out) {
+  auto& e = static_cast<CkHandle*>(h)->entries[(size_t)i];
+  if ((int)e.name.size() + 1 > cap) return -1;
+  std::memcpy(name_out, e.name.c_str(), e.name.size() + 1);
+  *dtype_out = e.dtype;
+  *ndim_out = (int)e.dims.size();
+  for (size_t d = 0; d < e.dims.size(); ++d) {
+    dims_out[d] = (long long)e.dims[d];
+  }
+  *nbytes_out = (long long)e.nbytes;
+  return 0;
+}
+
+// register destination buffers then bulk-read threaded
+int ck_read(void* hv, void* const* ptrs, int n_threads) {
+  auto* h = static_cast<CkHandle*>(hv);
+  for (size_t i = 0; i < h->entries.size(); ++i) {
+    h->entries[i].dst = ptrs[i];
+  }
+  return ReadChunks(h->fd, h->entries, n_threads < 1 ? 1 : n_threads) ? 0
+                                                                      : -1;
+}
+
+void ck_close(void* hv) {
+  auto* h = static_cast<CkHandle*>(hv);
+  ::close(h->fd);
+  delete h;
+}
+
+}  // extern "C"

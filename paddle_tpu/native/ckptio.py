@@ -1,0 +1,107 @@
+"""ctypes wrapper for the native checkpoint IO (ckptio.cc), with numpy
+fallback. save_tensors/load_tensors move dict[str, np.ndarray] <-> one file
+with threaded chunk IO (reference save_load_util.cc / save_op.cc analog).
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Dict
+
+import numpy as np
+
+from . import load_native
+
+_DTYPES = {np.dtype("float32"): 0, np.dtype("float64"): 1,
+           np.dtype("int32"): 2, np.dtype("int64"): 3,
+           np.dtype("uint8"): 4, np.dtype("bool"): 4}
+_BY_CODE = {0: np.float32, 1: np.float64, 2: np.int32, 3: np.int64,
+            4: np.uint8}
+
+
+def _lib():
+    lib = load_native("ckptio")
+    if lib is not None and not getattr(lib, "_ck_configured", False):
+        lib.ck_save.restype = ctypes.c_int
+        lib.ck_save.argtypes = [
+            ctypes.c_char_p, ctypes.c_longlong, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_ubyte), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int]
+        lib.ck_open.restype = ctypes.c_void_p
+        lib.ck_open.argtypes = [ctypes.c_char_p]
+        lib.ck_count.restype = ctypes.c_longlong
+        lib.ck_count.argtypes = [ctypes.c_void_p]
+        lib.ck_meta.restype = ctypes.c_int
+        lib.ck_meta.argtypes = [
+            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_ubyte), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_longlong)]
+        lib.ck_read.restype = ctypes.c_int
+        lib.ck_read.argtypes = [ctypes.c_void_p,
+                                ctypes.POINTER(ctypes.c_void_p), ctypes.c_int]
+        lib.ck_close.argtypes = [ctypes.c_void_p]
+        lib._ck_configured = True
+    return lib
+
+
+def save_tensors(path: str, tensors: Dict[str, np.ndarray],
+                 n_threads: int = 8) -> None:
+    arrays = {k: np.ascontiguousarray(np.asarray(v)) for k, v in
+              tensors.items()}
+    lib = _lib()
+    if lib is None:
+        np.savez(path, **arrays)
+        return
+    names = list(arrays)
+    blob = b"".join(n.encode() + b"\0" for n in names)
+    dtypes = (ctypes.c_ubyte * len(names))(
+        *[_DTYPES[arrays[n].dtype] for n in names])
+    ndims = (ctypes.c_int * len(names))(*[arrays[n].ndim for n in names])
+    dims_flat = [d for n in names for d in arrays[n].shape]
+    dims = (ctypes.c_longlong * len(dims_flat))(*dims_flat)
+    ptrs = (ctypes.c_void_p * len(names))(
+        *[arrays[n].ctypes.data_as(ctypes.c_void_p).value for n in names])
+    nbytes = (ctypes.c_longlong * len(names))(
+        *[arrays[n].nbytes for n in names])
+    rc = lib.ck_save(path.encode(), len(names), blob, dtypes, ndims, dims,
+                     ptrs, nbytes, n_threads)
+    if rc != 0:
+        raise IOError(f"native checkpoint save failed: {path}")
+
+
+def load_tensors(path: str, n_threads: int = 8) -> Dict[str, np.ndarray]:
+    lib = _lib()
+    if lib is None:
+        with np.load(path if path.endswith(".npz") else path + ".npz") as d:
+            return {k: d[k] for k in d.files}
+    h = lib.ck_open(path.encode())
+    if not h:
+        raise IOError(f"cannot open checkpoint {path}")
+    try:
+        n = lib.ck_count(h)
+        out: Dict[str, np.ndarray] = {}
+        ptrs = (ctypes.c_void_p * n)()
+        order = []
+        for i in range(n):
+            name_buf = ctypes.create_string_buffer(4096)
+            dt = ctypes.c_ubyte()
+            nd = ctypes.c_int()
+            dims = (ctypes.c_longlong * 32)()
+            nb = ctypes.c_longlong()
+            assert lib.ck_meta(h, i, name_buf, 4096, ctypes.byref(dt),
+                               ctypes.byref(nd), dims,
+                               ctypes.byref(nb)) == 0
+            shape = tuple(dims[d] for d in range(nd.value))
+            arr = np.empty(shape, _BY_CODE[dt.value])
+            assert arr.nbytes == nb.value, (shape, arr.dtype, nb.value)
+            name = name_buf.value.decode()
+            out[name] = arr
+            order.append(name)
+            ptrs[i] = arr.ctypes.data_as(ctypes.c_void_p).value
+        if lib.ck_read(h, ptrs, n_threads) != 0:
+            raise IOError(f"native checkpoint read failed: {path}")
+        return out
+    finally:
+        lib.ck_close(h)
