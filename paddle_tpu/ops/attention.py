@@ -32,17 +32,52 @@ def _xla_attention(q, k, v, mask, scale, dropout, key):
     return jnp.einsum("bnqk,bnkd->bnqd", probs, v)
 
 
+_flash_probe_ok = None
+
+
+def _flash_probe():
+    """One-time compile probe of the flash fwd+bwd pair on tiny shapes.
+
+    The backward kernels compile when the training step is traced — after
+    the forward call site's try/except has already returned — so probe the
+    whole custom-vjp pair up front and disable the flash path for the
+    process if Mosaic rejects it (falls back to the XLA attention path).
+    """
+    global _flash_probe_ok
+    if _flash_probe_ok is None:
+        try:
+            from .pallas.flash_attention import flash_attention
+            x = jnp.zeros((1, 1, 256, 64), jnp.bfloat16)
+
+            def f(q):
+                return jnp.sum(flash_attention(q, x, x, None, False,
+                                               128, 128).astype(jnp.float32))
+
+            jax.jit(jax.grad(f))(x).block_until_ready()
+            _flash_probe_ok = True
+        except Exception as e:  # pragma: no cover - platform specific
+            import warnings
+            warnings.warn(
+                f"pallas flash attention probe failed ({e!r}); "
+                f"using the XLA attention path")
+            _flash_probe_ok = False
+    return _flash_probe_ok
+
+
 def _use_pallas(q):
     import os
     if os.environ.get("PADDLE_TPU_DISABLE_PALLAS"):
         return False
     try:
-        if jax.default_backend() != "tpu":
+        # the axon PJRT plugin exposes the real TPU under backend name "axon"
+        if jax.default_backend() not in ("tpu", "axon"):
             return False
     except RuntimeError:
         return False
     b, nh, s, hd = q.shape
-    return s % 128 == 0 and hd in (64, 128, 256) and s >= 256
+    if not (s % 128 == 0 and hd in (64, 128, 256)):
+        return False
+    return _flash_probe()
 
 
 @register("fused_attention", is_random=True, nondiff_slots=("Mask",))

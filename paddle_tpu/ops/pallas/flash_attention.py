@@ -1,15 +1,23 @@
-"""Flash attention (Pallas, TPU).
+"""Flash attention (Pallas, TPU) — forward AND backward kernels.
 
 Replaces the reference's fused CUDA attention (fused/multihead_matmul_op.cu,
-math/bert_encoder_functor.cu) with an online-softmax tiled kernel: Q blocks
+math/bert_encoder_functor.cu) with online-softmax tiled kernels: Q blocks
 stay resident in VMEM while K/V stream through, so the S×S score matrix never
-touches HBM. Forward-only custom kernel; backward uses the XLA path via
-jax.custom_vjp (recompute — still O(S) memory).
+touches HBM — in either direction.
 
-Layout: [B, nh, S, hd]; grid over (batch*heads, q_blocks); K/V iterated with
-lax.fori_loop inside the kernel (KV fully resident per head — fine up to
-S~8k at hd 64-128 in 16MB VMEM; longer sequences use the ring path in
-parallel/ring_attention.py).
+Forward emits the per-row logsumexp (lse) residual; backward runs two
+blockwise kernels (FlashAttention-2 style):
+  * dq kernel  — grid over q blocks; streams K/V, accumulates
+    dq += ds @ K with ds = P ∘ (dP - delta), P = exp(S - lse).
+  * dkdv kernel — grid over k blocks; streams Q/dO/O, accumulates
+    dv += Pᵀ @ dO and dk += dsᵀ @ Q.
+delta = rowsum(dO ∘ O) is computed in-kernel from resident blocks, so no
+extra residual tensor is materialized. lse is stored broadcast along a
+128-lane trailing dim (the Mosaic-safe layout).
+
+Layout: [B, nh, S, hd]; grid (batch*heads, blocks); the non-gridded operand
+is fully resident per head — fine up to S~8k at hd 64-128 in 16MB VMEM;
+longer sequences use the ring path in parallel/ring_attention.py.
 """
 from __future__ import annotations
 
@@ -23,11 +31,36 @@ from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 512
+_LANES = 128  # Mosaic lane width; lse stored broadcast over it
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
-                      seq_len):
+def _interpret():
+    """Interpreter mode: lets the kernels run (and be tested) on CPU."""
+    import os
+    return (os.environ.get("PADDLE_TPU_PALLAS_INTERPRET") == "1"
+            or jax.default_backend() == "cpu")
+
+
+def _pick_block(s: int, preferred: int) -> int:
+    """Largest multiple of 128 that divides s and is <= preferred.
+
+    The grid uses floor division, so a block that doesn't divide s would
+    silently leave tail rows unwritten — reject such shapes up front.
+    """
+    if s % _LANES != 0:
+        raise ValueError(
+            f"flash_attention requires seq_len % 128 == 0, got {s}")
+    b = min(preferred, s)
+    b -= b % _LANES
+    while s % b != 0:
+        b -= _LANES
+    return b
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                      block_k, seq_len):
     # q_ref: [block_q, hd]; k_ref/v_ref: [S, hd]; o_ref: [block_q, hd]
+    # lse_ref: [block_q, 128] (row value broadcast along lanes)
     block_q = q_ref.shape[0]
     hd = q_ref.shape[1]
     q_idx = pl.program_id(1)
@@ -74,18 +107,21 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k,
     m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
     out = acc / jnp.maximum(l, 1e-30)
     o_ref[:] = out.astype(o_ref.dtype)
+    lse = jnp.where(jnp.isfinite(m), m + jnp.log(jnp.maximum(l, 1e-30)),
+                    -jnp.inf)
+    lse_ref[:] = jnp.broadcast_to(lse, (block_q, _LANES))
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
     b, nh, s, hd = q.shape
-    bq = min(block_q, s)
-    bk = min(block_k, s)
+    bq = _pick_block(s, block_q)
+    bk = _pick_block(s, block_k)
     q3 = q.reshape(b * nh, s, hd)
     k3 = k.reshape(b * nh, s, hd)
     v3 = v.reshape(b * nh, s, hd)
     kernel = functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
                                block_k=bk, seq_len=s)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * nh, s // bq),
         in_specs=[
@@ -93,12 +129,179 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
             pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
             pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((None, bq, hd), lambda h, i: (h, i, 0)),
+            pl.BlockSpec((None, bq, _LANES), lambda h, i: (h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * nh, s, hd), q.dtype),
+            jax.ShapeDtypeStruct((b * nh, s, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(q3, k3, v3)
+    return out.reshape(b, nh, s, hd), lse
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
+                         *, scale, causal, block_k, seq_len):
+    # q/do/o: [block_q, hd]; k/v: [S, hd]; lse: [block_q, 128]
+    block_q = q_ref.shape[0]
+    hd = q_ref.shape[1]
+    q_idx = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32)
+    do = do_ref[:].astype(jnp.float32)
+    o = o_ref[:].astype(jnp.float32)
+    lse = lse_ref[:, :1]  # [block_q, 1]
+    lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
+    delta = jnp.sum(do * o, axis=1, keepdims=True)  # [block_q, 1]
+
+    num_k_blocks = seq_len // block_k
+
+    def body(kb, dq_acc):
+        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - lse_safe), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq_acc + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        last = (q_idx + 1) * block_q
+        n_blocks = jnp.minimum(num_k_blocks,
+                               (last + block_k - 1) // block_k)
+    else:
+        n_blocks = num_k_blocks
+    dq = jax.lax.fori_loop(0, n_blocks, body,
+                           jnp.zeros((block_q, hd), jnp.float32))
+    dq_ref[:] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                           dk_ref, dv_ref, *, scale, causal, block_q,
+                           seq_len):
+    # k/v: [block_k, hd]; q/do/o: [S, hd]; lse: [S, 128]
+    block_k = k_ref.shape[0]
+    hd = k_ref.shape[1]
+    k_idx = pl.program_id(1)
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+
+    num_q_blocks = seq_len // block_q
+
+    def body(qb, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        o = o_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(qb * block_q, block_q), :1]
+        lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
+        delta = jnp.sum(do * o, axis=1, keepdims=True)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - lse_safe), 0.0)
+        # dv += P^T @ dO : contract over q rows
+        dv_new = dv_acc + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_new = dk_acc + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    if causal:
+        # q blocks strictly before this k block see nothing: start at the
+        # first q block whose rows reach k_idx * block_k
+        start = (k_idx * block_k) // block_q
+    else:
+        start = 0
+    dk, dv = jax.lax.fori_loop(
+        start, num_q_blocks, body,
+        (jnp.zeros((block_k, hd), jnp.float32),
+         jnp.zeros((block_k, hd), jnp.float32)))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
+    b, nh, s, hd = q.shape
+    bq = _pick_block(s, block_q)
+    bk = _pick_block(s, block_k)
+    q3 = q.reshape(b * nh, s, hd)
+    k3 = k.reshape(b * nh, s, hd)
+    v3 = v.reshape(b * nh, s, hd)
+    o3 = o.reshape(b * nh, s, hd)
+    do3 = do.reshape(b * nh, s, hd)
+
+    dq_kernel = functools.partial(_flash_bwd_dq_kernel, scale=scale,
+                                  causal=causal, block_k=bk, seq_len=s)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b * nh, s // bq),
+        in_specs=[
+            pl.BlockSpec((None, bq, hd), lambda h, i: (h, i, 0)),
+            pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
+            pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
+            pl.BlockSpec((None, bq, hd), lambda h, i: (h, i, 0)),
+            pl.BlockSpec((None, bq, hd), lambda h, i: (h, i, 0)),
+            pl.BlockSpec((None, bq, _LANES), lambda h, i: (h, i, 0)),
+        ],
         out_specs=pl.BlockSpec((None, bq, hd), lambda h, i: (h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * nh, s, hd), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
-    )(q3, k3, v3)
-    return out.reshape(b, nh, s, hd)
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, o3, lse)
+
+    dkdv_kernel = functools.partial(_flash_bwd_dkdv_kernel, scale=scale,
+                                    causal=causal, block_q=bq, seq_len=s)
+    dk, dv = pl.pallas_call(
+        dkdv_kernel,
+        grid=(b * nh, s // bk),
+        in_specs=[
+            pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
+            pl.BlockSpec((None, bk, hd), lambda h, i: (h, i, 0)),
+            pl.BlockSpec((None, bk, hd), lambda h, i: (h, i, 0)),
+            pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
+            pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
+            pl.BlockSpec((None, s, _LANES), lambda h, i: (h, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bk, hd), lambda h, i: (h, i, 0)),
+            pl.BlockSpec((None, bk, hd), lambda h, i: (h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * nh, s, hd), k.dtype),
+            jax.ShapeDtypeStruct((b * nh, s, hd), v.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, o3, lse)
+
+    return (dq.reshape(b, nh, s, hd), dk.reshape(b, nh, s, hd),
+            dv.reshape(b, nh, s, hd))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -106,31 +309,22 @@ def flash_attention(q, k, v, scale=None, causal=False,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    return _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    return out
 
 
 def _fwd(q, k, v, scale, causal, block_q, block_k):
-    out = flash_attention(q, k, v, scale, causal, block_q, block_k)
-    return out, (q, k, v)
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(scale, causal, block_q, block_k, res, do):
-    q, k, v = res
+    q, k, v, o, lse = res
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-
-    def ref_attn(q, k, v):
-        s = jnp.einsum("bnqd,bnkd->bnqk", q, k,
-                       preferred_element_type=jnp.float32) * scale
-        if causal:
-            sl = q.shape[2]
-            mask = jnp.tril(jnp.ones((sl, sl), bool))[None, None]
-            s = jnp.where(mask, s, -jnp.inf)
-        p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
-        return jnp.einsum("bnqk,bnkd->bnqd", p, v)
-
-    _, vjp = jax.vjp(ref_attn, q, k, v)
-    return vjp(do)
+    return _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k)
 
 
 flash_attention.defvjp(_fwd, _bwd)

@@ -8,28 +8,37 @@ runs on any machine. Bench runs on real TPU separately (bench.py).
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from paddle_tpu.testing import cpu_mesh_env  # noqa: E402,F401  (re-export for tests)
+
 # The axon TPU plugin (sitecustomize) pins the backend at interpreter start,
 # before conftest runs — env mutation here is too late. Re-exec once with a
 # sanitized environment so tests run on the virtual 8-device CPU mesh
 # (deterministic, supports sharding tests); bench.py targets the real chip.
+# The re-exec lives in pytest_configure (not module level) because pytest's
+# capture manager has already redirected fd 1/2 when conftests load — it must
+# be stopped first or the exec'd pytest writes into the orphaned capture file.
+_REEXEC_SENTINEL = "PADDLE_TPU_TEST_REEXEC"
+
+
+def _needs_reexec() -> bool:
+    return (os.environ.get(_REEXEC_SENTINEL) != "1"
+            and bool(os.environ.get("PALLAS_AXON_POOL_IPS")))
+
+
+def pytest_configure(config):
+    if not _needs_reexec():
+        return
+    env = cpu_mesh_env(8)
+    env[_REEXEC_SENTINEL] = "1"
+    capman = config.pluginmanager.get_plugin("capturemanager")
+    if capman is not None:
+        capman.stop_global_capturing()
+    os.execve(sys.executable,
+              [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
+
+
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-
-
-def cpu_mesh_env(n_devices: int = 8) -> dict:
-    """Sanitized env for subprocess tests needing an n-device CPU mesh.
-
-    In the axon/TPU agent environment the PJRT plugin pins the backend at
-    interpreter start, so multi-device tests follow the reference's pattern
-    (test_dist_base.py _run_cluster): spawn a fresh python with a clean env.
-    """
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
-                        f" --xla_force_host_platform_device_count={n_devices}").strip()
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
-    return env
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
