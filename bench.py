@@ -1,9 +1,17 @@
-"""Benchmark: BERT-base train-step throughput on one TPU chip.
+"""Benchmarks on one real TPU chip; prints ONE JSON line.
 
-Run by the driver on real TPU hardware each round; prints ONE JSON line.
-The reference publishes no numbers (BASELINE.md), so vs_baseline compares
-against the previous round's recording in BENCH_r*.json when present
-(ratio > 1.0 = faster than last round), else 1.0.
+Primary metric: BERT-base pretrain train-step throughput (BASELINE config 3
+geometry, bf16 AMP). Extras: ResNet-50 static-graph images/sec (config 2)
+and Wide&Deep CTR with the native sparse PS (config 5). The reference
+publishes no numbers (BASELINE.md), so vs_baseline compares the primary
+metric against the previous round's recording in BENCH_r*.json
+(ratio > 1.0 = faster than last round), else 1.0. An `mfu` field reports
+model-FLOPs utilization = tokens/s * 6 * params / peak_flops
+(peak via BENCH_PEAK_TFLOPS, default 197 = v5e bf16).
+
+Perf notes: feeds are device_put once and stay resident; fetches use
+return_numpy=False so steps dispatch asynchronously and only the final
+fetch blocks — the executor pipeline stays full.
 """
 from __future__ import annotations
 
@@ -17,16 +25,42 @@ import time
 import numpy as np
 
 
-def build_train_step(batch=32, seq_len=128):
+def _fresh_programs():
+    from paddle_tpu.testing import reset_programs
+    reset_programs(seed=0)
+
+
+def _device_feed(feed):
+    import jax
+    return {k: jax.device_put(v) for k, v in feed.items()}
+
+
+def _timed_steps(exe, feed, fetch, steps, warmup=3):
+    import jax
+    for _ in range(warmup):
+        out, = exe.run(feed=feed, fetch_list=[fetch], return_numpy=False)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out, = exe.run(feed=feed, fetch_list=[fetch], return_numpy=False)
+    jax.block_until_ready(out)
+    return time.perf_counter() - t0, float(np.asarray(out).reshape(-1)[0])
+
+
+def bench_bert(batch, seq_len, steps):
     import paddle_tpu as paddle
     import paddle_tpu.fluid as fluid
     from paddle_tpu.models import bert
     from paddle_tpu.distributed import fleet
 
-    paddle.seed(0)
+    _fresh_programs()
     cfg = bert.BertConfig()          # BERT-base geometry
     cfg.seq_len = seq_len
     ids, labels, loss = bert.build_pretrain_program(cfg)
+    gb = fluid.default_main_program().global_block()
+    n_params = sum(
+        int(np.prod(v.shape)) for v in gb.vars.values()
+        if v.persistable and v.shape and all(d > 0 for d in v.shape))
     fleet.init(is_collective=True)
     strategy = fleet.DistributedStrategy()
     strategy.amp = True              # bf16 matmuls on the MXU
@@ -37,29 +71,127 @@ def build_train_step(batch=32, seq_len=128):
     exe = fluid.Executor()
     exe.run(fluid.default_startup_program())
     rng = np.random.RandomState(0)
-    feed = {
+    feed = _device_feed({
         "input_ids": rng.randint(0, cfg.vocab_size,
                                  (batch, seq_len)).astype(np.int64),
         "mlm_labels": rng.randint(0, cfg.vocab_size,
                                   (batch, seq_len, 1)).astype(np.int64),
-    }
-    return exe, feed, loss
+    })
+    dt, _ = _timed_steps(exe, feed, loss, steps)
+    tokens_per_sec = batch * seq_len * steps / dt
+    peak = float(os.environ.get("BENCH_PEAK_TFLOPS", "197")) * 1e12
+    mfu = tokens_per_sec * 6.0 * n_params / peak
+    return tokens_per_sec, mfu
+
+
+def bench_resnet50(batch, steps):
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models.resnet import build_resnet50_program
+    from paddle_tpu.distributed import fleet
+
+    _fresh_programs()
+    img, label, loss = build_resnet50_program()
+    fleet.init(is_collective=True)
+    strategy = fleet.DistributedStrategy()
+    strategy.amp = True
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9), strategy)
+    opt.minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = _device_feed({
+        "image": rng.randn(batch, 3, 224, 224).astype(np.float32),
+        "label": rng.randint(0, 1000, (batch, 1)).astype(np.int64),
+    })
+    dt, _ = _timed_steps(exe, feed, loss, steps)
+    return batch * steps / dt
+
+
+def bench_wide_deep(batch, steps):
+    """CTR train step with the sparse table on the native KV service
+    (in-process loopback server — the PS path the reference benches with
+    dist_fleet_ctr)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.ps import (KVServer, SparseTableConfig,
+                                           distributed_embedding)
+
+    _fresh_programs()
+    slots, emb_dim, vocab = 26, 16, 100001
+    srv = KVServer([SparseTableConfig("ctr_emb", dim=emb_dim,
+                                      init_scale=0.01)])
+    port = srv.start(0)
+    try:
+        dense = layers.data(name="dense_input", shape=[13], dtype="float32")
+        ids = layers.data(name="ids", shape=[slots], dtype="int64")
+        label = layers.data(name="label", shape=[1], dtype="float32")
+        emb = distributed_embedding(ids, "ctr_emb", dim=emb_dim, lr=0.01)
+        feat = layers.concat(
+            [layers.reshape(emb, [-1, slots * emb_dim]), dense], axis=1)
+        x = layers.fc(feat, 400, act="relu")
+        x = layers.fc(x, 400, act="relu")
+        logit = layers.fc(x, 1)
+        loss = layers.mean(
+            layers.sigmoid_cross_entropy_with_logits(logit, label))
+
+        fleet.init(role_maker=fleet.UserDefinedRoleMaker(
+            server_endpoints=[f"127.0.0.1:{port}"]))
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.Adam(learning_rate=1e-3),
+            fleet.DistributedStrategy())
+        opt.minimize(loss)
+        fleet.init_worker()
+
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(0)
+        feed = {
+            "dense_input": rng.randn(batch, 13).astype(np.float32),
+            "ids": rng.randint(0, vocab, (batch, slots)).astype(np.int64),
+            "label": rng.randint(0, 2, (batch, 1)).astype(np.float32),
+        }
+        # PS pull/push happens on host per step — feeds stay numpy here
+        for _ in range(3):
+            exe.run(feed=feed, fetch_list=[loss])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            exe.run(feed=feed, fetch_list=[loss])
+        dt = time.perf_counter() - t0
+        return batch * steps / dt
+    finally:
+        srv.stop()
 
 
 def main():
     batch = int(os.environ.get("BENCH_BATCH", "128"))
     seq_len = int(os.environ.get("BENCH_SEQ", "128"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
+    which = os.environ.get("BENCH_WHICH", "all")
 
-    exe, feed, loss = build_train_step(batch, seq_len)
-    # warmup (compile)
-    for _ in range(3):
-        lv, = exe.run(feed=feed, fetch_list=[loss])
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        lv, = exe.run(feed=feed, fetch_list=[loss])
-    dt = time.perf_counter() - t0
-    tokens_per_sec = batch * seq_len * steps / dt
+    tokens_per_sec, mfu = bench_bert(batch, seq_len, steps)
+
+    extras = []
+    if which in ("all", "resnet"):
+        try:
+            ips = bench_resnet50(int(os.environ.get("BENCH_RESNET_BATCH",
+                                                    "64")), steps)
+            extras.append({"metric": "resnet50_train_images_per_sec_per_chip",
+                           "value": round(ips, 1), "unit": "images/s"})
+        except Exception as e:  # pragma: no cover
+            print(f"resnet bench failed: {e!r}", file=sys.stderr)
+    if which in ("all", "widedeep"):
+        try:
+            eps = bench_wide_deep(int(os.environ.get("BENCH_CTR_BATCH",
+                                                     "512")), steps)
+            extras.append({"metric": "wide_deep_ps_examples_per_sec",
+                           "value": round(eps, 1), "unit": "examples/s"})
+        except Exception as e:  # pragma: no cover
+            print(f"wide&deep bench failed: {e!r}", file=sys.stderr)
 
     prev = None
     recs = sorted(glob.glob("BENCH_r*.json"),
@@ -76,6 +208,8 @@ def main():
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(vs, 3),
+        "mfu": round(mfu, 4),
+        "extras": extras,
     }))
 
 

@@ -85,6 +85,8 @@ class DistributedStrategy:
     recompute_configs: dict = field(default_factory=lambda: {"checkpoints": []})
     gradient_merge: bool = False
     gradient_merge_configs: dict = field(default_factory=lambda: {"k_steps": 1})
+    # LocalSGD: k local steps on per-replica parameter copies, then a dp-axis
+    # param average (executor._LocalSGDBlock; dp-only — no tp/sp/pp/pipeline)
     localsgd: bool = False
     localsgd_configs: dict = field(default_factory=lambda: {"k_steps": 1})
     dgc: bool = False                      # no-op on TPU: no wire to compress
@@ -107,6 +109,11 @@ class DistributedStrategy:
     # reference knobs kept for source compat (scheduling is XLA's job)
     nccl_comm_num: int = 1
     use_hierarchical_allreduce: bool = False
+    # sync_batch_norm is TRUE BY CONSTRUCTION under GSPMD: batch_norm lowers
+    # over the logical (global) batch, so XLA computes cross-replica moments
+    # automatically (tests/test_strategies.py proves stat parity vs a single
+    # device). The reference needs sync_batch_norm_op.cu because its replicas
+    # compute local moments; ours never do. Flag kept for source compat.
     sync_batch_norm: bool = False
     execution_strategy: dict = field(default_factory=dict)
     build_strategy: dict = field(default_factory=dict)
@@ -297,6 +304,17 @@ class DistributedOptimizer:
             opt = GradientMergeWrapper(
                 opt, s.gradient_merge_configs["k_steps"],
                 avg=s.gradient_merge_configs.get("avg", True))
+
+        if s.localsgd and s.localsgd_configs.get("k_steps", 1) > 1:
+            if (s.tensor_parallel_degree > 1 or s.pipeline
+                    or s.pipeline_parallel_degree > 1
+                    or s.sequence_parallel_degree > 1
+                    or s.expert_parallel_degree > 1):
+                raise ValueError(
+                    "localsgd shards parameter copies over the dp axis and "
+                    "cannot combine with tp/sp/pp/ep in this build")
+            program._localsgd_k = int(s.localsgd_configs["k_steps"])
+            program.bump_version()
 
         if s.pipeline and s.pipeline_configs.get("accumulate_steps", 1) > 1:
             from ...optimizer import PipelineOptimizer

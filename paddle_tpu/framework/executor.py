@@ -105,6 +105,124 @@ class _CompiledBlock:
         return self.jitted(mut, ro, feeds, rng_key)
 
 
+class _LocalSGDBlock:
+    """LocalSGD train step (reference transpiler/collective.py:270 LocalSGD +
+    fleet/meta_optimizers/localsgd_optimizer.py): every dp replica trains its
+    OWN parameter copy for k steps, then the copies are averaged.
+
+    TPU-native formulation: the replica copies ARE a tensor axis — every
+    written persistable gains a leading [dp] dimension sharded over the
+    mesh's dp axis, and the whole train step runs under shard_map so each
+    device updates its slice independently. Local steps run an XLA program
+    with ZERO cross-replica communication (the point of LocalSGD); every
+    k-th step runs a second compilation of the same program with a pmean
+    epilogue that averages the copies. Between syncs the Scope keeps the
+    last synced (global) view; the diverged copies live under
+    '<name>@LOCALSGD' scope entries.
+    """
+
+    def __init__(self, program: Program, block_idx: int,
+                 feed_names: Sequence[str], fetch_names: Sequence[str],
+                 state_names: Sequence[str], k: int):
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.program = program
+        self.block = program.blocks[block_idx]
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        self.state_names = list(state_names)
+        self.k = int(k)
+        self.written_state = _CompiledBlock._written_persistables(self)
+        written = set(self.written_state)
+        self.mut_names = [n for n in self.state_names if n in written]
+        self.ro_names = [n for n in self.state_names if n not in written]
+        dist = program._dist_config
+        mesh = dist.resolve_mesh()
+        self.mesh = mesh
+        self.dp = int(mesh.shape["dp"])
+        self._step = 0
+        self._mut_sharding = NamedSharding(mesh, P("dp"))
+
+        base = functools.partial(_run_block, self.block, self.feed_names,
+                                 self.fetch_names, self.mut_names,
+                                 self.ro_names, self.written_state)
+
+        def make(sync: bool):
+            def inner(mut, ro, feeds, rng):
+                mut = {n: v[0] for n, v in mut.items()}   # drop copy axis
+                rng = jax.random.fold_in(rng, jax.lax.axis_index("dp"))
+                fetches, new_state = base(mut, ro, feeds, rng)
+                if sync:
+                    new_state = {
+                        n: (jax.lax.pmean(v, "dp")
+                            if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                        for n, v in new_state.items()}
+                fetches = [jnp.expand_dims(f, 0) for f in fetches]
+                new_state = {n: jnp.expand_dims(v, 0)
+                             for n, v in new_state.items()}
+                return fetches, new_state
+
+            sm = shard_map(
+                inner, mesh=mesh,
+                in_specs=({n: P("dp") for n in self.mut_names},
+                          {n: P() for n in self.ro_names},
+                          {n: P("dp") for n in self.feed_names},
+                          P()),
+                out_specs=([P("dp")] * len(self.fetch_names),
+                           {n: P("dp") for n in self.written_state}),
+                check_rep=False)
+            return jax.jit(sm, donate_argnums=(0,))
+
+        self._fn_local = make(False)
+        self._fn_sync = make(True)
+        # sharded tiling: out_shardings makes XLA place one copy per device
+        # directly — never materializing all dp copies on a single device
+        self._tile = jax.jit(
+            lambda v: jnp.broadcast_to(v[None], (self.dp,) + tuple(v.shape)),
+            out_shardings=self._mut_sharding)
+
+    def step(self, scope, feeds: dict, rng_key):
+        """Returns (fetches, logical_state_updates_for_scope).
+
+        Fetch semantics under localsgd: scalar fetches return the mean over
+        replicas (= the global-batch mean for equal shards); non-scalar
+        fetches are taken as per-example (batch-leading) and concatenate the
+        dp shards back into global batch order.
+        """
+        import jax.numpy as jnp
+        for name, arr in feeds.items():
+            if arr.shape and arr.shape[0] % self.dp:
+                raise ValueError(
+                    f"localsgd: feed {name!r} batch {arr.shape[0]} is not "
+                    f"divisible by dp={self.dp}")
+        mut = {}
+        for n in self.mut_names:
+            tiled = scope.find(n + "@LOCALSGD")
+            mut[n] = tiled if tiled is not None else self._tile(scope.find(n))
+        ro = {n: scope.find(n) for n in self.ro_names}
+        # the sync cadence counter lives in the Scope (not on this cache
+        # entry): cache misses / multiple fetch signatures share one cadence
+        step_idx = int(scope.find("__localsgd_step__") or 0)
+        sync = (step_idx % self.k) == self.k - 1
+        fn = self._fn_sync if sync else self._fn_local
+        fetches, new_tiled = fn(mut, ro, feeds, rng_key)
+        scope.set("__localsgd_step__", step_idx + 1)
+        for n, v in new_tiled.items():
+            scope.set(n + "@LOCALSGD", v)
+
+        def gather(f):
+            if f.ndim <= 1:   # stacked scalars: [dp]
+                return (f.mean(axis=0)
+                        if jnp.issubdtype(f.dtype, jnp.floating) else f[0])
+            return f.reshape((f.shape[0] * f.shape[1],) + tuple(f.shape[2:]))
+
+        fetches = [gather(f) for f in fetches]
+        logical = {n: v[0] for n, v in new_tiled.items()} if sync else {}
+        return fetches, logical
+
+
 # Stack of programs being traced; sub-block ops (__cond__ etc.) look up their
 # sub-blocks through this (trace-time only, never at run time).
 _lowering_programs: List = []
@@ -170,10 +288,10 @@ def _run_block_microbatched(micro_k, block, feed_names, fetch_names,
     the scan bounds activation memory to one microbatch and XLA overlaps
     each microbatch's collectives with the next one's compute.
 
-    Documented divergence: persistable writes from the fwd/bwd section (BN
-    running stats) are not threaded through the microbatch scan — they keep
-    their pre-step values (the reference's pipeline trainer has the same
-    wrinkle with per-microbatch scopes)."""
+    Persistable writes from the fwd/bwd section (BN running stats) are
+    threaded through the scan carry, so each microbatch sees the previous
+    one's running stats — matching sequential-microbatch semantics (the
+    reference's per-microbatch scopes share persistables the same way)."""
     import jax
     import jax.numpy as jnp
     from .program import OpRole
@@ -225,15 +343,33 @@ def _run_block_microbatched(micro_k, block, feed_names, fetch_names,
         body_block.vars = block.vars
         body_block.ops = body_ops
 
+        # persistables the fwd/bwd section writes (BN running stats): carried
+        # through the scan so microbatch i+1 sees microbatch i's update
+        body_written = []
+        seen = set()
+        for op in body_ops:
+            for names in op.outputs.values():
+                for n in names:
+                    if n == "@EMPTY@" or n in seen:
+                        continue
+                    v = block.find_var_recursive(n)
+                    if v is not None and v.persistable and n in base_env:
+                        body_written.append(n)
+                        seen.add(n)
+
         def body(carry, mf):
+            grad_acc, pers = carry
             step_env = dict(base_env)
+            step_env.update(pers)
             step_env.update(mf)
-            vals, _ = _run_block_inner(body_block, grad_names + fetch_in_body,
-                                       [], step_env, ctx)
+            vals, new_pers = _run_block_inner(
+                body_block, grad_names + fetch_in_body, body_written,
+                step_env, ctx)
             grads = vals[:len(grad_names)]
             outs = vals[len(grad_names):]
-            new_carry = tuple(c + g for c, g in zip(carry, grads))
-            return new_carry, tuple(outs)
+            new_acc = tuple(c + g for c, g in zip(grad_acc, grads))
+            pers_carry = {n: new_pers.get(n, pers[n]) for n in body_written}
+            return (new_acc, pers_carry), tuple(outs)
 
         # zero accumulators shaped like one microbatch's grads: get shapes by
         # abstract eval of the first microbatch
@@ -242,14 +378,19 @@ def _run_block_microbatched(micro_k, block, feed_names, fetch_names,
             lambda e: _run_block_inner(body_block, grad_names, [], dict(e),
                                        ctx)[0],
             {**base_env, **first_mf})
-        carry0 = tuple(jnp.zeros(s.shape, s.dtype) for s in shapes)
+        carry0 = (tuple(jnp.zeros(s.shape, s.dtype) for s in shapes),
+                  {n: base_env[n] for n in body_written})
 
-        acc, stacked = jax.lax.scan(body, carry0, micro_feeds)
+        (acc, pers_final), stacked = jax.lax.scan(body, carry0, micro_feeds)
 
-        # 3) optimizer once on averaged grads
+        # 3) optimizer once on averaged grads; BN stats take their final
+        # microbatch value
+        env.update(pers_final)
         for n, a in zip(grad_names, acc):
             env[n] = a / micro_k
         for n, s in zip(fetch_in_body, stacked):
+            if n in seen:   # body-written persistable: keep its final
+                continue    # scan-carry value, not a microbatch average
             env[n] = (jnp.mean(s, axis=0)
                       if jnp.issubdtype(s.dtype, jnp.floating) else s[-1])
         post_block = type(block)(block.program, block.idx, block.parent_idx)
@@ -332,7 +473,14 @@ class Executor:
             arr = np.asarray(value) if not hasattr(value, "dtype") else value
             v = block.find_var_recursive(name)
             if v is not None and hasattr(arr, "astype"):
-                arr = np.asarray(arr, dtype=v.dtype)
+                # cast in place (device-side for jax arrays — feeding device
+                # arrays must NOT bounce through host numpy); 64-bit dtypes
+                # canonicalize to 32-bit when jax x64 is off
+                want = np.dtype(v.dtype)
+                if isinstance(arr, jax.Array):
+                    want = jax.dtypes.canonicalize_dtype(want)
+                if np.dtype(arr.dtype) != want:
+                    arr = arr.astype(want)
             feed_vals[name] = arr
 
         # State = persistable vars that already have values in the scope and
@@ -347,40 +495,53 @@ class Executor:
             and (v := block.find_var_recursive(n)) is not None
             and v.persistable and scope.has(n) and n not in feed_vals)
 
-        feed_spec = tuple(sorted((k, tuple(v.shape), str(np.asarray(v).dtype))
+        feed_spec = tuple(sorted((k, tuple(v.shape), str(v.dtype))
                                  for k, v in feed_vals.items()))
         key = (id(program), program._version, feed_spec, tuple(fetch_names),
                tuple(state_names))
         compiled = self._cache.get(key) if use_program_cache else None
+        localsgd_k = getattr(program, "_localsgd_k", 0)
         if compiled is None:
-            compiled = _CompiledBlock(
-                program, 0, list(feed_vals), fetch_names, state_names,
-                feed_shapes={k: tuple(v.shape) for k, v in feed_vals.items()},
-                state_shapes={n: tuple(scope.find(n).shape)
-                              for n in state_names})
+            if localsgd_k and localsgd_k > 1:
+                compiled = _LocalSGDBlock(program, 0, list(feed_vals),
+                                          fetch_names, state_names,
+                                          localsgd_k)
+            else:
+                compiled = _CompiledBlock(
+                    program, 0, list(feed_vals), fetch_names, state_names,
+                    feed_shapes={k: tuple(v.shape)
+                                 for k, v in feed_vals.items()},
+                    state_shapes={n: tuple(scope.find(n).shape)
+                                  for n in state_names})
             if use_program_cache:
                 self._cache[key] = compiled
 
-        state = {n: scope.find(n) for n in state_names}
         rng_key = _next_rng_key(scope, program.random_seed)
         from .. import profiler as _prof
         from ..flags import flag
         self._step_counter = getattr(self, "_step_counter", 0) + 1
         if self._step_counter == flag("FLAGS_profile_start_step"):
             _prof.start_profiler()
+
+        def _dispatch():
+            if isinstance(compiled, _LocalSGDBlock):
+                return compiled.step(scope, feed_vals, rng_key)
+            state = {n: scope.find(n) for n in state_names}
+            return compiled(state, feed_vals, rng_key)
+
         benchmark = flag("FLAGS_benchmark")
         if _prof._enabled or benchmark:
             import time as _time
             t0 = _time.perf_counter()
             with _prof.RecordEvent(f"executor_run#{op_count(program)}ops"):
-                fetches, new_state = compiled(state, feed_vals, rng_key)
+                fetches, new_state = _dispatch()
                 if benchmark:  # sync so the wall time is the device time
                     jax.block_until_ready(fetches)
             if benchmark:
                 print(f"[benchmark] step {self._step_counter}: "
                       f"{(_time.perf_counter() - t0) * 1000:.3f} ms")
         else:
-            fetches, new_state = compiled(state, feed_vals, rng_key)
+            fetches, new_state = _dispatch()
         if self._step_counter == flag("FLAGS_profile_stop_step"):
             _prof.stop_profiler()
         for n, v in new_state.items():

@@ -13,11 +13,13 @@ Semantic notes vs the reference (XLA constraints, documented divergences):
 - Carried variables must keep a fixed shape/dtype across iterations.
 - `While` is not reverse-differentiable (lax.while_loop has no VJP); use
   StaticRNN / `lax.scan`-based loops on the training path, While for decode.
-- LoDTensorArray is a bounded buffer: `array_write` materializes a
-  `capacity`-slot buffer on first write (reference grows dynamically).
-  Writes at indices >= capacity are DROPPED (XLA scatter drop mode) while
-  `array_length` still reports the high-water index — size the capacity to
-  the loop bound.
+- LoDTensorArray: `array_write` materializes a `capacity`-slot buffer on
+  first write. Build-time-known indices (python ints / fill_constant) GROW
+  the buffer like the reference's dynamic LoDTensorArray. Only
+  data-dependent loop indices are bounded: writes at indices >= capacity
+  drop (XLA scatter drop mode) while `array_length` reports the high-water
+  index (length > capacity ⇒ overflow happened) — size the capacity to the
+  loop bound.
 """
 from __future__ import annotations
 
@@ -294,6 +296,14 @@ def array_write(x, i, array=None, capacity=None):
                              capacity=capacity or _DEFAULT_ARRAY_CAPACITY)
     cap = capacity or getattr(array, "_array_capacity",
                               _DEFAULT_ARRAY_CAPACITY)
+    # If the index is known at BUILD time (python int or fill_constant),
+    # grow the declared capacity so the lowering never drops the write —
+    # matching the reference's dynamically-growing LoDTensorArray. Only
+    # data-dependent loop indices keep the bounded-buffer semantics.
+    static_i = i if isinstance(i, int) else getattr(i, "_const_value", None)
+    if static_i is not None and int(static_i) >= cap:
+        cap = max(2 * cap, int(static_i) + 1)
+        array._array_capacity = cap
     helper.append_op("array_write",
                      inputs={"X": [x], "I": [i], "Array": [array]},
                      outputs={"Out": [array]},
@@ -304,15 +314,25 @@ def array_write(x, i, array=None, capacity=None):
 @register("array_write", infer=_noop_infer)
 def _lower_array_write(ctx, ins, attrs):
     x = ins["X"][0]
-    i = jnp.reshape(ins["I"][0], ()).astype(jnp.int32)
+    raw_i = ins["I"][0]
+    i = jnp.reshape(raw_i, ()).astype(jnp.int32)
     arr = ins["Array"][0]
     buffer, length = (None, jnp.zeros((), jnp.int32)) if arr is None else arr
     if buffer is None:
         buffer = jnp.zeros((int(attrs.get("capacity",
                                           _DEFAULT_ARRAY_CAPACITY)),)
                            + tuple(x.shape), x.dtype)
-    # drop (not clamp) out-of-capacity writes: clamping would silently
-    # overwrite the last slot with later elements
+    # GROW the buffer when the declared capacity outgrew it (the frontend
+    # bumps `capacity` for build-time-known indices — matches the reference's
+    # dynamically-growing LoDTensorArray; static shapes, so jit-safe). Only
+    # data-dependent loop indices keep the bounded-buffer semantics, where
+    # out-of-capacity writes drop (not clamp: clamping would silently
+    # overwrite the last slot) — size capacity to the loop bound.
+    want_cap = int(attrs.get("capacity", _DEFAULT_ARRAY_CAPACITY))
+    if want_cap > buffer.shape[0]:
+        pad = jnp.zeros((want_cap - buffer.shape[0],) + tuple(buffer.shape[1:]),
+                        buffer.dtype)
+        buffer = jnp.concatenate([buffer, pad], axis=0)
     buffer = buffer.at[i].set(x.astype(buffer.dtype), mode="drop")
     length = jnp.maximum(length, i + 1)
     return {"Out": [(buffer, length)]}

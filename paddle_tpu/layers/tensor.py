@@ -21,6 +21,8 @@ def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
                      attrs={"shape": list(shape),
                             "dtype": dtype_name(convert_dtype(dtype)),
                             "value": float(value)})
+    # build-time constant tag: lets array_write size its buffer statically
+    out._const_value = float(value)
     return out
 
 

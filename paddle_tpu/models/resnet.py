@@ -102,3 +102,50 @@ def resnet50(num_classes=1000, **kw):
 
 def resnet101(num_classes=1000, **kw):
     return ResNet(BottleneckBlock, [3, 4, 23, 3], num_classes, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Static-graph builder (fluid.layers) — the whole train step compiles to ONE
+# XLA program, which is how a throughput bench should drive the chip (the
+# dygraph path above dispatches op-by-op; fine for UX, wrong for max perf).
+# Mirrors the reference's static SE-ResNeXt/ResNet dist test models
+# (dist_se_resnext.py) at the API level.
+# ---------------------------------------------------------------------------
+
+def _static_conv_bn(x, ch, filter_size, stride=1, act=None, is_test=False):
+    from .. import layers
+    y = layers.conv2d(x, ch, filter_size, stride=stride,
+                      padding=(filter_size - 1) // 2, bias_attr=False)
+    return layers.batch_norm(y, act=act, is_test=is_test)
+
+
+def _static_bottleneck(x, ch, stride, is_test=False):
+    from .. import layers
+    out = _static_conv_bn(x, ch, 1, act="relu", is_test=is_test)
+    out = _static_conv_bn(out, ch, 3, stride=stride, act="relu",
+                          is_test=is_test)
+    out = _static_conv_bn(out, ch * 4, 1, is_test=is_test)
+    if stride != 1 or x.shape[1] != ch * 4:
+        x = _static_conv_bn(x, ch * 4, 1, stride=stride, is_test=is_test)
+    return layers.relu(layers.elementwise_add(out, x))
+
+
+def build_resnet50_program(num_classes=1000, image_size=224, is_test=False):
+    """Static ResNet-50: returns (image_var, label_var, avg_loss)."""
+    from .. import layers
+    img = layers.data(name="image", shape=[3, image_size, image_size],
+                      dtype="float32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    x = _static_conv_bn(img, 64, 7, stride=2, act="relu", is_test=is_test)
+    x = layers.pool2d(x, pool_size=3, pool_type="max", pool_stride=2,
+                      pool_padding=1)
+    for ch, depth, first_stride in ((64, 3, 1), (128, 4, 2),
+                                    (256, 6, 2), (512, 3, 2)):
+        for i in range(depth):
+            x = _static_bottleneck(x, ch, first_stride if i == 0 else 1,
+                                   is_test=is_test)
+    x = layers.pool2d(x, pool_type="avg", global_pooling=True)
+    logits = layers.fc(layers.flatten(x, axis=1), num_classes)
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(logits, label))
+    return img, label, loss

@@ -30,6 +30,9 @@ def _conv2d(ctx, ins, attrs):
     paddings = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
     groups = attrs.get("groups", 1) or 1
+    # No preferred_element_type: the MXU accumulates bf16 convs in f32 in
+    # hardware, and forcing an f32 output breaks the conv transpose rule
+    # (mixed-dtype cotangents) under AMP.
     out = jax.lax.conv_general_dilated(
         x, w,
         window_strides=strides,
@@ -37,7 +40,6 @@ def _conv2d(ctx, ins, attrs):
         rhs_dilation=dilations,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
     )
     return {"Output": [out.astype(x.dtype)]}
 

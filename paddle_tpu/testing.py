@@ -33,6 +33,20 @@ def cpu_mesh_env(n_devices: int = 8, base_env: dict | None = None) -> dict:
     return env
 
 
+def reset_programs(seed: int = 0) -> None:
+    """Fresh default main/startup programs + global scope + name counters —
+    the per-test/per-bench reset (the reference makes a new Program() per
+    unit test). One canonical copy; conftest, bench.py and __graft_entry__
+    all use it."""
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import program as pm, scope as sm, unique_name
+    pm._main_program = pm.Program()
+    pm._startup_program = pm.Program()
+    sm._reset_global_scope()
+    unique_name.switch()
+    paddle.seed(seed)
+
+
 def virtual_cpu_mesh_ready(n_devices: int) -> bool:
     """True if THIS process's env already provides an n-device CPU mesh
     (checked without initializing jax — that would dial the axon tunnel)."""

@@ -217,3 +217,20 @@ def test_unmaterialized_array_in_while_raises_clearly():
     exe = fluid.Executor()
     with pytest.raises(Exception, match="element_shape|materialized"):
         exe.run(feed={}, fetch_list=[layers.array_length(arr)])
+
+
+def test_array_write_grows_for_static_index():
+    """A build-time-known index past capacity grows the buffer (reference
+    LoDTensorArray grows dynamically) instead of silently dropping."""
+    x = layers.fill_constant([3], "float32", 7.0)
+    arr = layers.create_array("float32", capacity=2)
+    i0 = layers.fill_constant([1], "int64", 0)
+    i5 = layers.fill_constant([1], "int64", 5)
+    layers.array_write(x, i0, array=arr)
+    layers.array_write(x * 2.0, i5, array=arr)   # beyond capacity=2
+    got = layers.array_read(arr, i5)
+    n = layers.array_length(arr)
+    exe = fluid.Executor()
+    out, ln = exe.run(feed={}, fetch_list=[got, n])
+    np.testing.assert_allclose(out, [14.0, 14.0, 14.0])
+    assert int(np.asarray(ln)) == 6

@@ -119,3 +119,37 @@ def test_fleet_pipeline_strategy():
     for _ in range(10):
         lv = float(exe.run(feed=_feed(16), fetch_list=[loss])[0])
     assert np.isfinite(lv) and lv < l0
+
+
+def test_pipeline_threads_bn_stats_through_scan():
+    """BN running stats must advance once per microbatch (sequential
+    semantics), not stay at their pre-step values."""
+    from paddle_tpu.framework.scope import global_scope
+    from paddle_tpu.optimizer import PipelineOptimizer
+
+    x = fluid.layers.data(name="x", shape=[3, 4, 4], dtype="float32")
+    bn = layers.batch_norm(x)
+    loss = layers.reduce_mean(bn)
+    bn_op = [op for op in fluid.default_main_program().global_block().ops
+             if op.type == "batch_norm"][0]
+    mean_name = bn_op.inputs["Mean"][0]
+
+    opt = PipelineOptimizer(paddle.optimizer.SGD(learning_rate=0.0),
+                            num_microbatches=4)
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    scope = global_scope()
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(16, 3, 4, 4).astype(np.float32)
+    exe.run(feed={"x": xs}, fetch_list=[loss])
+    running = np.asarray(scope.find(mean_name))
+
+    # sequential microbatch simulation: running = 0; for each microbatch m:
+    # running = 0.9*running + 0.1*mean(m)
+    expect = np.zeros(3, np.float32)
+    for m in range(4):
+        mb = xs[4 * m:4 * m + 4]
+        expect = 0.9 * expect + 0.1 * mb.mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(running, expect, rtol=1e-5, atol=1e-6)
