@@ -1,0 +1,180 @@
+"""Structured decoding ops: linear-chain CRF, Viterbi, beam search.
+
+Reference counterparts: linear_chain_crf_op.{cc,h} (forward algorithm +
+hand-written grad), crf_decoding_op.cc (Viterbi), operators/math/
+beam_search.cc + beam_search_op.cc / beam_search_decode_op.cc (LoD beam
+bookkeeping), gather_tree_op.cc.
+
+TPU-native: padded-dense [b, T, ...] + length vectors instead of LoD; the
+time recursions are single `lax.scan`s (one fused XLA loop), and CRF
+gradients come from autodiff of the forward algorithm (the reference
+differentiates Alpha/Beta by hand — jax.vjp of logsumexp-scan is the same
+math).
+
+Transition layout matches the reference (linear_chain_crf_op.h): row 0 =
+start weights, row 1 = stop weights, rows 2.. = [C, C] transition matrix
+w[from, to].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _seq_lengths(ins, b, T, slot="SeqLen"):
+    sl = ins.get(slot, [None])[0]
+    if sl is None:
+        return jnp.full((b,), T, jnp.int32)
+    return jnp.reshape(sl, (-1,)).astype(jnp.int32)
+
+
+@register("linear_chain_crf", nondiff_slots=("Label", "SeqLen"))
+def _linear_chain_crf(ctx, ins, attrs):
+    em = ins["Emission"][0]               # [b, T, C] padded
+    trans = ins["Transition"][0]          # [C+2, C]
+    label = ins["Label"][0]               # [b, T] or [b, T, 1]
+    b, T, C = em.shape
+    lengths = _seq_lengths(ins, b, T)
+    lbl = label.reshape(b, T).astype(jnp.int32)
+    start, stop, w = trans[0], trans[1], trans[2:]
+
+    emf = em.astype(jnp.float32)
+    valid = (jnp.arange(T)[None, :] < lengths[:, None])   # [b, T]
+
+    # ---- log partition via forward algorithm (one lax.scan over time) ----
+    alpha0 = start[None, :] + emf[:, 0]                   # [b, C]
+
+    def step(alpha, t):
+        nxt = jax.scipy.special.logsumexp(
+            alpha[:, :, None] + w[None, :, :], axis=1) + emf[:, t]
+        keep = valid[:, t][:, None]
+        new = jnp.where(keep, nxt, alpha)
+        return new, new
+
+    alpha, alphas = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    logZ = jax.scipy.special.logsumexp(alpha + stop[None, :], axis=1)
+
+    # ---- gold path score ----
+    t_idx = jnp.arange(T)[None, :]
+    em_score = jnp.sum(
+        jnp.where(valid, jnp.take_along_axis(emf, lbl[:, :, None],
+                                             axis=2)[..., 0], 0.0), axis=1)
+    pair_valid = valid[:, 1:]
+    tr_score = jnp.sum(
+        jnp.where(pair_valid, w[lbl[:, :-1], lbl[:, 1:]], 0.0), axis=1)
+    last = jnp.clip(lengths - 1, 0, T - 1)
+    start_score = start[lbl[:, 0]]
+    stop_score = stop[jnp.take_along_axis(lbl, last[:, None], 1)[:, 0]]
+    gold = em_score + tr_score + start_score + stop_score
+
+    nll = (logZ - gold)[:, None]                          # [b, 1]
+    return {"LogLikelihood": [nll.astype(em.dtype)],
+            "Alpha": [jnp.concatenate([alpha0[:, None],
+                                       jnp.moveaxis(alphas, 0, 1)], axis=1)],
+            "EmissionExps": [jnp.exp(emf)],
+            "TransitionExps": [jnp.exp(trans.astype(jnp.float32))]}
+
+
+@register("crf_decoding")
+def _crf_decoding(ctx, ins, attrs):
+    """Viterbi decode (crf_decoding_op.cc). With Label given, outputs the
+    0/1 per-token correctness mask like the reference; else the path."""
+    em = ins["Emission"][0]               # [b, T, C]
+    trans = ins["Transition"][0]
+    label = ins.get("Label", [None])[0]
+    b, T, C = em.shape
+    lengths = _seq_lengths(ins, b, T)
+    start, stop, w = trans[0], trans[1], trans[2:]
+    emf = em.astype(jnp.float32)
+    valid = (jnp.arange(T)[None, :] < lengths[:, None])
+
+    v0 = start[None, :] + emf[:, 0]
+
+    def step(v, t):
+        cand = v[:, :, None] + w[None, :, :]              # [b, from, to]
+        best = jnp.max(cand, axis=1) + emf[:, t]
+        arg = jnp.argmax(cand, axis=1)                    # [b, to]
+        keep = valid[:, t][:, None]
+        return jnp.where(keep, best, v), jnp.where(keep, arg, -1)
+
+    v_last_pre, backps = jax.lax.scan(step, v0, jnp.arange(1, T))
+    # add stop weights at each sequence's true last step: since v carries
+    # the last valid alpha, adding stop once at the end is correct
+    v_final = v_last_pre + stop[None, :]
+    last_tag = jnp.argmax(v_final, axis=1).astype(jnp.int32)   # [b]
+
+    # walk back through backpointers (time-major backps: [T-1, b, C])
+    def walk(tag, bp_t):
+        prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+        new = jnp.where(prev >= 0, prev, tag).astype(jnp.int32)
+        return new, tag
+
+    first_tag, rev_path = jax.lax.scan(walk, last_tag, backps[::-1])
+    # rev_path = [tag_{T-1}, ..., tag_1]; the final carry is tag_0
+    path = jnp.concatenate([first_tag[:, None],
+                            rev_path[::-1].T], axis=1)     # [b, T]
+    path = jnp.where(valid, path, 0)
+    if label is not None:
+        lbl = label.reshape(b, T).astype(jnp.int32)
+        return {"ViterbiPath": [
+            jnp.where(valid, (path == lbl).astype(jnp.int64), 0)]}
+    return {"ViterbiPath": [path.astype(jnp.int64)]}
+
+
+@register("gather_tree")
+def _gather_tree(ctx, ins, attrs):
+    """gather_tree_op.cc: walk parent pointers backward to assemble full
+    beam sequences. Ids/Parents: [T, b, beam]."""
+    ids = ins["Ids"][0].astype(jnp.int32)
+    parents = ins["Parents"][0].astype(jnp.int32)
+    T, b, beam = ids.shape
+    beams = jnp.arange(beam)[None, :].repeat(b, 0)        # [b, beam]
+
+    def walk(cur_beam, t):
+        id_t = jnp.take_along_axis(ids[t], cur_beam, axis=1)
+        par_t = jnp.take_along_axis(parents[t], cur_beam, axis=1)
+        return par_t, id_t
+
+    _, rev = jax.lax.scan(walk, beams, jnp.arange(T - 1, -1, -1))
+    return {"Out": [rev[::-1].astype(ins["Ids"][0].dtype)]}
+
+
+@register("beam_search", nondiff_slots=("pre_ids", "pre_scores", "ids"))
+def _beam_search(ctx, ins, attrs):
+    """One step of beam selection (beam_search_op.cc, dense formulation):
+    pre_scores [b, beam], scores [b, beam, V] total log-probs; selects the
+    top `beam_size` of beam*V per batch row. End beams keep their score
+    (end_id continuation)."""
+    pre_ids = ins["pre_ids"][0]           # [b, beam]
+    pre_scores = ins["pre_scores"][0]     # [b, beam]
+    scores = ins["scores"][0]             # [b, beam, V]
+    beam_size = attrs["beam_size"]
+    end_id = attrs.get("end_id", 0)
+    b, beam, V = scores.shape
+    finished = (pre_ids == end_id)
+    neg = jnp.finfo(scores.dtype).min
+    # finished beams only continue via end_id at their frozen score
+    cont = jnp.where(finished[:, :, None], neg, scores)
+    frozen = jnp.full((b, beam, V), neg, scores.dtype)
+    frozen = frozen.at[:, :, end_id].set(
+        jnp.where(finished, pre_scores, neg))
+    total = jnp.where(finished[:, :, None], frozen, cont)  # [b, beam, V]
+    flat = total.reshape(b, beam * V)
+    top_scores, top_idx = jax.lax.top_k(flat, beam_size)
+    parent = (top_idx // V).astype(jnp.int64)
+    token = (top_idx % V).astype(jnp.int64)
+    return {"selected_ids": [token], "selected_scores": [top_scores],
+            "parent_idx": [parent]}
+
+
+@register("beam_search_decode")
+def _beam_search_decode(ctx, ins, attrs):
+    """beam_search_decode_op.cc: stitch per-step beam selections into full
+    sentences via gather_tree; scores are each step's selected scores."""
+    ids = ins["Ids"][0]                   # [T, b, beam]
+    scores = ins["Scores"][0]             # [T, b, beam]
+    parents = ins["Parents"][0]           # [T, b, beam]
+    seqs = _gather_tree(ctx, {"Ids": [ids], "Parents": [parents]}, {})["Out"][0]
+    return {"SentenceIds": [seqs], "SentenceScores": [scores[-1]]}

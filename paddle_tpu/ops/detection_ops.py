@@ -1,0 +1,473 @@
+"""Detection op family.
+
+Reference counterparts: paddle/fluid/operators/detection/ — prior_box_op.cc,
+density_prior_box_op.cc, anchor_generator_op.cc, box_coder_op.{cc,h},
+iou_similarity_op.cc, box_clip_op.cc, yolo_box_op.{cc,h}, multiclass_nms_op.cc,
+polygon_box_transform_op.cc — plus roi_align_op.{cc,h} and roi_pool_op.cc.
+
+TPU-native notes: everything is static-shape. multiclass_nms (whose reference
+output is a variable-length LoD tensor) returns a fixed keep_top_k block
+padded with label -1 plus a valid-count output — the jax/XLA analog of the
+reference's dynamic result. NMS itself is a masked greedy loop
+(lax.fori_loop), not data-dependent Python.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+
+# ---------------------------------------------------------------------------
+# anchors / priors
+# ---------------------------------------------------------------------------
+
+def _prior_centers(h, w, step_h, step_w, offset):
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * step_h
+    return jnp.meshgrid(cy, cx, indexing="ij")   # [h, w] each
+
+
+@register("prior_box")
+def _prior_box(ctx, ins, attrs):
+    feat = ins["Input"][0]                # [N, C, H, W]
+    img = ins["Image"][0]                 # [N, C, IH, IW]
+    h, w = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    min_sizes = [float(v) for v in attrs["min_sizes"]]
+    max_sizes = [float(v) for v in attrs.get("max_sizes", [])]
+    ars = [1.0]
+    for ar in attrs.get("aspect_ratios", []):
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if attrs.get("flip", False):
+                ars.append(1.0 / float(ar))
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    step_w = attrs.get("step_w", 0.0) or iw / w
+    step_h = attrs.get("step_h", 0.0) or ih / h
+    offset = attrs.get("offset", 0.5)
+    clip = attrs.get("clip", False)
+
+    cy, cx = _prior_centers(h, w, step_h, step_w, offset)
+    whs = []
+    for ms in min_sizes:
+        for ar in ars:                    # min size at each aspect ratio
+            whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        if max_sizes:                     # extra prior between min and max
+            mx = max_sizes[min_sizes.index(ms)]
+            whs.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+    boxes = []
+    for bw, bh in whs:
+        boxes.append(jnp.stack([(cx - bw / 2) / iw, (cy - bh / 2) / ih,
+                                (cx + bw / 2) / iw, (cy + bh / 2) / ih],
+                               axis=-1))
+    out = jnp.stack(boxes, axis=2)        # [h, w, num_priors, 4]
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), out.shape)
+    return {"Boxes": [out], "Variances": [var]}
+
+
+@register("density_prior_box")
+def _density_prior_box(ctx, ins, attrs):
+    feat = ins["Input"][0]
+    img = ins["Image"][0]
+    h, w = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    fixed_sizes = [float(v) for v in attrs.get("fixed_sizes", [])]
+    fixed_ratios = [float(v) for v in attrs.get("fixed_ratios", [1.0])]
+    densities = [int(v) for v in attrs.get("densities", [1])]
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    step_w = attrs.get("step_w", 0.0) or iw / w
+    step_h = attrs.get("step_h", 0.0) or ih / h
+    offset = attrs.get("offset", 0.5)
+    clip = attrs.get("clip", False)
+
+    cy, cx = _prior_centers(h, w, step_h, step_w, offset)
+    step_avg = 0.5 * (step_w + step_h)    # reference density_prior_box_op.h
+    boxes = []
+    for size, density in zip(fixed_sizes, densities):
+        shift = int(step_avg / density)
+        for ratio in fixed_ratios:
+            bw = size * np.sqrt(ratio)
+            bh = size / np.sqrt(ratio)
+            for dy in range(density):
+                for dx in range(density):
+                    ccx = cx - step_avg / 2.0 + shift / 2.0 + dx * shift
+                    ccy = cy - step_avg / 2.0 + shift / 2.0 + dy * shift
+                    boxes.append(jnp.stack(
+                        [(ccx - bw / 2) / iw, (ccy - bh / 2) / ih,
+                         (ccx + bw / 2) / iw, (ccy + bh / 2) / ih], axis=-1))
+    out = jnp.stack(boxes, axis=2)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), out.shape)
+    return {"Boxes": [out], "Variances": [var]}
+
+
+@register("anchor_generator")
+def _anchor_generator(ctx, ins, attrs):
+    feat = ins["Input"][0]                # [N, C, H, W]
+    h, w = feat.shape[2], feat.shape[3]
+    sizes = [float(v) for v in attrs["anchor_sizes"]]
+    ratios = [float(v) for v in attrs["aspect_ratios"]]
+    stride = attrs["stride"]              # [sw, sh]
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    offset = attrs.get("offset", 0.5)
+    sw, sh = float(stride[0]), float(stride[1])
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * sw
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * sh
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")
+    anchors = []
+    for r in ratios:
+        for s in sizes:
+            bw = s * np.sqrt(1.0 / r)
+            bh = s * np.sqrt(r)
+            anchors.append(jnp.stack(
+                [cxg - bw / 2, cyg - bh / 2, cxg + bw / 2, cyg + bh / 2],
+                axis=-1))
+    out = jnp.stack(anchors, axis=2)      # [h, w, A, 4]
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32), out.shape)
+    return {"Anchors": [out], "Variances": [var]}
+
+
+# ---------------------------------------------------------------------------
+# box arithmetic
+# ---------------------------------------------------------------------------
+
+def _box_wh(b, normalized):
+    extra = 0.0 if normalized else 1.0
+    w = b[..., 2] - b[..., 0] + extra
+    h = b[..., 3] - b[..., 1] + extra
+    return w, h
+
+
+@register("box_coder")
+def _box_coder(ctx, ins, attrs):
+    prior = ins["PriorBox"][0]            # [M, 4]
+    pvar = ins.get("PriorBoxVar", [None])[0]
+    target = ins["TargetBox"][0]
+    code_type = attrs.get("code_type", "encode_center_size")
+    normalized = attrs.get("box_normalized", True)
+    var_attr = attrs.get("variance", [])
+    pw, ph = _box_wh(prior, normalized)
+    pcx = prior[..., 0] + pw / 2
+    pcy = prior[..., 1] + ph / 2
+    if pvar is None and var_attr:
+        pvar = jnp.asarray(var_attr, jnp.float32)
+
+    if code_type.startswith("encode"):
+        tw, th = _box_wh(target, normalized)     # target [N, 4]
+        tcx = (target[..., 0] + target[..., 2]) / 2
+        tcy = (target[..., 1] + target[..., 3]) / 2
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        dh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)   # [N, M, 4]
+        if pvar is not None:
+            out = out / jnp.broadcast_to(pvar, out.shape)
+        return {"OutputBox": [out]}
+
+    # decode: target [N, M, 4] deltas (axis=0 semantics)
+    d = target
+    if pvar is not None:
+        d = d * jnp.broadcast_to(pvar, d.shape)
+    cx = d[..., 0] * pw + pcx
+    cy = d[..., 1] * ph + pcy
+    w = jnp.exp(d[..., 2]) * pw
+    h = jnp.exp(d[..., 3]) * ph
+    extra = 0.0 if normalized else 1.0
+    out = jnp.stack([cx - w / 2, cy - h / 2,
+                     cx + w / 2 - extra, cy + h / 2 - extra], axis=-1)
+    return {"OutputBox": [out]}
+
+
+def _iou_matrix(x, y, normalized=True):
+    extra = 0.0 if normalized else 1.0
+    area = lambda b: ((b[..., 2] - b[..., 0] + extra) *
+                      (b[..., 3] - b[..., 1] + extra))
+    ax = area(x)[:, None]
+    ay = area(y)[None, :]
+    x1 = jnp.maximum(x[:, None, 0], y[None, :, 0])
+    y1 = jnp.maximum(x[:, None, 1], y[None, :, 1])
+    x2 = jnp.minimum(x[:, None, 2], y[None, :, 2])
+    y2 = jnp.minimum(x[:, None, 3], y[None, :, 3])
+    iw = jnp.maximum(x2 - x1 + extra, 0.0)
+    ih = jnp.maximum(y2 - y1 + extra, 0.0)
+    inter = iw * ih
+    return inter / jnp.maximum(ax + ay - inter, 1e-10)
+
+
+@register("iou_similarity")
+def _iou_similarity(ctx, ins, attrs):
+    return {"Out": [_iou_matrix(ins["X"][0], ins["Y"][0],
+                                attrs.get("box_normalized", True))]}
+
+
+@register("box_clip")
+def _box_clip(ctx, ins, attrs):
+    boxes = ins["Input"][0]               # [N, 4] or [B, N, 4]
+    iminfo = ins["ImInfo"][0]             # [B, 3] (h, w, scale)
+    h = iminfo[..., 0] / iminfo[..., 2] - 1.0
+    w = iminfo[..., 1] / iminfo[..., 2] - 1.0
+    if boxes.ndim == 3:
+        h = h[:, None]
+        w = w[:, None]
+    x1 = jnp.clip(boxes[..., 0], 0, None)
+    y1 = jnp.clip(boxes[..., 1], 0, None)
+    x2 = boxes[..., 2]
+    y2 = boxes[..., 3]
+    out = jnp.stack([jnp.minimum(x1, w), jnp.minimum(y1, h),
+                     jnp.clip(jnp.minimum(x2, w), 0, None),
+                     jnp.clip(jnp.minimum(y2, h), 0, None)], axis=-1)
+    return {"Output": [out]}
+
+
+@register("polygon_box_transform")
+def _polygon_box_transform(ctx, ins, attrs):
+    """polygon_box_transform_op.cc: quad offsets -> absolute coordinates
+    (x channels add 4*col, y channels add 4*row)."""
+    x = ins["Input"][0]                   # [N, 8, H, W]
+    n, c, h, w = x.shape
+    col = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+    row = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+    is_x = (jnp.arange(c) % 2 == 0)[None, :, None, None]
+    return {"Output": [jnp.where(is_x, 4 * col - x, 4 * row - x)]}
+
+
+@register("yolo_box")
+def _yolo_box(ctx, ins, attrs):
+    """yolo_box_op.h:29-77."""
+    x = ins["X"][0]                       # [N, A*(5+C), H, W]
+    imgsize = ins["ImgSize"][0]           # [N, 2] (h, w)
+    anchors = attrs["anchors"]
+    class_num = attrs["class_num"]
+    conf_thresh = attrs.get("conf_thresh", 0.01)
+    downsample = attrs.get("downsample_ratio", 32)
+    clip_bbox = attrs.get("clip_bbox", True)
+    scale = attrs.get("scale_x_y", 1.0)
+    bias = -0.5 * (scale - 1.0)
+    n, _, h, w = x.shape
+    an_num = len(anchors) // 2
+    input_h = downsample * h
+    input_w = downsample * w
+
+    xr = x.reshape(n, an_num, 5 + class_num, h, w)
+    img_h = imgsize[:, 0].astype(jnp.float32)[:, None, None, None]
+    img_w = imgsize[:, 1].astype(jnp.float32)[:, None, None, None]
+    grid_x = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    grid_y = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+    ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+
+    cx = (grid_x + jax.nn.sigmoid(xr[:, :, 0]) * scale + bias) * img_w / w
+    cy = (grid_y + jax.nn.sigmoid(xr[:, :, 1]) * scale + bias) * img_h / h
+    bw = jnp.exp(xr[:, :, 2]) * aw * img_w / input_w
+    bh = jnp.exp(xr[:, :, 3]) * ah * img_h / input_h
+    conf = jax.nn.sigmoid(xr[:, :, 4])
+    on = conf >= conf_thresh
+
+    x1 = cx - bw / 2
+    y1 = cy - bh / 2
+    x2 = cx + bw / 2
+    y2 = cy + bh / 2
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, None)
+        y1 = jnp.clip(y1, 0, None)
+        x2 = jnp.minimum(x2, img_w - 1)
+        y2 = jnp.minimum(y2, img_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)      # [N, A, H, W, 4]
+    boxes = jnp.where(on[..., None], boxes, 0.0)
+    scores = conf[..., None] * jax.nn.sigmoid(
+        jnp.moveaxis(xr[:, :, 5:], 2, -1))            # [N, A, H, W, C]
+    scores = jnp.where(on[..., None], scores, 0.0)
+    return {"Boxes": [boxes.reshape(n, an_num * h * w, 4)],
+            "Scores": [scores.reshape(n, an_num * h * w, class_num)]}
+
+
+# ---------------------------------------------------------------------------
+# ROI ops
+# ---------------------------------------------------------------------------
+
+@register("roi_align")
+def _roi_align(ctx, ins, attrs):
+    """roi_align_op.h: average of bilinear samples per bin."""
+    x = ins["X"][0]                       # [N, C, H, W]
+    rois = ins["ROIs"][0]                 # [R, 4]
+    batch_ids = ins.get("RoisNum", [None])[0]
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    spatial_scale = attrs.get("spatial_scale", 1.0)
+    sampling = attrs.get("sampling_ratio", -1)
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    bids = (batch_ids.reshape(-1).astype(jnp.int32)
+            if batch_ids is not None else jnp.zeros((r,), jnp.int32))
+
+    xmin = rois[:, 0] * spatial_scale
+    ymin = rois[:, 1] * spatial_scale
+    xmax = rois[:, 2] * spatial_scale
+    ymax = rois[:, 3] * spatial_scale
+    rw = jnp.maximum(xmax - xmin, 1.0)
+    rh = jnp.maximum(ymax - ymin, 1.0)
+    bin_w = rw / pw
+    bin_h = rh / ph
+    ns = sampling if sampling > 0 else 2
+
+    def sample(py, px, iy, ix):
+        y = ymin[:, None] + py * bin_h[:, None] + \
+            (iy + 0.5) * bin_h[:, None] / ns
+        xx = xmin[:, None] + px * bin_w[:, None] + \
+            (ix + 0.5) * bin_w[:, None] / ns
+        y = jnp.clip(y[:, 0], 0.0, h - 1)
+        xx = jnp.clip(xx[:, 0], 0.0, w - 1)
+        y0 = jnp.floor(y).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
+        y1 = jnp.clip(y0 + 1, 0, h - 1)
+        x1 = jnp.clip(x0 + 1, 0, w - 1)
+        ly = y - y0
+        lx = xx - x0
+        v00 = x[bids, :, y0, x0]
+        v01 = x[bids, :, y0, x1]
+        v10 = x[bids, :, y1, x0]
+        v11 = x[bids, :, y1, x1]
+        return (v00 * ((1 - ly) * (1 - lx))[:, None]
+                + v01 * ((1 - ly) * lx)[:, None]
+                + v10 * (ly * (1 - lx))[:, None]
+                + v11 * (ly * lx)[:, None])          # [R, C]
+
+    outs = []
+    for py in range(ph):
+        row = []
+        for px in range(pw):
+            acc = 0.0
+            for iy in range(ns):
+                for ix in range(ns):
+                    acc = acc + sample(py, px, iy, ix)
+            row.append(acc / (ns * ns))
+        outs.append(jnp.stack(row, axis=-1))          # [R, C, pw]
+    out = jnp.stack(outs, axis=-2)                    # [R, C, ph, pw]
+    return {"Out": [out]}
+
+
+@register("roi_pool")
+def _roi_pool(ctx, ins, attrs):
+    """roi_pool_op.cc: max over quantized bins."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    batch_ids = ins.get("RoisNum", [None])[0]
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    spatial_scale = attrs.get("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    bids = (batch_ids.reshape(-1).astype(jnp.int32)
+            if batch_ids is not None else jnp.zeros((r,), jnp.int32))
+    x1 = jnp.clip(jnp.round(rois[:, 0] * spatial_scale), 0, w - 1).astype(jnp.int32)
+    y1 = jnp.clip(jnp.round(rois[:, 1] * spatial_scale), 0, h - 1).astype(jnp.int32)
+    x2 = jnp.clip(jnp.round(rois[:, 2] * spatial_scale), 0, w - 1).astype(jnp.int32)
+    y2 = jnp.clip(jnp.round(rois[:, 3] * spatial_scale), 0, h - 1).astype(jnp.int32)
+    rw = jnp.maximum(x2 - x1 + 1, 1)
+    rh = jnp.maximum(y2 - y1 + 1, 1)
+
+    ys = jnp.arange(h)[None, :]
+    xs = jnp.arange(w)[None, :]
+    neg = jnp.finfo(x.dtype).min
+    out = jnp.full((r, c, ph, pw), neg, x.dtype)
+    for py in range(ph):
+        hstart = y1 + (py * rh) // ph
+        hend = y1 + ((py + 1) * rh + ph - 1) // ph
+        ymask = (ys >= hstart[:, None]) & (ys < jnp.maximum(
+            hend, hstart + 1)[:, None])               # [R, H]
+        for px in range(pw):
+            wstart = x1 + (px * rw) // pw
+            wend = x1 + ((px + 1) * rw + pw - 1) // pw
+            xmask = (xs >= wstart[:, None]) & (xs < jnp.maximum(
+                wend, wstart + 1)[:, None])           # [R, W]
+            m = ymask[:, None, :, None] & xmask[:, None, None, :]
+            feat = x[bids]                            # [R, C, H, W]
+            val = jnp.max(jnp.where(m, feat, neg), axis=(2, 3))
+            empty = ~(jnp.any(ymask, 1) & jnp.any(xmask, 1))   # [R]
+            val = jnp.where(empty[:, None], 0.0, val)   # ref zeroes empty bins
+            out = out.at[:, :, py, px].set(val)
+    return {"Out": [out], "Argmax": [None]}
+
+
+# ---------------------------------------------------------------------------
+# NMS
+# ---------------------------------------------------------------------------
+
+def _nms_per_class(boxes, scores, iou_threshold, top_k, normalized):
+    """Greedy NMS over the top_k highest-score boxes. Returns a keep mask
+    aligned with the sorted order and the sorted indices."""
+    order = jnp.argsort(-scores)[:top_k]
+    b = boxes[order]
+    s = scores[order]
+    iou = _iou_matrix(b, b, normalized)
+    k = b.shape[0]
+
+    def body(i, keep):
+        sup = (iou[i] > iou_threshold) & keep & \
+            (jnp.arange(k) > i)
+        keep_new = keep & ~sup
+        return jnp.where(keep[i], keep_new, keep)
+
+    keep0 = jnp.ones((k,), bool)
+    keep = jax.lax.fori_loop(0, k, body, keep0)
+    return order, s, keep
+
+
+@register("multiclass_nms")
+def _multiclass_nms(ctx, ins, attrs):
+    """multiclass_nms_op.cc, static-shape formulation: output is a fixed
+    [keep_top_k, 6] block (label, score, x1, y1, x2, y2) padded with
+    label=-1 rows, plus NmsRoisNum = number of valid rows. Single-image
+    (BBoxes [M, 4], Scores [C, M]); batch via the frontend loop/vmap."""
+    bboxes = ins["BBoxes"][0]
+    scores = ins["Scores"][0]
+    if bboxes.ndim == 3:                  # [1, M, 4] batch-1 convenience
+        if bboxes.shape[0] != 1:
+            raise ValueError(
+                "multiclass_nms lowering is single-image; got batch "
+                f"{bboxes.shape[0]} — loop or vmap at the frontend")
+        bboxes = bboxes[0]
+        scores = scores[0]
+    c, m = scores.shape
+    score_threshold = attrs.get("score_threshold", 0.0)
+    nms_top_k = min(int(attrs.get("nms_top_k", m)) if
+                    attrs.get("nms_top_k", m) > 0 else m, m)
+    keep_top_k = int(attrs.get("keep_top_k", m))
+    if keep_top_k <= 0:
+        keep_top_k = c * nms_top_k
+    nms_threshold = attrs.get("nms_threshold", 0.3)
+    normalized = attrs.get("normalized", True)
+    background = attrs.get("background_label", 0)
+
+    all_rows = []
+    for cls in range(c):
+        if cls == background:
+            continue
+        order, s, keep = _nms_per_class(bboxes, scores[cls], nms_threshold,
+                                        nms_top_k, normalized)
+        ok = keep & (s > score_threshold)
+        sel_boxes = bboxes[order]
+        rows = jnp.concatenate(
+            [jnp.where(ok, float(cls), -1.0)[:, None],
+             jnp.where(ok, s, jnp.finfo(s.dtype).min)[:, None],
+             sel_boxes], axis=1)          # [nms_top_k, 6]
+        all_rows.append(rows)
+    cat = jnp.concatenate(all_rows, axis=0)
+    # keep the global top keep_top_k by score
+    take = min(keep_top_k, cat.shape[0])
+    top_idx = jnp.argsort(-cat[:, 1])[:take]
+    out = cat[top_idx]
+    valid = out[:, 0] >= 0
+    out = jnp.where(valid[:, None],
+                    out, jnp.concatenate(
+                        [jnp.full((take, 1), -1.0),
+                         jnp.zeros((take, 5))], axis=1).astype(out.dtype))
+    count = jnp.sum(valid).astype(jnp.int32)
+    return {"Out": [out], "NmsRoisNum": [count]}

@@ -5,6 +5,7 @@ operators/amp/check_finite_and_unscale_op.cc, update_loss_scaling_op.cc.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from .registry import register
@@ -98,3 +99,56 @@ def _update_loss_scaling(ctx, ins, attrs):
     outs = [jnp.where(found_inf, jnp.zeros_like(x), x) for x in ins["X"]]
     return {"Out": outs, "LossScaling": [new_scale],
             "OutGoodSteps": [new_good], "OutBadSteps": [new_bad]}
+
+
+@register("precision_recall")
+def _precision_recall(ctx, ins, attrs):
+    """metrics/precision_recall_op.{cc,h}: per-class TP/FP/TN/FN from
+    predicted Indices vs Labels (optionally weighted), then
+    [macro-P, macro-R, macro-F1, micro-P, micro-R, micro-F1] for the batch
+    and for the accumulated states (StatesInfo input carries history)."""
+    idx = ins["Indices"][0].reshape(-1).astype(jnp.int32)
+    lbl = ins["Labels"][0].reshape(-1).astype(jnp.int32)
+    weights = ins.get("Weights", [None])[0]
+    states = ins.get("StatesInfo", [None])[0]
+    c = attrs["class_number"]
+    w = (weights.reshape(-1).astype(jnp.float32) if weights is not None
+         else jnp.ones(idx.shape, jnp.float32))
+
+    correct = (idx == lbl)
+    onehot = lambda v: jax.nn.one_hot(v, c, dtype=jnp.float32)
+    tp = jnp.sum(onehot(idx) * (correct * w)[:, None], axis=0)
+    fp = jnp.sum(onehot(idx) * (~correct * w)[:, None], axis=0)
+    fn = jnp.sum(onehot(lbl) * (~correct * w)[:, None], axis=0)
+    # TN: every class not involved in the sample counts w (reference .h:86-99)
+    total_w = jnp.sum(w)
+    tn = total_w - tp - fp - fn
+
+    batch = jnp.stack([tp, fp, tn, fn], axis=1)           # [C, 4]
+
+    def metrics(st):
+        tp_, fp_, _, fn_ = st[:, 0], st[:, 1], st[:, 2], st[:, 3]
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / jnp.maximum(tp_ + fp_, 1e-12),
+                         1.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / jnp.maximum(tp_ + fn_, 1e-12),
+                        1.0)
+        f1 = jnp.where(prec + rec > 0,
+                       2 * prec * rec / jnp.maximum(prec + rec, 1e-12), 0.0)
+        micro_tp, micro_fp, micro_fn = (jnp.sum(tp_), jnp.sum(fp_),
+                                        jnp.sum(fn_))
+        mp = jnp.where(micro_tp + micro_fp > 0,
+                       micro_tp / jnp.maximum(micro_tp + micro_fp, 1e-12),
+                       1.0)
+        mr = jnp.where(micro_tp + micro_fn > 0,
+                       micro_tp / jnp.maximum(micro_tp + micro_fn, 1e-12),
+                       1.0)
+        mf = jnp.where(mp + mr > 0, 2 * mp * mr / jnp.maximum(mp + mr, 1e-12),
+                       0.0)
+        return jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1),
+                          mp, mr, mf])
+
+    accum = batch + (states.astype(jnp.float32)
+                     if states is not None else 0.0)
+    return {"BatchMetrics": [metrics(batch)],
+            "AccumMetrics": [metrics(accum)],
+            "AccumStatesInfo": [accum]}

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..framework.dtype import convert_dtype
 from .registry import register
@@ -375,3 +376,146 @@ def _simple_rnn(ctx, ins, attrs):
 
     (h_last, _), hs = jax.lax.scan(step, (h0, jnp.zeros((), jnp.int32)), (xs,))
     return {"Hidden": [jnp.moveaxis(hs, 0, 1)], "LastH": [h_last]}
+
+
+# ---------------------------------------------------------------------------
+# sequence tail ops (reference sequence_ops/sequence_{slice,erase,scatter,
+# enumerate,reshape,expand,topk_avg_pooling}_op.cc) on the padded-dense +
+# length-vector representation
+# ---------------------------------------------------------------------------
+
+@register("sequence_slice")
+def _sequence_slice(ctx, ins, attrs):
+    """Per-row crop: out[i] = x[i, offset[i]:offset[i]+length[i]] left-packed
+    (sequence_slice_op.cc). Output stays [b, T, ...]; SeqLenOut = length."""
+    x = ins["X"][0]                       # [b, T, ...]
+    off = jnp.reshape(ins["Offset"][0], (-1,)).astype(jnp.int32)
+    ln = jnp.reshape(ins["Length"][0], (-1,)).astype(jnp.int32)
+    b, T = x.shape[0], x.shape[1]
+    t = jnp.arange(T)[None, :]
+    src = jnp.clip(off[:, None] + t, 0, T - 1)           # [b, T]
+    gathered = jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+    mask = (t < ln[:, None]).reshape(
+        (b, T) + (1,) * (x.ndim - 2))
+    return {"Out": [jnp.where(mask, gathered, 0)], "SeqLenOut": [ln]}
+
+
+@register("sequence_erase")
+def _sequence_erase(ctx, ins, attrs):
+    """Remove listed tokens and left-pack the survivors
+    (sequence_erase_op.cc)."""
+    x = ins["X"][0]                       # [b, T] int tokens
+    b, T = x.shape[0], x.shape[1]
+    lengths = _lengths(ins, b, T)
+    tokens = attrs.get("tokens", [])
+    valid = _time_mask(lengths, T)
+    keep = valid
+    for tok in tokens:
+        keep = keep & (x != tok)
+    # stable left-pack: sort positions by (dropped, position)
+    order = jnp.argsort(jnp.where(keep, 0, 1) * T + jnp.arange(T)[None, :],
+                        axis=1)
+    packed = jnp.take_along_axis(x, order, axis=1)
+    new_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+    packed = jnp.where(_time_mask(new_len, T), packed, 0)
+    return {"Out": [packed], "SeqLenOut": [new_len]}
+
+
+@register("sequence_scatter")
+def _sequence_scatter(ctx, ins, attrs):
+    """out[i, ids[i, t]] += updates[i, t] for valid t
+    (sequence_scatter_op.cc, update semantics per row)."""
+    x = ins["X"][0]                       # [b, D]
+    ids = ins["Ids"][0]                   # [b, T] int positions
+    upd = ins["Updates"][0]               # [b, T]
+    b, T = ids.shape[0], ids.shape[1]
+    lengths = _lengths(ins, b, T)
+    mask = _time_mask(lengths, T)
+    vals = jnp.where(mask, upd, 0).astype(x.dtype)
+    rows = jnp.broadcast_to(jnp.arange(b)[:, None], (b, T))
+    return {"Out": [x.at[rows, ids.astype(jnp.int32)].add(vals)]}
+
+
+@register("sequence_enumerate")
+def _sequence_enumerate(ctx, ins, attrs):
+    """Sliding windows of win_size ids, pad_value past the end
+    (sequence_enumerate_op.cc)."""
+    x = ins["X"][0]                       # [b, T] int ids
+    win = attrs.get("win_size", 2)
+    pad = attrs.get("pad_value", 0)
+    b, T = x.shape[0], x.shape[1]
+    lengths = _lengths(ins, b, T)
+    t = jnp.arange(T)[None, :, None]                 # [1, T, 1]
+    k = jnp.arange(win)[None, None, :]               # [1, 1, win]
+    src = t + k                                      # [1, T, win]
+    gather = jnp.take_along_axis(
+        x[:, :, None], jnp.clip(src, 0, T - 1).repeat(b, 0), axis=1)
+    in_seq = src < lengths[:, None, None]
+    return {"Out": [jnp.where(in_seq, gather, pad)]}
+
+
+@register("sequence_reshape")
+def _sequence_reshape(ctx, ins, attrs):
+    """Change the token width: [b, T, D] -> [b, T*D/nd, nd]; lengths scale by
+    D/nd (sequence_reshape_op.cc)."""
+    x = ins["X"][0]
+    nd = attrs["new_dim"]
+    b, T, D = x.shape[0], x.shape[1], int(np.prod(x.shape[2:]))
+    lengths = _lengths(ins, b, T)
+    out = x.reshape(b, T * D // nd, nd)
+    new_len = (lengths * D) // nd
+    return {"Out": [out], "SeqLenOut": [new_len.astype(jnp.int32)]}
+
+
+@register("sequence_expand")
+def _sequence_expand(ctx, ins, attrs):
+    """v1 expand by reference sequence lengths (sequence_expand_op.cc):
+    row i of X is tiled to Y's i-th sequence length along time."""
+    x = ins["X"][0]                       # [b, Tx, ...] or [b, ...]
+    y = ins["Y"][0]                       # only its time axis matters
+    b = x.shape[0]
+    Ty = y.shape[1]
+    ylen = _lengths({"SeqLen": ins.get("YSeqLen", [None])}, b, Ty)
+    if x.ndim == 2:                       # one row per sequence: tile rows
+        out = jnp.repeat(x[:, None, :], Ty, axis=1)
+        mask = _time_mask(ylen, Ty)[..., None]
+        return {"Out": [jnp.where(mask, out, 0)], "SeqLenOut": [ylen]}
+    # general: cycle x's valid prefix along time (ref_level=0 tiling)
+    xlen = _lengths(ins, b, x.shape[1])
+    idx = jnp.arange(Ty)[None, :] % jnp.maximum(xlen[:, None], 1)
+    out = jnp.take_along_axis(
+        x, idx.reshape((b, Ty) + (1,) * (x.ndim - 2)), axis=1)
+    mask = _time_mask(ylen, Ty).reshape((b, Ty) + (1,) * (x.ndim - 2))
+    return {"Out": [jnp.where(mask, out, 0)], "SeqLenOut": [ylen]}
+
+
+@register("sequence_topk_avg_pooling")
+def _sequence_topk_avg_pooling(ctx, ins, attrs):
+    """Pyramid text-match pooling (sequence_topk_avg_pooling_op.h): X is a
+    per-pair score pyramid [b, C, R, Ccol]; for each (row, channel) take the
+    top-k over valid columns and average, for every k in topks. Output
+    [b, R, C * num_k]."""
+    x = ins["X"][0]                       # [b, C, R, Cc]
+    topks = list(attrs.get("topks", [1]))
+    b, C, Rr, Cc = x.shape
+    col_len = _lengths({"SeqLen": ins.get("COLUMN", [None])}, b, Cc)
+    neg = jnp.finfo(x.dtype).min
+    valid = (jnp.arange(Cc)[None, None, None, :] <
+             col_len[:, None, None, None])
+    masked = jnp.where(valid, x, neg)
+    srt = -jnp.sort(-masked, axis=-1)     # descending over columns
+    csum = jnp.cumsum(jnp.where(srt == neg, 0, srt), axis=-1)
+    outs = []
+    for k in topks:
+        kk = jnp.minimum(col_len, k)      # [b]
+        take = jnp.clip(kk, 1, Cc)
+        picked = jnp.take_along_axis(
+            csum, (take - 1)[:, None, None, None].repeat(C, 1)
+            .repeat(Rr, 2), axis=-1)[..., 0]
+        avg = picked / jnp.maximum(kk, 1)[:, None, None].astype(x.dtype)
+        avg = jnp.where(col_len[:, None, None] > 0, avg, 0)
+        outs.append(avg)                  # [b, C, R]
+    out = jnp.stack(outs, axis=-1)        # [b, C, R, nk]
+    out = jnp.moveaxis(out, 1, 2).reshape(b, Rr, C * len(topks))
+    return {"Out": [out], "pos": [None]}

@@ -1,0 +1,217 @@
+"""OpTest coverage: detection family + CRF/Viterbi/beam search +
+precision_recall (reference unittests: test_prior_box_op.py,
+test_box_coder_op.py, test_yolo_box_op.py, test_multiclass_nms_op.py,
+test_roi_align_op.py, test_linear_chain_crf_op.py, test_crf_decoding_op.py,
+test_beam_search_op.py, test_precision_recall_op.py)."""
+import numpy as np
+
+import paddle_tpu  # noqa: F401
+from op_test import run_op
+
+R = np.random.RandomState(5)
+
+
+def test_prior_box():
+    feat = np.zeros((1, 8, 2, 2), np.float32)
+    img = np.zeros((1, 3, 32, 32), np.float32)
+    out = run_op("prior_box", {"Input": [feat], "Image": [img]},
+                 {"min_sizes": [4.0], "max_sizes": [8.0],
+                  "aspect_ratios": [2.0], "flip": True, "clip": True,
+                  "variances": [0.1, 0.1, 0.2, 0.2]})
+    boxes = np.asarray(out["Boxes"][0])
+    # priors: ar 1, 2, 0.5 + max-size prior = 4
+    assert boxes.shape == (2, 2, 4, 4)
+    assert (boxes >= 0).all() and (boxes <= 1).all()
+    # first cell center is at offset*step = 8: ar=1 prior is [6,6,10,10]/32
+    np.testing.assert_allclose(boxes[0, 0, 0], np.array([6, 6, 10, 10]) / 32,
+                               rtol=1e-5)
+    var = np.asarray(out["Variances"][0])
+    np.testing.assert_allclose(var[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_anchor_generator():
+    feat = np.zeros((1, 8, 2, 3), np.float32)
+    out = run_op("anchor_generator", {"Input": [feat]},
+                 {"anchor_sizes": [32.0], "aspect_ratios": [1.0],
+                  "stride": [16.0, 16.0]})
+    a = np.asarray(out["Anchors"][0])
+    assert a.shape == (2, 3, 1, 4)
+    np.testing.assert_allclose(a[0, 0, 0], [8 - 16, 8 - 16, 8 + 16, 8 + 16])
+
+
+def test_box_coder_roundtrip():
+    prior = np.array([[0., 0., 10., 10.], [5., 5., 15., 20.]], np.float32)
+    target = np.array([[1., 1., 8., 9.]], np.float32)
+    enc = np.asarray(run_op("box_coder",
+                            {"PriorBox": [prior], "TargetBox": [target]},
+                            {"code_type": "encode_center_size",
+                             "box_normalized": True})["OutputBox"][0])
+    dec = np.asarray(run_op("box_coder",
+                            {"PriorBox": [prior], "TargetBox": [enc]},
+                            {"code_type": "decode_center_size",
+                             "box_normalized": True})["OutputBox"][0])
+    # decode(encode(t)) == t for each prior row
+    np.testing.assert_allclose(dec[0], np.tile(target, (2, 1)), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_iou_similarity_and_box_clip():
+    x = np.array([[0., 0., 10., 10.]], np.float32)
+    y = np.array([[0., 0., 10., 10.], [5., 5., 15., 15.]], np.float32)
+    iou = np.asarray(run_op("iou_similarity", {"X": [x], "Y": [y]},
+                            {"box_normalized": True})["Out"][0])
+    np.testing.assert_allclose(iou[0, 0], 1.0, rtol=1e-5)
+    np.testing.assert_allclose(iou[0, 1], 25.0 / 175.0, rtol=1e-4)
+
+    boxes = np.array([[-5., -5., 30., 30.]], np.float32)
+    iminfo = np.array([[20., 20., 1.]], np.float32)
+    out = np.asarray(run_op("box_clip", {"Input": [boxes],
+                                         "ImInfo": [iminfo]},
+                            {})["Output"][0])
+    np.testing.assert_allclose(out[0], [0., 0., 19., 19.])
+
+
+def test_yolo_box_shapes_and_center():
+    an = [10, 13, 16, 30]
+    x = np.zeros((1, 2 * 7, 2, 2), np.float32)   # class_num=2
+    img = np.array([[64, 64]], np.int64)
+    out = run_op("yolo_box", {"X": [x], "ImgSize": [img]},
+                 {"anchors": an, "class_num": 2, "conf_thresh": 0.0,
+                  "downsample_ratio": 32, "clip_bbox": False})
+    boxes = np.asarray(out["Boxes"][0])
+    scores = np.asarray(out["Scores"][0])
+    assert boxes.shape == (1, 8, 4) and scores.shape == (1, 8, 2)
+    # zero logits: sigmoid=0.5 -> center of cell 0 = (0.5/2)*64 = 16
+    cx = (boxes[0, 0, 0] + boxes[0, 0, 2]) / 2
+    np.testing.assert_allclose(cx, 16.0, rtol=1e-5)
+
+
+def test_roi_align_and_roi_pool():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0., 0., 3., 3.]], np.float32)
+    out = np.asarray(run_op("roi_align", {"X": [x], "ROIs": [rois]},
+                            {"pooled_height": 2, "pooled_width": 2,
+                             "spatial_scale": 1.0,
+                             "sampling_ratio": 2})["Out"][0])
+    assert out.shape == (1, 1, 2, 2)
+    assert out[0, 0, 0, 0] < out[0, 0, 1, 1]   # increasing ramp preserved
+
+    outp = np.asarray(run_op("roi_pool", {"X": [x], "ROIs": [rois]},
+                             {"pooled_height": 2, "pooled_width": 2,
+                              "spatial_scale": 1.0})["Out"][0])
+    np.testing.assert_allclose(outp[0, 0], [[5., 7.], [13., 15.]])
+
+
+def test_multiclass_nms():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                     np.float32)
+    scores = np.array([[0.0, 0.0, 0.0],          # background
+                       [0.9, 0.85, 0.1],         # class 1
+                       [0.2, 0.1, 0.8]], np.float32)   # class 2
+    out = run_op("multiclass_nms", {"BBoxes": [boxes], "Scores": [scores]},
+                 {"score_threshold": 0.3, "nms_threshold": 0.5,
+                  "nms_top_k": 3, "keep_top_k": 4, "background_label": 0})
+    rows = np.asarray(out["Out"][0])
+    n = int(np.asarray(out["NmsRoisNum"][0]))
+    assert n == 2   # one box per class (second class-1 box suppressed)
+    valid = rows[rows[:, 0] >= 0]
+    assert set(valid[:, 0].astype(int)) == {1, 2}
+    best1 = valid[valid[:, 0] == 1][0]
+    np.testing.assert_allclose(best1[1], 0.9, rtol=1e-5)
+    np.testing.assert_allclose(best1[2:], [0, 0, 10, 10], atol=1e-5)
+
+
+def test_linear_chain_crf_matches_bruteforce():
+    b, T, C = 2, 3, 3
+    em = R.randn(b, T, C).astype(np.float32)
+    trans = R.randn(C + 2, C).astype(np.float32)
+    label = R.randint(0, C, (b, T)).astype(np.int64)
+    lens = np.array([3, 2], np.int64)
+    out = run_op("linear_chain_crf",
+                 {"Emission": [em], "Transition": [trans],
+                  "Label": [label], "SeqLen": [lens]}, {})
+    nll = np.asarray(out["LogLikelihood"][0])
+
+    start, stop, w = trans[0], trans[1], trans[2:]
+    for i in range(b):
+        L = lens[i]
+        # brute-force logZ over all paths
+        import itertools
+        scores = []
+        for path in itertools.product(range(C), repeat=int(L)):
+            s = start[path[0]] + em[i, 0, path[0]]
+            for t in range(1, L):
+                s += w[path[t-1], path[t]] + em[i, t, path[t]]
+            s += stop[path[-1]]
+            scores.append(s)
+        logZ = np.logaddexp.reduce(scores)
+        gold = start[label[i, 0]] + em[i, 0, label[i, 0]]
+        for t in range(1, L):
+            gold += w[label[i, t-1], label[i, t]] + em[i, t, label[i, t]]
+        gold += stop[label[i, L-1]]
+        np.testing.assert_allclose(nll[i, 0], logZ - gold, rtol=1e-4,
+                                   atol=1e-4)
+
+
+def test_crf_decoding_matches_bruteforce():
+    b, T, C = 1, 4, 3
+    em = R.randn(b, T, C).astype(np.float32)
+    trans = R.randn(C + 2, C).astype(np.float32)
+    lens = np.array([4], np.int64)
+    path = np.asarray(run_op("crf_decoding",
+                             {"Emission": [em], "Transition": [trans],
+                              "SeqLen": [lens]}, {})["ViterbiPath"][0])
+    start, stop, w = trans[0], trans[1], trans[2:]
+    import itertools
+    best, best_s = None, -np.inf
+    for p in itertools.product(range(C), repeat=T):
+        s = start[p[0]] + em[0, 0, p[0]]
+        for t in range(1, T):
+            s += w[p[t-1], p[t]] + em[0, t, p[t]]
+        s += stop[p[-1]]
+        if s > best_s:
+            best, best_s = p, s
+    np.testing.assert_array_equal(path[0], best)
+
+
+def test_beam_search_and_gather_tree():
+    # 1 batch, beam 2, vocab 4
+    pre_ids = np.array([[1, 2]], np.int64)
+    pre_scores = np.array([[-1.0, -2.0]], np.float32)
+    scores = np.array([[[-1.5, -9, -9, -2.0],
+                        [-9, -2.5, -9, -9]]], np.float32)  # total log-probs
+    out = run_op("beam_search", {"pre_ids": [pre_ids],
+                                 "pre_scores": [pre_scores],
+                                 "ids": [None], "scores": [scores]},
+                 {"beam_size": 2, "end_id": 0})
+    sel = np.asarray(out["selected_ids"][0])
+    par = np.asarray(out["parent_idx"][0])
+    sc = np.asarray(out["selected_scores"][0])
+    np.testing.assert_array_equal(sel[0], [0, 3])   # -1.5 then -2.0
+    np.testing.assert_array_equal(par[0], [0, 0])
+    np.testing.assert_allclose(sc[0], [-1.5, -2.0])
+
+    ids = np.array([[[2, 5]], [[3, 7]], [[1, 4]]], np.int64)  # [T,b,beam]
+    parents = np.array([[[0, 0]], [[1, 0]], [[0, 1]]], np.int64)
+    seq = np.asarray(run_op("gather_tree", {"Ids": [ids],
+                                            "Parents": [parents]},
+                            {})["Out"][0])
+    # beam 0 at t=2: parent chain 0 <- parents[2][0]=0 -> t1 beam0 parent=1
+    np.testing.assert_array_equal(seq[:, 0, 0], [5, 3, 1])
+    np.testing.assert_array_equal(seq[:, 0, 1], [2, 7, 4])
+
+
+def test_precision_recall():
+    idx = np.array([[0], [1], [1], [2]], np.int32)
+    lbl = np.array([[0], [1], [2], [2]], np.int32)
+    out = run_op("precision_recall", {"Indices": [idx], "Labels": [lbl]},
+                 {"class_number": 3})
+    bm = np.asarray(out["BatchMetrics"][0])
+    st = np.asarray(out["AccumStatesInfo"][0])
+    # class 1: TP=1 FP=1 FN=0; class 2: TP=1 FP=0 FN=1; class 0: TP=1
+    np.testing.assert_allclose(st[1, 0], 1)  # TP
+    np.testing.assert_allclose(st[1, 1], 1)  # FP
+    np.testing.assert_allclose(st[2, 3], 1)  # FN
+    # micro: TP=3, FP=1, FN=1 -> P=0.75, R=0.75
+    np.testing.assert_allclose(bm[3], 0.75, rtol=1e-5)
+    np.testing.assert_allclose(bm[4], 0.75, rtol=1e-5)

@@ -1,0 +1,30 @@
+"""Sanity tests for the OpTest harness itself, on known-good ops."""
+import numpy as np
+import pytest
+
+from op_test import check_output, check_grad
+
+
+def test_check_output_matmul():
+    a = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    b = np.random.RandomState(1).randn(4, 5).astype(np.float32)
+    check_output("matmul", {"X": [a], "Y": [b]}, {}, {"Out": [a @ b]},
+                 rtol=1e-4, atol=1e-5)
+
+
+def test_check_grad_matmul():
+    a = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    b = np.random.RandomState(1).randn(4, 5).astype(np.float32)
+    check_grad("matmul", {"X": [a], "Y": [b]}, {}, wrt=["X", "Y"])
+
+
+def test_check_grad_softmax():
+    x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+    check_grad("softmax", {"X": [x]}, {"axis": -1}, wrt=["X"])
+
+
+def test_check_output_catches_mismatch():
+    a = np.ones((2, 2), np.float32)
+    with pytest.raises(AssertionError):
+        check_output("matmul", {"X": [a], "Y": [a]}, {},
+                     {"Out": [np.zeros((2, 2))]})

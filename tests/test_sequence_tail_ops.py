@@ -1,0 +1,92 @@
+"""OpTest coverage for the sequence tail ops (slice/erase/scatter/enumerate/
+reshape/expand/topk_avg_pooling) on the padded+lengths representation."""
+import numpy as np
+
+import paddle_tpu  # noqa: F401
+from op_test import run_op
+
+R = np.random.RandomState(3)
+
+
+def test_sequence_slice():
+    x = np.arange(2 * 5 * 2, dtype=np.float32).reshape(2, 5, 2)
+    off = np.array([[0], [1]], np.int64)
+    ln = np.array([[2], [1]], np.int64)
+    out = run_op("sequence_slice", {"X": [x], "Offset": [off],
+                                    "Length": [ln]}, {})
+    o = np.asarray(out["Out"][0])
+    np.testing.assert_allclose(o[0, :2], x[0, 0:2])
+    np.testing.assert_allclose(o[1, :1], x[1, 1:2])
+    assert (o[0, 2:] == 0).all() and (o[1, 1:] == 0).all()
+    np.testing.assert_array_equal(np.asarray(out["SeqLenOut"][0]), [2, 1])
+
+
+def test_sequence_erase():
+    x = np.array([[2, 2, 6, 1, 3], [9, 6, 1, 0, 1]], np.int64)
+    lens = np.array([5, 4], np.int64)
+    out = run_op("sequence_erase", {"X": [x], "SeqLen": [lens]},
+                 {"tokens": [2, 1]})
+    o = np.asarray(out["Out"][0])
+    nl = np.asarray(out["SeqLenOut"][0])
+    np.testing.assert_array_equal(o[0, :3], [6, 3, 0])   # 6,3 kept then pad
+    np.testing.assert_array_equal(nl, [2, 3])             # row1: 9,6,0 kept
+    np.testing.assert_array_equal(o[1, :3], [9, 6, 0])
+
+
+def test_sequence_scatter():
+    x = np.zeros((2, 6), np.float32)
+    ids = np.array([[1, 3, 1], [0, 2, 0]], np.int64)
+    upd = np.array([[1., 2., 3.], [4., 5., 6.]], np.float32)
+    lens = np.array([3, 2], np.int64)
+    out = np.asarray(run_op("sequence_scatter",
+                            {"X": [x], "Ids": [ids], "Updates": [upd],
+                             "SeqLen": [lens]}, {})["Out"][0])
+    assert out[0, 1] == 4.0 and out[0, 3] == 2.0       # two adds at pos 1
+    assert out[1, 0] == 4.0 and out[1, 2] == 5.0       # 3rd entry masked
+
+
+def test_sequence_enumerate():
+    x = np.array([[1, 2, 3, 4]], np.int64)
+    lens = np.array([3], np.int64)
+    out = np.asarray(run_op("sequence_enumerate",
+                            {"X": [x], "SeqLen": [lens]},
+                            {"win_size": 2, "pad_value": 0})["Out"][0])
+    np.testing.assert_array_equal(out[0, 0], [1, 2])
+    np.testing.assert_array_equal(out[0, 1], [2, 3])
+    np.testing.assert_array_equal(out[0, 2], [3, 0])   # window past length
+
+
+def test_sequence_reshape():
+    x = np.arange(2 * 4 * 6, dtype=np.float32).reshape(2, 4, 6)
+    lens = np.array([2, 4], np.int64)
+    out = run_op("sequence_reshape", {"X": [x], "SeqLen": [lens]},
+                 {"new_dim": 3})
+    o = np.asarray(out["Out"][0])
+    assert o.shape == (2, 8, 3)
+    np.testing.assert_array_equal(np.asarray(out["SeqLenOut"][0]), [4, 8])
+    np.testing.assert_allclose(o[0, 0], x[0, 0, :3])
+
+
+def test_sequence_expand():
+    x = np.array([[1., 2.], [3., 4.]], np.float32)    # one row per seq
+    y = np.zeros((2, 3, 5), np.float32)
+    ylen = np.array([2, 3], np.int64)
+    out = np.asarray(run_op("sequence_expand",
+                            {"X": [x], "Y": [y], "YSeqLen": [ylen]},
+                            {})["Out"][0])
+    np.testing.assert_allclose(out[0, :2], [[1, 2], [1, 2]])
+    np.testing.assert_allclose(out[0, 2], [0, 0])
+    np.testing.assert_allclose(out[1], [[3, 4]] * 3)
+
+
+def test_sequence_topk_avg_pooling():
+    x = R.randn(1, 2, 3, 6).astype(np.float32)
+    col = np.array([4], np.int64)
+    out = np.asarray(run_op("sequence_topk_avg_pooling",
+                            {"X": [x], "COLUMN": [col]},
+                            {"topks": [1, 3], "channel_num": 2})["Out"][0])
+    assert out.shape == (1, 3, 4)
+    # k=1 slot for channel 0, row 0 = max over the 4 valid cols
+    assert abs(out[0, 0, 0] - x[0, 0, 0, :4].max()) < 1e-5
+    top3 = np.sort(x[0, 0, 0, :4])[-3:].mean()
+    assert abs(out[0, 0, 1] - top3) < 1e-5
