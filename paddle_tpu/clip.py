@@ -15,14 +15,25 @@ class GradientClipBase:
         raise NotImplementedError
 
 
+def _split_sparse(params_grads):
+    """SelectedRows grads pass through unclipped, like the reference
+    (clip.py skips sparse grads with a warning)."""
+    dense = [(p, g) for p, g in params_grads
+             if not getattr(g, "_is_selected_rows", False)]
+    sparse = [(p, g) for p, g in params_grads
+              if getattr(g, "_is_selected_rows", False)]
+    return dense, sparse
+
+
 class GradientClipByValue(GradientClipBase):
     def __init__(self, max, min=None):
         self.max = max
         self.min = -max if min is None else min
 
     def __call__(self, params_grads):
+        dense, sparse = _split_sparse(params_grads)
         return [(p, layers.clip(g, self.min, self.max))
-                for p, g in params_grads]
+                for p, g in dense] + sparse
 
 
 class GradientClipByNorm(GradientClipBase):
@@ -30,8 +41,9 @@ class GradientClipByNorm(GradientClipBase):
         self.clip_norm = clip_norm
 
     def __call__(self, params_grads):
+        dense, sparse = _split_sparse(params_grads)
         return [(p, layers.clip_by_norm(g, self.clip_norm))
-                for p, g in params_grads]
+                for p, g in dense] + sparse
 
 
 class GradientClipByGlobalNorm(GradientClipBase):
@@ -42,6 +54,7 @@ class GradientClipByGlobalNorm(GradientClipBase):
         self.clip_norm = clip_norm
 
     def __call__(self, params_grads):
+        params_grads, sparse = _split_sparse(params_grads)
         helper_sums = []
         for _, g in params_grads:
             sq = layers.reduce_sum(layers.square(g))
@@ -52,7 +65,7 @@ class GradientClipByGlobalNorm(GradientClipBase):
         denom = layers.elementwise_max(global_norm, clip_var)
         scale = layers.elementwise_div(clip_var, denom)
         return [(p, layers.elementwise_mul(g, scale))
-                for p, g in params_grads]
+                for p, g in params_grads] + sparse
 
 
 ClipGradByValue = GradientClipByValue

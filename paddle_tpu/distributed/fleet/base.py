@@ -244,9 +244,17 @@ class _Fleet:
         self._kv_client = ShardedKVClient(eps,
                                           worker_id=self.worker_index(),
                                           a_sync=a_sync)
+        # Geo-SGD: a_sync + k_steps>0 turns hooks into k-step local training
+        # with param-delta pushes (reference geo_sgd_transpiler.py +
+        # communicator.h:413)
+        geo_k = 0
+        if self._strategy and self._strategy.a_sync:
+            geo_k = int((self._strategy.a_sync_configs or {})
+                        .get("k_steps", 0))
         hooks = getattr(default_main_program(), "_ps_hooks", None) or []
         for h in hooks:
             h.client = self._kv_client
+            h.geo_k = geo_k
         return self._kv_client
 
     def stop_worker(self):

@@ -34,7 +34,8 @@ def _lib():
         lib.kvs_create.restype = ctypes.c_void_p
         lib.kvs_create.argtypes = [ctypes.c_int, ctypes.POINTER(ctypes.c_int),
                                    ctypes.POINTER(ctypes.c_float),
-                                   ctypes.c_uint64]
+                                   ctypes.c_uint64,
+                                   ctypes.POINTER(ctypes.c_int)]
         lib.kvs_start.restype = ctypes.c_int
         lib.kvs_start.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.kvs_stop.argtypes = [ctypes.c_void_p]
@@ -58,6 +59,12 @@ def _lib():
                                  ctypes.POINTER(ctypes.c_float),
                                  ctypes.c_uint, ctypes.c_float]
         lib.kvc_push_async.argtypes = lib.kvc_push.argtypes
+        lib.kvc_push_delta.restype = ctypes.c_int
+        lib.kvc_push_delta.argtypes = [ctypes.c_void_p, ctypes.c_uint,
+                                       ctypes.POINTER(ctypes.c_longlong),
+                                       ctypes.c_longlong,
+                                       ctypes.POINTER(ctypes.c_float),
+                                       ctypes.c_uint]
         lib.kvc_flush.argtypes = [ctypes.c_void_p]
         lib.kvc_ping.restype = ctypes.c_int
         lib.kvc_ping.argtypes = [ctypes.c_void_p]
@@ -74,11 +81,21 @@ def _lib():
     return lib
 
 
+_OPT_CODES = {"sgd": 0, "adagrad": 1, "adam": 2}
+
+
 class SparseTableConfig:
-    def __init__(self, name: str, dim: int, init_scale: float = 0.01):
+    def __init__(self, name: str, dim: int, init_scale: float = 0.01,
+                 optimizer: str = "sgd"):
+        """`optimizer` picks the SERVER-side update rule (the reference's
+        pservers run arbitrary optimizer blocks, listen_and_serv_op.cc:127 /
+        lookup_sparse_table_fuse_adam_op.cc): sgd | adagrad | adam, with
+        per-row moment states held in the C++ table."""
         self.name = name
         self.dim = int(dim)
         self.init_scale = float(init_scale)
+        assert optimizer in _OPT_CODES, f"unknown server optimizer {optimizer}"
+        self.optimizer = optimizer
 
 
 class KVServer:
@@ -90,7 +107,9 @@ class KVServer:
         dims = (ctypes.c_int * len(tables))(*[t.dim for t in tables])
         scales = (ctypes.c_float * len(tables))(
             *[t.init_scale for t in tables])
-        self._h = self._lib.kvs_create(len(tables), dims, scales, seed)
+        opts = (ctypes.c_int * len(tables))(
+            *[_OPT_CODES[getattr(t, "optimizer", "sgd")] for t in tables])
+        self._h = self._lib.kvs_create(len(tables), dims, scales, seed, opts)
         self.port = None
 
     def start(self, port: int = 0) -> int:
@@ -153,6 +172,19 @@ class KVClient:
                 grads.shape[1], float(lr))
         if not self.a_sync and rc != 0:
             raise IOError("kv push failed")
+
+    def push_delta(self, table: int, keys: np.ndarray, deltas: np.ndarray):
+        """Geo-SGD: server applies w += delta (no lr)."""
+        keys = np.ascontiguousarray(keys, np.int64)
+        deltas = np.ascontiguousarray(deltas, np.float32)
+        rc = self._lib.kvc_push_delta(
+            self._h, table,
+            keys.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+            len(keys),
+            deltas.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            deltas.shape[1])
+        if rc != 0:
+            raise IOError("kv push_delta failed")
 
     def flush(self):
         self._lib.kvc_flush(self._h)
@@ -217,6 +249,16 @@ class ShardedKVClient:
             if m.any():
                 c.push(table, keys[m], np.ascontiguousarray(grads[m]), lr)
 
+    def push_delta(self, table: int, keys: np.ndarray, deltas: np.ndarray):
+        keys = np.ascontiguousarray(keys, np.int64)
+        if len(self.clients) == 1:
+            return self.clients[0].push_delta(table, keys, deltas)
+        shard = self._shard(keys)
+        for s, c in enumerate(self.clients):
+            m = shard == s
+            if m.any():
+                c.push_delta(table, keys[m], np.ascontiguousarray(deltas[m]))
+
     def flush(self):
         for c in self.clients:
             c.flush()
@@ -238,7 +280,16 @@ class ShardedKVClient:
 # ---------------------------------------------------------------------------
 
 class _PsHook:
-    """Pre/post hook pair the Executor fires around each run."""
+    """Pre/post hook pair the Executor fires around each run.
+
+    Two modes (reference communicator.h):
+    - sync/async (geo_k == 0): pull fresh rows each step, push grads after
+      (the server applies its configured optimizer rule).
+    - Geo-SGD (geo_k > 0, communicator.h:413 GeoCommunicator): the trainer
+      keeps LOCAL row copies and trains them with local SGD; every k-th
+      step it pushes param DELTAS (local - base) and re-pulls, so multiple
+      trainers' deltas merge additively on the server.
+    """
 
     def __init__(self, table_idx: int, ids_name: str, pulled_name: str,
                  grad_name: str, dim: int, lr: float):
@@ -250,11 +301,31 @@ class _PsHook:
         self.lr = lr
         self.client: Optional[KVClient] = None
         self._last_uniq = None
+        # geo state — bounded to the ids touched since the last sync (the
+        # reference GeoCommunicator sends only recently-touched ids too)
+        self.geo_k = 0
+        self._step = 0
+        self._local: dict = {}     # id -> local row (np)
+        self._base: dict = {}      # id -> row at last sync
+        self._touched: set = set()
+
+    def _geo_rows(self, uniq: np.ndarray) -> np.ndarray:
+        missing = np.asarray([k for k in uniq if k not in self._local],
+                             np.int64)
+        if len(missing):
+            pulled = self.client.pull(self.table_idx, missing, self.dim)
+            for k, row in zip(missing, pulled):
+                self._local[k] = row.copy()
+                self._base[k] = row.copy()
+        return np.stack([self._local[k] for k in uniq])
 
     def pre(self, feed: dict) -> dict:
         ids = np.asarray(feed[self.ids_name]).reshape(-1)
         uniq, inverse = np.unique(ids, return_inverse=True)
-        rows = self.client.pull(self.table_idx, uniq, self.dim)
+        if self.geo_k > 0:
+            rows = self._geo_rows(uniq)
+        else:
+            rows = self.client.pull(self.table_idx, uniq, self.dim)
         # pad the row count to a power-of-two bucket: the jitted step
         # specializes on feed shapes, so raw unique counts would recompile
         # every batch (same trick as the reference's fixed-capacity pull
@@ -270,9 +341,36 @@ class _PsHook:
 
     def post(self, fetched: dict):
         g = fetched.get(self.grad_name)
-        if g is not None and self._last_uniq is not None:
-            g = np.asarray(g)[:len(self._last_uniq)]
+        if g is None or self._last_uniq is None:
+            return
+        g = np.asarray(g)[:len(self._last_uniq)]
+        if self.geo_k <= 0:
             self.client.push(self.table_idx, self._last_uniq, g, self.lr)
+            return
+        # geo: local SGD step on the cached rows
+        for k, grow in zip(self._last_uniq, g):
+            self._local[k] -= self.lr * grow
+            self._touched.add(int(k))
+        self._step += 1
+        if self._step % self.geo_k == 0:
+            self._geo_sync()
+
+    def _geo_sync(self):
+        """Push deltas for ids touched since the last sync, re-pull them,
+        then evict everything else — bounding trainer memory and per-sync
+        traffic to the recent working set (untouched cached rows are stale
+        against other trainers anyway; next use re-pulls them)."""
+        if not self._touched:
+            self._local.clear()
+            self._base.clear()
+            return
+        keys = np.fromiter(self._touched, np.int64, count=len(self._touched))
+        delta = np.stack([self._local[k] - self._base[k] for k in keys])
+        self.client.push_delta(self.table_idx, keys, delta)
+        fresh = self.client.pull(self.table_idx, keys, self.dim)
+        self._local = {int(k): row.copy() for k, row in zip(keys, fresh)}
+        self._base = {int(k): row.copy() for k, row in zip(keys, fresh)}
+        self._touched.clear()
 
 
 def distributed_embedding(ids, table_name: str, dim: int,

@@ -81,11 +81,14 @@ class _GradAccumulator:
             # fresh var then treat it as canonical going forward
             sum_out = f"{gname}@MERGED"
         fwd = self.block.var(var_name)
-        self.block.create_var(name=sum_out, shape=fwd.shape, dtype=fwd.dtype,
-                              stop_gradient=True)
+        out_var = self.block.create_var(name=sum_out, shape=fwd.shape,
+                                        dtype=fwd.dtype, stop_gradient=True)
         self.block.append_op("sum", inputs={"X": list(lst)},
                              outputs={"Out": [sum_out]},
                              attrs={"op_role": OpRole.Backward})
+        if all(getattr(self.block.var(n), "_is_selected_rows", False)
+               for n in lst):   # sparse+sparse stays SelectedRows
+            out_var._is_selected_rows = True
         self.contribs[var_name] = [sum_out]
         return sum_out
 
@@ -176,6 +179,20 @@ def append_backward(loss: Variable, parameter_list=None,
                     ig.append("@EMPTY@")
             if slot_has:
                 grad_outputs[f"IG:{slot}"] = ig
+
+        # is_sparse embeddings get a SelectedRows grad op instead of the
+        # dense __vjp__ (reference lookup_table_op.cc is_sparse grad branch)
+        if op.type in ("lookup_table", "lookup_table_v2") \
+                and op.attrs.get("is_sparse", False) \
+                and list(grad_outputs) == ["IG:W"]:
+            block.append_op(
+                "lookup_table_sparse_grad", inputs=grad_inputs,
+                outputs=grad_outputs,
+                attrs={"padding_idx": op.attrs.get("padding_idx", -1),
+                       "op_role": OpRole.Backward})
+            gvar = block.var(grad_outputs["IG:W"][0])
+            gvar._is_selected_rows = True
+            continue
 
         attrs = registry.make_vjp_attrs(op, diff_entries, out_slots)
         block.append_op("__vjp__", inputs=grad_inputs, outputs=grad_outputs,

@@ -255,8 +255,10 @@ def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
 
 def embedding(input, size, is_sparse=False, is_distributed=False,
               padding_idx=None, param_attr=None, dtype="float32"):
-    """Reference layers/nn.py embedding → lookup_table op. is_sparse is a
-    no-op on TPU (grads are dense segment-sums; see SURVEY §7 hard parts)."""
+    """Reference layers/nn.py embedding → lookup_table op. is_sparse=True
+    produces a SelectedRows-equivalent row-sparse gradient (O(batch) HBM
+    instead of O(vocab); ops/sparse_grad.py) that the optimizer kernels
+    scatter-apply."""
     helper = LayerHelper("embedding")
     w = helper.create_parameter(param_attr, list(size), dtype=dtype)
     if is_distributed:
@@ -264,7 +266,9 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     out = helper.create_variable_for_type_inference(dtype)
     helper.append_op("lookup_table", inputs={"W": [w], "Ids": [input]},
                      outputs={"Out": [out]},
-                     attrs={"padding_idx": -1 if padding_idx is None else padding_idx})
+                     attrs={"padding_idx": -1 if padding_idx is None
+                            else padding_idx,
+                            "is_sparse": bool(is_sparse)})
     return out
 
 

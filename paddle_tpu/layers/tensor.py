@@ -7,7 +7,8 @@ from ..framework.dtype import convert_dtype, dtype_name
 from ..layer_helper import LayerHelper
 
 __all__ = [
-    "fill_constant", "fill_constant_batch_size_like", "zeros", "ones",
+    "fill_constant", "fill_constant_batch_size_like", "fill_constant_like",
+    "full_like", "zeros", "ones",
     "zeros_like", "ones_like", "assign", "create_tensor",
     "create_global_var", "create_parameter", "linspace", "eye", "diag",
     "range", "shape", "uniform_random", "gaussian_random", "tril", "triu",
@@ -24,6 +25,22 @@ def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
     # build-time constant tag: lets array_write size its buffer statically
     out._const_value = float(value)
     return out
+
+
+def fill_constant_like(x, value, dtype=None, name=None):
+    """reference layers fill_constant like-shape helper (fill_any_like op)."""
+    helper = LayerHelper("fill_constant_like")
+    out = helper.create_variable_for_type_inference(dtype or x.dtype)
+    attrs = {"value": float(value)}
+    if dtype is not None:
+        attrs["dtype"] = dtype_name(convert_dtype(dtype))
+    helper.append_op("fill_any_like", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return fill_constant_like(x, fill_value, dtype, name)
 
 
 def fill_constant_batch_size_like(input, shape, dtype, value,

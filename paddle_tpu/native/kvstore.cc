@@ -20,10 +20,16 @@
 //   request : u8 op | u32 table | u64 n | u32 dim | payload
 //   response: u64 n_bytes | payload
 //   PULL(1): keys i64[n]            -> f32[n*dim]
-//   PUSH(2): lr f32, keys i64[n], grads f32[n*dim] -> u8 ok (w -= lr*g)
+//   PUSH(2): lr f32, keys i64[n], grads f32[n*dim] -> u8 ok
+//            (server-side optimizer: sgd / adagrad / adam row states —
+//             the reference runs arbitrary optimizer blocks on the pserver,
+//             listen_and_serv_op.cc:127 + lookup_sparse_table_fuse_*_op)
 //   PING(3): worker_id u32          -> u8 ok       (heartbeat)
 //   SIZE(4):                        -> u64 rows
 //   SAVE(5)/LOAD(6): path bytes     -> u8 ok
+//   PUSH_DELTA(7): keys i64[n], delta f32[n*dim] -> u8 ok (w += delta) —
+//            the Geo-SGD k-step param-delta protocol (communicator.h:413
+//            GeoCommunicator; trainers train locally, send deltas)
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -33,6 +39,7 @@
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
+#include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <fstream>
@@ -58,8 +65,15 @@ struct Table {
   int dim = 0;
   float init_scale = 0.0f;
   uint64_t seed = 0;
+  // server-side optimizer (reference pservers run optimizer blocks):
+  // 0 = sgd, 1 = adagrad (state: G[dim]), 2 = adam (state: m[dim] v[dim] t)
+  int opt = 0;
+  float beta1 = 0.9f, beta2 = 0.999f, eps = 1e-8f;
   std::unordered_map<int64_t, std::vector<float>> shard[kShards];
+  std::unordered_map<int64_t, std::vector<float>> state[kShards];
   std::mutex mu[kShards];
+
+  int StateDim() const { return opt == 1 ? dim : (opt == 2 ? 2 * dim + 1 : 0); }
 
   void InitRow(int64_t key, std::vector<float>* row) const {
     row->resize(dim);
@@ -99,7 +113,51 @@ struct Table {
       }
       float* w = it->second.data();
       const float* g = grads + i * dim;
-      for (int j = 0; j < dim; ++j) w[j] -= lr * g[j];
+      if (opt == 0) {
+        for (int j = 0; j < dim; ++j) w[j] -= lr * g[j];
+      } else {
+        auto st = state[s].find(k);
+        if (st == state[s].end()) {
+          st = state[s].emplace(k, std::vector<float>(StateDim(), 0.f)).first;
+        }
+        float* sv = st->second.data();
+        if (opt == 1) {  // adagrad
+          for (int j = 0; j < dim; ++j) {
+            sv[j] += g[j] * g[j];
+            w[j] -= lr * g[j] / (std::sqrt(sv[j]) + eps);
+          }
+        } else {  // adam
+          float t = sv[2 * dim] + 1.f;
+          sv[2 * dim] = t;
+          float bc1 = 1.f - std::pow(beta1, t);
+          float bc2 = 1.f - std::pow(beta2, t);
+          float lr_t = lr * std::sqrt(bc2) / bc1;
+          for (int j = 0; j < dim; ++j) {
+            sv[j] = beta1 * sv[j] + (1.f - beta1) * g[j];
+            sv[dim + j] = beta2 * sv[dim + j] + (1.f - beta2) * g[j] * g[j];
+            w[j] -= lr_t * sv[j] / (std::sqrt(sv[dim + j]) + eps);
+          }
+        }
+      }
+    }
+  }
+
+  // Geo-SGD delta apply: w += delta (communicator.h:413 GeoCommunicator's
+  // server-side recv-and-add; no lr, trainers already applied their rule)
+  void PushDelta(const int64_t* keys, uint64_t n, const float* deltas) {
+    for (uint64_t i = 0; i < n; ++i) {
+      int64_t k = keys[i];
+      int s = (int)(splitmix64((uint64_t)k) % kShards);
+      std::lock_guard<std::mutex> lk(mu[s]);
+      auto it = shard[s].find(k);
+      if (it == shard[s].end()) {
+        std::vector<float> row;
+        InitRow(k, &row);
+        it = shard[s].emplace(k, std::move(row)).first;
+      }
+      float* w = it->second.data();
+      const float* d = deltas + i * dim;
+      for (int j = 0; j < dim; ++j) w[j] += d[j];
     }
   }
 
@@ -116,12 +174,21 @@ struct Table {
     std::ofstream f(path, std::ios::binary);
     if (!f) return false;
     uint32_t d = dim;
-    f.write((char*)&d, 4);
+    uint32_t sd = (uint32_t)StateDim();  // optimizer state persists too —
+    f.write((char*)&d, 4);               // else LOAD would silently reset
+    f.write((char*)&sd, 4);              // adam/adagrad moments
+    std::vector<float> zero_state(sd, 0.f);
     for (int s = 0; s < kShards; ++s) {
       std::lock_guard<std::mutex> lk(mu[s]);
       for (auto& kv : shard[s]) {
         f.write((char*)&kv.first, 8);
         f.write((char*)kv.second.data(), dim * sizeof(float));
+        if (sd) {
+          auto st = state[s].find(kv.first);
+          const float* sv =
+              st != state[s].end() ? st->second.data() : zero_state.data();
+          f.write((char*)sv, sd * sizeof(float));
+        }
       }
     }
     return (bool)f;
@@ -130,16 +197,24 @@ struct Table {
   bool Load(const std::string& path) {
     std::ifstream f(path, std::ios::binary);
     if (!f) return false;
-    uint32_t d = 0;
+    uint32_t d = 0, sd = 0;
     f.read((char*)&d, 4);
-    if (d != (uint32_t)dim) return false;
+    f.read((char*)&sd, 4);
+    if (d != (uint32_t)dim || sd != (uint32_t)StateDim()) return false;
+    for (int s = 0; s < kShards; ++s) {  // stale state must not pair with
+      std::lock_guard<std::mutex> lk(mu[s]);  // freshly loaded weights
+      state[s].clear();
+    }
     int64_t key;
     std::vector<float> row(dim);
+    std::vector<float> srow(sd);
     while (f.read((char*)&key, 8)) {
       if (!f.read((char*)row.data(), dim * sizeof(float))) break;
+      if (sd && !f.read((char*)srow.data(), sd * sizeof(float))) break;
       int s = (int)(splitmix64((uint64_t)key) % kShards);
       std::lock_guard<std::mutex> lk(mu[s]);
       shard[s][key] = row;
+      if (sd) state[s][key] = srow;
     }
     return true;
   }
@@ -170,13 +245,14 @@ static bool RecvAll(int fd, void* buf, size_t n) {
 class KVServer {
  public:
   KVServer(int n_tables, const int* dims, const float* init_scales,
-           uint64_t seed) {
+           uint64_t seed, const int* opt_types) {
     tables_.resize(n_tables);
     for (int t = 0; t < n_tables; ++t) {
       tables_[t] = new Table();
       tables_[t]->dim = dims[t];
       tables_[t]->init_scale = init_scales ? init_scales[t] : 0.01f;
       tables_[t]->seed = seed ^ splitmix64((uint64_t)t + 7);
+      tables_[t]->opt = opt_types ? opt_types[t] : 0;
     }
   }
 
@@ -304,6 +380,14 @@ class KVServer {
       } else if (hdr.op == 4 && tb) {  // SIZE
         uint64_t nb = 8, rows = tb->Size();
         if (!SendAll(fd, &nb, 8) || !SendAll(fd, &rows, 8)) break;
+      } else if (hdr.op == 7 && tb) {  // PUSH_DELTA (geo)
+        payload.resize(hdr.n * 8 + hdr.n * tb->dim * sizeof(float));
+        if (!RecvAll(fd, payload.data(), payload.size())) break;
+        tb->PushDelta((const int64_t*)payload.data(), hdr.n,
+                      (const float*)(payload.data() + hdr.n * 8));
+        uint64_t nb = 1;
+        uint8_t ok = 1;
+        if (!SendAll(fd, &nb, 8) || !SendAll(fd, &ok, 1)) break;
       } else if ((hdr.op == 5 || hdr.op == 6) && tb) {  // SAVE/LOAD
         payload.resize(hdr.n);
         if (!RecvAll(fd, payload.data(), hdr.n)) break;
@@ -375,6 +459,17 @@ class KVClient {
             const float* grads, uint32_t dim, float lr) {
     std::lock_guard<std::mutex> lk(io_mu_);
     return PushLocked(table, keys, n, grads, dim, lr);
+  }
+
+  bool PushDelta(uint32_t table, const int64_t* keys, uint64_t n,
+                 const float* deltas, uint32_t dim) {
+    std::lock_guard<std::mutex> lk(io_mu_);
+    if (!Send(7, table, n, dim)) return false;
+    if (!SendAll(fd_, keys, n * 8)) return false;
+    if (!SendAll(fd_, deltas, n * dim * sizeof(float))) return false;
+    uint64_t nb;
+    uint8_t ok;
+    return RecvAll(fd_, &nb, 8) && RecvAll(fd_, &ok, 1) && ok == 1;
   }
 
   // async path (reference AsyncCommunicator): merge grads by key host-side,
@@ -497,8 +592,8 @@ class KVClient {
 extern "C" {
 
 void* kvs_create(int n_tables, const int* dims, const float* init_scales,
-                 unsigned long long seed) {
-  return new KVServer(n_tables, dims, init_scales, seed);
+                 unsigned long long seed, const int* opt_types) {
+  return new KVServer(n_tables, dims, init_scales, seed, opt_types);
 }
 
 int kvs_start(void* s, int port) {
@@ -534,6 +629,14 @@ int kvc_push(void* c, unsigned table, const long long* keys, long long n,
              const float* grads, unsigned dim, float lr) {
   return static_cast<KVClient*>(c)->Push(table, (const int64_t*)keys,
                                          (uint64_t)n, grads, dim, lr)
+             ? 0
+             : -1;
+}
+
+int kvc_push_delta(void* c, unsigned table, const long long* keys,
+                   long long n, const float* deltas, unsigned dim) {
+  return static_cast<KVClient*>(c)->PushDelta(table, (const int64_t*)keys,
+                                              (uint64_t)n, deltas, dim)
              ? 0
              : -1;
 }

@@ -171,7 +171,15 @@ def _box_coder(ctx, ins, attrs):
             out = out / jnp.broadcast_to(pvar, out.shape)
         return {"OutputBox": [out]}
 
-    # decode: target [N, M, 4] deltas (axis=0 semantics)
+    # decode: target [N, M, 4] deltas; `axis` picks which target dim the
+    # priors align with (box_coder_op.h axis attr): 0 -> priors along dim 1
+    # (broadcast over rows), 1 -> priors along dim 0
+    axis = attrs.get("axis", 0)
+    if target.ndim == 3 and axis == 1:
+        pw = pw[:, None]
+        ph = ph[:, None]
+        pcx = pcx[:, None]
+        pcy = pcy[:, None]
     d = target
     if pvar is not None:
         d = d * jnp.broadcast_to(pvar, d.shape)

@@ -709,8 +709,12 @@ def _conv3d_transpose(ctx, ins, attrs):
     x, w = ins["Input"][0], ins["Filter"][0]
     strides = _triple(attrs.get("strides", [1, 1, 1]))
     pads = _triple(attrs.get("paddings", [0, 0, 0]))
+    dil = _triple(attrs.get("dilations", [1, 1, 1]))
+    # transpose_kernel=True = gradient-of-conv (the reference's semantics),
+    # matching the 2D lowering in nn_ops.py
     out = jax.lax.conv_transpose(
         x, w, strides=strides, padding=[(p, p) for p in pads],
+        rhs_dilation=dil, transpose_kernel=True,
         dimension_numbers=("NCDHW", "IODHW", "NCDHW"))
     return {"Output": [out]}
 

@@ -174,6 +174,23 @@ def _cast(ctx, ins, attrs):
 @register("sum")
 def _sum(ctx, ins, attrs):
     xs = ins["X"]
+    from .sparse_grad import SelectedRows, is_selected_rows
+    if any(is_selected_rows(x) for x in xs):
+        # SelectedRows grad accumulation (selected_rows_functor.cc MergeAdd):
+        # all-sparse -> concatenate rows; mixed -> scatter into the dense one
+        sparse = [x for x in xs if is_selected_rows(x)]
+        dense = [x for x in xs if not is_selected_rows(x)]
+        if not dense:
+            import jax.numpy as _jnp
+            return {"Out": [SelectedRows(
+                rows=_jnp.concatenate([s.rows for s in sparse], axis=0),
+                ids=_jnp.concatenate([s.ids for s in sparse], axis=0))]}
+        out = dense[0]
+        for x in dense[1:]:
+            out = out + x
+        for s in sparse:
+            out = out.at[s.ids].add(s.rows.astype(out.dtype), mode="drop")
+        return {"Out": [out]}
     out = xs[0]
     for x in xs[1:]:
         out = out + x

@@ -21,6 +21,12 @@ _OPT = dict(nondiff_slots=("Param", "Grad", "LearningRate", "Moment", "Moment1",
 @register("sgd", **_OPT)
 def _sgd(ctx, ins, attrs):
     p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
+    from .sparse_grad import is_selected_rows
+    if is_selected_rows(g):
+        # row-sparse apply (sgd_op.h SelectedRows branch); scatter-add
+        # handles duplicate ids, so no merge needed for a linear update
+        upd = (-lr.astype(p.dtype)) * g.rows.astype(p.dtype)
+        return {"ParamOut": [p.at[g.ids].add(upd, mode="drop")]}
     return {"ParamOut": [p - lr.astype(p.dtype) * g.astype(p.dtype)]}
 
 
@@ -29,6 +35,24 @@ def _momentum(ctx, ins, attrs):
     p, g = ins["Param"][0], ins["Grad"][0]
     v, lr = ins["Velocity"][0], ins["LearningRate"][0]
     mu = attrs.get("mu", 0.9)
+    from .sparse_grad import is_selected_rows, merge_rows
+    if is_selected_rows(g):
+        # momentum_op.h SelectedRows branch: merged rows-only update
+        sr = merge_rows(g, p.shape[0])
+        ids = sr.ids
+        gr = sr.rows.astype(v.dtype)
+        rd = attrs.get("regularization_coeff", 0.0)
+        if attrs.get("regularization_method", "") == "l2_decay" and rd:
+            gr = gr + rd * p.at[ids].get(mode="fill",
+                                         fill_value=0).astype(v.dtype)
+        v_rows = mu * v.at[ids].get(mode="fill", fill_value=0) + gr
+        if attrs.get("use_nesterov", False):
+            upd = lr * (gr + mu * v_rows)
+        else:
+            upd = lr * v_rows
+        return {"ParamOut": [p.at[ids].add(-upd.astype(p.dtype),
+                                           mode="drop")],
+                "VelocityOut": [v.at[ids].set(v_rows, mode="drop")]}
     rd = attrs.get("regularization_coeff", 0.0)
     if attrs.get("regularization_method", "") == "l2_decay" and rd:
         g = g + rd * p
@@ -64,6 +88,24 @@ def _adam(ctx, ins, attrs):
     b1 = attrs.get("beta1", 0.9)
     b2 = attrs.get("beta2", 0.999)
     eps = attrs.get("epsilon", 1e-8)
+    from .sparse_grad import is_selected_rows, merge_rows
+    if is_selected_rows(g):
+        # rows-only update = the reference's sparse adam lazy_mode=True
+        # (adam_op.h SelectedRows branch): merge duplicate ids, then update
+        # moments and param at the touched rows only
+        sr = merge_rows(g, p.shape[0])
+        ids = sr.ids
+        gf = sr.rows.astype(m1.dtype)
+        m1_rows = b1 * m1.at[ids].get(mode="fill", fill_value=0) \
+            + (1 - b1) * gf
+        m2_rows = b2 * m2.at[ids].get(mode="fill", fill_value=0) \
+            + (1 - b2) * jnp.square(gf)
+        lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+        upd = (lr_t * m1_rows / (jnp.sqrt(m2_rows) + eps)).astype(p.dtype)
+        return {"ParamOut": [p.at[ids].add(-upd, mode="drop")],
+                "Moment1Out": [m1.at[ids].set(m1_rows, mode="drop")],
+                "Moment2Out": [m2.at[ids].set(m2_rows, mode="drop")],
+                "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2]}
     gf = g.astype(m1.dtype)
     m1_out = b1 * m1 + (1 - b1) * gf
     m2_out = b2 * m2 + (1 - b2) * jnp.square(gf)
@@ -80,6 +122,17 @@ def _adamw(ctx, ins, attrs):
     lr = ins["LearningRate"][0]
     res = _adam(ctx, ins, attrs)
     if not attrs.get("with_decay", True):
+        return res
+    from .sparse_grad import is_selected_rows, merge_rows
+    g = ins["Grad"][0]
+    if is_selected_rows(g):
+        # decay only the touched rows — keeps the lazy sparse invariant
+        # (untouched vocab rows never move) and the O(batch) update cost
+        ids = merge_rows(g, p.shape[0]).ids
+        pout = res["ParamOut"][0]
+        decay = (lr * coeff * pout.at[ids].get(mode="fill", fill_value=0)
+                 ).astype(p.dtype)
+        res["ParamOut"] = [pout.at[ids].add(-decay, mode="drop")]
         return res
     res["ParamOut"] = [res["ParamOut"][0] - (lr * coeff * p).astype(p.dtype)]
     return res
@@ -104,6 +157,16 @@ def _adagrad(ctx, ins, attrs):
     p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
     m = ins["Moment"][0]
     eps = attrs.get("epsilon", 1e-6)
+    from .sparse_grad import is_selected_rows, merge_rows
+    if is_selected_rows(g):
+        # adagrad_op.h SelectedRows branch: merge then rows-only update
+        sr = merge_rows(g, p.shape[0])
+        ids = sr.ids
+        gr = sr.rows.astype(m.dtype)
+        m_rows = m.at[ids].get(mode="fill", fill_value=0) + jnp.square(gr)
+        upd = (lr * gr / (jnp.sqrt(m_rows) + eps)).astype(p.dtype)
+        return {"ParamOut": [p.at[ids].add(-upd, mode="drop")],
+                "MomentOut": [m.at[ids].set(m_rows, mode="drop")]}
     m_out = m + jnp.square(g)
     p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
     return {"ParamOut": [p_out], "MomentOut": [m_out]}

@@ -1,0 +1,72 @@
+"""Row-sparse (SelectedRows-equivalent) gradients for embeddings.
+
+Reference counterparts: framework/selected_rows.h (the {rows, value} sparse
+gradient type), operators/math/selected_rows_functor.cc (merge/apply), the
+lookup_table grad kernel's is_sparse branch (lookup_table_op.cc), and the
+sparse branches of the optimizer kernels (adam_op.h lazy rows path,
+sgd_op.h SelectedRows apply).
+
+TPU-native: a sparse grad is a `SelectedRows(rows [K, D], ids [K])` pytree —
+K is the (static) number of looked-up ids, so the gradient costs O(batch)
+HBM instead of O(vocab). `merge_rows` deduplicates via a static-size
+jnp.unique + segment_sum (out-of-range sentinel ids mark padding; scatter
+ops drop them). Optimizer lowerings (ops/optimizer_ops.py) detect
+SelectedRows grads and scatter-apply only the touched rows — the reference's
+adam `lazy_mode=True` semantics.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+class SelectedRows(NamedTuple):
+    rows: jax.Array      # [K, D] gradient rows
+    ids: jax.Array       # [K] int32 row indices into the [V, D] param
+
+
+def is_selected_rows(v) -> bool:
+    return isinstance(v, SelectedRows)
+
+
+def merge_rows(sr: SelectedRows, vocab: int) -> SelectedRows:
+    """Deduplicate ids, summing their rows (reference
+    selected_rows_functor.cc MergeAdd). Padding slots get the out-of-range
+    sentinel id `vocab`, which scatter `mode='drop'` ignores."""
+    k = sr.ids.shape[0]
+    uniq, inv = jnp.unique(sr.ids, return_inverse=True, size=k,
+                           fill_value=vocab)
+    rows = jax.ops.segment_sum(sr.rows, inv.reshape(-1), num_segments=k)
+    return SelectedRows(rows=rows, ids=uniq.astype(jnp.int32))
+
+
+def densify(sr: SelectedRows, vocab: int) -> jax.Array:
+    """Scatter-add the rows into a dense [V, D] gradient."""
+    dense = jnp.zeros((vocab,) + tuple(sr.rows.shape[1:]), sr.rows.dtype)
+    return dense.at[sr.ids].add(sr.rows, mode="drop")
+
+
+@register("lookup_table_sparse_grad", nondiff_slots=("W", "Ids"),
+          infer=lambda block, op: None)
+def _lookup_table_sparse_grad(ctx, ins, attrs):
+    """Backward of lookup_table with is_sparse=True: instead of the dense
+    scatter-add the generic __vjp__ would produce, emit the rows that were
+    actually touched."""
+    w = ins["W"][0]
+    ids = ins["Ids"][0]
+    og = ins["OG:Out"][0]
+    idx = ids.astype(jnp.int32)
+    if idx.shape and idx.shape[-1] == 1:
+        idx = jnp.squeeze(idx, -1)
+    flat_ids = idx.reshape(-1)
+    dim = w.shape[-1]
+    rows = og.reshape(-1, dim).astype(w.dtype)
+    padding_idx = attrs.get("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        keep = flat_ids != padding_idx
+        rows = jnp.where(keep[:, None], rows, 0.0)
+    return {"IG:W": [SelectedRows(rows=rows, ids=flat_ids)]}

@@ -40,6 +40,15 @@ def _fill_zeros_like(ctx, ins, attrs):
     return {"Out": [jnp.zeros(x.shape, x.dtype)]}
 
 
+@register("fill_any_like")
+def _fill_any_like(ctx, ins, attrs):
+    x = ins["X"][0]
+    from ..framework.dtype import convert_dtype
+    dt = attrs.get("dtype")
+    dtype = convert_dtype(dt) if dt else x.dtype
+    return {"Out": [jnp.full(x.shape, attrs.get("value", 0.0), dtype)]}
+
+
 @register("assign")
 def _assign(ctx, ins, attrs):
     return {"Out": [ins["X"][0]]}

@@ -134,7 +134,9 @@ class Optimizer:
         out = []
         for p, g in params_grads:
             reg = getattr(p, "regularizer", None) or self.regularization
-            if reg is not None:
+            # SelectedRows grads skip regularization, like the reference
+            # (regularizer.py warns and skips sparse grads)
+            if reg is not None and not getattr(g, "_is_selected_rows", False):
                 g = reg._append(p, g)
             out.append((p, g))
         return out

@@ -139,3 +139,141 @@ def test_distributed_embedding_end_to_end(server):
     assert losses[-1] < losses[0] * 0.5, losses[::10]
     assert np.abs(after - before).max() > 1e-4  # server table trained
     fleet.stop_worker()
+
+
+def test_server_side_adam_optimizer():
+    """Pluggable server optimizers (reference pservers run optimizer blocks,
+    listen_and_serv_op.cc:127): adam row states live server-side."""
+    srv = KVServer([SparseTableConfig("t", dim=4, init_scale=0.0,
+                                      optimizer="adam")])
+    port = srv.start(0)
+    try:
+        c = KVClient("127.0.0.1", port)
+        keys = np.array([3], np.int64)
+        g = np.full((1, 4), 0.5, np.float32)
+        c.push(0, keys, g, lr=0.1)
+        w1 = c.pull(0, keys, 4)
+        # adam step 1 from zero state: m=0.05..., update = lr * g/|g| ≈ lr
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        m = (1 - b1) * 0.5
+        v = (1 - b2) * 0.25
+        lr_t = 0.1 * np.sqrt(1 - b2) / (1 - b1)
+        expect = -lr_t * m / (np.sqrt(v) + eps)
+        np.testing.assert_allclose(w1[0], expect, rtol=1e-4)
+        c.push(0, keys, g, lr=0.1)
+        w2 = c.pull(0, keys, 4)
+        assert (w2 < w1).all()   # second step keeps moving
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_server_side_adagrad_optimizer():
+    srv = KVServer([SparseTableConfig("t", dim=2, init_scale=0.0,
+                                      optimizer="adagrad")])
+    port = srv.start(0)
+    try:
+        c = KVClient("127.0.0.1", port)
+        keys = np.array([1], np.int64)
+        g = np.array([[1.0, 2.0]], np.float32)
+        c.push(0, keys, g, lr=0.5)
+        w = c.pull(0, keys, 2)
+        # adagrad: G=g^2; w -= lr*g/(sqrt(G)+eps) = -lr*sign(g)
+        np.testing.assert_allclose(w[0], [-0.5, -0.5], rtol=1e-4)
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_geo_push_delta_merges_two_workers():
+    """Geo protocol op: two workers' deltas accumulate additively
+    (communicator.h:413 Geo semantics)."""
+    srv = KVServer([SparseTableConfig("t", dim=2, init_scale=0.0)])
+    port = srv.start(0)
+    try:
+        c1 = KVClient("127.0.0.1", port, worker_id=0)
+        c2 = KVClient("127.0.0.1", port, worker_id=1)
+        keys = np.array([7], np.int64)
+        c1.push_delta(0, keys, np.array([[1.0, 2.0]], np.float32))
+        c2.push_delta(0, keys, np.array([[10.0, 20.0]], np.float32))
+        w = c1.pull(0, keys, 2)
+        np.testing.assert_allclose(w[0], [11.0, 22.0], rtol=1e-5)
+        c1.close(); c2.close()
+    finally:
+        srv.stop()
+
+
+def test_geo_hook_end_to_end(server):
+    """distributed_embedding in geo mode: server rows move only at k-step
+    syncs, training converges, and the final server state reflects the
+    locally-trained deltas."""
+    from paddle_tpu.distributed import fleet
+    srv, port = server
+
+    ids = fluid.layers.data(name="ids", shape=[3], dtype="int64")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    emb = distributed_embedding(ids, "emb", dim=4, lr=0.2)
+    pred = fluid.layers.fc(layers.reshape(emb, [-1, 12]), size=1)
+    loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+
+    fleet.init(role_maker=fleet.UserDefinedRoleMaker(
+        server_endpoints=[f"127.0.0.1:{port}"]))
+    strategy = fleet.DistributedStrategy()
+    strategy.a_sync = True
+    strategy.a_sync_configs = {"k_steps": 4}
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.SGD(learning_rate=0.1), strategy)
+    opt.minimize(loss)
+    client = fleet.init_worker()
+    hooks = fluid.default_main_program()._ps_hooks
+    assert hooks[0].geo_k == 4
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    ids_np = rng.randint(0, 30, (16, 3)).astype(np.int64)
+    y_np = rng.randn(16, 1).astype(np.float32)
+    uniq = np.unique(ids_np)
+    before = client.pull(0, uniq, 4)
+    losses = []
+    for step in range(3):   # steps 1..3: no sync yet
+        lv, = exe.run(feed={"ids": ids_np, "y": y_np}, fetch_list=[loss])
+        losses.append(float(lv))
+    mid = client.pull(0, uniq, 4)
+    np.testing.assert_allclose(mid, before, rtol=1e-6)  # server untouched
+    lv, = exe.run(feed={"ids": ids_np, "y": y_np}, fetch_list=[loss])
+    losses.append(float(lv))
+    after = client.pull(0, uniq, 4)   # 4th step triggered the delta push
+    assert np.abs(after - before).max() > 1e-4
+    for _ in range(16):
+        lv, = exe.run(feed={"ids": ids_np, "y": y_np}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.5, losses[::5]
+
+
+def test_save_load_preserves_optimizer_state(tmp_path):
+    """Checkpoint round trip must carry the adam row states, not just
+    weights — else the restored server restarts adam from t=1."""
+    srv = KVServer([SparseTableConfig("t", dim=2, init_scale=0.0,
+                                      optimizer="adam")])
+    port = srv.start(0)
+    c = KVClient("127.0.0.1", port)
+    keys = np.array([5], np.int64)
+    g = np.array([[1.0, 1.0]], np.float32)
+    for _ in range(3):
+        c.push(0, keys, g, lr=0.1)
+    w3 = c.pull(0, keys, 2)
+    path = str(tmp_path / "adam_table.bin")
+    c.save(0, path)
+
+    srv2 = KVServer([SparseTableConfig("t", dim=2, init_scale=0.0,
+                                      optimizer="adam")])
+    p2 = srv2.start(0)
+    c2 = KVClient("127.0.0.1", p2)
+    c2.load(0, path)
+    # 4th push on the restored server == 4th push on the original
+    c.push(0, keys, g, lr=0.1)
+    c2.push(0, keys, g, lr=0.1)
+    np.testing.assert_allclose(c2.pull(0, keys, 2), c.pull(0, keys, 2),
+                               rtol=1e-6)
+    c.close(); c2.close(); srv.stop(); srv2.stop()
