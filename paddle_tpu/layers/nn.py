@@ -25,7 +25,7 @@ __all__ = [
     "equal", "not_equal", "less_than", "less_equal", "greater_than",
     "greater_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
     "where", "cond_take", "unique", "cumsum", "prelu", "brelu",
-    "fused_attention",
+    "fused_attention", "switch_moe",
 ]
 
 
@@ -823,3 +823,41 @@ def fused_attention(q, k, v, mask=None, scale=None, dropout=0.0,
     helper.append_op("fused_attention", inputs=inputs,
                      outputs={"Out": [out]}, attrs=attrs)
     return out
+
+
+def switch_moe(input, num_experts, d_ff, capacity_factor=1.25, name=None):
+    """Switch-style top-1 MoE FFN (beyond-reference: makes
+    expert_parallel_degree real; ops/moe.py). Returns (out, aux_loss) — add
+    aux_loss (scaled ~0.01) to the training loss for load balancing. Expert
+    weights are named '<prefix>_expert_w1/w2' so moe_sharding_rules() can
+    shard their leading [E] dim over the mesh's ep axis."""
+    helper = LayerHelper(name or "switch_moe")
+    d = input.shape[-1]
+    from ..framework import unique_name
+    prefix = unique_name.generate(name or "switch_moe")
+    wg = helper.create_parameter(
+        ParamAttr(name=f"{prefix}_gate_w"), [d, num_experts],
+        dtype=dtype_name(input.dtype))
+    w1 = helper.create_parameter(
+        ParamAttr(name=f"{prefix}_expert_w1"), [num_experts, d, d_ff],
+        dtype=dtype_name(input.dtype))
+    b1 = helper.create_parameter(
+        ParamAttr(name=f"{prefix}_expert_b1"), [num_experts, d_ff],
+        dtype=dtype_name(input.dtype), is_bias=True)
+    w2 = helper.create_parameter(
+        ParamAttr(name=f"{prefix}_expert_w2"), [num_experts, d_ff, d],
+        dtype=dtype_name(input.dtype))
+    b2 = helper.create_parameter(
+        ParamAttr(name=f"{prefix}_expert_b2"), [num_experts, d],
+        dtype=dtype_name(input.dtype), is_bias=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    aux = helper.create_variable_for_type_inference(input.dtype)
+    gidx = helper.create_variable_for_type_inference("int64")
+    helper.append_op("switch_moe",
+                     inputs={"X": [input], "GateW": [wg],
+                             "ExpertW1": [w1], "ExpertB1": [b1],
+                             "ExpertW2": [w2], "ExpertB2": [b2]},
+                     outputs={"Out": [out], "AuxLoss": [aux],
+                              "GateIdx": [gidx]},
+                     attrs={"capacity_factor": float(capacity_factor)})
+    return out, aux

@@ -20,7 +20,7 @@ from ..framework.program import in_dygraph_mode
 from ..layer_helper import LayerHelper
 
 __all__ = ["dynamic_lstm", "dynamic_gru", "simple_rnn", "dynamic_decode",
-           "GreedyEmbeddingDecoder"]
+           "GreedyEmbeddingDecoder", "BeamSearchDecoder"]
 
 
 def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
@@ -115,13 +115,31 @@ class GreedyEmbeddingDecoder:
     step_fn(token_ids [b], state) -> (logits [b, V], next_state)
     embedding of the next input is the step_fn's own concern; this mirrors the
     reference's Decoder protocol (layers/rnn.py Decoder.step) reduced to the
-    greedy case. Beam search lands with a later round.
+    greedy case.
     """
 
     def __init__(self, step_fn, start_token, end_token):
         self.step_fn = step_fn
         self.start_token = int(start_token)
         self.end_token = int(end_token)
+
+
+class BeamSearchDecoder:
+    """Beam-search decoder (reference layers/rnn.py:3413 BeamSearchDecoder +
+    operators/math/beam_search.cc re-expressed dense).
+
+    step_fn(token_ids [b*beam], state) -> (logits [b*beam, V], next_state);
+    `state` is a pytree of arrays with leading dim b*beam — beam reordering
+    gathers every leaf by the selected parent beams each step. Finished
+    beams freeze their score and continue emitting end_token (the
+    beam_search op's semantics).
+    """
+
+    def __init__(self, step_fn, start_token, end_token, beam_size=4):
+        self.step_fn = step_fn
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
 
 
 def dynamic_decode(decoder, inits=None, max_step_num=32, batch_size=None,
@@ -141,6 +159,8 @@ def dynamic_decode(decoder, inits=None, max_step_num=32, batch_size=None,
     from ..dygraph.tracer import to_tensor
 
     assert batch_size is not None, "dynamic_decode needs batch_size in dygraph"
+    if isinstance(decoder, BeamSearchDecoder):
+        return _beam_decode(decoder, inits, max_step_num, batch_size)
     tok = np.full((batch_size,), decoder.start_token, np.int32)
     state = inits
     outs = []
@@ -155,3 +175,73 @@ def dynamic_decode(decoder, inits=None, max_step_num=32, batch_size=None,
         if finished.all():
             break
     return np.stack(outs, axis=1).astype(np.int64)
+
+
+def _beam_decode(decoder, inits, max_step_num, batch_size):
+    """Beam decode loop: per-step top-k via the beam_search op lowering,
+    state reordered by parent beams, final sequences assembled with
+    gather_tree. Returns (ids [b, beam, T], scores [b, beam]), best first."""
+    import jax
+    import jax.numpy as jnp
+    from ..dygraph.tracer import to_tensor
+    from ..ops import registry
+
+    b = batch_size
+    beam = decoder.beam_size
+    end = decoder.end_token
+    ctx = registry.LowerCtx()
+    bs_op = registry.get("beam_search").lower
+    gt_op = registry.get("gather_tree").lower
+
+    def tile_state(s):
+        val = s.value if hasattr(s, "value") else jnp.asarray(s)
+        return to_tensor(jnp.repeat(val, beam, axis=0))   # [b*beam, ...]
+
+    state = jax.tree.map(tile_state, inits,
+                         is_leaf=lambda x: hasattr(x, "value")) \
+        if inits is not None else None
+    tok = np.full((b, beam), decoder.start_token, np.int64)
+    # only beam 0 is live at step 0 so the first top-k picks distinct tokens
+    scores = jnp.where(jnp.arange(beam)[None, :] == 0, 0.0,
+                       jnp.finfo(jnp.float32).min) * jnp.ones((b, 1))
+    step_ids, step_parents, final_scores = [], [], scores
+    pre_ids = jnp.full((b, beam), -1, jnp.int64)    # nothing finished yet
+
+    for _ in range(max_step_num):
+        logits, state = decoder.step_fn(
+            to_tensor(np.asarray(tok).reshape(-1)), state)
+        lg = logits.value if hasattr(logits, "value") else jnp.asarray(logits)
+        logp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+        total = scores[:, :, None] + logp.reshape(b, beam, -1)
+        outs = bs_op(ctx, {"pre_ids": [pre_ids], "pre_scores": [scores],
+                           "ids": [None], "scores": [total]},
+                     {"beam_size": beam, "end_id": end})
+        tok = outs["selected_ids"][0]               # [b, beam]
+        scores = outs["selected_scores"][0]
+        parent = outs["parent_idx"][0]
+        step_ids.append(tok)
+        step_parents.append(parent)
+        # reorder state leaves by the selected parent beams
+        if state is not None:
+            flat_parent = (jnp.arange(b)[:, None] * beam
+                           + parent).reshape(-1)
+
+            def reorder(s):
+                val = s.value if hasattr(s, "value") else jnp.asarray(s)
+                return to_tensor(jnp.take(val, flat_parent, axis=0))
+
+            state = jax.tree.map(reorder, state,
+                                 is_leaf=lambda x: hasattr(x, "value"))
+        pre_ids = tok
+        final_scores = scores
+        if bool(jnp.all(tok == end)):
+            break
+
+    ids_t = jnp.stack(step_ids, axis=0)             # [T, b, beam]
+    parents_t = jnp.stack(step_parents, axis=0)
+    seqs = gt_op(ctx, {"Ids": [ids_t], "Parents": [parents_t]}, {})["Out"][0]
+    out = jnp.moveaxis(seqs, 0, 2)                  # [b, beam, T]
+    order = jnp.argsort(-final_scores, axis=1)      # best beam first
+    out = jnp.take_along_axis(out, order[:, :, None], axis=1)
+    final_scores = jnp.take_along_axis(final_scores, order, axis=1)
+    return np.asarray(out).astype(np.int64), np.asarray(final_scores)

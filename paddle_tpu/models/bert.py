@@ -34,6 +34,8 @@ class BertConfig:
     seq_len: int = 128
     sequence_parallel: bool = False   # ring attention over the sp mesh axis
     sp_mode: str = "ring"
+    moe_experts: int = 0              # >0: switch-MoE FFN (ep mesh axis)
+    moe_capacity_factor: float = 2.0
 
     @staticmethod
     def base():
@@ -97,24 +99,36 @@ def encoder_layer(x, cfg: BertConfig, idx: int, attn_mask=None):
                           bias_attr=ParamAttr(name=f"enc{idx}_ln1_bias"))
 
     pre = x
-    ffn = layers.fc(x, cfg.intermediate_size, num_flatten_dims=2, act="gelu",
-                    param_attr=_attr(f"enc{idx}_ffn_in_w"),
-                    bias_attr=ParamAttr(name=f"enc{idx}_ffn_in_b"))
-    ffn = layers.fc(ffn, h, num_flatten_dims=2,
-                    param_attr=_attr(f"enc{idx}_ffn_out_w"),
-                    bias_attr=ParamAttr(name=f"enc{idx}_ffn_out_b"))
+    aux = None
+    if cfg.moe_experts > 0:
+        # switch-MoE FFN: experts shard over the ep mesh axis (ops/moe.py)
+        ffn, aux = layers.switch_moe(
+            x, num_experts=cfg.moe_experts, d_ff=cfg.intermediate_size,
+            capacity_factor=cfg.moe_capacity_factor, name=f"enc{idx}_moe")
+    else:
+        ffn = layers.fc(x, cfg.intermediate_size, num_flatten_dims=2,
+                        act="gelu",
+                        param_attr=_attr(f"enc{idx}_ffn_in_w"),
+                        bias_attr=ParamAttr(name=f"enc{idx}_ffn_in_b"))
+        ffn = layers.fc(ffn, h, num_flatten_dims=2,
+                        param_attr=_attr(f"enc{idx}_ffn_out_w"),
+                        bias_attr=ParamAttr(name=f"enc{idx}_ffn_out_b"))
     if cfg.hidden_dropout:
         ffn = layers.dropout(ffn, cfg.hidden_dropout,
                              dropout_implementation="upscale_in_train")
-    return layers.layer_norm(layers.elementwise_add(pre, ffn),
-                             begin_norm_axis=2,
-                             param_attr=ParamAttr(name=f"enc{idx}_ln2_scale"),
-                             bias_attr=ParamAttr(name=f"enc{idx}_ln2_bias"))
+    out = layers.layer_norm(layers.elementwise_add(pre, ffn),
+                            begin_norm_axis=2,
+                            param_attr=ParamAttr(name=f"enc{idx}_ln2_scale"),
+                            bias_attr=ParamAttr(name=f"enc{idx}_ln2_bias"))
+    return (out, aux) if cfg.moe_experts > 0 else out
 
 
 def bert_encoder(input_ids, cfg: BertConfig, position_ids=None,
                  attn_mask=None):
-    """Embeddings + N encoder layers -> sequence output [B, S, H]."""
+    """Embeddings + N encoder layers -> sequence output [B, S, H]. With
+    moe_experts>0, per-layer aux load-balancing losses accumulate on the
+    returned var's `_moe_aux_losses` (build_pretrain_program adds them)."""
+    aux_losses = []
     word_emb = layers.embedding(
         layers.unsqueeze(input_ids, [2]), [cfg.vocab_size, cfg.hidden_size],
         param_attr=_attr("word_embedding"))
@@ -133,6 +147,10 @@ def bert_encoder(input_ids, cfg: BertConfig, position_ids=None,
                            dropout_implementation="upscale_in_train")
     for i in range(cfg.num_layers):
         x = encoder_layer(x, cfg, i, attn_mask)
+        if cfg.moe_experts > 0:
+            x, aux = x
+            aux_losses.append(aux)
+    x._moe_aux_losses = aux_losses
     return x
 
 
@@ -153,6 +171,10 @@ def build_pretrain_program(cfg: BertConfig):
                              dtype="int64")
     seq = bert_encoder(input_ids, cfg)
     loss = bert_pretrain_loss(seq, mlm_labels, cfg)
+    aux = getattr(seq, "_moe_aux_losses", None)
+    if aux:   # switch_moe load-balancing term (Switch eq. 4, scale 0.01)
+        loss = layers.elementwise_add(
+            loss, layers.scale(layers.sums(aux), 0.01 / len(aux)))
     return input_ids, mlm_labels, loss
 
 
@@ -160,7 +182,8 @@ def tp_sharding_rules() -> ShardingRules:
     """Megatron-style tensor-parallel rules for this model's param names:
     column-parallel QKV & FFN-in (shard output dim over tp), row-parallel
     attn-proj & FFN-out (shard input dim), vocab-sharded embeddings/head."""
-    return ShardingRules([
+    from ..parallel.mesh import moe_sharding_rules
+    return moe_sharding_rules(extra=[
         (r"_attn_qkv_w$", P(None, "tp")),
         (r"_attn_qkv_b$", P("tp")),
         (r"_ffn_in_w$", P(None, "tp")),

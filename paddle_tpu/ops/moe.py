@@ -1,0 +1,74 @@
+"""Mixture-of-Experts with expert parallelism (beyond-reference, SURVEY
+§2.8: TP/SP/EP are ABSENT in the reference — this makes the fleet
+`expert_parallel_degree` knob real).
+
+TPU-native design (the Switch-Transformer / Mesh-TF dispatch pattern): a
+top-1 gated expert FFN where routing is expressed as dense dispatch/combine
+einsums over an expert-capacity buffer. Expert weights carry a leading [E]
+dim sharded over the mesh's `ep` axis (see moe_sharding_rules), so GSPMD
+lowers the dispatch einsum to an all-to-all over ICI — no hand-written
+collective schedule.
+
+Capacity semantics: each expert processes at most
+C = ceil(tokens/E * capacity_factor) tokens; overflowing tokens fall
+through the residual (output 0 from the MoE branch), the standard
+load-balancing-friendly behavior. An auxiliary load-balancing loss
+(importance * load, Switch eq. 4) is returned for the trainer to add.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("switch_moe")
+def _switch_moe(ctx, ins, attrs):
+    x = ins["X"][0]                        # [b, s, d] or [N, d]
+    wg = ins["GateW"][0]                   # [d, E]
+    w1 = ins["ExpertW1"][0]                # [E, d, ff]
+    b1 = ins.get("ExpertB1", [None])[0]    # [E, ff]
+    w2 = ins["ExpertW2"][0]                # [E, ff, d]
+    b2 = ins.get("ExpertB2", [None])[0]    # [E, d]
+    cf = attrs.get("capacity_factor", 1.25)
+
+    orig_shape = x.shape
+    d = x.shape[-1]
+    xt = x.reshape(-1, d)                  # [N, d]
+    n = xt.shape[0]
+    e = w1.shape[0]
+    cap = max(1, int(-(-n * cf // e)))     # ceil(n/e * cf)
+
+    gate_logits = xt.astype(jnp.float32) @ wg.astype(jnp.float32)  # [N, E]
+    gates = jax.nn.softmax(gate_logits, axis=-1)
+    expert = jnp.argmax(gates, axis=-1)                  # [N] top-1
+    gate_val = jnp.max(gates, axis=-1)                   # [N]
+
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)      # [N, E]
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0            # [N, E]
+    keep = (pos >= 0) & (pos < cap)
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), cap,
+                            dtype=jnp.float32) * keep[..., None]
+    dispatch = onehot[..., None] * pos_oh                      # [N, E, C]
+
+    # all-to-all happens here when E is sharded over 'ep'
+    xin = jnp.einsum("nec,nd->ecd", dispatch, xt.astype(jnp.float32))
+    h = jnp.einsum("ecd,edf->ecf", xin, w1.astype(jnp.float32))
+    if b1 is not None:
+        h = h + b1[:, None, :].astype(jnp.float32)
+    h = jax.nn.relu(h)
+    out_e = jnp.einsum("ecf,efd->ecd", h, w2.astype(jnp.float32))
+    if b2 is not None:
+        out_e = out_e + b2[:, None, :].astype(jnp.float32)
+    combined = jnp.einsum("nec,ecd->nd", dispatch, out_e)
+    out = (combined * gate_val[:, None]).astype(x.dtype)
+
+    # Switch aux loss: E * sum_e importance_e * load_e
+    importance = jnp.mean(gates, axis=0)                  # [E]
+    load = jnp.mean(onehot, axis=0)                       # [E]
+    aux = e * jnp.sum(importance * load)
+
+    return {"Out": [out.reshape(orig_shape)],
+            "AuxLoss": [aux.astype(x.dtype)],
+            "GateIdx": [expert.astype(jnp.int64)]}

@@ -39,9 +39,16 @@ __all__ = [
 
 class Optimizer:
     def __init__(self, learning_rate=0.001, parameter_list=None,
-                 regularization=None, grad_clip=None, name=None):
+                 regularization=None, grad_clip=None, name=None,
+                 parameters=None, weight_decay=None):
         self._learning_rate = learning_rate
-        self._parameter_list = parameter_list
+        # paddle 2.0 spelling: parameters= / weight_decay=
+        self._parameter_list = (parameter_list if parameter_list is not None
+                                else parameters)
+        if regularization is None and weight_decay:
+            from .regularizer import L2Decay
+            regularization = (weight_decay if not isinstance(
+                weight_decay, (int, float)) else L2Decay(weight_decay))
         self.regularization = regularization
         self._grad_clip = grad_clip
         self._name = name or unique_name.generate(type(self).__name__)

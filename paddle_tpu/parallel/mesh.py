@@ -137,3 +137,11 @@ class ShardingRules:
 
 
 REPLICATED = ShardingRules()
+
+
+def moe_sharding_rules(extra=()) -> "ShardingRules":
+    """Expert-parallel rules: shard the leading [E] dim of switch_moe expert
+    weights over the mesh's ep axis (ops/moe.py) — GSPMD then lowers the
+    dispatch einsum to an all-to-all over ICI."""
+    rules = [(r"_expert_(w|b)[12]_?\d*$", P("ep"))]
+    return ShardingRules(list(extra) + rules)

@@ -118,3 +118,77 @@ def test_word2vec_embeddings():
         first = first if first is not None else float(lv)
         last = float(lv)
     assert last < 0.5 * first
+
+
+def test_machine_translation_seq2seq_beam_decode():
+    """Book test #4 (reference test_machine_translation.py): train a tiny
+    GRU seq2seq on a copy task, then decode with beam search — the beam-1
+    hypothesis must reproduce the source, and beam scores must be ordered."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+
+    paddle.disable_static()
+    try:
+        V, H, T = 12, 64, 4
+        start, end = 1, 0
+
+        class Seq2Seq(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.src_emb = nn.Embedding(V, H)
+                self.tgt_emb = nn.Embedding(V, H)
+                self.enc = nn.GRUCell(H, H)
+                self.dec = nn.GRUCell(H, H)
+                self.out = nn.Linear(H, V)
+
+            def encode(self, src):
+                b = src.shape[0]
+                h = paddle.zeros([b, H], dtype="float32")
+                for t in range(src.shape[1]):
+                    h, _ = self.enc(self.src_emb(src[:, t]), h)
+                return h
+
+            def decode_step(self, tok, h):
+                h2, _ = self.dec(self.tgt_emb(tok), h)
+                return self.out(h2), h2
+
+        import numpy as np
+        rng = np.random.RandomState(0)
+        net = Seq2Seq()
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=net.parameters())
+        src_np = rng.randint(2, V, (8, T)).astype(np.int64)
+        # teacher-forced training on the copy task: target == source + end
+        for step in range(250):
+            src = paddle.to_tensor(src_np)
+            h = net.encode(src)
+            tok = paddle.to_tensor(np.full((8,), start, np.int64))
+            loss = 0
+            for t in range(T + 1):
+                logits, h = net.decode_step(tok, h)
+                tgt = (src_np[:, t] if t < T
+                       else np.full((8,), end)).astype(np.int64)
+                loss = loss + F.cross_entropy(
+                    logits, paddle.to_tensor(tgt.reshape(-1, 1)))
+                tok = paddle.to_tensor(tgt)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+
+        # beam decode must reproduce the memorized mapping
+        h0 = net.encode(paddle.to_tensor(src_np[:4]))
+        from paddle_tpu import layers
+        dec = layers.BeamSearchDecoder(
+            lambda tok, st: net.decode_step(tok, st),
+            start_token=start, end_token=end, beam_size=3)
+        ids, scores = layers.dynamic_decode(dec, inits=h0,
+                                            max_step_num=T + 1,
+                                            batch_size=4)
+        assert ids.shape[:2] == (4, 3)
+        best = ids[:, 0, :T]
+        acc = (best == src_np[:4]).mean()
+        assert acc > 0.9, (best, src_np[:4])
+        # scores sorted best-first
+        assert (np.diff(scores, axis=1) <= 1e-5).all()
+    finally:
+        paddle.enable_static()
