@@ -1,0 +1,80 @@
+"""CompiledProgram — fluid.compiler source-compatibility shim.
+
+Reference counterpart: python/paddle/fluid/compiler.py (CompiledProgram
+.with_data_parallel wraps ParallelExecutor: replicate the graph per device,
+insert allreduce op-handles). TPU-native: data parallelism is GSPMD — one
+program, feeds sharded over the mesh's dp axis, gradients reduced by XLA —
+so with_data_parallel simply attaches a DistConfig over the dp mesh and the
+Executor runs the same single fused computation. BuildStrategy /
+ExecutionStrategy knobs are accepted for source compat; scheduling is XLA's
+job (SURVEY §5 config system note).
+"""
+from __future__ import annotations
+
+
+class BuildStrategy:
+    """Reference details/build_strategy.h knobs, kept as plain attributes."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.memory_optimize = None
+        self.enable_inplace = None
+        self.fuse_all_reduce_ops = False
+        self.fuse_elewise_add_act_ops = False
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+class ExecutionStrategy:
+    """Reference details/execution_strategy.h knobs."""
+
+    def __init__(self):
+        self.num_threads = 0
+        self.num_iteration_per_drop_scope = 1
+        self.use_experimental_executor = True
+
+
+class CompiledProgram:
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._is_data_parallel = False
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        """Attach GSPMD data-parallel sharding to the program (the TPU-native
+        realization of ParallelExecutor's per-device replication)."""
+        import jax
+        from .parallel.mesh import build_mesh, get_mesh, set_mesh
+        from .parallel.spmd import DistConfig, attach
+
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        mesh = get_mesh()
+        if mesh is None:
+            mesh = build_mesh()
+            set_mesh(mesh)
+        attach(self._program, DistConfig(mesh=mesh))
+        self._is_data_parallel = True
+        self._loss_name = loss_name
+        return self
+
+    # Executor.run unwraps via this
+    @property
+    def program(self):
+        return self._program
+
+
+__all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy"]

@@ -1,0 +1,2 @@
+"""paddle.fluid.contrib parity namespace."""
+from . import slim  # noqa: F401

@@ -1,0 +1,4 @@
+"""Model slimming (reference fluid/contrib/slim/): quantization passes."""
+from . import quantization  # noqa: F401
+from .quantization import (QuantizationTransformPass,  # noqa: F401
+                           PostTrainingQuantization)

@@ -1,0 +1,216 @@
+"""Quantization: QAT fake-quant ops + program transform + PTQ calibration.
+
+Reference counterparts: contrib/slim/quantization/quantization_pass.py
+(QuantizationTransformPass inserting fake_quantize/dequantize around
+quantizable ops), post_training_quantization.py, and the fake-quant op
+kernels (operators/fake_quantize_op.cc: fake_quantize_dequantize_abs_max,
+fake_channel_wise_quantize_dequantize_abs_max,
+fake_quantize_dequantize_moving_average_abs_max).
+
+TPU-native notes: the fake q/dq lowerings simulate int8 on the bf16/f32
+datapath with a straight-through estimator — `x + stop_gradient(qdq(x)-x)`
+— so the generic __vjp__ machinery yields identity gradients through the
+rounding (the reference's FakeQuantizeDequantize grad kernel is exactly
+STE). Scales live as attrs (PTQ) or persistable state vars (QAT moving
+average), and the quantized program runs through the same fused-XLA
+executor.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.program import OpRole
+from ...ops.registry import register
+
+
+def _qdq(x, scale, bits=8):
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def _ste(x, qdq):
+    return x + jax.lax.stop_gradient(qdq - x)
+
+
+@register("fake_quantize_dequantize_abs_max")
+def _fq_abs_max(ctx, ins, attrs):
+    x = ins["X"][0]
+    bits = attrs.get("bit_length", 8)
+    static = attrs.get("static_scale", 0.0)
+    scale = (jnp.asarray(static, jnp.float32) if static > 0
+             else jnp.max(jnp.abs(x.astype(jnp.float32))))
+    out = _ste(x, _qdq(x.astype(jnp.float32), scale, bits).astype(x.dtype))
+    return {"Out": [out], "OutScale": [scale.reshape(1)]}
+
+
+@register("fake_channel_wise_quantize_dequantize_abs_max")
+def _fq_channel_abs_max(ctx, ins, attrs):
+    x = ins["X"][0]
+    bits = attrs.get("bit_length", 8)
+    axis = attrs.get("quant_axis", 0)
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=red, keepdims=True)
+    out = _ste(x, _qdq(x.astype(jnp.float32), scale, bits).astype(x.dtype))
+    return {"Out": [out], "OutScale": [scale.reshape(-1)]}
+
+
+@register("fake_quantize_dequantize_moving_average_abs_max",
+          nondiff_slots=("InScale",), stateful_outputs=("OutScale",))
+def _fq_moving_avg(ctx, ins, attrs):
+    x = ins["X"][0]
+    in_scale = ins["InScale"][0]
+    bits = attrs.get("bit_length", 8)
+    rate = attrs.get("moving_rate", 0.9)
+    is_test = attrs.get("is_test", False)
+    cur = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    scale = (jnp.reshape(in_scale, ()) if is_test
+             else rate * jnp.reshape(in_scale, ()) + (1 - rate) * cur)
+    out = _ste(x, _qdq(x.astype(jnp.float32), scale, bits).astype(x.dtype))
+    return {"Out": [out], "OutScale": [scale.reshape(1)]}
+
+
+_DEFAULT_QUANTIZABLE = ("mul", "conv2d", "depthwise_conv2d", "matmul",
+                        "matmul_v2")
+# which input slots hold weights (channel-wise quant) per op type
+_WEIGHT_SLOTS = {"mul": "Y", "conv2d": "Filter", "depthwise_conv2d": "Filter",
+                 "matmul": "Y", "matmul_v2": "Y"}
+_ACT_SLOTS = {"mul": "X", "conv2d": "Input", "depthwise_conv2d": "Input",
+              "matmul": "X", "matmul_v2": "X"}
+
+
+class QuantizationTransformPass:
+    """QAT rewrite (reference QuantizationTransformPass): insert fake
+    quant-dequant on the activation and weight inputs of quantizable ops.
+    Weights get channel-wise abs-max; activations get a moving-average
+    scale carried in a persistable state var."""
+
+    def __init__(self, scope=None, place=None, weight_bits=8,
+                 activation_bits=8, moving_rate=0.9,
+                 quantizable_op_type=_DEFAULT_QUANTIZABLE,
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_quantize_type="channel_wise_abs_max"):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.moving_rate = moving_rate
+        self.quantizable = set(quantizable_op_type)
+        self.act_type = activation_quantize_type
+
+    def apply(self, program, startup_program=None, fixed_scales=None):
+        """Rewrites `program` in place; returns it. `fixed_scales` (PTQ):
+        var name -> float scale, switching activations to static scales."""
+        block = program.global_block()
+        quantized: dict = {}
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            if op.type not in self.quantizable or \
+                    op.attrs.get("op_role", 0) != OpRole.Forward:
+                i += 1
+                continue
+            for kind, slot_map in (("w", _WEIGHT_SLOTS), ("a", _ACT_SLOTS)):
+                slot = slot_map[op.type]
+                names = op.inputs.get(slot, [])
+                if not names:
+                    continue
+                name = names[0]
+                key = (name, kind)
+                if key in quantized:
+                    op.inputs[slot][0] = quantized[key]
+                    continue
+                v = block.var(name)
+                if v is None or "int" in str(v.dtype):
+                    continue
+                qname = f"{name}@QUANT_DEQUANT"
+                block.create_var(name=qname, shape=v.shape, dtype=v.dtype,
+                                 stop_gradient=False)
+                if kind == "w":
+                    scale_name = f"{name}@QSCALE"
+                    block.create_var(name=scale_name, shape=(-1,),
+                                     dtype="float32", stop_gradient=True)
+                    block._insert_op(
+                        i, "fake_channel_wise_quantize_dequantize_abs_max",
+                        inputs={"X": [name]},
+                        outputs={"Out": [qname], "OutScale": [scale_name]},
+                        attrs={"bit_length": self.weight_bits,
+                               "quant_axis": v.shape and len(v.shape) - 1
+                               if op.type in ("mul", "matmul", "matmul_v2")
+                               else 0})
+                    i += 1
+                elif fixed_scales is not None:       # PTQ static scale
+                    scale_name = f"{name}@QSCALE"
+                    block.create_var(name=scale_name, shape=(1,),
+                                     dtype="float32", stop_gradient=True)
+                    block._insert_op(
+                        i, "fake_quantize_dequantize_abs_max",
+                        inputs={"X": [name]},
+                        outputs={"Out": [qname], "OutScale": [scale_name]},
+                        attrs={"bit_length": self.activation_bits,
+                               "static_scale":
+                                   float(fixed_scales.get(name, 0.0))})
+                    i += 1
+                else:                                # QAT moving average
+                    in_scale = f"{name}@QSCALE_STATE"
+                    sv = block.create_var(name=in_scale, shape=(1,),
+                                          dtype="float32",
+                                          stop_gradient=True)
+                    sv.persistable = True
+                    if startup_program is not None:
+                        sb = startup_program.global_block()
+                        sb.create_var(name=in_scale, shape=(1,),
+                                      dtype="float32",
+                                      persistable=True)
+                        sb.append_op("fill_constant",
+                                     outputs={"Out": [in_scale]},
+                                     attrs={"shape": [1],
+                                            "dtype": "float32",
+                                            "value": 1.0})
+                    block._insert_op(
+                        i,
+                        "fake_quantize_dequantize_moving_average_abs_max",
+                        inputs={"X": [name], "InScale": [in_scale]},
+                        outputs={"Out": [qname], "OutScale": [in_scale]},
+                        attrs={"bit_length": self.activation_bits,
+                               "moving_rate": self.moving_rate})
+                    i += 1
+                op.inputs[slot][0] = qname
+                quantized[key] = qname
+            i += 1
+        program.bump_version()
+        return program
+
+
+class PostTrainingQuantization:
+    """PTQ (reference post_training_quantization.py): run calibration
+    batches, record per-activation abs-max, then rewrite the program with
+    static-scale fake quant-dequant ops."""
+
+    def __init__(self, executor, program, feed_keys, fetch_list,
+                 batch_generator, quantizable_op_type=_DEFAULT_QUANTIZABLE):
+        self.exe = executor
+        self.program = program
+        self.feed_keys = list(feed_keys)
+        self.fetch_list = list(fetch_list)
+        self.batches = batch_generator
+        self.quantizable = set(quantizable_op_type)
+
+    def quantize(self):
+        block = self.program.global_block()
+        act_names = []
+        for op in block.ops:
+            if op.type in self.quantizable and \
+                    op.attrs.get("op_role", 0) == OpRole.Forward:
+                n = op.inputs.get(_ACT_SLOTS[op.type], [None])[0]
+                if n is not None and n not in act_names:
+                    act_names.append(n)
+        scales = {n: 0.0 for n in act_names}
+        for feed in self.batches:
+            vals = self.exe.run(self.program, feed=feed,
+                                fetch_list=act_names)
+            for n, v in zip(act_names, vals):
+                scales[n] = max(scales[n], float(np.abs(v).max()))
+        pass_ = QuantizationTransformPass(quantizable_op_type=self.quantizable)
+        return pass_.apply(self.program, fixed_scales=scales)

@@ -1,0 +1,82 @@
+"""Program visualization + environment self-check.
+
+Reference counterparts: python/paddle/fluid/debugger.py (draw_block_graphviz)
+and fluid/install_check.py (run_check: build a tiny model, train a step,
+verify the device stack — including the 2-device smoke test)."""
+from __future__ import annotations
+
+from typing import Optional
+
+
+def draw_block_graphviz(block, highlights=None, path: Optional[str] = None):
+    """Emit a graphviz dot description of a Block's ops and vars (reference
+    debugger.py). Returns the dot text; writes it when `path` is given."""
+    highlights = set(highlights or ())
+    lines = ["digraph G {", "  rankdir=TB;"]
+    for v in block.vars.values():
+        style = ("style=filled,fillcolor=lightsalmon"
+                 if v.name in highlights else
+                 "style=filled,fillcolor=lightgrey" if v.persistable else "")
+        label = f"{v.name}\\n{tuple(v.shape)} {v.dtype}"
+        lines.append(f'  "{v.name}" [shape=box,{style},label="{label}"];')
+    for i, op in enumerate(block.ops):
+        node = f"op_{i}_{op.type}"
+        lines.append(f'  "{node}" [shape=ellipse,style=filled,'
+                     f'fillcolor=lightblue,label="{op.type}"];')
+        for n in op.input_names():
+            if n != "@EMPTY@":
+                lines.append(f'  "{n}" -> "{node}";')
+        for n in op.output_names():
+            if n != "@EMPTY@":
+                lines.append(f'  "{node}" -> "{n}";')
+    lines.append("}")
+    dot = "\n".join(lines)
+    if path:
+        with open(path, "w") as f:
+            f.write(dot)
+    return dot
+
+
+def run_check():
+    """paddle.utils.run_check / fluid.install_check: train a toy model one
+    step single-device, then (when >=2 devices exist) one dp-sharded step —
+    the reference's two-GPU smoke test, TPU-style."""
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.testing import reset_programs
+
+    reset_programs(seed=0)
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(x, 1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(8, 4).astype(np.float32),
+            "y": rng.randn(8, 1).astype(np.float32)}
+    l0, = exe.run(feed=feed, fetch_list=[loss])
+    print(f"paddle_tpu single-device check: OK (loss {float(l0):.4f}, "
+          f"backend={jax.default_backend()}, devices={jax.device_count()})")
+
+    if jax.device_count() >= 2:
+        reset_programs(seed=0)
+        from paddle_tpu.distributed import fleet
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fleet.init(is_collective=True)
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.1),
+            fleet.DistributedStrategy())
+        opt.minimize(loss)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        l1, = exe.run(feed=feed, fetch_list=[loss])
+        print(f"paddle_tpu multi-device check: OK (dp over "
+              f"{jax.device_count()} devices, loss {float(l1):.4f})")
+    print("PaddlePaddle-TPU is installed successfully!")

@@ -19,16 +19,11 @@ __all__ = [
     "ReduceOp", "get_rank", "get_world_size", "init_parallel_env",
     "DataParallel", "ParallelEnv", "fleet", "build_mesh", "set_mesh",
     "get_mesh", "DistConfig", "attach", "launch", "spawn",
+    "SpawnContext", "Gloo",
 ]
 
 
-def spawn(func, args=(), nprocs=-1, **kwargs):
-    """paddle.distributed.spawn parity (reference distributed/spawn.py).
-
-    On a single-controller TPU runtime every device is visible to one process,
-    so 'spawn' runs func once with the full mesh (the sharding inside func
-    spans the devices). For true multi-host, use the launcher + env contract.
-    """
-    return func(*args)
+from .spawn import spawn, SpawnContext  # noqa: E402
+from .gloo import Gloo  # noqa: E402
 
 from . import ps  # noqa: E402  (sparse KV service: server/client/embedding)

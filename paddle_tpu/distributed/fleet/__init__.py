@@ -28,3 +28,6 @@ __all__ = [
     "PaddleCloudRoleMaker", "UserDefinedRoleMaker", "Role", "fleet",
     "init_server", "run_server", "stop_server", "init_worker", "stop_worker",
 ]
+
+from . import data_generator  # noqa: E402
+from .data_generator import DataGenerator, MultiSlotDataGenerator  # noqa: E402

@@ -1,0 +1,78 @@
+"""PS data-generator protocol (reference
+distributed/fleet/data_generator/data_generator.py:19): users subclass
+DataGenerator, yield (slot_name, values) pairs per sample, and the generator
+emits the MultiSlot text protocol on stdout — the exact line format the
+native data plane parses (native/dataplane.cc MultiSlot parser):
+
+    <slot>:<n> v1 ... vn <slot>:<n> ...
+"""
+from __future__ import annotations
+
+import sys
+from typing import Iterable, List, Tuple
+
+
+class DataGenerator:
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    # -- user overrides ------------------------------------------------------
+    def generate_sample(self, line):
+        """Override: return a generator yielding one sample — a list of
+        (slot_name, value_list) pairs (reference generate_sample contract)."""
+        raise NotImplementedError(
+            "implement generate_sample(self, line) returning a generator")
+
+    def generate_batch(self, samples):
+        """Optional override for batch-level rewriting."""
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    # -- protocol ------------------------------------------------------------
+    def _format_sample(self, sample: List[Tuple[str, Iterable]]) -> str:
+        parts = []
+        for slot, values in sample:
+            vals = list(values)
+            parts.append(f"{slot}:{len(vals)}")
+            parts.extend(str(v) for v in vals)
+        return " ".join(parts)
+
+    def _batched(self, samples_iter):
+        """Buffer batch_size_ samples and route each batch through
+        generate_batch (reference contract: batch-level rewriting hook)."""
+        buf = []
+        for s in samples_iter:
+            buf.append(s)
+            if len(buf) >= self.batch_size_:
+                yield from self.generate_batch(buf)()
+                buf = []
+        if buf:
+            yield from self.generate_batch(buf)()
+
+    def run_from_stdin(self):
+        """Pipe mode (reference run_from_stdin): each stdin line expands to
+        zero or more MultiSlot samples on stdout."""
+        def samples():
+            for line in sys.stdin:
+                yield from self.generate_sample(line)()
+        for sample in self._batched(samples()):
+            sys.stdout.write(self._format_sample(sample) + "\n")
+
+    def run_from_memory(self, lines=None):
+        """Return formatted sample lines from in-memory input (reference
+        run_from_memory writes to a memory channel)."""
+        def samples():
+            for line in (lines if lines is not None else [None]):
+                yield from self.generate_sample(line)()
+        return [self._format_sample(s) for s in self._batched(samples())]
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Alias matching the reference's exported name; the base already speaks
+    the MultiSlot protocol."""

@@ -1,0 +1,104 @@
+"""fleetrun console entry (reference fleet/launch.py:300, registered as the
+`fleetrun` script by setup.py.in:504-506). Two modes, auto-detected like the
+reference (:250): collective (spawn trainers with the env contract) and PS
+(--servers/--workers spawn pserver + trainer processes).
+
+Usage:
+    python -m paddle_tpu.distributed.fleet.launch train.py [args...]
+    python -m paddle_tpu.distributed.fleet.launch --server_num=1 \
+        --worker_num=2 train.py
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+from ..spawn import free_ports
+
+
+def _parse():
+    p = argparse.ArgumentParser("fleetrun")
+    p.add_argument("--ips", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--server_num", type=int, default=0)
+    p.add_argument("--worker_num", type=int, default=0)
+    p.add_argument("--servers", default="", help="ip:port list (PS mode)")
+    p.add_argument("--workers", default="")
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args()
+
+
+def _spawn(cmd, env, log_dir, tag):
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(log_dir, f"{tag}.log"), "w")
+    else:
+        out = None
+    return subprocess.Popen(cmd, env=env, stdout=out,
+                            stderr=subprocess.STDOUT if out else None)
+
+
+def launch():
+    args = _parse()
+    ps_mode = bool(args.server_num or args.servers)
+    script = [sys.executable, args.training_script,
+              *args.training_script_args]
+    procs = []
+    server_procs = []
+    if ps_mode:
+        # PS mode (reference launch_ps :232): spawn pservers then trainers
+        servers = (args.servers.split(",") if args.servers else
+                   [f"127.0.0.1:{p}" for p in free_ports(args.server_num)])
+        n_workers = args.worker_num or 1
+        for i, ep in enumerate(servers):
+            env = dict(os.environ,
+                       TRAINING_ROLE="PSERVER",
+                       PADDLE_PSERVERS_IP_PORT_LIST=",".join(servers),
+                       PADDLE_CURRENT_ENDPOINT=ep,
+                       PADDLE_PSERVER_ID=str(i),
+                       PADDLE_TRAINERS_NUM=str(n_workers))
+            server_procs.append(_spawn(script, env, args.log_dir,
+                                       f"server.{i}"))
+        for i in range(n_workers):
+            env = dict(os.environ,
+                       TRAINING_ROLE="TRAINER",
+                       PADDLE_PSERVERS_IP_PORT_LIST=",".join(servers),
+                       PADDLE_TRAINER_ID=str(i),
+                       PADDLE_TRAINERS_NUM=str(n_workers))
+            procs.append(_spawn(script, env, args.log_dir, f"worker.{i}"))
+    else:
+        # collective mode: delegate to the shared host launcher
+        ips = args.ips.split(",")
+        port = args.port or _free_port()
+        endpoints = ",".join(f"{ip}:{port + i}" for i, ip in enumerate(ips))
+        for rank, ip in enumerate(ips):
+            env = dict(os.environ,
+                       TRAINING_ROLE="TRAINER",
+                       PADDLE_TRAINER_ID=str(rank),
+                       PADDLE_TRAINERS_NUM=str(len(ips)),
+                       PADDLE_TRAINER_ENDPOINTS=endpoints,
+                       PADDLE_CURRENT_ENDPOINT=f"{ip}:{port + rank}")
+            procs.append(_spawn(script, env, args.log_dir, f"trainer.{rank}"))
+    rc = 0
+    try:
+        # wait on TRAINERS only; pservers run forever by design
+        # (fleet.run_server parks) and are killed once training ends —
+        # the reference launcher's shutdown order
+        for p in procs:
+            rc = p.wait() or rc
+    finally:
+        for p in server_procs + procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in server_procs:
+            p.wait()
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    launch()

@@ -1,0 +1,170 @@
+"""Host-CPU collective backend (the reference's Gloo role).
+
+Reference counterpart: framework/fleet/gloo_wrapper.h:106 (GlooWrapper
+AllReduce/AllGather/Barrier over CPU) + platform/gloo_context.cc, used for
+barriers and small host-side reductions when no device collective applies
+(PS mode, fleet utils). Rendezvous there is an HDFS/HTTP store; here rank 0
+hosts a tiny TCP store (length-prefixed pickles over loopback/DCN) — the
+same star pattern the reference's HTTP store uses.
+
+Device tensors ride XLA collectives (distributed/collective.py); this path
+is ONLY for host numpy values — exactly the split the reference has.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+
+def _send_msg(sock, obj):
+    data = pickle.dumps(obj)
+    sock.sendall(struct.pack("<Q", len(data)) + data)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        c = sock.recv(8 - len(hdr))
+        if not c:
+            raise ConnectionError("gloo store peer closed")
+        hdr += c
+    n = struct.unpack("<Q", hdr)[0]
+    buf = b""
+    while len(buf) < n:
+        c = sock.recv(min(1 << 20, n - len(buf)))
+        if not c:
+            raise ConnectionError("gloo store peer closed")
+        buf += c
+    return pickle.loads(buf)
+
+
+class _Store:
+    """Rank-0 TCP store: gathers one value per rank per round, then serves
+    the full set back (one round-trip collective primitive)."""
+
+    def __init__(self, world_size: int, port: int = 0):
+        self.world = world_size
+        self.srv = socket.socket()
+        self.srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.srv.bind(("0.0.0.0", port))
+        self.port = self.srv.getsockname()[1]
+        self.srv.listen(world_size + 4)
+        self._lock = threading.Condition()
+        self._rounds: dict = {}
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while self._running:
+            try:
+                conn, _ = self.srv.accept()
+            except OSError:
+                break
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                tag, rank, value = _recv_msg(conn)
+                with self._lock:
+                    rnd = self._rounds.setdefault(tag, {})
+                    rnd["values"] = rnd.get("values", {})
+                    rnd["values"][rank] = value
+                    self._lock.notify_all()
+                    while len(self._rounds[tag]["values"]) < self.world:
+                        if not self._lock.wait(timeout=60):
+                            self._rounds.pop(tag, None)  # poison removed
+                            raise TimeoutError(
+                                f"gloo round {tag} timed out waiting for "
+                                f"{self.world - len(rnd['values'])} rank(s)")
+                    vals = self._rounds[tag]["values"]
+                    full = [vals[r] for r in range(self.world)]
+                    rnd["served"] = rnd.get("served", 0) + 1
+                    if rnd["served"] >= self.world:   # GC completed rounds
+                        self._rounds.pop(tag, None)
+                _send_msg(conn, full)
+        except TimeoutError as e:
+            import sys
+            print(f"[gloo] {e}", file=sys.stderr)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._running = False
+        try:
+            self.srv.close()
+        except OSError:
+            pass
+
+
+class Gloo:
+    """Reference GlooWrapper surface: init/barrier/all_reduce/all_gather."""
+
+    def __init__(self, rank: int, world_size: int,
+                 store_addr: Optional[str] = None, port: int = 0):
+        self.rank = rank
+        self.world = world_size
+        self._store = None
+        self._round = 0
+        if rank == 0 and store_addr is None:
+            self._store = _Store(world_size, port)
+            host, sport = "127.0.0.1", self._store.port
+        else:
+            assert store_addr, "non-root ranks need store_addr host:port"
+            host, sport = store_addr.rsplit(":", 1)
+        deadline = time.time() + 60
+        while True:
+            try:
+                self.sock = socket.create_connection((host, int(sport)),
+                                                     timeout=60)
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.05)
+
+    @property
+    def store_port(self):
+        return self._store.port if self._store else None
+
+    def _exchange(self, value):
+        tag = self._round
+        self._round += 1
+        _send_msg(self.sock, (tag, self.rank, value))
+        return _recv_msg(self.sock)
+
+    def barrier(self):
+        self._exchange(None)
+
+    def all_gather(self, value) -> List:
+        return self._exchange(value)
+
+    def all_reduce(self, value, op: str = "sum"):
+        vals = [np.asarray(v) for v in self._exchange(np.asarray(value))]
+        if op == "sum":
+            return sum(vals[1:], vals[0].copy())
+        if op == "max":
+            return np.maximum.reduce(vals)
+        if op == "min":
+            return np.minimum.reduce(vals)
+        raise ValueError(f"unsupported reduce op {op!r}")
+
+    def broadcast(self, value, root: int = 0):
+        return self._exchange(value)[root]
+
+    def close(self):
+        try:
+            self.sock.close()
+        finally:
+            if self._store:
+                self._store.stop()

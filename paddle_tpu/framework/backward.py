@@ -55,15 +55,32 @@ class _GradAccumulator:
     def __init__(self, block):
         self.block = block
         self.contribs: Dict[str, List[str]] = {}
+        # grad names already produced by earlier append_backward calls must
+        # not be reused — higher-order passes (grad-of-grad) get fresh names
+        # (the reference's _rename_grad_ machinery)
+        self._taken = set()
+        for op in block.ops:
+            self._taken.update(n for n in op.output_names()
+                               if n != "@EMPTY@")
+
+    def _base_name(self, var_name: str) -> str:
+        gname = grad_var_name(var_name)
+        k = 2
+        while gname in self._taken:
+            gname = f"{grad_var_name(var_name)}@{k}"
+            k += 1
+        return gname
 
     def add(self, var_name: str) -> str:
         lst = self.contribs.setdefault(var_name, [])
-        gname = grad_var_name(var_name)
+        gname = self._base_name(var_name)
         name = gname if not lst else f"{gname}@RENAME@{len(lst)}"
         lst.append(name)
         fwd = self.block.var(var_name)
+        # grad vars stay differentiable-through: a later append_backward may
+        # differentiate THROUGH them (grad-of-grad)
         self.block.create_var(name=name, shape=fwd.shape, dtype=fwd.dtype,
-                              stop_gradient=True)
+                              stop_gradient=False)
         return name
 
     def finalize(self, var_name: str) -> Optional[str]:
@@ -72,8 +89,7 @@ class _GradAccumulator:
             return None
         if len(lst) == 1:
             return lst[0]
-        gname = grad_var_name(var_name)
-        out = gname if lst[0] != gname else f"{gname}@SUM"
+        gname = self._base_name(var_name)
         # sum all contributions into one var, then collapse the list
         sum_out = gname
         if lst[0] == gname:
@@ -82,7 +98,7 @@ class _GradAccumulator:
             sum_out = f"{gname}@MERGED"
         fwd = self.block.var(var_name)
         out_var = self.block.create_var(name=sum_out, shape=fwd.shape,
-                                        dtype=fwd.dtype, stop_gradient=True)
+                                        dtype=fwd.dtype, stop_gradient=False)
         self.block.append_op("sum", inputs={"X": list(lst)},
                              outputs={"Out": [sum_out]},
                              attrs={"op_role": OpRole.Backward})
@@ -119,7 +135,7 @@ def append_backward(loss: Variable, parameter_list=None,
     acc = _GradAccumulator(block)
 
     # Seed: d(loss)/d(loss) = 1
-    loss_grad = grad_var_name(loss.name)
+    loss_grad = acc._base_name(loss.name)
     block.create_var(name=loss_grad, shape=loss.shape, dtype=loss.dtype,
                      stop_gradient=True)
     block.append_op("fill_constant",
@@ -130,8 +146,13 @@ def append_backward(loss: Variable, parameter_list=None,
                            "op_role": OpRole.Backward | OpRole.Loss})
     acc.contribs[loss.name] = [loss_grad]
 
+    # differentiate every non-optimizer op built so far — including the
+    # __vjp__ ops of earlier append_backward calls, so grad-of-grad works
+    # (the reference composes per-op DoubleGrad makers; ours composes
+    # jax.vjp of the __vjp__ lowering itself)
     fwd_ops = [op for op in block.ops
-               if op.attrs.get("op_role", 0) == OpRole.Forward]
+               if op.attrs.get("op_role", 0) & OpRole.Optimize == 0
+               and not (op.attrs.get("op_role", 0) & OpRole.Loss)]
 
     for op in reversed(fwd_ops):
         if not registry.has(op.type):
@@ -222,8 +243,6 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     pgs = append_backward(targets[0],
                           parameter_list=list(inputs),
                           no_grad_set=no_grad_set)
-    outs = []
-    for x in inputs:
-        gname = grad_var_name(x.name if isinstance(x, Variable) else x)
-        outs.append(block.var(gname) if block.has_var(gname) else None)
-    return outs
+    by_name = {p.name: g for p, g in pgs}
+    return [by_name.get(x.name if isinstance(x, Variable) else x)
+            for x in inputs]

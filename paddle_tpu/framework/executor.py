@@ -445,6 +445,8 @@ class Executor:
             fetch_list: Optional[list] = None, scope: Optional[Scope] = None,
             return_numpy: bool = True, use_program_cache: bool = True):
         program = program or default_main_program()
+        if hasattr(program, "_is_data_parallel"):   # CompiledProgram shim
+            program = program.program
         feed = feed or {}
         fetch_list = fetch_list or []
         scope = scope or global_scope()

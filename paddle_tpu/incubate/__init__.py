@@ -1,2 +1,4 @@
 """paddle.incubate (reference python/paddle/fluid/incubate/)."""
 from . import checkpoint  # noqa: F401
+from . import hdfs  # noqa: F401
+from .hdfs import HDFSClient  # noqa: F401

@@ -1,0 +1,98 @@
+"""HDFS/AFS shell-out client (reference incubate/fleet/utils/hdfs.py:74
+HDFSClient — wraps `hadoop fs` subcommands; used by Dataset file lists and
+fleet checkpoint paths). Same surface; gracefully errors when the hadoop
+binary is absent (this build's environments usually have none)."""
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import List, Optional, Tuple
+
+
+class ExecuteError(RuntimeError):
+    pass
+
+
+class HDFSClient:
+    def __init__(self, hadoop_home: Optional[str] = None, configs=None,
+                 time_out=5 * 60 * 1000, sleep_inter=1000):
+        self._hadoop = (os.path.join(hadoop_home, "bin", "hadoop")
+                        if hadoop_home else "hadoop")
+        self._conf_flags = []
+        for k, v in (configs or {}).items():
+            self._conf_flags += ["-D", f"{k}={v}"]
+        self._timeout_s = time_out / 1000.0
+
+    def _run(self, *fs_args) -> Tuple[int, str]:
+        cmd = [self._hadoop, "fs", *self._conf_flags, *fs_args]
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=self._timeout_s)
+        except FileNotFoundError as e:
+            raise ExecuteError(
+                f"hadoop binary not found ({self._hadoop}); set hadoop_home"
+            ) from e
+        except subprocess.TimeoutExpired as e:
+            raise ExecuteError(f"hadoop fs timed out: {fs_args}") from e
+        return r.returncode, r.stdout + r.stderr
+
+    def is_exist(self, path) -> bool:
+        rc, _ = self._run("-test", "-e", path)
+        return rc == 0
+
+    def is_dir(self, path) -> bool:
+        rc, _ = self._run("-test", "-d", path)
+        return rc == 0
+
+    def is_file(self, path) -> bool:
+        return self.is_exist(path) and not self.is_dir(path)
+
+    def ls(self, path) -> List[str]:
+        rc, out = self._run("-ls", path)
+        if rc != 0:
+            raise ExecuteError(f"hdfs ls {path} failed: {out}")
+        files = []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) >= 8:
+                files.append(parts[-1])
+        return files
+
+    def mkdirs(self, path):
+        rc, out = self._run("-mkdir", "-p", path)
+        if rc != 0:
+            raise ExecuteError(f"hdfs mkdir {path} failed: {out}")
+
+    def delete(self, path):
+        rc, out = self._run("-rm", "-r", "-skipTrash", path)
+        if rc != 0:
+            raise ExecuteError(f"hdfs rm {path} failed: {out}")
+
+    def upload(self, hdfs_path, local_path, overwrite=False, retry_times=5):
+        args = ["-put"] + (["-f"] if overwrite else []) + \
+            [local_path, hdfs_path]
+        last = ""
+        for _ in range(max(retry_times, 1)):
+            rc, last = self._run(*args)
+            if rc == 0:
+                return True
+        raise ExecuteError(f"hdfs upload failed: {last}")
+
+    def download(self, hdfs_path, local_path, overwrite=False, unzip=False):
+        if overwrite and os.path.exists(local_path):
+            if os.path.isfile(local_path):
+                os.remove(local_path)
+        rc, out = self._run("-get", hdfs_path, local_path)
+        if rc != 0:
+            raise ExecuteError(f"hdfs download failed: {out}")
+        return True
+
+    def rename(self, src, dst):
+        rc, out = self._run("-mv", src, dst)
+        if rc != 0:
+            raise ExecuteError(f"hdfs mv failed: {out}")
+
+    def touch(self, path):
+        rc, out = self._run("-touchz", path)
+        if rc != 0:
+            raise ExecuteError(f"hdfs touchz failed: {out}")

@@ -1,0 +1,101 @@
+"""Quantization (reference contrib/slim/quantization): fake-quant op
+numerics + STE grads, QAT transform training, PTQ calibration."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from op_test import run_op
+
+R = np.random.RandomState(0)
+
+
+def test_fake_qdq_numerics_and_ste_grad():
+    x = R.randn(4, 6).astype(np.float32)
+    out = run_op("fake_quantize_dequantize_abs_max", {"X": [x]},
+                 {"bit_length": 8})
+    o = np.asarray(out["Out"][0])
+    scale = float(np.asarray(out["OutScale"][0]))
+    assert abs(scale - np.abs(x).max()) < 1e-6
+    q = np.clip(np.round(x / scale * 127), -127, 127)
+    np.testing.assert_allclose(o, q * scale / 127, rtol=1e-5, atol=1e-6)
+    # quantization error bounded by half a step
+    assert np.abs(o - x).max() <= scale / 127
+    # STE: gradient of sum(out) wrt x is exactly ones (NOT the true
+    # staircase derivative — that's the point of the straight-through
+    # estimator, so no finite-difference check here)
+    import jax
+    import jax.numpy as jnp
+
+    def f(xx):
+        return jnp.sum(run_op("fake_quantize_dequantize_abs_max",
+                              {"X": [xx]}, {"bit_length": 8})["Out"][0])
+
+    g = np.asarray(jax.grad(f)(jnp.asarray(x)))
+    np.testing.assert_allclose(g, np.ones_like(x), rtol=1e-6)
+
+
+def test_channel_wise_scales():
+    w = R.randn(5, 3).astype(np.float32) * np.array([1., 10., 100.])
+    out = run_op("fake_channel_wise_quantize_dequantize_abs_max",
+                 {"X": [w]}, {"bit_length": 8, "quant_axis": 1})
+    scales = np.asarray(out["OutScale"][0])
+    np.testing.assert_allclose(scales, np.abs(w).max(axis=0), rtol=1e-6)
+
+
+def test_qat_transform_trains_and_stays_close():
+    def build(quant):
+        from paddle_tpu.testing import reset_programs
+        reset_programs(seed=4)
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        h = layers.fc(x, 16, act="relu",
+                      param_attr=paddle.ParamAttr(name="w1"))
+        pred = layers.fc(h, 1, param_attr=paddle.ParamAttr(name="w2"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        main, startup = (fluid.default_main_program(),
+                         fluid.default_startup_program())
+        if quant:
+            from paddle_tpu.contrib.slim import QuantizationTransformPass
+            QuantizationTransformPass().apply(main, startup)
+        paddle.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(1)
+        xs = rng.randn(32, 8).astype(np.float32)
+        ys = (xs.sum(1, keepdims=True) * 0.2).astype(np.float32)
+        losses = [float(exe.run(main, feed={"x": xs, "y": ys},
+                                fetch_list=[loss])[0]) for _ in range(25)]
+        return losses, main
+
+    fl, _ = build(False)
+    ql, qprog = build(True)
+    ops = [op.type for op in qprog.global_block().ops]
+    assert "fake_channel_wise_quantize_dequantize_abs_max" in ops
+    assert "fake_quantize_dequantize_moving_average_abs_max" in ops
+    assert ql[-1] < ql[0] * 0.5                      # QAT trains
+    assert abs(ql[-1] - fl[-1]) < max(0.1, fl[-1])   # close to float
+
+
+def test_ptq_calibration():
+    from paddle_tpu.contrib.slim import PostTrainingQuantization
+    from paddle_tpu.testing import reset_programs
+    reset_programs(seed=5)
+    x = layers.data(name="x", shape=[6], dtype="float32")
+    h = layers.fc(x, 8, act="relu", param_attr=paddle.ParamAttr(name="pw"))
+    out = layers.fc(h, 2, param_attr=paddle.ParamAttr(name="pw2"))
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(2)
+    feeds = [{"x": rng.randn(16, 6).astype(np.float32)} for _ in range(3)]
+    float_out = np.asarray(exe.run(feed=feeds[0], fetch_list=[out])[0])
+
+    ptq = PostTrainingQuantization(exe, fluid.default_main_program(),
+                                   ["x"], [out], feeds)
+    qprog = ptq.quantize()
+    ops = [op.type for op in qprog.global_block().ops]
+    assert "fake_quantize_dequantize_abs_max" in ops
+    q_out = np.asarray(exe.run(qprog, feed=feeds[0], fetch_list=[out])[0])
+    # int8 emulation stays close to the float program
+    denom = np.abs(float_out).max()
+    assert np.abs(q_out - float_out).max() / denom < 0.05
