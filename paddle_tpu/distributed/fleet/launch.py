@@ -74,15 +74,16 @@ def launch():
     else:
         # collective mode: delegate to the shared host launcher
         ips = args.ips.split(",")
-        port = args.port or _free_port()
-        endpoints = ",".join(f"{ip}:{port + i}" for i, ip in enumerate(ips))
+        ports = ([args.port + i for i in range(len(ips))] if args.port
+                 else free_ports(len(ips)))
+        endpoints = ",".join(f"{ip}:{p}" for ip, p in zip(ips, ports))
         for rank, ip in enumerate(ips):
             env = dict(os.environ,
                        TRAINING_ROLE="TRAINER",
                        PADDLE_TRAINER_ID=str(rank),
                        PADDLE_TRAINERS_NUM=str(len(ips)),
                        PADDLE_TRAINER_ENDPOINTS=endpoints,
-                       PADDLE_CURRENT_ENDPOINT=f"{ip}:{port + rank}")
+                       PADDLE_CURRENT_ENDPOINT=f"{ip}:{ports[rank]}")
             procs.append(_spawn(script, env, args.log_dir, f"trainer.{rank}"))
     rc = 0
     try:

@@ -1,0 +1,334 @@
+"""AST dygraph→static conversion of data-dependent Python control flow.
+
+Reference counterpart: fluid/dygraph/dygraph_to_static/ — the
+ProgramTranslator (program_translator.py:691) and its per-construct AST
+transformers (ifelse_transformer.py, loop_transformer.py,
+logical_transformer.py). Trace-based capture (jit.py) bakes the taken
+branch in; THIS path rewrites the function's AST so `if`/`while` over
+tensors become `__cond__`/`__while__` ops (lax.cond / lax.while_loop) when
+the program is built, while plain-Python conditions keep Python semantics.
+
+The rewrite (same shape as the reference's transformers):
+
+    if <cond>: BODY else: ORELSE
+      -->  def _t(): BODY; return (mods...)
+           def _f(): ORELSE; return (mods...)
+           (mods...) = _jst.convert_ifelse(<cond>, _t, _f)
+
+    while <cond>: BODY
+      -->  def _c(mods...): return <cond>
+           def _b(mods...): BODY; return (mods...)
+           (mods...) = _jst.convert_while(_c, _b, (mods...))
+
+where mods = simple variable names assigned inside the construct. `and`/
+`or`/`not` inside conditions become convert_logical_* calls so tensor
+conditions don't hit Python's short-circuit `__bool__`.
+
+Runtime dispatch: a static-graph Variable condition builds layers.cond /
+layers.while_loop ops; anything else (python bool, eager tensor) keeps
+eager semantics — exactly the reference's convert_ifelse contract.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Callable
+
+__all__ = ["convert_to_static", "convert_ifelse", "convert_while",
+           "convert_logical_and", "convert_logical_or", "convert_logical_not"]
+
+
+# ---------------------------------------------------------------------------
+# runtime converters
+# ---------------------------------------------------------------------------
+
+def _is_static_var(x) -> bool:
+    from .framework.program import Variable
+    return isinstance(x, Variable)
+
+
+def _to_bool(x) -> bool:
+    import numpy as np
+    if hasattr(x, "numpy"):
+        return bool(np.asarray(x.numpy()).reshape(-1)[0])
+    return bool(x)
+
+
+def _promote_outputs(fn):
+    """Static branches may assign plain python values; promote them to
+    Variables (the reference's to_static_variable) so cond can merge."""
+    def inner():
+        import numpy as np
+        from .layers import tensor as tensor_layers
+        out = fn()
+        out = out if isinstance(out, (list, tuple)) else (out,)
+        return tuple(
+            o if _is_static_var(o)
+            else tensor_layers.assign(np.asarray(o)) for o in out)
+    return inner
+
+
+def convert_ifelse(pred, true_fn, false_fn):
+    if _is_static_var(pred):
+        from .layers import control_flow
+        out = control_flow.cond(pred, _promote_outputs(true_fn),
+                                _promote_outputs(false_fn))
+        return out if isinstance(out, tuple) else \
+            (tuple(out) if isinstance(out, list) else (out,))
+    return true_fn() if _to_bool(pred) else false_fn()
+
+
+def convert_while(cond_fn, body_fn, loop_vars):
+    if any(_is_static_var(v) for v in loop_vars):
+        from .layers import control_flow
+        out = control_flow.while_loop(cond_fn, body_fn, list(loop_vars))
+        return tuple(out)
+    vars_ = tuple(loop_vars)
+    while _to_bool(cond_fn(*vars_)):
+        vars_ = body_fn(*vars_)
+    return vars_
+
+
+def convert_logical_and(lhs_fn, rhs_fn):
+    lhs = lhs_fn()
+    if _is_static_var(lhs):
+        from . import layers
+        return layers.logical_and(lhs, rhs_fn())
+    return lhs and rhs_fn()
+
+
+def convert_logical_or(lhs_fn, rhs_fn):
+    lhs = lhs_fn()
+    if _is_static_var(lhs):
+        from . import layers
+        return layers.logical_or(lhs, rhs_fn())
+    return lhs or rhs_fn()
+
+
+def convert_logical_not(x):
+    if _is_static_var(x):
+        from . import layers
+        return layers.logical_not(x)
+    return not x
+
+
+# ---------------------------------------------------------------------------
+# AST transformer
+# ---------------------------------------------------------------------------
+
+class _AssignedNames(ast.NodeVisitor):
+    """Simple Name targets assigned in a statement list (not descending into
+    nested function/class definitions)."""
+
+    def __init__(self):
+        self.names = []
+
+    def collect(self, stmts):
+        for s in stmts:
+            self.visit(s)
+        return self.names
+
+    def _add(self, node):
+        if isinstance(node, ast.Name) and node.id not in self.names:
+            self.names.append(node.id)
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                self._add(e)
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._add(t)
+
+    def visit_AugAssign(self, node):
+        self._add(node.target)
+
+    def visit_AnnAssign(self, node):
+        self._add(node.target)
+
+    def visit_For(self, node):
+        self._add(node.target)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        pass  # don't descend
+
+    def visit_AsyncFunctionDef(self, node):
+        pass
+
+    def visit_ClassDef(self, node):
+        pass
+
+
+def _names_tuple(names, ctx):
+    return ast.Tuple(elts=[ast.Name(id=n, ctx=ctx()) for n in names],
+                     ctx=ctx())
+
+
+class _Dy2Static(ast.NodeTransformer):
+    def __init__(self):
+        self._counter = 0
+
+    def _uid(self):
+        self._counter += 1
+        return self._counter
+
+    # --- conditions: and/or/not -> converter calls -------------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = ("__jst_and__" if isinstance(node.op, ast.And) else "__jst_or__")
+        out = node.values[-1]
+        for v in reversed(node.values[:-1]):
+            out = ast.Call(
+                func=ast.Name(id=fn, ctx=ast.Load()),
+                args=[ast.Lambda(args=ast.arguments(
+                          posonlyargs=[], args=[], kwonlyargs=[],
+                          kw_defaults=[], defaults=[]), body=v),
+                      ast.Lambda(args=ast.arguments(
+                          posonlyargs=[], args=[], kwonlyargs=[],
+                          kw_defaults=[], defaults=[]), body=out)],
+                keywords=[])
+        return out
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(func=ast.Name(id="__jst_not__", ctx=ast.Load()),
+                            args=[node.operand], keywords=[])
+        return node
+
+    # --- if ----------------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        mods = sorted(set(_AssignedNames().collect(node.body)) |
+                      set(_AssignedNames().collect(node.orelse)))
+        if not mods:
+            return node   # assignment-free branch: keep python semantics
+                          # (early-return/continue guards stay untouched)
+        if _contains_return(node.body) or _contains_return(node.orelse):
+            raise NotImplementedError(
+                "dy2static: `return` inside a converted `if` branch is not "
+                "supported — assign to a variable and return after the if")
+        uid = self._uid()
+        ret = ast.Return(value=_names_tuple(mods, ast.Load))
+        t_def = ast.FunctionDef(
+            name=f"__jst_true_{uid}", args=_noargs(),
+            body=list(node.body) + [ret], decorator_list=[])
+        f_def = ast.FunctionDef(
+            name=f"__jst_false_{uid}", args=_noargs(),
+            body=list(node.orelse or [ast.Pass()]) + [ret],
+            decorator_list=[])
+        call = ast.Assign(
+            targets=[_names_tuple(mods, ast.Store)],
+            value=ast.Call(func=ast.Name(id="__jst_ifelse__", ctx=ast.Load()),
+                           args=[node.test,
+                                 ast.Name(id=t_def.name, ctx=ast.Load()),
+                                 ast.Name(id=f_def.name, ctx=ast.Load())],
+                           keywords=[]))
+        return [t_def, f_def, call]
+
+    # --- while -------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        assigned = set(_AssignedNames().collect(node.body))
+        if not assigned:
+            return node
+        if _contains_return(node.body):
+            raise NotImplementedError(
+                "dy2static: `return`/`break` inside a converted `while` is "
+                "not supported")
+        # loop-carried = assigned names read by the condition or read in the
+        # body before their (re)assignment; pure per-iteration temporaries
+        # stay local to the body fn (they don't escape the loop)
+        mods = sorted(_loop_carried(node, assigned))
+        if not mods:
+            return node
+        uid = self._uid()
+        args = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=n) for n in mods],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        c_def = ast.FunctionDef(
+            name=f"__jst_cond_{uid}", args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[])
+        b_def = ast.FunctionDef(
+            name=f"__jst_body_{uid}", args=args,
+            body=list(node.body) + [
+                ast.Return(value=_names_tuple(mods, ast.Load))],
+            decorator_list=[])
+        call = ast.Assign(
+            targets=[_names_tuple(mods, ast.Store)],
+            value=ast.Call(func=ast.Name(id="__jst_while__", ctx=ast.Load()),
+                           args=[ast.Name(id=c_def.name, ctx=ast.Load()),
+                                 ast.Name(id=b_def.name, ctx=ast.Load()),
+                                 _names_tuple(mods, ast.Load)],
+                           keywords=[]))
+        return [c_def, b_def, call]
+
+
+def _noargs():
+    return ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                         kw_defaults=[], defaults=[])
+
+
+def _loop_carried(node, assigned):
+    carried = set()
+    for n in ast.walk(node.test):
+        if isinstance(n, ast.Name) and n.id in assigned:
+            carried.add(n.id)
+    bound = set()
+    for stmt in node.body:
+        loads = [n.id for n in ast.walk(stmt)
+                 if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)]
+        for name in loads:
+            if name in assigned and name not in bound:
+                carried.add(name)
+        bound |= set(_AssignedNames().collect([stmt]))
+    return carried
+
+
+def _contains_return(stmts) -> bool:
+    for s in stmts:
+        for node in ast.walk(s):
+            if isinstance(node, (ast.Return, ast.Break, ast.Continue)):
+                return True
+    return False
+
+
+def convert_to_static(fn: Callable) -> Callable:
+    """Rewrite fn's AST so tensor `if`/`while` build __cond__/__while__ ops.
+    The converted function keeps fn's closure and globals."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return fn   # no source (builtins, lambdas from C) — run as-is
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    fdef.decorator_list = []   # the decorator must not re-apply
+    new = _Dy2Static().visit(tree)
+    ast.fix_missing_locations(new)
+    code = compile(new, filename=f"<dy2static {fn.__name__}>", mode="exec")
+    glb = dict(fn.__globals__)
+    glb.update({
+        "__jst_ifelse__": convert_ifelse,
+        "__jst_while__": convert_while,
+        "__jst_and__": convert_logical_and,
+        "__jst_or__": convert_logical_or,
+        "__jst_not__": convert_logical_not,
+    })
+    # Rebind closure cells as globals. Divergence note: values are
+    # snapshotted at conversion time (a later rebind of the closed-over
+    # variable is not seen) — document-level parity with the reference's
+    # StaticFunction, which also resolves the function once. Empty cells
+    # (not-yet-bound recursion) are skipped.
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError:
+                pass
+    ns: dict = {}
+    exec(code, glb, ns)
+    out = ns[fdef.name]
+    return functools.wraps(fn)(out)

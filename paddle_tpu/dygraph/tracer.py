@@ -112,6 +112,14 @@ class Tensor:
     def __len__(self):
         return self.shape[0]
 
+    def __bool__(self):
+        import numpy as _np
+        a = _np.asarray(self.value)
+        if a.size != 1:
+            raise ValueError(
+                "truth value of a multi-element Tensor is ambiguous")
+        return bool(a.reshape(-1)[0])
+
     def __repr__(self):
         return (f"Tensor(shape={self.shape}, dtype={self.dtype}, "
                 f"stop_gradient={self.stop_gradient},\n{self.numpy()})")

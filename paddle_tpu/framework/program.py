@@ -111,6 +111,23 @@ class Variable:
     def __matmul__(self, o):
         return self._binary(o, "matmul")
 
+    # comparisons (reference math_op_patch.py: monkey_patch_variable adds
+    # these so converted control-flow conditions build compare ops)
+    def __gt__(self, o):
+        return self._binary(o, "greater_than")
+
+    def __ge__(self, o):
+        return self._binary(o, "greater_equal")
+
+    def __lt__(self, o):
+        return self._binary(o, "less_than")
+
+    def __le__(self, o):
+        return self._binary(o, "less_equal")
+
+    def __neg__(self):
+        return self._binary(-1.0, "elementwise_mul")
+
 
 class Parameter(Variable):
     """Persistable trainable variable (reference framework.py Parameter)."""

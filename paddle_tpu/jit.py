@@ -25,7 +25,8 @@ from .framework.dtype import convert_dtype, dtype_name
 from .framework.program import Operator, Program, in_dygraph_mode
 
 __all__ = ["to_static", "declarative", "save", "load", "TracedLayer",
-           "TranslatedLayer", "ProgramTranslator", "not_to_static"]
+           "TranslatedLayer", "ProgramTranslator", "not_to_static",
+           "dy2static"]
 
 
 class _Capture:
@@ -363,3 +364,8 @@ class TracedLayer:
         with open(os.path.join(path, "__model__"), "w") as f:
             json.dump(payload, f)
         np.savez(os.path.join(path, "params.npz"), **cap.param_values)
+
+
+# AST-level conversion of data-dependent python control flow (reference
+# dygraph_to_static transformers); trace capture handles the rest
+from . import dy2static  # noqa: E402,F401

@@ -1,0 +1,181 @@
+"""AST dygraph→static conversion: data-dependent `if`/`while` become
+__cond__/__while__ ops and match eager execution on both branch outcomes
+(reference dygraph_to_static parity tests)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.dy2static import convert_to_static
+
+
+def model_if(x):
+    s = layers.reduce_sum(x)
+    if s > 0:
+        y = x * 2.0
+        tag = s + 100.0
+    else:
+        y = x - 1.0
+        tag = s - 100.0
+    return y + 0.0 * tag, tag
+
+
+def model_while(x):
+    total = layers.reshape(layers.reduce_sum(x), [1])
+    steps = layers.fill_constant([1], "float32", 0.0)
+    while total > 1.0:
+        total = total * 0.5
+        steps = steps + 1.0
+    return total, steps
+
+
+def _run_static(fn, x_np):
+    from paddle_tpu.testing import reset_programs
+    reset_programs(seed=0)
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    outs = convert_to_static(fn)(x)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    return [np.asarray(v) for v in
+            exe.run(feed={"x": x_np}, fetch_list=list(outs))]
+
+
+def _run_eager(fn, x_np):
+    paddle.disable_static()
+    try:
+        outs = fn(paddle.to_tensor(x_np))
+        return [np.asarray(o.numpy()) for o in outs]
+    finally:
+        paddle.enable_static()
+
+
+def test_if_converts_to_cond_op_and_matches_eager():
+    pos = np.ones((2, 4), np.float32)
+    neg = -np.ones((2, 4), np.float32)
+    # static program built ONCE must handle BOTH branch outcomes at runtime
+    from paddle_tpu.testing import reset_programs
+    reset_programs(seed=0)
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    y, tag = convert_to_static(model_if)(x)
+    ops = [op.type for op in fluid.default_main_program().global_block().ops]
+    assert "__cond__" in ops, ops
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    for x_np in (pos, neg):
+        got_y, got_tag = exe.run(feed={"x": x_np}, fetch_list=[y, tag])
+        want_y, want_tag = _run_eager(model_if, x_np)
+        np.testing.assert_allclose(np.asarray(got_y), want_y, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(got_tag), want_tag, rtol=1e-5)
+
+
+def test_while_converts_to_while_op_and_matches_eager():
+    from paddle_tpu.testing import reset_programs
+    reset_programs(seed=0)
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    total, steps = convert_to_static(model_while)(x)
+    ops = [op.type for op in fluid.default_main_program().global_block().ops]
+    assert "__while__" in ops, ops
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    for scale in (8.0, 0.25):   # data-dependent iteration counts (incl. 0)
+        x_np = np.full((1, 4), scale, np.float32)
+        got = exe.run(feed={"x": x_np}, fetch_list=[total, steps])
+        want = _run_eager(model_while, x_np)
+        np.testing.assert_allclose(np.asarray(got[0]).reshape(-1),
+                                   np.asarray(want[0]).reshape(-1),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(got[1]).reshape(-1),
+                                   np.asarray(want[1]).reshape(-1),
+                                   rtol=1e-5)
+
+
+def test_logical_ops_and_python_fallback():
+    def f(x, flag):
+        s = layers.reduce_sum(x)
+        if flag and x.shape[-1] > 0:          # plain python condition
+            z = x + 1.0
+        else:
+            z = x - 1.0
+        return (z,)
+
+    x_np = np.ones((2, 4), np.float32)
+    from paddle_tpu.testing import reset_programs
+    reset_programs(seed=0)
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    (z,) = convert_to_static(f)(x, True)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    out, = exe.run(feed={"x": x_np}, fetch_list=[z])
+    np.testing.assert_allclose(np.asarray(out), x_np + 1.0)
+
+
+def test_tensor_logical_and_in_condition():
+    def f(x):
+        s = layers.reduce_sum(x)
+        if (s > 0.0) and (s < 10.0):
+            y = x * 3.0
+        else:
+            y = x * 0.0
+        return (y,)
+
+    from paddle_tpu.testing import reset_programs
+    reset_programs(seed=0)
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    (y,) = convert_to_static(f)(x)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    inside = np.full((1, 4), 1.0, np.float32)     # sum=4 in (0,10)
+    outside = np.full((1, 4), 5.0, np.float32)    # sum=20 not < 10
+    o1, = exe.run(feed={"x": inside}, fetch_list=[y])
+    o2, = exe.run(feed={"x": outside}, fetch_list=[y])
+    np.testing.assert_allclose(np.asarray(o1), inside * 3.0)
+    np.testing.assert_allclose(np.asarray(o2), outside * 0.0)
+
+
+def test_loop_temporaries_and_guard_returns():
+    """Review regressions: per-iteration temporaries must not become loop
+    carries, and assignment-free early-return guards stay pure python."""
+    def f_tmp(n):
+        y = 0
+        while y < n:
+            t = 1
+            y = y + t
+        return y
+
+    assert convert_to_static(f_tmp)(3) == 3
+
+    def f_guard(x):
+        if x is None:
+            return 0
+        return x + 1
+
+    g = convert_to_static(f_guard)
+    assert g(None) == 0 and g(4) == 5
+
+
+def test_python_value_in_tensor_branch():
+    """Plain-python assignments inside a tensor branch are promoted to
+    Variables (reference to_static_variable)."""
+    from paddle_tpu.testing import reset_programs
+    reset_programs(seed=0)
+
+    def f(x):
+        s = layers.reduce_sum(x)
+        if s > 0:
+            y = x * 2.0
+            flag = 1.0
+        else:
+            y = x - 1.0
+            flag = 0.0
+        return y, flag
+
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    y, flag = convert_to_static(f)(x)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    ov, fv = exe.run(feed={"x": np.ones((1, 4), np.float32)},
+                     fetch_list=[y, flag])
+    assert float(np.asarray(fv).reshape(-1)[0]) == 1.0
+    ov, fv = exe.run(feed={"x": -np.ones((1, 4), np.float32)},
+                     fetch_list=[y, flag])
+    assert float(np.asarray(fv).reshape(-1)[0]) == 0.0
