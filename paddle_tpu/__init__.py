@@ -41,7 +41,7 @@ from . import io
 from .ops import (math_ops, nn_ops, tensor_ops, optimizer_ops,  # noqa: F401
                   metric_ops, attention, sequence_ops,  # noqa: F401
                   extra_ops, decode_ops, detection_ops,  # noqa: F401
-                  sparse_grad, moe)  # noqa: F401
+                  sparse_grad, moe, tail_ops)  # noqa: F401
 
 __version__ = "0.1.0"
 
@@ -125,6 +125,23 @@ from .hapi import Model, Input  # noqa: E402
 from . import hapi  # noqa: E402
 from . import io  # noqa: E402,F401  (paddle.io.DataLoader etc.)
 from . import dataset as _fluid_dataset  # noqa: E402,F401
+# Legacy paddle.dataset.* reader modules live on the same `dataset`
+# namespace as fluid's DatasetFactory (reference python/paddle/dataset/):
+# paddle.dataset.mnist.train() and fluid.dataset.DatasetFactory() both work.
+import sys as _sys  # noqa: E402
+from . import dataset_legacy as _dataset_legacy  # noqa: E402
+
+
+def _graft_legacy_datasets():
+    for _name in _dataset_legacy.__all__:
+        _mod = getattr(_dataset_legacy, _name)
+        setattr(_fluid_dataset, _name, _mod)
+        _sys.modules[f"{__name__}.dataset.{_name}"] = _mod
+
+
+_graft_legacy_datasets()
+from . import vision  # noqa: E402,F401
+from . import text  # noqa: E402,F401
 from . import jit  # noqa: E402
 from . import inference  # noqa: E402
 from . import profiler  # noqa: E402

@@ -1,0 +1,146 @@
+"""Legacy `paddle.dataset.*` reader-creator API (reference
+python/paddle/dataset/: uci_housing.py, mnist.py, cifar.py, imdb.py,
+imikolov.py, movielens.py, flowers.py, wmt14.py, wmt16.py, conll05.py).
+
+Each submodule exposes `train()`/`test()` returning a zero-arg reader
+function whose iterator yields per-sample tuples — the contract consumed by
+`fluid.io.batch`/DataFeeder. Backed by the map-style datasets in
+paddle_tpu.vision/.text (local files when present, deterministic synthetic
+fallback otherwise — zero-egress build).
+"""
+from __future__ import annotations
+
+import types
+
+
+def _reader_from(ds_factory, normalize=None):
+    def reader_creator(*a, **kw):
+        def reader():
+            ds = ds_factory(*a, **kw)
+            for i in range(len(ds)):
+                sample = ds[i]
+                yield normalize(sample) if normalize else sample
+        return reader
+    return reader_creator
+
+
+def _module(name, **readers):
+    m = types.ModuleType(f"paddle_tpu.dataset.{name}")
+    for k, v in readers.items():
+        setattr(m, k, v)
+    return m
+
+
+def _make():
+    import numpy as np
+    from ..vision.datasets import MNIST, Cifar10, Cifar100, Flowers
+    from ..text import (UCIHousing, Imdb, Imikolov, Movielens, WMT14,
+                        WMT16, Conll05st)
+
+    def _mnist_sample(s):
+        img, label = s
+        return (np.asarray(img, np.float32).reshape(-1) / 127.5 - 1.0,
+                int(label))
+
+    mnist = _module(
+        "mnist",
+        train=_reader_from(lambda: MNIST(mode="train"), _mnist_sample),
+        test=_reader_from(lambda: MNIST(mode="test"), _mnist_sample))
+
+    def _cifar_sample(s):
+        img, label = s
+        return (np.asarray(img, np.float32).transpose(2, 0, 1).reshape(-1)
+                / 255.0, int(label))
+
+    cifar = _module(
+        "cifar",
+        train10=_reader_from(lambda: Cifar10(mode="train"), _cifar_sample),
+        test10=_reader_from(lambda: Cifar10(mode="test"), _cifar_sample),
+        train100=_reader_from(lambda: Cifar100(mode="train"), _cifar_sample),
+        test100=_reader_from(lambda: Cifar100(mode="test"), _cifar_sample))
+
+    uci_housing = _module(
+        "uci_housing",
+        train=_reader_from(lambda: UCIHousing(mode="train")),
+        test=_reader_from(lambda: UCIHousing(mode="test")),
+        UCI_TRAIN_DATA=None, UCI_TEST_DATA=None)
+
+    def _imdb_sample(s):
+        doc, label = s
+        return list(int(w) for w in doc), int(label)
+
+    imdb = _module(
+        "imdb",
+        train=_reader_from(lambda word_idx=None: Imdb(mode="train"),
+                           _imdb_sample),
+        test=_reader_from(lambda word_idx=None: Imdb(mode="test"),
+                          _imdb_sample),
+        word_dict=lambda: Imdb(mode="train").word_idx)
+
+    imikolov = _module(
+        "imikolov",
+        train=_reader_creator_imikolov("train"),
+        test=_reader_creator_imikolov("test"),
+        build_dict=lambda min_word_freq=50: Imikolov(
+            mode="train").word_idx)
+
+    movielens = _module(
+        "movielens",
+        train=_reader_from(lambda: Movielens(mode="train")),
+        test=_reader_from(lambda: Movielens(mode="test")),
+        max_user_id=lambda: 6040, max_movie_id=lambda: 3952,
+        max_job_id=lambda: 20, age_table=[1, 18, 25, 35, 45, 50, 56])
+
+    flowers = _module(
+        "flowers",
+        train=_reader_from(lambda: Flowers(mode="train")),
+        valid=_reader_from(lambda: Flowers(mode="valid")),
+        test=_reader_from(lambda: Flowers(mode="test")))
+
+    def _wmt(cls, name):
+        return _module(
+            name,
+            train=_reader_from(lambda dict_size=30000: cls(mode="train")),
+            test=_reader_from(lambda dict_size=30000: cls(mode="test")))
+
+    def _conll_dicts():
+        ds = Conll05st(mode="train")
+        return ds.word_dict, ds.predicate_dict, ds.label_dict
+
+    conll05 = _module(
+        "conll05",
+        test=_reader_from(lambda: Conll05st(mode="test")),
+        get_dict=_conll_dicts)
+
+    return {
+        "mnist": mnist, "cifar": cifar, "uci_housing": uci_housing,
+        "imdb": imdb, "imikolov": imikolov, "movielens": movielens,
+        "flowers": flowers, "wmt14": _wmt(WMT14, "wmt14"),
+        "wmt16": _wmt(WMT16, "wmt16"), "conll05": conll05,
+    }
+
+
+def _reader_creator_imikolov(mode):
+    def creator(word_idx=None, n=5, data_type=None):
+        def reader():
+            from ..text import Imikolov
+            ds = Imikolov(mode=mode, window_size=n)
+            for i in range(len(ds)):
+                yield ds[i]
+        return reader
+    return creator
+
+
+_mods = _make()
+mnist = _mods["mnist"]
+cifar = _mods["cifar"]
+uci_housing = _mods["uci_housing"]
+imdb = _mods["imdb"]
+imikolov = _mods["imikolov"]
+movielens = _mods["movielens"]
+flowers = _mods["flowers"]
+wmt14 = _mods["wmt14"]
+wmt16 = _mods["wmt16"]
+conll05 = _mods["conll05"]
+
+__all__ = list(_mods)

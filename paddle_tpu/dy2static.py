@@ -11,18 +11,26 @@ the program is built, while plain-Python conditions keep Python semantics.
 The rewrite (same shape as the reference's transformers):
 
     if <cond>: BODY else: ORELSE
-      -->  def _t(): BODY; return (mods...)
-           def _f(): ORELSE; return (mods...)
-           (mods...) = _jst.convert_ifelse(<cond>, _t, _f)
+      -->  def _t(mods...): BODY; return (mods...)
+           def _f(mods...): ORELSE; return (mods...)
+           (mods...) = _jst.convert_ifelse(<cond>, lambda: _t(mods...),
+                                           lambda: _f(mods...))
 
     while <cond>: BODY
       -->  def _c(mods...): return <cond>
            def _b(mods...): BODY; return (mods...)
            (mods...) = _jst.convert_while(_c, _b, (mods...))
 
-where mods = simple variable names assigned inside the construct. `and`/
-`or`/`not` inside conditions become convert_logical_* calls so tensor
-conditions don't hit Python's short-circuit `__bool__`.
+where mods = simple variable names assigned inside the construct and read
+afterwards (an over-approximated liveness pass tracks reads after each
+statement, so loop temporaries consumed after the loop are carried too).
+Branch/body functions receive mods as parameters, so read-modify-write
+(`s = s + 1`) works. Names possibly unbound before the construct (assigned
+in only one branch) are seeded with an UndefinedVar sentinel — reading one
+in static mode raises a clear error, mirroring the reference's
+UndefinedVar contract (dygraph_to_static/utils.py). `and`/`or`/`not`
+inside conditions become convert_logical_* calls so tensor conditions
+don't hit Python's short-circuit `__bool__`.
 
 Runtime dispatch: a static-graph Variable condition builds layers.cond /
 layers.while_loop ops; anything else (python bool, eager tensor) keeps
@@ -44,6 +52,18 @@ __all__ = ["convert_to_static", "convert_ifelse", "convert_while",
 # runtime converters
 # ---------------------------------------------------------------------------
 
+class _UndefinedVar:
+    """Placeholder for a name not yet bound when a converted construct runs
+    (reference dygraph_to_static/utils.py UndefinedVar). Reading it through
+    the static merge path raises a clear error."""
+
+    def __repr__(self):
+        return "<dy2static undefined variable>"
+
+
+UNDEF = _UndefinedVar()
+
+
 def _is_static_var(x) -> bool:
     from .framework.program import Variable
     return isinstance(x, Variable)
@@ -64,6 +84,12 @@ def _promote_outputs(fn):
         from .layers import tensor as tensor_layers
         out = fn()
         out = out if isinstance(out, (list, tuple)) else (out,)
+        for o in out:
+            if o is UNDEF:
+                raise ValueError(
+                    "dy2static: a variable assigned in only one branch of a "
+                    "converted `if` (or only inside a loop) is merged in "
+                    "static mode — initialize it before the construct")
         return tuple(
             o if _is_static_var(o)
             else tensor_layers.assign(np.asarray(o)) for o in out)
@@ -82,6 +108,10 @@ def convert_ifelse(pred, true_fn, false_fn):
 
 def convert_while(cond_fn, body_fn, loop_vars):
     if any(_is_static_var(v) for v in loop_vars):
+        if any(v is UNDEF for v in loop_vars):
+            raise ValueError(
+                "dy2static: a loop variable is read before assignment in a "
+                "converted `while` — initialize it before the loop")
         from .layers import control_flow
         out = control_flow.while_loop(cond_fn, body_fn, list(loop_vars))
         return tuple(out)
@@ -166,13 +196,64 @@ def _names_tuple(names, ctx):
                      ctx=ctx())
 
 
+def _loads(node):
+    return {n.id for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
 class _Dy2Static(ast.NodeTransformer):
     def __init__(self):
         self._counter = 0
+        # Over-approximated liveness: names read after the statement being
+        # visited (within its block and all enclosing blocks). Drives which
+        # assigned names a converted construct must carry out.
+        self._after = [set()]
 
     def _uid(self):
         self._counter += 1
         return self._counter
+
+    def generic_visit(self, node):
+        """Like NodeTransformer.generic_visit but statement lists are
+        processed back-to-front so each statement sees the set of names read
+        after it (self._after[-1])."""
+        for field, old in ast.iter_fields(node):
+            if isinstance(old, list) and old and \
+                    all(isinstance(s, ast.stmt) for s in old):
+                setattr(node, field, self._visit_block(old))
+            elif isinstance(old, list):
+                new = []
+                for v in old:
+                    if isinstance(v, ast.AST):
+                        v = self.visit(v)
+                        if v is None:
+                            continue
+                        if not isinstance(v, ast.AST):
+                            new.extend(v)
+                            continue
+                    new.append(v)
+                setattr(node, field, new)
+            elif isinstance(old, ast.AST):
+                v = self.visit(old)
+                if v is None:
+                    delattr(node, field)
+                else:
+                    setattr(node, field, v)
+        return node
+
+    def _visit_block(self, stmts):
+        after = set(self._after[-1])
+        out_rev = []
+        for s in reversed(stmts):
+            s_loads = _loads(s)   # from the original node, pre-transform
+            self._after.append(set(after))
+            res = self.visit(s)
+            self._after.pop()
+            items = ([] if res is None
+                     else res if isinstance(res, list) else [res])
+            out_rev.extend(reversed(items))
+            after |= s_loads
+        return list(reversed(out_rev))
 
     # --- conditions: and/or/not -> converter calls -------------------------
     def visit_BoolOp(self, node):
@@ -200,37 +281,47 @@ class _Dy2Static(ast.NodeTransformer):
 
     # --- if ----------------------------------------------------------------
     def visit_If(self, node):
+        reads_after = set(self._after[-1])
         self.generic_visit(node)
-        mods = sorted(set(_AssignedNames().collect(node.body)) |
-                      set(_AssignedNames().collect(node.orelse)))
-        if not mods:
+        assigned = (set(_AssignedNames().collect(node.body)) |
+                    set(_AssignedNames().collect(node.orelse)))
+        if not assigned:
             return node   # assignment-free branch: keep python semantics
                           # (early-return/continue guards stay untouched)
         if _contains_return(node.body) or _contains_return(node.orelse):
             raise NotImplementedError(
                 "dy2static: `return` inside a converted `if` branch is not "
                 "supported — assign to a variable and return after the if")
+        # carry only names someone reads later; if none are read later the
+        # branches still run (side effects) with the full assigned set
+        mods = sorted(assigned & reads_after) or sorted(assigned)
         uid = self._uid()
+        args = _mods_args(mods)
         ret = ast.Return(value=_names_tuple(mods, ast.Load))
         t_def = ast.FunctionDef(
-            name=f"__jst_true_{uid}", args=_noargs(),
+            name=f"__jst_true_{uid}", args=args,
             body=list(node.body) + [ret], decorator_list=[])
         f_def = ast.FunctionDef(
-            name=f"__jst_false_{uid}", args=_noargs(),
+            name=f"__jst_false_{uid}", args=args,
             body=list(node.orelse or [ast.Pass()]) + [ret],
             decorator_list=[])
         call = ast.Assign(
             targets=[_names_tuple(mods, ast.Store)],
             value=ast.Call(func=ast.Name(id="__jst_ifelse__", ctx=ast.Load()),
                            args=[node.test,
-                                 ast.Name(id=t_def.name, ctx=ast.Load()),
-                                 ast.Name(id=f_def.name, ctx=ast.Load())],
+                                 _thunk_call(t_def.name, mods),
+                                 _thunk_call(f_def.name, mods)],
                            keywords=[]))
-        return [t_def, f_def, call]
+        return [_undef_guard(m) for m in mods] + [t_def, f_def, call]
 
     # --- while -------------------------------------------------------------
     def visit_While(self, node):
+        reads_after = set(self._after[-1])
+        # inside the body, anything the loop itself reads (test or body, any
+        # iteration) counts as read-after for nested constructs
+        self._after.append(reads_after | _loads(node))
         self.generic_visit(node)
+        self._after.pop()
         assigned = set(_AssignedNames().collect(node.body))
         if not assigned:
             return node
@@ -238,17 +329,14 @@ class _Dy2Static(ast.NodeTransformer):
             raise NotImplementedError(
                 "dy2static: `return`/`break` inside a converted `while` is "
                 "not supported")
-        # loop-carried = assigned names read by the condition or read in the
-        # body before their (re)assignment; pure per-iteration temporaries
-        # stay local to the body fn (they don't escape the loop)
-        mods = sorted(_loop_carried(node, assigned))
+        # loop-carried = assigned names read by the condition, read in the
+        # body before their (re)assignment, or read after the loop
+        mods = sorted(_loop_carried(node, assigned) |
+                      (assigned & reads_after))
         if not mods:
             return node
         uid = self._uid()
-        args = ast.arguments(
-            posonlyargs=[],
-            args=[ast.arg(arg=n) for n in mods],
-            kwonlyargs=[], kw_defaults=[], defaults=[])
+        args = _mods_args(mods)
         c_def = ast.FunctionDef(
             name=f"__jst_cond_{uid}", args=args,
             body=[ast.Return(value=node.test)], decorator_list=[])
@@ -264,12 +352,47 @@ class _Dy2Static(ast.NodeTransformer):
                                  ast.Name(id=b_def.name, ctx=ast.Load()),
                                  _names_tuple(mods, ast.Load)],
                            keywords=[]))
-        return [c_def, b_def, call]
+        return [_undef_guard(m) for m in mods] + [c_def, b_def, call]
+
+    def visit_For(self, node):
+        # python-semantics loop, but nested converted constructs must treat
+        # every name the loop reads as live (next-iteration reads)
+        self._after.append(set(self._after[-1]) | _loads(node))
+        self.generic_visit(node)
+        self._after.pop()
+        return node
 
 
 def _noargs():
     return ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
                          kw_defaults=[], defaults=[])
+
+
+def _mods_args(mods):
+    return ast.arguments(posonlyargs=[], args=[ast.arg(arg=n) for n in mods],
+                         kwonlyargs=[], kw_defaults=[], defaults=[])
+
+
+def _thunk_call(fname, mods):
+    """lambda: fname(m1, ..., mk) — defers evaluation to convert_ifelse."""
+    return ast.Lambda(
+        args=_noargs(),
+        body=ast.Call(func=ast.Name(id=fname, ctx=ast.Load()),
+                      args=[ast.Name(id=m, ctx=ast.Load()) for m in mods],
+                      keywords=[]))
+
+
+def _undef_guard(name):
+    """try: name / except NameError: name = __jst_undef__ — seeds names that
+    may be unbound before the construct (UnboundLocalError ⊂ NameError)."""
+    return ast.Try(
+        body=[ast.Expr(value=ast.Name(id=name, ctx=ast.Load()))],
+        handlers=[ast.ExceptHandler(
+            type=ast.Name(id="NameError", ctx=ast.Load()), name=None,
+            body=[ast.Assign(
+                targets=[ast.Name(id=name, ctx=ast.Store())],
+                value=ast.Name(id="__jst_undef__", ctx=ast.Load()))])],
+        orelse=[], finalbody=[])
 
 
 def _loop_carried(node, assigned):
@@ -289,11 +412,27 @@ def _loop_carried(node, assigned):
 
 
 def _contains_return(stmts) -> bool:
-    for s in stmts:
-        for node in ast.walk(s):
-            if isinstance(node, (ast.Return, ast.Break, ast.Continue)):
+    """Direct return/break/continue in these statements. Does NOT descend
+    into nested function/class defs (incl. the __jst_* defs synthesized for
+    inner constructs) nor into nested loops, whose break/continue bind
+    locally."""
+    def check(nodes, in_loop_ok):
+        for s in nodes:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(s, ast.Return):
                 return True
-    return False
+            if isinstance(s, (ast.Break, ast.Continue)) and not in_loop_ok:
+                return True
+            if isinstance(s, (ast.For, ast.While)):
+                if check(ast.iter_child_nodes(s), True):
+                    return True
+                continue
+            if check(ast.iter_child_nodes(s), in_loop_ok):
+                return True
+        return False
+    return check(stmts, False)
 
 
 def convert_to_static(fn: Callable) -> Callable:
@@ -316,6 +455,7 @@ def convert_to_static(fn: Callable) -> Callable:
         "__jst_and__": convert_logical_and,
         "__jst_or__": convert_logical_or,
         "__jst_not__": convert_logical_not,
+        "__jst_undef__": UNDEF,
     })
     # Rebind closure cells as globals. Divergence note: values are
     # snapshotted at conversion time (a later rebind of the closed-over

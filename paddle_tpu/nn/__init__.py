@@ -26,7 +26,8 @@ __all__ = [
     "Layer", "Linear", "Conv2D", "Conv2DTranspose", "BatchNorm", "BatchNorm1D",
     "BatchNorm2D", "LayerNorm", "GroupNorm", "Embedding", "Dropout",
     "MaxPool2D", "AvgPool2D", "AdaptiveAvgPool2D", "ReLU", "GELU", "Sigmoid",
-    "Tanh", "LeakyReLU", "Softmax", "Silu", "Hardswish", "Flatten",
+    "Tanh", "LeakyReLU", "Softmax", "Silu", "Hardswish", "ReLU6",
+    "Hardsigmoid", "Flatten",
     "Sequential", "LayerList", "ParameterList", "CrossEntropyLoss", "MSELoss",
     "BCEWithLogitsLoss", "functional", "initializer", "Identity", "Pad2D",
     "Upsample", "ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
@@ -439,6 +440,8 @@ LeakyReLU = _act_layer("leaky_relu")
 Softmax = _act_layer("softmax")
 Silu = _act_layer("silu")
 Hardswish = _act_layer("hardswish")
+ReLU6 = _act_layer("relu6")
+Hardsigmoid = _act_layer("hardsigmoid")
 
 
 class Identity(Layer):

@@ -54,6 +54,15 @@ def hardswish(x):
     return _apply("hard_swish", {"X": [x]}, {})
 
 
+def relu6(x):
+    return _apply("relu6", {"X": [x]}, {"threshold": 6.0})
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5):
+    return _apply("hard_sigmoid", {"X": [x]}, {"slope": slope,
+                                               "offset": offset})
+
+
 def softmax(x, axis=-1):
     return _apply("softmax", {"X": [x]}, {"axis": axis})
 

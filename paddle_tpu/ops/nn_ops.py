@@ -398,7 +398,8 @@ def _interpolate(ctx, ins, attrs):
         out_h = int(h * scale)
         out_w = int(w * scale)
     jm = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic"}[method]
-    out = jax.image.resize(x, (n, c, out_h, out_w), method=jm)
+    out = jax.image.resize(x, (n, c, out_h, out_w), method=jm,
+                           antialias=False)
     return {"Out": [out.astype(x.dtype)]}
 
 

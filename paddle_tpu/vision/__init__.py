@@ -1,0 +1,18 @@
+"""paddle.vision parity surface (reference python/paddle/vision/__init__.py).
+
+TPU-first split: transforms/datasets run on the host CPU as part of the
+data plane (numpy/PIL); models are paddle.nn Layers whose compute lowers
+to XLA. Nothing here touches the device until tensors are fed.
+"""
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from .models import (  # noqa: F401
+    LeNet, VGG, vgg11, vgg13, vgg16, vgg19, ResNet, resnet18, resnet34,
+    resnet50, resnet101, resnet152, MobileNetV1, MobileNetV2, mobilenet_v1,
+    mobilenet_v2)
+from .datasets import (  # noqa: F401
+    DatasetFolder, ImageFolder, MNIST, FashionMNIST, Cifar10, Cifar100,
+    Flowers, VOC2012)
+
+__all__ = models.__all__ + datasets.__all__ + ["transforms"]

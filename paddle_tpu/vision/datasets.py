@@ -1,0 +1,253 @@
+"""Vision datasets (reference python/paddle/vision/datasets/).
+
+Zero-egress environment: when the backing files exist locally (the same
+formats the reference downloads — MNIST idx, CIFAR pickle tars, image
+folders) they are parsed for real; otherwise each dataset falls back to a
+small deterministic synthetic sample set (seeded per class name) so
+pipelines and tests run without network access. `backend` handling and the
+(image, label) sample contract match the reference.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ..dataloader.dataset import Dataset
+
+__all__ = ["DatasetFolder", "ImageFolder", "MNIST", "FashionMNIST",
+           "Cifar10", "Cifar100", "Flowers", "VOC2012"]
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp", ".npy")
+
+
+def _load_image(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    from PIL import Image
+    with Image.open(path) as im:
+        return np.asarray(im.convert("RGB"))
+
+
+class DatasetFolder(Dataset):
+    """root/class_x/xxx.png layout (reference datasets/folder.py)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _load_image
+        self.transform = transform
+        extensions = extensions or IMG_EXTENSIONS
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class folders under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            for dirpath, _, files in sorted(os.walk(os.path.join(root, c))):
+                for fn in sorted(files):
+                    path = os.path.join(dirpath, fn)
+                    ok = (is_valid_file(path) if is_valid_file
+                          else fn.lower().endswith(extensions))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+
+class ImageFolder(Dataset):
+    """Flat folder of images, no labels (reference datasets/folder.py)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.loader = loader or _load_image
+        self.transform = transform
+        extensions = extensions or IMG_EXTENSIONS
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                path = os.path.join(dirpath, fn)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fn.lower().endswith(extensions))
+                if ok:
+                    self.samples.append(path)
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return (img,)
+
+
+def _synthetic(name, n, shape, num_classes, dtype=np.uint8):
+    rng = np.random.RandomState(abs(hash(name)) % (2 ** 31))
+    hi = 256 if dtype == np.uint8 else 2
+    imgs = rng.randint(0, hi, (n,) + shape).astype(dtype)
+    labels = (np.arange(n) % num_classes).astype(np.int64)
+    return imgs, labels
+
+
+class _ArrayDataset(Dataset):
+    transform = None
+
+    def __init__(self, images, labels, transform=None):
+        self.images, self.labels = images, labels
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self.labels[idx])
+
+
+def _read_idx(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return np.frombuffer(f.read(), np.uint8).reshape(dims)
+
+
+class MNIST(_ArrayDataset):
+    """idx/idx.gz files when given, else deterministic synthetic digits."""
+
+    NUM_CLASSES = 10
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        if image_path and label_path and os.path.exists(image_path):
+            images = _read_idx(image_path)
+            labels = _read_idx(label_path).astype(np.int64)
+        else:
+            n = 512 if mode == "train" else 128
+            images, labels = _synthetic(
+                f"{type(self).__name__}-{mode}", n, (28, 28),
+                self.NUM_CLASSES)
+        super().__init__(images, labels, transform)
+        self.mode = mode
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class _Cifar(_ArrayDataset):
+    num_classes = 10
+    label_key = b"labels"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        if data_file and os.path.exists(data_file):
+            images, labels = self._parse_tar(data_file, mode)
+        else:
+            n = 500 if mode == "train" else 100
+            images, labels = _synthetic(
+                f"{type(self).__name__}-{mode}", n, (32, 32, 3),
+                self.num_classes)
+        super().__init__(images, labels, transform)
+        self.mode = mode
+
+    def _parse_tar(self, data_file, mode):
+        want = "test" if mode in ("test", "valid") else "train"
+        imgs, labs = [], []
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                name = os.path.basename(m.name)
+                is_train = name.startswith("data_batch") or name == "train"
+                is_test = name.startswith("test_batch") or name == "test"
+                if (want == "train" and is_train) or \
+                        (want == "test" and is_test):
+                    batch = pickle.load(tf.extractfile(m), encoding="bytes")
+                    data = batch[b"data"].reshape(-1, 3, 32, 32)
+                    imgs.append(data.transpose(0, 2, 3, 1))
+                    labs.extend(batch.get(self.label_key,
+                                          batch.get(b"fine_labels")))
+        return np.concatenate(imgs), np.asarray(labs, np.int64)
+
+
+class Cifar10(_Cifar):
+    pass
+
+
+class Cifar100(_Cifar):
+    num_classes = 100
+    label_key = b"fine_labels"
+
+
+class Flowers(_ArrayDataset):
+    """102-category flowers; synthetic fallback (64x64 RGB)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        if data_file and os.path.exists(data_file):
+            import scipy.io as sio
+            labels = sio.loadmat(label_file)["labels"].ravel() - 1
+            setid = sio.loadmat(setid_file)
+            key = {"train": "trnid", "valid": "valid", "test": "tstid"}[mode]
+            idxs = setid[key].ravel() - 1
+            imgs, labs = [], []
+            with tarfile.open(data_file) as tf:
+                names = sorted(m.name for m in tf.getmembers()
+                               if m.name.endswith(".jpg"))
+                from PIL import Image
+                for i in idxs:
+                    with Image.open(tf.extractfile(names[i])) as im:
+                        imgs.append(np.asarray(
+                            im.convert("RGB").resize((64, 64))))
+                    labs.append(labels[i])
+            images, labels = np.stack(imgs), np.asarray(labs, np.int64)
+        else:
+            n = 306 if mode == "train" else 102
+            images, labels = _synthetic(f"Flowers-{mode}", n, (64, 64, 3),
+                                        102)
+        super().__init__(images, labels, transform)
+        self.mode = mode
+
+
+class VOC2012(Dataset):
+    """Segmentation pairs (image, mask); synthetic fallback."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        self.mode = mode
+        if data_file and os.path.exists(data_file):
+            raise NotImplementedError(
+                "local VOC tar parsing: provide an extracted DatasetFolder "
+                "instead (zero-egress build)")
+        n = 64 if mode == "train" else 16
+        rng = np.random.RandomState(2012)
+        self.images = rng.randint(0, 256, (n, 64, 64, 3)).astype(np.uint8)
+        self.masks = rng.randint(0, 21, (n, 64, 64)).astype(np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img, mask = self.images[idx], self.masks[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask

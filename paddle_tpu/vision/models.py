@@ -1,0 +1,196 @@
+"""paddle.vision.models (reference python/paddle/vision/models/*.py):
+LeNet, VGG, ResNet, MobileNetV1/V2 as paddle.nn Layers. Convs/matmuls lower
+to the MXU via the conv2d/matmul lowerings; NCHW is kept for API parity and
+XLA re-lays out for TPU.
+"""
+from __future__ import annotations
+
+from .. import nn
+from ..models.lenet import LeNet
+from ..models.resnet import (ResNet, resnet18, resnet50, resnet101,
+                             BasicBlock, BottleneckBlock)
+
+__all__ = ["LeNet", "VGG", "vgg11", "vgg13", "vgg16", "vgg19", "ResNet",
+           "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
+           "MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2"]
+
+
+def resnet34(num_classes=1000, **kw):
+    return ResNet(BasicBlock, [3, 4, 6, 3], num_classes=num_classes, **kw)
+
+
+def resnet152(num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, [3, 8, 36, 3], num_classes=num_classes,
+                  **kw)
+
+
+_VGG_CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+          512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+          "M", 512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512,
+          512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(nn.Layer):
+    def __init__(self, features, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.features = features
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(7)
+        self.classifier = nn.Sequential(
+            nn.Linear(512 * 7 * 7, 4096), nn.ReLU(), nn.Dropout(),
+            nn.Linear(4096, 4096), nn.ReLU(), nn.Dropout(),
+            nn.Linear(4096, num_classes))
+        self.flatten = nn.Flatten()
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        return self.classifier(self.flatten(x))
+
+
+def _make_vgg_layers(cfg, batch_norm=False):
+    layers, in_ch = [], 3
+    for v in cfg:
+        if v == "M":
+            layers.append(nn.MaxPool2D(2, stride=2))
+        else:
+            layers.append(nn.Conv2D(in_ch, v, 3, padding=1))
+            if batch_norm:
+                layers.append(nn.BatchNorm2D(v))
+            layers.append(nn.ReLU())
+            in_ch = v
+    return nn.Sequential(*layers)
+
+
+def _vgg(cfg, batch_norm=False, **kw):
+    return VGG(_make_vgg_layers(_VGG_CFGS[cfg], batch_norm), **kw)
+
+
+def vgg11(batch_norm=False, **kw):
+    return _vgg("A", batch_norm, **kw)
+
+
+def vgg13(batch_norm=False, **kw):
+    return _vgg("B", batch_norm, **kw)
+
+
+def vgg16(batch_norm=False, **kw):
+    return _vgg("D", batch_norm, **kw)
+
+
+def vgg19(batch_norm=False, **kw):
+    return _vgg("E", batch_norm, **kw)
+
+
+class _ConvBNLayer(nn.Layer):
+    def __init__(self, in_ch, out_ch, k, stride=1, padding=0, groups=1,
+                 act="relu"):
+        super().__init__()
+        self.conv = nn.Conv2D(in_ch, out_ch, k, stride=stride,
+                              padding=padding, groups=groups,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_ch)
+        self.act = {"relu": nn.ReLU(), "relu6": nn.ReLU6(),
+                    None: nn.Identity()}[act]
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class MobileNetV1(nn.Layer):
+    """Depthwise-separable stack (reference models/mobilenetv1.py)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        def c(ch):
+            return max(int(ch * scale), 8)
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
+              [(512, 1024, 2), (1024, 1024, 1)]
+        layers = [_ConvBNLayer(3, c(32), 3, stride=2, padding=1)]
+        for in_ch, out_ch, stride in cfg:
+            layers.append(_ConvBNLayer(c(in_ch), c(in_ch), 3, stride=stride,
+                                       padding=1, groups=c(in_ch)))
+            layers.append(_ConvBNLayer(c(in_ch), c(out_ch), 1))
+        self.features = nn.Sequential(*layers)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        self.flatten = nn.Flatten()
+        self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        return self.fc(self.flatten(x))
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, in_ch, out_ch, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(in_ch * expand_ratio))
+        self.use_res = stride == 1 and in_ch == out_ch
+        layers = []
+        if expand_ratio != 1:
+            layers.append(_ConvBNLayer(in_ch, hidden, 1, act="relu6"))
+        layers += [
+            _ConvBNLayer(hidden, hidden, 3, stride=stride, padding=1,
+                         groups=hidden, act="relu6"),
+            _ConvBNLayer(hidden, out_ch, 1, act=None),
+        ]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    """Inverted residuals (reference models/mobilenetv2.py)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        def c(ch):
+            return max(int(ch * scale), 8)
+        in_ch = c(32)
+        layers = [_ConvBNLayer(3, in_ch, 3, stride=2, padding=1,
+                               act="relu6")]
+        for t, ch, n, s in cfg:
+            out_ch = c(ch)
+            for i in range(n):
+                layers.append(_InvertedResidual(
+                    in_ch, out_ch, s if i == 0 else 1, t))
+                in_ch = out_ch
+        last = max(c(1280), 1280)
+        layers.append(_ConvBNLayer(in_ch, last, 1, act="relu6"))
+        self.features = nn.Sequential(*layers)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        self.flatten = nn.Flatten()
+        self.dropout = nn.Dropout(0.2)
+        self.fc = nn.Linear(last, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        return self.fc(self.dropout(self.flatten(x)))
+
+
+def mobilenet_v1(scale=1.0, **kw):
+    return MobileNetV1(scale=scale, **kw)
+
+
+def mobilenet_v2(scale=1.0, **kw):
+    return MobileNetV2(scale=scale, **kw)

@@ -1,0 +1,431 @@
+"""Host-side image transforms (reference python/paddle/vision/transforms/
+transforms.py). All transforms operate on numpy HWC images (uint8 or float);
+they run on the host CPU inside DataLoader workers — device work starts at
+feed time, so none of this traces into XLA.
+"""
+from __future__ import annotations
+
+import math
+import numbers
+import random
+
+import numpy as np
+
+__all__ = [
+    "Compose", "BatchCompose", "Resize", "RandomResizedCrop",
+    "CenterCropResize", "CenterCrop", "RandomHorizontalFlip",
+    "RandomVerticalFlip", "Normalize", "Permute", "GaussianNoise",
+    "BrightnessTransform", "ContrastTransform", "SaturationTransform",
+    "HueTransform", "ColorJitter", "RandomCrop", "RandomErasing", "Pad",
+    "RandomRotate", "Grayscale", "ToTensor",
+]
+
+
+def _to_pair(v):
+    return (v, v) if isinstance(v, numbers.Number) else tuple(v)
+
+
+def _resize(img, size, interpolation="bilinear"):
+    """Resize HWC (or HW) numpy image. `size` int = shorter side, tuple=(h,w)."""
+    h, w = img.shape[:2]
+    if isinstance(size, int):
+        if (h <= w and h == size) or (w <= h and w == size):
+            return img
+        if h < w:
+            oh, ow = size, int(round(size * w / h))
+        else:
+            oh, ow = int(round(size * h / w)), size
+    else:
+        oh, ow = size
+    if (oh, ow) == (h, w):
+        return img
+    ys = (np.arange(oh) + 0.5) * h / oh - 0.5
+    xs = (np.arange(ow) + 0.5) * w / ow - 0.5
+    if interpolation == "nearest":
+        yi = np.clip(np.round(ys).astype(np.int64), 0, h - 1)
+        xi = np.clip(np.round(xs).astype(np.int64), 0, w - 1)
+        return img[yi][:, xi]
+    y0 = np.clip(np.floor(ys).astype(np.int64), 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(np.int64), 0, w - 1)
+    y1, x1 = np.minimum(y0 + 1, h - 1), np.minimum(x0 + 1, w - 1)
+    wy = np.clip(ys - y0, 0.0, 1.0)[:, None]
+    wx = np.clip(xs - x0, 0.0, 1.0)[None, :]
+    if img.ndim == 3:
+        wy, wx = wy[..., None], wx[..., None]
+    f = img.astype(np.float32)
+    top = f[y0][:, x0] * (1 - wx) + f[y0][:, x1] * wx
+    bot = f[y1][:, x0] * (1 - wx) + f[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    return out.astype(img.dtype) if img.dtype == np.uint8 else out
+
+
+def _crop(img, top, left, h, w):
+    return img[top:top + h, left:left + w]
+
+
+def _center_crop(img, size):
+    th, tw = _to_pair(size)
+    h, w = img.shape[:2]
+    return _crop(img, max((h - th) // 2, 0), max((w - tw) // 2, 0), th, tw)
+
+
+def _rgb_to_gray(img):
+    g = (img[..., 0] * 0.299 + img[..., 1] * 0.587 + img[..., 2] * 0.114)
+    return g.astype(img.dtype) if img.dtype == np.uint8 else g
+
+
+def _blend(a, b, ratio):
+    out = a.astype(np.float32) * ratio + b.astype(np.float32) * (1 - ratio)
+    if a.dtype == np.uint8:
+        return np.clip(out, 0, 255).astype(np.uint8)
+    return out
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, *data):
+        for t in self.transforms:
+            if isinstance(data, tuple) and len(data) > 1:
+                data = t(*data) if _wants_multi(t) else \
+                    (t(data[0]),) + tuple(data[1:])
+            else:
+                x = data[0] if isinstance(data, tuple) else data
+                data = t(x)
+        return data
+
+
+def _wants_multi(t):
+    import inspect
+    try:
+        sig = inspect.signature(t.__call__ if hasattr(t, "__call__") else t)
+        params = [p for p in sig.parameters.values()
+                  if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD,
+                                p.VAR_POSITIONAL)]
+        return len(params) > 1 or any(p.kind == p.VAR_POSITIONAL
+                                      for p in params)
+    except (TypeError, ValueError):
+        return False
+
+
+class BatchCompose:
+    """Applied per batch inside DataLoader collation."""
+
+    def __init__(self, transforms=None):
+        self.transforms = transforms or []
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size, self.interpolation = size, interpolation
+
+    def __call__(self, img):
+        return _resize(img, self.size, self.interpolation)
+
+
+class RandomResizedCrop:
+    def __init__(self, output_size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+        self.size = _to_pair(output_size)
+        self.scale, self.ratio = scale, ratio
+
+    def _params(self, img):
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = math.exp(random.uniform(math.log(self.ratio[0]),
+                                         math.log(self.ratio[1])))
+            cw = int(round(math.sqrt(target * ar)))
+            ch = int(round(math.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                return (random.randint(0, h - ch), random.randint(0, w - cw),
+                        ch, cw)
+        s = min(h, w)
+        return (h - s) // 2, (w - s) // 2, s, s
+
+    def __call__(self, img):
+        t, l, ch, cw = self._params(img)
+        return _resize(_crop(img, t, l, ch, cw), self.size)
+
+
+class CenterCropResize:
+    def __init__(self, size, crop_padding=32, interpolation="bilinear"):
+        self.size = _to_pair(size)
+        self.crop_padding = crop_padding
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        h, w = img.shape[:2]
+        c = min(self.size)
+        s = int((c / (c + self.crop_padding)) * min(h, w))
+        return _resize(_center_crop(img, s), self.size, self.interpolation)
+
+
+class CenterCrop:
+    def __init__(self, output_size):
+        self.size = _to_pair(output_size)
+
+    def __call__(self, img):
+        return _center_crop(img, self.size)
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        return img[:, ::-1].copy() if random.random() < self.prob else img
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        return img[::-1].copy() if random.random() < self.prob else img
+
+
+class Normalize:
+    """(img - mean) / std. data_format 'CHW' (default, post-Permute) or 'HWC'."""
+
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW"):
+        mean = [mean] * 3 if isinstance(mean, numbers.Number) else list(mean)
+        std = [std] * 3 if isinstance(std, numbers.Number) else list(std)
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        img = np.asarray(img, np.float32)
+        if self.data_format == "CHW":
+            m, s = (self.mean.reshape(-1, 1, 1), self.std.reshape(-1, 1, 1))
+        else:
+            m, s = self.mean, self.std
+        return (img - m) / s
+
+
+class Permute:
+    """HWC uint8 → CHW float32 (mode='CHW'); matches reference Permute."""
+
+    def __init__(self, mode="CHW", to_rgb=True):
+        self.mode, self.to_rgb = mode, to_rgb
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        if img.ndim == 2:
+            img = img[..., None]
+        if self.mode == "CHW":
+            img = img.transpose(2, 0, 1)
+        return img.astype(np.float32)
+
+
+class ToTensor:
+    """HWC [0,255] → CHW float32 [0,1]."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        img = np.asarray(img, np.float32) / 255.0
+        if img.ndim == 2:
+            img = img[..., None]
+        return img.transpose(2, 0, 1) if self.data_format == "CHW" else img
+
+
+class GaussianNoise:
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, img):
+        noise = np.random.normal(self.mean, self.std, img.shape)
+        out = img.astype(np.float32) + noise
+        if img.dtype == np.uint8:
+            return np.clip(out, 0, 255).astype(np.uint8)
+        return out.astype(img.dtype)
+
+
+class BrightnessTransform:
+    def __init__(self, value):
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        alpha = random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        return _blend(img, np.zeros_like(img), alpha)
+
+
+class ContrastTransform:
+    def __init__(self, value):
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        alpha = random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        mean = np.full_like(img, _rgb_to_gray(img).mean())
+        return _blend(img, mean, alpha)
+
+
+class SaturationTransform:
+    def __init__(self, value):
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        alpha = random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        gray = _rgb_to_gray(img)[..., None]
+        return _blend(img, np.broadcast_to(gray, img.shape), alpha)
+
+
+class HueTransform:
+    def __init__(self, value):
+        assert 0 <= value <= 0.5
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        shift = random.uniform(-self.value, self.value)
+        f = img.astype(np.float32) / (255.0 if img.dtype == np.uint8 else 1.0)
+        r, g, b = f[..., 0], f[..., 1], f[..., 2]
+        mx, mn = f.max(-1), f.min(-1)
+        d = mx - mn + 1e-12
+        h = np.where(mx == r, (g - b) / d % 6,
+                     np.where(mx == g, (b - r) / d + 2, (r - g) / d + 4)) / 6
+        h = (h + shift) % 1.0
+        s = np.where(mx > 0, d / (mx + 1e-12), 0.0)
+        i = np.floor(h * 6).astype(np.int64) % 6
+        fh = h * 6 - np.floor(h * 6)
+        p, q, t = mx * (1 - s), mx * (1 - s * fh), mx * (1 - s * (1 - fh))
+        rgb = np.stack([
+            np.choose(i, [mx, q, p, p, t, mx]),
+            np.choose(i, [t, mx, mx, q, p, p]),
+            np.choose(i, [p, p, t, mx, mx, q])], axis=-1)
+        if img.dtype == np.uint8:
+            return np.clip(rgb * 255.0, 0, 255).astype(np.uint8)
+        return rgb.astype(img.dtype)
+
+
+class ColorJitter:
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.transforms = [BrightnessTransform(brightness),
+                           ContrastTransform(contrast),
+                           SaturationTransform(saturation),
+                           HueTransform(hue)]
+
+    def __call__(self, img):
+        order = list(self.transforms)
+        random.shuffle(order)
+        for t in order:
+            img = t(img)
+        return img
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0, pad_if_needed=False):
+        self.size = _to_pair(size)
+        self.padding, self.pad_if_needed = padding, pad_if_needed
+
+    def __call__(self, img):
+        if self.padding:
+            img = Pad(self.padding)(img)
+        th, tw = self.size
+        h, w = img.shape[:2]
+        if self.pad_if_needed:
+            ph, pw = max(th - h, 0), max(tw - w, 0)
+            if ph or pw:
+                img = Pad((pw, ph))(img)
+                h, w = img.shape[:2]
+        top = random.randint(0, max(h - th, 0))
+        left = random.randint(0, max(w - tw, 0))
+        return _crop(img, top, left, th, tw)
+
+
+class RandomErasing:
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0):
+        self.prob, self.scale, self.ratio, self.value = \
+            prob, scale, ratio, value
+
+    def __call__(self, img):
+        if random.random() >= self.prob:
+            return img
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = random.uniform(*self.ratio)
+            eh, ew = int(round(math.sqrt(target / ar))), \
+                int(round(math.sqrt(target * ar)))
+            if eh < h and ew < w:
+                top = random.randint(0, h - eh)
+                left = random.randint(0, w - ew)
+                img = img.copy()
+                img[top:top + eh, left:left + ew] = self.value
+                return img
+        return img
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        if isinstance(padding, numbers.Number):
+            padding = (padding,) * 4
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        self.padding, self.fill, self.mode = padding, fill, padding_mode
+
+    def __call__(self, img):
+        l, t, r, b = self.padding
+        pads = [(t, b), (l, r)] + [(0, 0)] * (img.ndim - 2)
+        if self.mode == "constant":
+            return np.pad(img, pads, constant_values=self.fill)
+        return np.pad(img, pads, mode=self.mode)
+
+
+class RandomRotate:
+    """Rotate by a random angle in `degrees`; nearest resampling."""
+
+    def __init__(self, degrees, expand=False, center=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees, self.expand, self.center = degrees, expand, center
+
+    def __call__(self, img):
+        angle = random.uniform(*self.degrees)
+        h, w = img.shape[:2]
+        cy, cx = ((h - 1) / 2, (w - 1) / 2) if self.center is None \
+            else self.center
+        rad = math.radians(angle)
+        c, s = math.cos(rad), math.sin(rad)
+        if self.expand:
+            nh = int(abs(h * c) + abs(w * s) + 0.5)
+            nw = int(abs(w * c) + abs(h * s) + 0.5)
+        else:
+            nh, nw = h, w
+        ys, xs = np.mgrid[0:nh, 0:nw]
+        oy, ox = ys - (nh - 1) / 2, xs - (nw - 1) / 2
+        sy = np.round(oy * c - ox * s + cy).astype(np.int64)
+        sx = np.round(oy * s + ox * c + cx).astype(np.int64)
+        valid = (sy >= 0) & (sy < h) & (sx >= 0) & (sx < w)
+        out = np.zeros((nh, nw) + img.shape[2:], img.dtype)
+        out[valid] = img[sy[valid], sx[valid]]
+        return out
+
+
+class Grayscale:
+    def __init__(self, output_channels=1):
+        self.output_channels = output_channels
+
+    def __call__(self, img):
+        g = _rgb_to_gray(img)[..., None]
+        if self.output_channels == 3:
+            g = np.repeat(g, 3, axis=-1)
+        return g

@@ -179,3 +179,106 @@ def test_python_value_in_tensor_branch():
     ov, fv = exe.run(feed={"x": -np.ones((1, 4), np.float32)},
                      fetch_list=[y, flag])
     assert float(np.asarray(fv).reshape(-1)[0]) == 0.0
+
+
+# --- regression tests: review findings r2 ---------------------------------
+
+def test_loop_temporary_read_after_loop():
+    """A body temporary consumed after the loop is loop-carried."""
+    def f(n):
+        i = 0
+        t = 0
+        while i < n:
+            t = i * 10
+            i = i + 1
+        return t
+
+    assert convert_to_static(f)(3) == 20
+    assert convert_to_static(f)(0) == 0
+
+
+def test_branch_read_modify_write():
+    """s = s + 1 inside a converted branch (was UnboundLocalError)."""
+    def f(x):
+        s = x
+        if s > 0:
+            s = s + 1
+        return s
+
+    assert convert_to_static(f)(2) == 3
+    assert convert_to_static(f)(-2) == -2
+
+
+def test_nested_control_flow_converts():
+    """if-in-if and if-in-while must not trip the return detector."""
+    def f(a, b):
+        out = 0
+        if a > 0:
+            if b > 0:
+                out = 1
+            else:
+                out = 2
+        else:
+            out = 3
+        return out
+
+    g = convert_to_static(f)
+    assert (g(1, 1), g(1, -1), g(-1, 1)) == (1, 2, 3)
+
+    def h(n):
+        i = 0
+        acc = 0
+        while i < n:
+            if i % 2 == 0:
+                acc = acc + i
+            i = i + 1
+        return acc
+
+    assert convert_to_static(h)(5) == 6
+
+
+def test_single_branch_assignment_no_nameerror():
+    """A name assigned in only one branch must not break the other path."""
+    def f(x):
+        if x > 0:
+            y = 1
+        else:
+            z = 2
+        return x
+
+    assert convert_to_static(f)(5) == 5
+    assert convert_to_static(f)(-5) == -5
+
+
+def test_real_return_still_rejected():
+    import pytest
+
+    def f(x):
+        s = x
+        if s > 0:
+            s = s - 1
+            return s
+        return s
+
+    with pytest.raises(NotImplementedError):
+        convert_to_static(f)
+
+
+def test_static_nested_if_in_while_parity():
+    """Nested tensor control flow lowers and matches eager."""
+    def body(x):
+        total = layers.reshape(layers.reduce_sum(x), [1])
+        steps = layers.fill_constant([1], "float32", 0.0)
+        while total > 1.0:
+            if steps < 2.0:
+                total = total * 0.25
+            else:
+                total = total * 0.5
+            steps = steps + 1.0
+        return total, steps
+
+    x_np = np.full((2, 4), 4.0, np.float32)   # sum=32 → 8 → 2 → 1 → stop
+    static = _run_static(body, x_np)
+    eager = _run_eager(body, x_np)
+    for s, e in zip(static, eager):
+        np.testing.assert_allclose(s, e, rtol=1e-6)

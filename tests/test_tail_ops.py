@@ -1,0 +1,550 @@
+"""OpTest coverage for the round-2 tail ops: interp v1/v2, geometry,
+sampled softmax, hashing, fused ops, quantize, random, optimizer tail,
+metric tail (reference per-op unittests: test_bilinear_interp_v2_op.py,
+test_affine_grid_op.py, test_nce.py, test_hash_op.py,
+test_fused_multihead_matmul_op.py, test_fake_quantize_op.py,
+test_mean_iou.py, test_chunk_eval_op.py, ...)."""
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401
+from op_test import check_output, check_grad, run_op
+
+R = np.random.RandomState(0)
+
+
+# --- interpolation ---------------------------------------------------------
+
+def test_interp_v2_family_shapes():
+    x = R.randn(2, 3, 8, 8).astype(np.float32)
+    for op in ("nearest_interp_v2", "bilinear_interp_v2",
+               "bicubic_interp_v2"):
+        out = run_op(op, {"X": [x]}, {"out_h": 16, "out_w": 12})
+        assert out["Out"][0].shape == (2, 3, 16, 12)
+    x1 = R.randn(2, 3, 8).astype(np.float32)
+    out = run_op("linear_interp_v2", {"X": [x1]}, {"out_w": 16})
+    assert out["Out"][0].shape == (2, 3, 16)
+    x3 = R.randn(1, 2, 4, 4, 4).astype(np.float32)
+    out = run_op("trilinear_interp_v2", {"X": [x3]},
+                 {"out_d": 8, "out_h": 6, "out_w": 2})
+    assert out["Out"][0].shape == (1, 2, 8, 6, 2)
+
+
+def test_bilinear_interp_v2_values_and_grad():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = run_op("bilinear_interp_v2", {"X": [x]}, {"out_h": 2, "out_w": 2})
+    np.testing.assert_allclose(
+        np.asarray(out["Out"][0]).reshape(2, 2),
+        [[2.5, 4.5], [10.5, 12.5]], atol=1e-5)
+    check_grad("bilinear_interp_v2", {"X": [x]},
+               {"out_h": 2, "out_w": 2}, wrt=["X"])
+
+
+def test_trilinear_align_corners():
+    x = np.arange(8, dtype=np.float32).reshape(1, 1, 2, 2, 2)
+    out = run_op("trilinear_interp_v2", {"X": [x]},
+                 {"out_d": 3, "out_h": 3, "out_w": 3,
+                  "align_corners": True})
+    got = np.asarray(out["Out"][0]).reshape(3, 3, 3)
+    assert got[0, 0, 0] == 0.0 and got[2, 2, 2] == 7.0
+    assert abs(got[1, 1, 1] - 3.5) < 1e-5
+
+
+# --- geometry --------------------------------------------------------------
+
+def test_affine_grid_identity():
+    theta = np.tile(np.array([[1, 0, 0], [0, 1, 0]], np.float32), (2, 1, 1))
+    out = run_op("affine_grid", {"Theta": [theta]},
+                 {"output_shape": [2, 1, 3, 3], "align_corners": True})
+    grid = np.asarray(out["Output"][0])
+    assert grid.shape == (2, 3, 3, 2)
+    np.testing.assert_allclose(grid[0, 0, 0], [-1, -1], atol=1e-6)
+    np.testing.assert_allclose(grid[0, 2, 2], [1, 1], atol=1e-6)
+    np.testing.assert_allclose(grid[0, 1, 1], [0, 0], atol=1e-6)
+    check_grad("affine_grid", {"Theta": [theta]},
+               {"output_shape": [2, 1, 3, 3]}, wrt=["Theta"],
+               out_slots=("Output",))
+
+
+def test_psroi_pool():
+    oc, ph, pw = 2, 2, 2
+    x = R.randn(1, oc * ph * pw, 8, 8).astype(np.float32)
+    rois = np.array([[0, 0, 7, 7]], np.float32)
+    out = run_op("psroi_pool", {"X": [x], "ROIs": [rois]},
+                 {"pooled_height": ph, "pooled_width": pw,
+                  "output_channels": oc, "spatial_scale": 1.0})
+    assert out["Out"][0].shape == (1, oc, ph, pw)
+
+
+def test_prroi_pool_constant_region():
+    x = np.full((1, 3, 8, 8), 5.0, np.float32)
+    rois = np.array([[1, 1, 6, 6]], np.float32)
+    out = run_op("prroi_pool", {"X": [x], "ROIs": [rois]},
+                 {"pooled_height": 2, "pooled_width": 2,
+                  "spatial_scale": 1.0})
+    np.testing.assert_allclose(np.asarray(out["Out"][0]), 5.0, atol=1e-5)
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    x = R.randn(2, 3, 6, 6).astype(np.float32)
+    w = R.randn(4, 3, 3, 3).astype(np.float32)
+    oh = ow = 4
+    off = np.zeros((2, 2 * 9, oh, ow), np.float32)
+    mask = np.ones((2, 9, oh, ow), np.float32)
+    out = run_op("deformable_conv",
+                 {"Input": [x], "Offset": [off], "Mask": [mask],
+                  "Filter": [w]},
+                 {"strides": [1, 1], "paddings": [0, 0],
+                  "dilations": [1, 1], "groups": 1})
+    got = np.asarray(out["Output"][0])
+    # reference: plain convolution
+    ref = run_op("conv2d", {"Input": [x], "Filter": [w]},
+                 {"strides": [1, 1], "paddings": [0, 0],
+                  "dilations": [1, 1], "groups": 1})
+    np.testing.assert_allclose(got, np.asarray(ref["Output"][0]),
+                               rtol=1e-4, atol=1e-4)
+    v1 = run_op("deformable_conv_v1",
+                {"Input": [x], "Offset": [off], "Filter": [w]},
+                {"strides": [1, 1], "paddings": [0, 0],
+                 "dilations": [1, 1], "groups": 1})
+    np.testing.assert_allclose(np.asarray(v1["Output"][0]), got,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_random_crop():
+    x = R.randn(4, 10, 10).astype(np.float32)
+    out = run_op("random_crop", {"X": [x]}, {"shape": [6, 6]})
+    assert out["Out"][0].shape == (4, 6, 6)
+
+
+# --- sampled softmax / nce -------------------------------------------------
+
+def test_nce_shapes_and_grad():
+    b, d, classes = 4, 8, 20
+    x = R.randn(b, d).astype(np.float32)
+    w = R.randn(classes, d).astype(np.float32)
+    bias = R.randn(classes).astype(np.float32)
+    lbl = R.randint(0, classes, (b, 1)).astype(np.int64)
+    out = run_op("nce", {"Input": [x], "Weight": [w], "Bias": [bias],
+                         "Label": [lbl]},
+                 {"num_neg_samples": 5, "num_total_classes": classes})
+    assert out["Cost"][0].shape == (b, 1)
+    assert out["SampleLogits"][0].shape == (b, 6)
+    assert np.all(np.asarray(out["Cost"][0]) > 0)
+
+
+def test_sample_logits():
+    b, c = 3, 50
+    logits = R.randn(b, c).astype(np.float32)
+    lbl = R.randint(0, c, (b, 1)).astype(np.int64)
+    out = run_op("sample_logits", {"Logits": [logits], "Labels": [lbl]},
+                 {"num_samples": 8})
+    assert out["SampledLogits"][0].shape == (b, 9)
+    assert np.all(np.asarray(out["SampledLabels"][0]) == 0)
+
+
+def test_sampling_id():
+    probs = np.array([[1.0, 0, 0, 0], [0, 0, 0, 1.0]], np.float32)
+    out = run_op("sampling_id", {"X": [probs]}, {})
+    ids = np.asarray(out["Out"][0])
+    np.testing.assert_array_equal(ids, [0, 3])
+
+
+# --- hashing / misc features ----------------------------------------------
+
+def test_hash_deterministic_in_range():
+    x = R.randint(0, 1000, (5, 3)).astype(np.int64)
+    a = np.asarray(run_op("hash", {"X": [x]},
+                          {"num_hash": 2, "mod_by": 997})["Out"][0])
+    b = np.asarray(run_op("hash", {"X": [x]},
+                          {"num_hash": 2, "mod_by": 997})["Out"][0])
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (5, 2, 1) and a.min() >= 0 and a.max() < 997
+
+
+def test_filter_by_instag():
+    x = R.randn(4, 3).astype(np.float32)
+    tags = np.array([[1], [2], [3], [2]], np.int64)
+    filt = np.array([2], np.int64)
+    out = run_op("filter_by_instag",
+                 {"Ins": [x], "Ins_tag": [tags], "Filter_tag": [filt]}, {})
+    got = np.asarray(out["Out"][0])
+    np.testing.assert_allclose(got[1], x[1])
+    np.testing.assert_allclose(got[0], 0.0)
+    np.testing.assert_array_equal(
+        np.asarray(out["LossWeight"][0]).reshape(-1), [0, 1, 0, 1])
+
+
+def test_shuffle_batch():
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+    out = run_op("shuffle_batch", {"X": [x]}, {})
+    got = np.sort(np.asarray(out["Out"][0]).reshape(-1))
+    np.testing.assert_allclose(got, np.arange(8))
+
+
+def test_match_matrix_tensor():
+    x = R.randn(2, 3, 4).astype(np.float32)
+    y = R.randn(2, 5, 4).astype(np.float32)
+    w = R.randn(4, 2, 4).astype(np.float32)
+    out = run_op("match_matrix_tensor", {"X": [x], "Y": [y], "W": [w]}, {})
+    got = np.asarray(out["Out"][0])
+    assert got.shape == (2, 2, 3, 5)
+    ref = np.einsum("bld,dte,bme->btlm", x, w, y)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+    check_grad("match_matrix_tensor", {"X": [x], "Y": [y], "W": [w]}, {},
+               wrt=["X", "W"])
+
+
+def test_batch_fc():
+    x = R.randn(3, 4, 5).astype(np.float32)
+    w = R.randn(3, 5, 2).astype(np.float32)
+    b = R.randn(3, 2).astype(np.float32)
+    out = run_op("batch_fc", {"Input": [x], "W": [w], "Bias": [b]}, {})
+    ref = np.einsum("sbi,sio->sbo", x, w) + b[:, None, :]
+    np.testing.assert_allclose(np.asarray(out["Out"][0]), ref,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_conv_shift():
+    x = R.randn(2, 7).astype(np.float32)
+    y = R.randn(2, 3).astype(np.float32)
+    out = np.asarray(run_op("conv_shift", {"X": [x], "Y": [y]}, {})["Out"][0])
+    ref = np.zeros_like(x)
+    for b in range(2):
+        for i in range(7):
+            for j in range(3):
+                ref[b, i] += x[b, (i + j - 1) % 7] * y[b, j]
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_tree_conv_shape():
+    nodes = R.randn(2, 5, 4).astype(np.float32)
+    edges = np.array([[[0, 1], [0, 2], [1, 3], [1, 4]]] * 2, np.int64)
+    filt = R.randn(4, 3, 6).astype(np.float32)
+    out = run_op("tree_conv", {"NodesVector": [nodes], "EdgeSet": [edges],
+                               "Filter": [filt]}, {})
+    assert out["Out"][0].shape == (2, 5, 6)
+
+
+# --- fused -----------------------------------------------------------------
+
+def test_multihead_matmul_matches_manual():
+    b, s, h, heads = 2, 4, 8, 2
+    qkv = R.randn(b, s, 3 * h).astype(np.float32)
+    out = run_op("multihead_matmul", {"Input": [qkv]},
+                 {"head_number": heads, "alpha": 1.0 / np.sqrt(h // heads)})
+    got = np.asarray(out["Out"][0])
+    assert got.shape == (b, s, h)
+    # manual attention
+    q, k, v = np.split(qkv, 3, axis=-1)
+    hd = h // heads
+    def sp(t):
+        return t.reshape(b, s, heads, hd).transpose(0, 2, 1, 3)
+    qh, kh, vh = map(sp, (q, k, v))
+    sc = np.einsum("bnsd,bntd->bnst", qh, kh) / np.sqrt(hd)
+    e = np.exp(sc - sc.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = np.einsum("bnst,bntd->bnsd", p, vh).transpose(0, 2, 1, 3) \
+        .reshape(b, s, h)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_fused_embedding_eltwise_layernorm():
+    vocab, d = 20, 8
+    w1 = R.randn(vocab, d).astype(np.float32)
+    w2 = R.randn(vocab, d).astype(np.float32)
+    ids1 = R.randint(0, vocab, (2, 5, 1)).astype(np.int64)
+    ids2 = R.randint(0, vocab, (2, 5, 1)).astype(np.int64)
+    scale = np.ones(d, np.float32)
+    bias = np.zeros(d, np.float32)
+    out = run_op("fused_embedding_eltwise_layernorm",
+                 {"Ids": [ids1, ids2], "Embs": [w1, w2],
+                  "Scale": [scale], "Bias": [bias]}, {"epsilon": 1e-5})
+    got = np.asarray(out["Out"][0])
+    assert got.shape == (2, 5, d)
+    np.testing.assert_allclose(got.mean(-1), 0.0, atol=1e-4)
+
+
+def test_fused_embedding_seq_pool():
+    w = R.randn(10, 4).astype(np.float32)
+    ids = R.randint(0, 10, (3, 5, 1)).astype(np.int64)
+    sl = np.array([5, 3, 0], np.int64)
+    out = run_op("fused_embedding_seq_pool",
+                 {"W": [w], "Ids": [ids], "SeqLen": [sl]}, {})
+    got = np.asarray(out["Out"][0])
+    ref0 = w[ids[0, :, 0]].sum(0)
+    ref1 = w[ids[1, :3, 0]].sum(0)
+    np.testing.assert_allclose(got[0], ref0, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(got[1], ref1, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(got[2], 0.0, atol=1e-6)
+
+
+def test_fusion_repeated_fc_relu():
+    x = R.randn(3, 4).astype(np.float32)
+    w1 = R.randn(4, 5).astype(np.float32)
+    b1 = R.randn(5).astype(np.float32)
+    w2 = R.randn(5, 2).astype(np.float32)
+    b2 = R.randn(2).astype(np.float32)
+    out = run_op("fusion_repeated_fc_relu",
+                 {"X": [x], "W": [w1, w2], "Bias": [b1, b2]}, {})
+    ref = np.maximum(np.maximum(x @ w1 + b1, 0) @ w2 + b2, 0)
+    np.testing.assert_allclose(np.asarray(out["Out"][0]), ref,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_fusion_squared_mat_sub():
+    x = R.randn(3, 4).astype(np.float32)
+    y = R.randn(4, 5).astype(np.float32)
+    out = run_op("fusion_squared_mat_sub", {"X": [x], "Y": [y]},
+                 {"scalar": 0.5})
+    ref = 0.5 * ((x @ y) ** 2 - (x * x) @ (y * y))
+    np.testing.assert_allclose(np.asarray(out["Out"][0]), ref,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_fusion_gru_lstm_shapes():
+    b, t, f, h = 2, 5, 3, 4
+    x = R.randn(b, t, f).astype(np.float32)
+    wx_g = R.randn(f, 3 * h).astype(np.float32)
+    wh_g = R.randn(h, 3 * h).astype(np.float32)
+    out = run_op("fusion_gru", {"X": [x], "WeightX": [wx_g],
+                                "WeightH": [wh_g]}, {})
+    assert out["Hidden"][0].shape == (b, t, h)
+    wx_l = R.randn(f, 4 * h).astype(np.float32)
+    wh_l = R.randn(h, 4 * h).astype(np.float32)
+    out = run_op("fusion_lstm", {"X": [x], "WeightX": [wx_l],
+                                 "WeightH": [wh_l]}, {})
+    assert out["Hidden"][0].shape == (b, t, h)
+    assert out["Cell"][0].shape == (b, t, h)
+
+
+def test_fusion_seqpool_concat():
+    x1 = R.randn(2, 4, 3).astype(np.float32)
+    x2 = R.randn(2, 4, 5).astype(np.float32)
+    out = run_op("fusion_seqpool_concat", {"X": [x1, x2]},
+                 {"pooltype": "SUM"})
+    got = np.asarray(out["Out"][0])
+    assert got.shape == (2, 8)
+    np.testing.assert_allclose(got[:, :3], x1.sum(1), rtol=1e-5, atol=1e-5)
+
+
+def test_lstmp():
+    b, t, d, p = 2, 4, 6, 3
+    x = R.randn(b, t, 4 * d).astype(np.float32)
+    w = R.randn(p, 4 * d).astype(np.float32)
+    pw = R.randn(d, p).astype(np.float32)
+    out = run_op("lstmp", {"Input": [x], "Weight": [w], "ProjWeight": [pw]},
+                 {})
+    assert out["Projection"][0].shape == (b, t, p)
+    assert out["Cell"][0].shape == (b, t, d)
+
+
+# --- quantize --------------------------------------------------------------
+
+def test_fake_quantize_abs_max():
+    x = R.randn(4, 5).astype(np.float32)
+    out = run_op("fake_quantize_abs_max", {"X": [x]}, {"bit_length": 8})
+    scale = float(np.abs(x).max())
+    ref = np.round(x / scale * 127)
+    np.testing.assert_allclose(np.asarray(out["Out"][0]), ref, atol=0.5)
+    np.testing.assert_allclose(np.asarray(out["OutScale"][0]), [scale],
+                               rtol=1e-6)
+    deq = run_op("fake_dequantize_max_abs",
+                 {"X": [ref.astype(np.float32)],
+                  "Scale": [np.array([scale], np.float32)]},
+                 {"max_range": 127.0})
+    np.testing.assert_allclose(np.asarray(deq["Out"][0]), x, atol=scale/100)
+
+
+def test_fake_channel_wise_quantize():
+    x = R.randn(3, 4).astype(np.float32)
+    out = run_op("fake_channel_wise_quantize_abs_max", {"X": [x]},
+                 {"bit_length": 8, "quant_axis": 0})
+    scale = np.abs(x).max(axis=1)
+    np.testing.assert_allclose(np.asarray(out["OutScale"][0]), scale,
+                               rtol=1e-6)
+    deq = run_op("fake_channel_wise_dequantize_max_abs",
+                 {"X": [np.asarray(out["Out"][0])], "Scales": [scale]},
+                 {"quant_bits": [8], "quant_axis": 0})
+    np.testing.assert_allclose(np.asarray(deq["Out"][0]), x,
+                               atol=float(scale.max()) / 100)
+
+
+def test_moving_average_abs_max_scale():
+    x = np.array([[1.0, -3.0]], np.float32)
+    out = run_op("moving_average_abs_max_scale",
+                 {"X": [x], "InState": [np.array(1.0, np.float32)],
+                  "InAccum": [np.array(2.0, np.float32)]},
+                 {"moving_rate": 0.9})
+    np.testing.assert_allclose(np.asarray(out["OutState"][0]), 1.9)
+    np.testing.assert_allclose(np.asarray(out["OutAccum"][0]), 4.8,
+                               rtol=1e-6)
+
+
+# --- random / creation -----------------------------------------------------
+
+def test_bernoulli_randperm_empty_fill_allclose():
+    p = np.full((1000,), 0.3, np.float32)
+    out = np.asarray(run_op("bernoulli", {"X": [p]}, {})["Out"][0])
+    assert set(np.unique(out)) <= {0.0, 1.0}
+    assert 0.2 < out.mean() < 0.4
+    perm = np.asarray(run_op("randperm", {}, {"n": 10})["Out"][0])
+    np.testing.assert_array_equal(np.sort(perm), np.arange(10))
+    e = run_op("empty", {}, {"shape": [2, 3], "dtype": "float32"})
+    assert e["Out"][0].shape == (2, 3)
+    f = run_op("fill", {}, {"shape": [2, 2],
+                            "value": [1.0, 2.0, 3.0, 4.0],
+                            "dtype": "float32"})
+    np.testing.assert_allclose(np.asarray(f["Out"][0]),
+                               [[1, 2], [3, 4]])
+    a = run_op("allclose", {"Input": [np.ones(3, np.float32)],
+                            "Other": [np.ones(3, np.float32) + 1e-9]}, {})
+    assert bool(np.asarray(a["Out"][0]))
+
+
+def test_batch_size_like_random():
+    ref = np.zeros((7, 2), np.float32)
+    u = run_op("uniform_random_batch_size_like", {"Input": [ref]},
+               {"shape": [1, 5], "min": 0.0, "max": 1.0})
+    assert u["Out"][0].shape == (7, 5)
+    g = run_op("gaussian_random_batch_size_like", {"Input": [ref]},
+               {"shape": [1, 4], "mean": 10.0, "std": 0.1})
+    arr = np.asarray(g["Out"][0])
+    assert arr.shape == (7, 4) and 9 < arr.mean() < 11
+
+
+# --- control flow helpers --------------------------------------------------
+
+def test_coalesce_tensor_roundtrip():
+    xs = [R.randn(2, 3).astype(np.float32),
+          R.randn(4).astype(np.float32)]
+    out = run_op("coalesce_tensor", {"Input": xs}, {})
+    assert out["FusedOutput"][0].shape == (10,)
+    for got, x in zip(out["Output"], xs):
+        np.testing.assert_allclose(np.asarray(got), x)
+
+
+def test_select_input_output():
+    xs = [np.zeros((2, 2), np.float32), np.ones((2, 2), np.float32)]
+    m = np.array([1], np.int32)
+    out = run_op("select_input", {"X": xs, "Mask": [m]}, {})
+    np.testing.assert_allclose(np.asarray(out["Out"][0]), 1.0)
+    outs = run_op("select_output", {"X": [xs[1]], "Mask": [m]},
+                  {"num_outputs": 2})
+    np.testing.assert_allclose(np.asarray(outs["Out"][0]), 0.0)
+    np.testing.assert_allclose(np.asarray(outs["Out"][1]), 1.0)
+
+
+def test_py_func():
+    from paddle_tpu.ops.tail_ops import register_py_func
+    register_py_func(7, lambda a: a * 2 + 1)
+    x = R.randn(3, 2).astype(np.float32)
+    out = run_op("py_func", {"X": [x]},
+                 {"forward_callable_id": 7,
+                  "out_shapes": [[3, 2]], "out_dtypes": ["float32"]})
+    np.testing.assert_allclose(np.asarray(out["Out"][0]), x * 2 + 1,
+                               rtol=1e-6)
+
+
+def test_print_identity():
+    x = R.randn(2, 2).astype(np.float32)
+    out = run_op("print", {"In": [x]}, {"message": "dbg: "})
+    np.testing.assert_allclose(np.asarray(out["Out"][0]), x)
+
+
+def test_write_read_array_aliases():
+    from paddle_tpu.ops import registry
+    assert registry.has("write_to_array")
+    assert registry.has("read_from_array")
+    assert registry.has("expand_as")
+    assert registry.has("multiclass_nms2")
+
+
+# --- optimizer tail --------------------------------------------------------
+
+def test_proximal_gd_adagrad():
+    p = np.array([1.0, -2.0], np.float32)
+    g = np.array([0.5, 0.5], np.float32)
+    lr = np.array([0.1], np.float32)
+    out = run_op("proximal_gd", {"Param": [p], "Grad": [g],
+                                 "LearningRate": [lr]},
+                 {"l1": 0.0, "l2": 0.0})
+    np.testing.assert_allclose(np.asarray(out["ParamOut"][0]),
+                               p - 0.1 * g, rtol=1e-6)
+    m = np.array([0.1, 0.1], np.float32)
+    out = run_op("proximal_adagrad",
+                 {"Param": [p], "Grad": [g], "Moment": [m],
+                  "LearningRate": [lr]}, {"l1": 0.01, "l2": 0.01})
+    assert out["ParamOut"][0].shape == (2,)
+    np.testing.assert_allclose(np.asarray(out["MomentOut"][0]),
+                               m + g * g, rtol=1e-6)
+
+
+def test_dgc_ops():
+    x = np.array([3.0, 4.0], np.float32)   # norm 5
+    out = run_op("dgc_clip_by_norm",
+                 {"X": [x], "current_step": [np.array(10.0, np.float32)]},
+                 {"rampup_begin_step": 0.0, "max_norm": 1.0})
+    np.testing.assert_allclose(np.asarray(out["Out"][0]), x / 5.0,
+                               rtol=1e-5)
+    p = np.array([1.0], np.float32)
+    g = np.array([0.1], np.float32)
+    v = np.array([0.0], np.float32)
+    out = run_op("dgc_momentum",
+                 {"Param": [p], "Grad": [g], "Velocity": [v],
+                  "LearningRate": [np.array([0.1], np.float32)],
+                  "current_step": [np.array(0.0, np.float32)]},
+                 {"mu": 0.9})
+    np.testing.assert_allclose(np.asarray(out["ParamOut"][0]),
+                               [1.0 - 0.01], rtol=1e-5)
+
+
+# --- metric tail -----------------------------------------------------------
+
+def test_mean_iou():
+    pred = np.array([0, 1, 1, 2], np.int32)
+    lab = np.array([0, 1, 2, 2], np.int32)
+    out = run_op("mean_iou", {"Predictions": [pred], "Labels": [lab]},
+                 {"num_classes": 3})
+    # class0: 1/1, class1: 1/2, class2: 1/2 → mean = 2/3
+    np.testing.assert_allclose(float(np.asarray(out["OutMeanIou"][0])),
+                               2 / 3, rtol=1e-5)
+
+
+def test_positive_negative_pair():
+    s = np.array([0.9, 0.1, 0.8, 0.6], np.float32)
+    l = np.array([1.0, 0.0, 1.0, 0.0], np.float32)
+    q = np.array([7, 7, 7, 7], np.int64)
+    out = run_op("positive_negative_pair",
+                 {"Score": [s], "Label": [l], "QueryID": [q]}, {})
+    assert float(np.asarray(out["PositivePair"][0])) == 4.0
+    assert float(np.asarray(out["NegativePair"][0])) == 0.0
+
+
+def test_chunk_eval_iob():
+    # tags: B-0=0 I-0=1 B-1=2 I-1=3 O=4 ; one seq
+    inf = np.array([[0, 1, 4, 2, 3]], np.int64)
+    lab = np.array([[0, 1, 4, 2, 4]], np.int64)
+    out = run_op("chunk_eval", {"Inference": [inf], "Label": [lab]},
+                 {"num_chunk_types": 2, "chunk_scheme": "IOB"})
+    # inferred chunks: (0,2,0),(3,5,1); label chunks: (0,2,0),(3,4,1)
+    assert int(np.asarray(out["NumInferChunks"][0])) == 2
+    assert int(np.asarray(out["NumLabelChunks"][0])) == 2
+    assert int(np.asarray(out["NumCorrectChunks"][0])) == 1
+    np.testing.assert_allclose(float(np.asarray(out["Precision"][0])), 0.5)
+
+
+def test_teacher_student_sigmoid_loss():
+    x = np.array([0.0, 2.0], np.float32)
+    lbl = np.array([1.0, 0.0], np.float32)
+    out = run_op("teacher_student_sigmoid_loss",
+                 {"X": [x], "Label": [lbl]}, {})
+    got = np.asarray(out["Y"][0]).reshape(-1)
+    sig = 1 / (1 + np.exp(-x))
+    ref = -lbl * np.log(sig + 1e-9) - (1 - lbl) * np.log(1 - sig + 1e-9)
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_op_count_target():
+    from paddle_tpu.ops import registry
+    assert len(registry.all_ops()) >= 375

@@ -1,0 +1,217 @@
+"""paddle.vision / paddle.text / paddle.dataset surface tests
+(reference python/paddle/tests/test_transforms.py, test_datasets.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import transforms as T
+from paddle_tpu.vision import datasets as vd
+from paddle_tpu import text as ptext
+
+
+def _img(h=32, w=48, c=3, dtype=np.uint8, seed=0):
+    r = np.random.RandomState(seed)
+    return r.randint(0, 256, (h, w, c)).astype(dtype)
+
+
+class TestTransforms:
+    def test_resize_shapes(self):
+        img = _img()
+        assert T.Resize((16, 20))(img).shape == (16, 20, 3)
+        out = T.Resize(16)(img)          # shorter side to 16
+        assert out.shape == (16, 24, 3)
+        near = T.Resize((16, 20), interpolation="nearest")(img)
+        assert near.shape == (16, 20, 3)
+
+    def test_resize_bilinear_values(self):
+        img = np.arange(16, dtype=np.float32).reshape(4, 4)
+        out = T.Resize((2, 2))(img)
+        # area-aligned bilinear: averages of 2x2 blocks
+        np.testing.assert_allclose(
+            out, [[2.5, 4.5], [10.5, 12.5]], atol=1e-5)
+
+    def test_crops_flips_pad(self):
+        img = _img()
+        assert T.CenterCrop(16)(img).shape == (16, 16, 3)
+        assert T.RandomCrop(16)(img).shape == (16, 16, 3)
+        assert T.RandomResizedCrop(16)(img).shape == (16, 16, 3)
+        assert T.CenterCropResize(24)(img).shape == (24, 24, 3)
+        np.testing.assert_array_equal(
+            T.RandomHorizontalFlip(1.0)(img), img[:, ::-1])
+        np.testing.assert_array_equal(
+            T.RandomVerticalFlip(1.0)(img), img[::-1])
+        assert T.Pad(2)(img).shape == (36, 52, 3)
+
+    def test_normalize_permute_totensor(self):
+        img = _img()
+        chw = T.Permute()(img)
+        assert chw.shape == (3, 32, 48) and chw.dtype == np.float32
+        norm = T.Normalize(mean=127.5, std=127.5)(chw)
+        assert abs(float(norm.mean())) < 0.2
+        tt = T.ToTensor()(img)
+        assert tt.shape == (3, 32, 48) and 0 <= tt.min() <= tt.max() <= 1
+
+    def test_color_ops(self):
+        img = _img()
+        for t in [T.BrightnessTransform(0.4), T.ContrastTransform(0.4),
+                  T.SaturationTransform(0.4), T.HueTransform(0.2),
+                  T.ColorJitter(0.2, 0.2, 0.2, 0.1), T.GaussianNoise(0, 5),
+                  T.RandomErasing(prob=1.0)]:
+            out = t(img)
+            assert out.shape == img.shape and out.dtype == img.dtype
+
+    def test_rotate_grayscale(self):
+        img = _img()
+        assert T.RandomRotate(30)(img).shape == img.shape
+        assert T.RandomRotate(30, expand=True)(img).shape[2] == 3
+        assert T.Grayscale()(img).shape == (32, 48, 1)
+        assert T.Grayscale(3)(img).shape == (32, 48, 3)
+
+    def test_compose(self):
+        tr = T.Compose([T.Resize(20), T.CenterCrop(16), T.ToTensor(),
+                        T.Normalize(mean=[0.5] * 3, std=[0.5] * 3)])
+        out = tr(_img())
+        assert out.shape == (3, 16, 16)
+
+
+class TestVisionDatasets:
+    def test_mnist(self):
+        ds = vd.MNIST(mode="train")
+        img, label = ds[0]
+        assert img.shape == (28, 28) and 0 <= label < 10
+        assert len(vd.MNIST(mode="test")) < len(ds)
+
+    def test_cifar(self):
+        ds = vd.Cifar10(mode="train", transform=T.ToTensor())
+        img, label = ds[3]
+        assert img.shape == (3, 32, 32) and 0 <= label < 10
+        ds100 = vd.Cifar100(mode="test")
+        assert max(ds100[i][1] for i in range(len(ds100))) > 9
+
+    def test_flowers_voc(self):
+        ds = vd.Flowers(mode="test")
+        img, label = ds[0]
+        assert img.shape == (64, 64, 3) and 0 <= label < 102
+        voc = vd.VOC2012(mode="train")
+        img, mask = voc[0]
+        assert img.shape == (64, 64, 3) and mask.shape == (64, 64)
+
+    def test_folder(self, tmp_path):
+        for cls in ("cat", "dog"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(2):
+                np.save(d / f"{i}.npy", _img(8, 8, seed=i))
+        ds = vd.DatasetFolder(str(tmp_path))
+        assert len(ds) == 4 and ds.classes == ["cat", "dog"]
+        img, label = ds[0]
+        assert img.shape == (8, 8, 3) and label == 0
+        flat = vd.ImageFolder(str(tmp_path))
+        assert len(flat) == 4 and flat[0][0].shape == (8, 8, 3)
+
+    def test_dataloader_integration(self):
+        from paddle_tpu.io import DataLoader
+        ds = vd.MNIST(mode="test", transform=T.Compose([T.ToTensor()]))
+        loader = DataLoader(ds, batch_size=16, shuffle=True, num_workers=0)
+        imgs, labels = next(iter(loader))
+        assert tuple(np.asarray(imgs).shape) == (16, 1, 28, 28)
+        assert len(np.asarray(labels)) == 16
+
+
+class TestVisionModels:
+    def test_forward_shapes(self):
+        import paddle_tpu.vision as V
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 3, 32, 32).astype(np.float32))
+        for factory in (lambda: V.mobilenet_v1(scale=0.25, num_classes=7),
+                        lambda: V.mobilenet_v2(scale=0.25, num_classes=7)):
+            m = factory()
+            m.eval()
+            out = m(x)
+            assert tuple(out.shape) == (2, 7)
+
+    def test_vgg_small(self):
+        import paddle_tpu.vision as V
+        m = V.vgg11(num_classes=5)
+        m.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(1, 3, 224, 224)
+            .astype(np.float32))
+        assert tuple(m(x).shape) == (1, 5)
+
+    def test_resnet_variants_exist(self):
+        import paddle_tpu.vision as V
+        assert V.resnet34 and V.resnet152 and V.LeNet
+
+
+class TestTextDatasets:
+    def test_uci_housing(self):
+        tr = ptext.UCIHousing(mode="train")
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        assert abs(float(np.stack([tr[i][0] for i in
+                                   range(len(tr))]).mean())) < 0.1
+
+    def test_imdb_imikolov(self):
+        ds = ptext.Imdb(mode="train")
+        doc, label = ds[0]
+        assert doc.dtype == np.int64 and label in (0, 1)
+        ng = ptext.Imikolov(mode="train", window_size=5)
+        assert len(ng[0]) == 5
+
+    def test_movielens_wmt(self):
+        ml = ptext.Movielens(mode="train")
+        s = ml[0]
+        assert len(s) == 8 and isinstance(s[-1], float)
+        wmt = ptext.WMT16(mode="test")
+        src, trg, nxt = wmt[0]
+        assert len(trg) == len(nxt)
+        assert trg[0] == 0 and nxt[-1] == 1   # bos/eos framing
+
+    def test_conll05(self):
+        ds = ptext.Conll05st(mode="test")
+        words, pred, mark, labels = ds[0]
+        assert len(words) == len(mark) == len(labels)
+        assert mark.sum() == 1
+
+    def test_viterbi_decode(self):
+        r = np.random.RandomState(0)
+        pot = r.randn(2, 6, 4).astype(np.float32)
+        trans = r.randn(4, 4).astype(np.float32)
+        path = ptext.viterbi_decode(pot, trans,
+                                    lengths=np.array([6, 4], np.int64))
+        arr = np.asarray(path.numpy())
+        assert arr.shape == (2, 6)
+        # brute-force check for batch 0
+        best, best_score = None, -1e30
+        import itertools
+        for seq in itertools.product(range(4), repeat=6):
+            sc = pot[0, 0, seq[0]] + sum(
+                trans[seq[t - 1], seq[t]] + pot[0, t, seq[t]]
+                for t in range(1, 6))
+            if sc > best_score:
+                best_score, best = sc, seq
+        np.testing.assert_array_equal(arr[0], best)
+
+
+class TestLegacyDatasetModule:
+    def test_readers(self):
+        import paddle_tpu.dataset as D
+        x, y = next(D.uci_housing.train()())
+        assert x.shape == (13,)
+        img, label = next(D.mnist.train()())
+        assert img.shape == (784,) and -1 <= img.min()
+        sample = next(D.cifar.train10()())
+        assert sample[0].shape == (3072,)
+        doc, lab = next(D.imdb.train()())
+        assert isinstance(doc, list) and lab in (0, 1)
+        assert len(next(D.imikolov.train()())) == 5
+        assert D.movielens.max_user_id() == 6040
+        src, trg, nxt = next(D.wmt16.train()())
+        assert trg[0] == 0
+        # DatasetFactory still lives on the same namespace
+        assert D.DatasetFactory
+
+    def test_import_styles(self):
+        import paddle_tpu.dataset.mnist as m
+        assert m.train
