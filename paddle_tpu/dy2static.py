@@ -283,8 +283,9 @@ class _Dy2Static(ast.NodeTransformer):
     def visit_If(self, node):
         reads_after = set(self._after[-1])
         self.generic_visit(node)
-        assigned = (set(_AssignedNames().collect(node.body)) |
-                    set(_AssignedNames().collect(node.orelse)))
+        a_true = set(_AssignedNames().collect(node.body))
+        a_false = set(_AssignedNames().collect(node.orelse))
+        assigned = a_true | a_false
         if not assigned:
             return node   # assignment-free branch: keep python semantics
                           # (early-return/continue guards stay untouched)
@@ -293,8 +294,12 @@ class _Dy2Static(ast.NodeTransformer):
                 "dy2static: `return` inside a converted `if` branch is not "
                 "supported — assign to a variable and return after the if")
         # carry only names someone reads later; if none are read later the
-        # branches still run (side effects) with the full assigned set
-        mods = sorted(assigned & reads_after) or sorted(assigned)
+        # branches still run (side effects) — but then carry only TWO-sided
+        # names: a one-sided assignment nobody reads would flow UNDEF into
+        # the merge and reject valid code (the reference's UndefinedVar only
+        # errors on a real read)
+        mods = (sorted(assigned & reads_after)
+                or sorted(a_true & a_false))
         uid = self._uid()
         args = _mods_args(mods)
         ret = ast.Return(value=_names_tuple(mods, ast.Load))
@@ -305,13 +310,19 @@ class _Dy2Static(ast.NodeTransformer):
             name=f"__jst_false_{uid}", args=args,
             body=list(node.orelse or [ast.Pass()]) + [ret],
             decorator_list=[])
-        call = ast.Assign(
-            targets=[_names_tuple(mods, ast.Store)],
-            value=ast.Call(func=ast.Name(id="__jst_ifelse__", ctx=ast.Load()),
-                           args=[node.test,
-                                 _thunk_call(t_def.name, mods),
-                                 _thunk_call(f_def.name, mods)],
-                           keywords=[]))
+        ifelse = ast.Call(func=ast.Name(id="__jst_ifelse__", ctx=ast.Load()),
+                          args=[node.test,
+                                _thunk_call(t_def.name, mods),
+                                _thunk_call(f_def.name, mods)],
+                          keywords=[])
+        if mods:
+            call = ast.Assign(targets=[_names_tuple(mods, ast.Store)],
+                              value=ifelse)
+        else:
+            # only one-sided names nobody reads: run the branches for their
+            # side effects, carry nothing (an unread one-sided assignment
+            # must not flow UNDEF into the merge)
+            call = ast.Expr(value=ifelse)
         return [_undef_guard(m) for m in mods] + [t_def, f_def, call]
 
     # --- while -------------------------------------------------------------

@@ -34,6 +34,31 @@ _ALIASES = {
 
 FLOAT_DTYPES = (jnp.float16, jnp.bfloat16, jnp.float32, jnp.float64)
 
+# --- int64 device policy ---------------------------------------------------
+# jax x64 stays OFF (64-bit lanes halve VPU throughput and double HBM for id
+# tensors). "int64" is a declaration-level dtype for API parity with the
+# reference (lookup_table ids are int64 there); VALUES live as int32 on
+# device. Safety comes from two rules:
+#   * host-side sparse paths (ShardedKVClient, distributed_embedding) keep
+#     full int64 keys and hand the device only compact int32 row indices
+#     (distributed/ps.py:324-340), so >2B-row tables never truncate;
+#   * the executor feed boundary range-checks int64 feeds and raises on
+#     values outside int32 (framework/executor.py), instead of the silent
+#     jax canonicalization.
+# Lowerings that produce "int64" outputs must cast via INT64_DEVICE_DTYPE
+# (not jnp.int64, which warns and truncates anyway).
+INT64_DEVICE_DTYPE = jnp.int32
+
+
+def device_dtype(dtype):
+    """convert_dtype + the 64-bit-int -> 32-bit on-device policy."""
+    d = convert_dtype(dtype)
+    if d == np.dtype(np.int64):
+        return np.dtype(np.int32)
+    if d == np.dtype(np.uint64):
+        return np.dtype(np.uint32)
+    return d
+
 
 def convert_dtype(dtype):
     """Normalize any dtype spec (string / numpy / jax) to a numpy dtype object."""

@@ -476,11 +476,28 @@ class Executor:
             v = block.find_var_recursive(name)
             if v is not None and hasattr(arr, "astype"):
                 # cast in place (device-side for jax arrays — feeding device
-                # arrays must NOT bounce through host numpy); 64-bit dtypes
-                # canonicalize to 32-bit when jax x64 is off
+                # arrays must NOT bounce through host numpy); 64-bit ints
+                # live as int32 on device (framework/dtype.py policy) with a
+                # range guard here instead of jax's silent truncation
                 want = np.dtype(v.dtype)
                 if isinstance(arr, jax.Array):
                     want = jax.dtypes.canonicalize_dtype(want)
+                elif want in (np.dtype(np.int64), np.dtype(np.uint64)):
+                    # 64-bit-int var: range-check ANY host feed (int64,
+                    # float64-from-pandas, ...) against the 32-bit device
+                    # dtype instead of jax's silent wraparound
+                    info = (np.iinfo(np.int32) if want == np.dtype(np.int64)
+                            else np.iinfo(np.uint32))
+                    if arr.size and (arr.max() > info.max
+                                     or arr.min() < info.min):
+                        raise ValueError(
+                            f"feed {name!r} holds {want.name} ids outside "
+                            f"{info.dtype.name} range; device tensors are "
+                            f"32-bit (see framework/dtype.py). Route "
+                            f">2B-row ids through distributed_embedding / "
+                            f"the sparse KV path, which keeps int64 keys "
+                            f"on host.")
+                    want = np.dtype(info.dtype)
                 if np.dtype(arr.dtype) != want:
                     arr = arr.astype(want)
             feed_vals[name] = arr

@@ -34,7 +34,10 @@ from ..layer_helper import LayerHelper
 from ..ops.registry import register
 
 __all__ = ["cond", "increment", "array_write", "array_read", "array_length",
-           "create_array", "While", "while_loop", "StaticRNN", "Switch"]
+           "create_array", "While", "while_loop", "StaticRNN", "Switch",
+           "lod_rank_table", "max_sequence_len", "lod_tensor_to_array",
+           "array_to_lod_tensor", "shrink_memory", "split_lod_tensor",
+           "merge_lod_tensor"]
 
 
 # ---------------------------------------------------------------------------
@@ -634,3 +637,109 @@ def _lower_switch(ctx, ins, attrs):
             list(carried_vals))
 
     return {"Out": build(0, list(ins["Carried"]))}
+
+
+# ---------------------------------------------------------------------------
+# LoD rank-table family (dynamic-RNN memory ops; lowerings in ops/lod_ops.py)
+# ---------------------------------------------------------------------------
+
+def lod_rank_table(x, level=0, length=None):
+    """Reference fluid.layers.lod_rank_table (layers/control_flow.py:1231).
+    The reference reads lengths from x's LoD level; padded-dense sequences
+    carry them in an explicit `length=` Variable instead (the framework-wide
+    convention, layers/sequence_lod.py)."""
+    if length is None:
+        raise ValueError(
+            "lod_rank_table on TPU needs length= (padded-dense sequences "
+            "have no LoD metadata; pass the per-sequence length vector)")
+    if level != 0:
+        raise ValueError("only LoD level 0 is supported (one nesting level)")
+    helper = LayerHelper("lod_rank_table")
+    table = helper.create_variable_for_type_inference("int32")
+    table.shape = (length.shape[0] if length.shape else -1, 2)
+    helper.append_op("lod_rank_table",
+                     inputs={"X": [x], "Length": [length]},
+                     outputs={"Out": [table]})
+    return table
+
+
+def max_sequence_len(rank_table):
+    """Reference layers/control_flow.py:1298."""
+    helper = LayerHelper("max_sequence_len")
+    out = helper.create_variable_for_type_inference("int32")
+    out.shape = (1,)
+    helper.append_op("max_sequence_len", inputs={"RankTable": [rank_table]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def lod_tensor_to_array(x, table):
+    """Reference layers/control_flow.py:1323 — padded [B, T, ...] to a
+    time-major TensorArray in rank (desc-length) order, dead rows zeroed."""
+    helper = LayerHelper("lod_tensor_to_array")
+    arr = helper.main_program.current_block().create_var(
+        dtype=x.dtype, type="lod_tensor_array")
+    arr._array_capacity = int(x.shape[1]) if len(x.shape) > 1 and \
+        x.shape[1] and x.shape[1] > 0 else _DEFAULT_ARRAY_CAPACITY
+    helper.append_op("lod_tensor_to_array",
+                     inputs={"X": [x], "RankTable": [table]},
+                     outputs={"Out": [arr]})
+    return arr
+
+
+def array_to_lod_tensor(x, table, max_len=None):
+    """Reference layers/control_flow.py:1375 — inverse of
+    lod_tensor_to_array, back to original order, zero-padded. `max_len`
+    bounds the time dimension; defaults to the array's build-time capacity
+    (exact for arrays made by lod_tensor_to_array; pass T explicitly for
+    arrays assembled via plain array_write with a larger capacity)."""
+    helper = LayerHelper("array_to_lod_tensor")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    cap = max_len or getattr(x, "_array_capacity", None)
+    helper.append_op("array_to_lod_tensor",
+                     inputs={"X": [x], "RankTable": [table]},
+                     outputs={"Out": [out]},
+                     attrs={} if cap is None else {"max_len": int(cap)})
+    return out
+
+
+def shrink_memory(x, i, table):
+    """Reference layers/control_flow.py:1997 / shrink_rnn_memory_op.cc:1 —
+    keep memory rows of sequences alive at step i (static shape: dead rows
+    zeroed)."""
+    helper = LayerHelper("shrink_memory")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = tuple(x.shape)
+    helper.append_op("shrink_rnn_memory",
+                     inputs={"X": [x], "I": [i], "RankTable": [table]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def split_lod_tensor(input, mask, level=0):
+    """Reference layers/control_flow.py:104 — route rows by boolean mask
+    into (true, false) outputs, stably front-compacted, zero-padded."""
+    helper = LayerHelper("split_lod_tensor")
+    out_true = helper.create_variable_for_type_inference(input.dtype)
+    out_false = helper.create_variable_for_type_inference(input.dtype)
+    out_true.shape = tuple(input.shape)
+    out_false.shape = tuple(input.shape)
+    helper.append_op("split_lod_tensor",
+                     inputs={"X": [input], "Mask": [mask]},
+                     outputs={"OutTrue": [out_true],
+                              "OutFalse": [out_false]},
+                     attrs={"level": int(level)})
+    return out_true, out_false
+
+
+def merge_lod_tensor(in_true, in_false, x, mask, level=0):
+    """Reference layers/control_flow.py:157 — inverse of split_lod_tensor."""
+    helper = LayerHelper("merge_lod_tensor")
+    out = helper.create_variable_for_type_inference(in_true.dtype)
+    out.shape = tuple(in_true.shape)
+    helper.append_op("merge_lod_tensor",
+                     inputs={"InTrue": [in_true], "InFalse": [in_false],
+                             "X": [x], "Mask": [mask]},
+                     outputs={"Out": [out]},
+                     attrs={"level": int(level)})
+    return out

@@ -205,7 +205,7 @@ def _beam_decode(decoder, inits, max_step_num, batch_size):
     scores = jnp.where(jnp.arange(beam)[None, :] == 0, 0.0,
                        jnp.finfo(jnp.float32).min) * jnp.ones((b, 1))
     step_ids, step_parents, final_scores = [], [], scores
-    pre_ids = jnp.full((b, beam), -1, jnp.int64)    # nothing finished yet
+    pre_ids = jnp.full((b, beam), -1, INT64_DEVICE_DTYPE)  # nothing finished
 
     for _ in range(max_step_num):
         logits, state = decoder.step_fn(

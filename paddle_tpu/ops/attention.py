@@ -50,8 +50,10 @@ def _flash_probe():
             x = jnp.zeros((1, 1, 256, 64), jnp.bfloat16)
 
             def f(q):
-                return jnp.sum(flash_attention(q, x, x, None, False,
-                                               128, 128).astype(jnp.float32))
+                plain = flash_attention(q, x, x, None, False, 128, 128)
+                dropped = flash_attention(q, x, x, None, False, 128, 128,
+                                          dropout=0.1, seed=1)
+                return jnp.sum((plain + dropped).astype(jnp.float32))
 
             jax.jit(jax.grad(f))(x).block_until_ready()
             _flash_probe_ok = True
@@ -104,12 +106,20 @@ def _fused_attention(ctx, ins, attrs):
                   if attrs.get("sp_mode") == "ulysses" else ring_attention)
             return {"Out": [fn(q, k, v, mesh=mesh, scale=scale,
                                causal=causal)]}
-    if not ctx.is_eval_shape and dropout == 0.0 and mask is None \
+    if not ctx.is_eval_shape and mask is None \
             and not isinstance(q, jax.ShapeDtypeStruct) and _use_pallas(q):
         try:
             from .pallas.flash_attention import flash_attention
+            seed = None
+            if dropout:
+                # fold the op's stable seed into the run key, then squeeze to
+                # the int32 the in-kernel counter-based mask hashes on
+                seed = jax.random.randint(
+                    key, (), jnp.iinfo(jnp.int32).min,
+                    jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
             return {"Out": [flash_attention(q, k, v, scale=scale,
-                                            causal=causal)]}
+                                            causal=causal, dropout=dropout,
+                                            seed=seed)]}
         except Exception as e:  # pragma: no cover - kernel/platform specific
             global _warned_fallback
             if not _warned_fallback:

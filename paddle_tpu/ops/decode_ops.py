@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from .registry import register
+from ..framework.dtype import INT64_DEVICE_DTYPE
 
 
 def _seq_lengths(ins, b, T, slot="SeqLen"):
@@ -119,8 +120,8 @@ def _crf_decoding(ctx, ins, attrs):
     if label is not None:
         lbl = label.reshape(b, T).astype(jnp.int32)
         return {"ViterbiPath": [
-            jnp.where(valid, (path == lbl).astype(jnp.int64), 0)]}
-    return {"ViterbiPath": [path.astype(jnp.int64)]}
+            jnp.where(valid, (path == lbl).astype(INT64_DEVICE_DTYPE), 0)]}
+    return {"ViterbiPath": [path.astype(INT64_DEVICE_DTYPE)]}
 
 
 @register("gather_tree")
@@ -163,8 +164,8 @@ def _beam_search(ctx, ins, attrs):
     total = jnp.where(finished[:, :, None], frozen, cont)  # [b, beam, V]
     flat = total.reshape(b, beam * V)
     top_scores, top_idx = jax.lax.top_k(flat, beam_size)
-    parent = (top_idx // V).astype(jnp.int64)
-    token = (top_idx % V).astype(jnp.int64)
+    parent = (top_idx // V).astype(INT64_DEVICE_DTYPE)
+    token = (top_idx % V).astype(INT64_DEVICE_DTYPE)
     return {"selected_ids": [token], "selected_scores": [top_scores],
             "parent_idx": [parent]}
 

@@ -305,15 +305,16 @@ def _roi_align(ctx, ins, attrs):
     """roi_align_op.h: average of bilinear samples per bin."""
     x = ins["X"][0]                       # [N, C, H, W]
     rois = ins["ROIs"][0]                 # [R, 4]
-    batch_ids = ins.get("RoisNum", [None])[0]
     ph = attrs.get("pooled_height", 1)
     pw = attrs.get("pooled_width", 1)
     spatial_scale = attrs.get("spatial_scale", 1.0)
     sampling = attrs.get("sampling_ratio", -1)
     n, c, h, w = x.shape
     r = rois.shape[0]
-    bids = (batch_ids.reshape(-1).astype(jnp.int32)
-            if batch_ids is not None else jnp.zeros((r,), jnp.int32))
+    # RoisNum = per-IMAGE roi counts (roi_align_op.cc), not per-ROI ids —
+    # one shared counts->index contract with psroi/prroi (tail_ops.py)
+    from .tail_ops import _roi_batch_index
+    bids = _roi_batch_index(ins, r, n)
 
     xmin = rois[:, 0] * spatial_scale
     ymin = rois[:, 1] * spatial_scale
@@ -366,14 +367,13 @@ def _roi_pool(ctx, ins, attrs):
     """roi_pool_op.cc: max over quantized bins."""
     x = ins["X"][0]
     rois = ins["ROIs"][0]
-    batch_ids = ins.get("RoisNum", [None])[0]
     ph = attrs.get("pooled_height", 1)
     pw = attrs.get("pooled_width", 1)
     spatial_scale = attrs.get("spatial_scale", 1.0)
     n, c, h, w = x.shape
     r = rois.shape[0]
-    bids = (batch_ids.reshape(-1).astype(jnp.int32)
-            if batch_ids is not None else jnp.zeros((r,), jnp.int32))
+    from .tail_ops import _roi_batch_index   # RoisNum = per-image counts
+    bids = _roi_batch_index(ins, r, n)
     x1 = jnp.clip(jnp.round(rois[:, 0] * spatial_scale), 0, w - 1).astype(jnp.int32)
     y1 = jnp.clip(jnp.round(rois[:, 1] * spatial_scale), 0, h - 1).astype(jnp.int32)
     x2 = jnp.clip(jnp.round(rois[:, 2] * spatial_scale), 0, w - 1).astype(jnp.int32)

@@ -26,7 +26,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..framework.dtype import convert_dtype
+# device_dtype: on-device dtype policy (int64 ids live as int32 — framework/dtype.py)
+from ..framework.dtype import device_dtype as convert_dtype
+from ..framework.dtype import INT64_DEVICE_DTYPE
 from .registry import register
 
 
@@ -986,7 +988,7 @@ def _cross_entropy2(ctx, ins, attrs):
 def _size(ctx, ins, attrs):
     import numpy as _np
     return {"Out": [jnp.asarray(int(_np.prod(ins["Input"][0].shape)),
-                                jnp.int64)]}
+                                INT64_DEVICE_DTYPE)]}
 
 
 @register("is_empty")

@@ -1,0 +1,163 @@
+"""LoD rank-table + dynamic-RNN memory ops — the ragged-sequence bridge.
+
+Reference counterparts: lod_rank_table_op.cc, max_sequence_len_op.cc,
+lod_tensor_to_array_op.cc:1, array_to_lod_tensor_op.cc,
+shrink_rnn_memory_op.cc:1, split_lod_tensor_op.cc, merge_lod_tensor_op.cc.
+These are what make the reference's *dynamic* RNN (recurrent_op.cc) ragged-
+correct rather than pad-and-mask.
+
+TPU-native contract (static shapes; XLA cannot resize tensors mid-loop):
+
+* A "rank table" is an int32 tensor [B, 2]: column 0 = original sequence
+  index sorted by length descending (stable), column 1 = that sequence's
+  length. This replaces the reference's LoDRankTable type; it is an
+  ordinary device tensor so it flows through jit/scan.
+* Sequences are padded [B, T, ...] with an explicit Length vector (the
+  framework-wide convention, ops/sequence_ops.py) instead of LoD offsets.
+* Where the reference *shrinks* tensor heights step by step (alive-sequence
+  prefix of the rank order), these lowerings keep the full static height and
+  ZERO the dead rows. Downstream consumers (array_to_lod_tensor, the
+  dynamic-RNN book tests) mask identically, so live-region numerics match
+  the reference exactly and dead rows are zeros, not garbage.
+* split/merge route rows by a boolean mask with stable front-compaction —
+  the inverse permutation is recomputed from the same mask in merge, so
+  split+merge round-trips bit-exactly with static shapes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+
+def _stable_rank_desc(lengths):
+    """Indices sorting lengths descending, ties by original order (the
+    reference's std::stable_sort in lod_rank_table.cc)."""
+    b = lengths.shape[0]
+    # single sort key: -len * B + index  (lexicographic, collision-free)
+    key = (-lengths.astype(jnp.int32)) * jnp.int32(b) \
+        + jnp.arange(b, dtype=jnp.int32)
+    return jnp.argsort(key).astype(jnp.int32)
+
+
+@register("lod_rank_table", nondiff_slots=("X", "Length"))
+def _lod_rank_table(ctx, ins, attrs):
+    lengths = jnp.reshape(ins["Length"][0], (-1,)).astype(jnp.int32)
+    idx = _stable_rank_desc(lengths)
+    table = jnp.stack([idx, lengths[idx]], axis=1)
+    return {"Out": [table]}
+
+
+@register("max_sequence_len", nondiff_slots=("RankTable",))
+def _max_sequence_len(ctx, ins, attrs):
+    table = ins["RankTable"][0]
+    return {"Out": [jnp.reshape(table[0, 1], (1,)).astype(jnp.int32)]}
+
+
+@register("lod_tensor_to_array", nondiff_slots=("RankTable",))
+def _lod_tensor_to_array(ctx, ins, attrs):
+    """x [B, T, ...] -> TensorArray whose slot t holds the t-th token of
+    every sequence still alive at step t, in rank (desc-length) order; dead
+    rows are zeros. Runtime array value = (buffer [T, B, ...], length=T)."""
+    x = ins["X"][0]
+    table = ins["RankTable"][0]
+    idx, lens = table[:, 0], table[:, 1]
+    t = x.shape[1]
+    sorted_x = jnp.take(x, idx, axis=0)              # [B, T, ...]
+    tm = jnp.moveaxis(sorted_x, 1, 0)                # [T, B, ...]
+    steps = jnp.arange(t, dtype=jnp.int32)
+    alive = (lens[None, :] > steps[:, None])          # [T, B]
+    mask = alive.reshape(alive.shape + (1,) * (tm.ndim - 2))
+    buf = jnp.where(mask, tm, jnp.zeros((), tm.dtype))
+    return {"Out": [(buf, jnp.asarray(t, jnp.int32))]}
+
+
+@register("array_to_lod_tensor", nondiff_slots=("RankTable",))
+def _array_to_lod_tensor(ctx, ins, attrs):
+    """Inverse: TensorArray buffer [T, B, ...] (rank order) -> padded
+    batch-major [B, T, ...] in ORIGINAL sequence order, zeros past each
+    sequence's length."""
+    buf, _ = ins["X"][0]
+    table = ins["RankTable"][0]
+    max_len = attrs.get("max_len")
+    if max_len and int(max_len) < buf.shape[0]:
+        # arrays not born from lod_tensor_to_array (plain array_write) carry
+        # a default 128-slot capacity; trim to the build-time sequence length
+        # so Out is [B, T, ...], not [B, capacity, ...]
+        buf = buf[:int(max_len)]
+    idx, lens = table[:, 0], table[:, 1]
+    b = idx.shape[0]
+    inv = jnp.zeros((b,), jnp.int32).at[idx].set(
+        jnp.arange(b, dtype=jnp.int32))
+    bm = jnp.moveaxis(buf, 0, 1)                      # [B(rank), T, ...]
+    out = jnp.take(bm, inv, axis=0)                   # original order
+    t = out.shape[1]
+    steps = jnp.arange(t, dtype=jnp.int32)
+    orig_lens = jnp.take(lens, inv)                   # length per orig seq
+    valid = (steps[None, :] < orig_lens[:, None])     # [B, T]
+    mask = valid.reshape(valid.shape + (1,) * (out.ndim - 2))
+    return {"Out": [jnp.where(mask, out, jnp.zeros((), out.dtype))]}
+
+
+@register("shrink_rnn_memory", nondiff_slots=("RankTable", "I"))
+def _shrink_rnn_memory(ctx, ins, attrs):
+    """Memory rows for sequences alive at step I — the first
+    active(I) = #(len > I) rows of the rank order (shrink_rnn_memory_op.cc's
+    lower_bound over the rank table). Static shape: dead rows zeroed; the
+    grad of the zeroed rows is zero, matching ShrinkRNNMemoryGradOp's
+    zero-fill of the removed rows."""
+    x = ins["X"][0]
+    table = ins["RankTable"][0]
+    i = jnp.reshape(ins["I"][0], ()).astype(jnp.int32)
+    active = jnp.sum((table[:, 1] > i).astype(jnp.int32))
+    rows = jnp.arange(x.shape[0], dtype=jnp.int32)
+    mask = (rows < active).reshape((-1,) + (1,) * (x.ndim - 1))
+    return {"Out": [jnp.where(mask, x, jnp.zeros((), x.dtype))]}
+
+
+def _mask_positions(mask_b):
+    """Stable front-compaction positions: pos[i] = #True among mask[:i]
+    (rows routed to the True output), likewise for False."""
+    m = mask_b.astype(jnp.int32)
+    pos_true = jnp.cumsum(m) - m          # exclusive prefix sum
+    inv = 1 - m
+    pos_false = jnp.cumsum(inv) - inv
+    return pos_true, pos_false
+
+
+@register("split_lod_tensor", nondiff_slots=("Mask",))
+def _split_lod_tensor(ctx, ins, attrs):
+    """Route rows of X into (OutTrue, OutFalse) by boolean Mask [B, 1],
+    stably compacted to the front, zero-padded to the full static height
+    (split_lod_tensor_op.cc; the reference emits variable heights)."""
+    x = ins["X"][0]
+    mask = jnp.reshape(ins["Mask"][0], (-1,)).astype(bool)
+    b = x.shape[0]
+    pos_t, pos_f = _mask_positions(mask)
+    zeros = jnp.zeros_like(x)
+    # scatter row i of x to slot pos[i] of the matching output; mode="drop"
+    # ignores the rows routed to the other side (their target index is set
+    # out of range)
+    big = jnp.int32(b)
+    ti = jnp.where(mask, pos_t, big)
+    fi = jnp.where(mask, big, pos_f)
+    out_t = zeros.at[ti].set(x, mode="drop")
+    out_f = zeros.at[fi].set(x, mode="drop")
+    return {"OutTrue": [out_t], "OutFalse": [out_f]}
+
+
+@register("merge_lod_tensor", nondiff_slots=("Mask", "X"))
+def _merge_lod_tensor(ctx, ins, attrs):
+    """Inverse of split: out[i] = InTrue[pos_true(i)] if Mask[i] else
+    InFalse[pos_false(i)] (merge_lod_tensor_op.cc). X supplies dtype/shape
+    in the reference; unused here beyond parity."""
+    in_true = ins["InTrue"][0]
+    in_false = ins["InFalse"][0]
+    mask = jnp.reshape(ins["Mask"][0], (-1,)).astype(bool)
+    pos_t, pos_f = _mask_positions(mask)
+    rows_t = jnp.take(in_true, pos_t, axis=0)
+    rows_f = jnp.take(in_false, pos_f, axis=0)
+    sel = mask.reshape((-1,) + (1,) * (in_true.ndim - 1))
+    return {"Out": [jnp.where(sel, rows_t, rows_f)]}

@@ -13,7 +13,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .registry import register
-from ..framework.dtype import convert_dtype
+# device_dtype: on-device dtype policy (int64 ids live as int32 — framework/dtype.py)
+from ..framework.dtype import device_dtype as convert_dtype
 
 
 def _bcast_y(x, y, axis):

@@ -9,14 +9,15 @@ import jax
 import jax.numpy as jnp
 
 from .registry import register
+from ..framework.dtype import INT64_DEVICE_DTYPE
 
 
 @register("accuracy", nondiff_slots=("Out", "Indices", "Label"))
 def _accuracy(ctx, ins, attrs):
     """Reference accuracy_op.cc: fraction of rows whose top-k Indices contain
     the Label."""
-    indices = ins["Indices"][0].astype(jnp.int64)
-    label = ins["Label"][0].astype(jnp.int64)
+    indices = ins["Indices"][0].astype(INT64_DEVICE_DTYPE)
+    label = ins["Label"][0].astype(INT64_DEVICE_DTYPE)
     if label.ndim == indices.ndim:
         label_col = label
     else:
@@ -41,7 +42,7 @@ def _auc(ctx, ins, attrs):
     prob = pred[:, -1] if pred.ndim == 2 else pred.reshape(-1)
     bucket = jnp.clip((prob * num_thresholds).astype(jnp.int32), 0,
                       num_thresholds)
-    is_pos = (label > 0).astype(jnp.int64)
+    is_pos = (label > 0).astype(INT64_DEVICE_DTYPE)
     pos_add = jnp.zeros_like(stat_pos).at[bucket].add(is_pos)
     neg_add = jnp.zeros_like(stat_neg).at[bucket].add(1 - is_pos)
     new_pos = stat_pos + pos_add

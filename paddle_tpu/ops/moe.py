@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from .registry import register
+from ..framework.dtype import INT64_DEVICE_DTYPE
 
 
 @register("switch_moe")
@@ -71,4 +72,4 @@ def _switch_moe(ctx, ins, attrs):
 
     return {"Out": [out.reshape(orig_shape)],
             "AuxLoss": [aux.astype(x.dtype)],
-            "GateIdx": [expert.astype(jnp.int64)]}
+            "GateIdx": [expert.astype(INT64_DEVICE_DTYPE)]}

@@ -33,6 +33,37 @@ DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 512
 _LANES = 128  # Mosaic lane width; lse stored broadcast over it
 
+# odd constants for the counter-based dropout hash (murmur3 fmix32 mixers)
+_H1 = 0x85EB_CA6B
+_H2 = 0xC2B2_AE35
+_H3 = 0x9E37_79B9
+
+
+def _keep_mask(seed, head, q_off, k_off, block_q, block_k, rate):
+    """Deterministic elementwise keep-mask for attention dropout.
+
+    Counter-based: bit (q_pos, k_pos) of head `head` depends only on
+    (seed, head, q_pos, k_pos) — NOT on block geometry — so the forward
+    kernel and both backward kernels regenerate identical masks even though
+    they tile the score matrix differently. Plain uint32 ops (wrap-around
+    multiply + murmur3 finalizer) so it runs under Mosaic and in interpret
+    mode alike; pltpu.prng_* has no CPU lowering in this jax.
+    """
+    qp = (q_off + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)).astype(jnp.uint32)
+    kp = (k_off + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)).astype(jnp.uint32)
+    x = (qp * jnp.uint32(_H1)) ^ (kp * jnp.uint32(_H2)) \
+        ^ (seed.astype(jnp.uint32) + head.astype(jnp.uint32)
+           * jnp.uint32(_H3))
+    x ^= x >> 16
+    x *= jnp.uint32(_H1)
+    x ^= x >> 13
+    x *= jnp.uint32(_H2)
+    x ^= x >> 16
+    thresh = jnp.uint32(min(int(round(rate * 2.0 ** 32)), 2 ** 32 - 1))
+    return x >= thresh  # P(keep) = 1 - rate
+
 
 def _interpret():
     """Interpreter mode: lets the kernels run (and be tested) on CPU."""
@@ -57,12 +88,13 @@ def _pick_block(s: int, preferred: int) -> int:
     return b
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                      block_k, seq_len):
+def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                      scale, causal, dropout, block_k, seq_len):
     # q_ref: [block_q, hd]; k_ref/v_ref: [S, hd]; o_ref: [block_q, hd]
     # lse_ref: [block_q, 128] (row value broadcast along lanes)
     block_q = q_ref.shape[0]
     hd = q_ref.shape[1]
+    head = pl.program_id(0)
     q_idx = pl.program_id(1)
     q = q_ref[:].astype(jnp.float32) * scale
 
@@ -92,8 +124,16 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         p = jnp.where(jnp.isfinite(s), p, 0.0)
         alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        if dropout > 0.0:
+            # drop AFTER the normalizer accumulates: out = dropout(P) @ V
+            # with P the true softmax — matches upscale_in_train semantics
+            keep = _keep_mask(seed_ref[0], head, q_idx * block_q,
+                              kb * block_k, block_q, block_k, dropout)
+            p_acc = jnp.where(keep, p / (1.0 - dropout), 0.0)
+        else:
+            p_acc = p
         acc_new = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p_acc, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
@@ -112,7 +152,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     lse_ref[:] = jnp.broadcast_to(lse, (block_q, _LANES))
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+def _flash_fwd(q, k, v, seed, scale, causal, dropout, block_q, block_k):
     b, nh, s, hd = q.shape
     bq = _pick_block(s, block_q)
     bk = _pick_block(s, block_k)
@@ -120,11 +160,12 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
     k3 = k.reshape(b * nh, s, hd)
     v3 = v.reshape(b * nh, s, hd)
     kernel = functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
-                               block_k=bk, seq_len=s)
+                               dropout=dropout, block_k=bk, seq_len=s)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * nh, s // bq),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((None, bq, hd), lambda h, i: (h, i, 0)),
             pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
             pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
@@ -140,15 +181,17 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
-    )(q3, k3, v3)
+    )(seed, q3, k3, v3)
     return out.reshape(b, nh, s, hd), lse
 
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
-                         *, scale, causal, block_k, seq_len):
+def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, o_ref,
+                         lse_ref, dq_ref, *, scale, causal, dropout, block_k,
+                         seq_len):
     # q/do/o: [block_q, hd]; k/v: [S, hd]; lse: [block_q, 128]
     block_q = q_ref.shape[0]
     hd = q_ref.shape[1]
+    head = pl.program_id(0)
     q_idx = pl.program_id(1)
     q = q_ref[:].astype(jnp.float32)
     do = do_ref[:].astype(jnp.float32)
@@ -173,6 +216,12 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
         p = jnp.where(jnp.isfinite(s), jnp.exp(s - lse_safe), 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout > 0.0:
+            # d(softmax probs) flows only through kept entries, upscaled;
+            # delta = rowsum(dO∘O) already absorbs the mask (O is dropped)
+            keep = _keep_mask(seed_ref[0], head, q_idx * block_q,
+                              kb * block_k, block_q, block_k, dropout)
+            dp = jnp.where(keep, dp / (1.0 - dropout), 0.0)
         ds = p * (dp - delta) * scale
         return dq_acc + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
@@ -189,12 +238,13 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
     dq_ref[:] = dq.astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
-                           dk_ref, dv_ref, *, scale, causal, block_q,
-                           seq_len):
+def _flash_bwd_dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, o_ref,
+                           lse_ref, dk_ref, dv_ref, *, scale, causal,
+                           dropout, block_q, seq_len):
     # k/v: [block_k, hd]; q/do/o: [S, hd]; lse: [S, 128]
     block_k = k_ref.shape[0]
     hd = k_ref.shape[1]
+    head = pl.program_id(0)
     k_idx = pl.program_id(1)
     k = k_ref[:].astype(jnp.float32)
     v = v_ref[:].astype(jnp.float32)
@@ -218,12 +268,20 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
         p = jnp.where(jnp.isfinite(s), jnp.exp(s - lse_safe), 0.0)
-        # dv += P^T @ dO : contract over q rows
+        if dropout > 0.0:
+            keep = _keep_mask(seed_ref[0], head, qb * block_q,
+                              k_idx * block_k, block_q, block_k, dropout)
+            p_drop = jnp.where(keep, p / (1.0 - dropout), 0.0)
+        else:
+            p_drop = p
+        # dv += dropout(P)^T @ dO : contract over q rows
         dv_new = dv_acc + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p_drop, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout > 0.0:
+            dp = jnp.where(keep, dp / (1.0 - dropout), 0.0)
         ds = p * (dp - delta) * scale
         dk_new = dk_acc + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -244,7 +302,8 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
+def _flash_bwd(q, k, v, o, lse, do, seed, scale, causal, dropout, block_q,
+               block_k):
     b, nh, s, hd = q.shape
     bq = _pick_block(s, block_q)
     bk = _pick_block(s, block_k)
@@ -255,11 +314,13 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
     do3 = do.reshape(b * nh, s, hd)
 
     dq_kernel = functools.partial(_flash_bwd_dq_kernel, scale=scale,
-                                  causal=causal, block_k=bk, seq_len=s)
+                                  causal=causal, dropout=dropout,
+                                  block_k=bk, seq_len=s)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(b * nh, s // bq),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((None, bq, hd), lambda h, i: (h, i, 0)),
             pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
             pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
@@ -272,14 +333,16 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
-    )(q3, k3, v3, do3, o3, lse)
+    )(seed, q3, k3, v3, do3, o3, lse)
 
     dkdv_kernel = functools.partial(_flash_bwd_dkdv_kernel, scale=scale,
-                                    causal=causal, block_q=bq, seq_len=s)
+                                    causal=causal, dropout=dropout,
+                                    block_q=bq, seq_len=s)
     dk, dv = pl.pallas_call(
         dkdv_kernel,
         grid=(b * nh, s // bk),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
             pl.BlockSpec((None, bk, hd), lambda h, i: (h, i, 0)),
             pl.BlockSpec((None, bk, hd), lambda h, i: (h, i, 0)),
@@ -298,33 +361,46 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
-    )(q3, k3, v3, do3, o3, lse)
+    )(seed, q3, k3, v3, do3, o3, lse)
 
     return (dq.reshape(b, nh, s, hd), dk.reshape(b, nh, s, hd),
             dv.reshape(b, nh, s, hd))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, scale=None, causal=False,
-                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
-    if scale is None:
-        scale = 1.0 / math.sqrt(q.shape[-1])
-    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, seed, scale, causal, dropout, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, seed, scale, causal, dropout, block_q,
+                        block_k)
     return out
 
 
-def _fwd(q, k, v, scale, causal, block_q, block_k):
+def _fwd(q, k, v, seed, scale, causal, dropout, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, seed, scale, causal, dropout, block_q,
+                          block_k)
+    return out, (q, k, v, seed, out, lse)
+
+
+def _bwd(scale, causal, dropout, block_q, block_k, res, do):
+    q, k, v, seed, o, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, seed, scale, causal,
+                            dropout, block_q, block_k)
+    import numpy as np
+    dseed = np.zeros(seed.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dseed
+
+
+_flash.defvjp(_fwd, _bwd)
+
+
+def flash_attention(q, k, v, scale=None, causal=False,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    dropout=0.0, seed=None):
+    """Tiled attention; `dropout` drops post-softmax probs with an in-kernel
+    counter-based mask keyed on `seed` (traced int32 scalar/array ok)."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
-    return out, (q, k, v, out, lse)
-
-
-def _bwd(scale, causal, block_q, block_k, res, do):
-    q, k, v, o, lse = res
-    if scale is None:
-        scale = 1.0 / math.sqrt(q.shape[-1])
-    return _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k)
-
-
-flash_attention.defvjp(_fwd, _bwd)
+    if dropout > 0.0 and seed is None:
+        raise ValueError("flash_attention dropout requires a seed")
+    seed = jnp.asarray(0 if seed is None else seed, jnp.int32).reshape((1,))
+    return _flash(q, k, v, seed, scale, causal, float(dropout),
+                  block_q, block_k)

@@ -23,7 +23,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..framework.dtype import convert_dtype
+# device_dtype: on-device dtype policy (int64 ids live as int32 — framework/dtype.py)
+from ..framework.dtype import device_dtype as convert_dtype
 from .registry import register
 
 

@@ -23,8 +23,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..framework.dtype import convert_dtype
+# device_dtype: on-device dtype policy (int64 ids live as int32 — framework/dtype.py)
+from ..framework.dtype import device_dtype as convert_dtype
 from .registry import register, get as get_op
+from ..framework.dtype import INT64_DEVICE_DTYPE
 
 
 # ---------------------------------------------------------------------------
@@ -72,8 +74,14 @@ def _interp_sizes(ins, attrs, x, ndim_sp):
         sizes = [int(v) for v in np.asarray(osz)]
     if any(s <= 0 for s in sizes):
         scale = attrs.get("scale", 0.0)
-        scales = (list(scale) + [scale] * ndim_sp)[:ndim_sp] \
-            if isinstance(scale, (list, tuple)) else [scale] * ndim_sp
+        if isinstance(scale, (list, tuple)):
+            # a short list broadcasts its last element over the remaining
+            # spatial dims (scale=[2.0] for bilinear means 2.0 both ways)
+            if not scale:
+                raise ValueError("interp: empty scale list and no out size")
+            scales = (list(scale) + [scale[-1]] * ndim_sp)[:ndim_sp]
+        else:
+            scales = [scale] * ndim_sp
         sizes = [int(d * s) for d, s in zip(x.shape[2:], scales)]
     return sizes
 
@@ -142,6 +150,23 @@ def _bilinear_at(feat, y, x):
     return out * inb
 
 
+def _roi_batch_index(ins, n_rois, batch, slot="RoisNum"):
+    """Per-ROI image index from the RoisNum counts tensor (the LoD-free
+    batching contract, reference psroi_pool_op.cc RoisNum input). With no
+    counts and batch > 1 the mapping is ambiguous — fail loudly instead of
+    silently pooling image 0."""
+    nums = ins.get(slot, [None])[0]
+    if nums is None:
+        if batch > 1:
+            raise ValueError(
+                f"{slot} input is required when batch size > 1 "
+                f"(got batch={batch}, {n_rois} rois)")
+        return jnp.zeros((n_rois,), jnp.int32)
+    starts = jnp.cumsum(nums.reshape(-1).astype(jnp.int32))
+    return jnp.sum(jnp.arange(n_rois, dtype=jnp.int32)[:, None]
+                   >= starts[None, :], axis=1).astype(jnp.int32)
+
+
 @register("psroi_pool", nondiff_slots=("ROIs", "RoisNum"))
 def _psroi_pool(ctx, ins, attrs):
     """psroi_pool_op.cc: position-sensitive ROI average pooling — output
@@ -152,10 +177,11 @@ def _psroi_pool(ctx, ins, attrs):
     pw = attrs.get("pooled_width", 1)
     oc = attrs.get("output_channels")
     scale = attrs.get("spatial_scale", 1.0)
-    feat = x[0]   # single-image batch contract for the masked TPU lowering
+    bidx = _roi_batch_index(ins, rois.shape[0], x.shape[0])
     samples = 4
 
-    def pool_one(roi):
+    def pool_one(roi, bi):
+        feat = x[bi]
         x1, y1, x2, y2 = roi * scale
         rh = jnp.maximum(y2 - y1, 0.1) / ph
         rw = jnp.maximum(x2 - x1, 0.1) / pw
@@ -165,12 +191,12 @@ def _psroi_pool(ctx, ins, attrs):
         ys = y1 + ii * rh + (si + 0.5) * rh / samples
         xs = x1 + jj * rw + (sj + 0.5) * rw / samples
         v = _bilinear_at(feat, ys, xs).mean(axis=(-1, -2))  # [C,ph,pw]
-        co, bi, bj = jnp.meshgrid(jnp.arange(oc), jnp.arange(ph),
+        co, gi, gj = jnp.meshgrid(jnp.arange(oc), jnp.arange(ph),
                                   jnp.arange(pw), indexing="ij")
-        chan = co * (ph * pw) + bi * pw + bj
-        return v[chan, bi, bj]
+        chan = co * (ph * pw) + gi * pw + gj
+        return v[chan, gi, gj]
 
-    out = jax.vmap(pool_one)(rois.astype(x.dtype))
+    out = jax.vmap(pool_one)(rois.astype(x.dtype), bidx)
     return {"Out": [out]}
 
 
@@ -181,10 +207,12 @@ def _prroi_pool(ctx, ins, attrs):
     ph = attrs.get("pooled_height", 1)
     pw = attrs.get("pooled_width", 1)
     scale = attrs.get("spatial_scale", 1.0)
-    feat = x[0]
+    bidx = _roi_batch_index(ins, rois.shape[0], x.shape[0],
+                            slot="BatchRoINums")
     samples = 4
 
-    def pool_one(roi):
+    def pool_one(roi, bi):
+        feat = x[bi]
         x1, y1, x2, y2 = roi * scale
         rh = jnp.maximum(y2 - y1, 1e-4) / ph
         rw = jnp.maximum(x2 - x1, 1e-4) / pw
@@ -196,7 +224,7 @@ def _prroi_pool(ctx, ins, attrs):
         v = _bilinear_at(feat, ys, xs)          # [C,ph,pw,s,s]
         return v.mean(axis=(-1, -2))
 
-    out = jax.vmap(pool_one)(rois.astype(x.dtype))
+    out = jax.vmap(pool_one)(rois.astype(x.dtype), bidx)
     return {"Out": [out]}
 
 
@@ -266,7 +294,7 @@ def _random_crop(ctx, ins, attrs):
     begin = [0] * (x.ndim - nd) + starts
     sizes = list(x.shape[:-nd]) + list(shape)
     out = jax.lax.dynamic_slice(x, begin, sizes)
-    return {"Out": [out], "SeedOut": [jnp.zeros((1,), jnp.int64)]}
+    return {"Out": [out], "SeedOut": [jnp.zeros((1,), INT64_DEVICE_DTYPE)]}
 
 
 # ---------------------------------------------------------------------------
@@ -330,8 +358,8 @@ def _sample_logits(ctx, ins, attrs):
     return {"SampledLogits": [out], "Samples": [ids],
             "SampledLabels": [new_labels],
             "Probabilities": [prob],
-            "LogitsDim": [jnp.asarray(logits.shape, jnp.int64)],
-            "LabelsDim": [jnp.asarray(labels.shape, jnp.int64)]}
+            "LogitsDim": [jnp.asarray(logits.shape, INT64_DEVICE_DTYPE)],
+            "LabelsDim": [jnp.asarray(labels.shape, INT64_DEVICE_DTYPE)]}
 
 
 @register("sampling_id", is_random=True)
@@ -339,7 +367,7 @@ def _sampling_id(ctx, ins, attrs):
     x = ins["X"][0]   # [b, C] probabilities
     key = ctx.op_key(attrs)
     ids = jax.random.categorical(key, jnp.log(x + 1e-20), axis=-1)
-    return {"Out": [ids.astype(jnp.int64)]}
+    return {"Out": [ids.astype(INT64_DEVICE_DTYPE)]}
 
 
 # ---------------------------------------------------------------------------
@@ -359,7 +387,7 @@ def _hash(ctx, ins, attrs):
     mixed = flat[:, None, :] * mults[None, :, None]
     mixed = jnp.bitwise_xor(mixed, mixed >> 16)
     h = mixed.sum(-1) % jnp.uint32(mod_by)
-    return {"Out": [h.astype(jnp.int64).reshape(x.shape[0], num_hash, 1)]}
+    return {"Out": [h.astype(INT64_DEVICE_DTYPE).reshape(x.shape[0], num_hash, 1)]}
 
 
 @register("filter_by_instag", nondiff_slots=("Ins_tag", "Filter_tag"))
@@ -377,7 +405,7 @@ def _filter_by_instag(ctx, ins, attrs):
     return {"Out": [x * shaped],
             "LossWeight": [mask.reshape(-1, 1)],
             "IndexMap": [jnp.stack([jnp.arange(x.shape[0])] * 2, 1)
-                         .astype(jnp.int64)]}
+                         .astype(INT64_DEVICE_DTYPE)]}
 
 
 @register("shuffle_batch", is_random=True, nondiff_slots=("Seed",))
@@ -386,8 +414,8 @@ def _shuffle_batch(ctx, ins, attrs):
     key = ctx.op_key(attrs)
     perm = jax.random.permutation(key, x.shape[0])
     return {"Out": [x[perm]],
-            "ShuffleIdx": [perm.astype(jnp.int64)],
-            "SeedOut": [jnp.zeros((1,), jnp.int64)]}
+            "ShuffleIdx": [perm.astype(INT64_DEVICE_DTYPE)],
+            "SeedOut": [jnp.zeros((1,), INT64_DEVICE_DTYPE)]}
 
 
 @register("match_matrix_tensor")
@@ -700,22 +728,36 @@ def _fake_cw_dequantize_max_abs(ctx, ins, attrs):
 @register("fake_quantize_range_abs_max",
           stateful_outputs=("OutScales", "OutScale"))
 def _fake_quantize_range_abs_max(ctx, ins, attrs):
+    """fake_quantize_op.cc:236 FindRangeAbsMaxFunctor: keep a window_size
+    ring of per-batch abs-max scales; the effective scale is the max over
+    the live window. InScales carries the ring across steps (slot iter %
+    window holds this batch's value); Iter is the step counter tensor."""
     x = ins["X"][0]
     it = ins.get("Iter", [None])[0]
     scales = ins.get("InScales", [None])[0]
     bit = attrs.get("bit_length", 8)
     window = attrs.get("window_size", 10000)
     qmax = float(2 ** (bit - 1) - 1)
-    cur = jnp.max(jnp.abs(x))
+    cur = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    new_scales = None
     if attrs.get("is_test", False) and scales is not None:
-        scale = scales.reshape(-1)[0]
+        scale = jnp.max(scales.reshape(-1))
+        new_scales = scales.reshape(-1)  # eval must not clobber the window
+    elif it is not None and scales is not None \
+            and int(np.prod(scales.shape)) == window:
+        idx = jnp.mod(it.reshape(-1)[0].astype(jnp.int32), window)
+        new_scales = scales.reshape(-1).at[idx].set(cur)
+        scale = jnp.max(new_scales)
     else:
         scale = cur
     out = jnp.clip(jnp.round(x / jnp.maximum(scale, 1e-12) * qmax),
                    -qmax, qmax)
     res = {"Out": [out], "OutScale": [scale.reshape(1)]}
     if it is not None:
-        res["OutScales"] = [jnp.full((window,), scale, x.dtype)]
+        if new_scales is None:
+            new_scales = jnp.zeros((window,), jnp.float32).at[0].set(scale)
+        res["OutScales"] = [new_scales.astype(
+            scales.dtype if scales is not None else x.dtype)]
     return res
 
 
@@ -970,7 +1012,7 @@ def _mean_iou(ctx, ins, attrs):
     label = ins["Labels"][0].reshape(-1)
     n = attrs["num_classes"]
     idx = label * n + pred
-    cm = jnp.zeros((n * n,), jnp.int64).at[idx].add(1).reshape(n, n)
+    cm = jnp.zeros((n * n,), INT64_DEVICE_DTYPE).at[idx].add(1).reshape(n, n)
     inter = jnp.diagonal(cm).astype(jnp.float32)
     union = (cm.sum(0) + cm.sum(1)).astype(jnp.float32) - inter
     valid = union > 0
@@ -1051,7 +1093,7 @@ def _chunk_eval(ctx, ins, attrs):
 
     specs = (jax.ShapeDtypeStruct((), jnp.float32),) * 3 + \
         (jax.ShapeDtypeStruct((), jnp.int32),) * 3
-    sl_arg = sl if sl is not None else jnp.zeros((0,), jnp.int64)
+    sl_arg = sl if sl is not None else jnp.zeros((0,), INT64_DEVICE_DTYPE)
     p, r, f1, ni, nl, nc = jax.pure_callback(
         lambda a, b_, c: host_eval(a, b_, c if c.size else None),
         specs, inf, lab, sl_arg)
@@ -1126,8 +1168,12 @@ def _lstmp(ctx, ins, attrs):
 
 def _alias(new, old, slot_map=None):
     target = get_op(old)
+    # nondiff bookkeeping runs on the aliased op's OWN slot names: map the
+    # target's nondiff slots back through the (v1 name -> v2 name) slot_map
+    inv = {v: k for k, v in (slot_map or {}).items()}
+    nondiff = tuple(inv.get(s, s) for s in target.nondiff_slots)
 
-    @register(new, nondiff_slots=tuple(target.nondiff_slots),
+    @register(new, nondiff_slots=nondiff,
               stateful_outputs=tuple(target.stateful_outputs))
     def _fwd(ctx, ins, attrs, _t=target, _m=slot_map):
         if _m:
@@ -1138,5 +1184,6 @@ def _alias(new, old, slot_map=None):
 
 _alias("write_to_array", "array_write")
 _alias("read_from_array", "array_read")
-_alias("expand_as", "expand_as_v2")
+# v1 feeds the broadcast target via slot 'target_tensor' (expand_as_op.cc:28)
+_alias("expand_as", "expand_as_v2", slot_map={"target_tensor": "Y"})
 _alias("multiclass_nms2", "multiclass_nms")

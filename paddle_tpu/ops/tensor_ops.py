@@ -12,7 +12,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .registry import register
-from ..framework.dtype import convert_dtype
+from ..framework.dtype import INT64_DEVICE_DTYPE
+# device_dtype: on-device dtype policy (int64 ids live as int32 — framework/dtype.py)
+from ..framework.dtype import device_dtype as convert_dtype
 
 
 @register("fill_constant")
@@ -295,7 +297,7 @@ def _top_k(ctx, ins, attrs):
     x = ins["X"][0]
     k = attrs.get("k", 1)
     vals, idxs = jax.lax.top_k(x, k)
-    return {"Out": [vals], "Indices": [idxs.astype(jnp.int64)]}
+    return {"Out": [vals], "Indices": [idxs.astype(INT64_DEVICE_DTYPE)]}
 
 
 @register("top_k_v2", nondiff_slots=())
@@ -309,7 +311,7 @@ def _top_k_v2(ctx, ins, attrs):
     if axis not in (-1, x.ndim - 1):
         vals = jnp.moveaxis(vals, -1, axis)
         idxs = jnp.moveaxis(idxs, -1, axis)
-    return {"Out": [vals], "Indices": [idxs.astype(jnp.int64)]}
+    return {"Out": [vals], "Indices": [idxs.astype(INT64_DEVICE_DTYPE)]}
 
 
 @register("arg_max", nondiff_slots=("X",))
@@ -326,7 +328,7 @@ def _arg_max(ctx, ins, attrs):
 def _arg_min(ctx, ins, attrs):
     x = ins["X"][0]
     out = jnp.argmin(x, axis=attrs.get("axis", -1))
-    return {"Out": [out.astype(jnp.int64)]}
+    return {"Out": [out.astype(INT64_DEVICE_DTYPE)]}
 
 
 @register("argsort", nondiff_slots=())
@@ -336,7 +338,7 @@ def _argsort(ctx, ins, attrs):
     desc = attrs.get("descending", False)
     idx = jnp.argsort(-x if desc else x, axis=axis)
     out = jnp.take_along_axis(x, idx, axis=axis)
-    return {"Out": [out], "Indices": [idx.astype(jnp.int64)]}
+    return {"Out": [out], "Indices": [idx.astype(INT64_DEVICE_DTYPE)]}
 
 
 @register("where", nondiff_slots=("Condition",))
@@ -348,7 +350,7 @@ def _where(ctx, ins, attrs):
 def _where_index(ctx, ins, attrs):
     # Dynamic output shape — only usable outside jit (eager/dygraph mode).
     cond = ins["Condition"][0]
-    return {"Out": [jnp.stack(jnp.nonzero(cond), axis=-1).astype(jnp.int64)]}
+    return {"Out": [jnp.stack(jnp.nonzero(cond), axis=-1).astype(INT64_DEVICE_DTYPE)]}
 
 
 @register("masked_select", nondiff_slots=("Mask",))
@@ -414,7 +416,7 @@ def _unique(ctx, ins, attrs):
     # Dynamic shape — eager only.
     x = ins["X"][0]
     u, inv = jnp.unique(x, return_inverse=True)
-    return {"Out": [u], "Index": [inv.astype(jnp.int64)]}
+    return {"Out": [u], "Index": [inv.astype(INT64_DEVICE_DTYPE)]}
 
 
 @register("increment")

@@ -282,3 +282,23 @@ def test_static_nested_if_in_while_parity():
     eager = _run_eager(body, x_np)
     for s, e in zip(static, eager):
         np.testing.assert_allclose(s, e, rtol=1e-6)
+
+
+def test_one_sided_unread_assignment_allowed():
+    """A name assigned in only one branch and never read afterwards must not
+    flow UNDEF into the cond merge (the reference's UndefinedVar only errors
+    on a real read). The read result `y` is two-sided and carried."""
+    def f(x):
+        s = layers.reduce_sum(x)
+        if s > 0:
+            scratch = s + 1.0      # one-sided, never read again
+            y = x * 2.0
+        else:
+            y = x - 1.0
+        return (y,)
+
+    for fill in (2.0, -2.0):
+        x_np = np.full((2, 4), fill, np.float32)
+        static = _run_static(f, x_np)
+        eager = _run_eager(f, x_np)
+        np.testing.assert_allclose(static[0], eager[0], rtol=1e-6)

@@ -88,3 +88,18 @@ def test_save_load_persistables(tmp_path):
     fluid.io.load_persistables(exe, str(tmp_path), fluid.default_main_program())
     np.testing.assert_allclose(paddle.global_scope().numpy("w_state"),
                                np.full(4, 2.0))
+
+
+def test_int64_feed_overflow_guard():
+    """int64 ids live as int32 on device (framework/dtype.py policy): in-range
+    int64 feeds cast silently; out-of-range ids raise instead of truncating."""
+    import pytest
+    ids = fluid.layers.data(name="big_ids", shape=[4], dtype="int64")
+    out = fluid.layers.cast(ids, "float32")
+    exe = fluid.Executor()
+    ok = np.array([[1, 2, 3, 2**31 - 1]], np.int64)
+    res, = exe.run(feed={"big_ids": ok}, fetch_list=[out])
+    np.testing.assert_allclose(res, ok.astype(np.float32))
+    bad = np.array([[1, 2, 3, 2**31 + 7]], np.int64)
+    with pytest.raises(ValueError, match="int32 range"):
+        exe.run(feed={"big_ids": bad}, fetch_list=[out])

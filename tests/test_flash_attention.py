@@ -60,6 +60,80 @@ def test_flash_fwd_bwd_matches_dense(causal, seq, block_q, block_k):
             err_msg=f"d{name} mismatch (causal={causal}, seq={seq})")
 
 
+def test_flash_dropout_mask_semantics():
+    """v = I recovers the dropped prob matrix: check drop rate, upscale
+    factor, determinism per seed, and dropout=0 == plain path."""
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+    rng = np.random.RandomState(0)
+    S = hd = 128
+    rate = 0.1
+    q = jnp.asarray(rng.randn(1, 2, S, hd).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(1, 2, S, hd).astype(np.float32)) * 0.3
+    v_eye = jnp.broadcast_to(jnp.eye(S, dtype=jnp.float32), (1, 2, S, S))
+
+    out = flash_attention(q, k, v_eye, 1.0, False, 128, 128,
+                          dropout=rate, seed=42)
+    pd = np.asarray(out)
+    probs = np.asarray(jax.nn.softmax(
+        jnp.einsum("bnqd,bnkd->bnqk", q, k), axis=-1))
+    mask = pd != 0
+    assert abs((1 - mask.mean()) - rate) < 0.02, "drop fraction off"
+    ratio = pd[mask] / probs[mask]
+    np.testing.assert_allclose(ratio, 1.0 / (1 - rate), rtol=1e-5)
+
+    out2 = flash_attention(q, k, v_eye, 1.0, False, 128, 128,
+                           dropout=rate, seed=42)
+    assert bool(jnp.all(out == out2)), "same seed must reproduce"
+    out3 = flash_attention(q, k, v_eye, 1.0, False, 128, 128,
+                           dropout=rate, seed=43)
+    assert bool(jnp.any(out != out3)), "different seed must differ"
+    plain = flash_attention(q, k, v_eye, 1.0, False, 128, 128)
+    zero = flash_attention(q, k, v_eye, 1.0, False, 128, 128,
+                           dropout=0.0, seed=7)
+    assert bool(jnp.all(plain == zero))
+
+
+def test_flash_dropout_grads_match_dense_with_same_mask():
+    """The in-kernel mask depends only on (seed, head, positions), so recover
+    it via uniform probs + v=I, then check fwd and all three grads against a
+    dense implementation using that exact mask."""
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+    rng = np.random.RandomState(1)
+    S = hd = 128
+    rate, seed = 0.15, 7
+    q = jnp.asarray(rng.randn(2, 2, S, hd).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(2, 2, S, hd).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(2, 2, S, hd).astype(np.float32))
+    v_eye = jnp.broadcast_to(jnp.eye(S, dtype=jnp.float32), (2, 2, S, S))
+
+    pd = flash_attention(jnp.zeros_like(q), jnp.zeros_like(k), v_eye,
+                         1.0, False, 128, 128, dropout=rate, seed=seed)
+    keep = jnp.asarray(np.asarray(pd) != 0)
+
+    def dense(q, k, v):
+        p = jax.nn.softmax(
+            jnp.einsum("bnqd,bnkd->bnqk", q, k) * (hd ** -0.5), axis=-1)
+        return jnp.einsum("bnqk,bnkd->bnqd",
+                          jnp.where(keep, p / (1 - rate), 0.0), v)
+
+    def flash(q, k, v):
+        return flash_attention(q, k, v, None, False, 128, 128,
+                               dropout=rate, seed=seed)
+
+    cot = jnp.asarray(rng.randn(2, 2, S, hd).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(flash(q, k, v)),
+                               np.asarray(dense(q, k, v)),
+                               atol=2e-5, rtol=2e-5)
+    gf = jax.grad(lambda *a: jnp.vdot(flash(*a), cot), (0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda *a: jnp.vdot(dense(*a), cot), (0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name} mismatch")
+
+
 def test_flash_bf16_grads_finite():
     from paddle_tpu.ops.pallas.flash_attention import flash_attention
 

@@ -1,0 +1,198 @@
+"""LoD rank-table + dynamic-RNN memory ops (reference
+lod_tensor_to_array_op.cc:1, shrink_rnn_memory_op.cc:1,
+split_lod_tensor_op.cc, merge_lod_tensor_op.cc; python surface
+fluid/layers/control_flow.py:104,157,1231,1298,1323,1375,1997).
+
+TPU contract under test: padded [B, T, ...] + explicit length vector; rank
+order = stable desc-length; dead rows are zeros (static shapes)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+R = np.random.RandomState(0)
+B, T, H = 4, 5, 3
+LENS = np.array([3, 5, 1, 4], np.int32)     # rank order: 1, 3, 0, 2
+
+
+def _build():
+    from paddle_tpu.testing import reset_programs
+    reset_programs(seed=0)
+    x = layers.data(name="x", shape=[T, H], dtype="float32")
+    ln = layers.data(name="ln", shape=[1], dtype="int32")
+    table = layers.lod_rank_table(x, length=ln)
+    return x, ln, table
+
+
+def test_rank_table_and_max_len():
+    x, ln, table = _build()
+    mx = layers.max_sequence_len(table)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xv = R.randn(B, T, H).astype(np.float32)
+    tb, m = exe.run(feed={"x": xv, "ln": LENS}, fetch_list=[table, mx])
+    np.testing.assert_array_equal(tb, [[1, 5], [3, 4], [0, 3], [2, 1]])
+    assert int(m[0]) == 5
+
+
+def test_rank_table_stable_ties():
+    x, ln, table = _build()
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xv = np.zeros((B, T, H), np.float32)
+    tb, = exe.run(feed={"x": xv, "ln": np.array([2, 3, 2, 3], np.int32)},
+                  fetch_list=[table])
+    # equal lengths keep original order (reference std::stable_sort)
+    np.testing.assert_array_equal(tb[:, 0], [1, 3, 0, 2])
+
+
+def test_lod_tensor_to_array_roundtrip_ragged():
+    """to_array then back: original order restored, zeros past each length."""
+    x, ln, table = _build()
+    arr = layers.lod_tensor_to_array(x, table)
+    back = layers.array_to_lod_tensor(arr, table)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xv = R.randn(B, T, H).astype(np.float32)
+    bk, = exe.run(feed={"x": xv, "ln": LENS}, fetch_list=[back])
+    ref = xv.copy()
+    for s in range(B):
+        ref[s, LENS[s]:] = 0
+    np.testing.assert_allclose(bk, ref, rtol=1e-6)
+
+
+def test_array_slots_are_rank_ordered_and_masked():
+    """Slot t holds token t of alive sequences in rank order, dead rows 0."""
+    x, ln, table = _build()
+    arr = layers.lod_tensor_to_array(x, table)
+    i = layers.fill_constant([1], "int32", 3)
+    slot3 = layers.array_read(arr, i)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xv = R.randn(B, T, H).astype(np.float32)
+    s3, = exe.run(feed={"x": xv, "ln": LENS}, fetch_list=[slot3])
+    # step 3 alive: lens 5 (seq1), 4 (seq3) → 2 rows
+    np.testing.assert_allclose(s3[0], xv[1, 3], rtol=1e-6)
+    np.testing.assert_allclose(s3[1], xv[3, 3], rtol=1e-6)
+    np.testing.assert_allclose(s3[2:], 0.0)
+
+
+def test_shrink_rnn_memory_masks_dead_rows():
+    x, ln, table = _build()
+    mem = layers.data(name="mem", shape=[H], dtype="float32")
+    i = layers.fill_constant([1], "int32", 2)
+    shr = layers.shrink_memory(mem, i, table)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xv = R.randn(B, T, H).astype(np.float32)
+    memv = R.randn(B, H).astype(np.float32)
+    sh, = exe.run(feed={"x": xv, "ln": LENS, "mem": memv},
+                  fetch_list=[shr])
+    # step 2: 3 sequences alive (lens 5,4,3) → first 3 rows kept AS-IS
+    # (memory is already in rank space in a dynamic RNN; no reorder)
+    exp = memv.copy()
+    exp[3:] = 0
+    np.testing.assert_allclose(sh, exp, rtol=1e-6)
+
+
+def test_split_merge_lod_tensor_roundtrip():
+    from paddle_tpu.testing import reset_programs
+    reset_programs(seed=0)
+    mem = layers.data(name="mem", shape=[H], dtype="float32")
+    msk = layers.data(name="msk", shape=[1], dtype="int32")
+    t_out, f_out = layers.split_lod_tensor(mem, msk)
+    merged = layers.merge_lod_tensor(t_out, f_out, mem, msk)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    memv = R.randn(B, H).astype(np.float32)
+    mv = np.array([[1], [0], [1], [0]], np.int32)
+    tt, ff, mg = exe.run(feed={"mem": memv, "msk": mv},
+                         fetch_list=[t_out, f_out, merged])
+    np.testing.assert_allclose(tt[:2], memv[[0, 2]], rtol=1e-6)
+    np.testing.assert_allclose(tt[2:], 0.0)
+    np.testing.assert_allclose(ff[:2], memv[[1, 3]], rtol=1e-6)
+    np.testing.assert_allclose(mg, memv, rtol=1e-6)
+
+
+def test_dynamic_rnn_ragged_parity():
+    """Book-style dynamic RNN over ragged batches: simple accumulator RNN
+    h_t = tanh(W x_t + U h_{t-1}) run step-wise in rank space via
+    lod_tensor_to_array / shrink_memory / array_write, reassembled with
+    array_to_lod_tensor — checked against a per-sequence numpy loop (true
+    ragged semantics, the reference test_machine_translation pattern)."""
+    from paddle_tpu.testing import reset_programs
+    reset_programs(seed=0)
+    np.random.seed(1)
+    W = np.random.randn(H, H).astype(np.float32) * 0.5
+    U = np.random.randn(H, H).astype(np.float32) * 0.5
+
+    x = layers.data(name="x", shape=[T, H], dtype="float32")
+    ln = layers.data(name="ln", shape=[1], dtype="int32")
+    table = layers.lod_rank_table(x, length=ln)
+    arr = layers.lod_tensor_to_array(x, table)
+    from paddle_tpu.initializer import NumpyArrayInitializer
+    w = layers.create_parameter([H, H], "float32", name="rnn_W",
+                                default_initializer=NumpyArrayInitializer(W))
+    u = layers.create_parameter([H, H], "float32", name="rnn_U",
+                                default_initializer=NumpyArrayInitializer(U))
+
+    out_arr = layers.create_array("float32", element_shape=[B, H],
+                                  capacity=T)
+    h = layers.fill_constant([B, H], "float32", 0.0)
+    for t in range(T):      # static unroll; shrink masks the dead rows
+        i = layers.fill_constant([1], "int32", t)
+        xt = layers.array_read(arr, i)
+        h_alive = layers.shrink_memory(h, i, table)
+        new_h = layers.tanh(
+            layers.elementwise_add(layers.matmul(xt, w),
+                                   layers.matmul(h_alive, u)))
+        # dead rows: keep 0 (their xt is 0 and h_alive is 0 → tanh(0)=0 ✓)
+        alive_mask = layers.cast(
+            layers.less_than(
+                layers.fill_constant([B, 1], "int32", t),
+                layers.reshape(layers.slice(table, [1], [1], [2]), [B, 1])),
+            "float32")
+        h = layers.elementwise_mul(new_h, alive_mask)
+        layers.array_write(h, i, array=out_arr)
+    rnn_out = layers.array_to_lod_tensor(out_arr, table)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xv = np.random.randn(B, T, H).astype(np.float32)
+    got, = exe.run(feed={"x": xv, "ln": LENS}, fetch_list=[rnn_out])
+
+    # numpy ragged reference, per sequence
+    ref = np.zeros((B, T, H), np.float32)
+    for s in range(B):
+        hh = np.zeros(H, np.float32)
+        for t in range(LENS[s]):
+            hh = np.tanh(xv[s, t] @ W + hh @ U)
+            ref[s, t] = hh
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_array_to_lod_tensor_trims_default_capacity():
+    """An array built by plain array_write (default 128-slot capacity) must
+    come back as [B, T, ...], not [B, capacity, ...]."""
+    from paddle_tpu.testing import reset_programs
+    reset_programs(seed=0)
+    x = layers.data(name="x", shape=[T, H], dtype="float32")
+    ln = layers.data(name="ln", shape=[1], dtype="int32")
+    table = layers.lod_rank_table(x, length=ln)
+    arr = None
+    for t in range(T):
+        i = layers.fill_constant([1], "int32", t)
+        xt = layers.fill_constant([B, H], "float32", float(t + 1))
+        arr = layers.array_write(xt, i, array=arr)
+    out = layers.array_to_lod_tensor(arr, table, max_len=T)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xv = np.zeros((B, T, H), np.float32)
+    got, = exe.run(feed={"x": xv, "ln": LENS}, fetch_list=[out])
+    assert got.shape == (B, T, H), got.shape
+    # row s: values 1..len(s) then zeros (slot t is constant t+1)
+    for s in range(B):
+        for t in range(LENS[s]):
+            np.testing.assert_allclose(got[s, t], t + 1)
+        np.testing.assert_allclose(got[s, LENS[s]:], 0.0)

@@ -76,6 +76,42 @@ def test_psroi_pool():
     assert out["Out"][0].shape == (1, oc, ph, pw)
 
 
+def test_psroi_pool_batched_rois_num():
+    """With batch N>1, each ROI must pool from ITS image (RoisNum routing),
+    not image 0."""
+    oc, ph, pw = 2, 2, 2
+    x0 = np.full((oc * ph * pw, 8, 8), 1.0, np.float32)
+    x1 = np.full((oc * ph * pw, 8, 8), 3.0, np.float32)
+    x = np.stack([x0, x1])
+    rois = np.array([[0, 0, 7, 7], [0, 0, 7, 7]], np.float32)
+    nums = np.array([1, 1], np.int32)
+    out = run_op("psroi_pool",
+                 {"X": [x], "ROIs": [rois], "RoisNum": [nums]},
+                 {"pooled_height": ph, "pooled_width": pw,
+                  "output_channels": oc, "spatial_scale": 1.0})
+    got = np.asarray(out["Out"][0])
+    np.testing.assert_allclose(got[0], 1.0, atol=1e-5)
+    np.testing.assert_allclose(got[1], 3.0, atol=1e-5)
+    with pytest.raises(ValueError, match="RoisNum"):
+        run_op("psroi_pool", {"X": [x], "ROIs": [rois]},
+               {"pooled_height": ph, "pooled_width": pw,
+                "output_channels": oc, "spatial_scale": 1.0})
+
+
+def test_prroi_pool_batched_rois():
+    x = np.stack([np.full((3, 8, 8), 5.0, np.float32),
+                  np.full((3, 8, 8), 9.0, np.float32)])
+    rois = np.array([[1, 1, 6, 6], [1, 1, 6, 6]], np.float32)
+    nums = np.array([1, 1], np.int32)
+    out = run_op("prroi_pool",
+                 {"X": [x], "ROIs": [rois], "BatchRoINums": [nums]},
+                 {"pooled_height": 2, "pooled_width": 2,
+                  "spatial_scale": 1.0})
+    got = np.asarray(out["Out"][0])
+    np.testing.assert_allclose(got[0], 5.0, atol=1e-5)
+    np.testing.assert_allclose(got[1], 9.0, atol=1e-5)
+
+
 def test_prroi_pool_constant_region():
     x = np.full((1, 3, 8, 8), 5.0, np.float32)
     rois = np.array([[1, 1, 6, 6]], np.float32)
@@ -354,6 +390,50 @@ def test_fake_quantize_abs_max():
                   "Scale": [np.array([scale], np.float32)]},
                  {"max_range": 127.0})
     np.testing.assert_allclose(np.asarray(deq["Out"][0]), x, atol=scale/100)
+
+
+def test_fake_quantize_range_abs_max_window():
+    """FindRangeAbsMaxFunctor semantics (fake_quantize_op.cc:236): the scale
+    is the running max over a window_size ring of per-batch abs-maxes, and
+    the ring persists across steps via InScales/OutScales."""
+    window = 4
+    scales = np.zeros(window, np.float32)
+    seen = []
+    for step, amp in enumerate([2.0, 8.0, 1.0, 0.5, 0.25, 0.125]):
+        x = np.array([[amp, -amp / 2]], np.float32)
+        out = run_op("fake_quantize_range_abs_max",
+                     {"X": [x], "Iter": [np.array([step], np.int64)],
+                      "InScales": [scales]},
+                     {"bit_length": 8, "window_size": window})
+        scales = np.asarray(out["OutScales"][0])
+        seen.append(amp)
+        live = seen[-window:] + [0.0] * (window - len(seen))
+        np.testing.assert_allclose(np.asarray(out["OutScale"][0]),
+                                   [max(live)], rtol=1e-6)
+    # after 6 steps the window holds steps 2..5: the early 8.0 max evicted
+    assert abs(float(scales.max()) - 1.0) < 1e-6
+    # eval (is_test) reads the window max but must NOT clobber the ring
+    ev = run_op("fake_quantize_range_abs_max",
+                {"X": [np.array([[99.0]], np.float32)],
+                 "Iter": [np.array([6], np.int64)], "InScales": [scales]},
+                {"bit_length": 8, "window_size": window, "is_test": True})
+    np.testing.assert_allclose(np.asarray(ev["OutScales"][0]), scales)
+    np.testing.assert_allclose(np.asarray(ev["OutScale"][0]),
+                               [scales.max()], rtol=1e-6)
+
+
+def test_interp_scalar_scale_list_broadcasts():
+    x = R.randn(1, 2, 4, 6).astype(np.float32)
+    out = run_op("bilinear_interp_v2", {"X": [x]}, {"scale": [2.0]})
+    assert out["Out"][0].shape == (1, 2, 8, 12)
+
+
+def test_expand_as_v1_target_tensor_slot():
+    x = np.array([[1.0], [2.0]], np.float32)
+    tgt = np.zeros((2, 3), np.float32)
+    out = run_op("expand_as", {"X": [x], "target_tensor": [tgt]}, {})
+    np.testing.assert_allclose(np.asarray(out["Out"][0]),
+                               [[1, 1, 1], [2, 2, 2]])
 
 
 def test_fake_channel_wise_quantize():
