@@ -30,39 +30,81 @@ def _fresh_programs():
     reset_programs(seed=0)
 
 
-def _backend_ready(attempts=5, base_delay=10.0):
-    """Force backend init, retrying transient TPU-grant failures.
+def _backend_ready(attempts=4, probe_timeout=150.0, base_delay=15.0):
+    """Force backend init, surviving BOTH failure modes seen in rounds 2-3:
 
-    Round 2 lost its entire perf recording to one 'Unable to initialize
-    backend axon: UNAVAILABLE' — retry with backoff (~3 min total) before
-    giving up, and reset jax's backend cache between tries so a failed init
-    isn't sticky.
+    * 'Unable to initialize backend axon: UNAVAILABLE' raised quickly
+      (round 2) — retry with backoff, clearing jax's backend cache so a
+      cpu-only partial init isn't sticky.
+    * the claim leg inside the PJRT plugin BLOCKING FOREVER in a
+      nanosleep bind loop (round 3, wedged tunnel after a killed holder) —
+      jax.devices() never returns, so probe in a KILLABLE subprocess with
+      a hard timeout before dialing in-process.
     """
-    import jax
+    import subprocess
     last = None
     for i in range(attempts):
         try:
-            devs = jax.devices()
-            want = os.environ.get("JAX_PLATFORMS", "")
-            if want and want != "cpu" \
-                    and all(d.platform == "cpu" for d in devs):
+            # Popen + SIGTERM-first: subprocess.run would SIGKILL on
+            # timeout, and a probe killed mid-claim while holding the one
+            # axon grant manufactures the very wedge being probed for
+            proc = subprocess.Popen(
+                [sys.executable, "-c",
+                 "import jax; d=jax.devices(); "
+                 "print(d[0].platform, len(d))"],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+            try:
+                out_s, err_s = proc.communicate(timeout=probe_timeout)
+            except subprocess.TimeoutExpired:
+                proc.terminate()          # let it release the tunnel grant
+                try:
+                    proc.communicate(timeout=15)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.communicate()
+                raise
+            probe = subprocess.CompletedProcess(
+                proc.args, proc.returncode, out_s, err_s)
+            if probe.returncode != 0:
                 raise RuntimeError(
-                    f"JAX_PLATFORMS={want} but only cpu devices came up")
-            return None
-        except Exception as e:  # RuntimeError from xla_bridge
+                    f"probe rc={probe.returncode}: "
+                    f"{(probe.stderr or '').strip()[-300:]}")
+            plat = (probe.stdout.split() or ["?"])[0]
+            want = os.environ.get("JAX_PLATFORMS", "")
+            if want and want != "cpu" and plat == "cpu":
+                raise RuntimeError(
+                    f"JAX_PLATFORMS={want} but probe saw only cpu")
+        except subprocess.TimeoutExpired:
+            last = RuntimeError(
+                f"backend probe hung >{probe_timeout:.0f}s "
+                f"(wedged TPU claim — see axon notes)")
+            print(f"attempt {i + 1}/{attempts}: {last}", file=sys.stderr)
+            if i + 1 < attempts:
+                time.sleep(min(base_delay * (2 ** i), 90.0))
+            continue
+        except Exception as e:
             last = e
             print(f"backend init attempt {i + 1}/{attempts} failed: {e!r}",
                   file=sys.stderr)
+            if i + 1 < attempts:
+                time.sleep(min(base_delay * (2 ** i), 90.0))
+            continue
+        # probe OK: init in-process (should be fast — the pool answered)
+        try:
+            import jax
+            jax.devices()
+            return None
+        except Exception as e:
+            last = e
+            print(f"in-process init failed after OK probe: {e!r}",
+                  file=sys.stderr)
             try:
-                # a failed init can leave _backends partially populated
-                # (cpu only) — the NEXT call would then silently return cpu;
-                # clear so the retry re-dials the TPU plugin
                 from jax._src import xla_bridge as xb
                 xb._clear_backends()
             except Exception:
                 pass
             if i + 1 < attempts:
-                time.sleep(min(base_delay * (2 ** i), 60.0))
+                time.sleep(min(base_delay * (2 ** i), 90.0))
     return last
 
 

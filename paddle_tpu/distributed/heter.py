@@ -1,0 +1,153 @@
+"""Heterogeneous parameter-server training: host-CPU sections around the
+device step.
+
+Reference counterparts: HeterXpuTrainer (framework/trainer.h:162),
+HeterCpuWorker (framework/device_worker.h:349), and the activation/grad
+shuttle in framework/fleet/heter_wrapper.h. There, CPU workers own the
+sparse/embedding front of the model and accelerator workers own the dense
+tail; per microbatch the CPU side runs its section forward, ships the cut
+activation to the device worker, receives the cut gradient back, and runs
+its section backward + sparse update.
+
+TPU-native shape (this module): the same section split over the existing
+host collectives transport (distributed/gloo.py TCP rounds — the kvstore
+transport's sibling; both are loopback-TCP tested the way the reference
+tests its RPC stack without a cluster):
+
+* ``HeterSection`` — the host-resident front section: an embedding table
+  with its own SGD. Runs in the heter CPU worker PROCESS (not just a host
+  thread of the trainer — true process separation like the reference's
+  distinct trainer roles).
+* ``HeterWorker`` — the CPU worker loop: receive ids → section forward →
+  send activation → receive activation grad → section backward/update.
+* ``HeterTrainer`` — the device-side driver: it feeds the received
+  activation into the dense program as a data var, fetches the
+  activation's gradient after the device step, and ships it back.
+
+Exchange protocol: one 2-rank gloo round per phase (ids, act, act_grad) —
+trainer is rank 0 and owns the store; the worker connects by port. Each
+phase is an ``all_gather`` where the non-owning side contributes None.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .gloo import Gloo
+
+_STOP = "__heter_stop__"
+
+
+class HeterSection:
+    """Host-resident model front: embedding lookup + SGD update.
+
+    The reference's HeterCpuWorker runs ops listed in its section config
+    (device_worker.h:349); here the canonical sparse front — an embedding
+    table — is implemented directly with numpy (host CPU is the point:
+    these FLOPs deliberately never touch the device).
+    """
+
+    def __init__(self, vocab: int, dim: int, lr: float = 0.1,
+                 seed: int = 0):
+        rng = np.random.RandomState(seed)
+        self.table = (rng.uniform(-0.1, 0.1, (vocab, dim))
+                      .astype(np.float32))
+        self.lr = lr
+
+    def forward(self, ids: np.ndarray) -> np.ndarray:
+        return self.table[ids]                      # [B, S, D]
+
+    def backward(self, ids: np.ndarray, act_grad: np.ndarray) -> None:
+        flat = ids.reshape(-1)
+        g = act_grad.reshape(len(flat), -1)
+        np.add.at(self.table, flat, -self.lr * g)   # scatter SGD
+
+
+class HeterWorker:
+    """The heter CPU worker loop (HeterCpuWorker, device_worker.h:349)."""
+
+    def __init__(self, section: HeterSection, store_addr: str):
+        self.section = section
+        self.gloo = Gloo(rank=1, world_size=2, store_addr=store_addr)
+
+    def run(self) -> int:
+        """Serve until the trainer sends the stop token; returns #steps."""
+        steps = 0
+        while True:
+            ids = self.gloo.all_gather(None)[0]     # phase 1: receive ids
+            if isinstance(ids, str) and ids == _STOP:
+                break
+            act = self.section.forward(np.asarray(ids))
+            self.gloo.all_gather(act)               # phase 2: send act
+            grad = self.gloo.all_gather(None)[0]    # phase 3: receive dAct
+            self.section.backward(np.asarray(ids), np.asarray(grad))
+            steps += 1
+        self.gloo.close()
+        return steps
+
+
+def materialize_cut_gradient(loss_var, act_var) -> str:
+    """Append d(loss)/d(act) ops for the heter cut activation and return the
+    grad var name. The optimizer backward only covers the parameter closure
+    (act is a fed var, outside it), so the cut needs its own grad request.
+    gradients() appends at the block end — AFTER any optimizer update ops,
+    where the vjp would read post-update weights — so the new ops are
+    spliced to just before the first Optimize-role op: the activation grad
+    is taken at the same weights as the step's own backward."""
+    block = loss_var.block
+    act_name = act_var if isinstance(act_var, str) else act_var.name
+    act = block.var(act_name)
+    from ..framework.backward import gradients
+    from ..framework.program import OpRole
+    n0 = len(block.ops)
+    grad = gradients(loss_var, [act])[0]
+    if grad is None:
+        raise ValueError(
+            f"no gradient path from {loss_var.name!r} to {act_name!r} — is "
+            f"stop_gradient unset on the cut activation var?")
+    first_opt = next((i for i, op in enumerate(block.ops[:n0])
+                      if op.attrs.get("op_role", 0) & OpRole.Optimize),
+                     None)
+    if first_opt is not None:
+        appended = block.ops[n0:]
+        del block.ops[n0:]
+        block.ops[first_opt:first_opt] = appended
+        block.program.bump_version()
+    return grad if isinstance(grad, str) else grad.name
+
+
+class HeterTrainer:
+    """Device-side driver (HeterXpuTrainer, trainer.h:162): runs the dense
+    program on the device with the host section's activation as input."""
+
+    def __init__(self, exe, program, act_var, loss_var, feed_extra=None,
+                 port: int = 0):
+        self.exe = exe
+        self.program = program
+        self.act_name = act_var if isinstance(act_var, str) else act_var.name
+        self.loss = loss_var
+        self.feed_extra = feed_extra or {}
+        self.act_grad_name = materialize_cut_gradient(loss_var, self.act_name)
+        self.gloo = Gloo(rank=0, world_size=2, port=port)
+
+    @property
+    def worker_addr(self) -> str:
+        return f"127.0.0.1:{self.gloo.store_port}"
+
+    def step(self, ids: np.ndarray, feed: dict) -> float:
+        """One heter train step: ship ids, get the host activation, run the
+        device fwd+bwd, ship the activation grad back."""
+        self.gloo.all_gather(np.asarray(ids))                # phase 1
+        act = np.asarray(self.gloo.all_gather(None)[1])      # phase 2
+        full_feed = dict(feed)
+        full_feed[self.act_name] = act
+        loss_v, grad_v = self.exe.run(
+            program=self.program, feed=full_feed,
+            fetch_list=[self.loss, self.act_grad_name])
+        self.gloo.all_gather(np.asarray(grad_v))             # phase 3
+        return float(np.asarray(loss_v))
+
+    def shutdown(self) -> None:
+        self.gloo.all_gather(_STOP)
+        self.gloo.close()

@@ -580,23 +580,88 @@ class Executor:
                            thread=0, debug=False, fetch_list=None,
                            fetch_info=None, print_period=100):
         """Drain one epoch of a fluid.dataset through the jitted train step
-        (reference executor.py:1598 -> TrainerFactory/MultiTrainer threads;
-        here the native data plane feeds the single fused XLA program)."""
+        (reference executor.py:1598 -> TrainerFactory/MultiTrainer threads).
+
+        The data plane OVERLAPS the device: a producer thread iterates the
+        dataset (MultiSlot parse/pack runs there) into a bounded queue while
+        the main thread dispatches steps with device-resident fetches —
+        jax dispatch is async, so step N computes while batch N+1 parses.
+        This is the reference Trainer/DeviceWorker design's purpose
+        (trainer.h:51: keep the device busy) in two threads + XLA async
+        dispatch instead of a DeviceWorker pool."""
         assert dataset is not None, "train_from_dataset needs a dataset"
+        import queue as _queue
+        import threading
+
         program = program or default_main_program()
         fetch_list = fetch_list or []
+        q: "_queue.Queue" = _queue.Queue(maxsize=4)
+        _END = object()
+        err = []
+        stop = threading.Event()
+
+        def _produce():
+            try:
+                for feed in dataset:
+                    while not stop.is_set():
+                        try:
+                            q.put(feed, timeout=0.2)
+                            break
+                        except _queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            except BaseException as e:   # surface parse errors in the main
+                err.append(e)            # thread, not a dead daemon
+            finally:
+                # the sentinel must not be lost when the queue is full and
+                # the consumer is still draining — block until it fits (or
+                # the consumer has signalled stop, in which case nobody is
+                # waiting on it)
+                while not stop.is_set():
+                    try:
+                        q.put(_END, timeout=0.2)
+                        break
+                    except _queue.Full:
+                        continue
+
+        producer = threading.Thread(target=_produce, daemon=True,
+                                    name="dataplane-prefetch")
+        producer.start()
         fetched = None
         step = 0
-        for feed in dataset:
-            fetched = self.run(program=program, feed=feed,
-                               fetch_list=fetch_list, scope=scope)
-            if debug and fetch_list and step % print_period == 0:
-                names = fetch_info or [getattr(v, "name", str(v))
-                                       for v in fetch_list]
-                print(f"step {step}: " + ", ".join(
-                    f"{n}={np.asarray(v).ravel()[:4]}"
-                    for n, v in zip(names, fetched)))
-            step += 1
+        try:
+            while True:
+                feed = q.get()
+                if feed is _END:
+                    break
+                # return_numpy=False: dispatch without blocking on the
+                # result — only debug prints (and the final return)
+                # materialize to host
+                fetched = self.run(program=program, feed=feed,
+                                   fetch_list=fetch_list, scope=scope,
+                                   return_numpy=False)
+                if debug and fetch_list and step % print_period == 0:
+                    names = fetch_info or [getattr(v, "name", str(v))
+                                           for v in fetch_list]
+                    print(f"step {step}: " + ", ".join(
+                        f"{n}={np.asarray(v).ravel()[:4]}"
+                        for n, v in zip(names, fetched)))
+                step += 1
+        finally:
+            # a failed step must not leave the producer blocked on the
+            # bounded queue holding the dataset open: signal + drain
+            stop.set()
+            while True:
+                try:
+                    q.get_nowait()
+                except _queue.Empty:
+                    break
+            producer.join(timeout=10)
+        if err:
+            raise err[0]
+        if fetched is not None:
+            fetched = [np.asarray(f) for f in fetched]
         return fetched
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,

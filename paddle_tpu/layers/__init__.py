@@ -9,6 +9,8 @@ from .tensor import *      # noqa: F401,F403
 from .loss import *        # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
 from .sequence_lod import *  # noqa: F401,F403
+from .detection import *   # noqa: F401,F403
+from . import detection    # noqa: F401
 from .rnn import *      # noqa: F401,F403
 from .learning_rate_scheduler import *  # noqa: F401,F403
 from . import learning_rate_scheduler  # noqa: F401

@@ -1,0 +1,358 @@
+"""Detection layer functions.
+
+Reference counterpart: python/paddle/fluid/layers/detection.py (prior_box,
+anchor_generator, box_coder, iou_similarity, box_clip, yolo_box,
+yolov3_loss, multiclass_nms, matrix_nms, bipartite_match, target_assign,
+generate_proposals, distribute/collect_fpn_proposals,
+retinanet_detection_output, sigmoid_focal_loss, roi ops). Thin wrappers over
+the lowerings in ops/detection_ops.py / ops/extra_ops.py — same call
+signatures for the covered arguments; static-shape outputs carry explicit
+count tensors where the reference emits LoD."""
+from __future__ import annotations
+
+from ..framework.dtype import dtype_name
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "prior_box", "density_prior_box", "anchor_generator", "box_coder",
+    "iou_similarity", "box_clip", "yolo_box", "yolov3_loss",
+    "multiclass_nms", "matrix_nms", "bipartite_match", "target_assign",
+    "generate_proposals", "distribute_fpn_proposals",
+    "collect_fpn_proposals", "retinanet_detection_output",
+    "sigmoid_focal_loss", "roi_align", "roi_pool", "psroi_pool",
+    "prroi_pool", "box_decoder_and_assign",
+]
+
+
+def _op(helper, op_type, inputs, out_slots, attrs=None, dtypes=None):
+    outs = {}
+    for s in out_slots:
+        dt = (dtypes or {}).get(s, "float32")
+        outs[s] = helper.create_variable_for_type_inference(dt)
+    helper.append_op(op_type, inputs=inputs,
+                     outputs={k: [v] for k, v in outs.items()},
+                     attrs=attrs or {})
+    return outs
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None):
+    helper = LayerHelper("prior_box")
+    outs = _op(helper, "prior_box", {"Input": [input], "Image": [image]},
+               ("Boxes", "Variances"),
+               {"min_sizes": list(min_sizes),
+                "max_sizes": list(max_sizes or []),
+                "aspect_ratios": list(aspect_ratios),
+                "variances": list(variance), "flip": flip, "clip": clip,
+                "step_w": steps[0], "step_h": steps[1], "offset": offset})
+    return outs["Boxes"], outs["Variances"]
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, flatten_to_2d=False,
+                      name=None):
+    helper = LayerHelper("density_prior_box")
+    outs = _op(helper, "density_prior_box",
+               {"Input": [input], "Image": [image]},
+               ("Boxes", "Variances"),
+               {"densities": list(densities),
+                "fixed_sizes": list(fixed_sizes),
+                "fixed_ratios": list(fixed_ratios),
+                "variances": list(variance), "clip": clip,
+                "step_w": steps[0], "step_h": steps[1], "offset": offset})
+    return outs["Boxes"], outs["Variances"]
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios, stride,
+                     variance=(0.1, 0.1, 0.2, 0.2), offset=0.5, name=None):
+    helper = LayerHelper("anchor_generator")
+    outs = _op(helper, "anchor_generator", {"Input": [input]},
+               ("Anchors", "Variances"),
+               {"anchor_sizes": list(anchor_sizes),
+                "aspect_ratios": list(aspect_ratios),
+                "stride": list(stride), "variances": list(variance),
+                "offset": offset})
+    return outs["Anchors"], outs["Variances"]
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    helper = LayerHelper("box_coder")
+    ins = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    attrs = {"code_type": code_type, "box_normalized": box_normalized,
+             "axis": axis}
+    if isinstance(prior_box_var, (list, tuple)):
+        attrs["variance"] = [float(v) for v in prior_box_var]
+    elif prior_box_var is not None:
+        ins["PriorBoxVar"] = [prior_box_var]
+    outs = _op(helper, "box_coder", ins, ("OutputBox",), attrs)
+    return outs["OutputBox"]
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    helper = LayerHelper("iou_similarity")
+    outs = _op(helper, "iou_similarity", {"X": [x], "Y": [y]}, ("Out",),
+               {"box_normalized": box_normalized})
+    return outs["Out"]
+
+
+def box_clip(input, im_info, name=None):
+    helper = LayerHelper("box_clip")
+    outs = _op(helper, "box_clip",
+               {"Input": [input], "ImInfo": [im_info]}, ("Output",))
+    return outs["Output"]
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0):
+    helper = LayerHelper("yolo_box")
+    outs = _op(helper, "yolo_box", {"X": [x], "ImgSize": [img_size]},
+               ("Boxes", "Scores"),
+               {"anchors": list(anchors), "class_num": int(class_num),
+                "conf_thresh": float(conf_thresh),
+                "downsample_ratio": int(downsample_ratio),
+                "clip_bbox": clip_bbox, "scale_x_y": float(scale_x_y)})
+    return outs["Boxes"], outs["Scores"]
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None, scale_x_y=1.0):
+    helper = LayerHelper("yolov3_loss")
+    ins = {"X": [x], "GTBox": [gt_box], "GTLabel": [gt_label]}
+    if gt_score is not None:
+        ins["GTScore"] = [gt_score]
+    outs = _op(helper, "yolov3_loss", ins,
+               ("Loss", "ObjectnessMask", "GTMatchMask"),
+               {"anchors": list(anchors), "anchor_mask": list(anchor_mask),
+                "class_num": int(class_num),
+                "ignore_thresh": float(ignore_thresh),
+                "downsample_ratio": int(downsample_ratio),
+                "use_label_smooth": use_label_smooth,
+                "scale_x_y": float(scale_x_y)},
+               dtypes={"GTMatchMask": "int32"})
+    return outs["Loss"]
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None, return_index=False,
+                   rois_num=None):
+    helper = LayerHelper("multiclass_nms")
+    outs = _op(helper, "multiclass_nms",
+               {"BBoxes": [bboxes], "Scores": [scores]},
+               ("Out", "Index", "NmsRoisNum"),
+               {"score_threshold": float(score_threshold),
+                "nms_top_k": int(nms_top_k), "keep_top_k": int(keep_top_k),
+                "nms_threshold": float(nms_threshold),
+                "normalized": normalized, "nms_eta": float(nms_eta),
+                "background_label": int(background_label)},
+               dtypes={"Index": "int32", "NmsRoisNum": "int32"})
+    if return_index:
+        return outs["Out"], outs["Index"], outs["NmsRoisNum"]
+    return outs["Out"], outs["NmsRoisNum"]
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    helper = LayerHelper("matrix_nms")
+    outs = _op(helper, "matrix_nms",
+               {"BBoxes": [bboxes], "Scores": [scores]},
+               ("Out", "Index", "RoisNum"),
+               {"score_threshold": float(score_threshold),
+                "post_threshold": float(post_threshold),
+                "nms_top_k": int(nms_top_k), "keep_top_k": int(keep_top_k),
+                "use_gaussian": use_gaussian,
+                "gaussian_sigma": float(gaussian_sigma),
+                "background_label": int(background_label),
+                "normalized": normalized},
+               dtypes={"Index": "int32", "RoisNum": "int32"})
+    if return_index:
+        return outs["Out"], outs["Index"]
+    if return_rois_num:
+        return outs["Out"], outs["RoisNum"]
+    return outs["Out"]
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    helper = LayerHelper("bipartite_match")
+    outs = _op(helper, "bipartite_match", {"DistMat": [dist_matrix]},
+               ("ColToRowMatchIndices", "ColToRowMatchDist"),
+               {"match_type": match_type or "bipartite",
+                "dist_threshold": float(dist_threshold or 0.5)},
+               dtypes={"ColToRowMatchIndices": "int32"})
+    return outs["ColToRowMatchIndices"], outs["ColToRowMatchDist"]
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=None, name=None):
+    helper = LayerHelper("target_assign")
+    ins = {"X": [input], "MatchIndices": [matched_indices]}
+    if negative_indices is not None:
+        ins["NegIndices"] = [negative_indices]
+    outs = _op(helper, "target_assign", ins, ("Out", "OutWeight"),
+               {"mismatch_value": mismatch_value or 0})
+    return outs["Out"], outs["OutWeight"]
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       return_rois_num=False, name=None):
+    helper = LayerHelper("generate_proposals")
+    outs = _op(helper, "generate_proposals",
+               {"Scores": [scores], "BboxDeltas": [bbox_deltas],
+                "ImInfo": [im_info], "Anchors": [anchors],
+                "Variances": [variances]},
+               ("RpnRois", "RpnRoiProbs", "RpnRoisNum"),
+               {"pre_nms_topN": int(pre_nms_top_n),
+                "post_nms_topN": int(post_nms_top_n),
+                "nms_thresh": float(nms_thresh),
+                "min_size": float(min_size), "eta": float(eta)},
+               dtypes={"RpnRoisNum": "int32"})
+    if return_rois_num:
+        return outs["RpnRois"], outs["RpnRoiProbs"], outs["RpnRoisNum"]
+    return outs["RpnRois"], outs["RpnRoiProbs"]
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    helper = LayerHelper("distribute_fpn_proposals")
+    n_lvl = max_level - min_level + 1
+    multi = [helper.create_variable_for_type_inference(fpn_rois.dtype)
+             for _ in range(n_lvl)]
+    counts = helper.create_variable_for_type_inference("int32")
+    restore = helper.create_variable_for_type_inference("int32")
+    ins = {"FpnRois": [fpn_rois]}
+    if rois_num is not None:
+        ins["RoisNum"] = [rois_num]
+    helper.append_op("distribute_fpn_proposals", inputs=ins,
+                     outputs={"MultiFpnRois": multi,
+                              "MultiLevelRoIsNum": [counts],
+                              "RestoreIndex": [restore]},
+                     attrs={"min_level": int(min_level),
+                            "max_level": int(max_level),
+                            "refer_level": int(refer_level),
+                            "refer_scale": int(refer_scale)})
+    # RestoreIndex addresses concat(multi) directly (padded static layout);
+    # with rois_num given, also hand back the per-level live counts (the
+    # 2.x reference signature) so callers can mask padding
+    if rois_num is not None:
+        return multi, restore, counts
+    return multi, restore
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    helper = LayerHelper("collect_fpn_proposals")
+    n_lvl = int(max_level) - int(min_level) + 1
+    if len(multi_rois) != n_lvl or len(multi_scores) != n_lvl:
+        raise ValueError(
+            f"collect_fpn_proposals: expected {n_lvl} levels "
+            f"(min_level={min_level}..max_level={max_level}), got "
+            f"{len(multi_rois)} rois / {len(multi_scores)} scores lists")
+    ins = {"MultiLevelRois": list(multi_rois),
+           "MultiLevelScores": list(multi_scores)}
+    if rois_num_per_level is not None:
+        ins["MultiLevelRoIsNum"] = list(rois_num_per_level)
+    outs = {}
+    outs["FpnRois"] = helper.create_variable_for_type_inference(
+        multi_rois[0].dtype)
+    outs["RoisNum"] = helper.create_variable_for_type_inference("int32")
+    helper.append_op("collect_fpn_proposals", inputs=ins,
+                     outputs={k: [v] for k, v in outs.items()},
+                     attrs={"post_nms_topN": int(post_nms_top_n)})
+    if rois_num_per_level is not None:
+        return outs["FpnRois"], outs["RoisNum"]
+    return outs["FpnRois"]
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    helper = LayerHelper("retinanet_detection_output")
+    out = helper.create_variable_for_type_inference(bboxes[0].dtype)
+    cnt = helper.create_variable_for_type_inference("int32")
+    helper.append_op("retinanet_detection_output",
+                     inputs={"BBoxes": list(bboxes),
+                             "Scores": list(scores),
+                             "Anchors": list(anchors),
+                             "ImInfo": [im_info]},
+                     outputs={"Out": [out], "NmsRoisNum": [cnt]},
+                     attrs={"score_threshold": float(score_threshold),
+                            "nms_top_k": int(nms_top_k),
+                            "keep_top_k": int(keep_top_k),
+                            "nms_threshold": float(nms_threshold),
+                            "nms_eta": float(nms_eta)})
+    return out
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
+    helper = LayerHelper("sigmoid_focal_loss")
+    outs = _op(helper, "sigmoid_focal_loss",
+               {"X": [x], "Label": [label], "FgNum": [fg_num]}, ("Out",),
+               {"gamma": float(gamma), "alpha": float(alpha)})
+    return outs["Out"]
+
+
+def _roi_op(op_type, input, rois, pooled_height, pooled_width,
+            spatial_scale, rois_num=None, extra_attrs=None,
+            num_slot="RoisNum"):
+    helper = LayerHelper(op_type)
+    ins = {"X": [input], "ROIs": [rois]}
+    if rois_num is not None:
+        ins[num_slot] = [rois_num]
+    attrs = {"pooled_height": int(pooled_height),
+             "pooled_width": int(pooled_width),
+             "spatial_scale": float(spatial_scale)}
+    attrs.update(extra_attrs or {})
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(op_type, inputs=ins, outputs={"Out": [out]},
+                     attrs=attrs)
+    return out
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, name=None,
+              rois_num=None):
+    return _roi_op("roi_align", input, rois, pooled_height, pooled_width,
+                   spatial_scale, rois_num,
+                   {"sampling_ratio": int(sampling_ratio)})
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_num=None, name=None):
+    return _roi_op("roi_pool", input, rois, pooled_height, pooled_width,
+                   spatial_scale, rois_num)
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, rois_num=None, name=None):
+    return _roi_op("psroi_pool", input, rois, pooled_height, pooled_width,
+                   spatial_scale, rois_num,
+                   {"output_channels": int(output_channels)})
+
+
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, batch_roi_nums=None, name=None):
+    return _roi_op("prroi_pool", input, rois, pooled_height, pooled_width,
+                   spatial_scale, batch_roi_nums, num_slot="BatchRoINums")
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip, name=None):
+    helper = LayerHelper("box_decoder_and_assign")
+    outs = _op(helper, "box_decoder_and_assign",
+               {"PriorBox": [prior_box], "PriorBoxVar": [prior_box_var],
+                "TargetBox": [target_box], "BoxScore": [box_score]},
+               ("DecodeBox", "OutputAssignBox"),
+               {"box_clip": float(box_clip)})
+    return outs["DecodeBox"], outs["OutputAssignBox"]

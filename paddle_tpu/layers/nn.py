@@ -825,12 +825,15 @@ def fused_attention(q, k, v, mask=None, scale=None, dropout=0.0,
     return out
 
 
-def switch_moe(input, num_experts, d_ff, capacity_factor=1.25, name=None):
-    """Switch-style top-1 MoE FFN (beyond-reference: makes
-    expert_parallel_degree real; ops/moe.py). Returns (out, aux_loss) — add
-    aux_loss (scaled ~0.01) to the training loss for load balancing. Expert
-    weights are named '<prefix>_expert_w1/w2' so moe_sharding_rules() can
-    shard their leading [E] dim over the mesh's ep axis."""
+def switch_moe(input, num_experts, d_ff, capacity_factor=1.25, name=None,
+               top_k=1):
+    """Switch-style gated MoE FFN (beyond-reference: makes
+    expert_parallel_degree real; ops/moe.py). top_k=1 is Switch routing,
+    top_k=2 is GShard (second choice queues behind all first choices, pair
+    gates renormalized). Returns (out, aux_loss) — add aux_loss (scaled
+    ~0.01) to the training loss for load balancing. Expert weights are
+    named '<prefix>_expert_w1/w2' so moe_sharding_rules() can shard their
+    leading [E] dim over the mesh's ep axis."""
     helper = LayerHelper(name or "switch_moe")
     d = input.shape[-1]
     from ..framework import unique_name
@@ -859,5 +862,85 @@ def switch_moe(input, num_experts, d_ff, capacity_factor=1.25, name=None):
                              "ExpertW2": [w2], "ExpertB2": [b2]},
                      outputs={"Out": [out], "AuxLoss": [aux],
                               "GateIdx": [gidx]},
-                     attrs={"capacity_factor": float(capacity_factor)})
+                     attrs={"capacity_factor": float(capacity_factor),
+                            "top_k": int(top_k)})
     return out, aux
+
+
+# ---------------------------------------------------------------------------
+# CRF + chunk evaluation (reference layers/nn.py:710 linear_chain_crf,
+# :835 crf_decoding, :1038 chunk_eval — wrappers over ops/decode_ops.py and
+# ops/tail_ops.py lowerings)
+# ---------------------------------------------------------------------------
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """input [b, T, C] padded emissions + per-sequence length; creates the
+    [C+2, C] transition parameter (rows 0/1 = start/stop weights, the
+    reference linear_chain_crf_op.h layout). Returns the negative
+    log-likelihood [b, 1] to minimize."""
+    helper = LayerHelper("linear_chain_crf")
+    c = int(input.shape[-1])
+    trans = helper.create_parameter(param_attr, [c + 2, c],
+                                    dtype_name(input.dtype))
+    nll = helper.create_variable_for_type_inference(input.dtype)
+    alpha = helper.create_variable_for_type_inference(input.dtype)
+    em_exps = helper.create_variable_for_type_inference(input.dtype)
+    tr_exps = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"Emission": [input], "Transition": [trans], "Label": [label]}
+    if length is not None:
+        ins["SeqLen"] = [length]
+    helper.append_op("linear_chain_crf", inputs=ins,
+                     outputs={"LogLikelihood": [nll], "Alpha": [alpha],
+                              "EmissionExps": [em_exps],
+                              "TransitionExps": [tr_exps]})
+    return nll
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    """Viterbi decode against the SHARED transition parameter (pass the
+    same ParamAttr/name used in linear_chain_crf). With label given,
+    returns the per-token 0/1 correctness mask like the reference."""
+    helper = LayerHelper("crf_decoding")
+    attr = ParamAttr._to_attr(param_attr)
+    block = helper.main_program.global_block()
+    if attr and attr.name and block.has_var(attr.name):
+        trans = block.var(attr.name)     # share the trained transitions
+    else:
+        c = int(input.shape[-1])
+        trans = helper.create_parameter(attr, [c + 2, c],
+                                        dtype_name(input.dtype))
+    path = helper.create_variable_for_type_inference("int64")
+    ins = {"Emission": [input], "Transition": [trans]}
+    if label is not None:
+        ins["Label"] = [label]
+    if length is not None:
+        ins["SeqLen"] = [length]
+    helper.append_op("crf_decoding", inputs=ins,
+                     outputs={"ViterbiPath": [path]})
+    return path
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """Chunk-level precision/recall/F1 (IOB and variants). Returns the
+    reference's 6-tuple."""
+    helper = LayerHelper("chunk_eval")
+    outs = {n: helper.create_variable_for_type_inference("float32")
+            for n in ("Precision", "Recall", "F1-Score")}
+    for n in ("NumInferChunks", "NumLabelChunks", "NumCorrectChunks"):
+        outs[n] = helper.create_variable_for_type_inference("int64")
+    ins = {"Inference": [input], "Label": [label]}
+    if seq_length is not None:
+        ins["SeqLength"] = [seq_length]
+    helper.append_op("chunk_eval", inputs=ins,
+                     outputs={k: [v] for k, v in outs.items()},
+                     attrs={"num_chunk_types": int(num_chunk_types),
+                            "chunk_scheme": chunk_scheme,
+                            "excluded_chunk_types":
+                                list(excluded_chunk_types or [])})
+    return (outs["Precision"], outs["Recall"], outs["F1-Score"],
+            outs["NumInferChunks"], outs["NumLabelChunks"],
+            outs["NumCorrectChunks"])
+
+
+__all__ += ["linear_chain_crf", "crf_decoding", "chunk_eval"]

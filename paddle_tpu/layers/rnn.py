@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..framework.dtype import dtype_name
+from ..framework.dtype import INT64_DEVICE_DTYPE, dtype_name
 from ..framework.program import in_dygraph_mode
 from ..layer_helper import LayerHelper
 

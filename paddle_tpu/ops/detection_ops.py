@@ -408,23 +408,31 @@ def _roi_pool(ctx, ins, attrs):
 # NMS
 # ---------------------------------------------------------------------------
 
-def _nms_per_class(boxes, scores, iou_threshold, top_k, normalized):
+def _nms_per_class(boxes, scores, iou_threshold, top_k, normalized,
+                   eta=1.0):
     """Greedy NMS over the top_k highest-score boxes. Returns a keep mask
-    aligned with the sorted order and the sorted indices."""
+    aligned with the sorted order and the sorted indices. eta < 1 decays
+    the threshold after each kept box while it stays above 0.5 (the
+    reference NMSFast adaptive_threshold, multiclass_nms_op.cc)."""
     order = jnp.argsort(-scores)[:top_k]
     b = boxes[order]
     s = scores[order]
     iou = _iou_matrix(b, b, normalized)
     k = b.shape[0]
 
-    def body(i, keep):
-        sup = (iou[i] > iou_threshold) & keep & \
-            (jnp.arange(k) > i)
-        keep_new = keep & ~sup
-        return jnp.where(keep[i], keep_new, keep)
+    # candidate-centric like the reference: candidate i survives iff no
+    # ALREADY-KEPT earlier box overlaps it above the CURRENT threshold;
+    # the threshold decays after each kept candidate
+    def body(i, carry):
+        keep, thr = carry
+        over = (iou[:, i] > thr) & keep & (jnp.arange(k) < i)
+        keep = keep.at[i].set(~jnp.any(over))
+        thr = jnp.where(keep[i] & (eta < 1.0) & (thr > 0.5), thr * eta, thr)
+        return keep, thr
 
     keep0 = jnp.ones((k,), bool)
-    keep = jax.lax.fori_loop(0, k, body, keep0)
+    keep, _ = jax.lax.fori_loop(
+        0, k, body, (keep0, jnp.asarray(iou_threshold, s.dtype)))
     return order, s, keep
 
 
@@ -453,13 +461,14 @@ def _multiclass_nms(ctx, ins, attrs):
     nms_threshold = attrs.get("nms_threshold", 0.3)
     normalized = attrs.get("normalized", True)
     background = attrs.get("background_label", 0)
+    nms_eta = float(attrs.get("nms_eta", 1.0))
 
-    all_rows = []
+    all_rows, all_src = [], []
     for cls in range(c):
         if cls == background:
             continue
         order, s, keep = _nms_per_class(bboxes, scores[cls], nms_threshold,
-                                        nms_top_k, normalized)
+                                        nms_top_k, normalized, eta=nms_eta)
         ok = keep & (s > score_threshold)
         sel_boxes = bboxes[order]
         rows = jnp.concatenate(
@@ -467,7 +476,9 @@ def _multiclass_nms(ctx, ins, attrs):
              jnp.where(ok, s, jnp.finfo(s.dtype).min)[:, None],
              sel_boxes], axis=1)          # [nms_top_k, 6]
         all_rows.append(rows)
+        all_src.append(jnp.where(ok, order, -1))   # original box index
     cat = jnp.concatenate(all_rows, axis=0)
+    src = jnp.concatenate(all_src, axis=0)
     # keep the global top keep_top_k by score
     take = min(keep_top_k, cat.shape[0])
     top_idx = jnp.argsort(-cat[:, 1])[:take]
@@ -478,4 +489,608 @@ def _multiclass_nms(ctx, ins, attrs):
                         [jnp.full((take, 1), -1.0),
                          jnp.zeros((take, 5))], axis=1).astype(out.dtype))
     count = jnp.sum(valid).astype(jnp.int32)
-    return {"Out": [out], "NmsRoisNum": [count]}
+    # Index: each kept row's index into the input box list (-1 on padding)
+    index = jnp.where(valid, src[top_idx], -1).astype(jnp.int32)
+    return {"Out": [out], "NmsRoisNum": [count],
+            "Index": [index[:, None]]}
+
+
+# ---------------------------------------------------------------------------
+# training-side detection ops (round 3)
+# ---------------------------------------------------------------------------
+
+def _iou_cwh(x1, y1, w1, h1, x2, y2, w2, h2):
+    """IoU of center-format boxes; broadcasts."""
+    ov_w = jnp.minimum(x1 + w1 / 2, x2 + w2 / 2) \
+        - jnp.maximum(x1 - w1 / 2, x2 - w2 / 2)
+    ov_h = jnp.minimum(y1 + h1 / 2, y2 + h2 / 2) \
+        - jnp.maximum(y1 - h1 / 2, y2 - h2 / 2)
+    inter = jnp.where((ov_w > 0) & (ov_h > 0), ov_w * ov_h, 0.0)
+    return inter / jnp.maximum(w1 * h1 + w2 * h2 - inter, 1e-10)
+
+
+def _sce(x, label):
+    """Numerically-stable sigmoid cross entropy (yolov3_loss_op.h:30)."""
+    return jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+
+@register("yolov3_loss", nondiff_slots=("GTBox", "GTLabel", "GTScore"))
+def _yolov3_loss(ctx, ins, attrs):
+    """yolov3_loss_op.cc:1 / yolov3_loss_op.h:259. The reference is four
+    nested CPU loops; here every stage is a batched tensor op: pred-vs-gt
+    IoU as one [N,M,H,W,B] broadcast, per-gt best-anchor match as an
+    argmax, and the positive-cell writes as scatters — XLA fuses the lot.
+    Assumes square grids (h == w), as the reference kernel does
+    (GetYoloBox divides both coords by `h`)."""
+    x = ins["X"][0]                              # [N, M*(5+C), H, W]
+    gt_box = ins["GTBox"][0].astype(jnp.float32)  # [N, B, 4] xywh in [0,1]
+    gt_label = ins["GTLabel"][0].astype(jnp.int32)  # [N, B]
+    gt_score = ins.get("GTScore", [None])[0]
+    anchors = list(attrs["anchors"])
+    mask = list(attrs["anchor_mask"])
+    class_num = int(attrs["class_num"])
+    ignore_thresh = float(attrs.get("ignore_thresh", 0.7))
+    downsample = int(attrs.get("downsample_ratio", 32))
+    label_smooth = bool(attrs.get("use_label_smooth", True))
+    scale_xy = float(attrs.get("scale_x_y", 1.0))
+    bias_xy = -0.5 * (scale_xy - 1.0)
+
+    n, _, h, w = x.shape
+    an_num = len(anchors) // 2
+    m = len(mask)
+    b = gt_box.shape[1]
+    input_size = downsample * h
+    xr = x.astype(jnp.float32).reshape(n, m, 5 + class_num, h, w)
+    if gt_score is None:
+        gt_score = jnp.ones((n, b), jnp.float32)
+    gt_score = gt_score.astype(jnp.float32)
+    gx, gy, gw, gh = (gt_box[..., 0], gt_box[..., 1],
+                      gt_box[..., 2], gt_box[..., 3])
+    valid = (gw > 1e-6) & (gh > 1e-6)                       # [N, B]
+
+    if label_smooth:
+        sw = min(1.0 / class_num, 1.0 / 40)
+        pos_l, neg_l = 1.0 - sw, sw
+    else:
+        pos_l, neg_l = 1.0, 0.0
+
+    anc = jnp.asarray(anchors, jnp.float32).reshape(an_num, 2)
+    anc_m = anc[jnp.asarray(mask, jnp.int32)]               # [M, 2]
+
+    # ---- predicted boxes per cell (for the ignore mask) ----
+    ii = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]   # x / cols
+    jj = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]   # y / rows
+    px = (ii + jax.nn.sigmoid(xr[:, :, 0]) * scale_xy + bias_xy) / h
+    py = (jj + jax.nn.sigmoid(xr[:, :, 1]) * scale_xy + bias_xy) / h
+    pw = jnp.exp(xr[:, :, 2]) * anc_m[None, :, 0, None, None] / input_size
+    ph = jnp.exp(xr[:, :, 3]) * anc_m[None, :, 1, None, None] / input_size
+    iou_pg = _iou_cwh(px[..., None], py[..., None], pw[..., None],
+                      ph[..., None],
+                      gx[:, None, None, None, :], gy[:, None, None, None, :],
+                      gw[:, None, None, None, :], gh[:, None, None, None, :])
+    iou_pg = jnp.where(valid[:, None, None, None, :], iou_pg, 0.0)
+    best_iou = jnp.max(iou_pg, axis=-1) if b else jnp.zeros_like(px)
+    obj_mask = jnp.where(best_iou > ignore_thresh, -1.0, 0.0)  # [N,M,H,W]
+
+    # ---- per-gt best anchor (shape-only IoU at the origin) ----
+    aw = anc[None, None, :, 0] / input_size                 # [1,1,A]
+    ah = anc[None, None, :, 1] / input_size
+    iou_ga = _iou_cwh(0.0, 0.0, gw[..., None], gh[..., None],
+                      0.0, 0.0, aw, ah)                     # [N,B,A]
+    best_n = jnp.argmax(iou_ga, axis=-1).astype(jnp.int32)  # [N,B]
+    # position of best_n inside anchor_mask, -1 when absent
+    mask_arr = jnp.asarray(mask, jnp.int32)                 # [M]
+    eq = best_n[..., None] == mask_arr[None, None, :]       # [N,B,M]
+    mask_idx = jnp.where(jnp.any(eq, -1),
+                         jnp.argmax(eq, -1).astype(jnp.int32), -1)
+    gt_match = jnp.where(valid, mask_idx, -1)               # [N,B] out
+
+    gi = jnp.clip((gx * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gy * h).astype(jnp.int32), 0, h - 1)
+    matched = valid & (mask_idx >= 0)
+    score = gt_score
+
+    # scatter positive scores into the objectness mask (overwrites any -1)
+    bi = jnp.arange(n, dtype=jnp.int32)[:, None] * jnp.ones(
+        (1, b), jnp.int32)
+    safe_m = jnp.where(matched, mask_idx, m)                # m = dropped
+    obj_mask = obj_mask.at[bi, safe_m, gj, gi].set(
+        score, mode="drop")
+
+    # ---- location + class losses at each matched gt's cell (gathers) ----
+    mg = jnp.where(matched, mask_idx, 0)
+    cell = xr[bi, mg, :, jnp.where(matched, gj, 0),
+              jnp.where(matched, gi, 0)]                    # [N,B,5+C]
+    g_safe_w = jnp.where(valid, gw, 1.0)
+    g_safe_h = jnp.where(valid, gh, 1.0)
+    anc_best = anc[jnp.where(matched, best_n, 0)]           # [N,B,2]
+    tx = gx * h - gi
+    ty = gy * h - gj
+    tw = jnp.log(jnp.maximum(g_safe_w * input_size, 1e-9)
+                 / jnp.maximum(anc_best[..., 0], 1e-9))
+    th = jnp.log(jnp.maximum(g_safe_h * input_size, 1e-9)
+                 / jnp.maximum(anc_best[..., 1], 1e-9))
+    sf = (2.0 - g_safe_w * g_safe_h) * score
+    loc = (_sce(cell[..., 0], tx) + _sce(cell[..., 1], ty)
+           + jnp.abs(cell[..., 2] - tw) + jnp.abs(cell[..., 3] - th)) * sf
+    cls_target = jnp.where(
+        jax.nn.one_hot(gt_label, class_num, dtype=jnp.float32) > 0,
+        pos_l, neg_l)                                       # [N,B,C]
+    cls = jnp.sum(_sce(cell[..., 5:], cls_target), -1) * score
+    loss_pos = jnp.sum(jnp.where(matched, loc + cls, 0.0), axis=1)  # [N]
+
+    # ---- objectness loss over the final mask ----
+    xo = xr[:, :, 4]                                        # [N,M,H,W]
+    obj_pos = jnp.where(obj_mask > 1e-5, _sce(xo, 1.0) * obj_mask, 0.0)
+    obj_neg = jnp.where((obj_mask <= 1e-5) & (obj_mask > -0.5),
+                        _sce(xo, 0.0), 0.0)
+    loss_obj = jnp.sum(obj_pos + obj_neg, axis=(1, 2, 3))
+    loss = (loss_pos + loss_obj).astype(x.dtype)
+    return {"Loss": [loss],
+            "ObjectnessMask": [obj_mask.astype(x.dtype)],
+            "GTMatchMask": [gt_match]}
+
+
+@register("generate_proposals",
+          nondiff_slots=("Scores", "BboxDeltas", "ImInfo", "Anchors",
+                         "Variances"))
+def _generate_proposals(ctx, ins, attrs):
+    """generate_proposals_op.cc:1 (RPN proposal stage). Pixel-coordinate
+    convention (+1 widths), delta clip log(1000/16), min-size + center
+    filter, then greedy NMS — all static-shape: outputs are
+    [N*post_nms_topN, 4] padded blocks + per-image RpnRoisNum counts
+    (the XLA analog of the reference's LoD append loop)."""
+    scores = ins["Scores"][0]          # [N, A, H, W]
+    deltas = ins["BboxDeltas"][0]      # [N, 4A, H, W]
+    im_info = ins["ImInfo"][0]         # [N, 3] (h, w, scale)
+    anchors = ins["Anchors"][0].reshape(-1, 4)     # [M, 4]
+    variances = ins["Variances"][0].reshape(-1, 4)
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_thresh = float(attrs.get("nms_thresh", 0.7))
+    eta = float(attrs.get("eta", 1.0))
+    min_size = max(float(attrs.get("min_size", 0.1)), 1.0)
+    clip_default = float(np.log(1000.0 / 16.0))
+
+    n, a, h, w = scores.shape
+    m = a * h * w
+    pre_n = min(pre_n, m)
+    sc = jnp.moveaxis(scores, 1, -1).reshape(n, m)          # [N, M] hwa
+    dl = deltas.reshape(n, a, 4, h, w)
+    dl = jnp.moveaxis(dl, (3, 4, 1), (1, 2, 3)).reshape(n, m, 4)
+
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+
+    rois_out, probs_out, counts = [], [], []
+    for i in range(n):
+        order = jnp.argsort(-sc[i])[:pre_n]
+        d = dl[i][order]
+        s = sc[i][order]
+        va = variances[order]
+        cx = va[:, 0] * d[:, 0] * aw[order] + acx[order]
+        cy = va[:, 1] * d[:, 1] * ah[order] + acy[order]
+        bw = jnp.exp(jnp.minimum(va[:, 2] * d[:, 2], clip_default)) \
+            * aw[order]
+        bh = jnp.exp(jnp.minimum(va[:, 3] * d[:, 3], clip_default)) \
+            * ah[order]
+        x1 = cx - bw / 2
+        y1 = cy - bh / 2
+        x2 = cx + bw / 2 - 1.0
+        y2 = cy + bh / 2 - 1.0
+        imh, imw, imsc = im_info[i, 0], im_info[i, 1], im_info[i, 2]
+        x1 = jnp.clip(x1, 0.0, imw - 1.0)
+        y1 = jnp.clip(y1, 0.0, imh - 1.0)
+        x2 = jnp.clip(x2, 0.0, imw - 1.0)
+        y2 = jnp.clip(y2, 0.0, imh - 1.0)
+        ws, hs = x2 - x1 + 1.0, y2 - y1 + 1.0
+        ws_o = (x2 - x1) / imsc + 1.0
+        hs_o = (y2 - y1) / imsc + 1.0
+        keep_sz = (ws_o >= min_size) & (hs_o >= min_size) & \
+            (x1 + ws / 2 <= imw) & (y1 + hs / 2 <= imh)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=1)
+        s = jnp.where(keep_sz, s, jnp.finfo(s.dtype).min)
+        order2, s2, keep = _nms_per_class(boxes, s, nms_thresh, pre_n,
+                                          normalized=False, eta=eta)
+        ok = keep & (s2 > jnp.finfo(s.dtype).min)
+        # stable-compact the kept rows to the front, take post_n; padding
+        # prob rows carry -inf (NOT 0) so downstream consumers — notably
+        # collect_fpn_proposals without explicit counts — can tell live
+        # rows from padding by score alone
+        rank = jnp.cumsum(ok.astype(jnp.int32)) - 1
+        tgt = jnp.where(ok, rank, pre_n)
+        rois = jnp.zeros((pre_n, 4), boxes.dtype).at[tgt].set(
+            boxes[order2], mode="drop")[:post_n]
+        probs = jnp.full((pre_n,), jnp.finfo(s.dtype).min, s.dtype).at[
+            tgt].set(s2, mode="drop")[:post_n]
+        rois_out.append(rois)
+        probs_out.append(probs[:, None])
+        counts.append(jnp.minimum(jnp.sum(ok), post_n).astype(jnp.int32))
+    return {"RpnRois": [jnp.concatenate(rois_out, 0)],
+            "RpnRoiProbs": [jnp.concatenate(probs_out, 0)],
+            "RpnRoisNum": [jnp.stack(counts)]}
+
+
+@register("distribute_fpn_proposals", nondiff_slots=("FpnRois", "RoisNum"))
+def _distribute_fpn_proposals(ctx, ins, attrs):
+    """distribute_fpn_proposals_op.cc: route each ROI to an FPN level by
+    scale: level = floor(log2(sqrt(area) / refer_scale + 1e-6)) +
+    refer_level, clipped. Static outputs: per-level [R, 4] blocks with
+    dead rows zeroed, per-level counts, and RestoreIndex mapping the
+    sorted-by-level order back to the input order."""
+    rois = ins["FpnRois"][0]                       # [R, 4]
+    min_level = int(attrs["min_level"])
+    max_level = int(attrs["max_level"])
+    refer_level = int(attrs["refer_level"])
+    refer_scale = float(attrs["refer_scale"])
+    r = rois.shape[0]
+    ws = rois[:, 2] - rois[:, 0]
+    hs = rois[:, 3] - rois[:, 1]
+    scale = jnp.sqrt(jnp.maximum(ws * hs, 1e-12))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+
+    # RoisNum ([B] per-image live counts over equal-size image blocks, the
+    # static layout generate_proposals emits): rows past an image's count
+    # are padding — they belong to NO level and must not inflate counts
+    nums_in = [x for x in ins.get("RoisNum", []) if x is not None]
+    if nums_in:
+        nums = jnp.concatenate([x.reshape(-1) for x in nums_in])   # [B]
+        per_img = r // nums.shape[0]
+        live = (jnp.arange(r) % per_img) < jnp.repeat(nums, per_img)
+    else:
+        live = jnp.ones((r,), bool)
+
+    num_levels = max_level - min_level + 1
+    outs, counts = [], []
+    # RestoreIndex addresses the CONCAT OF THE PADDED BLOCKS this op
+    # actually emits (each level block is [R, 4]): roi i lives at row
+    # (level_i - min_level) * R + rank_i, so
+    # concat(MultiFpnRois)[RestoreIndex] == FpnRois with no compaction
+    # step (the reference's restore assumes its compact LoD layout; the
+    # static equivalent must match the static layout). Dead input rows
+    # point at guaranteed-zero slots of the level-0 block after its live
+    # rows (count_0 + dead_rank < R always holds), reproducing their
+    # zero padding.
+    rank_all = jnp.zeros((r,), jnp.int32)
+    lvl_eff = jnp.where(live, lvl, -1)
+    for li in range(num_levels):
+        sel = lvl_eff == (min_level + li)
+        rank = jnp.cumsum(sel.astype(jnp.int32)) - 1
+        rank_all = jnp.where(sel, rank, rank_all)
+        tgt = jnp.where(sel, rank, r)
+        blk = jnp.zeros((r, 4), rois.dtype).at[tgt].set(rois, mode="drop")
+        outs.append(blk)
+        counts.append(jnp.sum(sel).astype(jnp.int32))
+    restore = (lvl - min_level) * r + rank_all
+    if nums_in:
+        dead_rank = jnp.cumsum((~live).astype(jnp.int32)) - 1
+        restore = jnp.where(live, restore, counts[0] + dead_rank)
+    return {"MultiFpnRois": outs,
+            "MultiLevelRoIsNum": [jnp.stack(counts)],
+            "RestoreIndex": [restore[:, None]]}
+
+
+@register("collect_fpn_proposals",
+          nondiff_slots=("MultiLevelRois", "MultiLevelScores",
+                         "MultiLevelRoIsNum"))
+def _collect_fpn_proposals(ctx, ins, attrs):
+    """collect_fpn_proposals_op.cc: concat per-level (rois, scores), keep
+    the global top post_nms_topN by score. Padded rows ride in with
+    score -inf so they never win."""
+    rois = jnp.concatenate([x.reshape(-1, 4)
+                            for x in ins["MultiLevelRois"]], 0)
+    scores = jnp.concatenate([s.reshape(-1)
+                              for s in ins["MultiLevelScores"]], 0)
+    nums_in = [n for n in ins.get("MultiLevelRoIsNum", []) if n is not None]
+    if nums_in:
+        # counts arrive as one packed [L] tensor or L per-level [1] tensors
+        nums = jnp.concatenate([n.reshape(-1) for n in nums_in])
+        # mask per-level padding using the counts; level blocks may have
+        # different row counts, so build each level's mask at its own size
+        valid = jnp.concatenate([
+            jnp.arange(x.reshape(-1, 4).shape[0], dtype=jnp.int32) < nums[i]
+            for i, x in enumerate(ins["MultiLevelRois"])])
+        scores = jnp.where(valid, scores, jnp.finfo(scores.dtype).min)
+    post_n = min(int(attrs.get("post_nms_topN", 1000)), rois.shape[0])
+    order = jnp.argsort(-scores)[:post_n]
+    out = rois[order]
+    cnt = jnp.sum(scores > jnp.finfo(scores.dtype).min).astype(jnp.int32)
+    return {"FpnRois": [out],
+            "RoisNum": [jnp.minimum(cnt, post_n).reshape(1)]}
+
+
+@register("matrix_nms", nondiff_slots=("BBoxes", "Scores"))
+def _matrix_nms(ctx, ins, attrs):
+    """matrix_nms_op.cc:94 NMSMatrix — decay-based soft NMS with a CLOSED
+    FORM instead of the greedy loop: decay_i = min_j<i f(iou_ij)/f(iou_max_j)
+    — one triangular matrix op on the MXU, no sequential dependence (this
+    is why SOLO-style models use it)."""
+    bboxes = ins["BBoxes"][0]
+    scores = ins["Scores"][0]
+    if bboxes.ndim == 3:
+        if bboxes.shape[0] != 1:
+            raise ValueError("matrix_nms lowering is single-image")
+        bboxes, scores = bboxes[0], scores[0]
+    c, m = scores.shape
+    score_threshold = float(attrs.get("score_threshold", 0.0))
+    post_threshold = float(attrs.get("post_threshold", 0.0))
+    nms_top_k = int(attrs.get("nms_top_k", m))
+    nms_top_k = m if nms_top_k <= 0 else min(nms_top_k, m)
+    keep_top_k = int(attrs.get("keep_top_k", m))
+    if keep_top_k <= 0:
+        keep_top_k = c * nms_top_k
+    use_gaussian = bool(attrs.get("use_gaussian", False))
+    sigma = float(attrs.get("gaussian_sigma", 2.0))
+    normalized = bool(attrs.get("normalized", True))
+    background = int(attrs.get("background_label", 0))
+
+    rows, orig_idx = [], []
+    for cls in range(c):
+        if cls == background:
+            continue
+        s_raw = scores[cls]
+        s = jnp.where(s_raw > score_threshold, s_raw,
+                      jnp.finfo(s_raw.dtype).min)
+        order = jnp.argsort(-s)[:nms_top_k]
+        b = bboxes[order]
+        ss = s[order]
+        live = ss > jnp.finfo(s_raw.dtype).min
+        iou = _iou_matrix(b, b, normalized)
+        k = b.shape[0]
+        tri = (jnp.arange(k)[:, None] > jnp.arange(k)[None, :]) \
+            & live[:, None] & live[None, :]          # j < i pairs
+        iou_t = jnp.where(tri, iou, 0.0)
+        iou_max = jnp.max(jnp.where(tri, iou, -jnp.inf), axis=1)
+        iou_max = jnp.where(jnp.isfinite(iou_max), iou_max, 0.0)  # [i]
+        if use_gaussian:
+            decay = jnp.exp((iou_max[None, :] ** 2 - iou_t ** 2) * sigma)
+        else:
+            decay = (1.0 - iou_t) / jnp.maximum(1.0 - iou_max[None, :],
+                                                1e-10)
+        decay = jnp.where(tri, decay, 1.0)
+        dec = jnp.min(decay, axis=1)
+        ds = jnp.where(live, dec * ss, jnp.finfo(s_raw.dtype).min)
+        ok = ds > post_threshold
+        rows.append(jnp.concatenate(
+            [jnp.where(ok, float(cls), -1.0)[:, None],
+             jnp.where(ok, ds, jnp.finfo(ds.dtype).min)[:, None],
+             b], axis=1))
+        orig_idx.append(jnp.where(ok, order.astype(jnp.int32), -1))
+    cat = jnp.concatenate(rows, 0)
+    cat_idx = jnp.concatenate(orig_idx, 0)    # original box index per row
+    take = min(keep_top_k, cat.shape[0])
+    top = jnp.argsort(-cat[:, 1])[:take]
+    out = cat[top]
+    valid = out[:, 0] >= 0
+    out = jnp.where(valid[:, None], out,
+                    jnp.concatenate([jnp.full((take, 1), -1.0),
+                                     jnp.zeros((take, 5))],
+                                    axis=1).astype(out.dtype))
+    idx = jnp.where(valid, cat_idx[top], -1).astype(jnp.int32)
+    return {"Out": [out], "Index": [idx[:, None]],
+            "RoisNum": [jnp.sum(valid).astype(jnp.int32).reshape(1)]}
+
+
+@register("bipartite_match", nondiff_slots=("DistMat",))
+def _bipartite_match(ctx, ins, attrs):
+    """bipartite_match_op.cc: greedy global-max bipartite matching on the
+    distance matrix [R, C] (rows = gt entities, cols = priors); optional
+    per_prediction pass adds col->row matches above overlap_threshold.
+    Sequential by nature → lax.fori_loop over min(R,C) rounds."""
+    dist = ins["DistMat"][0]
+    if dist.ndim == 3:
+        if dist.shape[0] != 1:
+            raise ValueError("bipartite_match lowering is single-instance")
+        dist = dist[0]
+    r, c = dist.shape
+    match_type = attrs.get("match_type", "bipartite")
+    overlap_threshold = float(attrs.get("dist_threshold", 0.5))
+    neg = jnp.finfo(dist.dtype).min
+
+    def body(_, carry):
+        d, row_of_col, dist_of_col = carry
+        flat = jnp.argmax(d)
+        i, j = flat // c, flat % c
+        best = d[i, j]
+        do = best > 0
+        row_of_col = jnp.where(do, row_of_col.at[j].set(i.astype(jnp.int32)),
+                               row_of_col)
+        dist_of_col = jnp.where(do, dist_of_col.at[j].set(best),
+                                dist_of_col)
+        d = jnp.where(do, d.at[i, :].set(neg).at[:, j].set(neg), d)
+        return d, row_of_col, dist_of_col
+
+    row_of_col0 = jnp.full((c,), -1, jnp.int32)
+    dist_of_col0 = jnp.zeros((c,), dist.dtype)
+    _, row_of_col, dist_of_col = jax.lax.fori_loop(
+        0, min(r, c), body, (dist, row_of_col0, dist_of_col0))
+
+    if match_type == "per_prediction":
+        best_row = jnp.argmax(dist, axis=0).astype(jnp.int32)
+        best_val = jnp.max(dist, axis=0)
+        extra = (row_of_col < 0) & (best_val >= overlap_threshold)
+        row_of_col = jnp.where(extra, best_row, row_of_col)
+        dist_of_col = jnp.where(extra, best_val, dist_of_col)
+    return {"ColToRowMatchIndices": [row_of_col[None, :]],
+            "ColToRowMatchDist": [dist_of_col[None, :]]}
+
+
+@register("target_assign", nondiff_slots=("MatchIndices", "NegIndices"))
+def _target_assign(ctx, ins, attrs):
+    """target_assign_op.cc: out[i][j] = X[match[i][j]] where matched, else
+    mismatch_value; weight 1 for matched AND for mined negatives
+    (NegIndices — SSD conf loss trains on background through them), 0
+    else. NegIndices here is the padded [B, C] block mine_hard_examples
+    emits (-1 = pad), the static stand-in for the reference's ragged
+    LoD list."""
+    x = ins["X"][0]                     # [R, D] (LoD rows) or [B, R, D]
+    match = ins["MatchIndices"][0].astype(jnp.int32)   # [B, C]
+    neg = ins.get("NegIndices", [None])[0]
+    mismatch = attrs.get("mismatch_value", 0)
+    if x.ndim == 2:
+        x = x[None]
+    bsz, c = match.shape
+    d = x.shape[-1]
+    safe = jnp.maximum(match, 0)
+    rows = jnp.take_along_axis(
+        x, safe[..., None].repeat(d, -1), axis=1)   # [B, C, D]
+    matched = (match >= 0)[..., None]
+    out = jnp.where(matched, rows,
+                    jnp.asarray(mismatch, x.dtype))
+    wt = matched[..., 0].astype(jnp.float32)
+    if neg is not None:
+        neg = neg.astype(jnp.int32).reshape(bsz, -1)
+        bi = jnp.arange(bsz, dtype=jnp.int32)[:, None] \
+            * jnp.ones_like(neg)
+        tgt = jnp.where(neg >= 0, neg, c)           # pad rows drop
+        wt = wt.at[bi, tgt].max(1.0, mode="drop")
+    return {"Out": [out], "OutWeight": [wt[..., None]]}
+
+
+@register("mine_hard_examples",
+          nondiff_slots=("ClsLoss", "LocLoss", "MatchIndices", "MatchDist"))
+def _mine_hard_examples(ctx, ins, attrs):
+    """mine_hard_examples_op.cc (max_negative mining): per instance, rank
+    unmatched priors by loss desc and keep neg_pos_ratio * #pos of them as
+    negatives. Static form: UpdatedMatchIndices unchanged for matched,
+    and a NegFlag mask output instead of the reference's ragged NegIndices
+    (padded -1 block kept for slot parity)."""
+    cls_loss = ins["ClsLoss"][0]                 # [B, P]
+    loc_loss = ins.get("LocLoss", [None])[0]
+    match = ins["MatchIndices"][0].astype(jnp.int32)   # [B, P]
+    ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    neg_overlap = float(attrs.get("neg_dist_threshold", 0.5))
+    dist = ins.get("MatchDist", [None])[0]
+    mining = attrs.get("mining_type", "max_negative")
+    if mining != "max_negative":
+        raise NotImplementedError("hard_example mining_type: max_negative "
+                                  "only (the reference marks hard_example "
+                                  "as unimplemented too)")
+    loss = cls_loss + (loc_loss if loc_loss is not None else 0.0)
+    is_neg = match < 0
+    if dist is not None:
+        is_neg = is_neg & (dist < neg_overlap)
+    n_pos = jnp.sum((match >= 0).astype(jnp.int32), axis=1)   # [B]
+    n_neg = (n_pos.astype(jnp.float32) * ratio).astype(jnp.int32)
+    masked = jnp.where(is_neg, loss, -jnp.inf)
+    order = jnp.argsort(-masked, axis=1)
+    rank = jnp.zeros_like(order).at[
+        jnp.arange(match.shape[0])[:, None], order].set(
+        jnp.broadcast_to(jnp.arange(match.shape[1]), match.shape))
+    neg_flag = is_neg & (rank < n_neg[:, None])
+    b, p = match.shape
+    neg_idx = jnp.where(neg_flag,
+                        jnp.arange(p, dtype=jnp.int32)[None, :], -1)
+    return {"UpdatedMatchIndices": [match],
+            "NegIndices": [neg_idx], "NegFlag": [neg_flag]}
+
+
+@register("box_decoder_and_assign",
+          nondiff_slots=("PriorBox", "PriorBoxVar", "BoxScore"))
+def _box_decoder_and_assign(ctx, ins, attrs):
+    """box_decoder_and_assign_op.cc: decode per-class deltas against prior
+    boxes, then pick each roi's best-scoring class box."""
+    prior = ins["PriorBox"][0]                   # [R, 4]
+    pvar = ins["PriorBoxVar"][0]                 # [R, 4]
+    deltas = ins["TargetBox"][0]                 # [R, 4*C]
+    score = ins["BoxScore"][0]                   # [R, C]
+    clip = float(attrs.get("box_clip", 4.135))
+    r, c = score.shape
+    pw = prior[:, 2] - prior[:, 0] + 1.0
+    ph = prior[:, 3] - prior[:, 1] + 1.0
+    pcx = prior[:, 0] + 0.5 * pw
+    pcy = prior[:, 1] + 0.5 * ph
+    d = deltas.reshape(r, c, 4)
+    dx = d[..., 0] * pvar[:, None, 0]
+    dy = d[..., 1] * pvar[:, None, 1]
+    dw = jnp.minimum(d[..., 2] * pvar[:, None, 2], clip)
+    dh = jnp.minimum(d[..., 3] * pvar[:, None, 3], clip)
+    cx = dx * pw[:, None] + pcx[:, None]
+    cy = dy * ph[:, None] + pcy[:, None]
+    bw = jnp.exp(dw) * pw[:, None]
+    bh = jnp.exp(dh) * ph[:, None]
+    decoded = jnp.stack([cx - bw / 2, cy - bh / 2,
+                         cx + bw / 2 - 1.0, cy + bh / 2 - 1.0], axis=-1)
+    best = jnp.argmax(score[:, 1:], axis=1) + 1   # skip background col 0
+    assigned = jnp.take_along_axis(
+        decoded, best[:, None, None].repeat(4, -1), axis=1)[:, 0]
+    return {"DecodeBox": [decoded.reshape(r, c * 4)],
+            "OutputAssignBox": [assigned]}
+
+
+@register("retinanet_detection_output",
+          nondiff_slots=("BBoxes", "Scores", "Anchors", "ImInfo"))
+def _retinanet_detection_output(ctx, ins, attrs):
+    """retinanet_detection_output_op.cc: per FPN level take the nms_top_k
+    scoring (anchor, class) pairs above threshold, decode against that
+    level's anchors, then merge levels and run per-class NMS. Single
+    image; static [keep_top_k, 6] output padded with label -1."""
+    bbox_levels = ins["BBoxes"]          # each [1, Ai, 4] deltas
+    score_levels = ins["Scores"]         # each [1, Ai, C] sigmoid scores
+    anchor_levels = ins["Anchors"]       # each [Ai, 4]
+    im_info = ins["ImInfo"][0].reshape(-1)
+    score_threshold = float(attrs.get("score_threshold", 0.05))
+    nms_top_k = int(attrs.get("nms_top_k", 1000))
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+    nms_threshold = float(attrs.get("nms_threshold", 0.3))
+    nms_eta = float(attrs.get("nms_eta", 1.0))
+
+    boxes_all, scores_all, labels_all = [], [], []
+    for blv, slv, alv in zip(bbox_levels, score_levels, anchor_levels):
+        d = blv.reshape(-1, 4)
+        s = slv.reshape(-1, slv.shape[-1])           # [A, C]
+        a_count, c = s.shape
+        anc = alv.reshape(-1, 4)
+        flat = s.reshape(-1)                          # [A*C]
+        k = min(nms_top_k, flat.shape[0])
+        top = jnp.argsort(-flat)[:k]
+        ai = (top // c).astype(jnp.int32)
+        ci = (top % c).astype(jnp.int32)
+        sv = flat[top]
+        aw = anc[ai, 2] - anc[ai, 0] + 1.0
+        ah = anc[ai, 3] - anc[ai, 1] + 1.0
+        acx = anc[ai, 0] + 0.5 * aw
+        acy = anc[ai, 1] + 0.5 * ah
+        dd = d[ai]
+        cx = dd[:, 0] * aw + acx
+        cy = dd[:, 1] * ah + acy
+        bw = jnp.exp(jnp.minimum(dd[:, 2], 4.135)) * aw
+        bh = jnp.exp(jnp.minimum(dd[:, 3], 4.135)) * ah
+        x1 = jnp.clip(cx - bw / 2, 0.0, im_info[1] - 1.0)
+        y1 = jnp.clip(cy - bh / 2, 0.0, im_info[0] - 1.0)
+        x2 = jnp.clip(cx + bw / 2 - 1.0, 0.0, im_info[1] - 1.0)
+        y2 = jnp.clip(cy + bh / 2 - 1.0, 0.0, im_info[0] - 1.0)
+        boxes_all.append(jnp.stack([x1, y1, x2, y2], 1))
+        scores_all.append(jnp.where(sv > score_threshold, sv,
+                                    jnp.finfo(sv.dtype).min))
+        labels_all.append(ci)
+    boxes = jnp.concatenate(boxes_all, 0)
+    scores = jnp.concatenate(scores_all, 0)
+    labels = jnp.concatenate(labels_all, 0)
+    num_classes = score_levels[0].shape[-1]
+    rows = []
+    for cls in range(num_classes):
+        s_cls = jnp.where(labels == cls, scores,
+                          jnp.finfo(scores.dtype).min)
+        order, s2, keep = _nms_per_class(boxes, s_cls, nms_threshold,
+                                         min(nms_top_k, boxes.shape[0]),
+                                         normalized=False, eta=nms_eta)
+        ok = keep & (s2 > jnp.finfo(scores.dtype).min)
+        rows.append(jnp.concatenate(
+            [jnp.where(ok, float(cls), -1.0)[:, None],
+             jnp.where(ok, s2, jnp.finfo(s2.dtype).min)[:, None],
+             boxes[order]], axis=1))
+    cat = jnp.concatenate(rows, 0)
+    take = min(keep_top_k, cat.shape[0])
+    top = jnp.argsort(-cat[:, 1])[:take]
+    out = cat[top]
+    valid = out[:, 0] >= 0
+    out = jnp.where(valid[:, None], out,
+                    jnp.concatenate([jnp.full((take, 1), -1.0),
+                                     jnp.zeros((take, 5))],
+                                    axis=1).astype(out.dtype))
+    return {"Out": [out],
+            "NmsRoisNum": [jnp.sum(valid).astype(jnp.int32).reshape(1)]}

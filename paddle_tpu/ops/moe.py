@@ -41,17 +41,44 @@ def _switch_moe(ctx, ins, attrs):
     e = w1.shape[0]
     cap = max(1, int(-(-n * cf // e)))     # ceil(n/e * cf)
 
+    top_k = int(attrs.get("top_k", 1))
+    if top_k not in (1, 2):
+        raise ValueError(
+            f"switch_moe supports top_k in (1, 2), got top_k={top_k}")
+
     gate_logits = xt.astype(jnp.float32) @ wg.astype(jnp.float32)  # [N, E]
     gates = jax.nn.softmax(gate_logits, axis=-1)
     expert = jnp.argmax(gates, axis=-1)                  # [N] top-1
-    gate_val = jnp.max(gates, axis=-1)                   # [N]
-
+    gate1 = jnp.max(gates, axis=-1)                      # [N]
     onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)      # [N, E]
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0            # [N, E]
-    keep = (pos >= 0) & (pos < cap)
-    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), cap,
-                            dtype=jnp.float32) * keep[..., None]
-    dispatch = onehot[..., None] * pos_oh                      # [N, E, C]
+
+    # choice-1 positions in each expert's capacity buffer
+    pos1 = jnp.cumsum(onehot, axis=0) * onehot - 1.0           # [N, E]
+    keep1 = (pos1 >= 0) & (pos1 < cap)
+    pos1_oh = jax.nn.one_hot(pos1.astype(jnp.int32), cap,
+                             dtype=jnp.float32) * keep1[..., None]
+    dispatch = onehot[..., None] * pos1_oh                     # [N, E, C]
+    combine_w = dispatch * gate1[:, None, None]
+
+    if top_k == 2:
+        # GShard top-2: second choice queues BEHIND all first choices
+        # (capacity positions continue from each expert's top-1 count);
+        # both gate values renormalize over the pair.
+        gates2 = gates * (1.0 - onehot)                        # mask choice 1
+        expert2 = jnp.argmax(gates2, axis=-1)
+        gate2 = jnp.max(gates2, axis=-1)
+        onehot2 = jax.nn.one_hot(expert2, e, dtype=jnp.float32)
+        count1 = jnp.sum(onehot, axis=0)                       # [E]
+        pos2 = (jnp.cumsum(onehot2, axis=0) * onehot2 - 1.0
+                + count1[None, :] * onehot2)
+        keep2 = (pos2 >= 0) & (pos2 < cap) & (onehot2 > 0)
+        pos2_oh = jax.nn.one_hot(pos2.astype(jnp.int32), cap,
+                                 dtype=jnp.float32) * keep2[..., None]
+        dispatch2 = onehot2[..., None] * pos2_oh
+        denom = jnp.maximum(gate1 + gate2, 1e-9)
+        combine_w = (dispatch * (gate1 / denom)[:, None, None]
+                     + dispatch2 * (gate2 / denom)[:, None, None])
+        dispatch = dispatch + dispatch2
 
     # all-to-all happens here when E is sharded over 'ep'
     xin = jnp.einsum("nec,nd->ecd", dispatch, xt.astype(jnp.float32))
@@ -62,10 +89,10 @@ def _switch_moe(ctx, ins, attrs):
     out_e = jnp.einsum("ecf,efd->ecd", h, w2.astype(jnp.float32))
     if b2 is not None:
         out_e = out_e + b2[:, None, :].astype(jnp.float32)
-    combined = jnp.einsum("nec,ecd->nd", dispatch, out_e)
-    out = (combined * gate_val[:, None]).astype(x.dtype)
+    combined = jnp.einsum("nec,ecd->nd", combine_w, out_e)
+    out = combined.astype(x.dtype)
 
-    # Switch aux loss: E * sum_e importance_e * load_e
+    # Switch aux loss (eq. 4) / GShard me*ce: both use the TOP-1 assignment
     importance = jnp.mean(gates, axis=0)                  # [E]
     load = jnp.mean(onehot, axis=0)                       # [E]
     aux = e * jnp.sum(importance * load)

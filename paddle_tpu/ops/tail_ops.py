@@ -1046,13 +1046,64 @@ def _positive_negative_pair(ctx, ins, attrs):
 
 @register("chunk_eval", nondiff_slots=("Inference", "Label", "SeqLength"))
 def _chunk_eval(ctx, ins, attrs):
-    """chunk_eval_op.cc (IOB scheme): chunk-level P/R/F1 via host callback
+    """chunk_eval_op.cc (IOB/IOE/IOBES/plain): chunk P/R/F1 via host callback
     (irregular chunk extraction doesn't vectorize; metric ops run rarely)."""
     inf = ins["Inference"][0]
     lab = ins["Label"][0]
     sl = ins.get("SeqLength", [None])[0]
     num_chunk_types = attrs["num_chunk_types"]
     scheme = attrs.get("chunk_scheme", "IOB")
+    excluded = frozenset(attrs.get("excluded_chunk_types", ()) or ())
+    # per-scheme tag roles (chunk_eval_op.h:124-150): label encodes
+    # chunk_type * num_tag_types + tag; type == num_chunk_types is "O"
+    try:
+        n_tag, t_b, t_i, t_e, t_s = {
+            "IOB":   (2, 0, 1, -1, -1),
+            "IOE":   (2, -1, 0, 1, -1),
+            "IOBES": (4, 0, 1, 2, 3),
+            "plain": (1, -1, -1, -1, -1),
+        }[scheme]
+    except KeyError:
+        raise ValueError(f"Unknown chunk scheme {scheme!r}")
+    other = num_chunk_types
+
+    def _chunk_end(ptag, ptype, tag, typ):
+        if ptype == other:
+            return False
+        if typ == other or typ != ptype:
+            return True
+        if ptag in (t_b, t_i) and ptag >= 0:
+            return tag in (t_b, t_s) and tag >= 0
+        return ptag in (t_e, t_s) and ptag >= 0
+
+    def _chunk_begin(ptag, ptype, tag, typ):
+        if ptype == other:
+            return typ != other
+        if typ == other:
+            return False
+        if typ != ptype:
+            return True
+        if tag in (t_b, t_s) and tag >= 0:
+            return True
+        if tag in (t_i, t_e) and tag >= 0:
+            return ptag in (t_e, t_s) and ptag >= 0
+        return False
+
+    def segments(seq):
+        """Exact GetSegments state machine (chunk_eval_op.h:41-87)."""
+        out, start, in_chunk = set(), 0, False
+        tag, typ = -1, other
+        for i, t in enumerate(seq):
+            ptag, ptype = tag, typ
+            tag, typ = int(t) % n_tag, int(t) // n_tag
+            if in_chunk and _chunk_end(ptag, ptype, tag, typ):
+                out.add((start, i - 1, ptype))
+                in_chunk = False
+            if _chunk_begin(ptag, ptype, tag, typ):
+                start, in_chunk = i, True
+        if in_chunk:
+            out.add((start, len(seq) - 1, typ))
+        return out
 
     def host_eval(inf_np, lab_np, sl_np):
         inf_np = np.asarray(inf_np).reshape(lab_np.shape)
@@ -1061,33 +1112,17 @@ def _chunk_eval(ctx, ins, attrs):
         lab2 = np.asarray(lab_np).reshape(b, -1)
         lens = (np.asarray(sl_np).reshape(-1) if sl_np is not None
                 else np.full(b, inf2.shape[1]))
-        def chunks(seq):
-            out, start, ctype = set(), -1, -1
-            for i, t in enumerate(list(seq) + [-1]):
-                if scheme == "IOB":
-                    # tag = type*2 (B) / type*2+1 (I); odd max = O
-                    is_b = t >= 0 and t % 2 == 0 and t // 2 < num_chunk_types
-                    is_i = t >= 0 and t % 2 == 1 and t // 2 == ctype
-                    if start >= 0 and not is_i:
-                        out.add((start, i, ctype))
-                        start, ctype = -1, -1
-                    if is_b:
-                        start, ctype = i, t // 2
-                else:  # plain: every tag < num_chunk_types is its own chunk
-                    if t >= 0 and t < num_chunk_types:
-                        out.add((i, i + 1, t))
-            return out
         ncorr = ninf = nlab = 0
         for bi in range(b):
             L = int(lens[bi])
-            ci = chunks(inf2[bi][:L])
-            cl = chunks(lab2[bi][:L])
+            ci = {s for s in segments(inf2[bi][:L]) if s[2] not in excluded}
+            cl = {s for s in segments(lab2[bi][:L]) if s[2] not in excluded}
             ncorr += len(ci & cl)
             ninf += len(ci)
             nlab += len(cl)
         p = ncorr / ninf if ninf else 0.0
         r = ncorr / nlab if nlab else 0.0
-        f1 = 2 * p * r / (p + r) if p + r else 0.0
+        f1 = 2 * p * r / (p + r) if ncorr else 0.0
         return (np.float32(p), np.float32(r), np.float32(f1),
                 np.int32(ninf), np.int32(nlab), np.int32(ncorr))
 

@@ -1,0 +1,118 @@
+"""NER book-style end-to-end test over the fluid.layers CRF surface
+(reference layers/nn.py:710 linear_chain_crf, :835 crf_decoding, :1038
+chunk_eval; book: test_label_semantic_roles pattern at toy scale): an
+embedding + FC emission model trained with the CRF negative log-likelihood
+over ragged sequences, decoded with shared transitions, chunk-scored."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.layer_helper import ParamAttr
+
+
+def test_ner_crf_trains_and_decodes():
+    from paddle_tpu.testing import reset_programs
+    reset_programs(seed=0)
+    B, T, V, C = 8, 6, 30, 5          # C tags (IOB-ish)
+
+    words = layers.data(name="words", shape=[T], dtype="int64")
+    tags = layers.data(name="tags", shape=[T], dtype="int64")
+    lens = layers.data(name="lens", shape=[1], dtype="int32")
+    emb = layers.embedding(layers.unsqueeze(words, [2]), [V, 16])
+    emb = layers.reshape(emb, [0, 0, 16])
+    emission = layers.fc(emb, C, num_flatten_dims=2)
+    nll = layers.linear_chain_crf(
+        emission, tags, param_attr=ParamAttr(name="crf_trans"),
+        length=lens)
+    loss = layers.mean(nll)
+    test_prog = fluid.default_main_program().clone(for_test=True)
+    opt = paddle.optimizer.Adam(learning_rate=0.05)
+    opt.minimize(loss)
+
+    # decode program: shares crf_trans by name
+    with fluid.program_guard(test_prog):
+        em_var = test_prog.global_block().var(emission.name)
+        path = layers.crf_decoding(
+            em_var, param_attr=ParamAttr(name="crf_trans"),
+            length=test_prog.global_block().var(lens.name))
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.RandomState(0)
+    wv = rng.randint(0, V, (B, T)).astype(np.int64)
+    # deterministic tag rule: word parity + position, learnable
+    tv = ((wv % 2) * 2 + (np.arange(T)[None, :] % 2)).astype(np.int64) % C
+    lv = rng.randint(3, T + 1, (B, 1)).astype(np.int32)
+
+    feed = {"words": wv, "tags": tv, "lens": lv}
+    losses = []
+    for _ in range(60):
+        lval, = exe.run(feed=feed, fetch_list=[loss])
+        losses.append(float(lval))
+    assert losses[-1] < losses[0] * 0.3, \
+        f"CRF nll did not fall: {losses[0]:.3f} -> {losses[-1]:.3f}"
+
+    got_path, = exe.run(test_prog, feed=feed, fetch_list=[path])
+    got_path = np.asarray(got_path)
+    # accuracy on live tokens must beat chance after training
+    live = np.arange(T)[None, :] < lv
+    acc = (got_path == tv)[live].mean()
+    assert acc > 0.8, f"viterbi accuracy {acc:.2f}"
+
+
+def test_chunk_eval_layer_counts():
+    from paddle_tpu.testing import reset_programs
+    reset_programs(seed=0)
+    T = 6
+    inf = layers.data(name="inf", shape=[T], dtype="int64")
+    lab = layers.data(name="lab", shape=[T], dtype="int64")
+    p, r, f1, ni, nl, nc = layers.chunk_eval(
+        inf, lab, chunk_scheme="IOB", num_chunk_types=2)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    # IOB with 2 types: tags 0=B0 1=I0 2=B1 3=I1 4=O
+    label = np.array([[0, 1, 4, 2, 3, 4]], np.int64)    # chunks: t0@0-1, t1@3-4
+    pred = np.array([[0, 1, 4, 4, 4, 4]], np.int64)     # finds only t0
+    pv, rv, fv, niv, nlv, ncv = exe.run(
+        feed={"inf": pred, "lab": label},
+        fetch_list=[p, r, f1, ni, nl, nc])
+    assert int(niv[0]) == 1 and int(nlv[0]) == 2 and int(ncv[0]) == 1
+    np.testing.assert_allclose(float(pv[0]), 1.0)
+    np.testing.assert_allclose(float(rv[0]), 0.5)
+
+
+def test_yolov3_loss_layer_trains():
+    """Detection layer surface end-to-end: a tiny conv head trained with
+    fluid.layers.detection.yolov3_loss (reference layers/detection.py)."""
+    from paddle_tpu.testing import reset_programs
+    reset_programs(seed=0)
+    h = w = 4
+    class_num = 2
+    anchors = [10, 13, 16, 30]
+    mask = [0, 1]
+    m = len(mask)
+    img = layers.data(name="img", shape=[3, h, w], dtype="float32")
+    gt_box = layers.data(name="gt_box", shape=[3, 4], dtype="float32")
+    gt_label = layers.data(name="gt_label", shape=[3], dtype="int32")
+    head = layers.conv2d(img, m * (5 + class_num), 3, padding=1)
+    loss_v = layers.yolov3_loss(head, gt_box, gt_label, anchors, mask,
+                                class_num, ignore_thresh=0.5,
+                                downsample_ratio=32)
+    loss = layers.mean(loss_v)
+    opt = paddle.optimizer.Adam(learning_rate=5e-3)
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {
+        "img": rng.randn(2, 3, h, w).astype(np.float32),
+        "gt_box": np.tile(np.array([[[0.4, 0.4, 0.3, 0.3]]], np.float32),
+                          (2, 3, 1)),
+        "gt_label": np.ones((2, 3), np.int32),
+    }
+    losses = [float(exe.run(feed=feed, fetch_list=[loss])[0])
+              for _ in range(25)]
+    assert losses[-1] < losses[0] * 0.7, \
+        f"yolo loss did not fall: {losses[0]:.3f} -> {losses[-1]:.3f}"

@@ -121,6 +121,43 @@ def test_multiclass_nms():
     np.testing.assert_allclose(best1[2:], [0, 0, 10, 10], atol=1e-5)
 
 
+def test_multiclass_nms_index_points_at_kept_boxes():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                     np.float32)
+    scores = np.array([[0.0, 0.0, 0.0],
+                       [0.9, 0.85, 0.1],
+                       [0.2, 0.1, 0.8]], np.float32)
+    out = run_op("multiclass_nms", {"BBoxes": [boxes], "Scores": [scores]},
+                 {"score_threshold": 0.3, "nms_threshold": 0.5,
+                  "nms_top_k": 3, "keep_top_k": 4, "background_label": 0})
+    rows = np.asarray(out["Out"][0])
+    idx = np.asarray(out["Index"][0])[:, 0]
+    n = int(np.asarray(out["NmsRoisNum"][0]))
+    for r in range(n):
+        # each kept row's box must equal the input box its Index names
+        np.testing.assert_allclose(rows[r, 2:], boxes[idx[r]], atol=1e-5)
+    assert (idx[n:] == -1).all()    # padding rows carry -1
+
+
+def test_multiclass_nms_eta_decays_threshold():
+    # chain: iou(A,B)=iou(B,C)~0.43, iou(A,C)~0.11. At thr=0.6 all three
+    # survive. eta=0.1 decays the threshold after the FIRST keep
+    # (0.6 -> 0.06, reference NMSFast: decay only while thr > 0.5), so
+    # B and C both overlap kept A above 0.06 and are culled.
+    boxes = np.array([[0, 0, 10, 10], [0, 4, 10, 14], [0, 8, 10, 18]],
+                     np.float32)
+    scores = np.array([[0.9, 0.8, 0.7]], np.float32)
+    base = run_op("multiclass_nms", {"BBoxes": [boxes], "Scores": [scores]},
+                  {"score_threshold": 0.1, "nms_threshold": 0.6,
+                   "nms_top_k": 3, "keep_top_k": 3, "background_label": -1})
+    assert int(np.asarray(base["NmsRoisNum"][0])) == 3
+    decay = run_op("multiclass_nms", {"BBoxes": [boxes], "Scores": [scores]},
+                   {"score_threshold": 0.1, "nms_threshold": 0.6,
+                    "nms_top_k": 3, "keep_top_k": 3, "background_label": -1,
+                    "nms_eta": 0.1})
+    assert int(np.asarray(decay["NmsRoisNum"][0])) == 1
+
+
 def test_linear_chain_crf_matches_bruteforce():
     b, T, C = 2, 3, 3
     em = R.randn(b, T, C).astype(np.float32)

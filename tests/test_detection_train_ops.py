@@ -1,0 +1,456 @@
+"""Training-side detection ops (round 3): yolov3_loss, generate_proposals,
+distribute/collect_fpn_proposals, matrix_nms, retinanet_detection_output,
+bipartite_match, target_assign (reference detection/*.cc per-op unittests:
+test_yolov3_loss_op.py pattern — loop-based numpy reference vs the
+vectorized lowering)."""
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401
+from op_test import run_op, check_grad
+
+R = np.random.RandomState(0)
+
+
+def _sce(x, label):
+    return max(x, 0.0) - x * label + np.log1p(np.exp(-abs(x)))
+
+
+def _sig(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _iou_cwh(b1, b2):
+    ow = min(b1[0] + b1[2] / 2, b2[0] + b2[2] / 2) - \
+        max(b1[0] - b1[2] / 2, b2[0] - b2[2] / 2)
+    oh = min(b1[1] + b1[3] / 2, b2[1] + b2[3] / 2) - \
+        max(b1[1] - b1[3] / 2, b2[1] - b2[3] / 2)
+    inter = ow * oh if (ow > 0 and oh > 0) else 0.0
+    return inter / max(b1[2] * b1[3] + b2[2] * b2[3] - inter, 1e-10)
+
+
+def _yolo_ref(x, gt_box, gt_label, anchors, mask, class_num, ignore_thresh,
+              downsample, label_smooth):
+    """Loop transcription of yolov3_loss_op.h:259 (the reference algorithm
+    restated in numpy for the test oracle)."""
+    n, _, h, w = x.shape
+    m = len(mask)
+    an_num = len(anchors) // 2
+    b = gt_box.shape[1]
+    input_size = downsample * h
+    xr = x.reshape(n, m, 5 + class_num, h, w)
+    if label_smooth:
+        sw = min(1.0 / class_num, 1.0 / 40)
+        pos_l, neg_l = 1.0 - sw, sw
+    else:
+        pos_l, neg_l = 1.0, 0.0
+    loss = np.zeros(n)
+    obj_mask = np.zeros((n, m, h, w))
+    gt_match = np.full((n, b), -1, np.int32)
+    for i in range(n):
+        for j in range(m):
+            for k in range(h):
+                for l in range(w):
+                    px = (l + _sig(xr[i, j, 0, k, l])) / h
+                    py = (k + _sig(xr[i, j, 1, k, l])) / h
+                    pw = np.exp(xr[i, j, 2, k, l]) * anchors[2 * mask[j]] \
+                        / input_size
+                    ph = np.exp(xr[i, j, 3, k, l]) \
+                        * anchors[2 * mask[j] + 1] / input_size
+                    best = 0.0
+                    for t in range(b):
+                        if gt_box[i, t, 2] < 1e-6 or gt_box[i, t, 3] < 1e-6:
+                            continue
+                        best = max(best, _iou_cwh((px, py, pw, ph),
+                                                  gt_box[i, t]))
+                    if best > ignore_thresh:
+                        obj_mask[i, j, k, l] = -1
+        for t in range(b):
+            if gt_box[i, t, 2] < 1e-6 or gt_box[i, t, 3] < 1e-6:
+                continue
+            gx, gy, gw, gh = gt_box[i, t]
+            gi, gj = int(gx * w), int(gy * h)
+            best_iou, best_n = 0.0, 0
+            for a in range(an_num):
+                iou = _iou_cwh((0, 0, gw, gh),
+                               (0, 0, anchors[2 * a] / input_size,
+                                anchors[2 * a + 1] / input_size))
+                if iou > best_iou:
+                    best_iou, best_n = iou, a
+            mask_idx = mask.index(best_n) if best_n in mask else -1
+            gt_match[i, t] = mask_idx
+            if mask_idx < 0:
+                continue
+            tx, ty = gx * h - gi, gy * h - gj
+            tw = np.log(gw * input_size / anchors[2 * best_n])
+            th = np.log(gh * input_size / anchors[2 * best_n + 1])
+            sf = 2.0 - gw * gh
+            cell = xr[i, mask_idx, :, gj, gi]
+            loss[i] += (_sce(cell[0], tx) + _sce(cell[1], ty)
+                        + abs(cell[2] - tw) + abs(cell[3] - th)) * sf
+            obj_mask[i, mask_idx, gj, gi] = 1.0
+            for c in range(class_num):
+                lbl = pos_l if c == gt_label[i, t] else neg_l
+                loss[i] += _sce(cell[5 + c], lbl)
+    for i in range(n):
+        for j in range(m):
+            for k in range(h):
+                for l in range(w):
+                    o = obj_mask[i, j, k, l]
+                    xo = xr[i, j, 4, k, l]
+                    if o > 1e-5:
+                        loss[i] += _sce(xo, 1.0) * o
+                    elif o > -0.5:
+                        loss[i] += _sce(xo, 0.0)
+    return loss, obj_mask, gt_match
+
+
+def test_yolov3_loss_matches_loop_reference():
+    n, h, w, class_num, b = 2, 4, 4, 3, 5
+    anchors = [10, 13, 16, 30, 33, 23]
+    mask = [0, 1]
+    m = len(mask)
+    x = R.randn(n, m * (5 + class_num), h, w).astype(np.float32) * 0.5
+    gt = R.uniform(0.1, 0.9, (n, b, 4)).astype(np.float32)
+    gt[:, :, 2:] *= 0.3
+    gt[0, 3, 2] = 0.0                 # an invalid box
+    lbl = R.randint(0, class_num, (n, b)).astype(np.int32)
+
+    out = run_op("yolov3_loss",
+                 {"X": [x], "GTBox": [gt], "GTLabel": [lbl]},
+                 {"anchors": anchors, "anchor_mask": mask,
+                  "class_num": class_num, "ignore_thresh": 0.5,
+                  "downsample_ratio": 32, "use_label_smooth": True})
+    ref_loss, ref_obj, ref_match = _yolo_ref(
+        x.astype(np.float64), gt, lbl, anchors, mask, class_num, 0.5, 32,
+        True)
+    np.testing.assert_allclose(np.asarray(out["Loss"][0]), ref_loss,
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(out["GTMatchMask"][0]),
+                                  ref_match)
+    np.testing.assert_allclose(np.asarray(out["ObjectnessMask"][0]),
+                               ref_obj, atol=1e-6)
+
+
+def test_yolov3_loss_grad_finite_and_nonzero():
+    n, h, w, class_num, b = 1, 4, 4, 2, 3
+    anchors = [10, 13, 16, 30]
+    mask = [0, 1]
+    x = R.randn(n, 2 * (5 + class_num), h, w).astype(np.float32) * 0.3
+    gt = R.uniform(0.2, 0.8, (n, b, 4)).astype(np.float32)
+    gt[:, :, 2:] *= 0.4
+    lbl = R.randint(0, class_num, (n, b)).astype(np.int32)
+    check_grad("yolov3_loss",
+               {"X": [x], "GTBox": [gt], "GTLabel": [lbl]},
+               {"anchors": anchors, "anchor_mask": mask,
+                "class_num": class_num, "ignore_thresh": 0.5,
+                "downsample_ratio": 32},
+               wrt=["X"], out_slots=("Loss",))
+
+
+def test_generate_proposals_basic():
+    n, a, h, w = 1, 3, 8, 8
+    scores = R.rand(n, a, h, w).astype(np.float32)
+    deltas = (R.randn(n, 4 * a, h, w) * 0.1).astype(np.float32)
+    im_info = np.array([[64.0, 64.0, 1.0]], np.float32)
+    # simple anchors: centered boxes of various sizes per cell
+    ys, xs = np.meshgrid(np.arange(h) * 8 + 4, np.arange(w) * 8 + 4,
+                         indexing="ij")
+    anchors = np.zeros((h, w, a, 4), np.float32)
+    for k, sz in enumerate([8, 16, 32]):
+        anchors[..., k, 0] = xs - sz / 2
+        anchors[..., k, 1] = ys - sz / 2
+        anchors[..., k, 2] = xs + sz / 2
+        anchors[..., k, 3] = ys + sz / 2
+    var = np.full((h, w, a, 4), 1.0, np.float32)
+    out = run_op("generate_proposals",
+                 {"Scores": [scores], "BboxDeltas": [deltas],
+                  "ImInfo": [im_info], "Anchors": [anchors],
+                  "Variances": [var]},
+                 {"pre_nms_topN": 50, "post_nms_topN": 10,
+                  "nms_thresh": 0.7, "min_size": 2.0})
+    rois = np.asarray(out["RpnRois"][0])
+    cnt = int(np.asarray(out["RpnRoisNum"][0])[0])
+    assert rois.shape == (10, 4)
+    assert 0 < cnt <= 10
+    live = rois[:cnt]
+    assert (live[:, 2] >= live[:, 0]).all()
+    assert (live[:, 3] >= live[:, 1]).all()
+    assert live.min() >= 0 and live.max() <= 63.0
+    probs = np.asarray(out["RpnRoiProbs"][0])[:cnt, 0]
+    assert (np.diff(probs) <= 1e-6).all(), "probs must be sorted desc"
+
+
+def test_distribute_and_collect_fpn_proposals_roundtrip():
+    r = 12
+    sizes = R.uniform(8, 448, r).astype(np.float32)
+    rois = np.zeros((r, 4), np.float32)
+    rois[:, 2] = sizes
+    rois[:, 3] = sizes
+    out = run_op("distribute_fpn_proposals", {"FpnRois": [rois]},
+                 {"min_level": 2, "max_level": 5, "refer_level": 4,
+                  "refer_scale": 224})
+    levels = np.floor(np.log2(sizes / 224 + 1e-6)) + 4
+    levels = np.clip(levels, 2, 5).astype(int)
+    counts = np.asarray(out["MultiLevelRoIsNum"][0])
+    for li in range(4):
+        assert counts[li] == (levels == 2 + li).sum()
+        blk = np.asarray(out["MultiFpnRois"][li])
+        want = rois[levels == 2 + li]
+        np.testing.assert_allclose(blk[:len(want)], want, rtol=1e-6)
+        np.testing.assert_allclose(blk[len(want):], 0.0)
+    restore = np.asarray(out["RestoreIndex"][0])[:, 0]
+    # RestoreIndex addresses the concat of the op's OWN padded blocks:
+    # concat(MultiFpnRois)[restore] == input rois, no compaction needed
+    padded_cat = np.concatenate(
+        [np.asarray(out["MultiFpnRois"][li]) for li in range(4)])
+    np.testing.assert_allclose(padded_cat[restore], rois, rtol=1e-6)
+
+    # collect: feed the level blocks + fake scores, top post_nms_topN wins
+    scores = [np.where(np.arange(r) < counts[li],
+                       R.rand(r), -1e30).astype(np.float32)
+              for li in range(4)]
+    col = run_op("collect_fpn_proposals",
+                 {"MultiLevelRois": out["MultiFpnRois"],
+                  "MultiLevelScores": [np.asarray(s) for s in scores],
+                  "MultiLevelRoIsNum": [counts]},
+                 {"post_nms_topN": 6})
+    fpn = np.asarray(col["FpnRois"][0])
+    assert fpn.shape == (6, 4)
+    assert int(np.asarray(col["RoisNum"][0])[0]) == 6
+
+
+def test_matrix_nms_decay_matches_loop():
+    """Closed-form decay vs the reference's loop (matrix_nms_op.cc:94)."""
+    m, c = 6, 2
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30],
+                      [21, 21, 31, 31], [40, 40, 50, 50], [0, 0, 9, 9]],
+                     np.float32)
+    scores = R.rand(c, m).astype(np.float32)
+    out = run_op("matrix_nms", {"BBoxes": [boxes], "Scores": [scores]},
+                 {"score_threshold": 0.01, "post_threshold": 0.0,
+                  "nms_top_k": m, "keep_top_k": m, "background_label": -1,
+                  "use_gaussian": False, "normalized": True})
+    got = np.asarray(out["Out"][0])
+
+    def iou(b1, b2):
+        ix = min(b1[2], b2[2]) - max(b1[0], b2[0])
+        iy = min(b1[3], b2[3]) - max(b1[1], b2[1])
+        inter = max(ix, 0) * max(iy, 0)
+        a1 = (b1[2] - b1[0]) * (b1[3] - b1[1])
+        a2 = (b2[2] - b2[0]) * (b2[3] - b2[1])
+        return inter / max(a1 + a2 - inter, 1e-10)
+
+    expect = []
+    for cls in range(c):
+        perm = [i for i in np.argsort(-scores[cls])
+                if scores[cls][i] > 0.01]
+        iou_max = {}
+        for rank, i in enumerate(perm):
+            iou_max[i] = max((iou(boxes[i], boxes[perm[j]])
+                              for j in range(rank)), default=0.0)
+        for rank, i in enumerate(perm):
+            decay = min(((1 - iou(boxes[i], boxes[perm[j]]))
+                         / (1 - iou_max[perm[j]])
+                         for j in range(rank)), default=1.0)
+            expect.append((cls, decay * scores[cls][i], i))
+    expect.sort(key=lambda t: -t[1])
+    for row, (cls, sc, _) in zip(got, expect):
+        assert int(row[0]) == cls
+        np.testing.assert_allclose(row[1], sc, rtol=1e-5)
+
+
+def test_bipartite_match_greedy_and_per_prediction():
+    dist = np.array([[0.9, 0.1, 0.6],
+                     [0.2, 0.8, 0.7]], np.float32)
+    out = run_op("bipartite_match", {"DistMat": [dist]}, {})
+    m = np.asarray(out["ColToRowMatchIndices"][0])[0]
+    # greedy: global max 0.9 -> (0,0); next max excluding row0/col0: 0.8 ->
+    # (1,1); col 2 unmatched
+    np.testing.assert_array_equal(m, [0, 1, -1])
+    out2 = run_op("bipartite_match", {"DistMat": [dist]},
+                  {"match_type": "per_prediction", "dist_threshold": 0.5})
+    m2 = np.asarray(out2["ColToRowMatchIndices"][0])[0]
+    np.testing.assert_array_equal(m2, [0, 1, 1])   # col2 best row=1 @ 0.7
+
+
+def test_matrix_nms_index_points_at_original_boxes():
+    m, c = 5, 2
+    boxes = R.uniform(0, 40, (m, 4)).astype(np.float32)
+    boxes[:, 2:] = boxes[:, :2] + 5
+    scores = R.rand(c, m).astype(np.float32)   # NOT sorted
+    out = run_op("matrix_nms", {"BBoxes": [boxes], "Scores": [scores]},
+                 {"score_threshold": 0.01, "post_threshold": 0.0,
+                  "nms_top_k": 3, "keep_top_k": 6,
+                  "background_label": -1})
+    o = np.asarray(out["Out"][0])
+    idx = np.asarray(out["Index"][0])[:, 0]
+    n = int(np.asarray(out["RoisNum"][0])[0])
+    for row, i in zip(o[:n], idx[:n]):
+        np.testing.assert_allclose(row[2:], boxes[i], rtol=1e-6,
+                                   err_msg="Index row must point at the "
+                                           "original box")
+
+
+def test_target_assign_negative_indices_weighted():
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    match = np.array([[2, -1, -1, 0]], np.int32)
+    neg = np.array([[1, -1, -1, -1]], np.int32)   # prior 1 mined negative
+    out = run_op("target_assign",
+                 {"X": [x], "MatchIndices": [match], "NegIndices": [neg]},
+                 {"mismatch_value": -9})
+    w = np.asarray(out["OutWeight"][0])[0, :, 0]
+    np.testing.assert_allclose(w, [1, 1, 0, 1])   # neg gets weight 1
+    o = np.asarray(out["Out"][0])[0]
+    np.testing.assert_allclose(o[1], -9)          # but value stays mismatch
+
+
+def test_target_assign_gathers_and_weights():
+    x = np.arange(12, np.float32).reshape(4, 3) \
+        if False else np.arange(12, dtype=np.float32).reshape(4, 3)
+    match = np.array([[2, -1, 0]], np.int32)
+    out = run_op("target_assign", {"X": [x], "MatchIndices": [match]},
+                 {"mismatch_value": -9})
+    o = np.asarray(out["Out"][0])[0]
+    np.testing.assert_allclose(o[0], x[2])
+    np.testing.assert_allclose(o[1], -9)
+    np.testing.assert_allclose(o[2], x[0])
+    w = np.asarray(out["OutWeight"][0])[0]
+    np.testing.assert_allclose(w[:, 0] if w.ndim == 2 else w, [1, 0, 1])
+
+
+def test_mine_hard_examples_max_negative():
+    cls_loss = np.array([[0.1, 0.9, 0.5, 0.8, 0.2, 0.7]], np.float32)
+    match = np.array([[3, -1, -1, -1, -1, -1]], np.int32)   # 1 positive
+    out = run_op("mine_hard_examples",
+                 {"ClsLoss": [cls_loss], "MatchIndices": [match]},
+                 {"neg_pos_ratio": 3.0})
+    flag = np.asarray(out["NegFlag"][0])[0]
+    # 3 hardest negatives: indices 1 (0.9), 3 (0.8), 5 (0.7)
+    np.testing.assert_array_equal(flag, [False, True, False, True, False,
+                                         True])
+    np.testing.assert_array_equal(
+        np.asarray(out["UpdatedMatchIndices"][0]), match)
+
+
+def test_box_decoder_and_assign():
+    prior = np.array([[0, 0, 10, 10]], np.float32)
+    pvar = np.full((1, 4), 1.0, np.float32)
+    deltas = np.zeros((1, 8), np.float32)      # 2 classes, zero deltas
+    score = np.array([[0.1, 0.9]], np.float32)
+    out = run_op("box_decoder_and_assign",
+                 {"PriorBox": [prior], "PriorBoxVar": [pvar],
+                  "TargetBox": [deltas], "BoxScore": [score]}, {})
+    dec = np.asarray(out["DecodeBox"][0]).reshape(1, 2, 4)
+    # zero deltas decode back to the prior (pixel convention)
+    np.testing.assert_allclose(dec[0, 0], [0, 0, 10, 10], atol=1e-4)
+    np.testing.assert_allclose(np.asarray(out["OutputAssignBox"][0])[0],
+                               [0, 0, 10, 10], atol=1e-4)
+
+
+def test_retinanet_detection_output_shapes():
+    a1, a2, c = 12, 6, 3
+    levels = [
+        ((R.randn(1, a1, 4) * 0.1).astype(np.float32),
+         R.rand(1, a1, c).astype(np.float32),
+         R.uniform(0, 50, (a1, 4)).astype(np.float32)),
+        ((R.randn(1, a2, 4) * 0.1).astype(np.float32),
+         R.rand(1, a2, c).astype(np.float32),
+         R.uniform(0, 50, (a2, 4)).astype(np.float32)),
+    ]
+    for _, _, anc in levels:
+        anc[:, 2:] = anc[:, :2] + np.abs(anc[:, 2:]) + 4
+    out = run_op("retinanet_detection_output",
+                 {"BBoxes": [lv[0] for lv in levels],
+                  "Scores": [lv[1] for lv in levels],
+                  "Anchors": [lv[2] for lv in levels],
+                  "ImInfo": [np.array([[64, 64, 1]], np.float32)]},
+                 {"score_threshold": 0.05, "nms_top_k": 10,
+                  "keep_top_k": 8, "nms_threshold": 0.3})
+    o = np.asarray(out["Out"][0])
+    assert o.shape == (8, 6)
+    n = int(np.asarray(out["NmsRoisNum"][0])[0])
+    assert 0 < n <= 8
+    assert (o[:n, 0] >= 0).all() and (o[n:, 0] == -1).all()
+
+
+def test_collect_fpn_proposals_layer_returns_rois_num():
+    """fluid.layers surface: with rois_num_per_level given, the 2.x
+    signature returns (fpn_rois, rois_num); level-count mismatch raises."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.testing import reset_programs
+    reset_programs(seed=0)
+    r = 6
+    rois = [layers.data(name=f"rois{i}", shape=[r, 4], dtype="float32",
+                        append_batch_size=False) for i in range(2)]
+    scores = [layers.data(name=f"sc{i}", shape=[r], dtype="float32",
+                          append_batch_size=False) for i in range(2)]
+    nums = [layers.data(name=f"n{i}", shape=[1], dtype="int32",
+                        append_batch_size=False) for i in range(2)]
+    got = layers.collect_fpn_proposals(rois, scores, 2, 3, post_nms_top_n=4,
+                                       rois_num_per_level=nums)
+    assert isinstance(got, tuple) and len(got) == 2
+    fpn, cnt = got
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    feed = {}
+    for i in range(2):
+        feed[f"rois{i}"] = R.uniform(0, 20, (r, 4)).astype(np.float32)
+        feed[f"sc{i}"] = R.rand(r).astype(np.float32)
+        feed[f"n{i}"] = np.array([3], np.int32)   # only 3 of 6 rows live
+    out, n = exe.run(feed=feed, fetch_list=[fpn, cnt])
+    assert np.asarray(out).shape == (4, 4)
+    assert int(np.asarray(n)[0]) == 4
+    with pytest.raises(ValueError, match="levels"):
+        layers.collect_fpn_proposals(rois, scores, 2, 5, post_nms_top_n=4)
+
+
+def test_distribute_fpn_proposals_masks_padded_rows():
+    """RoisNum input: rows past each image's live count belong to NO level
+    (regression: padding rows were routed to min_level and counted)."""
+    per, b = 6, 2
+    r = per * b
+    sizes = R.uniform(8, 448, r).astype(np.float32)
+    rois = np.zeros((r, 4), np.float32)
+    rois[:, 2] = sizes
+    rois[:, 3] = sizes
+    nums = np.array([4, 3], np.int32)            # live rows per image
+    live = np.concatenate([np.arange(per) < n for n in nums])
+    rois[~live] = 0.0                            # producer zero-padding
+    out = run_op("distribute_fpn_proposals",
+                 {"FpnRois": [rois], "RoisNum": [nums]},
+                 {"min_level": 2, "max_level": 5, "refer_level": 4,
+                  "refer_scale": 224})
+    counts = np.asarray(out["MultiLevelRoIsNum"][0])
+    assert counts.sum() == nums.sum(), \
+        f"padding rows routed to levels: {counts} vs {nums.sum()} live"
+    levels = np.floor(np.log2(sizes / 224 + 1e-6)) + 4
+    levels = np.clip(levels, 2, 5).astype(int)
+    for li in range(4):
+        assert counts[li] == ((levels == 2 + li) & live).sum()
+    # restore still reproduces the input (dead rows -> zero slots)
+    padded_cat = np.concatenate(
+        [np.asarray(out["MultiFpnRois"][li]) for li in range(4)])
+    restore = np.asarray(out["RestoreIndex"][0])[:, 0]
+    np.testing.assert_allclose(padded_cat[restore], rois, rtol=1e-6)
+
+
+def test_collect_fpn_proposals_unequal_level_sizes():
+    """Level blocks of different row counts must mask correctly
+    (regression: the mask used level 0's size for every level)."""
+    rois_a = R.uniform(0, 20, (5, 4)).astype(np.float32)
+    rois_b = R.uniform(0, 20, (3, 4)).astype(np.float32)
+    sc_a = np.array([0.9, 0.8, 0.7, -1e30, -1e30], np.float32)
+    sc_b = np.array([0.95, -1e30, -1e30], np.float32)
+    col = run_op("collect_fpn_proposals",
+                 {"MultiLevelRois": [rois_a, rois_b],
+                  "MultiLevelScores": [sc_a, sc_b],
+                  "MultiLevelRoIsNum": [np.array([3], np.int32),
+                                        np.array([1], np.int32)]},
+                 {"post_nms_topN": 3})
+    fpn = np.asarray(col["FpnRois"][0])
+    assert int(np.asarray(col["RoisNum"][0])[0]) == 3
+    # top-3 by score: b0 (0.95), a0 (0.9), a1 (0.8)
+    np.testing.assert_allclose(fpn, np.stack([rois_b[0], rois_a[0],
+                                              rois_a[1]]), rtol=1e-6)

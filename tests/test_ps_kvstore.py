@@ -277,3 +277,62 @@ def test_save_load_preserves_optimizer_state(tmp_path):
     np.testing.assert_allclose(c2.pull(0, keys, 2), c.pull(0, keys, 2),
                                rtol=1e-6)
     c.close(); c2.close(); srv.stop(); srv2.stop()
+
+
+def _train_ps_mode(k_steps, steps=24, seed=3):
+    """Train a tiny embedding regression against a fresh KV server in sync
+    (k_steps=0 → a_sync off) or geo (k_steps>0) mode; return the losses."""
+    from paddle_tpu.framework import program as pm, scope as sm, unique_name
+    from paddle_tpu.distributed import fleet
+    pm._main_program = pm.Program()
+    pm._startup_program = pm.Program()
+    sm._reset_global_scope()
+    unique_name.switch()
+    paddle.seed(0)
+
+    srv = KVServer([SparseTableConfig("geo_emb", dim=4, init_scale=0.1)])
+    port = srv.start(0)
+    try:
+        ids = fluid.layers.data(name="ids", shape=[3], dtype="int64")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        emb = distributed_embedding(ids, "geo_emb", dim=4, lr=0.2)
+        pred = fluid.layers.fc(layers.reshape(emb, [-1, 12]), size=1)
+        loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+
+        fleet.init(role_maker=fleet.UserDefinedRoleMaker(
+            server_endpoints=[f"127.0.0.1:{port}"]))
+        strategy = fleet.DistributedStrategy()
+        if k_steps:
+            strategy.a_sync = True
+            strategy.a_sync_configs = {"k_steps": k_steps}
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.1), strategy)
+        opt.minimize(loss)
+        fleet.init_worker()
+
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(seed)
+        idv = rng.randint(0, 50, (16, 3)).astype(np.int64)
+        yv = rng.randn(16, 1).astype(np.float32)
+        losses = []
+        for _ in range(steps):
+            lv, = exe.run(feed={"ids": idv, "y": yv}, fetch_list=[loss])
+            losses.append(float(lv))
+        return losses
+    finally:
+        srv.stop()
+
+
+def test_geo_sgd_convergence_parity_vs_sync():
+    """Reference bar (test_dist_base.py loss-delta asserts): geo-SGD with
+    k-step delta sync must track sync PS training — same data, same seeds,
+    final loss within tolerance and both strictly converging."""
+    sync = _train_ps_mode(0)
+    geo = _train_ps_mode(4)
+    assert sync[-1] < sync[0] * 0.5, f"sync did not converge: {sync}"
+    assert geo[-1] < geo[0] * 0.5, f"geo did not converge: {geo}"
+    # single-worker geo applies the same local updates, synced every k
+    # steps — final losses must agree within a small delta
+    assert abs(geo[-1] - sync[-1]) <= max(0.25 * sync[-1], 0.05), \
+        f"geo={geo[-1]:.4f} vs sync={sync[-1]:.4f}"

@@ -614,6 +614,61 @@ def test_chunk_eval_iob():
     np.testing.assert_allclose(float(np.asarray(out["Precision"][0])), 0.5)
 
 
+def test_chunk_eval_ioe():
+    # IOE: I-0=0 E-0=1 I-1=2 E-1=3 O=4
+    inf = np.array([[0, 1, 2, 3]], np.int64)   # chunks (0,1,0),(2,3,1)
+    lab = np.array([[0, 1, 4, 3]], np.int64)   # chunks (0,1,0),(3,3,1)
+    out = run_op("chunk_eval", {"Inference": [inf], "Label": [lab]},
+                 {"num_chunk_types": 2, "chunk_scheme": "IOE"})
+    assert int(np.asarray(out["NumInferChunks"][0])) == 2
+    assert int(np.asarray(out["NumLabelChunks"][0])) == 2
+    assert int(np.asarray(out["NumCorrectChunks"][0])) == 1
+    np.testing.assert_allclose(float(np.asarray(out["Precision"][0])), 0.5)
+
+
+def test_chunk_eval_iobes():
+    # IOBES: B-t=4t I-t=4t+1 E-t=4t+2 S-t=4t+3, O=8
+    inf = np.array([[3, 8, 4, 5, 6]], np.int64)  # (0,0,0),(2,4,1)
+    lab = np.array([[3, 8, 4, 5, 8]], np.int64)  # (0,0,0),(2,3,1)
+    out = run_op("chunk_eval", {"Inference": [inf], "Label": [lab]},
+                 {"num_chunk_types": 2, "chunk_scheme": "IOBES"})
+    assert int(np.asarray(out["NumInferChunks"][0])) == 2
+    assert int(np.asarray(out["NumCorrectChunks"][0])) == 1
+    np.testing.assert_allclose(float(np.asarray(out["Recall"][0])), 0.5)
+
+
+def test_chunk_eval_plain_groups_runs():
+    # plain: consecutive same-type tokens are ONE chunk (chunk_eval_op.h
+    # state machine with num_tag_types=1), not per-token chunks
+    inf = np.array([[0, 0, 1, 2]], np.int64)   # runs (0,1,0),(2,2,1); 2=O
+    lab = np.array([[0, 0, 1, 2]], np.int64)
+    out = run_op("chunk_eval", {"Inference": [inf], "Label": [lab]},
+                 {"num_chunk_types": 2, "chunk_scheme": "plain"})
+    assert int(np.asarray(out["NumInferChunks"][0])) == 2
+    assert int(np.asarray(out["NumCorrectChunks"][0])) == 2
+    np.testing.assert_allclose(float(np.asarray(out["F1-Score"][0])), 1.0)
+
+
+def test_chunk_eval_excluded_types():
+    # same data as the IOB test; excluding type 0 removes the only match
+    inf = np.array([[0, 1, 4, 2, 3]], np.int64)
+    lab = np.array([[0, 1, 4, 2, 4]], np.int64)
+    out = run_op("chunk_eval", {"Inference": [inf], "Label": [lab]},
+                 {"num_chunk_types": 2, "chunk_scheme": "IOB",
+                  "excluded_chunk_types": [0]})
+    assert int(np.asarray(out["NumInferChunks"][0])) == 1
+    assert int(np.asarray(out["NumLabelChunks"][0])) == 1
+    assert int(np.asarray(out["NumCorrectChunks"][0])) == 0
+    np.testing.assert_allclose(float(np.asarray(out["Precision"][0])), 0.0)
+
+
+def test_chunk_eval_unknown_scheme_raises():
+    inf = np.array([[0]], np.int64)
+    with pytest.raises(Exception, match="chunk scheme"):
+        run_op("chunk_eval", {"Inference": [inf], "Label": [inf]},
+               {"num_chunk_types": 2, "chunk_scheme": "BIO2"})
+
+
 def test_teacher_student_sigmoid_loss():
     x = np.array([0.0, 2.0], np.float32)
     lbl = np.array([1.0, 0.0], np.float32)
