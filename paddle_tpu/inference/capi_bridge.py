@@ -1,0 +1,53 @@
+"""Python side of the C inference API (native/capi.cc).
+
+The C shim embeds CPython and drives this module: `create` / `io_names` /
+`run_raw` marshal tensors as (name, dtype, shape, bytes) tuples across the
+C ABI. Reference counterpart: paddle/fluid/inference/capi/pd_predictor.cc —
+there the marshalling targets the C++ AnalysisPredictor; here it targets
+the XLA Predictor (inference/__init__.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def create(model_dir: str):
+    from . import Config, Predictor
+    return Predictor(Config(model_dir))
+
+
+def io_names(pred):
+    return (list(pred.get_input_names()), list(pred.get_output_names()))
+
+
+def run_raw(pred, inputs):
+    """inputs: [(name, dtype_str, shape_tuple, raw_bytes)] -> same shape
+    list for the outputs (contiguous buffers, library-owned on the C side).
+    """
+    for name, dt, shape, buf in inputs:
+        arr = np.frombuffer(buf, dtype=np.dtype(dt)).reshape(shape)
+        pred.get_input_handle(name).copy_from_cpu(arr)
+    outs = pred.run()
+    res = []
+    for name, arr in zip(pred.get_output_names(), outs):
+        a = np.ascontiguousarray(arr)
+        res.append((name, str(a.dtype), tuple(int(d) for d in a.shape),
+                    a.tobytes()))
+    return res
+
+
+def build_capi():
+    """Compile native/capi.cc against the running interpreter's headers and
+    return the shared-library path (for C consumers to dlopen/link)."""
+    import os
+    import sysconfig
+    from ..native import load_native
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    pyver = f"python{sysconfig.get_python_version()}"
+    flags = [f"-I{inc}", f"-L{libdir}", f"-l{pyver}"]
+    handle = load_native("capi", extra_flags=tuple(flags))
+    if handle is None:
+        return None
+    from ..native import _DIR
+    return os.path.join(_DIR, "libcapi.so")

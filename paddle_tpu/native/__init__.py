@@ -24,13 +24,24 @@ def load_native(name: str, extra_flags=()):
         return _cache[name]
     src = os.path.join(_DIR, f"{name}.cc")
     lib = os.path.join(_DIR, f"lib{name}.so")
+
+    def _build():
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+               "-o", lib, src, "-lpthread", *extra_flags]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+
     try:
         if (not os.path.exists(lib)
                 or os.path.getmtime(lib) < os.path.getmtime(src)):
-            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                   "-o", lib, src, "-lpthread", *extra_flags]
-            subprocess.run(cmd, check=True, capture_output=True, text=True)
-        handle = ctypes.CDLL(lib)
+            _build()
+        try:
+            handle = ctypes.CDLL(lib)
+        except OSError:
+            # a stale .so (e.g. linked against another interpreter's
+            # libpython) dlopen-fails even though the toolchain works —
+            # rebuild once against the current environment
+            _build()
+            handle = ctypes.CDLL(lib)
     except (OSError, subprocess.CalledProcessError) as e:
         detail = getattr(e, "stderr", str(e))
         warnings.warn(f"native component {name!r} unavailable "

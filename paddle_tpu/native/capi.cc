@@ -1,0 +1,283 @@
+// C inference API: a C-ABI surface over the XLA inference engine.
+//
+// Reference counterpart: paddle/fluid/inference/capi/paddle_c_api.h
+// (PD_Predictor / PD_ZeroCopyTensor create-run-destroy surface, consumed by
+// the C and Go bindings — go/paddle/predictor.go). There the C API wraps the
+// C++ AnalysisPredictor; the TPU build's engine is the Python/XLA Predictor
+// (paddle_tpu/inference/__init__.py), so this shim embeds CPython: each call
+// grabs the GIL, drives paddle_tpu.inference.capi_bridge, and marshals
+// tensors as raw buffers. PD_PredictorClone shares device weights for
+// multi-threaded serving exactly like AnalysisPredictor::Clone.
+//
+// Exported surface (see PD_* below): Init/Finalize, PredictorCreate /
+// Clone / Destroy, input/output introspection, Run, FreeOutputs,
+// GetLastError. All functions are thread-safe: Python access is serialized
+// by the GIL; XLA executes outside it.
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#define PD_CAPI_EXPORT extern "C" __attribute__((visibility("default")))
+
+// ---- public types ---------------------------------------------------------
+
+enum PD_DataType { PD_FLOAT32 = 0, PD_INT32 = 1, PD_INT64 = 2 };
+
+typedef struct PD_CTensor {
+  char name[64];
+  int dtype;     // PD_DataType
+  int ndim;
+  int64_t shape[8];
+  void* data;        // input: caller-owned; output: owned by the library,
+  size_t byte_len;   //         release with PD_FreeOutputs
+} PD_CTensor;
+
+typedef struct PD_Predictor PD_Predictor;  // opaque
+
+// ---- error handling -------------------------------------------------------
+
+static thread_local std::string g_last_error;
+
+static void set_error_from_python() {
+  PyObject *ptype, *pvalue, *ptraceback;
+  PyErr_Fetch(&ptype, &pvalue, &ptraceback);
+  PyErr_NormalizeException(&ptype, &pvalue, &ptraceback);
+  g_last_error = "python error";
+  if (pvalue) {
+    PyObject* s = PyObject_Str(pvalue);
+    if (s) {
+      g_last_error = PyUnicode_AsUTF8(s) ? PyUnicode_AsUTF8(s) : "?";
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(ptype);
+  Py_XDECREF(pvalue);
+  Py_XDECREF(ptraceback);
+}
+
+PD_CAPI_EXPORT const char* PD_GetLastError() { return g_last_error.c_str(); }
+
+// ---- interpreter lifecycle ------------------------------------------------
+
+static std::once_flag g_init_once;
+static bool g_we_initialized = false;
+
+static void ensure_python() {
+  std::call_once(g_init_once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      g_we_initialized = true;
+      // release the GIL acquired by initialization so any thread
+      // (including this one, via PyGILState_Ensure) can take it
+      PyEval_SaveThread();
+    }
+  });
+}
+
+PD_CAPI_EXPORT int PD_Init() {
+  ensure_python();
+  return 0;
+}
+
+PD_CAPI_EXPORT void PD_Finalize() {
+  // embedded-interpreter teardown is deliberately a no-op: jax/XLA keep
+  // background threads whose teardown at Py_Finalize is unsafe; the OS
+  // reclaims everything at process exit (the reference C API likewise
+  // leaks its singletons on exit)
+}
+
+struct PD_Predictor {
+  PyObject* obj;  // paddle_tpu Predictor (bridge-owned reference)
+  std::vector<std::string> in_names, out_names;
+};
+
+// RAII GIL scope
+struct Gil {
+  PyGILState_STATE st;
+  Gil() { st = PyGILState_Ensure(); }
+  ~Gil() { PyGILState_Release(st); }
+};
+
+static PyObject* bridge() {  // borrowed-style: cached module reference
+  static PyObject* mod = nullptr;
+  if (!mod) {
+    mod = PyImport_ImportModule("paddle_tpu.inference.capi_bridge");
+  }
+  return mod;
+}
+
+static bool fill_names(PD_Predictor* p) {
+  PyObject* names =
+      PyObject_CallMethod(bridge(), "io_names", "O", p->obj);
+  if (!names) return false;
+  // (in_names, out_names) tuple of str lists
+  for (int side = 0; side < 2; ++side) {
+    PyObject* lst = PyTuple_GetItem(names, side);
+    auto& dst = side == 0 ? p->in_names : p->out_names;
+    for (Py_ssize_t i = 0; i < PyList_Size(lst); ++i) {
+      dst.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(lst, i)));
+    }
+  }
+  Py_DECREF(names);
+  return true;
+}
+
+PD_CAPI_EXPORT PD_Predictor* PD_PredictorCreate(const char* model_dir) {
+  ensure_python();
+  Gil gil;
+  if (!bridge()) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject* obj = PyObject_CallMethod(bridge(), "create", "s", model_dir);
+  if (!obj) {
+    set_error_from_python();
+    return nullptr;
+  }
+  auto* p = new PD_Predictor{obj, {}, {}};
+  if (!fill_names(p)) {
+    set_error_from_python();
+    Py_DECREF(obj);
+    delete p;
+    return nullptr;
+  }
+  return p;
+}
+
+PD_CAPI_EXPORT PD_Predictor* PD_PredictorClone(PD_Predictor* src) {
+  Gil gil;
+  PyObject* obj = PyObject_CallMethod(src->obj, "clone", nullptr);
+  if (!obj) {
+    set_error_from_python();
+    return nullptr;
+  }
+  return new PD_Predictor{obj, src->in_names, src->out_names};
+}
+
+PD_CAPI_EXPORT void PD_PredictorDestroy(PD_Predictor* p) {
+  if (!p) return;
+  Gil gil;
+  Py_DECREF(p->obj);
+  delete p;
+}
+
+PD_CAPI_EXPORT int PD_PredictorNumInputs(PD_Predictor* p) {
+  return static_cast<int>(p->in_names.size());
+}
+PD_CAPI_EXPORT int PD_PredictorNumOutputs(PD_Predictor* p) {
+  return static_cast<int>(p->out_names.size());
+}
+PD_CAPI_EXPORT const char* PD_PredictorInputName(PD_Predictor* p, int i) {
+  return p->in_names.at(i).c_str();
+}
+PD_CAPI_EXPORT const char* PD_PredictorOutputName(PD_Predictor* p, int i) {
+  return p->out_names.at(i).c_str();
+}
+
+static const char* dtype_str(int dt) {
+  switch (dt) {
+    case PD_FLOAT32: return "float32";
+    case PD_INT32: return "int32";
+    case PD_INT64: return "int64";
+  }
+  return nullptr;
+}
+
+static int dtype_code(const char* s) {
+  if (!strcmp(s, "float32")) return PD_FLOAT32;
+  if (!strcmp(s, "int32")) return PD_INT32;
+  if (!strcmp(s, "int64")) return PD_INT64;
+  return -1;
+}
+
+PD_CAPI_EXPORT void PD_FreeOutputs(PD_CTensor* outputs, int n_out);
+
+// Run: inputs are caller-owned raw buffers; outputs are malloc'd by the
+// library (data too) and released with PD_FreeOutputs.
+PD_CAPI_EXPORT int PD_PredictorRun(PD_Predictor* p, const PD_CTensor* inputs,
+                                   int n_in, PD_CTensor** outputs,
+                                   int* n_out) {
+  Gil gil;
+  PyObject* feed = PyList_New(n_in);
+  for (int i = 0; i < n_in; ++i) {
+    const PD_CTensor& t = inputs[i];
+    const char* dt = dtype_str(t.dtype);
+    if (!dt) {
+      Py_DECREF(feed);
+      g_last_error = "unsupported input dtype code";
+      return -1;
+    }
+    if (t.ndim < 0 || t.ndim > 8) {
+      Py_DECREF(feed);
+      g_last_error = "input ndim out of range (max 8)";
+      return -1;
+    }
+    // name may legally fill all 64 bytes without a NUL — bound the read
+    std::string nm(t.name, strnlen(t.name, sizeof(t.name)));
+    PyObject* shape = PyTuple_New(t.ndim);
+    for (int d = 0; d < t.ndim; ++d) {
+      PyTuple_SetItem(shape, d, PyLong_FromLongLong(t.shape[d]));
+    }
+    PyObject* buf = PyBytes_FromStringAndSize(
+        static_cast<const char*>(t.data), t.byte_len);
+    PyObject* item =
+        Py_BuildValue("(s s N N)", nm.c_str(), dt, shape, buf);
+    if (!item) {
+      set_error_from_python();
+      Py_DECREF(feed);
+      return -1;
+    }
+    PyList_SetItem(feed, i, item);
+  }
+  PyObject* res =
+      PyObject_CallMethod(bridge(), "run_raw", "OO", p->obj, feed);
+  Py_DECREF(feed);
+  if (!res) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_ssize_t n = PyList_Size(res);
+  auto* outs = static_cast<PD_CTensor*>(calloc(n, sizeof(PD_CTensor)));
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* item = PyList_GetItem(res, i);  // (name, dtype, shape, bytes)
+    const char* nm = PyUnicode_AsUTF8(PyTuple_GetItem(item, 0));
+    const char* dt = PyUnicode_AsUTF8(PyTuple_GetItem(item, 1));
+    PyObject* shape = PyTuple_GetItem(item, 2);
+    PyObject* data = PyTuple_GetItem(item, 3);
+    snprintf(outs[i].name, sizeof(outs[i].name), "%s", nm);
+    outs[i].dtype = dtype_code(dt);
+    outs[i].ndim = static_cast<int>(PyTuple_Size(shape));
+    if (outs[i].dtype < 0 || outs[i].ndim > 8) {
+      g_last_error = std::string("output ") + nm +
+                     (outs[i].dtype < 0
+                          ? std::string(": unsupported dtype ") + dt
+                          : ": rank above 8");
+      PD_FreeOutputs(outs, static_cast<int>(i));
+      Py_DECREF(res);
+      return -1;
+    }
+    for (int d = 0; d < outs[i].ndim; ++d) {
+      outs[i].shape[d] = PyLong_AsLongLong(PyTuple_GetItem(shape, d));
+    }
+    char* raw;
+    Py_ssize_t len;
+    PyBytes_AsStringAndSize(data, &raw, &len);
+    outs[i].byte_len = static_cast<size_t>(len);
+    outs[i].data = malloc(len);
+    memcpy(outs[i].data, raw, len);
+  }
+  Py_DECREF(res);
+  *outputs = outs;
+  *n_out = static_cast<int>(n);
+  return 0;
+}
+
+PD_CAPI_EXPORT void PD_FreeOutputs(PD_CTensor* outputs, int n_out) {
+  if (!outputs) return;
+  for (int i = 0; i < n_out; ++i) free(outputs[i].data);
+  free(outputs);
+}
