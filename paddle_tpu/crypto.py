@@ -1,0 +1,150 @@
+"""Model encryption: AESCipher / CipherFactory / CipherUtils.
+
+Reference counterpart: framework/io/crypto/ (aes_cipher.cc, cipher.cc,
+cipher_utils.cc) exposed through pybind/crypto.cc as paddle.fluid.core
+Cipher/CipherFactory/CipherUtils. The primitive set lives in
+native/crypto.cc (AES-CTR + HMAC-SHA256 AEAD, built from the FIPS specs —
+see that file's header); this module is the reference-shaped surface plus
+model-directory helpers for encrypting a saved inference model at rest.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Dict, Optional
+
+__all__ = ["AESCipher", "CipherFactory", "CipherUtils",
+           "encrypt_inference_model", "decrypt_inference_model"]
+
+_OVERHEAD = 48        # iv[16] + hmac-sha256 tag[32]
+
+
+def _lib():
+    from .native import load_native
+    lib = load_native("crypto")
+    if lib is None:
+        raise RuntimeError("native crypto component unavailable")
+    lib.pd_crypto_encrypt.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.c_int, ctypes.c_char_p]
+    lib.pd_crypto_decrypt.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.c_int, ctypes.c_char_p]
+    return lib
+
+
+class AESCipher:
+    """Authenticated AES cipher (reference AESCipher, aes_cipher.cc).
+    `bits` selects AES-128 or AES-256 for the CTR keystream."""
+
+    def __init__(self, bits: int = 256):
+        assert bits in (128, 256), "AES-128 or AES-256"
+        self.bits = bits
+        self._lib = _lib()
+
+    def encrypt(self, plaintext: bytes, key: bytes) -> bytes:
+        if isinstance(plaintext, str):
+            plaintext = plaintext.encode()
+        out = ctypes.create_string_buffer(len(plaintext) + _OVERHEAD)
+        rc = self._lib.pd_crypto_encrypt(plaintext, len(plaintext), key,
+                                         len(key), self.bits, out)
+        if rc != 0:
+            raise ValueError("encryption failed")
+        return out.raw
+
+    def decrypt(self, ciphertext: bytes, key: bytes) -> bytes:
+        if len(ciphertext) < _OVERHEAD:
+            raise ValueError("ciphertext too short")
+        out = ctypes.create_string_buffer(
+            max(1, len(ciphertext) - _OVERHEAD))
+        rc = self._lib.pd_crypto_decrypt(ciphertext, len(ciphertext), key,
+                                         len(key), self.bits, out)
+        if rc == -2:
+            raise ValueError(
+                "decryption failed: authentication tag mismatch "
+                "(wrong key or tampered data)")
+        if rc != 0:
+            raise ValueError("decryption failed")
+        return out.raw[:len(ciphertext) - _OVERHEAD]
+
+    def encrypt_to_file(self, plaintext: bytes, key: bytes, filename: str):
+        with open(filename, "wb") as f:
+            f.write(self.encrypt(plaintext, key))
+
+    def decrypt_from_file(self, key: bytes, filename: str) -> bytes:
+        with open(filename, "rb") as f:
+            return self.decrypt(f.read(), key)
+
+
+class CipherFactory:
+    """reference CipherFactory::CreateCipher(config_file): config lines of
+    `key=value`; honored keys: cipher_name (AES_CTR_NoPadding only here),
+    aes_key_bits (128/256)."""
+
+    @staticmethod
+    def create_cipher(config_file: Optional[str] = None) -> AESCipher:
+        bits = 256
+        if config_file:
+            cfg = CipherUtils.load_config(config_file)
+            bits = int(cfg.get("aes_key_bits", "256"))
+        return AESCipher(bits)
+
+
+class CipherUtils:
+    """reference CipherUtils (cipher_utils.cc): key generation + config."""
+
+    @staticmethod
+    def gen_key(length_bits: int) -> bytes:
+        assert length_bits % 8 == 0
+        return os.urandom(length_bits // 8)
+
+    @staticmethod
+    def gen_key_to_file(length_bits: int, filename: str) -> bytes:
+        k = CipherUtils.gen_key(length_bits)
+        with open(filename, "wb") as f:
+            f.write(k)
+        return k
+
+    @staticmethod
+    def read_key_from_file(filename: str) -> bytes:
+        with open(filename, "rb") as f:
+            return f.read()
+
+    @staticmethod
+    def load_config(filename: str) -> Dict[str, str]:
+        out = {}
+        with open(filename) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#") or "=" not in line:
+                    continue
+                k, v = line.split("=", 1)
+                out[k.strip()] = v.strip()
+        return out
+
+
+_MODEL_FILES = ("__model__", "params.npz", "params")
+
+
+def encrypt_inference_model(model_dir: str, key: bytes, bits: int = 256):
+    """Encrypt a save_inference_model directory in place (model topology +
+    params). The reference encrypts the same two artifacts with
+    EncryptToFile; file names gain a '.enc' suffix."""
+    c = AESCipher(bits)
+    for name in _MODEL_FILES:
+        p = os.path.join(model_dir, name)
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                c.encrypt_to_file(f.read(), key, p + ".enc")
+            os.remove(p)
+
+
+def decrypt_inference_model(model_dir: str, key: bytes, bits: int = 256):
+    """Inverse of encrypt_inference_model: restores the plain files so
+    Predictor/load_inference_model can consume the directory."""
+    c = AESCipher(bits)
+    for name in _MODEL_FILES:
+        p = os.path.join(model_dir, name + ".enc")
+        if os.path.exists(p):
+            with open(os.path.join(model_dir, name), "wb") as f:
+                f.write(c.decrypt_from_file(key, p))

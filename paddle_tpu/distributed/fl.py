@@ -1,0 +1,111 @@
+"""Federated learning on the KV transport: data stays local, weights travel.
+
+Reference counterpart: operators/distributed_ops/fl_listen_and_serv_op.cc:83
+(FlListenAndServOp::RunSyncLoop) — trainers keep their data private, run
+local optimizer steps, and the server block aggregates the uploaded weights
+once per round, gated on a per-round barrier.
+
+TPU-native shape: no new server code at all — the round is a pure protocol
+over the existing pieces:
+
+* globals live in the native KV service (one dense table per parameter,
+  key 0, dim = param size; native/kvstore.cc) — the same process that
+  serves sparse PS training can serve FL;
+* each round a trainer pulls the globals, runs E LOCAL steps on its
+  PRIVATE shard (only this process ever touches that data), and pushes
+  ``(w_local - w_global) * (n_i / N)`` through the geo PUSH_DELTA merge —
+  the additive server merge then yields exactly the FedAvg weighted mean
+  ``sum_i n_i w_i / N``;
+* the round gate is a gloo barrier carrying each trainer's sample count,
+  so N is exact per round (the reference gates on kOptimizeBlocks
+  completion the same way).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+import numpy as np
+
+from .gloo import Gloo
+from .ps import KVClient, KVServer, SparseTableConfig
+
+
+class FLServer:
+    """Round-passive FL server: a KV service with one dense table per
+    parameter. The aggregation rule (weighted mean) is realized by the
+    delta protocol, so the server needs no FL-specific code path."""
+
+    def __init__(self, param_spec: Dict[str, int], seed: int = 0):
+        """param_spec: name -> flattened parameter size."""
+        self.names = sorted(param_spec)
+        self.dims = [int(param_spec[n]) for n in self.names]
+        self.server = KVServer(
+            [SparseTableConfig(n, d, init_scale=0.0, optimizer="sgd")
+             for n, d in zip(self.names, self.dims)], seed=seed)
+        self.port = self.server.start()
+
+    def stop(self):
+        self.server.stop()
+
+
+class FLTrainer:
+    """One federated participant. Drives rounds against an FLServer and a
+    rank-0-hosted gloo store for the round barrier."""
+
+    def __init__(self, host: str, port: int,
+                 param_spec: Dict[str, int], rank: int, world_size: int,
+                 store_addr: str = None, store_port: int = 0):
+        self.names = sorted(param_spec)
+        self.dims = [int(param_spec[n]) for n in self.names]
+        self.kv = KVClient(host, int(port), worker_id=rank)
+        if rank == 0:
+            self.gloo = Gloo(rank=0, world_size=world_size, port=store_port)
+        else:
+            assert store_addr, "non-zero ranks need the rank-0 store addr"
+            self.gloo = Gloo(rank=rank, world_size=world_size,
+                             store_addr=store_addr)
+        self.rank = rank
+        self.world = world_size
+        self._zero_key = np.zeros(1, np.int64)
+
+    @property
+    def store_port(self) -> int:
+        return self.gloo.store_port
+
+    def init_globals(self, params: Dict[str, np.ndarray]):
+        """Rank 0 seeds the server with the initial model; everyone else
+        waits at the barrier so no round starts on uninitialized rows."""
+        if self.rank == 0:
+            for ti, n in enumerate(self.names):
+                cur = self.kv.pull(ti, self._zero_key, self.dims[ti])[0]
+                delta = params[n].astype(np.float32).ravel() - cur
+                self.kv.push_delta(ti, self._zero_key, delta[None, :])
+        self.gloo.barrier()
+
+    def pull_globals(self) -> Dict[str, np.ndarray]:
+        return {n: self.kv.pull(ti, self._zero_key, self.dims[ti])[0].copy()
+                for ti, n in enumerate(self.names)}
+
+    def run_round(self, local_train: Callable[[Dict[str, np.ndarray]],
+                                              Dict[str, np.ndarray]],
+                  num_samples: int) -> Dict[str, np.ndarray]:
+        """One FL round: pull -> LOCAL training on private data -> push the
+        sample-weighted delta -> barrier -> pull the aggregated model.
+        `local_train` receives the global weights and returns the locally
+        trained weights; its data never enters this function."""
+        w_global = self.pull_globals()
+        w_local = local_train({n: v.copy() for n, v in w_global.items()})
+        # exchange sample counts so every trainer scales by the true N
+        counts = self.gloo.all_gather(int(num_samples))
+        total = float(sum(counts))
+        scale = num_samples / total
+        for ti, n in enumerate(self.names):
+            delta = (w_local[n].astype(np.float32).ravel()
+                     - w_global[n]) * scale
+            self.kv.push_delta(ti, self._zero_key, delta[None, :])
+        self.gloo.barrier()      # all deltas merged before anyone pulls
+        return self.pull_globals()
+
+    def close(self):
+        self.kv.close()
+        self.gloo.close()

@@ -1,0 +1,117 @@
+"""Model encryption (reference framework/io/crypto/: aes_cipher_test.cc,
+cipher_utils_test.cc patterns): FIPS test vectors for the primitives, AEAD
+round-trip/tamper/wrong-key behavior, key utils, and an encrypted
+inference-model round trip through the Predictor."""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.crypto import (AESCipher, CipherFactory, CipherUtils,
+                               decrypt_inference_model,
+                               encrypt_inference_model)
+
+
+def _raw():
+    from paddle_tpu.native import load_native
+    lib = load_native("crypto")
+    if lib is None:
+        pytest.skip("toolchain unavailable")
+    return lib
+
+
+def test_sha256_fips_vector():
+    lib = _raw()
+    out = ctypes.create_string_buffer(32)
+    lib.pd_crypto_sha256(b"abc", 3, out)
+    assert out.raw.hex() == ("ba7816bf8f01cfea414140de5dae2223"
+                             "b00361a396177a9cb410ff61f20015ad")
+    lib.pd_crypto_sha256(b"", 0, out)
+    assert out.raw.hex() == ("e3b0c44298fc1c149afbf4c8996fb924"
+                             "27ae41e4649b934ca495991b7852b855")
+
+
+def test_aes_fips197_vectors():
+    """FIPS-197 appendix C block-cipher vectors."""
+    lib = _raw()
+    pt = bytes.fromhex("00112233445566778899aabbccddeeff")
+    out = ctypes.create_string_buffer(16)
+    key128 = bytes.fromhex("000102030405060708090a0b0c0d0e0f")
+    assert lib.pd_crypto_aes_block(key128, 128, pt, out) == 0
+    assert out.raw.hex() == "69c4e0d86a7b0430d8cdb78070b4c55a"
+    key256 = bytes.fromhex("000102030405060708090a0b0c0d0e0f"
+                           "101112131415161718191a1b1c1d1e1f")
+    assert lib.pd_crypto_aes_block(key256, 256, pt, out) == 0
+    assert out.raw.hex() == "8ea2b7ca516745bfeafc49904b496089"
+
+
+@pytest.mark.parametrize("bits", [128, 256])
+def test_roundtrip_and_iv_freshness(bits):
+    c = AESCipher(bits)
+    key = CipherUtils.gen_key(256)
+    msg = os.urandom(1000) + b"tail"
+    ct1 = c.encrypt(msg, key)
+    ct2 = c.encrypt(msg, key)
+    assert len(ct1) == len(msg) + 48
+    assert ct1 != ct2, "IV must be fresh per encryption"
+    assert c.decrypt(ct1, key) == msg
+    assert c.decrypt(ct2, key) == msg
+    assert msg not in ct1
+
+
+def test_tamper_and_wrong_key_detected():
+    c = AESCipher()
+    key = CipherUtils.gen_key(128)
+    ct = bytearray(c.encrypt(b"model bytes", key))
+    ct[20] ^= 1                                   # flip a ciphertext bit
+    with pytest.raises(ValueError, match="tag mismatch"):
+        c.decrypt(bytes(ct), key)
+    ct[20] ^= 1                                   # restore
+    with pytest.raises(ValueError, match="tag mismatch"):
+        c.decrypt(bytes(ct), CipherUtils.gen_key(128))
+    assert c.decrypt(bytes(ct), key) == b"model bytes"
+
+
+def test_cipher_utils_and_factory(tmp_path):
+    kf = str(tmp_path / "k.bin")
+    k = CipherUtils.gen_key_to_file(256, kf)
+    assert len(k) == 32 and CipherUtils.read_key_from_file(kf) == k
+    cfgf = str(tmp_path / "cipher.conf")
+    with open(cfgf, "w") as f:
+        f.write("# comment\ncipher_name=AES_CTR_NoPadding\naes_key_bits"
+                "=128\n")
+    c = CipherFactory.create_cipher(cfgf)
+    assert c.bits == 128
+    assert CipherFactory.create_cipher().bits == 256
+
+
+def test_encrypted_inference_model_roundtrip(tmp_path):
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.testing import reset_programs
+    reset_programs(seed=0)
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    p = layers.fc(x, 2)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    d = str(tmp_path / "m")
+    fluid.io.save_inference_model(d, ["x"], [p], exe)
+    xv = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+
+    from paddle_tpu.inference import Config, Predictor
+    ref = Predictor(Config(d))
+    ref.get_input_handle("x").copy_from_cpu(xv)
+    want = np.asarray(ref.run()[0])
+
+    key = CipherUtils.gen_key(256)
+    encrypt_inference_model(d, key)
+    assert not os.path.exists(os.path.join(d, "__model__"))
+    with pytest.raises(Exception):
+        Predictor(Config(d))                  # at-rest form is unreadable
+
+    decrypt_inference_model(d, key)
+    pred = Predictor(Config(d))
+    pred.get_input_handle("x").copy_from_cpu(xv)
+    np.testing.assert_allclose(np.asarray(pred.run()[0]), want, rtol=1e-6)
