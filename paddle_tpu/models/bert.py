@@ -163,13 +163,24 @@ def bert_pretrain_loss(seq_out, mlm_labels, cfg: BertConfig):
     return layers.mean(loss)
 
 
-def build_pretrain_program(cfg: BertConfig):
-    """Declare data vars + full pretrain graph; returns (ids, labels, loss)."""
+def build_pretrain_program(cfg: BertConfig, use_input_mask=False):
+    """Declare data vars + full pretrain graph; returns (ids, labels, loss).
+
+    With `use_input_mask`, a float `input_mask` feed (1 = real token,
+    0 = pad, shape [B, S]) becomes an additive [-1e9/0] key-padding mask
+    [B,1,1,S] that rides into the attention kernels — the padded-batch
+    real-data path (reference: bert_encoder_functor.cu masks in-kernel)."""
     input_ids = layers.data(name="input_ids", shape=[cfg.seq_len],
                             dtype="int64")
     mlm_labels = layers.data(name="mlm_labels", shape=[cfg.seq_len, 1],
                              dtype="int64")
-    seq = bert_encoder(input_ids, cfg)
+    attn_mask = None
+    if use_input_mask:
+        input_mask = layers.data(name="input_mask", shape=[cfg.seq_len],
+                                 dtype="float32")
+        attn_mask = layers.unsqueeze(
+            layers.scale(input_mask, scale=1e9, bias=-1e9), [1, 2])
+    seq = bert_encoder(input_ids, cfg, attn_mask=attn_mask)
     loss = bert_pretrain_loss(seq, mlm_labels, cfg)
     aux = getattr(seq, "_moe_aux_losses", None)
     if aux:   # switch_moe load-balancing term (Switch eq. 4, scale 0.01)

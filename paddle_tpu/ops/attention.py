@@ -49,11 +49,16 @@ def _flash_probe():
             from .pallas.flash_attention import flash_attention
             x = jnp.zeros((1, 1, 256, 64), jnp.bfloat16)
 
+            m = jnp.zeros((1, 1, 1, 256), jnp.float32)
+
             def f(q):
                 plain = flash_attention(q, x, x, None, False, 128, 128)
                 dropped = flash_attention(q, x, x, None, False, 128, 128,
                                           dropout=0.1, seed=1)
-                return jnp.sum((plain + dropped).astype(jnp.float32))
+                masked = flash_attention(q, x, x, None, False, 128, 128,
+                                         dropout=0.1, seed=2, mask=m)
+                return jnp.sum((plain + dropped + masked)
+                               .astype(jnp.float32))
 
             jax.jit(jax.grad(f))(x).block_until_ready()
             _flash_probe_ok = True
@@ -64,6 +69,19 @@ def _flash_probe():
                 f"using the XLA attention path")
             _flash_probe_ok = False
     return _flash_probe_ok
+
+
+def _mask_flashable(mask, q):
+    """Additive masks the kernels take in-kernel: any shape broadcastable to
+    [B, nh, S(or 1), S]. Anything else (e.g. per-example ragged objects)
+    falls back to the dense path."""
+    b, nh, s, _ = q.shape
+    shp = tuple(getattr(mask, "shape", ()))
+    if len(shp) > 4 or not shp:
+        return False
+    shp = (1,) * (4 - len(shp)) + shp
+    return (shp[3] == s and shp[0] in (1, b) and shp[1] in (1, nh)
+            and shp[2] in (1, s))
 
 
 def _use_pallas(q):
@@ -106,8 +124,9 @@ def _fused_attention(ctx, ins, attrs):
                   if attrs.get("sp_mode") == "ulysses" else ring_attention)
             return {"Out": [fn(q, k, v, mesh=mesh, scale=scale,
                                causal=causal)]}
-    if not ctx.is_eval_shape and mask is None \
-            and not isinstance(q, jax.ShapeDtypeStruct) and _use_pallas(q):
+    if not ctx.is_eval_shape \
+            and not isinstance(q, jax.ShapeDtypeStruct) and _use_pallas(q) \
+            and (mask is None or _mask_flashable(mask, q)):
         try:
             from .pallas.flash_attention import flash_attention
             seed = None
@@ -119,7 +138,7 @@ def _fused_attention(ctx, ins, attrs):
                     jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
             return {"Out": [flash_attention(q, k, v, scale=scale,
                                             causal=causal, dropout=dropout,
-                                            seed=seed)]}
+                                            seed=seed, mask=mask)]}
         except Exception as e:  # pragma: no cover - kernel/platform specific
             global _warned_fallback
             if not _warned_fallback:
@@ -128,9 +147,10 @@ def _fused_attention(ctx, ins, attrs):
                     f"pallas flash attention unavailable ({e!r}); "
                     f"using the XLA attention path")
                 _warned_fallback = True
-    if causal and mask is None:
+    if causal:
         s = q.shape[2]
-        mask = jnp.triu(jnp.full((s, s), -1e9, jnp.float32), 1)[None, None]
+        tri = jnp.triu(jnp.full((s, s), -1e9, jnp.float32), 1)[None, None]
+        mask = tri if mask is None else mask + tri
     return {"Out": [_xla_attention(q, k, v, mask, scale, dropout, key)]}
 
 

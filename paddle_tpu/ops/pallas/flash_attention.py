@@ -15,6 +15,13 @@ delta = rowsum(dO ∘ O) is computed in-kernel from resident blocks, so no
 extra residual tensor is materialized. lse is stored broadcast along a
 128-lane trailing dim (the Mosaic-safe layout).
 
+An additive mask rides into all three kernels (the reference handles padded
+batches in-kernel too — bert_encoder_functor.cu applies the mask inside the
+fused softmax). The mask is normalized to [Bm, Rm, S] where Bm encodes how
+heads map onto it (batch-broadcast / head-broadcast / per-(b,h)) and
+Rm ∈ {1, S} — a key-padding mask [B,1,1,S] stays O(B·S) in HBM, never
+expanded per head or per query row.
+
 Layout: [B, nh, S, hd]; grid (batch*heads, blocks); the non-gridded operand
 is fully resident per head — fine up to S~8k at hd 64-128 in 16MB VMEM;
 longer sequences use the ring path in parallel/ring_attention.py.
@@ -88,10 +95,40 @@ def _pick_block(s: int, preferred: int) -> int:
     return b
 
 
-def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                      scale, causal, dropout, block_k, seq_len):
+# mask_mode: how the (batch*head) grid index maps to the mask's leading dim.
+#   "1"  -> mask shared by every head            (Bm == 1)
+#   "b"  -> one mask per batch row, heads share  (Bm == B,    idx = h // nh)
+#   "h"  -> one mask per head, batches share     (Bm == nh,   idx = h %  nh)
+#   "bh" -> distinct per (batch, head)           (Bm == B*nh, idx = h)
+def _mask_bidx(mask_mode, nh):
+    if mask_mode == "1":
+        return lambda h: 0
+    if mask_mode == "b":
+        return lambda h: h // nh
+    if mask_mode == "h":
+        return lambda h: h % nh
+    return lambda h: h
+
+
+def _mask_block(mask_ref, q_start, block_q, k_start, block_k):
+    """[rows, block_k] additive-bias tile; rows broadcasts when the mask has
+    no query-row structure (key-padding case)."""
+    cols = pl.ds(k_start, block_k)
+    if mask_ref.shape[0] == 1:
+        return mask_ref[:, cols]                       # [1, block_k]
+    return mask_ref[pl.ds(q_start, block_q), cols]     # [block_q, block_k]
+
+
+def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest, scale, causal,
+                      dropout, block_k, seq_len, has_mask):
     # q_ref: [block_q, hd]; k_ref/v_ref: [S, hd]; o_ref: [block_q, hd]
     # lse_ref: [block_q, 128] (row value broadcast along lanes)
+    # mask_ref (if present): [1 or block_q, S] additive bias
+    if has_mask:
+        mask_ref, o_ref, lse_ref = rest
+    else:
+        o_ref, lse_ref = rest
+        mask_ref = None
     block_q = q_ref.shape[0]
     hd = q_ref.shape[1]
     head = pl.program_id(0)
@@ -110,6 +147,11 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
+        if mask_ref is not None:
+            # the q-grid BlockSpec already delivered THIS q block's rows,
+            # so the row offset here is 0, not q_idx * block_q
+            s = s + _mask_block(mask_ref, 0, block_q,
+                                kb * block_k, block_k).astype(jnp.float32)
         if causal:
             q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -152,24 +194,49 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     lse_ref[:] = jnp.broadcast_to(lse, (block_q, _LANES))
 
 
-def _flash_fwd(q, k, v, seed, scale, causal, dropout, block_q, block_k):
+def _mask_spec_qgrid(mask, bq, mask_mode, nh):
+    """BlockSpec for the mask under a (batch*head, q_block) grid."""
+    bidx = _mask_bidx(mask_mode, nh)
+    bm, rm, s = mask.shape
+    if rm == 1:
+        return pl.BlockSpec((None, 1, s), lambda h, i: (bidx(h), 0, 0))
+    return pl.BlockSpec((None, bq, s), lambda h, i: (bidx(h), i, 0))
+
+
+def _mask_spec_kgrid(mask, bk, mask_mode, nh):
+    """BlockSpec for the mask under a (batch*head, k_block) grid: this k
+    block's columns, all query rows resident."""
+    bidx = _mask_bidx(mask_mode, nh)
+    bm, rm, s = mask.shape
+    return pl.BlockSpec((None, rm, bk), lambda h, j: (bidx(h), 0, j))
+
+
+def _flash_fwd(q, k, v, seed, mask, scale, causal, dropout, block_q, block_k,
+               mask_mode):
     b, nh, s, hd = q.shape
     bq = _pick_block(s, block_q)
     bk = _pick_block(s, block_k)
     q3 = q.reshape(b * nh, s, hd)
     k3 = k.reshape(b * nh, s, hd)
     v3 = v.reshape(b * nh, s, hd)
+    has_mask = mask is not None
     kernel = functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
-                               dropout=dropout, block_k=bk, seq_len=s)
+                               dropout=dropout, block_k=bk, seq_len=s,
+                               has_mask=has_mask)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((None, bq, hd), lambda h, i: (h, i, 0)),
+        pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
+        pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
+    ]
+    operands = [seed, q3, k3, v3]
+    if has_mask:
+        in_specs.append(_mask_spec_qgrid(mask, bq, mask_mode, nh))
+        operands.append(mask)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * nh, s // bq),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((None, bq, hd), lambda h, i: (h, i, 0)),
-            pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
-            pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((None, bq, hd), lambda h, i: (h, i, 0)),
             pl.BlockSpec((None, bq, _LANES), lambda h, i: (h, i, 0)),
@@ -181,14 +248,19 @@ def _flash_fwd(q, k, v, seed, scale, causal, dropout, block_q, block_k):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
-    )(seed, q3, k3, v3)
+    )(*operands)
     return out.reshape(b, nh, s, hd), lse
 
 
 def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, o_ref,
-                         lse_ref, dq_ref, *, scale, causal, dropout, block_k,
-                         seq_len):
+                         lse_ref, *rest, scale, causal, dropout, block_k,
+                         seq_len, has_mask):
     # q/do/o: [block_q, hd]; k/v: [S, hd]; lse: [block_q, 128]
+    if has_mask:
+        mask_ref, dq_ref = rest
+    else:
+        dq_ref, = rest
+        mask_ref = None
     block_q = q_ref.shape[0]
     hd = q_ref.shape[1]
     head = pl.program_id(0)
@@ -207,6 +279,10 @@ def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, o_ref,
         v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if mask_ref is not None:
+            # q-grid BlockSpec already row-tiled the mask: offset 0 here
+            s = s + _mask_block(mask_ref, 0, block_q,
+                                kb * block_k, block_k).astype(jnp.float32)
         if causal:
             q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -239,9 +315,15 @@ def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, o_ref,
 
 
 def _flash_bwd_dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, o_ref,
-                           lse_ref, dk_ref, dv_ref, *, scale, causal,
-                           dropout, block_q, seq_len):
+                           lse_ref, *rest, scale, causal, dropout, block_q,
+                           seq_len, has_mask):
     # k/v: [block_k, hd]; q/do/o: [S, hd]; lse: [S, 128]
+    # mask_ref (if present): [1 or S, block_k] — this k block's columns
+    if has_mask:
+        mask_ref, dk_ref, dv_ref = rest
+    else:
+        dk_ref, dv_ref = rest
+        mask_ref = None
     block_k = k_ref.shape[0]
     hd = k_ref.shape[1]
     head = pl.program_id(0)
@@ -261,6 +343,10 @@ def _flash_bwd_dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, o_ref,
         delta = jnp.sum(do * o, axis=1, keepdims=True)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if mask_ref is not None:
+            # columns already sliced by the BlockSpec; rows here
+            s = s + _mask_block(mask_ref, qb * block_q, block_q,
+                                0, block_k).astype(jnp.float32)
         if causal:
             q_pos = qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -302,8 +388,8 @@ def _flash_bwd_dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, o_ref,
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, seed, scale, causal, dropout, block_q,
-               block_k):
+def _flash_bwd(q, k, v, o, lse, do, seed, mask, scale, causal, dropout,
+               block_q, block_k, mask_mode):
     b, nh, s, hd = q.shape
     bq = _pick_block(s, block_q)
     bk = _pick_block(s, block_k)
@@ -312,44 +398,55 @@ def _flash_bwd(q, k, v, o, lse, do, seed, scale, causal, dropout, block_q,
     v3 = v.reshape(b * nh, s, hd)
     o3 = o.reshape(b * nh, s, hd)
     do3 = do.reshape(b * nh, s, hd)
+    has_mask = mask is not None
 
     dq_kernel = functools.partial(_flash_bwd_dq_kernel, scale=scale,
                                   causal=causal, dropout=dropout,
-                                  block_k=bk, seq_len=s)
+                                  block_k=bk, seq_len=s, has_mask=has_mask)
+    dq_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((None, bq, hd), lambda h, i: (h, i, 0)),
+        pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
+        pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
+        pl.BlockSpec((None, bq, hd), lambda h, i: (h, i, 0)),
+        pl.BlockSpec((None, bq, hd), lambda h, i: (h, i, 0)),
+        pl.BlockSpec((None, bq, _LANES), lambda h, i: (h, i, 0)),
+    ]
+    dq_operands = [seed, q3, k3, v3, do3, o3, lse]
+    if has_mask:
+        dq_specs.append(_mask_spec_qgrid(mask, bq, mask_mode, nh))
+        dq_operands.append(mask)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(b * nh, s // bq),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((None, bq, hd), lambda h, i: (h, i, 0)),
-            pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
-            pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
-            pl.BlockSpec((None, bq, hd), lambda h, i: (h, i, 0)),
-            pl.BlockSpec((None, bq, hd), lambda h, i: (h, i, 0)),
-            pl.BlockSpec((None, bq, _LANES), lambda h, i: (h, i, 0)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((None, bq, hd), lambda h, i: (h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * nh, s, hd), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
-    )(seed, q3, k3, v3, do3, o3, lse)
+    )(*dq_operands)
 
     dkdv_kernel = functools.partial(_flash_bwd_dkdv_kernel, scale=scale,
                                     causal=causal, dropout=dropout,
-                                    block_q=bq, seq_len=s)
+                                    block_q=bq, seq_len=s, has_mask=has_mask)
+    dkdv_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
+        pl.BlockSpec((None, bk, hd), lambda h, i: (h, i, 0)),
+        pl.BlockSpec((None, bk, hd), lambda h, i: (h, i, 0)),
+        pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
+        pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
+        pl.BlockSpec((None, s, _LANES), lambda h, i: (h, 0, 0)),
+    ]
+    dkdv_operands = [seed, q3, k3, v3, do3, o3, lse]
+    if has_mask:
+        dkdv_specs.append(_mask_spec_kgrid(mask, bk, mask_mode, nh))
+        dkdv_operands.append(mask)
     dk, dv = pl.pallas_call(
         dkdv_kernel,
         grid=(b * nh, s // bk),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
-            pl.BlockSpec((None, bk, hd), lambda h, i: (h, i, 0)),
-            pl.BlockSpec((None, bk, hd), lambda h, i: (h, i, 0)),
-            pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
-            pl.BlockSpec((None, s, hd), lambda h, i: (h, 0, 0)),
-            pl.BlockSpec((None, s, _LANES), lambda h, i: (h, 0, 0)),
-        ],
+        in_specs=dkdv_specs,
         out_specs=[
             pl.BlockSpec((None, bk, hd), lambda h, i: (h, i, 0)),
             pl.BlockSpec((None, bk, hd), lambda h, i: (h, i, 0)),
@@ -361,46 +458,82 @@ def _flash_bwd(q, k, v, o, lse, do, seed, scale, causal, dropout, block_q,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
-    )(seed, q3, k3, v3, do3, o3, lse)
+    )(*dkdv_operands)
 
     return (dq.reshape(b, nh, s, hd), dk.reshape(b, nh, s, hd),
             dv.reshape(b, nh, s, hd))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, seed, scale, causal, dropout, block_q, block_k):
-    out, _ = _flash_fwd(q, k, v, seed, scale, causal, dropout, block_q,
-                        block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, seed, mask, scale, causal, dropout, block_q, block_k,
+           mask_mode):
+    out, _ = _flash_fwd(q, k, v, seed, mask, scale, causal, dropout,
+                        block_q, block_k, mask_mode)
     return out
 
 
-def _fwd(q, k, v, seed, scale, causal, dropout, block_q, block_k):
-    out, lse = _flash_fwd(q, k, v, seed, scale, causal, dropout, block_q,
-                          block_k)
-    return out, (q, k, v, seed, out, lse)
+def _fwd(q, k, v, seed, mask, scale, causal, dropout, block_q, block_k,
+         mask_mode):
+    out, lse = _flash_fwd(q, k, v, seed, mask, scale, causal, dropout,
+                          block_q, block_k, mask_mode)
+    return out, (q, k, v, seed, mask, out, lse)
 
 
-def _bwd(scale, causal, dropout, block_q, block_k, res, do):
-    q, k, v, seed, o, lse = res
-    dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, seed, scale, causal,
-                            dropout, block_q, block_k)
+def _bwd(scale, causal, dropout, block_q, block_k, mask_mode, res, do):
+    q, k, v, seed, mask, o, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, seed, mask, scale, causal,
+                            dropout, block_q, block_k, mask_mode)
     import numpy as np
     dseed = np.zeros(seed.shape, dtype=jax.dtypes.float0)
-    return dq, dk, dv, dseed
+    # the op registry declares Mask nondiff (ops/attention.py nondiff_slots);
+    # a zero cotangent keeps custom_vjp's pytree contract satisfied
+    dmask = None if mask is None else jnp.zeros_like(mask)
+    return dq, dk, dv, dseed, dmask
 
 
 _flash.defvjp(_fwd, _bwd)
 
 
+def _normalize_mask(mask, b, nh, s):
+    """Additive mask of any shape broadcastable to [B, nh, S, S] (with the
+    query dim allowed to be 1) → ([Bm, Rm, S], mask_mode). Key-padding
+    masks [B,1,1,S] stay O(B·S); ALiBi-style [1,nh,S,S] stays O(nh·S²)."""
+    mask = jnp.asarray(mask)
+    if not jnp.issubdtype(mask.dtype, jnp.floating):
+        # int/bool additive masks would poison the bwd cotangent pytree
+        mask = mask.astype(jnp.float32)
+    while mask.ndim < 4:
+        mask = mask[None]
+    if mask.ndim != 4:
+        raise ValueError(f"mask rank must be <= 4, got {mask.shape}")
+    mb, mh, mq, mk = mask.shape
+    if mk != s or mb not in (1, b) or mh not in (1, nh) or mq not in (1, s):
+        raise ValueError(
+            f"mask {mask.shape} not broadcastable to attention "
+            f"[{b},{nh},{s},{s}]")
+    if mh == 1:
+        mode = "1" if mb == 1 else "b"
+        return mask[:, 0], mode
+    if mb == 1:
+        return mask[0], "h"
+    return mask.reshape(b * nh, mq, s), "bh"
+
+
 def flash_attention(q, k, v, scale=None, causal=False,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                    dropout=0.0, seed=None):
+                    dropout=0.0, seed=None, mask=None):
     """Tiled attention; `dropout` drops post-softmax probs with an in-kernel
-    counter-based mask keyed on `seed` (traced int32 scalar/array ok)."""
+    counter-based mask keyed on `seed` (traced int32 scalar/array ok);
+    `mask` is an additive bias broadcastable to [B, nh, S(or 1), S] applied
+    to the scaled scores inside all three kernels."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if dropout > 0.0 and seed is None:
         raise ValueError("flash_attention dropout requires a seed")
     seed = jnp.asarray(0 if seed is None else seed, jnp.int32).reshape((1,))
-    return _flash(q, k, v, seed, scale, causal, float(dropout),
-                  block_q, block_k)
+    mask_mode = None
+    if mask is not None:
+        b, nh, s, _ = q.shape
+        mask, mask_mode = _normalize_mask(mask, b, nh, s)
+    return _flash(q, k, v, seed, mask, scale, causal, float(dropout),
+                  block_q, block_k, mask_mode)

@@ -134,6 +134,110 @@ def test_flash_dropout_grads_match_dense_with_same_mask():
                                    err_msg=f"d{name} mismatch")
 
 
+@pytest.mark.parametrize("mask_shape", [
+    (1, 1, 1, 256),   # shared key bias
+    (2, 1, 1, 256),   # per-batch key padding (the padded-BERT case)
+    (1, 2, 256, 256), # per-head full bias (ALiBi-style), batch-broadcast
+    (2, 2, 256, 256), # distinct per (batch, head)
+])
+def test_flash_masked_matches_dense(mask_shape):
+    """Additive mask applied in-kernel across fwd + both bwd kernels, for
+    every head→mask broadcast layout the normalizer distinguishes."""
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+    rng = np.random.RandomState(3)
+    b, nh, seq, hd = 2, 2, 256, 64
+    q = jnp.asarray(rng.randn(b, nh, seq, hd).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, nh, seq, hd).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, nh, seq, hd).astype(np.float32))
+    do = jnp.asarray(rng.randn(b, nh, seq, hd).astype(np.float32))
+    scale = 1.0 / np.sqrt(hd)
+    # mix of -1e9 "pad" entries and small finite biases
+    bias = rng.randn(*mask_shape).astype(np.float32)
+    pad = (rng.rand(*mask_shape) < 0.25) * -1e9
+    mask = jnp.asarray(bias + pad.astype(np.float32))
+
+    def dense(q, k, v):
+        s = jnp.einsum("bnqd,bnkd->bnqk", q, k) * scale + mask
+        return jnp.einsum("bnqk,bnkd->bnqd", jax.nn.softmax(s, -1), v)
+
+    def flash(q, k, v):
+        return flash_attention(q, k, v, scale, False, 128, 128, mask=mask)
+
+    np.testing.assert_allclose(np.asarray(flash(q, k, v)),
+                               np.asarray(dense(q, k, v)),
+                               atol=2e-5, rtol=2e-5)
+    gf = jax.grad(lambda *a: jnp.vdot(flash(*a), do), (0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda *a: jnp.vdot(dense(*a), do), (0, 1, 2))(q, k, v)
+    for a, bb, name in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   atol=5e-4, rtol=5e-4,
+                                   err_msg=f"d{name} ({mask_shape})")
+
+
+def test_flash_mask_dropout_causal_combined():
+    """The round-4 target path: padding mask + dropout + causal, all
+    in-kernel at once. Recover the dropout keep-mask via v=I then compare
+    against a dense implementation using mask, causal triangle and that
+    exact keep pattern."""
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+    rng = np.random.RandomState(5)
+    b, nh, S, hd = 2, 2, 128, 128
+    rate, seed = 0.1, 11
+    q = jnp.asarray(rng.randn(b, nh, S, hd).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(b, nh, S, hd).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(b, nh, S, hd).astype(np.float32))
+    v_eye = jnp.broadcast_to(jnp.eye(S, dtype=jnp.float32), (b, nh, S, S))
+    # pad out the last 32 keys of example 1
+    pad = np.zeros((b, 1, 1, S), np.float32)
+    pad[1, :, :, S - 32:] = -1e9
+    mask = jnp.asarray(pad)
+
+    pd = flash_attention(jnp.zeros_like(q), jnp.zeros_like(k), v_eye,
+                         1.0, False, 128, 128, dropout=rate, seed=seed)
+    keep = jnp.asarray(np.asarray(pd) != 0)
+
+    tri = jnp.tril(jnp.ones((S, S), bool))[None, None]
+
+    def dense(q, k, v):
+        s = jnp.einsum("bnqd,bnkd->bnqk", q, k) * (hd ** -0.5) + mask
+        s = jnp.where(tri, s, -jnp.inf)
+        p = jax.nn.softmax(s, -1)
+        return jnp.einsum("bnqk,bnkd->bnqd",
+                          jnp.where(keep, p / (1 - rate), 0.0), v)
+
+    def flash(q, k, v):
+        return flash_attention(q, k, v, None, True, 128, 128,
+                               dropout=rate, seed=seed, mask=mask)
+
+    cot = jnp.asarray(rng.randn(b, nh, S, hd).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(flash(q, k, v)),
+                               np.asarray(dense(q, k, v)),
+                               atol=2e-5, rtol=2e-5)
+    gf = jax.grad(lambda *a: jnp.vdot(flash(*a), cot), (0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda *a: jnp.vdot(dense(*a), cot), (0, 1, 2))(q, k, v)
+    for a, bb, name in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_flash_int_mask_is_cast():
+    """An int additive mask must not poison the bwd cotangent pytree."""
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(1, 1, 128, 64).astype(np.float32))
+    mask = jnp.asarray(
+        (rng.rand(1, 1, 1, 128) < 0.3) * np.int32(-10 ** 9))
+    out = flash_attention(q, q, q, None, False, 128, 128, mask=mask)
+    g = jax.grad(lambda a: jnp.sum(flash_attention(
+        a, a, a, None, False, 128, 128, mask=mask)))(q)
+    assert np.isfinite(np.asarray(out)).all()
+    assert np.isfinite(np.asarray(g)).all()
+
+
 def test_flash_bf16_grads_finite():
     from paddle_tpu.ops.pallas.flash_attention import flash_attention
 
