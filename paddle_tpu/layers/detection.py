@@ -20,7 +20,10 @@ __all__ = [
     "generate_proposals", "distribute_fpn_proposals",
     "collect_fpn_proposals", "retinanet_detection_output",
     "sigmoid_focal_loss", "roi_align", "roi_pool", "psroi_pool",
-    "prroi_pool", "box_decoder_and_assign",
+    "prroi_pool", "rpn_target_assign", "retinanet_target_assign",
+    "generate_proposal_labels", "generate_mask_labels",
+    "locality_aware_nms", "roi_perspective_transform", "ssd_loss",
+    "detection_output", "detection_map", "box_decoder_and_assign",
 ]
 
 
@@ -356,3 +359,246 @@ def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
                ("DecodeBox", "OutputAssignBox"),
                {"box_clip": float(box_clip)})
     return outs["DecodeBox"], outs["OutputAssignBox"]
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd, im_info,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """Reference python/paddle/fluid/layers/detection.py:310. The reference
+    gathers predictions at sampled indices into ragged tensors; the static
+    form instead returns DENSE per-anchor predictions/targets plus weight
+    masks (score_weight selects the sampled fg+bg set, bbox_weight the
+    sampled fg set) — masked losses give the same gradients. Returns
+    (score_pred, loc_pred, score_tgt, loc_tgt, bbox_weight, score_weight)."""
+    helper = LayerHelper("rpn_target_assign")
+    ins = {"Anchor": [anchor_box], "GtBoxes": [gt_boxes],
+           "ImInfo": [im_info]}
+    if is_crowd is not None:
+        ins["IsCrowd"] = [is_crowd]
+    outs = _op(helper, "rpn_target_assign", ins,
+               ("TargetLabel", "ScoreWeight", "TargetBBox",
+                "BBoxInsideWeight"),
+               {"rpn_batch_size_per_im": int(rpn_batch_size_per_im),
+                "rpn_straddle_thresh": float(rpn_straddle_thresh),
+                "rpn_fg_fraction": float(rpn_fg_fraction),
+                "rpn_positive_overlap": float(rpn_positive_overlap),
+                "rpn_negative_overlap": float(rpn_negative_overlap),
+                "use_random": bool(use_random)})
+    for v in outs.values():
+        v.stop_gradient = True
+    return (cls_logits, bbox_pred, outs["TargetLabel"], outs["TargetBBox"],
+            outs["BBoxInsideWeight"], outs["ScoreWeight"])
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd, im_info,
+                            num_classes=1, positive_overlap=0.5,
+                            negative_overlap=0.4):
+    """Reference layers/detection.py:69. Dense static form (see
+    rpn_target_assign above). Returns (score_pred, loc_pred, score_tgt,
+    loc_tgt, bbox_weight, score_weight, fg_num)."""
+    helper = LayerHelper("retinanet_target_assign")
+    ins = {"Anchor": [anchor_box], "GtBoxes": [gt_boxes],
+           "GtLabels": [gt_labels], "ImInfo": [im_info]}
+    if is_crowd is not None:
+        ins["IsCrowd"] = [is_crowd]
+    outs = _op(helper, "retinanet_target_assign", ins,
+               ("TargetLabel", "ScoreWeight", "TargetBBox",
+                "BBoxInsideWeight", "ForegroundNumber"),
+               {"positive_overlap": float(positive_overlap),
+                "negative_overlap": float(negative_overlap)},
+               dtypes={"TargetLabel": "int32", "ForegroundNumber": "int32"})
+    for v in outs.values():
+        v.stop_gradient = True
+    return (cls_logits, bbox_pred, outs["TargetLabel"], outs["TargetBBox"],
+            outs["BBoxInsideWeight"], outs["ScoreWeight"],
+            outs["ForegroundNumber"])
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False,
+                             rpn_rois_num=None, return_roi_weights=False):
+    """Reference layers/detection.py generate_proposal_labels. Static form:
+    exactly batch_size_per_im rows per image (fg, then bg, then padding),
+    RoisNum = live counts. Returns (rois, labels_int32, bbox_targets,
+    bbox_inside_weights, bbox_outside_weights, rois_num)."""
+    helper = LayerHelper("generate_proposal_labels")
+    ins = {"RpnRois": [rpn_rois], "GtClasses": [gt_classes],
+           "GtBoxes": [gt_boxes], "ImInfo": [im_info]}
+    if is_crowd is not None:
+        ins["IsCrowd"] = [is_crowd]
+    if rpn_rois_num is not None:
+        ins["RpnRoisNum"] = [rpn_rois_num]
+    outs = _op(helper, "generate_proposal_labels", ins,
+               ("Rois", "LabelsInt32", "BboxTargets", "BboxInsideWeights",
+                "BboxOutsideWeights", "RoisNum", "RoiWeights"),
+               {"batch_size_per_im": int(batch_size_per_im),
+                "fg_fraction": float(fg_fraction),
+                "fg_thresh": float(fg_thresh),
+                "bg_thresh_hi": float(bg_thresh_hi),
+                "bg_thresh_lo": float(bg_thresh_lo),
+                "bbox_reg_weights": [float(w) for w in bbox_reg_weights],
+                "class_nums": int(class_nums or 2),
+                "use_random": bool(use_random),
+                "is_cls_agnostic": bool(is_cls_agnostic),
+                "is_cascade_rcnn": bool(is_cascade_rcnn)},
+               dtypes={"LabelsInt32": "int32", "RoisNum": "int32"})
+    for v in outs.values():
+        v.stop_gradient = True
+    ret = (outs["Rois"], outs["LabelsInt32"], outs["BboxTargets"],
+           outs["BboxInsideWeights"], outs["BboxOutsideWeights"],
+           outs["RoisNum"])
+    if return_roi_weights:   # static-design extra: 1 on live rows, 0 on pad
+        ret = ret + (outs["RoiWeights"],)
+    return ret
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution,
+                         gt_boxes=None, rois_num=None):
+    """Reference layers/detection.py generate_mask_labels. TPU-native:
+    gt_segms is a dense per-gt bitmap [B, G, Hm, Wm] (polygons rasterized
+    host-side), not a polygon LoD. Returns (mask_rois, roi_has_mask_int32,
+    mask_int32)."""
+    helper = LayerHelper("generate_mask_labels")
+    ins = {"ImInfo": [im_info], "GtClasses": [gt_classes],
+           "GtSegms": [gt_segms], "Rois": [rois],
+           "LabelsInt32": [labels_int32]}
+    if is_crowd is not None:
+        ins["IsCrowd"] = [is_crowd]
+    if gt_boxes is not None:
+        ins["GtBoxes"] = [gt_boxes]
+    if rois_num is not None:
+        ins["RoisNum"] = [rois_num]
+    outs = _op(helper, "generate_mask_labels", ins,
+               ("MaskRois", "RoiHasMaskInt32", "MaskInt32"),
+               {"num_classes": int(num_classes),
+                "resolution": int(resolution)},
+               dtypes={"RoiHasMaskInt32": "int32", "MaskInt32": "int32"})
+    for v in outs.values():
+        v.stop_gradient = True
+    return outs["MaskRois"], outs["RoiHasMaskInt32"], outs["MaskInt32"]
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
+                       keep_top_k, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, background_label=-1, name=None):
+    """Reference layers/detection.py locality_aware_nms (EAST). Static
+    output [keep_top_k, 2 + box_size] + count."""
+    helper = LayerHelper("locality_aware_nms")
+    outs = _op(helper, "locality_aware_nms",
+               {"BBoxes": [bboxes], "Scores": [scores]},
+               ("Out", "OutCount"),
+               {"score_threshold": float(score_threshold),
+                "nms_top_k": int(nms_top_k), "keep_top_k": int(keep_top_k),
+                "nms_threshold": float(nms_threshold),
+                "normalized": bool(normalized), "nms_eta": float(nms_eta),
+                "background_label": int(background_label)},
+               dtypes={"OutCount": "int32"})
+    # static-shape convention: padded block + live-row count (the
+    # reference's LoD carries the count implicitly)
+    return outs["Out"], outs["OutCount"]
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              rois_num=None, name=None):
+    """Reference layers/detection.py roi_perspective_transform (OCR).
+    Returns (out, mask, transform_matrix)."""
+    helper = LayerHelper("roi_perspective_transform")
+    ins = {"X": [input], "ROIs": [rois]}
+    if rois_num is not None:
+        ins["RoisNum"] = [rois_num]
+    outs = _op(helper, "roi_perspective_transform", ins,
+               ("Out", "Mask", "TransformMatrix"),
+               {"transformed_height": int(transformed_height),
+                "transformed_width": int(transformed_width),
+                "spatial_scale": float(spatial_scale)},
+               dtypes={"Mask": "int32"})
+    return outs["Out"], outs["Mask"], outs["TransformMatrix"]
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True,
+             sample_size=None):
+    """Reference layers/detection.py:1517. One fused static lowering of the
+    reference's 8-op composition (iou_similarity -> bipartite_match ->
+    target_assign -> mine_hard_examples -> smooth_l1 + CE); gt padding =
+    zero-area boxes. Returns the per-image weighted loss [B, 1]."""
+    helper = LayerHelper("ssd_loss")
+    ins = {"Location": [location], "Confidence": [confidence],
+           "GtBox": [gt_box], "GtLabel": [gt_label],
+           "PriorBox": [prior_box]}
+    if prior_box_var is not None:
+        ins["PriorBoxVar"] = [prior_box_var]
+    outs = _op(helper, "ssd_loss", ins, ("Loss",),
+               {"background_label": int(background_label),
+                "overlap_threshold": float(overlap_threshold),
+                "neg_pos_ratio": float(neg_pos_ratio),
+                "neg_overlap": float(neg_overlap),
+                "loc_loss_weight": float(loc_loss_weight),
+                "conf_loss_weight": float(conf_loss_weight),
+                "match_type": match_type, "mining_type": mining_type,
+                "normalize": bool(normalize),
+                "sample_size": -1 if sample_size is None
+                else int(sample_size)})
+    return outs["Loss"]
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     return_index=False):
+    """Reference layers/detection.py:620 — softmax the class logits,
+    transpose to [N, C, P], decode (box_coder decode_center_size), then
+    multiclass_nms — composed from the existing ops exactly as the
+    reference composes them (:720-722). `scores` arrives [N, P, C] raw."""
+    from . import nn as _nn
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size", axis=0)
+    probs = _nn.transpose(_nn.softmax(scores), [0, 2, 1])
+    return multiclass_nms(decoded, probs,
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold, normalized=True,
+                          nms_eta=nms_eta,
+                          background_label=background_label,
+                          return_index=return_index)
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  has_state=None, input_states=None, out_states=None,
+                  ap_version="integral"):
+    """Reference layers/detection.py detection_map (mAP evaluator).
+    Static form: DetectRes [B, K, 6] padded (label < 0), Label [B, G, 6]
+    (label, difficult, x1..y2, zero-area = pad). For streaming epoch mAP,
+    pass the previous batch's accumulators as `input_states` and receive
+    the updated ones: returns (map, accum_pos_count, accum_true_pos,
+    accum_false_pos) when states are involved, else just map."""
+    helper = LayerHelper("detection_map")
+    ins = {"DetectRes": [detect_res], "Label": [label]}
+    if input_states is not None:
+        ins["PosCount"], ins["TruePos"], ins["FalsePos"] = \
+            [input_states[0]], [input_states[1]], [input_states[2]]
+    outs = _op(helper, "detection_map", ins,
+               ("MAP", "AccumPosCount", "AccumTruePos", "AccumFalsePos"),
+               {"class_num": int(class_num),
+                "background_label": int(background_label),
+                "overlap_threshold": float(overlap_threshold),
+                "evaluate_difficult": bool(evaluate_difficult),
+                "ap_type": ap_version})
+    if input_states is not None or out_states is not None:
+        return (outs["MAP"], outs["AccumPosCount"], outs["AccumTruePos"],
+                outs["AccumFalsePos"])
+    return outs["MAP"]

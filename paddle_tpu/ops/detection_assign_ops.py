@@ -1,0 +1,858 @@
+"""Training-side detection target-assignment ops (RCNN/SSD/EAST families).
+
+Reference counterparts (paddle/fluid/operators/detection/):
+  rpn_target_assign_op.cc, generate_proposal_labels_op.cc,
+  generate_mask_labels_op.cc, locality_aware_nms_op.cc,
+  roi_perspective_transform_op.cc — plus the ssd_loss composite from
+  python/paddle/fluid/layers/detection.py:1517.
+
+TPU-native redesign: the reference emits ragged LoD outputs (compact index
+lists whose length depends on the data). Every op here keeps STATIC shapes —
+dense per-anchor/per-roi targets with explicit weight masks, padded blocks
+with count tensors — so the whole pipeline stays inside one XLA program.
+Random subsampling uses the registry's deterministic per-op PRNG
+(ctx.op_key), mirroring the reference's seeded ReservoirSampling; with
+`use_random=False` the lowest-index candidates win (the reference's
+unittest mode keeps the first N the same way).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from .detection_ops import _iou_matrix
+
+
+def _rank_among(mask, priority):
+    """Rank of each True row among the True rows, ordered by `priority`
+    ascending; False rows get ranks after every True row."""
+    n = mask.shape[0]
+    key = jnp.where(mask, priority, jnp.inf)
+    order = jnp.argsort(key)
+    rank = jnp.zeros((n,), jnp.int32).at[order].set(
+        jnp.arange(n, dtype=jnp.int32))
+    return rank
+
+
+def _priorities(key, n, use_random):
+    if use_random:
+        return jax.random.uniform(key, (n,))
+    return jnp.arange(n, dtype=jnp.float32)   # first-N, reference test mode
+
+
+def _encode_delta(ex, gt, weights=None):
+    """BoxToDelta (bbox_util.h:54), pixel convention (+1 widths)."""
+    ew = ex[:, 2] - ex[:, 0] + 1.0
+    eh = ex[:, 3] - ex[:, 1] + 1.0
+    ecx = ex[:, 0] + 0.5 * ew
+    ecy = ex[:, 1] + 0.5 * eh
+    gw = gt[:, 2] - gt[:, 0] + 1.0
+    gh = gt[:, 3] - gt[:, 1] + 1.0
+    gcx = gt[:, 0] + 0.5 * gw
+    gcy = gt[:, 1] + 0.5 * gh
+    d = jnp.stack([(gcx - ecx) / ew, (gcy - ecy) / eh,
+                   jnp.log(jnp.maximum(gw, 1e-6) / ew),
+                   jnp.log(jnp.maximum(gh, 1e-6) / eh)], axis=1)
+    if weights is not None:
+        d = d / jnp.asarray(weights, d.dtype)[None, :]
+    return d
+
+
+def _valid_gt(gt_boxes, is_crowd):
+    """Padding gt rows are all-zero boxes; crowd rows are excluded from
+    matching (reference FilterCrowdGt)."""
+    area = (gt_boxes[:, 2] - gt_boxes[:, 0]) * (gt_boxes[:, 3] - gt_boxes[:, 1])
+    valid = area > 0
+    if is_crowd is not None:
+        valid = valid & (is_crowd.reshape(-1) == 0)
+    return valid
+
+
+@register("rpn_target_assign", is_random=True,
+          nondiff_slots=("Anchor", "GtBoxes", "IsCrowd", "ImInfo"))
+def _rpn_target_assign(ctx, ins, attrs):
+    """rpn_target_assign_op.cc:520. Dense static form: instead of compact
+    LocationIndex/ScoreIndex lists, emits per-anchor targets with weights —
+    TargetLabel [B,A,1] (1 fg / 0 bg), ScoreWeight [B,A,1] (1 iff sampled),
+    TargetBBox [B,A,4] anchor→gt deltas, BBoxInsideWeight [B,A,4] (1 on
+    sampled fg rows). The sampled-set semantics (straddle filter, fg =
+    IoU≥pos ∪ per-gt argmax, bg = IoU<neg, capped reservoir subsample to
+    rpn_batch_size_per_im with fg_fraction) match the reference kernel."""
+    anchors = ins["Anchor"][0].reshape(-1, 4)         # [A, 4]
+    gt_all = ins["GtBoxes"][0]                        # [B, G, 4]
+    crowd_all = ins.get("IsCrowd", [None])[0]         # [B, G]
+    im_info = ins["ImInfo"][0]                        # [B, 3]
+    if gt_all.ndim == 2:
+        gt_all = gt_all[None]
+    if crowd_all is not None and crowd_all.ndim == 1:
+        crowd_all = crowd_all[None]
+    bs = int(attrs.get("rpn_batch_size_per_im", 256))
+    straddle = float(attrs.get("rpn_straddle_thresh", 0.0))
+    fg_frac = float(attrs.get("rpn_fg_fraction", 0.5))
+    pos_ov = float(attrs.get("rpn_positive_overlap", 0.7))
+    neg_ov = float(attrs.get("rpn_negative_overlap", 0.3))
+    use_random = bool(attrs.get("use_random", True))
+    eps = 1e-5
+
+    b = gt_all.shape[0]
+    a = anchors.shape[0]
+    base = ctx.op_key(attrs)
+    labels, sweights, tboxes, bweights = [], [], [], []
+    for i in range(b):
+        gt = gt_all[i]
+        valid = _valid_gt(gt, None if crowd_all is None else crowd_all[i])
+        imh, imw = im_info[i, 0], im_info[i, 1]
+        if straddle >= 0:
+            inside = ((anchors[:, 0] >= -straddle)
+                      & (anchors[:, 1] >= -straddle)
+                      & (anchors[:, 2] < imw + straddle)
+                      & (anchors[:, 3] < imh + straddle))
+        else:
+            inside = jnp.ones((a,), bool)
+        iou = _iou_matrix(anchors, gt, normalized=False)      # [A, G]
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        amax = jnp.max(iou, axis=1)                            # [A]
+        aarg = jnp.argmax(iou, axis=1)
+        gmax = jnp.max(jnp.where(inside[:, None], iou, -1.0), axis=0)  # [G]
+        is_best = jnp.any((iou >= gmax[None, :] - eps) & valid[None, :]
+                          & (gmax[None, :] > 0), axis=1)
+        any_gt = jnp.any(valid)
+        fg = inside & any_gt & ((amax >= pos_ov) | is_best)
+        bg = inside & (amax < neg_ov) & ~fg
+
+        k1, k2 = jax.random.split(jax.random.fold_in(base, i))
+        fg_rank = _rank_among(fg, _priorities(k1, a, use_random))
+        n_fg = jnp.minimum(jnp.int32(fg_frac * bs),
+                           jnp.sum(fg.astype(jnp.int32)))
+        fg_keep = fg & (fg_rank < n_fg)
+        bg_rank = _rank_among(bg, _priorities(k2, a, use_random))
+        n_bg = jnp.maximum(bs - n_fg, 0)
+        bg_keep = bg & (bg_rank < n_bg)
+
+        delta = _encode_delta(anchors, gt[jnp.maximum(aarg, 0)])
+        labels.append(fg_keep.astype(jnp.float32)[:, None])
+        sweights.append((fg_keep | bg_keep).astype(jnp.float32)[:, None])
+        tboxes.append(jnp.where(fg_keep[:, None], delta, 0.0))
+        bweights.append(jnp.where(fg_keep[:, None],
+                                  jnp.ones((a, 4), jnp.float32), 0.0))
+    return {"TargetLabel": [jnp.stack(labels)],
+            "ScoreWeight": [jnp.stack(sweights)],
+            "TargetBBox": [jnp.stack(tboxes)],
+            "BBoxInsideWeight": [jnp.stack(bweights)]}
+
+
+@register("retinanet_target_assign",
+          nondiff_slots=("Anchor", "GtBoxes", "GtLabels", "IsCrowd",
+                         "ImInfo"))
+def _retinanet_target_assign(ctx, ins, attrs):
+    """retinanet_target_assign (rpn_target_assign_op.cc:608 variant): no
+    subsampling — every anchor with IoU≥positive_overlap (or per-gt best)
+    is fg carrying its gt's class label, IoU<negative_overlap is bg
+    (label 0), the band between is ignored (weight 0). Dense outputs:
+    TargetLabel [B,A,1] int32, ScoreWeight, TargetBBox, BBoxInsideWeight,
+    ForegroundNumber [B,1]."""
+    anchors = ins["Anchor"][0].reshape(-1, 4)
+    gt_all = ins["GtBoxes"][0]
+    lbl_all = ins["GtLabels"][0]
+    crowd_all = ins.get("IsCrowd", [None])[0]
+    im_info = ins["ImInfo"][0]
+    if gt_all.ndim == 2:
+        gt_all = gt_all[None]
+    pos_ov = float(attrs.get("positive_overlap", 0.5))
+    neg_ov = float(attrs.get("negative_overlap", 0.4))
+    eps = 1e-5
+    b = gt_all.shape[0]
+    a = anchors.shape[0]
+    labels, sweights, tboxes, bweights, fgnums = [], [], [], [], []
+    for i in range(b):
+        gt = gt_all[i]
+        gl = lbl_all[i].reshape(-1).astype(jnp.int32)
+        valid = _valid_gt(gt, None if crowd_all is None else crowd_all[i])
+        iou = jnp.where(valid[None, :],
+                        _iou_matrix(anchors, gt, normalized=False), -1.0)
+        amax = jnp.max(iou, axis=1)
+        aarg = jnp.argmax(iou, axis=1)
+        gmax = jnp.max(iou, axis=0)
+        is_best = jnp.any((iou >= gmax[None, :] - eps) & valid[None, :]
+                          & (gmax[None, :] > 0), axis=1)
+        fg = jnp.any(valid) & ((amax >= pos_ov) | is_best)
+        bg = (amax < neg_ov) & ~fg
+        lab = jnp.where(fg, gl[jnp.maximum(aarg, 0)], 0)
+        delta = _encode_delta(anchors, gt[jnp.maximum(aarg, 0)])
+        labels.append(lab.astype(jnp.int32)[:, None])
+        sweights.append((fg | bg).astype(jnp.float32)[:, None])
+        tboxes.append(jnp.where(fg[:, None], delta, 0.0))
+        bweights.append(jnp.where(fg[:, None],
+                                  jnp.ones((a, 4), jnp.float32), 0.0))
+        fgnums.append(jnp.maximum(jnp.sum(fg.astype(jnp.int32)), 1))
+    return {"TargetLabel": [jnp.stack(labels)],
+            "ScoreWeight": [jnp.stack(sweights)],
+            "TargetBBox": [jnp.stack(tboxes)],
+            "BBoxInsideWeight": [jnp.stack(bweights)],
+            "ForegroundNumber": [jnp.stack(fgnums)[:, None]]}
+
+
+@register("generate_proposal_labels", is_random=True,
+          nondiff_slots=("RpnRois", "GtClasses", "IsCrowd", "GtBoxes",
+                         "ImInfo", "RpnRoisNum"))
+def _generate_proposal_labels(ctx, ins, attrs):
+    """generate_proposal_labels_op.cc:407 (SampleRoisForOneImage). Static
+    form: each image contributes exactly batch_size_per_im output rows —
+    sampled fg rois first, then bg, then zero padding; RoisNum carries the
+    live count (the LoD stand-in). Candidates = the image's proposal block
+    (live rows per RpnRoisNum) plus its valid gt boxes, as in the
+    reference's concat step. BboxTargets go to the labeled class's 4-slot
+    (or class 1 when is_cls_agnostic), scaled by 1/bbox_reg_weights."""
+    rois_all = ins["RpnRois"][0]                 # [B*R, 4] padded blocks
+    gt_cls_all = ins["GtClasses"][0]             # [B, G]
+    crowd_all = ins.get("IsCrowd", [None])[0]
+    gt_all = ins["GtBoxes"][0]                   # [B, G, 4]
+    nums = ins.get("RpnRoisNum", [None])[0]
+    if gt_all.ndim == 2:
+        gt_all = gt_all[None]
+    b, g = gt_all.shape[:2]
+    r = rois_all.shape[0] // b
+    bs = int(attrs.get("batch_size_per_im", 512))
+    fg_frac = float(attrs.get("fg_fraction", 0.25))
+    fg_thresh = float(attrs.get("fg_thresh", 0.5))
+    bg_hi = float(attrs.get("bg_thresh_hi", 0.5))
+    bg_lo = float(attrs.get("bg_thresh_lo", 0.0))
+    reg_w = attrs.get("bbox_reg_weights", [0.1, 0.1, 0.2, 0.2])
+    class_nums = int(attrs.get("class_nums", 2))
+    agnostic = bool(attrs.get("is_cls_agnostic", False))
+    use_random = bool(attrs.get("use_random", True))
+
+    base = ctx.op_key(attrs)
+    o_rois, o_lab, o_tgt, o_inw, o_outw, o_cnt, o_rw = \
+        [], [], [], [], [], [], []
+    n_cand = r + g
+    for i in range(b):
+        blk = rois_all[i * r:(i + 1) * r]
+        gt = gt_all[i]
+        valid = _valid_gt(gt, None if crowd_all is None else crowd_all[i])
+        live = jnp.ones((r,), bool) if nums is None else \
+            jnp.arange(r) < nums.reshape(-1)[i]
+        cand = jnp.concatenate([blk, gt], axis=0)             # [R+G, 4]
+        cand_live = jnp.concatenate([live, valid])
+        iou = jnp.where(valid[None, :],
+                        _iou_matrix(cand, gt, normalized=False), -1.0)
+        mov = jnp.max(iou, axis=1)
+        marg = jnp.argmax(iou, axis=1)
+        fg = cand_live & (mov >= fg_thresh)
+        bg = cand_live & (mov < bg_hi) & (mov >= bg_lo)
+
+        k1, k2 = jax.random.split(jax.random.fold_in(base, i))
+        fg_rank = _rank_among(fg, _priorities(k1, n_cand, use_random))
+        n_fg = jnp.minimum(jnp.int32(round(fg_frac * bs)),
+                           jnp.sum(fg.astype(jnp.int32)))
+        fg_keep = fg & (fg_rank < n_fg)
+        bg_rank = _rank_among(bg, _priorities(k2, n_cand, use_random))
+        n_bg = jnp.minimum(bs - n_fg, jnp.sum(bg.astype(jnp.int32)))
+        bg_keep = bg & (bg_rank < n_bg)
+
+        # compact: fg rows to [0, n_fg), bg rows to [n_fg, n_fg + n_bg)
+        tgt_row = jnp.where(fg_keep, fg_rank,
+                            jnp.where(bg_keep, n_fg + bg_rank, bs))
+        rois_o = jnp.zeros((bs, 4), cand.dtype).at[tgt_row].set(
+            cand, mode="drop")
+        lab_cand = jnp.where(
+            fg_keep, gt_cls_all[i].reshape(-1)[jnp.maximum(marg, 0)]
+            .astype(jnp.int32), 0)
+        lab_o = jnp.zeros((bs,), jnp.int32).at[tgt_row].set(
+            lab_cand, mode="drop")
+        delta = _encode_delta(cand, gt[jnp.maximum(marg, 0)], weights=reg_w)
+        delta = jnp.where(fg_keep[:, None], delta, 0.0)
+        d_o = jnp.zeros((bs, 4), delta.dtype).at[tgt_row].set(
+            delta, mode="drop")
+        # scatter the 4-vector into the labeled class slot
+        cls_slot = jnp.ones((bs,), jnp.int32) if agnostic \
+            else jnp.maximum(lab_o, 0)
+        col = cls_slot[:, None] * 4 + jnp.arange(4, dtype=jnp.int32)[None, :]
+        is_fg_row = lab_o > 0
+        tgt_full = jnp.zeros((bs, 4 * class_nums), d_o.dtype).at[
+            jnp.arange(bs)[:, None], col].set(
+            jnp.where(is_fg_row[:, None], d_o, 0.0))
+        w_full = jnp.zeros((bs, 4 * class_nums), jnp.float32).at[
+            jnp.arange(bs)[:, None], col].set(
+            jnp.where(is_fg_row[:, None], 1.0, 0.0))
+        o_rois.append(rois_o)
+        o_lab.append(lab_o[:, None])
+        o_tgt.append(tgt_full)
+        o_inw.append(w_full)
+        o_outw.append(w_full)
+        o_cnt.append((n_fg + n_bg).astype(jnp.int32))
+        # live-row weight: the static stand-in for "this LoD row exists" —
+        # masked losses must not train on zero-padding rows as background
+        o_rw.append((jnp.arange(bs) < n_fg + n_bg)
+                    .astype(jnp.float32)[:, None])
+    return {"Rois": [jnp.concatenate(o_rois, 0)],
+            "LabelsInt32": [jnp.concatenate(o_lab, 0)],
+            "BboxTargets": [jnp.concatenate(o_tgt, 0)],
+            "BboxInsideWeights": [jnp.concatenate(o_inw, 0)],
+            "BboxOutsideWeights": [jnp.concatenate(o_outw, 0)],
+            "RoisNum": [jnp.stack(o_cnt)],
+            "RoiWeights": [jnp.concatenate(o_rw, 0)]}
+
+
+@register("generate_mask_labels",
+          nondiff_slots=("ImInfo", "GtClasses", "IsCrowd", "GtSegms",
+                         "Rois", "LabelsInt32", "RoisNum"))
+def _generate_mask_labels(ctx, ins, attrs):
+    """generate_mask_labels_op.cc:408. TPU-native redesign of the segm
+    input: the reference takes ragged polygon LoD and rasterizes on CPU
+    (Poly2MaskUtil); here GtSegms is a DENSE per-gt bitmap [B, G, Hm, Wm]
+    spanning the image (rasterize polygons host-side in the data
+    pipeline). For each fg roi the matched gt's bitmap is bilinearly
+    resampled over the roi window to resolution², thresholded at 0.5.
+    MaskInt32 rows are -1 except the roi's class slot (loss ignores <0),
+    matching the reference's expand_mask_targets semantics."""
+    im_info = ins["ImInfo"][0]                  # [B, 3]
+    gt_cls_all = ins["GtClasses"][0]            # [B, G]
+    crowd_all = ins.get("IsCrowd", [None])[0]
+    segms_all = ins["GtSegms"][0]               # [B, G, Hm, Wm]
+    rois_all = ins["Rois"][0]                   # [B*R, 4]
+    labels_all = ins["LabelsInt32"][0].reshape(-1)   # [B*R]
+    nums = ins.get("RoisNum", [None])[0]
+    num_classes = int(attrs.get("num_classes", 2))
+    res = int(attrs.get("resolution", 14))
+    b, g, hm, wm = segms_all.shape
+    r = rois_all.shape[0] // b
+
+    has_gt = bool(ins.get("GtBoxes"))
+    o_rois, o_has, o_mask = [], [], []
+    for i in range(b):
+        rois = rois_all[i * r:(i + 1) * r]
+        labels = labels_all[i * r:(i + 1) * r].astype(jnp.int32)
+        live = jnp.ones((r,), bool) if nums is None else \
+            jnp.arange(r) < nums.reshape(-1)[i]
+        fg = live & (labels > 0)
+        if has_gt:
+            # match each roi to its best-IoU valid (non-crowd, non-pad) gt
+            gt = ins["GtBoxes"][0][i]
+            valid = _valid_gt(gt,
+                              None if crowd_all is None else crowd_all[i])
+            iou = jnp.where(valid[None, :],
+                            _iou_matrix(rois, gt, normalized=False), -1.0)
+            marg = jnp.argmax(iou, axis=1)
+        else:
+            marg = jnp.zeros((r,), jnp.int32)   # single-gt convention
+        segs = segms_all[i][jnp.maximum(marg, 0)]        # [R, Hm, Wm]
+        x1, y1, x2, y2 = rois[:, 0], rois[:, 1], rois[:, 2], rois[:, 3]
+        imh, imw = im_info[i, 0], im_info[i, 1]
+        jj = (jnp.arange(res, dtype=jnp.float32) + 0.5) / res
+        u = x1[:, None] + jj[None, :] * (x2 - x1)[:, None]   # [R, res]
+        v = y1[:, None] + jj[None, :] * (y2 - y1)[:, None]
+        bu = jnp.clip(u / jnp.maximum(imw, 1.0) * wm - 0.5, 0.0, wm - 1.0)
+        bv = jnp.clip(v / jnp.maximum(imh, 1.0) * hm - 0.5, 0.0, hm - 1.0)
+        u0 = jnp.floor(bu).astype(jnp.int32)
+        v0 = jnp.floor(bv).astype(jnp.int32)
+        u1 = jnp.clip(u0 + 1, 0, wm - 1)
+        v1 = jnp.clip(v0 + 1, 0, hm - 1)
+        lu = (bu - u0)[:, None, :]                  # [R, 1, res]
+        lv = (bv - v0)[:, :, None]                  # [R, res, 1]
+        ri = jnp.arange(r)[:, None, None]
+        g00 = segs[ri, v0[:, :, None], u0[:, None, :]].astype(jnp.float32)
+        g01 = segs[ri, v0[:, :, None], u1[:, None, :]].astype(jnp.float32)
+        g10 = segs[ri, v1[:, :, None], u0[:, None, :]].astype(jnp.float32)
+        g11 = segs[ri, v1[:, :, None], u1[:, None, :]].astype(jnp.float32)
+        samp = (g00 * (1 - lv) * (1 - lu) + g01 * (1 - lv) * lu
+                + g10 * lv * (1 - lu) + g11 * lv * lu)       # [R, res, res]
+        bin_m = (samp >= 0.5).astype(jnp.int32).reshape(r, res * res)
+        full = jnp.full((r, num_classes, res * res), -1, jnp.int32)
+        cls = jnp.maximum(labels, 0)
+        full = full.at[jnp.arange(r), cls].set(bin_m)
+        full = jnp.where(fg[:, None, None], full, -1)
+        o_rois.append(jnp.where(fg[:, None], rois, 0.0))
+        o_has.append(fg.astype(jnp.int32)[:, None])
+        o_mask.append(full.reshape(r, num_classes * res * res))
+    return {"MaskRois": [jnp.concatenate(o_rois, 0)],
+            "RoiHasMaskInt32": [jnp.concatenate(o_has, 0)],
+            "MaskInt32": [jnp.concatenate(o_mask, 0)]}
+
+
+# ---------------------------------------------------------------------------
+# locality-aware NMS (EAST text detection) — quad geometry helpers
+# ---------------------------------------------------------------------------
+
+_MAXV = 16  # clip buffer: 4-gon ∩ 4 half-planes has ≤ 8 vertices
+
+
+def _shoelace(pts, cnt):
+    """Signed area of the first `cnt` vertices of pts [V, 2]."""
+    v = pts.shape[0]
+    idx = jnp.arange(v)
+    m = idx < cnt
+    nxt = jnp.where(idx + 1 >= cnt, 0, idx + 1)
+    x, y = pts[:, 0], pts[:, 1]
+    cross = x * y[nxt] - x[nxt] * y
+    return 0.5 * jnp.sum(jnp.where(m, cross, 0.0))
+
+
+def _clip_halfplane(pts, cnt, a, b):
+    """Sutherland–Hodgman step: keep the side left of directed edge a→b.
+    pts [V,2] with `cnt` live vertices → (pts', cnt')."""
+    v = pts.shape[0]
+    idx = jnp.arange(v)
+    m = idx < cnt
+    nxt = jnp.where(idx + 1 >= cnt, 0, idx + 1)
+    p, q = pts, pts[nxt]
+    d = b - a
+
+    def side(x):
+        return d[0] * (x[:, 1] - a[1]) - d[1] * (x[:, 0] - a[0])
+
+    sp, sq = side(p), side(q)
+    in_p, in_q = sp >= 0, sq >= 0
+    t = sp / jnp.where(jnp.abs(sp - sq) < 1e-12, 1e-12, sp - sq)
+    inter = p + t[:, None] * (q - p)
+    # each edge emits: p if in_p; intersection if in_p != in_q
+    emit1 = m & in_p
+    emit2 = m & (in_p ^ in_q)
+    # pack (emit1 then emit2 per edge, order-preserving)
+    cnt1 = jnp.cumsum(emit1.astype(jnp.int32))
+    cnt2 = jnp.cumsum(emit2.astype(jnp.int32))
+    pos1 = jnp.where(emit1, cnt1 - 1 + jnp.where(
+        idx > 0, cnt2[jnp.maximum(idx - 1, 0)], 0), _MAXV)
+    pos2 = jnp.where(emit2, cnt1 + cnt2 - 1, _MAXV)
+    out = jnp.zeros((_MAXV, 2), pts.dtype)
+    out = out.at[pos1].set(p, mode="drop")
+    out = out.at[pos2].set(inter, mode="drop")
+    return out, cnt1[-1] + cnt2[-1]
+
+
+def _poly_area4(q):
+    """|area| of quad q [4, 2]."""
+    return jnp.abs(_shoelace(jnp.concatenate(
+        [q, jnp.zeros((_MAXV - 4, 2), q.dtype)]), 4))
+
+
+def _quad_iou(q1, q2):
+    """PolyIoU (gpc-free): clip q1 by q2's 4 edges (both wound CCW via
+    signed-area flip), shoelace the intersection."""
+    def ccw(q):
+        s = _shoelace(jnp.concatenate(
+            [q, jnp.zeros((_MAXV - 4, 2), q.dtype)]), 4)
+        return jnp.where(s < 0, q[::-1], q)
+
+    a, c = ccw(q1), ccw(q2)
+    pts = jnp.concatenate([a, jnp.zeros((_MAXV - 4, 2), q1.dtype)])
+    cnt = jnp.int32(4)
+    for e in range(4):
+        pts, cnt = _clip_halfplane(pts, cnt, c[e], c[(e + 1) % 4])
+    inter = jnp.abs(_shoelace(pts, cnt))
+    a1, a2 = _poly_area4(a), _poly_area4(c)
+    union = a1 + a2 - inter
+    return jnp.where(union > 1e-9, inter / union, 0.0)
+
+
+def _box_iou_single(b1, b2, normalized):
+    off = 0.0 if normalized else 1.0
+    ix = jnp.maximum(jnp.minimum(b1[2], b2[2])
+                     - jnp.maximum(b1[0], b2[0]) + off, 0.0)
+    iy = jnp.maximum(jnp.minimum(b1[3], b2[3])
+                     - jnp.maximum(b1[1], b2[1]) + off, 0.0)
+    inter = ix * iy
+    a1 = (b1[2] - b1[0] + off) * (b1[3] - b1[1] + off)
+    a2 = (b2[2] - b2[0] + off) * (b2[3] - b2[1] + off)
+    return jnp.where(a1 + a2 - inter > 1e-9, inter / (a1 + a2 - inter), 0.0)
+
+
+@register("locality_aware_nms", nondiff_slots=("BBoxes", "Scores"))
+def _locality_aware_nms(ctx, ins, attrs):
+    """locality_aware_nms_op.cc:313 (EAST). Pass 1 streams boxes in input
+    order (locality = adjacent rows of the geometry map) merging
+    consecutive overlapping boxes score-weighted (PolyWeightedMerge);
+    pass 2 is standard greedy NMS over the merged set. Static output:
+    [keep_top_k, 2 + box_size] rows (label, score, coords), padding rows
+    score 0 label -1, plus OutCount. Supports box_size 4 (rects) and 8
+    (quads, true polygon IoU via Sutherland–Hodgman clipping)."""
+    boxes = ins["BBoxes"][0]           # [N, M, K]
+    scores = ins["Scores"][0]          # [N, C, M]
+    if boxes.ndim == 2:
+        boxes = boxes[None]
+    if scores.ndim == 2:
+        scores = scores[None]
+    n, m, k = boxes.shape
+    c = scores.shape[1]
+    if k not in (4, 8):
+        raise NotImplementedError(
+            f"locality_aware_nms: box_size {k} (4 and 8 supported; the "
+            f"reference's 16/24/32-point variants are out of scope)")
+    score_thresh = float(attrs.get("score_threshold", 0.0))
+    nms_thresh = float(attrs.get("nms_threshold", 0.3))
+    nms_top_k = int(attrs.get("nms_top_k", -1))
+    keep_top_k = int(attrs.get("keep_top_k", -1))
+    bg = int(attrs.get("background_label", -1))
+    normalized = bool(attrs.get("normalized", True))
+    if keep_top_k <= 0:
+        keep_top_k = m
+    top_k = m if nms_top_k <= 0 else min(nms_top_k, m)
+
+    def iou_one(b1, b2):
+        if k == 4:
+            return _box_iou_single(b1, b2, normalized)
+        return _quad_iou(b1.reshape(4, 2), b2.reshape(4, 2))
+
+    def merge_pass(bx, sc):
+        """Sequential locality merge: carry the open (box, score); emit the
+        previous one whenever the next box stops overlapping it."""
+        def step(carry, inp):
+            cur_b, cur_s, started = carry
+            b_i, s_i = inp
+            ov = iou_one(b_i, cur_b)
+            do_merge = started & (ov > nms_thresh)
+            tot = cur_s + s_i
+            merged = (b_i * s_i + cur_b * cur_s) / jnp.maximum(tot, 1e-12)
+            # on merge: keep accumulating, emit nothing
+            new_b = jnp.where(do_merge, merged, b_i)
+            new_s = jnp.where(do_merge, tot, s_i)
+            emit_b = jnp.where(do_merge, jnp.zeros_like(cur_b), cur_b)
+            emit_s = jnp.where(do_merge | ~started, 0.0, cur_s)
+            return (new_b, new_s, jnp.ones((), bool)), (emit_b, emit_s)
+
+        (last_b, last_s, started), (eb, es) = jax.lax.scan(
+            step, (jnp.zeros((k,), bx.dtype), jnp.zeros((), sc.dtype),
+                   jnp.zeros((), bool)), (bx, sc))
+        eb = jnp.concatenate([eb, last_b[None]])
+        es = jnp.concatenate([es, jnp.where(started, last_s, 0.0)[None]])
+        return eb, es                            # [M+1, K], [M+1]
+
+    def nms_pass(bx, sc):
+        order = jnp.argsort(-sc)[:top_k]
+        bx, sc = bx[order], sc[order]
+        t = bx.shape[0]
+        if k == 4:
+            x1 = jnp.maximum(bx[:, None, 0], bx[None, :, 0])
+            y1 = jnp.maximum(bx[:, None, 1], bx[None, :, 1])
+            x2 = jnp.minimum(bx[:, None, 2], bx[None, :, 2])
+            y2 = jnp.minimum(bx[:, None, 3], bx[None, :, 3])
+            off = 0.0 if normalized else 1.0
+            inter = jnp.maximum(x2 - x1 + off, 0) * jnp.maximum(
+                y2 - y1 + off, 0)
+            ar = (bx[:, 2] - bx[:, 0] + off) * (bx[:, 3] - bx[:, 1] + off)
+            iou = inter / jnp.maximum(ar[:, None] + ar[None, :] - inter,
+                                      1e-9)
+        else:
+            iou = jax.vmap(lambda b1: jax.vmap(
+                lambda b2: _quad_iou(b1.reshape(4, 2),
+                                     b2.reshape(4, 2)))(bx))(bx)
+
+        def body(i, keep):
+            sup = keep & (iou[i] > nms_thresh) \
+                & (jnp.arange(t) > i) & keep[i]
+            return keep & ~sup
+
+        keep = jax.lax.fori_loop(0, t, body,
+                                 sc > jnp.maximum(score_thresh, 0.0))
+        return bx, sc, keep
+
+    outs, counts = [], []
+    for ni in range(n):
+        all_b, all_s, all_l = [], [], []
+        for ci in range(c):
+            if ci == bg:
+                continue
+            eb, es = merge_pass(boxes[ni], scores[ni, ci])
+            bx, sc, keep = nms_pass(eb, es)
+            sc = jnp.where(keep, sc, 0.0)
+            all_b.append(bx)
+            all_s.append(sc)
+            all_l.append(jnp.full(sc.shape, ci, jnp.int32))
+        ab = jnp.concatenate(all_b)
+        asc = jnp.concatenate(all_s)
+        al = jnp.concatenate(all_l)
+        order = jnp.argsort(-asc)[:keep_top_k]
+        sc_k = asc[order]
+        row = jnp.concatenate(
+            [jnp.where(sc_k > 0, al[order], -1).astype(ab.dtype)[:, None],
+             sc_k[:, None], ab[order]], axis=1)
+        outs.append(row)
+        counts.append(jnp.sum((sc_k > 0).astype(jnp.int32)))
+    return {"Out": [jnp.concatenate(outs, 0)],
+            "OutCount": [jnp.stack(counts)]}
+
+
+@register("roi_perspective_transform",
+          nondiff_slots=("ROIs", "RoisNum"))
+def _roi_perspective_transform(ctx, ins, attrs):
+    """roi_perspective_transform_op.cc:570 (OCR text rectification): each
+    quad ROI [x1..y4] is warped to a transformed_height×transformed_width
+    rect by the homography mapping the rect corners to the quad corners
+    (8×8 solve per roi, batched), then X is bilinearly sampled along the
+    warp. Out2InIdx/Out2InWeights (CUDA backward scratch) are not emitted —
+    jax autodiffs the gather. Mask marks in-bounds samples."""
+    x = ins["X"][0]                    # [N, C, H, W]
+    rois = ins["ROIs"][0]              # [R, 8] quads
+    ss = float(attrs.get("spatial_scale", 1.0))
+    th = int(attrs.get("transformed_height", 1))
+    tw = int(attrs.get("transformed_width", 1))
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    from .tail_ops import _roi_batch_index
+    bids = _roi_batch_index(ins, r, n)
+
+    quad = rois.reshape(r, 4, 2) * ss              # (x1,y1)..(x4,y4)
+    # rect corners in output space, same winding as the reference
+    rect = jnp.asarray([[0.0, 0.0], [tw - 1.0, 0.0],
+                        [tw - 1.0, th - 1.0], [0.0, th - 1.0]], jnp.float32)
+
+    def solve_h(qd):
+        # H maps (u,v,1) -> (x,y): rows [u v 1 0 0 0 -ux -vx] h = x etc.
+        zero = jnp.zeros(())
+        one = jnp.ones(())
+        rows = []
+        rhs = []
+        for p in range(4):
+            u, v = rect[p, 0], rect[p, 1]
+            xq, yq = qd[p, 0], qd[p, 1]
+            rows.append(jnp.stack([u, v, one, zero, zero, zero,
+                                   -u * xq, -v * xq]))
+            rows.append(jnp.stack([zero, zero, zero, u, v, one,
+                                   -u * yq, -v * yq]))
+            rhs.extend([xq, yq])
+        a = jnp.stack(rows)                         # [8, 8]
+        bvec = jnp.stack(rhs)
+        sol = jnp.linalg.solve(a + 1e-9 * jnp.eye(8), bvec)
+        return jnp.concatenate([sol, jnp.ones((1,))])   # [9]
+
+    hmats = jax.vmap(solve_h)(quad)                 # [R, 9]
+    hm = hmats.reshape(r, 3, 3)
+    uu, vv = jnp.meshgrid(jnp.arange(tw, dtype=jnp.float32),
+                          jnp.arange(th, dtype=jnp.float32))
+    ones = jnp.ones_like(uu)
+    grid = jnp.stack([uu, vv, ones], axis=0).reshape(3, th * tw)
+    xy = jnp.einsum("rij,jp->rip", hm, grid)        # [R, 3, th*tw]
+    xs = xy[:, 0] / jnp.where(jnp.abs(xy[:, 2]) < 1e-9, 1e-9, xy[:, 2])
+    ys = xy[:, 1] / jnp.where(jnp.abs(xy[:, 2]) < 1e-9, 1e-9, xy[:, 2])
+    inb = (xs >= -0.5) & (xs <= w - 0.5) & (ys >= -0.5) & (ys <= h - 0.5)
+    xc = jnp.clip(xs, 0.0, w - 1.0)
+    yc = jnp.clip(ys, 0.0, h - 1.0)
+    x0 = jnp.floor(xc).astype(jnp.int32)
+    y0 = jnp.floor(yc).astype(jnp.int32)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    lx = (xc - x0)[:, None, :]
+    ly = (yc - y0)[:, None, :]
+    ri = bids[:, None, None]
+    ci = jnp.arange(c)[None, :, None]
+    v00 = x[ri, ci, y0[:, None, :], x0[:, None, :]]
+    v01 = x[ri, ci, y0[:, None, :], x1[:, None, :]]
+    v10 = x[ri, ci, y1[:, None, :], x0[:, None, :]]
+    v11 = x[ri, ci, y1[:, None, :], x1[:, None, :]]
+    out = (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx
+           + v10 * ly * (1 - lx) + v11 * ly * lx)
+    out = jnp.where(inb[:, None, :], out, 0.0).reshape(r, c, th, tw)
+    mask = inb.astype(jnp.int32).reshape(r, 1, th, tw)
+    return {"Out": [out], "Mask": [mask], "TransformMatrix": [hmats],
+            "Out2InIdx": [None], "Out2InWeights": [None]}
+
+
+@register("ssd_loss", is_random=False,
+          nondiff_slots=("GtBox", "GtLabel", "PriorBox", "PriorBoxVar"))
+def _ssd_loss(ctx, ins, attrs):
+    """The reference builds ssd_loss as an 8-op python composition
+    (python/paddle/fluid/layers/detection.py:1517: iou_similarity →
+    bipartite_match → target_assigns → mine_hard_examples → smooth_l1 +
+    softmax CE). That decomposition exists to thread ragged LoD through
+    separate CPU kernels; here the whole loss fuses into one static-shape
+    lowering per batch — same math: bipartite matching per image, hard
+    negative mining at neg_pos_ratio, encoded-center-size loc targets,
+    conf CE over matched + mined, normalized by matched count.
+    Gt padding rows are zero-area boxes."""
+    loc = ins["Location"][0]           # [B, P, 4]
+    conf = ins["Confidence"][0]        # [B, P, C]
+    gt_box = ins["GtBox"][0]           # [B, G, 4]
+    gt_lbl = ins["GtLabel"][0]         # [B, G, 1] or [B, G]
+    prior = ins["PriorBox"][0].reshape(-1, 4)          # [P, 4]
+    pvar_in = ins.get("PriorBoxVar", [None])[0]
+    pvar = (jnp.asarray([0.1, 0.1, 0.2, 0.2], prior.dtype)[None, :]
+            * jnp.ones_like(prior)) if pvar_in is None \
+        else pvar_in.reshape(-1, 4)
+    overlap_t = float(attrs.get("overlap_threshold", 0.5))
+    neg_overlap = float(attrs.get("neg_overlap", 0.5))
+    ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    loc_w = float(attrs.get("loc_loss_weight", 1.0))
+    conf_w = float(attrs.get("conf_loss_weight", 1.0))
+    bg_label = int(attrs.get("background_label", 0))
+    match_type = attrs.get("match_type", "per_prediction")
+    normalize = bool(attrs.get("normalize", True))
+    mining = attrs.get("mining_type", "max_negative")
+    if mining != "max_negative":
+        raise NotImplementedError("ssd_loss: max_negative mining only "
+                                  "(sample_size is a hard_example knob)")
+    if gt_lbl.ndim == 3:
+        gt_lbl = gt_lbl[..., 0]
+    b, p, ncls = conf.shape
+    g = gt_box.shape[1]
+
+    from .detection_ops import _bipartite_match as _bm  # reuse lowering
+
+    losses = []
+    for i in range(b):
+        gt = gt_box[i]
+        valid = _valid_gt(gt, None)
+        iou = jnp.where(valid[:, None],
+                        _iou_matrix(gt, prior, normalized=True), -1.0)
+        mres = _bm(ctx, {"DistMat": [jnp.where(iou < 0, 0.0, iou)[None]]},
+                   {"match_type": match_type,
+                    "dist_threshold": overlap_t})
+        match = mres["ColToRowMatchIndices"][0][0]      # [P] gt idx or -1
+        mdist = mres["ColToRowMatchDist"][0][0]
+        matched = match >= 0
+        safe = jnp.maximum(match, 0)
+
+        # conf target: gt label where matched, else background
+        tgt_lbl = jnp.where(matched,
+                            gt_lbl[i].reshape(-1)[safe].astype(jnp.int32),
+                            bg_label)
+        logp = jax.nn.log_softmax(conf[i].astype(jnp.float32), axis=-1)
+        ce = -jnp.take_along_axis(logp, tgt_lbl[:, None], axis=1)[:, 0]
+
+        # hard negative mining on the conf loss
+        is_neg = ~matched & (mdist < neg_overlap)
+        n_pos = jnp.sum(matched.astype(jnp.int32))
+        n_neg = jnp.minimum((n_pos.astype(jnp.float32) * ratio)
+                            .astype(jnp.int32),
+                            jnp.sum(is_neg.astype(jnp.int32)))
+        neg_rank = _rank_among(is_neg, -ce)        # highest loss first
+        neg_keep = is_neg & (neg_rank < n_neg)
+
+        # loc target: encode_center_size(gt, prior) with prior variances
+        pw = prior[:, 2] - prior[:, 0]
+        ph = prior[:, 3] - prior[:, 1]
+        pcx = prior[:, 0] + 0.5 * pw
+        pcy = prior[:, 1] + 0.5 * ph
+        gtm = gt[safe]
+        gw = gtm[:, 2] - gtm[:, 0]
+        gh = gtm[:, 3] - gtm[:, 1]
+        gcx = gtm[:, 0] + 0.5 * gw
+        gcy = gtm[:, 1] + 0.5 * gh
+        tloc = jnp.stack(
+            [(gcx - pcx) / jnp.maximum(pw, 1e-6) / pvar[:, 0],
+             (gcy - pcy) / jnp.maximum(ph, 1e-6) / pvar[:, 1],
+             jnp.log(jnp.maximum(gw, 1e-6) / jnp.maximum(pw, 1e-6))
+             / pvar[:, 2],
+             jnp.log(jnp.maximum(gh, 1e-6) / jnp.maximum(ph, 1e-6))
+             / pvar[:, 3]], axis=1)
+        diff = jnp.abs(loc[i].astype(jnp.float32) - tloc)
+        sl1 = jnp.sum(jnp.where(diff < 1.0, 0.5 * diff * diff, diff - 0.5),
+                      axis=1)
+        loc_loss = jnp.sum(jnp.where(matched, sl1, 0.0))
+        conf_loss = jnp.sum(jnp.where(matched | neg_keep, ce, 0.0))
+        total = loc_w * loc_loss + conf_w * conf_loss
+        if normalize:   # reference normalizes by the matched-prior count
+            total = total / jnp.maximum(n_pos.astype(jnp.float32), 1.0)
+        losses.append(total)
+    return {"Loss": [jnp.stack(losses)[:, None]]}
+
+
+# ---------------------------------------------------------------------------
+# Build-time shape inference for the per-image-loop ops above (plus the two
+# batch-looping ops in detection_ops.py). The generic eval_shape inference
+# substitutes a large sentinel for dynamic batch dims, which would make these
+# ops' python `for i in range(b)` loops trace thousands of images at BUILD
+# time. Shapes here are simple functions of attrs/static dims, so set them
+# directly (reference: each op's InferShape method).
+# ---------------------------------------------------------------------------
+
+def _mk_infer(rules):
+    """rules: list of (slot, shape_fn(block, op) -> shape, dtype)."""
+    def infer(block, op):
+        for slot, shape_fn, dtype in rules:
+            names = op.outputs.get(slot, [])
+            for nme in names:
+                if nme == "@EMPTY@":
+                    continue
+                v = block.find_var_recursive(nme)
+                if v is None:
+                    continue
+                try:
+                    v.shape = tuple(shape_fn(block, op))
+                    v.dtype = dtype
+                except Exception:
+                    pass
+        block.program.bump_version()
+    return infer
+
+
+def _in_shape(block, op, slot):
+    return tuple(block.var(op.inputs[slot][0]).shape)
+
+
+def _anchor_count(block, op):
+    shp = _in_shape(block, op, "Anchor")
+    tot = 1
+    for d in shp:
+        tot *= d
+    return tot // 4
+
+
+def _attach_detection_infers():
+    from . import registry as _r
+
+    _r.get("rpn_target_assign").infer = _mk_infer([
+        ("TargetLabel", lambda b, o: (-1, _anchor_count(b, o), 1),
+         "float32"),
+        ("ScoreWeight", lambda b, o: (-1, _anchor_count(b, o), 1),
+         "float32"),
+        ("TargetBBox", lambda b, o: (-1, _anchor_count(b, o), 4),
+         "float32"),
+        ("BBoxInsideWeight", lambda b, o: (-1, _anchor_count(b, o), 4),
+         "float32"),
+    ])
+    _r.get("retinanet_target_assign").infer = _mk_infer([
+        ("TargetLabel", lambda b, o: (-1, _anchor_count(b, o), 1), "int32"),
+        ("ScoreWeight", lambda b, o: (-1, _anchor_count(b, o), 1),
+         "float32"),
+        ("TargetBBox", lambda b, o: (-1, _anchor_count(b, o), 4),
+         "float32"),
+        ("BBoxInsideWeight", lambda b, o: (-1, _anchor_count(b, o), 4),
+         "float32"),
+        ("ForegroundNumber", lambda b, o: (-1, 1), "int32"),
+    ])
+    _r.get("generate_proposal_labels").infer = _mk_infer([
+        ("Rois", lambda b, o: (-1, 4), "float32"),
+        ("LabelsInt32", lambda b, o: (-1, 1), "int32"),
+        ("BboxTargets",
+         lambda b, o: (-1, 4 * int(o.attrs.get("class_nums", 2))),
+         "float32"),
+        ("BboxInsideWeights",
+         lambda b, o: (-1, 4 * int(o.attrs.get("class_nums", 2))),
+         "float32"),
+        ("BboxOutsideWeights",
+         lambda b, o: (-1, 4 * int(o.attrs.get("class_nums", 2))),
+         "float32"),
+        ("RoisNum", lambda b, o: (-1,), "int32"),
+        ("RoiWeights", lambda b, o: (-1, 1), "float32"),
+    ])
+    _r.get("generate_mask_labels").infer = _mk_infer([
+        ("MaskRois", lambda b, o: (-1, 4), "float32"),
+        ("RoiHasMaskInt32", lambda b, o: (-1, 1), "int32"),
+        ("MaskInt32",
+         lambda b, o: (-1, int(o.attrs["num_classes"])
+                       * int(o.attrs["resolution"]) ** 2), "int32"),
+    ])
+    _r.get("locality_aware_nms").infer = _mk_infer([
+        ("Out", lambda b, o: (-1, 2 + _in_shape(b, o, "BBoxes")[-1]),
+         "float32"),
+        ("OutCount", lambda b, o: (-1,), "int32"),
+    ])
+    _r.get("ssd_loss").infer = _mk_infer([
+        ("Loss", lambda b, o: (-1, 1), "float32"),
+    ])
+    _r.get("generate_proposals").infer = _mk_infer([
+        ("RpnRois", lambda b, o: (-1, 4), "float32"),
+        ("RpnRoiProbs", lambda b, o: (-1, 1), "float32"),
+        ("RpnRoisNum", lambda b, o: (-1,), "int32"),
+    ])
+    _r.get("multiclass_nms").infer = _mk_infer([
+        ("Out", lambda b, o: (-1, 6), "float32"),
+        ("NmsRoisNum", lambda b, o: (-1,), "int32"),
+        ("Index", lambda b, o: (-1, 1), "int32"),
+    ])
+
+
+_attach_detection_infers()

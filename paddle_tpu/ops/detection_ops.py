@@ -438,19 +438,32 @@ def _nms_per_class(boxes, scores, iou_threshold, top_k, normalized,
 
 @register("multiclass_nms")
 def _multiclass_nms(ctx, ins, attrs):
-    """multiclass_nms_op.cc, static-shape formulation: output is a fixed
+    """multiclass_nms_op.cc, static-shape formulation: per image a fixed
     [keep_top_k, 6] block (label, score, x1, y1, x2, y2) padded with
-    label=-1 rows, plus NmsRoisNum = number of valid rows. Single-image
-    (BBoxes [M, 4], Scores [C, M]); batch via the frontend loop/vmap."""
+    label=-1 rows, plus NmsRoisNum = per-image valid-row counts. 2-D
+    input ([M,4]/[C,M]) keeps the legacy single-image contract (scalar
+    count); 3-D input runs the per-image loop and emits concatenated
+    blocks + [N] counts (the reference's LoD layout, static)."""
     bboxes = ins["BBoxes"][0]
     scores = ins["Scores"][0]
-    if bboxes.ndim == 3:                  # [1, M, 4] batch-1 convenience
-        if bboxes.shape[0] != 1:
-            raise ValueError(
-                "multiclass_nms lowering is single-image; got batch "
-                f"{bboxes.shape[0]} — loop or vmap at the frontend")
-        bboxes = bboxes[0]
-        scores = scores[0]
+    if bboxes.ndim == 3:
+        # ANY 3-D batch (including N==1) gets the [N]-counts contract so
+        # output ranks don't depend on batch size
+        n, m = bboxes.shape[:2]
+        outs, counts, idxs = [], [], []
+        for i in range(n):
+            o, cnt, ix = _multiclass_nms_single(bboxes[i], scores[i], attrs)
+            outs.append(o)
+            counts.append(cnt)
+            idxs.append(jnp.where(ix >= 0, ix + i * m, -1))
+        return {"Out": [jnp.concatenate(outs, 0)],
+                "NmsRoisNum": [jnp.stack(counts)],
+                "Index": [jnp.concatenate(idxs, 0)]}
+    out, count, index = _multiclass_nms_single(bboxes, scores, attrs)
+    return {"Out": [out], "NmsRoisNum": [count], "Index": [index]}
+
+
+def _multiclass_nms_single(bboxes, scores, attrs):
     c, m = scores.shape
     score_threshold = attrs.get("score_threshold", 0.0)
     nms_top_k = min(int(attrs.get("nms_top_k", m)) if
@@ -491,8 +504,7 @@ def _multiclass_nms(ctx, ins, attrs):
     count = jnp.sum(valid).astype(jnp.int32)
     # Index: each kept row's index into the input box list (-1 on padding)
     index = jnp.where(valid, src[top_idx], -1).astype(jnp.int32)
-    return {"Out": [out], "NmsRoisNum": [count],
-            "Index": [index[:, None]]}
+    return out, count, index[:, None]
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,419 @@
+"""Round-4 detection target-assignment ops vs independent numpy loop
+oracles (reference per-op unittests pattern: test_rpn_target_assign_op.py,
+test_generate_proposal_labels_op.py, test_locality_aware_nms_op.py,
+test_roi_perspective_transform_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401  (registers ops)
+from op_test import run_op, check_grad
+
+R = np.random.RandomState(7)
+
+
+def _iou1(a, b):
+    """Pixel-convention IoU (+1 widths)."""
+    iw = min(a[2], b[2]) - max(a[0], b[0]) + 1.0
+    ih = min(a[3], b[3]) - max(a[1], b[1]) + 1.0
+    if iw <= 0 or ih <= 0:
+        return 0.0
+    inter = iw * ih
+    aa = (a[2] - a[0] + 1.0) * (a[3] - a[1] + 1.0)
+    ab = (b[2] - b[0] + 1.0) * (b[3] - b[1] + 1.0)
+    return inter / (aa + ab - inter)
+
+
+def _delta1(ex, gt, w=None):
+    ew = ex[2] - ex[0] + 1.0
+    eh = ex[3] - ex[1] + 1.0
+    ecx, ecy = ex[0] + 0.5 * ew, ex[1] + 0.5 * eh
+    gw = gt[2] - gt[0] + 1.0
+    gh = gt[3] - gt[1] + 1.0
+    gcx, gcy = gt[0] + 0.5 * gw, gt[1] + 0.5 * gh
+    d = np.array([(gcx - ecx) / ew, (gcy - ecy) / eh,
+                  np.log(gw / ew), np.log(gh / eh)])
+    return d / np.asarray(w) if w is not None else d
+
+
+def _grid_anchors(n=4, size=6.0, stride=8.0):
+    out = []
+    for i in range(n):
+        for j in range(n):
+            cx, cy = j * stride + 4, i * stride + 4
+            out.append([cx - size / 2, cy - size / 2,
+                        cx + size / 2, cy + size / 2])
+            out.append([cx - size, cy - size / 4,
+                        cx + size, cy + size / 4])
+    return np.asarray(out, np.float32)
+
+
+def test_rpn_target_assign_matches_loop_oracle():
+    anchors = _grid_anchors()                       # [32, 4]
+    a = anchors.shape[0]
+    gt = np.zeros((1, 3, 4), np.float32)
+    gt[0, 0] = [2, 2, 12, 12]
+    gt[0, 1] = [14, 14, 30, 28]                     # second real gt
+    # gt[0, 2] stays zero = padding
+    crowd = np.zeros((1, 3), np.int64)
+    im_info = np.asarray([[32.0, 32.0, 1.0]], np.float32)
+    attrs = {"rpn_batch_size_per_im": 12, "rpn_straddle_thresh": 0.0,
+             "rpn_fg_fraction": 0.5, "rpn_positive_overlap": 0.6,
+             "rpn_negative_overlap": 0.3, "use_random": False}
+    out = run_op("rpn_target_assign",
+                 {"Anchor": [anchors], "GtBoxes": [gt], "IsCrowd": [crowd],
+                  "ImInfo": [im_info]}, attrs)
+    lab = np.asarray(out["TargetLabel"][0])[0, :, 0]
+    sw = np.asarray(out["ScoreWeight"][0])[0, :, 0]
+    tb = np.asarray(out["TargetBBox"][0])[0]
+    bw = np.asarray(out["BBoxInsideWeight"][0])[0]
+
+    # oracle
+    inside = [(anchors[i, 0] >= 0 and anchors[i, 1] >= 0
+               and anchors[i, 2] < 32 and anchors[i, 3] < 32)
+              for i in range(a)]
+    iou = np.array([[_iou1(anchors[i], gt[0, g]) if g < 2 else -1.0
+                     for g in range(3)] for i in range(a)])
+    amax = iou.max(1)
+    aarg = iou.argmax(1)
+    gmax = np.where(np.asarray(inside)[:, None], iou, -1.0).max(0)
+    fg = [inside[i] and (amax[i] >= 0.6 or any(
+        iou[i, g] >= gmax[g] - 1e-5 and gmax[g] > 0 for g in range(2)))
+        for i in range(a)]
+    bg = [inside[i] and amax[i] < 0.3 and not fg[i] for i in range(a)]
+    fg_idx = [i for i in range(a) if fg[i]][:6]     # use_random=False: first-N
+    n_bg = 12 - len(fg_idx)
+    bg_idx = [i for i in range(a) if bg[i]][:n_bg]
+    exp_lab = np.zeros(a)
+    exp_lab[fg_idx] = 1.0
+    exp_sw = np.zeros(a)
+    exp_sw[fg_idx + bg_idx] = 1.0
+    np.testing.assert_allclose(lab, exp_lab)
+    np.testing.assert_allclose(sw, exp_sw)
+    for i in fg_idx:
+        np.testing.assert_allclose(
+            tb[i], _delta1(anchors[i], gt[0, aarg[i]]), rtol=1e-5,
+            atol=1e-5)
+        np.testing.assert_allclose(bw[i], 1.0)
+    assert np.all(tb[~np.asarray(fg, bool)] == 0.0)
+    assert np.all(bw.sum(1)[~np.asarray(fg, bool)] == 0.0)
+
+
+def test_retinanet_target_assign_labels_and_ignore_band():
+    anchors = _grid_anchors()
+    a = anchors.shape[0]
+    gt = np.zeros((1, 2, 4), np.float32)
+    gt[0, 0] = [2, 2, 12, 12]
+    labels = np.asarray([[3, 0]], np.int64)
+    im_info = np.asarray([[32.0, 32.0, 1.0]], np.float32)
+    out = run_op("retinanet_target_assign",
+                 {"Anchor": [anchors], "GtBoxes": [gt],
+                  "GtLabels": [labels], "ImInfo": [im_info]},
+                 {"positive_overlap": 0.5, "negative_overlap": 0.4})
+    lab = np.asarray(out["TargetLabel"][0])[0, :, 0]
+    sw = np.asarray(out["ScoreWeight"][0])[0, :, 0]
+    fgn = int(np.asarray(out["ForegroundNumber"][0])[0, 0])
+    iou = np.array([_iou1(anchors[i], gt[0, 0]) for i in range(a)])
+    best = iou.argmax()
+    fg = (iou >= 0.5) | (np.arange(a) == best)
+    ignore = ~fg & (iou >= 0.4)
+    assert fgn == fg.sum()
+    np.testing.assert_array_equal(lab[fg], 3)
+    np.testing.assert_array_equal(sw[ignore], 0.0)
+    np.testing.assert_array_equal(lab[~fg], 0)
+    np.testing.assert_array_equal(sw[fg], 1.0)
+
+
+def test_generate_proposal_labels_matches_loop_oracle():
+    r, g, bs = 8, 2, 6
+    rois = np.zeros((8, 4), np.float32)
+    rois[0] = [2, 2, 11, 11]        # IoU with gt0 high -> fg
+    rois[1] = [3, 3, 13, 13]        # fg
+    rois[2] = [20, 20, 30, 30]      # bg (no overlap)
+    rois[3] = [0, 16, 10, 30]       # bg
+    rois[4] = [4, 4, 30, 30]        # mid overlap -> depends
+    rois[5] = [16, 0, 30, 12]       # bg
+    # rows 6..7 are dead padding (count=6)
+    nums = np.asarray([6], np.int32)
+    gt = np.zeros((1, g, 4), np.float32)
+    gt[0, 0] = [2, 2, 12, 12]
+    gt_cls = np.asarray([[2, 0]], np.int64)
+    crowd = np.zeros((1, g), np.int64)
+    im_info = np.asarray([[32.0, 32.0, 1.0]], np.float32)
+    attrs = {"batch_size_per_im": bs, "fg_fraction": 0.5, "fg_thresh": 0.5,
+             "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0,
+             "bbox_reg_weights": [0.1, 0.1, 0.2, 0.2], "class_nums": 3,
+             "use_random": False}
+    out = run_op("generate_proposal_labels",
+                 {"RpnRois": [rois], "GtClasses": [gt_cls],
+                  "IsCrowd": [crowd], "GtBoxes": [gt], "ImInfo": [im_info],
+                  "RpnRoisNum": [nums]}, attrs)
+    # oracle: candidates = 6 live rois + 1 valid gt
+    cand = np.concatenate([rois, gt[0]], 0)
+    live = [True] * 6 + [False] * 2 + [True, False]
+    mov = np.array([_iou1(cand[i], gt[0, 0]) for i in range(r + g)])
+    fg = [live[i] and mov[i] >= 0.5 for i in range(r + g)]
+    bg = [live[i] and 0.0 <= mov[i] < 0.5 for i in range(r + g)]
+    fg_idx = [i for i in range(r + g) if fg[i]][:3]
+    bg_idx = [i for i in range(r + g) if bg[i]][:bs - len(fg_idx)]
+    got_rois = np.asarray(out["Rois"][0])
+    got_lab = np.asarray(out["LabelsInt32"][0])[:, 0]
+    got_tgt = np.asarray(out["BboxTargets"][0])
+    got_w = np.asarray(out["BboxInsideWeights"][0])
+    got_cnt = int(np.asarray(out["RoisNum"][0])[0])
+    got_rw = np.asarray(out["RoiWeights"][0])[:, 0]
+    assert got_cnt == len(fg_idx) + len(bg_idx)
+    np.testing.assert_allclose(got_rw,
+                               (np.arange(bs) < got_cnt).astype(np.float32))
+    for row, i in enumerate(fg_idx):
+        np.testing.assert_allclose(got_rois[row], cand[i])
+        assert got_lab[row] == 2
+        np.testing.assert_allclose(
+            got_tgt[row, 8:12],
+            _delta1(cand[i], gt[0, 0], [0.1, 0.1, 0.2, 0.2]),
+            rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(got_w[row, 8:12], 1.0)
+        assert np.all(got_tgt[row, :8] == 0)
+    for row, i in enumerate(bg_idx):
+        np.testing.assert_allclose(got_rois[len(fg_idx) + row], cand[i])
+        assert got_lab[len(fg_idx) + row] == 0
+        assert np.all(got_w[len(fg_idx) + row] == 0)
+
+
+def test_generate_mask_labels_exact_rectangle():
+    hm = wm = 32
+    res = 8
+    segm = np.zeros((1, 2, hm, wm), np.float32)
+    segm[0, 0, 8:24, 8:24] = 1.0          # gt 0 bitmap: square
+    gt = np.zeros((1, 2, 4), np.float32)
+    gt[0, 0] = [8, 8, 23, 23]
+    gt_cls = np.asarray([[1, 0]], np.int64)
+    rois = np.zeros((4, 4), np.float32)
+    rois[0] = [8, 8, 24, 24]              # fg roi exactly on the square
+    rois[1] = [0, 0, 31, 31]              # fg roi covering whole image
+    rois[2] = [25, 25, 31, 31]            # bg roi
+    labels = np.asarray([[1], [1], [0], [0]], np.int32)
+    nums = np.asarray([3], np.int32)
+    im_info = np.asarray([[32.0, 32.0, 1.0]], np.float32)
+    out = run_op("generate_mask_labels",
+                 {"ImInfo": [im_info], "GtClasses": [gt_cls],
+                  "GtSegms": [segm], "Rois": [rois],
+                  "LabelsInt32": [labels], "GtBoxes": [gt],
+                  "RoisNum": [nums]},
+                 {"num_classes": 2, "resolution": res})
+    mask = np.asarray(out["MaskInt32"][0]).reshape(4, 2, res, res)
+    has = np.asarray(out["RoiHasMaskInt32"][0])[:, 0]
+    np.testing.assert_array_equal(has, [1, 1, 0, 0])
+    # roi 0 covers exactly the square: class-1 slot all ones
+    np.testing.assert_array_equal(mask[0, 1], 1)
+    np.testing.assert_array_equal(mask[0, 0], -1)   # other class ignored
+    # roi 1 covers the whole image: interior ~quarter ones
+    m1 = mask[1, 1]
+    assert m1.min() == 0 and m1.max() == 1
+    frac = (m1 == 1).mean()
+    assert 0.1 < frac < 0.45
+    # bg / padding rows fully ignored
+    np.testing.assert_array_equal(mask[2], -1)
+    np.testing.assert_array_equal(mask[3], -1)
+
+
+def _jac(a, b):
+    iw = min(a[2], b[2]) - max(a[0], b[0])
+    ih = min(a[3], b[3]) - max(a[1], b[1])
+    if iw <= 0 or ih <= 0:
+        return 0.0
+    inter = iw * ih
+    s = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1])
+    return inter / (s - inter)
+
+
+def test_locality_aware_nms_rect_matches_loop_oracle():
+    boxes = np.asarray([
+        [0.0, 0.0, 0.40, 0.40],
+        [0.02, 0.02, 0.42, 0.42],     # merges with 0
+        [0.03, 0.01, 0.41, 0.43],     # merges again
+        [0.60, 0.60, 0.90, 0.90],     # new cluster
+        [0.61, 0.61, 0.91, 0.91],     # merges with 3
+        [0.10, 0.70, 0.30, 0.95],     # isolated
+    ], np.float32)[None]
+    scores = np.asarray([0.8, 0.7, 0.6, 0.9, 0.5, 0.3],
+                        np.float32)[None, None]
+    attrs = {"score_threshold": 0.05, "nms_top_k": 10, "keep_top_k": 5,
+             "nms_threshold": 0.3, "normalized": True,
+             "background_label": -1}
+    out = run_op("locality_aware_nms",
+                 {"BBoxes": [boxes], "Scores": [scores]}, attrs)
+    got = np.asarray(out["Out"][0])
+    cnt = int(np.asarray(out["OutCount"][0])[0])
+
+    # oracle merge pass (reference GetMaxScoreIndexWithLocalityAware)
+    bx = boxes[0].copy()
+    sc = scores[0, 0].copy()
+    skip = [False] * 6
+    index = -1
+    for i in range(6):
+        if index > -1:
+            if _jac(bx[i], bx[index]) > 0.3:
+                bx[index] = (bx[i] * sc[i] + bx[index] * sc[index]) \
+                    / (sc[i] + sc[index])
+                sc[index] += sc[i]
+                skip[i] = True
+            else:
+                index = i
+        else:
+            index = i
+    merged = [(sc[i], bx[i]) for i in range(6) if not skip[i]
+              and sc[i] > 0.05]
+    merged.sort(key=lambda t: -t[0])
+    kept = []
+    for s, b in merged:
+        if all(_jac(b, kb) <= 0.3 for _, kb in kept):
+            kept.append((s, b))
+    assert cnt == len(kept)
+    for row, (s, b) in enumerate(kept):
+        assert got[row, 0] == 0           # class label
+        np.testing.assert_allclose(got[row, 1], s, rtol=1e-5)
+        np.testing.assert_allclose(got[row, 2:], b, rtol=1e-5, atol=1e-6)
+
+
+def test_quad_iou_known_values():
+    from paddle_tpu.ops.detection_assign_ops import _quad_iou
+    import jax.numpy as jnp
+    sq = jnp.asarray([[0.0, 0.0], [1.0, 0.0], [1.0, 1.0], [0.0, 1.0]])
+    shifted = sq + jnp.asarray([0.5, 0.0])
+    # overlap 0.5, union 1.5
+    np.testing.assert_allclose(float(_quad_iou(sq, shifted)), 1.0 / 3.0,
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(_quad_iou(sq, sq)), 1.0, rtol=1e-5)
+    far = sq + jnp.asarray([5.0, 5.0])
+    np.testing.assert_allclose(float(_quad_iou(sq, far)), 0.0, atol=1e-7)
+    # clockwise winding must not break the clipper
+    cw = sq[::-1]
+    np.testing.assert_allclose(float(_quad_iou(cw, shifted)), 1.0 / 3.0,
+                               rtol=1e-5)
+    # 45-degree diamond inside the square: inter = diamond area 0.5
+    diamond = jnp.asarray([[0.5, 0.0], [1.0, 0.5], [0.5, 1.0], [0.0, 0.5]])
+    np.testing.assert_allclose(float(_quad_iou(sq, diamond)), 0.5 / 1.0,
+                               rtol=1e-5)
+
+
+def test_locality_aware_nms_quads():
+    """Two overlapping quads merge; the far one survives separately."""
+    q = np.asarray([
+        [0, 0, 10, 0, 10, 10, 0, 10],
+        [1, 1, 11, 1, 11, 11, 1, 11],
+        [30, 30, 40, 30, 40, 40, 30, 40],
+    ], np.float32)[None]
+    s = np.asarray([0.6, 0.4, 0.9], np.float32)[None, None]
+    out = run_op("locality_aware_nms", {"BBoxes": [q], "Scores": [s]},
+                 {"score_threshold": 0.05, "nms_top_k": 5, "keep_top_k": 3,
+                  "nms_threshold": 0.3, "normalized": False,
+                  "background_label": -1})
+    got = np.asarray(out["Out"][0])
+    cnt = int(np.asarray(out["OutCount"][0])[0])
+    assert cnt == 2
+    # merged quad = weighted mean, score = sum
+    exp = (q[0, 0] * 0.6 + q[0, 1] * 0.4) / 1.0
+    np.testing.assert_allclose(got[0, 1], 1.0, rtol=1e-5)      # 0.6 + 0.4
+    np.testing.assert_allclose(got[1, 1], 0.9, rtol=1e-5)
+    np.testing.assert_allclose(got[0, 2:], exp, rtol=1e-5)
+
+
+def test_roi_perspective_transform_axis_aligned_is_crop():
+    n, c, h, w = 1, 2, 12, 16
+    x = R.randn(n, c, h, w).astype(np.float32)
+    th, tw = 6, 8
+    # quad = axis-aligned rect (2,1)-(9,6): warp == integer crop
+    rois = np.asarray([[2, 1, 9, 1, 9, 6, 2, 6]], np.float32)
+    out = run_op("roi_perspective_transform",
+                 {"X": [x], "ROIs": [rois]},
+                 {"spatial_scale": 1.0, "transformed_height": th,
+                  "transformed_width": tw})
+    got = np.asarray(out["Out"][0])
+    mask = np.asarray(out["Mask"][0])
+    np.testing.assert_allclose(got[0], x[0, :, 1:7, 2:10], rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_array_equal(mask, 1)
+    hm = np.asarray(out["TransformMatrix"][0]).reshape(3, 3)
+    # H maps (0,0)->(2,1) and (tw-1,0)->(9,1)
+    p = hm @ np.asarray([0.0, 0.0, 1.0])
+    np.testing.assert_allclose(p[:2] / p[2], [2, 1], atol=1e-4)
+    p = hm @ np.asarray([tw - 1.0, 0.0, 1.0])
+    np.testing.assert_allclose(p[:2] / p[2], [9, 1], atol=1e-4)
+
+
+def test_roi_perspective_transform_grad_flows():
+    x = R.randn(1, 1, 8, 8).astype(np.float32)
+    rois = np.asarray([[1, 1, 6, 2, 6, 6, 1, 5]], np.float32)  # real quad
+    check_grad("roi_perspective_transform", {"X": [x], "ROIs": [rois]},
+               {"spatial_scale": 1.0, "transformed_height": 4,
+                "transformed_width": 4}, wrt=["X"], out_slots=("Out",))
+
+
+def test_ssd_loss_matches_loop_oracle():
+    p, g, ncls = 6, 2, 3
+    prior = np.asarray([
+        [0.0, 0.0, 0.3, 0.3],
+        [0.1, 0.1, 0.4, 0.4],
+        [0.5, 0.5, 0.9, 0.9],
+        [0.6, 0.6, 1.0, 1.0],
+        [0.0, 0.6, 0.3, 1.0],
+        [0.7, 0.0, 1.0, 0.3],
+    ], np.float32)
+    gt = np.zeros((1, g, 4), np.float32)
+    gt[0, 0] = [0.05, 0.05, 0.35, 0.35]
+    gt[0, 1] = [0.55, 0.55, 0.95, 0.95]
+    gl = np.asarray([[1, 2]], np.int64)[..., None]
+    loc = R.randn(1, p, 4).astype(np.float32) * 0.1
+    conf = R.randn(1, p, ncls).astype(np.float32)
+    out = run_op("ssd_loss",
+                 {"Location": [loc], "Confidence": [conf], "GtBox": [gt],
+                  "GtLabel": [gl], "PriorBox": [prior]},
+                 {"overlap_threshold": 0.5, "neg_pos_ratio": 1.0,
+                  "neg_overlap": 0.5, "background_label": 0})
+    got = float(np.asarray(out["Loss"][0])[0, 0])
+
+    # --- oracle ---
+    iou = np.array([[_jac(gt[0, gi], prior[pi]) for pi in range(p)]
+                    for gi in range(g)])
+    # greedy bipartite
+    d = iou.copy()
+    match = np.full(p, -1)
+    mdist = np.zeros(p)
+    for _ in range(min(g, p)):
+        gi, pi = np.unravel_index(d.argmax(), d.shape)
+        if d[gi, pi] <= 0:
+            break
+        match[pi] = gi
+        mdist[pi] = d[gi, pi]
+        d[gi, :] = -1
+        d[:, pi] = -1
+    # per_prediction extras
+    for pi in range(p):
+        if match[pi] < 0 and iou[:, pi].max() >= 0.5:
+            match[pi] = iou[:, pi].argmax()
+            mdist[pi] = iou[:, pi].max()
+    tgt = np.where(match >= 0, gl[0, np.maximum(match, 0), 0], 0)
+    lp = conf[0] - conf[0].max(1, keepdims=True)
+    lp = lp - np.log(np.exp(lp).sum(1, keepdims=True))
+    ce = -lp[np.arange(p), tgt]
+    is_neg = (match < 0) & (mdist < 0.5)
+    n_pos = (match >= 0).sum()
+    n_neg = min(int(n_pos * 1.0), is_neg.sum())
+    neg_order = np.argsort(-np.where(is_neg, ce, -np.inf))[:n_neg]
+    var = np.asarray([0.1, 0.1, 0.2, 0.2])
+    loss = 0.0
+    for pi in range(p):
+        if match[pi] >= 0:
+            pr = prior[pi]
+            gb = gt[0, match[pi]]
+            pw, ph = pr[2] - pr[0], pr[3] - pr[1]
+            gw, gh = gb[2] - gb[0], gb[3] - gb[1]
+            t = np.array([((gb[0] + gb[2]) / 2 - (pr[0] + pr[2]) / 2) / pw,
+                          ((gb[1] + gb[3]) / 2 - (pr[1] + pr[3]) / 2) / ph,
+                          np.log(gw / pw), np.log(gh / ph)]) / var
+            diff = np.abs(loc[0, pi] - t)
+            loss += np.sum(np.where(diff < 1, 0.5 * diff ** 2, diff - 0.5))
+            loss += ce[pi]
+    loss += ce[neg_order].sum()
+    loss /= max(n_pos, 1)
+    np.testing.assert_allclose(got, loss, rtol=1e-4)

@@ -1,0 +1,177 @@
+"""End-to-end trainable detection pipelines (round-4 VERDICT item 3):
+a tiny Faster-RCNN-style two-stage network (rpn_target_assign →
+generate_proposals → generate_proposal_labels → roi_align → heads) and a
+tiny SSD-style one-stage network (prior_box → ssd_loss), both trained to a
+falling loss — the reference's book-model style for the two classic
+detection training pipelines (reference models built from
+layers/detection.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.testing import reset_programs
+
+
+def _feed_rcnn(rng, b=2):
+    gt = np.zeros((b, 3, 4), np.float32)
+    cls = np.zeros((b, 3), np.int64)
+    for i in range(b):
+        n = rng.randint(1, 3)
+        for j in range(n):
+            x1 = rng.uniform(0, 80)
+            y1 = rng.uniform(0, 80)
+            w = rng.uniform(20, 46)
+            h = rng.uniform(20, 46)
+            gt[i, j] = [x1, y1, min(x1 + w, 127), min(y1 + h, 127)]
+            cls[i, j] = rng.randint(1, 3)
+    return {
+        "image": rng.randn(b, 8, 16, 16).astype(np.float32) * 0.5,
+        "gt_boxes": gt,
+        "gt_classes": cls,
+        "is_crowd": np.zeros((b, 3), np.int64),
+        "im_info": np.tile(np.asarray([[128.0, 128.0, 1.0]], np.float32),
+                           (b, 1)),
+    }
+
+
+def test_faster_rcnn_style_pipeline_trains():
+    reset_programs(seed=0)
+    feat = layers.data(name="image", shape=[8, 16, 16], dtype="float32")
+    gt_boxes = layers.data(name="gt_boxes", shape=[3, 4], dtype="float32")
+    gt_classes = layers.data(name="gt_classes", shape=[3], dtype="int64")
+    is_crowd = layers.data(name="is_crowd", shape=[3], dtype="int64")
+    im_info = layers.data(name="im_info", shape=[3], dtype="float32")
+
+    body = layers.conv2d(feat, 16, 3, padding=1, act="relu")
+    # --- RPN head: A = 3 anchors per location on the 16x16 / stride-8 map
+    a_per_loc = 3
+    hw = 16 * 16
+    rpn_cls = layers.conv2d(body, a_per_loc, 1)
+    rpn_reg = layers.conv2d(body, a_per_loc * 4, 1)
+    anchors, avar = layers.anchor_generator(
+        body, anchor_sizes=[16, 32, 64], aspect_ratios=[1.0],
+        stride=[8, 8])
+    cls_pred = layers.reshape(
+        layers.transpose(rpn_cls, [0, 2, 3, 1]), [0, hw * a_per_loc, 1])
+    loc_pred = layers.reshape(
+        layers.transpose(rpn_reg, [0, 2, 3, 1]), [0, hw * a_per_loc, 4])
+
+    (score_pred, bbox_pred, score_tgt, loc_tgt, bbox_w,
+     score_w) = layers.rpn_target_assign(
+        loc_pred, cls_pred, anchors, avar, gt_boxes, is_crowd, im_info,
+        rpn_batch_size_per_im=64, rpn_positive_overlap=0.5,
+        rpn_negative_overlap=0.3, use_random=False)
+    rpn_cls_loss = layers.sigmoid_cross_entropy_with_logits(
+        score_pred, score_tgt)
+    rpn_cls_loss = layers.reduce_sum(
+        layers.elementwise_mul(rpn_cls_loss, score_w)) / 128.0
+    rpn_reg_loss = layers.smooth_l1(bbox_pred, loc_tgt,
+                                    inside_weight=bbox_w,
+                                    outside_weight=bbox_w)
+    rpn_reg_loss = layers.reduce_sum(rpn_reg_loss) / 64.0
+
+    # --- proposals + RCNN head
+    probs = layers.sigmoid(rpn_cls)
+    rois, roi_probs, rois_num = layers.generate_proposals(
+        probs, rpn_reg, im_info, anchors, avar,
+        pre_nms_top_n=128, post_nms_top_n=32, nms_thresh=0.7, min_size=4.0,
+        return_rois_num=True)
+    (s_rois, s_labels, bbox_targets, bbox_in_w, bbox_out_w, s_num,
+     roi_w) = layers.generate_proposal_labels(
+        rois, gt_classes, is_crowd, gt_boxes, im_info,
+        batch_size_per_im=16, fg_fraction=0.5, fg_thresh=0.5,
+        bg_thresh_hi=0.5, bg_thresh_lo=0.0, class_nums=3,
+        use_random=False, rpn_rois_num=rois_num, return_roi_weights=True)
+    pooled = layers.roi_align(body, s_rois, pooled_height=4, pooled_width=4,
+                              spatial_scale=1.0 / 8.0, rois_num=s_num)
+    flat = layers.reshape(pooled, [-1, 16 * 4 * 4])
+    fc6 = layers.fc(flat, 64, act="relu")
+    cls_logits = layers.fc(fc6, 3)
+    bbox_reg = layers.fc(fc6, 4 * 3)
+    cls_loss = layers.softmax_with_cross_entropy(
+        cls_logits, layers.cast(s_labels, "int64"))
+    cls_loss = layers.reduce_sum(layers.elementwise_mul(cls_loss, roi_w)) \
+        / 32.0
+    reg_loss = layers.smooth_l1(bbox_reg, bbox_targets,
+                                inside_weight=bbox_in_w,
+                                outside_weight=bbox_out_w)
+    reg_loss = layers.reduce_sum(reg_loss) / 32.0
+
+    loss = rpn_cls_loss + rpn_reg_loss + cls_loss + reg_loss
+    opt = paddle.optimizer.Adam(learning_rate=2e-3)
+    opt.minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = _feed_rcnn(rng)
+    curve = []
+    for _ in range(12):
+        out, = exe.run(feed=feed, fetch_list=[loss])
+        curve.append(float(out))
+    assert np.isfinite(curve).all(), curve
+    assert curve[-1] < curve[0] * 0.8, f"rcnn loss did not fall: {curve}"
+
+
+def test_ssd_style_pipeline_trains_and_decodes():
+    reset_programs(seed=0)
+    image = layers.data(name="image", shape=[3, 32, 32], dtype="float32")
+    gt_box = layers.data(name="gt_box", shape=[4, 4], dtype="float32")
+    gt_label = layers.data(name="gt_label", shape=[4, 1], dtype="int64")
+
+    c1 = layers.conv2d(image, 16, 3, stride=2, padding=1, act="relu")
+    c2 = layers.conv2d(c1, 32, 3, stride=2, padding=1, act="relu")  # 8x8
+    pb, pbv = layers.prior_box(
+        c2, image, min_sizes=[8.0], max_sizes=[16.0], aspect_ratios=[2.0],
+        flip=True, clip=True)
+    n_priors_loc = 4        # ars {1, 2, 0.5} + max-size extra
+    p_total = 8 * 8 * n_priors_loc
+    ncls = 3
+    loc_head = layers.conv2d(c2, n_priors_loc * 4, 3, padding=1)
+    conf_head = layers.conv2d(c2, n_priors_loc * ncls, 3, padding=1)
+    loc = layers.reshape(
+        layers.transpose(loc_head, [0, 2, 3, 1]), [0, p_total, 4])
+    conf = layers.reshape(
+        layers.transpose(conf_head, [0, 2, 3, 1]), [0, p_total, ncls])
+    prior_flat = layers.reshape(pb, [-1, 4])
+    pvar_flat = layers.reshape(pbv, [-1, 4])
+
+    loss = layers.mean(layers.ssd_loss(
+        loc, conf, gt_box, gt_label, prior_flat, pvar_flat,
+        overlap_threshold=0.5, neg_pos_ratio=3.0))
+    # inference branch: decode + NMS (reference detection_output —
+    # softmax + [0,2,1] transpose happen inside, as in the reference)
+    det, det_num = layers.detection_output(
+        loc, conf, prior_flat, pvar_flat, score_threshold=0.01,
+        nms_top_k=50, keep_top_k=10)
+    opt = paddle.optimizer.Adam(learning_rate=2e-3)
+    opt.minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(1)
+    b = 2
+    gt = np.zeros((b, 4, 4), np.float32)
+    gl = np.zeros((b, 4, 1), np.int64)
+    for i in range(b):
+        for j in range(rng.randint(1, 4)):
+            x1 = rng.uniform(0.0, 0.6)
+            y1 = rng.uniform(0.0, 0.6)
+            gt[i, j] = [x1, y1, x1 + rng.uniform(0.15, 0.4),
+                        y1 + rng.uniform(0.15, 0.4)]
+            gl[i, j, 0] = rng.randint(1, ncls)
+    feed = {"image": rng.randn(b, 3, 32, 32).astype(np.float32),
+            "gt_box": np.clip(gt, 0, 1), "gt_label": gl}
+    curve = []
+    for _ in range(12):
+        out, = exe.run(feed=feed, fetch_list=[loss])
+        curve.append(float(out))
+    assert np.isfinite(curve).all(), curve
+    assert curve[-1] < curve[0] * 0.8, f"ssd loss did not fall: {curve}"
+
+    d, dn = exe.run(feed=feed, fetch_list=[det, det_num])
+    assert d.shape[-1] == 6                 # [label, score, x1, y1, x2, y2]
+    assert d.shape[0] == b * 10
+    assert dn.shape == (b,)
