@@ -180,7 +180,34 @@ def append_backward(loss: Variable, parameter_list=None,
         if not diff_entries:
             continue
 
-        grad_inputs = {slot: list(names) for slot, names in op.inputs.items()}
+        # Ops that overwrite their own input vars (While carried state,
+        # in-place increments): by the time the __vjp__ op runs, the env
+        # holds POST-op values under those names, which would corrupt the
+        # re-lowered forward inside jax.vjp (a finished While's cond=False
+        # re-runs zero iterations -> zero grads). Snapshot the pre-op
+        # values with assign ops inserted right before the forward op and
+        # point the vjp's regular inputs at the snapshots.
+        out_names = {n for ns in op.outputs.values() for n in ns
+                     if n != "@EMPTY@"}
+        overlap = {n for ns in op.inputs.values() for n in ns
+                   if n != "@EMPTY@" and n in out_names}
+        snap = {}
+        if overlap:
+            pos = block.ops.index(op)
+            for n in sorted(overlap):
+                sname = f"{n}@PRE"
+                while block.find_var_recursive(sname) is not None:
+                    sname += "_"
+                fv = block.var(n)
+                block.create_var(name=sname, shape=fv.shape, dtype=fv.dtype,
+                                 stop_gradient=True)
+                block._insert_op(pos, "assign", inputs={"X": [n]},
+                                 outputs={"Out": [sname]})
+                snap[n] = sname
+                pos += 1
+
+        grad_inputs = {slot: [snap.get(n, n) for n in names]
+                       for slot, names in op.inputs.items()}
         for slot in out_slots:
             og_names = []
             for n in op.outputs[slot]:

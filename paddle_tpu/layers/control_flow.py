@@ -37,7 +37,8 @@ __all__ = ["cond", "increment", "array_write", "array_read", "array_length",
            "create_array", "While", "while_loop", "StaticRNN", "Switch",
            "lod_rank_table", "max_sequence_len", "lod_tensor_to_array",
            "array_to_lod_tensor", "shrink_memory", "split_lod_tensor",
-           "merge_lod_tensor"]
+           "merge_lod_tensor", "reorder_lod_tensor_by_rank",
+           "tensor_array_to_tensor", "DynamicRNN"]
 
 
 # ---------------------------------------------------------------------------
@@ -167,9 +168,15 @@ class While:
     pre-loop values.
     """
 
-    def __init__(self, cond, is_test=False, name=None):
+    def __init__(self, cond, is_test=False, name=None, bound=None):
         self.cond_var = cond
         self.helper = LayerHelper("while")
+        # static trip-count upper bound: when set, the loop lowers to a
+        # masked lax.scan of `bound` steps (iterations past the live count
+        # are select-no-ops) — REVERSE-DIFFERENTIABLE, unlike
+        # lax.while_loop. DynamicRNN sets this to the padded sequence
+        # length so ragged RNNs can train.
+        self.bound = bound
 
     @contextlib.contextmanager
     def block(self):
@@ -191,7 +198,8 @@ class While:
                     "Free": free},
             outputs={"Out": carried},
             attrs={"sub_block": sub.idx, "carried_names": carried,
-                   "free_names": free, "cond_name": self.cond_var.name})
+                   "free_names": free, "cond_name": self.cond_var.name,
+                   "trip_bound": int(self.bound) if self.bound else 0})
 
 
 @register("__while__", infer=_noop_infer)
@@ -223,7 +231,21 @@ def _lower_while(ctx, ins, attrs):
                                 env, {}, {}, ctx.rng_key)
         return tuple(fetches)
 
-    out = jax.lax.while_loop(cond_fn, body_fn, carry0)
+    bound = int(attrs.get("trip_bound", 0) or 0)
+    if bound > 0:
+        # masked scan: run exactly `bound` steps, select old/new carry by
+        # the loop predicate — semantically the while loop whenever the
+        # true trip count <= bound, and reverse-differentiable (DynamicRNN
+        # training path; lax.while_loop has no reverse rule)
+        def scan_body(carry, _):
+            pred = jnp.reshape(carry[cond_idx], ()).astype(bool)
+            new = body_fn(carry)
+            merged = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(pred, n, o), new, carry)
+            return merged, None
+        out, _ = jax.lax.scan(scan_body, carry0, None, length=bound)
+    else:
+        out = jax.lax.while_loop(cond_fn, body_fn, carry0)
     return {"Out": list(out)}
 
 
@@ -743,3 +765,211 @@ def merge_lod_tensor(in_true, in_false, x, mask, level=0):
                      outputs={"Out": [out]},
                      attrs={"level": int(level)})
     return out
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """Reference layers/control_flow.py reorder_lod_tensor_by_rank —
+    permute batch rows into the rank table's order."""
+    helper = LayerHelper("reorder_lod_tensor_by_rank")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = tuple(x.shape)
+    helper.append_op("reorder_lod_tensor_by_rank",
+                     inputs={"X": [x], "RankTable": [rank_table]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def tensor_array_to_tensor(input, axis=1, use_stack=False, name=None):
+    """Reference layers/tensor.py tensor_array_to_tensor: fuse array slots
+    by stack/concat. Returns (out, out_index)."""
+    helper = LayerHelper("tensor_array_to_tensor")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    idx = helper.create_variable_for_type_inference("int32")
+    helper.append_op("tensor_array_to_tensor",
+                     inputs={"X": [input]},
+                     outputs={"Out": [out], "OutIndex": [idx]},
+                     attrs={"axis": int(axis), "use_stack": bool(use_stack)})
+    return out, idx
+
+
+class DynamicRNN:
+    """Ragged-batch RNN (reference fluid.layers.DynamicRNN,
+    control_flow.py:2927). Sequences are sorted by length descending
+    internally (rank table); each step processes only the sequences still
+    alive — here with static shapes: dead rows are zeroed, and the final
+    outputs are restored to original order and zero-padded
+    (docs/lod_design.md).
+
+    One TPU-native signature change: the first `step_input` must pass the
+    per-sequence lengths (`length=`) since padded-dense tensors carry no
+    LoD metadata. Everything else mirrors the reference API::
+
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            word = drnn.step_input(emb, length=lens)     # [B, T, D] + [B]
+            prev = drnn.memory(shape=[H], value=0.0)
+            h = layers.fc(layers.concat([word, prev], 1), H, act="tanh")
+            drnn.update_memory(prev, h)
+            drnn.output(h)
+        hidden_seq = drnn()                              # [B, T, H]
+    """
+
+    BEFORE_RNN, IN_RNN, AFTER_RNN = 0, 1, 2
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("dynamic_rnn")
+        self.status = DynamicRNN.BEFORE_RNN
+        self.rank_table = None
+        self.max_seq_len = None
+        self.step_idx = None
+        self.zero_idx = None
+        self.cond = None
+        self.while_op = None
+        self.mem_dict = {}
+        self.mem_link = []
+        self.input_array = []
+        self.output_array = []
+        self.outputs = []
+        self._max_t = None
+        self._in0 = None
+
+    @contextlib.contextmanager
+    def _parent(self):
+        """Append ops to the parent block (the reference's
+        _parent_block_() pattern: setup ops live OUTSIDE the while body)."""
+        prog = self.helper.main_program
+        saved = prog.current_block_idx
+        prog.current_block_idx = prog.blocks[saved].parent_idx
+        try:
+            yield
+        finally:
+            prog.current_block_idx = saved
+
+    @contextlib.contextmanager
+    def block(self):
+        if self.status != DynamicRNN.BEFORE_RNN:
+            raise ValueError("block() can only be entered once")
+        from . import tensor as T
+        from . import nn as N
+        self.step_idx = T.fill_constant([1], "int64", 0)
+        self.zero_idx = T.fill_constant([1], "int64", 0)
+        self.cond = T.fill_constant([1], "bool", True)
+        self.while_op = While(self.cond)
+        self.status = DynamicRNN.IN_RNN
+        with self.while_op.block():
+            yield
+            if self.rank_table is None:
+                raise ValueError("DynamicRNN.block() used without any "
+                                 "step_input()")
+            increment(self.step_idx)
+            for new_mem, mem_array in self.mem_link:
+                array_write(new_mem, self.step_idx, array=mem_array)
+            N.less_than(self.step_idx, self.max_seq_len, cond=self.cond)
+        self.status = DynamicRNN.AFTER_RNN
+        for arr in self.output_array:
+            self.outputs.append(
+                array_to_lod_tensor(arr, self.rank_table,
+                                    max_len=self._max_t))
+
+    def _assert_in_rnn(self, method):
+        if self.status != DynamicRNN.IN_RNN:
+            raise ValueError(f"{method}() can only be used inside block()")
+
+    def _first_slot(self):
+        if self._in0 is None:
+            with self._parent():
+                self._in0 = array_read(self.input_array[0], self.zero_idx)
+        return self._in0
+
+    def step_input(self, x, level=0, length=None):
+        """Returns the current step's rows [B, ...] (rank order, dead rows
+        zeroed). The FIRST call defines the rank table and needs
+        `length=` [B]."""
+        self._assert_in_rnn("step_input")
+        from . import nn as N
+        with self._parent():
+            if self.rank_table is None:
+                if length is None:
+                    raise ValueError(
+                        "the first step_input needs length= (padded-dense "
+                        "sequences carry no LoD; see docs/lod_design.md)")
+                self.rank_table = lod_rank_table(x, level=level,
+                                                 length=length)
+                self.max_seq_len = max_sequence_len(self.rank_table)
+                self._max_t = int(x.shape[1])
+                # bounded masked-scan lowering => training works (see While)
+                self.while_op.bound = self._max_t
+                N.less_than(self.step_idx, self.max_seq_len, cond=self.cond)
+            arr = lod_tensor_to_array(x, self.rank_table)
+            self.input_array.append(arr)
+        ret = array_read(arr, self.step_idx)
+        # a time-step slice is [B, ...x's feature dims]; array_read alone
+        # cannot know this (arrays carry only dtype)
+        ret.shape = (x.shape[0],) + tuple(x.shape[2:])
+        return ret
+
+    def static_input(self, x):
+        """Per-step view of a non-sequence input: reordered to rank order,
+        rows of finished sequences zeroed."""
+        self._assert_in_rnn("static_input")
+        if self.rank_table is None:
+            raise RuntimeError("static_input() must come after step_input()")
+        with self._parent():
+            reordered = reorder_lod_tensor_by_rank(x, self.rank_table)
+        return shrink_memory(reordered, self.step_idx, self.rank_table)
+
+    def memory(self, init=None, shape=None, value=0.0, need_reorder=False,
+               dtype="float32"):
+        self._assert_in_rnn("memory")
+        from . import tensor as T
+        if self.rank_table is None:
+            raise ValueError("memory() must come after step_input()")
+        if init is not None:
+            with self._parent():
+                init_t = reorder_lod_tensor_by_rank(init, self.rank_table) \
+                    if need_reorder else init
+                mem_array = array_write(init_t, self.zero_idx,
+                                        capacity=self._max_t + 1)
+        else:
+            if shape is None:
+                raise ValueError("memory() needs init= or shape=")
+            in0 = self._first_slot()
+            with self._parent():
+                init_t = T.fill_constant_batch_size_like(
+                    in0, shape=[-1] + list(shape), dtype=dtype, value=value)
+                mem_array = array_write(init_t, self.zero_idx,
+                                        capacity=self._max_t + 1)
+        retv = array_read(mem_array, self.step_idx)
+        retv.shape = tuple(init.shape) if init is not None \
+            else (-1,) + tuple(int(d) for d in shape)
+        retv = shrink_memory(retv, self.step_idx, self.rank_table)
+        self.mem_dict[retv.name] = mem_array
+        return retv
+
+    def update_memory(self, ex_mem, new_mem):
+        self._assert_in_rnn("update_memory")
+        mem_array = self.mem_dict.get(ex_mem.name)
+        if mem_array is None:
+            raise ValueError("update_memory's first arg must be a memory() "
+                             "result")
+        self.mem_link.append((new_mem, mem_array))
+
+    def output(self, *outputs):
+        self._assert_in_rnn("output")
+        from . import tensor as T
+        in0 = self._first_slot()
+        for o in outputs:
+            with self._parent():
+                prime = T.fill_constant_batch_size_like(
+                    in0, shape=[-1] + [int(d) for d in o.shape[1:]],
+                    dtype=dtype_name(o.dtype), value=0.0)
+                arr = array_write(prime, self.zero_idx,
+                                  capacity=self._max_t + 1)
+            array_write(o, self.step_idx, array=arr)
+            self.output_array.append(arr)
+
+    def __call__(self):
+        if self.status != DynamicRNN.AFTER_RNN:
+            raise ValueError("DynamicRNN outputs are available only after "
+                             "block() closes")
+        return self.outputs[0] if len(self.outputs) == 1 else self.outputs

@@ -161,3 +161,56 @@ def _merge_lod_tensor(ctx, ins, attrs):
     rows_f = jnp.take(in_false, pos_f, axis=0)
     sel = mask.reshape((-1,) + (1,) * (in_true.ndim - 1))
     return {"Out": [jnp.where(sel, rows_t, rows_f)]}
+
+
+@register("reorder_lod_tensor_by_rank", nondiff_slots=("RankTable",))
+def _reorder_lod_tensor_by_rank(ctx, ins, attrs):
+    """reorder_lod_tensor_by_rank_op.cc: permute batch rows into the rank
+    table's (desc-length) order — how DynamicRNN aligns a batch-ordered
+    init memory / static input with its internally sorted sequences.
+    Differentiable: the grad of a gather is the inverse scatter, which the
+    generic __vjp__ gets from jax for free (the reference ships a dedicated
+    grad kernel for this)."""
+    x = ins["X"][0]
+    table = ins["RankTable"][0]
+    return {"Out": [jnp.take(x, table[:, 0], axis=0)]}
+
+
+@register("lod_array_length", nondiff_slots=("X",))
+def _lod_array_length(ctx, ins, attrs):
+    """lod_array_length_op.cc: length of a LoDTensorArray as an int64 [1]
+    tensor (the separately-registered twin of array_length — both names
+    exist in the reference)."""
+    arr = ins["X"][0]
+    length = jnp.zeros((), jnp.int32) if arr is None else arr[1]
+    # device int32 (not the reference's int64): framework/dtype.py device
+    # int-width policy — jax x64 is off, int64 would silently truncate
+    return {"Out": [jnp.reshape(length, (1,)).astype(jnp.int32)]}
+
+
+@register("tensor_array_to_tensor", nondiff_slots=())
+def _tensor_array_to_tensor(ctx, ins, attrs):
+    """tensor_array_to_tensor_op.cc: fuse a TensorArray's slots into one
+    tensor — stacked on a new leading `axis` (use_stack) or concatenated
+    along `axis`. Static form: all `capacity` slots participate (unwritten
+    slots are zeros); OutIndex reports each slot's size along the concat
+    axis, as the reference does."""
+    buf, _length = ins["X"][0]
+    axis = int(attrs.get("axis", 0))
+    use_stack = bool(attrs.get("use_stack", False))
+    if axis < 0:                     # normalize against the SLOT rank
+        axis += buf.ndim - 1 if not use_stack else buf.ndim
+    t = buf.shape[0]
+    if use_stack:
+        out = jnp.moveaxis(buf, 0, axis) if axis else buf
+    else:
+        # concat of the T slots along `axis` == slot-major merge of the
+        # (T, axis) dims: one moveaxis+reshape instead of T slices + a
+        # T-ary concatenate (keeps trace/compile size O(1) in capacity)
+        moved = jnp.moveaxis(buf, 0, axis)           # [..., T, da, ...]
+        shp = list(moved.shape)
+        shp[axis:axis + 2] = [shp[axis] * shp[axis + 1]]
+        out = moved.reshape(shp)
+    sizes = jnp.full((t,), 1 if use_stack else buf.shape[1 + axis],
+                     jnp.int32)
+    return {"Out": [out], "OutIndex": [sizes]}

@@ -192,6 +192,22 @@ def _sum(ctx, ins, attrs):
         for s in sparse:
             out = out.at[s.ids].add(s.rows.astype(out.dtype), mode="drop")
         return {"Out": [out]}
+    if isinstance(xs[0], tuple):
+        # TensorArray(-gradient) accumulation: (buffer, length) pytrees —
+        # tuple + tuple would CONCATENATE, so add leaf-wise instead. The
+        # int length leaf's cotangent is float0 (no vector space): keep it.
+        import jax as _jax
+
+        def _leaf_add(a, b):
+            if getattr(a, "dtype", None) == _jax.dtypes.float0 \
+                    or getattr(b, "dtype", None) == _jax.dtypes.float0:
+                return a
+            return a + b
+
+        out = xs[0]
+        for x in xs[1:]:
+            out = _jax.tree_util.tree_map(_leaf_add, out, x)
+        return {"Out": [out]}
     out = xs[0]
     for x in xs[1:]:
         out = out + x

@@ -197,10 +197,22 @@ def _lower_vjp(ctx, ins, attrs):
             if j < len(ogs) and ogs[j] is not None:
                 ct = ogs[j]
                 # AMP may deliver cotangents in a different float dtype than
-                # this op's output (e.g. bf16 grads into an f32 op) — align
-                if ct.dtype != ref.dtype:
+                # this op's output (e.g. bf16 grads into an f32 op) — align.
+                # TensorArray-valued outputs are (buffer, length) pytrees:
+                # align leaf-wise (the length leaf's cotangent is symbolic).
+                if isinstance(ref, tuple):
+                    ct = jax.tree_util.tree_map(
+                        lambda c, r: c if c is None
+                        or getattr(c, "dtype", None) == r.dtype
+                        or not jax.numpy.issubdtype(r.dtype,
+                                                    jax.numpy.floating)
+                        else c.astype(r.dtype), tuple(ct), ref)
+                elif ct.dtype != ref.dtype:
                     ct = ct.astype(ref.dtype)
                 cts.append(ct)
+            elif isinstance(ref, tuple):
+                cts.append(jax.tree_util.tree_map(
+                    lambda r: jax.numpy.zeros(r.shape, r.dtype), ref))
             else:
                 cts.append(jax.numpy.zeros(ref.shape, ref.dtype))
         idx += n_outs
@@ -214,4 +226,24 @@ def _lower_vjp(ctx, ins, attrs):
     return result
 
 
-_REGISTRY["__vjp__"] = OpDef("__vjp__", _lower_vjp)
+def _vjp_infer(block, op):
+    """Build-time shapes for grad vars are EXACTLY the forward inputs'
+    shapes — never eval_shape the vjp lowering (it would re-trace the
+    forward AND its transpose per op at build time; for batch-looping ops
+    the dynamic-dim sentinel makes that catastrophically slow)."""
+    block.program.bump_version()
+    for slot, names in op.outputs.items():
+        if not slot.startswith("IG:"):
+            continue
+        fwd_names = op.inputs.get(slot[3:], [])
+        for n, src in zip(names, fwd_names):
+            if n == "@EMPTY@" or src == "@EMPTY@":
+                continue
+            v = block.find_var_recursive(n)
+            s = block.find_var_recursive(src)
+            if v is not None and s is not None:
+                v.shape = tuple(s.shape)
+                v.dtype = s.dtype
+
+
+_REGISTRY["__vjp__"] = OpDef("__vjp__", _lower_vjp, infer=_vjp_infer)

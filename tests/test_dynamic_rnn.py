@@ -1,0 +1,147 @@
+"""DynamicRNN user API (reference fluid.layers.DynamicRNN,
+control_flow.py:2927) + its round-4 supporting ops
+(reorder_lod_tensor_by_rank, lod_array_length, tensor_array_to_tensor).
+
+The book test is a machine-translation-style ragged decode: embedding →
+DynamicRNN with a static encoder input and a need_reorder boot memory →
+per-step softmax — trained until the loss falls, with per-sequence ragged
+lengths. A numpy step-loop oracle checks the forward exactly."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.testing import reset_programs
+from op_test import run_op
+
+
+def test_reorder_lod_tensor_by_rank_op():
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    lens = np.asarray([2, 4, 1, 3], np.int64)
+    table = run_op("lod_rank_table", {"X": [x], "Length": [lens]}, {})
+    out = run_op("reorder_lod_tensor_by_rank",
+                 {"X": [x], "RankTable": [table["Out"][0]]}, {})
+    # rank order by desc length: seq 1 (4), seq 3 (3), seq 0 (2), seq 2 (1)
+    np.testing.assert_allclose(np.asarray(out["Out"][0]),
+                               x[[1, 3, 0, 2]])
+
+
+def test_lod_array_length_and_tensor_array_to_tensor_ops():
+    # TensorArray runtime values are (buffer, length) tuples — call the
+    # lowerings directly (run_op's jnp.asarray would flatten the pair)
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import registry
+    ctx = registry.LowerCtx(rng_key=jax.random.key(0))
+    buf = jnp.asarray(np.arange(24, dtype=np.float32).reshape(3, 2, 4))
+    arr = (buf, jnp.asarray(3, jnp.int32))
+    ln = registry.get("lod_array_length").lower(ctx, {"X": [arr]}, {})
+    assert int(np.asarray(ln["Out"][0])[0]) == 3
+    tat = registry.get("tensor_array_to_tensor").lower
+    st = tat(ctx, {"X": [arr]}, {"axis": 0, "use_stack": True})
+    np.testing.assert_allclose(np.asarray(st["Out"][0]), np.asarray(buf))
+    cc = tat(ctx, {"X": [arr]}, {"axis": 0, "use_stack": False})
+    np.testing.assert_allclose(np.asarray(cc["Out"][0]),
+                               np.asarray(buf).reshape(6, 4))
+    np.testing.assert_array_equal(np.asarray(cc["OutIndex"][0]), [2, 2, 2])
+
+
+def _np_tanh_cell(x, h, w, b):
+    return np.tanh(np.concatenate([x, h], -1) @ w + b)
+
+
+def test_dynamic_rnn_forward_matches_step_loop():
+    """drnn outputs == a plain per-sequence numpy loop (original order,
+    zeros past each length)."""
+    reset_programs(seed=0)
+    B, T, D, H = 4, 5, 3, 6
+    rng = np.random.RandomState(0)
+    xv = rng.randn(B, T, D).astype(np.float32)
+    lens = np.asarray([3, 5, 1, 4], np.int64)
+
+    x = layers.data(name="x", shape=[T, D], dtype="float32")
+    lod = layers.data(name="lens", shape=[1], dtype="int64")
+    from paddle_tpu.layer_helper import ParamAttr
+    drnn = layers.DynamicRNN()
+    with drnn.block():
+        step = drnn.step_input(x, length=lod)
+        prev = drnn.memory(shape=[H], value=0.0)
+        h = layers.fc(layers.concat([step, prev], axis=1), H, act="tanh",
+                      param_attr=ParamAttr(name="cell_w"),
+                      bias_attr=ParamAttr(name="cell_b"))
+        drnn.update_memory(prev, h)
+        drnn.output(h)
+    out = drnn()
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+
+    # pull the fc weights to replay in numpy
+    got, w, b = exe.run(feed={"x": xv, "lens": lens},
+                        fetch_list=[out, "cell_w", "cell_b"])
+
+    exp = np.zeros((B, T, H), np.float32)
+    for i in range(B):
+        h = np.zeros(H, np.float32)
+        for t in range(int(lens[i])):
+            h = _np_tanh_cell(xv[i, t], h, w, b)
+            exp[i, t] = h
+    np.testing.assert_allclose(got, exp, rtol=2e-4, atol=2e-5)
+    # zeros past each length (ragged contract)
+    for i in range(B):
+        assert np.all(got[i, int(lens[i]):] == 0)
+
+
+def test_dynamic_rnn_mt_decode_trains():
+    """MT-style ragged decode: encoder mean -> boot memory (need_reorder) +
+    static input, per-step vocab softmax; Adam training must drop the
+    masked CE loss."""
+    reset_programs(seed=0)
+    B, T, V, E, H = 4, 6, 50, 8, 16
+    src = layers.data(name="src", shape=[T], dtype="int64")
+    tgt_in = layers.data(name="tgt_in", shape=[T], dtype="int64")
+    tgt_out = layers.data(name="tgt_out", shape=[T, 1], dtype="int64")
+    lens = layers.data(name="lens", shape=[1], dtype="int64")
+
+    src_emb = layers.embedding(layers.unsqueeze(src, [2]), [V, E])
+    src_emb = layers.reshape(src_emb, [0, 0, E])
+    enc = layers.reduce_mean(src_emb, dim=1)            # [B, E]
+    boot = layers.fc(enc, H, act="tanh")                # decoder boot state
+
+    tgt_emb = layers.embedding(layers.unsqueeze(tgt_in, [2]), [V, E])
+    tgt_emb = layers.reshape(tgt_emb, [0, 0, E])
+
+    drnn = layers.DynamicRNN()
+    with drnn.block():
+        word = drnn.step_input(tgt_emb, length=lens)
+        ctx_enc = drnn.static_input(enc)
+        prev = drnn.memory(init=boot, need_reorder=True)
+        h = layers.fc(layers.concat([word, ctx_enc, prev], axis=1), H,
+                      act="tanh")
+        drnn.update_memory(prev, h)
+        logit = layers.fc(h, V)
+        drnn.output(logit)
+    logits = drnn()                                     # [B, T, V]
+
+    ce = layers.softmax_with_cross_entropy(logits, tgt_out)   # [B, T, 1]
+    mask = layers.cast(layers.sequence_mask(lens, maxlen=T), "float32")
+    ce = layers.elementwise_mul(layers.reshape(ce, [0, T]), mask)
+    loss = layers.reduce_sum(ce) / layers.reduce_sum(mask)
+    paddle.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(3)
+    feed = {
+        "src": rng.randint(0, V, (B, T)).astype(np.int64),
+        "tgt_in": rng.randint(0, V, (B, T)).astype(np.int64),
+        "tgt_out": rng.randint(0, V, (B, T, 1)).astype(np.int64),
+        "lens": np.asarray([4, 6, 2, 5], np.int64),
+    }
+    curve = []
+    for _ in range(25):
+        out, = exe.run(feed=feed, fetch_list=[loss])
+        curve.append(float(out))
+    assert np.isfinite(curve).all()
+    assert curve[-1] < curve[0] - 1.0, f"decode loss did not fall: {curve}"
