@@ -64,10 +64,62 @@ class HeterSection:
         np.add.at(self.table, flat, -self.lr * g)   # scatter SGD
 
 
-class HeterWorker:
-    """The heter CPU worker loop (HeterCpuWorker, device_worker.h:349)."""
+class ProgramHeterSection:
+    """Host section built from an ARBITRARY fluid sub-program — the general
+    form of the reference's op-list section (trainer_desc's section config,
+    device_worker.h:349): any front expressible in fluid.layers runs on the
+    host, not just one embedding table.
 
-    def __init__(self, section: HeterSection, store_addr: str):
+    ``build_fn()`` constructs the front inside a fresh program and returns
+    ``(feed_names, act_var)``. Backward uses the chain-rule surrogate: with
+    the received cut-gradient g fed as a constant, minimizing
+    ``sum(act * g)`` updates the host params by exactly gᵀ·∂act/∂θ. The
+    surrogate step re-runs the front forward (host recompute) — the
+    stateless TPU-native stand-in for the reference worker's kept
+    activations."""
+
+    def __init__(self, build_fn, optimizer=None, seed: int = 7):
+        import paddle_tpu as paddle
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid import layers
+        from ..framework.program import Program, program_guard
+
+        self._fluid = fluid
+        main = Program()
+        startup = Program()
+        with program_guard(main, startup):
+            main.random_seed = seed
+            self.feed_names, act = build_fn()
+            self.act_name = act.name
+            # forward-only view BEFORE grad/opt ops exist
+            self.fwd_prog = main.clone(for_test=True)
+            gshape = [int(d) for d in act.shape[1:]]
+            g = layers.data(name="__heter_act_grad__", shape=gshape,
+                            dtype="float32")
+            surrogate = layers.reduce_sum(layers.elementwise_mul(act, g))
+            opt = optimizer or paddle.optimizer.SGD(learning_rate=0.1)
+            opt.minimize(surrogate)
+        self.train_prog = main
+        self.exe = fluid.Executor()
+        self.exe.run(startup)
+
+    def forward(self, feed: dict) -> np.ndarray:
+        act, = self.exe.run(program=self.fwd_prog, feed=dict(feed),
+                            fetch_list=[self.act_name])
+        return np.asarray(act)
+
+    def backward(self, feed: dict, act_grad: np.ndarray) -> None:
+        full = dict(feed)
+        full["__heter_act_grad__"] = np.asarray(act_grad)
+        self.exe.run(program=self.train_prog, feed=full, fetch_list=[])
+
+
+class HeterWorker:
+    """The heter CPU worker loop (HeterCpuWorker, device_worker.h:349).
+    Phase-1 payloads are either a bare ids array (the classic embedding
+    section) or a feed dict (program-driven sections)."""
+
+    def __init__(self, section, store_addr: str):
         self.section = section
         self.gloo = Gloo(rank=1, world_size=2, store_addr=store_addr)
 
@@ -75,13 +127,15 @@ class HeterWorker:
         """Serve until the trainer sends the stop token; returns #steps."""
         steps = 0
         while True:
-            ids = self.gloo.all_gather(None)[0]     # phase 1: receive ids
-            if isinstance(ids, str) and ids == _STOP:
+            inp = self.gloo.all_gather(None)[0]     # phase 1: receive feed
+            if isinstance(inp, str) and inp == _STOP:
                 break
-            act = self.section.forward(np.asarray(ids))
+            if not isinstance(inp, dict):
+                inp = np.asarray(inp)
+            act = self.section.forward(inp)
             self.gloo.all_gather(act)               # phase 2: send act
             grad = self.gloo.all_gather(None)[0]    # phase 3: receive dAct
-            self.section.backward(np.asarray(ids), np.asarray(grad))
+            self.section.backward(inp, np.asarray(grad))
             steps += 1
         self.gloo.close()
         return steps
@@ -135,10 +189,12 @@ class HeterTrainer:
     def worker_addr(self) -> str:
         return f"127.0.0.1:{self.gloo.store_port}"
 
-    def step(self, ids: np.ndarray, feed: dict) -> float:
-        """One heter train step: ship ids, get the host activation, run the
-        device fwd+bwd, ship the activation grad back."""
-        self.gloo.all_gather(np.asarray(ids))                # phase 1
+    def step(self, ids, feed: dict) -> float:
+        """One heter train step: ship the host feed (ids array or a feed
+        dict for program-driven sections), get the host activation, run
+        the device fwd+bwd, ship the activation grad back."""
+        self.gloo.all_gather(ids if isinstance(ids, dict)
+                             else np.asarray(ids))           # phase 1
         act = np.asarray(self.gloo.all_gather(None)[1])      # phase 2
         full_feed = dict(feed)
         full_feed[self.act_name] = act

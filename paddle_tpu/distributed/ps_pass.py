@@ -1,0 +1,341 @@
+"""PS program-rewriting v2: the functional pass pipeline that converts a
+VANILLA trainer program into parameter-server form — no fleet facade
+required.
+
+Reference counterpart: python/paddle/fluid/incubate/fleet/parameter_server/
+ir/trainer_pass.py — delete_optimizer_pass (:51), distributed_ops_pass
+(:82), append_send_ops_pass (:167), fake_init_ops_pass (:283). Same
+contract here over our Program IR: each pass is a function
+``pass(program, config) -> program`` mutating the IR, unit-testable by
+asserting which ops were inserted/removed.
+
+TPU-native runtime: the rewritten program stays ONE jit-compiled XLA step;
+host↔server traffic rides the executor's pre/post hooks (the kvstore
+transport, distributed/ps.py) — sparse tables through the pulled+gather
+pattern, dense params through scope writes (pull) and grad pushes. This
+replaces the reference's send/recv ops + Communicator threads; `send`
+remains in the IR as the marker op the hooks key off, as in the reference
+where the communicator intercepts it.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..framework.program import OpRole
+from ..ops.registry import register
+from .ps import KVClient, SparseTableConfig, _PsHook
+
+_SPARSE_OPS = {"lookup_table": "W", "lookup_table_v2": "W"}
+
+
+# ---------------------------------------------------------------------------
+# IR marker ops
+# ---------------------------------------------------------------------------
+
+@register("send", nondiff_slots=("X",))
+def _send(ctx, ins, attrs):
+    """trainer_pass.py:167 appends send ops per grad; the reference's
+    communicator intercepts them off the graph. Here the op is a pure IR
+    marker (identity on device) — the executor-level _DensePsHook does the
+    actual push, so the jitted step stays host-call-free."""
+    return {"Out": [ins["X"][0]]}
+
+
+@register("recv", nondiff_slots=("X",))
+def _recv(ctx, ins, attrs):
+    return {"Out": [ins["X"][0] if ins.get("X") else None]}
+
+
+@register("fake_init")
+def _fake_init(ctx, ins, attrs):
+    """fake_init_op.cc: the var is served remotely — emit a 1-row
+    placeholder instead of materializing vocab×dim on device."""
+    import jax.numpy as jnp
+    shape = [int(d) for d in attrs.get("shape", [1])]
+    if shape:
+        shape = [1] + shape[1:]
+    return {"Out": [jnp.zeros(shape or (1,), jnp.float32)]}
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PsPassConfig:
+    """What the reference reads off CompileTimeStrategy: which params are
+    remote sparse tables, where the servers are, how the trainer pushes."""
+    endpoints: List[str] = field(default_factory=list)
+    sparse_params: Optional[List[str]] = None    # None = infer from IR
+    lr: float = 0.1
+    geo_k: int = 0                               # >0 = geo-SGD push cadence
+    trainer_id: int = 0
+    send_dense: bool = True
+
+    def resolve_sparse(self, program) -> List[str]:
+        if self.sparse_params is not None:
+            return list(self.sparse_params)
+        names = []
+        for op in program.global_block().ops:
+            w = _SPARSE_OPS.get(op.type)
+            if w is None:
+                continue
+            if op.attrs.get("is_sparse") or op.attrs.get("is_distributed") \
+                    or op.attrs.get("remote_prefetch"):
+                names.append(op.inputs[w][0])
+        return sorted(set(names))
+
+
+# ---------------------------------------------------------------------------
+# pass 1: delete_optimizer_pass (trainer_pass.py:51)
+# ---------------------------------------------------------------------------
+
+def delete_optimizer_pass(program, config: PsPassConfig):
+    """Strip optimizer + LR-schedule ops (the SERVER optimizes under PS);
+    drop vars only they used (moments, lr tensors), keeping params."""
+    block = program.global_block()
+    opt_ops = [op for op in block.ops
+               if op.attrs.get("op_role", 0) & (OpRole.Optimize
+                                                | OpRole.LRSched)]
+    opt_vars = {n for op in opt_ops for n in op.input_names()}
+    opt_vars |= {n for op in opt_ops for n in op.output_names()}
+    for op in opt_ops:
+        block.ops.remove(op)
+    survivors = {n for op in block.ops
+                 for n in op.input_names() + op.output_names()}
+    from ..framework.program import Parameter
+    for n in sorted(opt_vars):
+        if n in survivors or n == "@EMPTY@":
+            continue
+        v = block.vars.get(n)
+        if v is None or isinstance(v, Parameter):
+            continue
+        del block.vars[n]
+    program.bump_version()
+    return program
+
+
+# ---------------------------------------------------------------------------
+# pass 2: distributed_ops_pass (trainer_pass.py:82)
+# ---------------------------------------------------------------------------
+
+def distributed_ops_pass(program, config: PsPassConfig):
+    """Rewrite each sparse lookup_table over a remote table into the
+    pulled+gather form (our distributed_lookup_table equivalent): the
+    pre-hook uniques the ids and pulls rows; on device only a gather
+    remains. The grad of `pulled` is pushed by the post-hook."""
+    block = program.global_block()
+    sparse = set(config.resolve_sparse(program))
+    hooks = getattr(program, "_ps_hooks", None)
+    if hooks is None:
+        hooks = program._ps_hooks = []
+    program._ps_tables = getattr(program, "_ps_tables", [])
+    table_idx = {t.name: i for i, t in enumerate(program._ps_tables)}
+
+    for w_name in sorted(sparse):
+        ops = [op for op in block.ops
+               if op.type in _SPARSE_OPS
+               and op.inputs[_SPARSE_OPS[op.type]][0] == w_name]
+        if not ops:
+            continue
+        w = block.var(w_name)
+        dim = int(w.shape[-1])
+        if w_name not in table_idx:
+            table_idx[w_name] = len(program._ps_tables)
+            program._ps_tables.append(SparseTableConfig(w_name, dim))
+        for op in ops:
+            idx = block.ops.index(op)
+            ids_name = op.inputs["Ids"][0]
+            out_name = op.outputs["Out"][0]
+            ids_v = block.var(ids_name)
+            pulled = block.create_var(
+                name=f"{w_name}@pulled@{config.trainer_id}_{idx}",
+                shape=(-1, dim), dtype="float32", is_data=True)
+            pulled.stop_gradient = False
+            inv_name = ids_name + "@inverse"
+            if inv_name not in block.vars:
+                block.create_var(name=inv_name, shape=tuple(ids_v.shape),
+                                 dtype="int32", is_data=True)
+            block.ops.remove(op)
+            gather_op = block._insert_op(
+                idx, "gather",
+                inputs={"X": [pulled.name], "Index": [inv_name]},
+                outputs={"Out": [out_name]})
+
+            # rewire the already-built backward: the lookup's grad op
+            # (lookup_table_sparse_grad or dense __vjp__) becomes the
+            # gather's vjp producing pulled@GRAD for the push hook —
+            # trainer_pass.py pairs this with its push_sparse rewrite
+            gname = pulled.name + "@GRAD"
+            bwd = [o for o in block.ops
+                   if ((o.type == "lookup_table_sparse_grad"
+                        or (o.type == "__vjp__"
+                            and o.attrs.get("fwd_type") in _SPARSE_OPS))
+                       and o.inputs.get("W", [None])[0] == w_name
+                       and o.inputs.get("Ids", [None])[0] == ids_name)]
+            from ..ops.registry import make_vjp_attrs
+            for bo in bwd:
+                og = bo.inputs.get("OG:Out", [None])[0]
+                bidx = block.ops.index(bo)
+                block.ops.remove(bo)
+                for dead in bo.output_names():
+                    if dead != "@EMPTY@" and dead in block.vars and not any(
+                            dead in o2.input_names() for o2 in block.ops):
+                        del block.vars[dead]
+                if og is None or og == "@EMPTY@":
+                    continue
+                block.create_var(name=gname, shape=(-1, dim),
+                                 dtype="float32", stop_gradient=True)
+                vattrs = make_vjp_attrs(gather_op, [("X", 0)], ["Out"])
+                block._insert_op(
+                    bidx, "__vjp__",
+                    inputs={"X": [pulled.name], "Index": [inv_name],
+                            "OG:Out": [og]},
+                    outputs={"IG:X": [gname]}, attrs=vattrs)
+
+            h = _PsHook(table_idx[w_name], ids_name, pulled.name,
+                        gname, dim, config.lr)
+            h.geo_k = config.geo_k
+            hooks.append(h)
+    program.bump_version()
+    return program
+
+
+# ---------------------------------------------------------------------------
+# pass 3: append_send_ops_pass (trainer_pass.py:167)
+# ---------------------------------------------------------------------------
+
+class _DensePsHook:
+    """Runtime side of a dense `send` op: push the fetched grad to the
+    server's per-param dense table (rows = leading dim), pull the
+    server-optimized value back into the scope before the next step."""
+
+    def __init__(self, param_name: str, table_idx: int, shape, lr: float):
+        self.param = param_name
+        self.table_idx = table_idx
+        self.shape = tuple(int(d) for d in shape)
+        self.rows = self.shape[0] if len(self.shape) > 1 else 1
+        self.dim = int(np.prod(self.shape[1:])) if len(self.shape) > 1 \
+            else int(self.shape[0])
+        self.lr = lr
+        self.grad_name = param_name + "@GRAD"
+        self.client: Optional[KVClient] = None
+        self.ids_name = None          # hook-protocol compat (unused)
+        self.pulled_name = None
+
+    def pre(self, feed: dict) -> dict:
+        from ..framework.scope import global_scope
+        rows = self.client.pull(self.table_idx,
+                                np.arange(self.rows, dtype=np.int64),
+                                self.dim)
+        global_scope().set(self.param,
+                           np.asarray(rows).reshape(self.shape))
+        return {}
+
+    def post(self, fetched: dict):
+        g = fetched.get(self.grad_name)
+        if g is None:
+            return
+        g = np.asarray(g, np.float32).reshape(self.rows, self.dim)
+        self.client.push(self.table_idx,
+                         np.arange(self.rows, dtype=np.int64), g, self.lr)
+
+
+def append_send_ops_pass(program, config: PsPassConfig):
+    """Append one `send` op per trainable grad (the reference batches grads
+    per endpoint section; one op per grad keeps the IR assertion simple and
+    the runtime identical). Dense sends register _DensePsHook runtime
+    state; sparse tables are already handled by distributed_ops_pass."""
+    if not config.send_dense:
+        return program
+    block = program.global_block()
+    sparse = set(config.resolve_sparse(program))
+    hooks = program._ps_hooks = getattr(program, "_ps_hooks", None) or []
+    program._ps_tables = getattr(program, "_ps_tables", [])
+    from ..framework.program import Parameter
+    for v in list(block.vars.values()):
+        if not isinstance(v, Parameter) or not v.trainable:
+            continue
+        if v.name in sparse:
+            continue
+        gname = v.name + "@GRAD"
+        if gname not in block.vars:
+            continue
+        block.append_op("send", inputs={"X": [gname]},
+                        outputs={"Out": ["@EMPTY@"]},
+                        attrs={"table_name": v.name + "@dense",
+                               "endpoints": list(config.endpoints),
+                               "op_role": OpRole.Backward})
+        dim = int(np.prod(v.shape[1:])) if len(v.shape) > 1 \
+            else int(v.shape[0])
+        tidx = len(program._ps_tables)
+        # fan-in-scaled init: the server owns initialization under PS
+        # (fake-init'd trainers never see the startup program's values),
+        # so near-zero defaults would stall deep fronts
+        scale = float(1.0 / np.sqrt(max(dim, 1)))
+        program._ps_tables.append(
+            SparseTableConfig(v.name + "@dense", dim, init_scale=scale))
+        hooks.append(_DensePsHook(v.name, tidx, v.shape, config.lr))
+    program.bump_version()
+    return program
+
+
+# ---------------------------------------------------------------------------
+# pass 4: fake_init_ops_pass (trainer_pass.py:283)
+# ---------------------------------------------------------------------------
+
+def fake_init_ops_pass(startup_program, config: PsPassConfig,
+                       main_program=None):
+    """In the startup program, replace the init ops of remote sparse tables
+    with fake_init — the table lives on the servers; the trainer must not
+    materialize vocab×dim locally."""
+    block = startup_program.global_block()
+    sparse = set(config.sparse_params or
+                 (config.resolve_sparse(main_program) if main_program
+                  else []))
+    replaced = 0
+    for i, op in enumerate(list(block.ops)):
+        outs = op.output_names()
+        hit = [n for n in outs if n in sparse]
+        if not hit:
+            continue
+        idx = block.ops.index(op)
+        shape = tuple(block.var(hit[0]).shape)
+        block.ops.remove(op)
+        block._insert_op(idx, "fake_init", inputs={},
+                         outputs={"Out": [hit[0]]},
+                         attrs={"shape": [int(d) for d in shape]})
+        replaced += 1
+    startup_program.bump_version()
+    return startup_program
+
+
+def build_trainer_program_pipeline(main_program, startup_program,
+                                   config: PsPassConfig):
+    """The reference's pass chaining for a_sync trainers
+    (ParameterServerRuntime): delete_optimizer → distributed_ops →
+    append_send → fake_init. Returns (main, startup) rewritten in place."""
+    sparse = config.resolve_sparse(main_program)
+    cfg = PsPassConfig(endpoints=config.endpoints, sparse_params=sparse,
+                       lr=config.lr, geo_k=config.geo_k,
+                       trainer_id=config.trainer_id,
+                       send_dense=config.send_dense)
+    delete_optimizer_pass(main_program, cfg)
+    distributed_ops_pass(main_program, cfg)
+    append_send_ops_pass(main_program, cfg)
+    fake_init_ops_pass(startup_program, cfg, main_program)
+    return main_program, startup_program
+
+
+def connect_trainer(program, endpoints: List[str], worker_id: int = 0,
+                    a_sync: bool = False):
+    """Wire every registered hook to the live KV service (what
+    fleet.init_worker does in the facade flow)."""
+    from .ps import ShardedKVClient
+    client = ShardedKVClient(endpoints, worker_id=worker_id, a_sync=a_sync)
+    for h in getattr(program, "_ps_hooks", []):
+        h.client = client
+    return program

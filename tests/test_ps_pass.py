@@ -1,0 +1,145 @@
+"""PS program-rewriting v2 pass pipeline (reference incubate/fleet/
+parameter_server/ir/trainer_pass.py:51,82,167,283): a VANILLA program —
+embedding + dense net + optimizer, no fleet facade — converts to PS trainer
+form. Reference-style unit tests assert exactly which ops each pass
+inserts/removes, then an end-to-end test trains the rewritten program
+against a live KV server."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.framework.program import OpRole
+from paddle_tpu.testing import reset_programs
+
+VOCAB, DIM, SLOTS, B = 50, 4, 3, 16
+
+
+def _vanilla_program():
+    """A plain CTR-ish trainer program, built with NO fleet involvement."""
+    reset_programs(seed=0)
+    ids = layers.data(name="ids", shape=[SLOTS, 1], dtype="int64")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    emb = layers.embedding(ids, [VOCAB, DIM], is_sparse=True,
+                           param_attr=paddle.ParamAttr(name="emb_table"))
+    feat = layers.reshape(emb, [-1, SLOTS * DIM])
+    h = layers.fc(feat, 8, act="relu",
+                  param_attr=paddle.ParamAttr(name="w1"),
+                  bias_attr=paddle.ParamAttr(name="b1"))
+    pred = layers.fc(h, 1, param_attr=paddle.ParamAttr(name="w2"),
+                     bias_attr=paddle.ParamAttr(name="b2"))
+    loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+    paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def _types(program):
+    return [op.type for op in program.global_block().ops]
+
+
+def test_delete_optimizer_pass_removes_opt_ops_and_vars():
+    from paddle_tpu.distributed.ps_pass import (PsPassConfig,
+                                                delete_optimizer_pass)
+    _vanilla_program()
+    prog = fluid.default_main_program()
+    n_opt = sum(1 for op in prog.global_block().ops
+                if op.attrs.get("op_role", 0) & OpRole.Optimize)
+    assert n_opt >= 5          # one sgd per param
+    delete_optimizer_pass(prog, PsPassConfig())
+    assert not any(op.attrs.get("op_role", 0) & OpRole.Optimize
+                   for op in prog.global_block().ops)
+    # params survive; backward ops survive (grads still computed)
+    gb = prog.global_block()
+    for p in ("emb_table", "w1", "b1", "w2", "b2"):
+        assert p in gb.vars
+    assert any(op.type == "__vjp__" for op in gb.ops)
+
+
+def test_distributed_ops_pass_rewrites_lookup_to_gather():
+    from paddle_tpu.distributed.ps_pass import (PsPassConfig,
+                                                distributed_ops_pass)
+    _vanilla_program()
+    prog = fluid.default_main_program()
+    before = _types(prog)
+    assert "lookup_table" in before or "lookup_table_v2" in before
+    lt_idx = next(i for i, t in enumerate(before) if t.startswith("lookup"))
+    distributed_ops_pass(prog, PsPassConfig())
+    after = _types(prog)
+    assert not any(t.startswith("lookup_table") for t in after)
+    assert after[lt_idx] == "gather"      # spliced at the same position
+    hooks = prog._ps_hooks
+    assert len(hooks) == 1 and hooks[0].ids_name == "ids"
+    assert prog._ps_tables[0].name == "emb_table"
+
+
+def test_append_send_ops_pass_adds_send_per_dense_grad():
+    from paddle_tpu.distributed.ps_pass import (PsPassConfig,
+                                                append_send_ops_pass,
+                                                delete_optimizer_pass)
+    _vanilla_program()
+    prog = fluid.default_main_program()
+    cfg = PsPassConfig(endpoints=["127.0.0.1:0"],
+                       sparse_params=["emb_table"])
+    delete_optimizer_pass(prog, cfg)
+    append_send_ops_pass(prog, cfg)
+    sends = [op for op in prog.global_block().ops if op.type == "send"]
+    sent = {op.inputs["X"][0] for op in sends}
+    assert sent == {"w1@GRAD", "b1@GRAD", "w2@GRAD", "b2@GRAD"}
+    # dense tables registered with rows/dim split
+    names = [t.name for t in prog._ps_tables]
+    assert set(names) == {"w1@dense", "b1@dense", "w2@dense", "b2@dense"}
+
+
+def test_fake_init_ops_pass_replaces_table_init():
+    from paddle_tpu.distributed.ps_pass import (PsPassConfig,
+                                                fake_init_ops_pass)
+    _vanilla_program()
+    startup = fluid.default_startup_program()
+    main = fluid.default_main_program()
+    init_types = _types(startup)
+    assert "fake_init" not in init_types
+    fake_init_ops_pass(startup, PsPassConfig(), main)
+    gb = startup.global_block()
+    fakes = [op for op in gb.ops if op.type == "fake_init"]
+    assert len(fakes) == 1
+    assert fakes[0].outputs["Out"] == ["emb_table"]
+    # other params' init ops untouched
+    assert sum(1 for op in gb.ops if "emb_table" in op.output_names()) == 1
+
+
+def test_pipeline_end_to_end_trains_against_live_server():
+    """The full chain: vanilla program -> 4 passes -> connect -> the
+    rewritten program trains to a falling loss with the table and all
+    dense params served by the KV service."""
+    from paddle_tpu.distributed.ps import KVServer
+    from paddle_tpu.distributed.ps_pass import (
+        PsPassConfig, build_trainer_program_pipeline, connect_trainer)
+
+    loss = _vanilla_program()
+    main = fluid.default_main_program()
+    startup = fluid.default_startup_program()
+    cfg = PsPassConfig(lr=0.05)
+    build_trainer_program_pipeline(main, startup, cfg)
+
+    srv = KVServer(main._ps_tables)
+    port = srv.start(0)
+    try:
+        connect_trainer(main, [f"127.0.0.1:{port}"])
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, VOCAB, (B, SLOTS, 1)).astype(np.int64)
+        fixed = rng.randn(VOCAB, DIM).astype(np.float32)
+        w_true = rng.randn(SLOTS * DIM, 1).astype(np.float32)
+        yv = (fixed[ids[..., 0]].reshape(B, -1) @ w_true).astype(np.float32)
+        losses = []
+        for _ in range(120):
+            out, = exe.run(feed={"ids": ids, "y": yv}, fetch_list=[loss])
+            losses.append(float(np.asarray(out).reshape(-1)[0]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.5, \
+            f"PS-rewritten program failed to train: {losses[0]:.4f} -> " \
+            f"{losses[-1]:.4f}"
+    finally:
+        srv.stop()
