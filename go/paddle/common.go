@@ -1,0 +1,51 @@
+// Package paddle: Go inference bindings for paddle-tpu over the C API
+// (paddle_tpu/native/capi.{h,cc}). Reference counterpart:
+// go/paddle/{common,config,predictor,tensor}.go — same API surface, backed
+// by the XLA predictor instead of the AnalysisPredictor.
+//
+// Build: the cgo directives below expect libcapi.so next to capi.h in
+// paddle_tpu/native (built by setup_native.py). At run time the library
+// embeds Python, so LD_LIBRARY_PATH must reach libpython and PYTHONPATH
+// must reach paddle_tpu (tests/test_go_bindings.py arranges both).
+package paddle
+
+// #cgo CFLAGS: -I${SRCDIR}/../../paddle_tpu/native
+// #cgo LDFLAGS: -L${SRCDIR}/../../paddle_tpu/native -lcapi -Wl,-rpath,${SRCDIR}/../../paddle_tpu/native
+// #include <capi.h>
+import "C"
+
+// DataType mirrors PD_DataType.
+type DataType int
+
+const (
+	Float32 DataType = iota
+	Int32
+	Int64
+)
+
+func (t DataType) String() string {
+	switch t {
+	case Float32:
+		return "float32"
+	case Int32:
+		return "int32"
+	case Int64:
+		return "int64"
+	}
+	return "unknown"
+}
+
+// LastError returns the library's thread-local error message.
+func LastError() string {
+	return C.GoString(C.PD_GetLastError())
+}
+
+// Init starts the embedded runtime (idempotent).
+func Init() bool {
+	return C.PD_Init() == 0
+}
+
+// Finalize stops the embedded runtime.
+func Finalize() {
+	C.PD_Finalize()
+}

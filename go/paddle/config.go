@@ -1,0 +1,78 @@
+package paddle
+
+// AnalysisConfig mirrors the reference's go/paddle/config.go surface. On
+// TPU the accelerator/IR knobs are recorded but inert: the XLA predictor
+// always runs the compiled path (GPU/TensorRT/MKLDNN toggles have no TPU
+// meaning — README "declared scope cuts"), so getters faithfully report
+// what the caller set while the predictor ignores them.
+type AnalysisConfig struct {
+	modelDir   string
+	progFile   string
+	paramsFile string
+
+	useGpu            bool
+	gpuDeviceID       int
+	memoryPoolSizeMB  int
+	irOptim           bool
+	useFeedFetchOps   bool
+	specifyInputNames bool
+	cpuMathThreads    int
+	memoryOptim       bool
+	profile           bool
+	glogInfoDisabled  bool
+	valid             bool
+}
+
+func NewAnalysisConfig() *AnalysisConfig {
+	return &AnalysisConfig{irOptim: true, valid: true}
+}
+
+// SetModel points the config at a saved inference model directory (the
+// combined prog+params layout save_inference_model emits). The two-file
+// form passes the program and params paths explicitly.
+func (c *AnalysisConfig) SetModel(model string, params string) {
+	if params == "" {
+		c.modelDir = model
+	} else {
+		c.progFile = model
+		c.paramsFile = params
+	}
+}
+
+func (c *AnalysisConfig) SetModelDir(dir string) { c.modelDir = dir }
+func (c *AnalysisConfig) ModelDir() string       { return c.modelDir }
+func (c *AnalysisConfig) ProgFile() string       { return c.progFile }
+func (c *AnalysisConfig) ParamsFile() string     { return c.paramsFile }
+
+func (c *AnalysisConfig) EnableUseGpu(memoryPoolInitSizeMb int, deviceID int) {
+	c.useGpu = true
+	c.memoryPoolSizeMB = memoryPoolInitSizeMb
+	c.gpuDeviceID = deviceID
+}
+func (c *AnalysisConfig) DisableGpu()               { c.useGpu = false }
+func (c *AnalysisConfig) UseGpu() bool              { return c.useGpu }
+func (c *AnalysisConfig) GpuDeviceId() int          { return c.gpuDeviceID }
+func (c *AnalysisConfig) MemoryPoolInitSizeMb() int { return c.memoryPoolSizeMB }
+
+func (c *AnalysisConfig) SwitchIrOptim(x bool) { c.irOptim = x }
+func (c *AnalysisConfig) IrOptim() bool        { return c.irOptim }
+
+func (c *AnalysisConfig) SwitchUseFeedFetchOps(x bool) { c.useFeedFetchOps = x }
+func (c *AnalysisConfig) UseFeedFetchOpsEnabled() bool { return c.useFeedFetchOps }
+
+func (c *AnalysisConfig) SwitchSpecifyInputNames(x bool) { c.specifyInputNames = x }
+func (c *AnalysisConfig) SpecifyInputName() bool         { return c.specifyInputNames }
+
+func (c *AnalysisConfig) SetCpuMathLibraryNumThreads(n int) { c.cpuMathThreads = n }
+func (c *AnalysisConfig) CpuMathLibraryNumThreads() int     { return c.cpuMathThreads }
+
+func (c *AnalysisConfig) EnableMemoryOptim()      { c.memoryOptim = true }
+func (c *AnalysisConfig) MemoryOptimEnabled() bool { return c.memoryOptim }
+
+func (c *AnalysisConfig) EnableProfile()      { c.profile = true }
+func (c *AnalysisConfig) ProfileEnabled() bool { return c.profile }
+
+func (c *AnalysisConfig) DisableGlogInfo() { c.glogInfoDisabled = true }
+
+func (c *AnalysisConfig) SetInValid() { c.valid = false }
+func (c *AnalysisConfig) IsValid() bool { return c.valid }

@@ -1,0 +1,137 @@
+package paddle
+
+// Predictor mirrors go/paddle/predictor.go over the paddle-tpu C API: one
+// compiled XLA program per model, Clone() for cheap per-goroutine handles
+// sharing the compilation cache (capi.cc clone-per-thread contract).
+
+// #include <capi.h>
+// #include <stdlib.h>
+import "C"
+import (
+	"errors"
+	"runtime"
+	"unsafe"
+)
+
+type Predictor struct {
+	c *C.PD_Predictor
+}
+
+// NewPredictor loads the saved inference model named by the config
+// (SetModel / SetModelDir) and compiles it. Returns nil on failure —
+// inspect LastError().
+func NewPredictor(config *AnalysisConfig) *Predictor {
+	if !Init() {
+		return nil
+	}
+	dir := config.ModelDir()
+	if dir == "" {
+		dir = config.ProgFile() // two-file form: prog path names the dir
+	}
+	cdir := C.CString(dir)
+	defer C.free(unsafe.Pointer(cdir))
+	p := C.PD_PredictorCreate(cdir)
+	if p == nil {
+		return nil
+	}
+	pred := &Predictor{c: p}
+	runtime.SetFinalizer(pred, (*Predictor).finalize)
+	return pred
+}
+
+func (p *Predictor) finalize() {
+	if p.c != nil {
+		C.PD_PredictorDestroy(p.c)
+		p.c = nil
+	}
+}
+
+func DeletePredictor(p *Predictor) {
+	p.finalize()
+	runtime.SetFinalizer(p, nil)
+}
+
+// Clone returns an independent handle sharing the compiled program —
+// the per-goroutine serving pattern.
+func (p *Predictor) Clone() *Predictor {
+	c := C.PD_PredictorClone(p.c)
+	if c == nil {
+		return nil
+	}
+	cl := &Predictor{c: c}
+	runtime.SetFinalizer(cl, (*Predictor).finalize)
+	return cl
+}
+
+func (p *Predictor) GetInputNum() int  { return int(C.PD_PredictorNumInputs(p.c)) }
+func (p *Predictor) GetOutputNum() int { return int(C.PD_PredictorNumOutputs(p.c)) }
+
+func (p *Predictor) GetInputName(n int) string {
+	return C.GoString(C.PD_PredictorInputName(p.c, C.int(n)))
+}
+
+func (p *Predictor) GetOutputName(n int) string {
+	return C.GoString(C.PD_PredictorOutputName(p.c, C.int(n)))
+}
+
+func (p *Predictor) GetInputNames() []string {
+	names := make([]string, p.GetInputNum())
+	for i := range names {
+		names[i] = p.GetInputName(i)
+	}
+	return names
+}
+
+func (p *Predictor) GetOutputNames() []string {
+	names := make([]string, p.GetOutputNum())
+	for i := range names {
+		names[i] = p.GetOutputName(i)
+	}
+	return names
+}
+
+// GetInputTensors returns fresh named tensors for every model input.
+func (p *Predictor) GetInputTensors() []*ZeroCopyTensor {
+	ts := make([]*ZeroCopyTensor, p.GetInputNum())
+	for i := range ts {
+		ts[i] = NewZeroCopyTensor(p.GetInputName(i))
+	}
+	return ts
+}
+
+// Run executes the model on `inputs` and returns one output tensor per
+// model output (replaces the reference's SetZeroCopyInput/ZeroCopyRun/
+// GetZeroCopyOutput triple with one call; the data crossing is identical).
+func (p *Predictor) Run(inputs []*ZeroCopyTensor) ([]*ZeroCopyTensor, error) {
+	cin := make([]C.PD_CTensor, len(inputs))
+	pins := make([]unsafe.Pointer, 0, len(inputs))
+	for i, t := range inputs {
+		ptr, err := t.fill(&cin[i])
+		if err != nil {
+			return nil, err
+		}
+		if ptr != nil {
+			pins = append(pins, ptr)
+		}
+	}
+	var couts *C.PD_CTensor
+	var nOut C.int
+	var inPtr *C.PD_CTensor
+	if len(cin) > 0 {
+		inPtr = &cin[0]
+	}
+	rc := C.PD_PredictorRun(p.c, inPtr, C.int(len(cin)), &couts, &nOut)
+	runtime.KeepAlive(inputs)
+	_ = pins
+	if rc != 0 {
+		return nil, errors.New(LastError())
+	}
+	outs := make([]*ZeroCopyTensor, int(nOut))
+	carr := unsafe.Slice(couts, int(nOut))
+	for i := range outs {
+		outs[i] = &ZeroCopyTensor{}
+		outs[i].fromC(&carr[i])
+	}
+	C.PD_FreeOutputs(couts, nOut)
+	return outs, nil
+}

@@ -1,0 +1,63 @@
+package paddle
+
+// Driven by tests/test_go_bindings.py, which saves a tiny inference model
+// and points PADDLE_TPU_GO_TEST_MODEL at it (plus PYTHONPATH/LD_LIBRARY_PATH
+// for the embedded runtime). Standalone `go test` without that env skips.
+
+import (
+	"os"
+	"testing"
+)
+
+func TestPredictorEndToEnd(t *testing.T) {
+	model := os.Getenv("PADDLE_TPU_GO_TEST_MODEL")
+	if model == "" {
+		t.Skip("PADDLE_TPU_GO_TEST_MODEL not set (run via tests/test_go_bindings.py)")
+	}
+	cfg := NewAnalysisConfig()
+	cfg.SetModelDir(model)
+	pred := NewPredictor(cfg)
+	if pred == nil {
+		t.Fatalf("NewPredictor failed: %s", LastError())
+	}
+	defer DeletePredictor(pred)
+
+	if pred.GetInputNum() < 1 || pred.GetOutputNum() < 1 {
+		t.Fatalf("unexpected io arity: %d in, %d out",
+			pred.GetInputNum(), pred.GetOutputNum())
+	}
+	ins := pred.GetInputTensors()
+	// the python side saves fc(x[4]) with input "x" [batch, 4]
+	ins[0].Reshape([]int64{2, 4})
+	if err := ins[0].SetValue([]float32{1, 2, 3, 4, 5, 6, 7, 8}); err != nil {
+		t.Fatal(err)
+	}
+	outs, err := pred.Run(ins)
+	if err != nil {
+		t.Fatalf("Run: %v", err)
+	}
+	if len(outs) != pred.GetOutputNum() {
+		t.Fatalf("got %d outputs", len(outs))
+	}
+	v, ok := outs[0].Value().([]float32)
+	if !ok || len(v) == 0 {
+		t.Fatalf("bad output payload: %#v", outs[0].Value())
+	}
+
+	// clone shares the compiled program and must agree bit-for-bit
+	cl := pred.Clone()
+	if cl == nil {
+		t.Fatalf("Clone failed: %s", LastError())
+	}
+	defer DeletePredictor(cl)
+	outs2, err := cl.Run(ins)
+	if err != nil {
+		t.Fatalf("clone Run: %v", err)
+	}
+	v2 := outs2[0].Value().([]float32)
+	for i := range v {
+		if v[i] != v2[i] {
+			t.Fatalf("clone output diverges at %d: %v vs %v", i, v[i], v2[i])
+		}
+	}
+}

@@ -1,0 +1,152 @@
+package paddle
+
+// ZeroCopyTensor mirrors go/paddle/tensor.go: a named, typed, shaped buffer
+// handed to/from the predictor. "Zero-copy" here means the Go slice's
+// backing array is passed to PD_PredictorRun directly (pinned for the call);
+// outputs are copied once out of the library-owned buffer then freed.
+
+// #include <capi.h>
+// #include <stdlib.h>
+// #include <string.h>
+import "C"
+import (
+	"fmt"
+	"reflect"
+	"unsafe"
+)
+
+type ZeroCopyTensor struct {
+	name  string
+	dtype DataType
+	shape []int64
+	// exactly one of these holds data, matching dtype
+	f32 []float32
+	i32 []int32
+	i64 []int64
+}
+
+func NewZeroCopyTensor(name string) *ZeroCopyTensor {
+	return &ZeroCopyTensor{name: name, dtype: Float32}
+}
+
+func (t *ZeroCopyTensor) Name() string      { return t.name }
+func (t *ZeroCopyTensor) Rename(n string)   { t.name = n }
+func (t *ZeroCopyTensor) DataType() DataType { return t.dtype }
+func (t *ZeroCopyTensor) Shape() []int64    { return t.shape }
+
+func (t *ZeroCopyTensor) Reshape(shape []int64) { t.shape = shape }
+
+func numel(shape []int64) int64 {
+	n := int64(1)
+	for _, d := range shape {
+		n *= d
+	}
+	return n
+}
+
+// SetValue accepts []float32, []int32 or []int64 whose length matches the
+// current shape.
+func (t *ZeroCopyTensor) SetValue(value interface{}) error {
+	want := numel(t.shape)
+	switch v := value.(type) {
+	case []float32:
+		if int64(len(v)) != want {
+			return fmt.Errorf("shape %v wants %d elems, got %d", t.shape, want, len(v))
+		}
+		t.dtype, t.f32, t.i32, t.i64 = Float32, v, nil, nil
+	case []int32:
+		if int64(len(v)) != want {
+			return fmt.Errorf("shape %v wants %d elems, got %d", t.shape, want, len(v))
+		}
+		t.dtype, t.f32, t.i32, t.i64 = Int32, nil, v, nil
+	case []int64:
+		if int64(len(v)) != want {
+			return fmt.Errorf("shape %v wants %d elems, got %d", t.shape, want, len(v))
+		}
+		t.dtype, t.f32, t.i32, t.i64 = Int64, nil, nil, v
+	default:
+		return fmt.Errorf("unsupported value type %v", reflect.TypeOf(value))
+	}
+	return nil
+}
+
+// Value returns the tensor's data as []float32 / []int32 / []int64.
+func (t *ZeroCopyTensor) Value() interface{} {
+	switch t.dtype {
+	case Float32:
+		return t.f32
+	case Int32:
+		return t.i32
+	case Int64:
+		return t.i64
+	}
+	return nil
+}
+
+// fill packs this tensor into a PD_CTensor for a Run call. The returned
+// pointer (if any) must be kept alive until the call returns.
+func (t *ZeroCopyTensor) fill(ct *C.PD_CTensor) (unsafe.Pointer, error) {
+	if len(t.name) >= 64 {
+		return nil, fmt.Errorf("tensor name %q too long (max 63)", t.name)
+	}
+	cs := C.CString(t.name)
+	defer C.free(unsafe.Pointer(cs))
+	C.strncpy(&ct.name[0], cs, 63)
+	ct.dtype = C.int(t.dtype)
+	if len(t.shape) > 8 {
+		return nil, fmt.Errorf("rank %d > 8", len(t.shape))
+	}
+	ct.ndim = C.int(len(t.shape))
+	for i, d := range t.shape {
+		ct.shape[i] = C.int64_t(d)
+	}
+	var p unsafe.Pointer
+	var bytes int64
+	switch t.dtype {
+	case Float32:
+		if len(t.f32) > 0 {
+			p = unsafe.Pointer(&t.f32[0])
+		}
+		bytes = int64(len(t.f32)) * 4
+	case Int32:
+		if len(t.i32) > 0 {
+			p = unsafe.Pointer(&t.i32[0])
+		}
+		bytes = int64(len(t.i32)) * 4
+	case Int64:
+		if len(t.i64) > 0 {
+			p = unsafe.Pointer(&t.i64[0])
+		}
+		bytes = int64(len(t.i64)) * 8
+	}
+	ct.data = p
+	ct.byte_len = C.size_t(bytes)
+	return p, nil
+}
+
+// fromC copies a library-owned output PD_CTensor into Go memory.
+func (t *ZeroCopyTensor) fromC(ct *C.PD_CTensor) {
+	t.name = C.GoString(&ct.name[0])
+	t.dtype = DataType(ct.dtype)
+	t.shape = make([]int64, int(ct.ndim))
+	n := int64(1)
+	for i := range t.shape {
+		t.shape[i] = int64(ct.shape[i])
+		n *= t.shape[i]
+	}
+	t.f32, t.i32, t.i64 = nil, nil, nil
+	if ct.data == nil || n == 0 {
+		return
+	}
+	switch t.dtype {
+	case Float32:
+		t.f32 = make([]float32, n)
+		C.memcpy(unsafe.Pointer(&t.f32[0]), ct.data, C.size_t(n*4))
+	case Int32:
+		t.i32 = make([]int32, n)
+		C.memcpy(unsafe.Pointer(&t.i32[0]), ct.data, C.size_t(n*4))
+	case Int64:
+		t.i64 = make([]int64, n)
+		C.memcpy(unsafe.Pointer(&t.i64[0]), ct.data, C.size_t(n*8))
+	}
+}

@@ -1,2 +1,3 @@
 """paddle.fluid.contrib parity namespace."""
 from . import slim  # noqa: F401
+from . import layers  # noqa: F401

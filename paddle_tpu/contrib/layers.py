@@ -1,0 +1,148 @@
+"""fluid.contrib.layers — the contrib op surface (reference
+python/paddle/fluid/contrib/layers/nn.py): tdm_child, tdm_sampler,
+pyramid_hash (search_pyramid_hash), var_conv_2d, rank_attention,
+correlation, bilateral_slice, similarity_focus (core layers in the
+reference but grouped here with their CTR siblings where noted)."""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+
+def _op(op_type, inputs, out_slots, attrs=None, dtypes=None):
+    helper = LayerHelper(op_type)
+    outs = {}
+    for s in out_slots:
+        outs[s] = helper.create_variable_for_type_inference(
+            (dtypes or {}).get(s, "float32"))
+    helper.append_op(op_type, inputs=inputs,
+                     outputs={k: [v] for k, v in outs.items()},
+                     attrs=attrs or {})
+    return outs
+
+
+def tdm_child(x, node_nums, child_nums, param_attr=None, dtype="int32",
+              tree_info=None, name=None):
+    """Reference contrib/layers/nn.py tdm_child. TPU-native: the tree-info
+    table is an explicit Variable (`tree_info=`), not a hidden parameter."""
+    assert tree_info is not None, \
+        "pass tree_info= (the [node_nums, 3+child_nums] tree table var)"
+    outs = _op("tdm_child", {"X": [x], "TreeInfo": [tree_info]},
+               ("Child", "LeafMask"), {"child_nums": int(child_nums)},
+               dtypes={"Child": dtype, "LeafMask": dtype})
+    return outs["Child"], outs["LeafMask"]
+
+
+def tdm_sampler(x, neg_samples_num_list, layer_node_num_list, leaf_node_num,
+                travel=None, layer=None, output_positive=True,
+                output_list=True, seed=0, tree_travel_attr=None,
+                tree_layer_attr=None, dtype="int32", name=None):
+    """Reference contrib/layers/nn.py tdm_sampler; travel/layer tables are
+    explicit Variables here."""
+    assert travel is not None and layer is not None, \
+        "pass travel= and layer= table Variables"
+    offsets = [0]
+    for n in layer_node_num_list:
+        offsets.append(offsets[-1] + int(n))
+    outs = _op("tdm_sampler", {"X": [x], "Travel": [travel],
+                               "Layer": [layer]},
+               ("Out", "Labels", "Mask"),
+               {"neg_samples_num_list": [int(v) for v in
+                                         neg_samples_num_list],
+                "layer_offset_lod": offsets,
+                "output_positive": bool(output_positive), "seed": int(seed)},
+               dtypes={"Out": dtype, "Labels": dtype, "Mask": dtype})
+    return outs["Out"], outs["Labels"], outs["Mask"]
+
+
+def search_pyramid_hash(input, num_emb, space_len, pyramid_layer, rand_len,
+                        drop_out_percent, is_training, use_filter,
+                        white_list_len, black_list_len, seed,
+                        lr=1.0, param_attr=None, param_attr_wl=None,
+                        param_attr_bl=None, name=None,
+                        distribute_update_vars=None, dtype="float32",
+                        seq_len=None):
+    """Reference contrib/layers/nn.py search_pyramid_hash (pyramid_hash
+    op). Padded-dense input + optional seq_len lengths."""
+    from .. import initializer as I
+    helper = LayerHelper("pyramid_hash")
+    w = helper.create_parameter(param_attr, [int(space_len), int(num_emb)],
+                                dtype=dtype,
+                                default_initializer=I.Uniform(-0.1, 0.1))
+    ins = {"X": [input], "W": [w]}
+    if seq_len is not None:
+        ins["SeqLen"] = [seq_len]
+    outs = _op("pyramid_hash", ins, ("Out",),
+               {"num_emb": int(num_emb), "space_len": int(space_len),
+                "pyramid_layer": int(pyramid_layer),
+                "rand_len": int(rand_len),
+                "drop_out_percent": float(drop_out_percent),
+                "is_training": int(is_training),
+                "use_filter": bool(use_filter),
+                "white_list_len": int(white_list_len),
+                "black_list_len": int(black_list_len), "seed": int(seed)})
+    return outs["Out"]
+
+
+def var_conv_2d(input, row, col, input_channel, output_channel, filter_size,
+                stride=1, param_attr=None, act=None, dtype="float32",
+                name=None):
+    """Reference contrib/layers/nn.py var_conv_2d over padded-dense maps."""
+    from .. import initializer as I
+    helper = LayerHelper("var_conv_2d")
+    fh, fw = (filter_size if hasattr(filter_size, "__len__")
+              else (filter_size, filter_size))
+    sh, sw = (stride if hasattr(stride, "__len__") else (stride, stride))
+    w = helper.create_parameter(
+        param_attr, [int(output_channel), int(input_channel * fh * fw)],
+        dtype=dtype, default_initializer=I.Xavier())
+    outs = _op("var_conv_2d",
+               {"X": [input], "ROW": [row], "COLUMN": [col], "W": [w]},
+               ("Out", "Col"),
+               {"InputChannel": int(input_channel),
+                "OutputChannel": int(output_channel),
+                "KernelH": int(fh), "KernelW": int(fw),
+                "StrideH": int(sh), "StrideW": int(sw)})
+    return helper.append_activation(outs["Out"], act)
+
+
+def rank_attention(input, rank_offset, rank_param_shape, rank_param_attr,
+                   max_rank=3, max_size=0):
+    """Reference contrib/layers/nn.py rank_attention."""
+    from .. import initializer as I
+    helper = LayerHelper("rank_attention")
+    w = helper.create_parameter(rank_param_attr,
+                                [int(d) for d in rank_param_shape],
+                                dtype="float32",
+                                default_initializer=I.Xavier())
+    outs = _op("rank_attention",
+               {"X": [input], "RankOffset": [rank_offset],
+                "RankParam": [w]},
+               ("Out", "InputHelp", "InsRank"),
+               {"MaxRank": int(max_rank), "MaxSize": int(max_size)})
+    return outs["Out"]
+
+
+def correlation(x, y, pad_size, kernel_size, max_displacement, stride1,
+                stride2, corr_type_multiply=1):
+    """Reference contrib/layers/nn.py correlation (FlowNet cost volume)."""
+    outs = _op("correlation", {"Input1": [x], "Input2": [y]}, ("Output",),
+               {"pad_size": int(pad_size), "kernel_size": int(kernel_size),
+                "max_displacement": int(max_displacement),
+                "stride1": int(stride1), "stride2": int(stride2),
+                "corr_type_multiply": int(corr_type_multiply)})
+    return outs["Output"]
+
+
+def bilateral_slice(x, guide, grid, has_offset=False, name=None):
+    """Reference contrib/layers/nn.py bilateral_slice (HDRNet)."""
+    outs = _op("bilateral_slice",
+               {"X": [x], "Guide": [guide], "Grid": [grid]}, ("Out",),
+               {"has_offset": bool(has_offset)})
+    return outs["Out"]
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """Reference layers/nn.py similarity_focus."""
+    outs = _op("similarity_focus", {"X": [input]}, ("Out",),
+               {"axis": int(axis), "indexes": [int(i) for i in indexes]})
+    return outs["Out"]

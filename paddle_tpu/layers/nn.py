@@ -22,6 +22,8 @@ __all__ = [
     "image_resize", "resize_nearest", "resize_bilinear", "relu6",
     "softplus", "swish", "hard_swish", "hard_sigmoid", "exp", "sqrt", "abs",
     "square", "log", "floor", "ceil", "round", "sign", "pow", "cos", "sin",
+    "hsigmoid", "edit_distance", "bilinear_tensor_product",
+    "add_position_encoding",
     "equal", "not_equal", "less_than", "less_equal", "greater_than",
     "greater_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
     "where", "cond_take", "unique", "cumsum", "prelu", "brelu",
@@ -944,3 +946,85 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types,
 
 
 __all__ += ["linear_chain_crf", "crf_decoding", "chunk_eval"]
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    """Reference layers/nn.py hsigmoid (hierarchical_sigmoid_op). The
+    weight is [num_classes - 1, D] like the reference (a complete binary
+    tree over C leaves has C-1 internal nodes). `is_sparse` is accepted
+    for signature parity but the update stays dense — row-sparse optimizer
+    state has no TPU win at hsigmoid's num_classes scale."""
+    from .. import initializer as I
+    helper = LayerHelper("hsigmoid")
+    d = int(input.shape[-1])
+    num_nodes = int(num_classes) - 1 if not is_custom else \
+        int(path_table.shape[-1]) + num_classes
+    w = helper.create_parameter(param_attr, [num_nodes, d],
+                                dtype=dtype_name(input.dtype),
+                                default_initializer=I.Xavier())
+    ins = {"X": [input], "W": [w], "Label": [label]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_nodes],
+                                    dtype=dtype_name(input.dtype),
+                                    is_bias=True)
+        ins["Bias"] = [b]
+    if is_custom:
+        ins["PathTable"] = [path_table]
+        ins["PathCode"] = [path_code]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pre = helper.create_variable_for_type_inference(input.dtype)
+    w_out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("hierarchical_sigmoid", inputs=ins,
+                     outputs={"Out": [out], "PreOut": [pre],
+                              "W_Out": [w_out]},
+                     attrs={"num_classes": int(num_classes)})
+    return out
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """Reference layers/nn.py edit_distance. Padded-dense + lengths;
+    returns (distance, sequence_num)."""
+    helper = LayerHelper("edit_distance")
+    ins = {"Hyps": [input], "Refs": [label]}
+    if input_length is not None:
+        ins["HypsLength"] = [input_length]
+    if label_length is not None:
+        ins["RefsLength"] = [label_length]
+    out = helper.create_variable_for_type_inference("float32")
+    seq = helper.create_variable_for_type_inference("int32")
+    helper.append_op("edit_distance", inputs=ins,
+                     outputs={"Out": [out], "SequenceNum": [seq]},
+                     attrs={"normalized": bool(normalized)})
+    return out, seq
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """Reference layers/nn.py bilinear_tensor_product."""
+    from .. import initializer as I
+    helper = LayerHelper("bilinear_tensor_product")
+    w = helper.create_parameter(
+        param_attr, [int(size), int(x.shape[-1]), int(y.shape[-1])],
+        dtype=dtype_name(x.dtype), default_initializer=I.Xavier())
+    ins = {"X": [x], "Y": [y], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [1, int(size)],
+                                    dtype=dtype_name(x.dtype), is_bias=True)
+        ins["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("bilinear_tensor_product", inputs=ins,
+                     outputs={"Out": [out]})
+    return helper.append_activation(out, act)
+
+
+def add_position_encoding(input, alpha, beta, name=None):
+    """Reference layers/nn.py add_position_encoding."""
+    helper = LayerHelper("add_position_encoding")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("add_position_encoding", inputs={"X": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"alpha": float(alpha), "beta": float(beta)})
+    return out

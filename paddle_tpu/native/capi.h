@@ -1,0 +1,58 @@
+/* C inference API for paddle-tpu (reference: paddle/fluid/inference/capi/
+ * paddle_c_api.h — same role, re-designed over the XLA predictor; see
+ * capi.cc). Consumed by C programs (tests/test_capi_serving.py) and the Go
+ * bindings (go/paddle). */
+#ifndef PADDLE_TPU_CAPI_H_
+#define PADDLE_TPU_CAPI_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef enum PD_DataType {
+  PD_FLOAT32 = 0,
+  PD_INT32 = 1,
+  PD_INT64 = 2,
+} PD_DataType;
+
+typedef struct PD_CTensor {
+  char name[64];
+  int dtype;   /* PD_DataType */
+  int ndim;
+  int64_t shape[8];
+  void* data;      /* input: caller-owned; output: owned by the library, */
+  size_t byte_len; /*         release with PD_FreeOutputs */
+} PD_CTensor;
+
+typedef struct PD_Predictor PD_Predictor; /* opaque */
+
+const char* PD_GetLastError(void);
+
+/* Start/stop the embedded runtime (idempotent; thread-safe). */
+int PD_Init(void);
+void PD_Finalize(void);
+
+PD_Predictor* PD_PredictorCreate(const char* model_dir);
+PD_Predictor* PD_PredictorClone(PD_Predictor* src);
+void PD_PredictorDestroy(PD_Predictor* p);
+
+int PD_PredictorNumInputs(PD_Predictor* p);
+int PD_PredictorNumOutputs(PD_Predictor* p);
+const char* PD_PredictorInputName(PD_Predictor* p, int i);
+const char* PD_PredictorOutputName(PD_Predictor* p, int i);
+
+/* Run: inputs are caller-owned raw buffers; outputs (including data) are
+ * malloc'd by the library and released with PD_FreeOutputs. Returns 0 on
+ * success; on failure see PD_GetLastError. */
+int PD_PredictorRun(PD_Predictor* p, const PD_CTensor* inputs, int n_in,
+                    PD_CTensor** outputs, int* n_out);
+void PD_FreeOutputs(PD_CTensor* outputs, int n_out);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* PADDLE_TPU_CAPI_H_ */

@@ -1,0 +1,575 @@
+"""Round-4 dense-op tail, part 2: vision/CTR structural ops.
+
+Reference counterparts noted per op; everything static-shape (padded +
+lengths replace LoD per docs/lod_design.md)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("spp")
+def _spp(ctx, ins, attrs):
+    """spp_op.h (spatial pyramid pooling): level l pools an adaptive
+    2^l × 2^l grid; levels flatten + concat → [N, C·Σ4^l]."""
+    x = ins["X"][0]                              # [N, C, H, W]
+    levels = int(attrs.get("pyramid_height", 1))
+    ptype = attrs.get("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    for l in range(levels):
+        bins = 2 ** l
+        # adaptive bin edges (floor/ceil rule, identical to reference's
+        # AdaptStartIndex/AdaptEndIndex)
+        hs = [(i * h) // bins for i in range(bins)]
+        he = [-(-(i + 1) * h // bins) for i in range(bins)]
+        ws = [(j * w) // bins for j in range(bins)]
+        we = [-(-(j + 1) * w // bins) for j in range(bins)]
+        rows = []
+        for i in range(bins):
+            cols = []
+            for j in range(bins):
+                window = x[:, :, hs[i]:he[i], ws[j]:we[j]]
+                cols.append(window.max(axis=(2, 3)) if ptype == "max"
+                            else window.mean(axis=(2, 3)))
+            rows.append(jnp.stack(cols, axis=-1))
+        outs.append(jnp.stack(rows, axis=-2).reshape(n, c * bins * bins))
+    return {"Out": [jnp.concatenate(outs, axis=1)]}
+
+
+@register("similarity_focus", nondiff_slots=("X",))
+def _similarity_focus(ctx, ins, attrs):
+    """similarity_focus_op.h: for each chosen slice along `axis`, greedily
+    walk its elements in descending order and mark an element's full fiber
+    (all positions along `axis`) with 1 when neither of its two other
+    coordinates is taken yet — a hard assignment reminiscent of bipartite
+    matching. Sequential by nature → lax.scan over the sorted order."""
+    x = ins["X"][0]                              # [B, d1, d2, d3]
+    axis = int(attrs.get("axis", 1))
+    indexes = [int(i) for i in attrs.get("indexes", [0])]
+    b = x.shape[0]
+    # canonicalize: move `axis` to dim 1 → slices are [M, N]
+    perm = [0, axis] + [d for d in (1, 2, 3) if d != axis]
+    xc = jnp.transpose(x, perm)                  # [B, A, M, N]
+    m, n2 = xc.shape[2], xc.shape[3]
+
+    def greedy(slice2d):
+        order = jnp.argsort(-slice2d.reshape(-1))
+
+        def step(carry, t):
+            tm, tn, out = carry
+            i = order[t] // n2
+            j = order[t] % n2
+            ok = (~tm[i]) & (~tn[j])
+            tm = tm.at[i].set(tm[i] | ok)
+            tn = tn.at[j].set(tn[j] | ok)
+            out = jnp.where(ok, out.at[i, j].set(1.0), out)
+            return (tm, tn, out), None
+
+        (_, _, out), _ = jax.lax.scan(
+            step, (jnp.zeros((m,), bool), jnp.zeros((n2,), bool),
+                   jnp.zeros((m, n2), jnp.float32)),
+            jnp.arange(m * n2))
+        return out
+
+    res = jnp.zeros(xc.shape, jnp.float32)
+    for idx in indexes:
+        marks = jax.vmap(greedy)(xc[:, idx])     # [B, M, N]
+        res = jnp.maximum(res, marks[:, None, :, :])
+    inv = np.argsort(perm)
+    return {"Out": [jnp.transpose(res, inv).astype(x.dtype)]}
+
+
+@register("correlation")
+def _correlation(ctx, ins, attrs):
+    """correlation_op (FlowNet cost volume): out[n, q, y, x] = mean over
+    channels × kernel window of x1[p]·x2[p + disp_q], displacements on a
+    stride2 grid within ±max_displacement."""
+    x1 = ins["Input1"][0].astype(jnp.float32)
+    x2 = ins["Input2"][0].astype(jnp.float32)
+    pad = int(attrs.get("pad_size", 0))
+    ksize = int(attrs.get("kernel_size", 1))
+    maxd = int(attrs.get("max_displacement", 1))
+    s1 = int(attrs.get("stride1", 1))
+    s2 = int(attrs.get("stride2", 1))
+    n, c, h, w = x1.shape
+    p1 = jnp.pad(x1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(x2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    d_r = maxd // s2
+    grid = range(-d_r * s2, d_r * s2 + 1, s2)
+    krad = (ksize - 1) // 2
+    ph, pw = h + 2 * pad, w + 2 * pad
+    # valid centers (reference: border of max_displacement + kernel radius)
+    ys = np.arange(maxd + krad, ph - maxd - krad, s1)
+    xs = np.arange(maxd + krad, pw - maxd - krad, s1)
+    outs = []
+    for dy in grid:
+        for dx in grid:
+            prod = p1 * jnp.roll(p2, shift=(-dy, -dx), axis=(2, 3))
+            # kernel-window mean via cumulative box filter
+            if ksize > 1:
+                kern = jnp.ones((ksize, ksize), jnp.float32) / (ksize * ksize)
+                prod = jax.lax.conv_general_dilated(
+                    prod.reshape(n * c, 1, ph, pw), kern[None, None],
+                    (1, 1), "SAME").reshape(n, c, ph, pw)
+            cm = prod.mean(axis=1)               # mean over channels
+            outs.append(cm[:, ys][:, :, xs])
+    out = jnp.stack(outs, axis=1)                # [N, (2d+1)^2, H', W']
+    return {"Output": [out]}
+
+
+@register("bilateral_slice", nondiff_slots=())
+def _bilateral_slice(ctx, ins, attrs):
+    """bilateral_slice_op (HDRNet): per-pixel trilinear slice of the
+    bilateral grid at (x, y, guide) → local affine coeffs applied to X."""
+    x = ins["X"][0].astype(jnp.float32)          # [N, Ci, H, W]
+    grid = ins["Grid"][0].astype(jnp.float32)    # [N, Cf, GD, GH, GW]
+    guide = ins["Guide"][0].astype(jnp.float32)  # [N, H, W]
+    has_offset = bool(attrs.get("has_offset", False))
+    n, ci, h, w = x.shape
+    cf, gd, gh, gw = grid.shape[1:]
+    co = cf // (ci + 1) if has_offset else cf // ci
+
+    gx = (jnp.arange(w, dtype=jnp.float32) + 0.5) / w * gw - 0.5
+    gy = (jnp.arange(h, dtype=jnp.float32) + 0.5) / h * gh - 0.5
+    gz = guide * gd - 0.5                        # [N, H, W]
+
+    def tri(gridn, gzn):
+        # gather 8 corners; clamp to edges (reference diff_abs weighting
+        # reduces to hat-function trilinear for in-range samples)
+        x0 = jnp.clip(jnp.floor(gx).astype(jnp.int32), 0, gw - 1)
+        x1 = jnp.clip(x0 + 1, 0, gw - 1)
+        y0 = jnp.clip(jnp.floor(gy).astype(jnp.int32), 0, gh - 1)
+        y1 = jnp.clip(y0 + 1, 0, gh - 1)
+        z0 = jnp.clip(jnp.floor(gzn).astype(jnp.int32), 0, gd - 1)
+        z1 = jnp.clip(z0 + 1, 0, gd - 1)
+        fx = jnp.clip(gx - x0, 0.0, 1.0)[None, :]          # [1, W]
+        fy = jnp.clip(gy - y0, 0.0, 1.0)[:, None]          # [H, 1]
+        fz = jnp.clip(gzn - z0, 0.0, 1.0)                  # [H, W]
+        out = 0.0
+        for zi, wz in ((z0, 1.0 - fz), (z1, fz)):
+            for yi, wy in ((y0, 1.0 - fy), (y1, fy)):
+                for xi, wx in ((x0, 1.0 - fx), (x1, fx)):
+                    # zi is per-pixel [H, W]; yi/xi broadcast to it
+                    g = gridn[:, zi, yi[:, None], xi[None, :]]  # [Cf, H, W]
+                    out = out + g * (wz * wy * wx)[None]
+        return out                               # [Cf, H, W]
+
+    def one(xn, gridn, gzn):
+        coeff = tri(gridn, gzn)
+        if has_offset:
+            cc = coeff.reshape(co, ci + 1, h, w)
+            return jnp.einsum("oihw,ihw->ohw", cc[:, :ci], xn) + cc[:, ci]
+        cc = coeff.reshape(co, ci, h, w)
+        return jnp.einsum("oihw,ihw->ohw", cc, xn)
+
+    out = jax.vmap(one)(x, grid, gz)
+    return {"Out": [out]}
+
+
+@register("deformable_psroi_pooling", nondiff_slots=("ROIs", "RoisNum"))
+def _deformable_psroi_pooling(ctx, ins, attrs):
+    """deformable_psroi_pooling_op.h: position-sensitive ROI pooling whose
+    bins shift by learned normalized offsets (Trans); each bin averages
+    sample_per_part² bilinear samples."""
+    x = ins["Input"][0].astype(jnp.float32)      # [N, C, H, W]
+    rois = ins["ROIs"][0]                        # [R, 4]
+    trans = ins.get("Trans", [None])[0]          # [R, 2, PH, PW]
+    no_trans = bool(attrs.get("no_trans", trans is None))
+    ss = float(attrs.get("spatial_scale", 1.0))
+    out_dim = int(attrs.get("output_dim", 1))
+    group = attrs.get("group_size", [1, 1])
+    gh, gw = (int(group[0]), int(group[1])) if hasattr(group, "__len__") \
+        else (int(group), int(group))
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    part = attrs.get("part_size", [ph, pw])
+    part_h, part_w = (int(part[0]), int(part[1])) if hasattr(
+        part, "__len__") and len(part) else (ph, pw)
+    spp_ = max(int(attrs.get("sample_per_part", 1)), 1)
+    tstd = float(attrs.get("trans_std", 0.1))
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    from .tail_ops import _roi_batch_index
+    bids = _roi_batch_index(ins, r, n)
+
+    x1 = rois[:, 0] * ss - 0.5
+    y1 = rois[:, 1] * ss - 0.5
+    x2 = (rois[:, 2] + 1.0) * ss - 0.5
+    y2 = (rois[:, 3] + 1.0) * ss - 0.5
+    rw = jnp.maximum(x2 - x1, 0.1)
+    rh = jnp.maximum(y2 - y1, 0.1)
+    bin_w = rw / pw
+    bin_h = rh / ph
+
+    out = jnp.zeros((r, out_dim, ph, pw), jnp.float32)
+    cnt = jnp.zeros((r, out_dim, ph, pw), jnp.float32)
+    for i in range(ph):
+        for j in range(pw):
+            pint_h = min(i * part_h // ph, part_h - 1)
+            pint_w = min(j * part_w // pw, part_w - 1)
+            if no_trans or trans is None:
+                off_x = jnp.zeros((r,))
+                off_y = jnp.zeros((r,))
+            else:
+                off_x = trans[:, 0, pint_h, pint_w] * tstd * rw
+                off_y = trans[:, 1, pint_h, pint_w] * tstd * rh
+            acc = 0.0
+            ok_cnt = 0.0
+            for iy in range(spp_):
+                for ix in range(spp_):
+                    sx = x1 + j * bin_w + (ix + 0.5) * bin_w / spp_ + off_x
+                    sy = y1 + i * bin_h + (iy + 0.5) * bin_h / spp_ + off_y
+                    inb = (sx > -0.5) & (sx < w - 0.5) & \
+                        (sy > -0.5) & (sy < h - 0.5)
+                    cx = jnp.clip(sx, 0.0, w - 1.0)
+                    cy = jnp.clip(sy, 0.0, h - 1.0)
+                    x0 = jnp.floor(cx).astype(jnp.int32)
+                    y0 = jnp.floor(cy).astype(jnp.int32)
+                    xp = jnp.clip(x0 + 1, 0, w - 1)
+                    yp = jnp.clip(y0 + 1, 0, h - 1)
+                    lx = cx - x0
+                    ly = cy - y0
+                    # position-sensitive channel block for this bin
+                    gi = min(i * gh // ph, gh - 1)
+                    gj = min(j * gw // pw, gw - 1)
+                    cbase = (jnp.arange(out_dim) * gh + gi) * gw + gj
+                    feat = x[bids[:, None], cbase[None, :]]  # [R, O, H, W]
+                    ri = jnp.arange(r)[:, None]
+                    oi = jnp.arange(out_dim)[None, :]
+                    v = (feat[ri, oi, y0[:, None], x0[:, None]]
+                         * ((1 - ly) * (1 - lx))[:, None]
+                         + feat[ri, oi, y0[:, None], xp[:, None]]
+                         * ((1 - ly) * lx)[:, None]
+                         + feat[ri, oi, yp[:, None], x0[:, None]]
+                         * (ly * (1 - lx))[:, None]
+                         + feat[ri, oi, yp[:, None], xp[:, None]]
+                         * (ly * lx)[:, None])
+                    acc = acc + jnp.where(inb[:, None], v, 0.0)
+                    ok_cnt = ok_cnt + inb.astype(jnp.float32)[:, None]
+            out = out.at[:, :, i, j].set(acc / jnp.maximum(ok_cnt, 1.0))
+            cnt = cnt.at[:, :, i, j].set(ok_cnt)
+    return {"Output": [out], "TopCount": [cnt]}
+
+
+# ---------------------------------------------------------------------------
+# TDM (tree-based deep match, CTR retrieval)
+# ---------------------------------------------------------------------------
+
+@register("tdm_child", nondiff_slots=("X", "TreeInfo"))
+def _tdm_child(ctx, ins, attrs):
+    """tdm_child_op.h: TreeInfo rows are [item_id, layer_id, ancestor_id,
+    child_0..child_{child_nums-1}]; node 0 / childless nodes emit zeros.
+    LeafMask marks children that are items (item_id != 0)."""
+    x = ins["X"][0].astype(jnp.int32)
+    info = ins["TreeInfo"][0].astype(jnp.int32)   # [nodes, 3+child_nums]
+    child_nums = int(attrs.get("child_nums", 1))
+    shp = x.shape
+    flat = x.reshape(-1)
+    has_child = (flat != 0) & (info[flat, 3] != 0)
+    children = info[flat, 3:3 + child_nums]       # [M, child_nums]
+    children = jnp.where(has_child[:, None], children, 0)
+    leaf = (info[children.reshape(-1), 0] != 0).astype(jnp.int32) \
+        .reshape(children.shape)
+    leaf = jnp.where(has_child[:, None], leaf, 0)
+    return {"Child": [children.reshape(shp + (child_nums,))],
+            "LeafMask": [leaf.reshape(shp + (child_nums,))]}
+
+
+@register("tdm_sampler", is_random=True,
+          nondiff_slots=("X", "Travel", "Layer"))
+def _tdm_sampler(ctx, ins, attrs):
+    """tdm_sampler_op.h: per input item, per tree layer — the positive node
+    from its Travel path plus `neg_num` negatives drawn from that Layer's
+    node list (excluding the positive). Outputs per layer concatenate
+    [pos?, negs] with labels 1/0 and a mask that zeroes padded travel
+    entries (short paths)."""
+    x = ins["X"][0].astype(jnp.int32).reshape(-1)     # [N]
+    travel = ins["Travel"][0].astype(jnp.int32)       # [items, L]
+    layer = ins["Layer"][0].astype(jnp.int32).reshape(-1)  # flat node list
+    neg_nums = [int(v) for v in attrs.get("neg_samples_num_list", [1])]
+    offsets = [int(v) for v in attrs.get("layer_offset_lod",
+                                         [0, layer.shape[0]])]
+    out_pos = bool(attrs.get("output_positive", True))
+    n = x.shape[0]
+    key = ctx.op_key(attrs)
+    outs, labels, masks = [], [], []
+    path = travel[x]                                   # [N, L]
+    for li, neg in enumerate(neg_nums):
+        lo, hi = offsets[li], offsets[li + 1]
+        width = max(hi - lo, 1)
+        pos = path[:, li]                              # [N]
+        alive = pos != 0
+        k = jax.random.fold_in(key, li)
+        # draw with replacement then re-draw collisions with the positive
+        # by shifting one slot (cheap rejection good enough for k << width)
+        draw = jax.random.randint(k, (n, neg), 0, width)
+        draw = jnp.where(layer[lo + draw] == pos[:, None],
+                         (draw + 1) % width, draw)
+        negs = layer[lo + draw]
+        if out_pos:
+            o = jnp.concatenate([pos[:, None], negs], axis=1)
+            lab = jnp.concatenate(
+                [jnp.ones((n, 1), jnp.int32),
+                 jnp.zeros((n, neg), jnp.int32)], axis=1)
+        else:
+            o = negs
+            lab = jnp.zeros((n, neg), jnp.int32)
+        o = jnp.where(alive[:, None], o, 0)
+        lab = jnp.where(alive[:, None], lab, 0)
+        outs.append(o)
+        labels.append(lab)
+        masks.append(jnp.broadcast_to(alive[:, None].astype(jnp.int32),
+                                      o.shape))
+    return {"Out": [jnp.concatenate(outs, axis=1)[..., None]],
+            "Labels": [jnp.concatenate(labels, axis=1)[..., None]],
+            "Mask": [jnp.concatenate(masks, axis=1)[..., None]]}
+
+
+# ---------------------------------------------------------------------------
+# text-matching CTR ops
+# ---------------------------------------------------------------------------
+
+def _fnv1a(tokens):
+    """Deterministic rolling FNV-1a over int32 tokens along the last dim."""
+    h = jnp.full(tokens.shape[:-1], 0x811C9DC5, jnp.uint32)
+    for i in range(tokens.shape[-1]):
+        h = (h ^ tokens[..., i].astype(jnp.uint32)) * jnp.uint32(0x01000193)
+    return h
+
+
+@register("pyramid_hash", is_random=True, nondiff_slots=("X",))
+def _pyramid_hash(ctx, ins, attrs):
+    """pyramid_hash_op.cc re-designed for padded-dense input: every n-gram
+    window of length 2..pyramid_layer hashes (deterministic FNV-1a, the
+    xxhash stand-in) into W's space_len rows; a window's embedding is the
+    W row scaled by 1/sqrt(len); Out pools (sums) the live windows per
+    sequence. White/black-list filtering (bloom filters over a host dict)
+    is host-side data prep here — the attrs remain accepted with len 0."""
+    x = ins["X"][0].astype(jnp.int32)              # [B, T]
+    if x.ndim == 1:
+        x = x[None]
+    w = ins["W"][0]                                # [space_len, num_emb]
+    lens = ins.get("SeqLen", [None])[0]
+    num_emb = int(attrs.get("num_emb", w.shape[-1]))
+    space = int(attrs.get("space_len", w.shape[0]))
+    pyramid = max(int(attrs.get("pyramid_layer", 2)), 2)
+    drop = float(attrs.get("drop_out_percent", 0.0))
+    training = bool(attrs.get("is_training", 0))
+    b, t = x.shape
+    lens = (jnp.full((b,), t, jnp.int32) if lens is None
+            else lens.reshape(-1).astype(jnp.int32))
+    out = jnp.zeros((b, num_emb), jnp.float32)
+    key = ctx.op_key(attrs)
+    for l in range(2, pyramid + 1):
+        if t < l:
+            break
+        windows = jnp.stack([x[:, i:t - l + 1 + i] for i in range(l)],
+                            axis=-1)               # [B, T-l+1, l]
+        hidx = (_fnv1a(windows) % jnp.uint32(space)).astype(jnp.int32)
+        emb = w[hidx] / np.sqrt(l)                 # [B, T-l+1, E]
+        live = (jnp.arange(t - l + 1)[None, :] + l) <= lens[:, None]
+        if training and drop > 0.0:
+            k = jax.random.fold_in(key, l)
+            live = live & (jax.random.uniform(k, live.shape) >= drop)
+        out = out + jnp.sum(jnp.where(live[..., None], emb, 0.0), axis=1)
+    return {"Out": [out]}
+
+
+@register("var_conv_2d", nondiff_slots=("ROW", "COLUMN"))
+def _var_conv_2d(ctx, ins, attrs):
+    """var_conv_2d_op.cc: per-sample variable-size 2-D conv. Padded-dense
+    form: X [B, C, H, W] with per-sample (ROW, COLUMN) sizes; one batched
+    conv over the padded maps, then positions outside a sample's own
+    ceil(row/stride)×ceil(col/stride) window are zeroed — live-region
+    numerics match the reference's per-sample im2col exactly."""
+    x = ins["X"][0].astype(jnp.float32)            # [B, C, H, W]
+    rows = ins["ROW"][0].reshape(-1).astype(jnp.int32)
+    cols = ins["COLUMN"][0].reshape(-1).astype(jnp.int32)
+    w = ins["W"][0].astype(jnp.float32)            # [OutC, InC*KH*KW]
+    ic = int(attrs.get("InputChannel", x.shape[1]))
+    oc = int(attrs.get("OutputChannel", 1))
+    kh = int(attrs.get("KernelH", 1))
+    kw = int(attrs.get("KernelW", 1))
+    sh = int(attrs.get("StrideH", 1))
+    sw = int(attrs.get("StrideW", 1))
+    kern = w.reshape(oc, ic, kh, kw)
+    out = jax.lax.conv_general_dilated(
+        x, kern, (sh, sw), [(kh // 2, kh // 2), (kw // 2, kw // 2)])
+    oh, ow = out.shape[2], out.shape[3]
+    live_h = -(-rows // sh)                        # ceil(row/stride)
+    live_w = -(-cols // sw)
+    mh = jnp.arange(oh)[None, :] < live_h[:, None]
+    mw = jnp.arange(ow)[None, :] < live_w[:, None]
+    mask = (mh[:, None, :, None] & mw[:, None, None, :])
+    return {"Out": [jnp.where(mask, out, 0.0)], "Col": [None]}
+
+
+@register("rank_attention", nondiff_slots=("RankOffset",))
+def _rank_attention(ctx, ins, attrs):
+    """rank_attention_op (rank_attention.cu.h): per instance with rank
+    `lower`, gather the co-ranked instances' features (RankOffset columns
+    2k+2 give their row indices) into InputHelp [N, K·D], gather the
+    (lower, faster) rank-pair parameter blocks [K·D, P], and matmul.
+    Invalid pairs (rank 0) contribute zeros."""
+    x = ins["X"][0].astype(jnp.float32)            # [N, D]
+    ro = ins["RankOffset"][0].astype(jnp.int32)    # [N, 1+2K]
+    param = ins["RankParam"][0].astype(jnp.float32)
+    max_rank = int(attrs.get("MaxRank", 3))
+    n, d = x.shape
+    p = param.shape[-1]
+    # param rows: [(lower*K+faster), D, P]
+    pview = param.reshape(-1, d, p)
+    lower = ro[:, 0] - 1                           # [N]
+    faster = ro[:, 1 + 2 * jnp.arange(max_rank)] - 1    # [N, K]
+    index = ro[:, 2 + 2 * jnp.arange(max_rank)]         # [N, K]
+    valid = (lower[:, None] >= 0) & (faster >= 0)
+    xk = jnp.where(valid[..., None], x[jnp.maximum(index, 0)], 0.0)
+    start = jnp.maximum(lower[:, None] * max_rank + faster, 0)
+    blocks = jnp.where(valid[..., None, None], pview[start], 0.0)
+    out = jnp.einsum("nkd,nkdp->np", xk, blocks)
+    return {"Out": [out],
+            "InputHelp": [xk.reshape(n, max_rank * d)],
+            "InsRank": [ro[:, :1].astype(jnp.float32)]}
+
+
+# ---------------------------------------------------------------------------
+# detection mAP evaluator
+# ---------------------------------------------------------------------------
+
+@register("detection_map",
+          nondiff_slots=("DetectRes", "Label", "HasState", "PosCount",
+                         "TruePos", "FalsePos"))
+def _detection_map(ctx, ins, attrs):
+    """detection_map_op.cc: the mAP evaluator. Static redesign of its LoD
+    states: DetectRes [B, K, 6] (label, score, x1..y2; label<0 = pad),
+    Label [B, G, 6] (label, difficult, x1..y2; zero-area = pad). The
+    accumulation states are fixed-capacity per-class score lists —
+    AccumPosCount [C], AccumTruePos/AccumFalsePos [C, Q, 2] (score, flag)
+    with live entries flagged in column 1 via flag >= 0."""
+    det = ins["DetectRes"][0]
+    gt = ins["Label"][0]
+    if det.ndim == 2:
+        det = det[None]
+    if gt.ndim == 2:
+        gt = gt[None]
+    c = int(attrs.get("class_num", 2))
+    ov_t = float(attrs.get("overlap_threshold", 0.5))
+    eval_diff = bool(attrs.get("evaluate_difficult", True))
+    ap_type = attrs.get("ap_type", "integral")
+    b, k = det.shape[:2]
+    g = gt.shape[1]
+    q = b * k
+
+    # previous accumulation (optional)
+    prev_pos = ins.get("PosCount", [None])[0]
+    prev_tp = ins.get("TruePos", [None])[0]
+    prev_fp = ins.get("FalsePos", [None])[0]
+
+    lab_d = det[..., 0].astype(jnp.int32)          # [B, K]
+    score = det[..., 1]
+    box_d = det[..., 2:6]
+    lab_g = gt[..., 0].astype(jnp.int32)           # [B, G]
+    diff_g = gt[..., 1] > 0
+    box_g = gt[..., 2:6]
+    area = (box_g[..., 2] - box_g[..., 0]) * (box_g[..., 3] - box_g[..., 1])
+    valid_g = area > 0
+    count_g = valid_g if eval_diff else (valid_g & ~diff_g)
+
+    def iou(b1, b2):
+        x1 = jnp.maximum(b1[..., 0], b2[..., 0])
+        y1 = jnp.maximum(b1[..., 1], b2[..., 1])
+        x2 = jnp.minimum(b1[..., 2], b2[..., 2])
+        y2 = jnp.minimum(b1[..., 3], b2[..., 3])
+        inter = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+        a1 = (b1[..., 2] - b1[..., 0]) * (b1[..., 3] - b1[..., 1])
+        a2 = (b2[..., 2] - b2[..., 0]) * (b2[..., 3] - b2[..., 1])
+        return inter / jnp.maximum(a1 + a2 - inter, 1e-10)
+
+    tp_all = jnp.full((c, q, 2), -1.0)
+    fp_all = jnp.full((c, q, 2), -1.0)
+    pos_all = jnp.zeros((c,), jnp.float32)
+    for cls in range(c):
+        pos_all = pos_all.at[cls].set(
+            jnp.sum((count_g & (lab_g == cls)).astype(jnp.float32)))
+        recs = []
+        for bi in range(b):
+            sel = lab_d[bi] == cls
+            ious = iou(box_d[bi][:, None, :], box_g[bi][None, :, :])
+            ious = jnp.where((lab_g[bi] == cls)[None, :]
+                             & valid_g[bi][None, :], ious, -1.0)
+            # greedy match in score order within the image
+            order = jnp.argsort(-jnp.where(sel, score[bi], -jnp.inf))
+
+            def match_step(taken, t):
+                di = order[t]
+                best = jnp.argmax(jnp.where(taken, -1.0, ious[di]))
+                ok = (ious[di][best] >= ov_t) & sel[di] & ~taken[best]
+                is_diff = diff_g[bi][best] & ok
+                taken = taken.at[best].set(taken[best] | ok)
+                # difficult matches are neither tp nor fp when excluded
+                tp = ok & (eval_diff | ~is_diff)
+                fp = sel[di] & ~ok
+                return taken, (di, tp, fp)
+
+            _, (dis, tps, fps) = jax.lax.scan(
+                match_step, jnp.zeros((g,), bool), jnp.arange(k))
+            recs.append((score[bi][dis], sel[dis], tps, fps))
+        sc = jnp.concatenate([r[0] for r in recs])
+        live = jnp.concatenate([r[1] for r in recs])
+        tpf = jnp.concatenate([r[2] for r in recs])
+        fpf = jnp.concatenate([r[3] for r in recs])
+        tp_all = tp_all.at[cls, :, 0].set(sc)
+        tp_all = tp_all.at[cls, :, 1].set(
+            jnp.where(live, tpf.astype(jnp.float32), -1.0))
+        fp_all = fp_all.at[cls, :, 0].set(sc)
+        fp_all = fp_all.at[cls, :, 1].set(
+            jnp.where(live, fpf.astype(jnp.float32), -1.0))
+
+    if prev_pos is not None:
+        pos_all = pos_all + prev_pos.reshape(-1)[:c]
+    if prev_tp is not None:
+        tp_all = jnp.concatenate([prev_tp, tp_all], axis=1)
+        fp_all = jnp.concatenate([prev_fp, fp_all], axis=1)
+
+    # AP per class over the accumulated lists
+    aps = []
+    has_cls = []
+    for cls in range(c):
+        sc = tp_all[cls, :, 0]
+        tpv = tp_all[cls, :, 1]
+        fpv = fp_all[cls, :, 1]
+        live = tpv >= 0
+        order = jnp.argsort(jnp.where(live, -sc, jnp.inf))
+        tps = jnp.where(live[order], tpv[order], 0.0)
+        fps = jnp.where(live[order], fpv[order], 0.0)
+        ctp = jnp.cumsum(tps)
+        cfp = jnp.cumsum(fps)
+        npos = jnp.maximum(pos_all[cls], 1e-10)
+        recall = ctp / npos
+        precision = ctp / jnp.maximum(ctp + cfp, 1e-10)
+        mask = live[order]
+        if ap_type == "11point":
+            pts = []
+            for tpoint in np.linspace(0, 1, 11):
+                pmax = jnp.max(jnp.where(mask & (recall >= tpoint),
+                                         precision, 0.0))
+                pts.append(pmax)
+            ap = jnp.mean(jnp.stack(pts))
+        else:
+            prev_r = jnp.concatenate([jnp.zeros((1,)), recall[:-1]])
+            ap = jnp.sum(jnp.where(mask, (recall - prev_r) * precision,
+                                   0.0))
+        aps.append(ap)
+        has_cls.append(pos_all[cls] > 0)
+    aps = jnp.stack(aps)
+    has = jnp.stack(has_cls).astype(jnp.float32)
+    m_ap = jnp.sum(aps * has) / jnp.maximum(jnp.sum(has), 1.0)
+    return {"MAP": [m_ap.reshape(1)],
+            "AccumPosCount": [pos_all],
+            "AccumTruePos": [tp_all],
+            "AccumFalsePos": [fp_all]}

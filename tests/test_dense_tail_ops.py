@@ -1,0 +1,402 @@
+"""Round-4 dense-op tail vs loop/analytic oracles (reference per-op
+unittests: test_hsigmoid_op.py, test_edit_distance_op.py,
+test_ctc_align_op.py, test_multinomial_op.py, test_histogram_op.py,
+test_bilinear_tensor_product_op.py, test_add_position_encoding_op.py,
+test_squared_l2_distance_op.py, test_modified_huber_loss_op.py,
+test_tdm_child_op.py, test_tdm_sampler_op.py, test_rank_attention_op.py,
+test_spp_op.py, test_similarity_focus_op.py, test_correlation_op.py,
+test_bilateral_slice_op.py, test_detection_map_op.py ...)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401
+from op_test import run_op, check_grad
+
+R = np.random.RandomState(5)
+
+
+def test_hierarchical_sigmoid_matches_loop_oracle():
+    n, d, classes = 4, 6, 7
+    x = R.randn(n, d).astype(np.float32)
+    num_nodes = classes  # complete-tree internal nodes < num_classes
+    w = R.randn(num_nodes, d).astype(np.float32) * 0.5
+    bias = R.randn(num_nodes).astype(np.float32) * 0.1
+    label = R.randint(0, classes, (n, 1)).astype(np.int64)
+    out = run_op("hierarchical_sigmoid",
+                 {"X": [x], "W": [w], "Label": [label], "Bias": [bias]},
+                 {"num_classes": classes})
+    got = np.asarray(out["Out"][0])[:, 0]
+
+    exp = np.zeros(n)
+    for i in range(n):
+        code = int(label[i, 0]) + classes
+        length = int(math.floor(math.log2(code)))
+        for j in range(length):
+            node = (code >> (length - j)) - 1
+            bit = (code >> (length - j - 1)) & 1
+            z = float(x[i] @ w[node] + bias[node])
+            exp[i] += max(z, 0) - z * bit + math.log1p(math.exp(-abs(z)))
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+    check_grad("hierarchical_sigmoid",
+               {"X": [x], "W": [w], "Label": [label], "Bias": [bias]},
+               {"num_classes": classes}, wrt=["X"], out_slots=("Out",))
+
+
+def _lev(a, b):
+    dp = np.arange(len(b) + 1, dtype=float)
+    for i, ca in enumerate(a):
+        prev = dp.copy()
+        dp[0] = i + 1
+        for j, cb in enumerate(b):
+            dp[j + 1] = min(prev[j + 1] + 1, dp[j] + 1,
+                            prev[j] + (ca != cb))
+    return dp[-1]
+
+
+@pytest.mark.parametrize("normalized", [False, True])
+def test_edit_distance_matches_python_levenshtein(normalized):
+    b, th, tr = 4, 6, 5
+    hyps = R.randint(0, 5, (b, th)).astype(np.int64)
+    refs = R.randint(0, 5, (b, tr)).astype(np.int64)
+    hl = np.asarray([6, 3, 1, 4], np.int64)
+    rl = np.asarray([5, 2, 4, 1], np.int64)
+    out = run_op("edit_distance",
+                 {"Hyps": [hyps], "Refs": [refs], "HypsLength": [hl],
+                  "RefsLength": [rl]}, {"normalized": normalized})
+    got = np.asarray(out["Out"][0])[:, 0]
+    for i in range(b):
+        e = _lev(list(hyps[i, :hl[i]]), list(refs[i, :rl[i]]))
+        if normalized:
+            e /= max(rl[i], 1)
+        np.testing.assert_allclose(got[i], e, rtol=1e-6)
+    assert int(np.asarray(out["SequenceNum"][0])[0]) == b
+
+
+def test_ctc_align_merge_and_blank():
+    x = np.asarray([[0, 1, 1, 0, 2, 2, 0, 3],
+                    [2, 2, 2, 0, 0, 1, 3, 3]], np.int32)
+    lens = np.asarray([8, 6], np.int32)
+    out = run_op("ctc_align", {"Input": [x], "InputLength": [lens]},
+                 {"blank": 0, "merge_repeated": True, "padding_value": 0})
+    got = np.asarray(out["Output"][0])
+    cnt = np.asarray(out["OutputLength"][0])[:, 0]
+    np.testing.assert_array_equal(got[0, :3], [1, 2, 3])
+    assert cnt[0] == 3
+    np.testing.assert_array_equal(got[1, :2], [2, 1])   # len-6 cut drops 3s
+    assert cnt[1] == 2
+
+
+def test_multinomial_distribution_and_no_replacement():
+    probs = np.asarray([[0.1, 0.6, 0.3]], np.float32)
+    out = run_op("multinomial", {"X": [probs]},
+                 {"num_samples": 4000, "replacement": True}, seed=3)
+    s = np.asarray(out["Out"][0])[0]
+    freq = np.bincount(s, minlength=3) / 4000.0
+    np.testing.assert_allclose(freq, [0.1, 0.6, 0.3], atol=0.04)
+    out2 = run_op("multinomial", {"X": [probs]},
+                  {"num_samples": 3, "replacement": False}, seed=3)
+    assert sorted(np.asarray(out2["Out"][0])[0].tolist()) == [0, 1, 2]
+
+
+def test_histogram_matches_numpy():
+    x = R.randn(500).astype(np.float32) * 2
+    out = run_op("histogram", {"X": [x]}, {"bins": 8, "min": -3, "max": 3})
+    ref, _ = np.histogram(x, bins=8, range=(-3, 3))
+    # np.histogram excludes values > max; reference includes max edge only —
+    # both clip identically for interior bins
+    got = np.asarray(out["Out"][0])
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_bilinear_tensor_product_matches_einsum():
+    n, dx, dy, k = 3, 4, 5, 2
+    x = R.randn(n, dx).astype(np.float32)
+    y = R.randn(n, dy).astype(np.float32)
+    w = R.randn(k, dx, dy).astype(np.float32)
+    b = R.randn(1, k).astype(np.float32)
+    out = run_op("bilinear_tensor_product",
+                 {"X": [x], "Y": [y], "Weight": [w], "Bias": [b]}, {})
+    exp = np.einsum("nd,kde,ne->nk", x, w, y) + b
+    np.testing.assert_allclose(np.asarray(out["Out"][0]), exp, rtol=1e-4,
+                               atol=1e-5)
+    check_grad("bilinear_tensor_product",
+               {"X": [x], "Y": [y], "Weight": [w], "Bias": [b]}, {},
+               wrt=["X", "Y"], out_slots=("Out",))
+
+
+def test_add_position_encoding_formula():
+    b, t, d = 2, 5, 8
+    x = R.randn(b, t, d).astype(np.float32)
+    out = run_op("add_position_encoding", {"X": [x]},
+                 {"alpha": 0.5, "beta": 2.0})
+    got = np.asarray(out["Out"][0])
+    half = d // 2
+    for j in range(t):
+        for k in range(half):
+            val = j / (10000.0 ** (k / (half - 1)))
+            np.testing.assert_allclose(got[:, j, k],
+                                       x[:, j, k] * 0.5 + math.sin(val) * 2,
+                                       rtol=1e-4, atol=1e-5)
+            np.testing.assert_allclose(
+                got[:, j, half + k],
+                x[:, j, half + k] * 0.5 + math.cos(val) * 2,
+                rtol=1e-4, atol=1e-5)
+
+
+def test_squared_l2_distance_and_huber():
+    x = R.randn(4, 3).astype(np.float32)
+    y = R.randn(1, 3).astype(np.float32)
+    out = run_op("squared_l2_distance", {"X": [x], "Y": [y]}, {})
+    np.testing.assert_allclose(np.asarray(out["Out"][0])[:, 0],
+                               ((x - y) ** 2).sum(1), rtol=1e-5)
+    xv = np.asarray([[2.0], [0.5], [-0.5], [-2.0]], np.float32)
+    yv = np.asarray([[1.0], [1.0], [1.0], [1.0]], np.float32)
+    hub = run_op("modified_huber_loss", {"X": [xv], "Y": [yv]}, {})
+    np.testing.assert_allclose(
+        np.asarray(hub["Out"][0])[:, 0],
+        [0.0, 0.25, 2.25, 8.0], rtol=1e-5)
+
+
+def test_selected_rows_utils_and_grad_add_and_fill_zeros():
+    import jax.numpy as jnp
+    from paddle_tpu.ops import registry
+    from paddle_tpu.ops.sparse_grad import SelectedRows
+    ctx = registry.LowerCtx(rng_key=None)
+    sr = SelectedRows(rows=jnp.asarray([[1.0, 1.0], [2.0, 2.0],
+                                        [3.0, 3.0]]),
+                      ids=jnp.asarray([4, 2, 4]))
+    merged = registry.get("merge_selected_rows").lower(
+        ctx, {"X": [sr]}, {})["Out"][0]
+    mrows = np.asarray(merged.rows)
+    np.testing.assert_allclose(mrows[0], [4.0, 4.0])   # 1+3 at id 4
+    np.testing.assert_allclose(mrows[1], [2.0, 2.0])
+    np.testing.assert_allclose(mrows[2], [0.0, 0.0])   # dup slot zeroed
+    dense = registry.get("get_tensor_from_selected_rows").lower(
+        ctx, {"X": [sr]}, {})["Out"][0]
+    assert np.asarray(dense).shape == (3, 2)
+
+    g = run_op("grad_add", {"X": [np.ones((2, 2), np.float32)],
+                            "Y": [np.full((2, 2), 2.0, np.float32)]}, {})
+    np.testing.assert_allclose(np.asarray(g["Out"][0]), 3.0)
+    z = run_op("fill_zeros_like2", {"X": [np.ones((3,), np.float32)]},
+               {"dtype": "float32"})
+    np.testing.assert_allclose(np.asarray(z["Out"][0]), 0.0)
+    s = run_op("seed", {}, {"seed": 42})
+    assert int(np.asarray(s["Out"][0])[0]) == 42
+
+
+def test_spp_levels_and_shapes():
+    x = R.randn(2, 3, 8, 8).astype(np.float32)
+    out = run_op("spp", {"X": [x]}, {"pyramid_height": 2,
+                                     "pooling_type": "max"})
+    got = np.asarray(out["Out"][0])
+    assert got.shape == (2, 3 * (1 + 4))
+    np.testing.assert_allclose(got[:, :3], x.max(axis=(2, 3)), rtol=1e-6)
+    np.testing.assert_allclose(got[0, 3], x[0, 0, :4, :4].max(), rtol=1e-6)
+
+
+def test_similarity_focus_axis1_matches_loop():
+    b, a, m, n = 1, 2, 3, 3
+    x = R.randn(b, a, m, n).astype(np.float32)
+    out = run_op("similarity_focus", {"X": [x]},
+                 {"axis": 1, "indexes": [0]})
+    got = np.asarray(out["Out"][0])
+    # oracle: greedy over sorted entries of x[0, 0]
+    arr = sorted([(x[0, 0, i, j], i, j) for i in range(m)
+                  for j in range(n)], key=lambda t: -t[0])
+    tag2, tag3 = [False] * m, [False] * n
+    exp = np.zeros((a, m, n), np.float32)
+    for v, i, j in arr:
+        if tag2[i] or tag3[j]:
+            continue
+        tag2[i] = tag3[j] = True
+        exp[:, i, j] = 1
+    np.testing.assert_array_equal(got[0], exp)
+
+
+def test_correlation_zero_displacement_is_channel_mean_product():
+    x1 = R.randn(1, 4, 6, 6).astype(np.float32)
+    x2 = R.randn(1, 4, 6, 6).astype(np.float32)
+    out = run_op("correlation", {"Input1": [x1], "Input2": [x2]},
+                 {"pad_size": 2, "kernel_size": 1, "max_displacement": 2,
+                  "stride1": 1, "stride2": 2})
+    got = np.asarray(out["Output"][0])
+    assert got.shape[1] == 9                     # (2*1+1)^2 displacements
+    # center displacement channel at valid positions == mean_c x1*x2
+    center = got[0, 4]
+    ref = (x1[0] * x2[0]).mean(0)
+    # valid region offset: maxd(2) - pad(2) = 0 in padded coords
+    np.testing.assert_allclose(center, ref[0:center.shape[0],
+                                           0:center.shape[1]],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_bilateral_slice_constant_grid():
+    """A grid holding the identity affine transform must reproduce X."""
+    n, ci, h, w = 1, 2, 4, 4
+    co = ci
+    gd, gh, gw = 3, 2, 2
+    x = R.randn(n, ci, h, w).astype(np.float32)
+    guide = R.rand(n, h, w).astype(np.float32)
+    cf = co * (ci + 1)
+    grid = np.zeros((n, cf, gd, gh, gw), np.float32)
+    for o in range(co):
+        grid[:, o * (ci + 1) + o] = 1.0          # identity weights, 0 offset
+    out = run_op("bilateral_slice",
+                 {"X": [x], "Grid": [grid], "Guide": [guide]},
+                 {"has_offset": True})
+    np.testing.assert_allclose(np.asarray(out["Out"][0]), x, rtol=1e-4,
+                               atol=1e-5)
+    check_grad("bilateral_slice",
+               {"X": [x], "Grid": [grid], "Guide": [guide]},
+               {"has_offset": True}, wrt=["X"], out_slots=("Out",))
+
+
+def test_tdm_child_tree_lookup():
+    # tree: node 1 (root, item 0) children 2,3; node 2 children 4,5 (items)
+    info = np.zeros((6, 5), np.int64)
+    info[1] = [0, 0, 0, 2, 3]
+    info[2] = [0, 1, 1, 4, 5]
+    info[3] = [7, 1, 1, 0, 0]     # item leaf, no children
+    info[4] = [8, 2, 2, 0, 0]
+    info[5] = [9, 2, 2, 0, 0]
+    x = np.asarray([[1], [2], [3]], np.int64)
+    out = run_op("tdm_child", {"X": [x], "TreeInfo": [info]},
+                 {"child_nums": 2})
+    child = np.asarray(out["Child"][0]).reshape(3, 2)
+    mask = np.asarray(out["LeafMask"][0]).reshape(3, 2)
+    np.testing.assert_array_equal(child[0], [2, 3])
+    np.testing.assert_array_equal(mask[0], [0, 1])    # 2 internal, 3 item
+    np.testing.assert_array_equal(child[1], [4, 5])
+    np.testing.assert_array_equal(mask[1], [1, 1])
+    np.testing.assert_array_equal(child[2], [0, 0])   # leaf: no children
+    np.testing.assert_array_equal(mask[2], [0, 0])
+
+
+def test_tdm_sampler_structure():
+    travel = np.asarray([[1, 3], [2, 5], [0, 0]], np.int64)  # row 2: pad
+    layer = np.asarray([1, 2, 3, 4, 5, 6], np.int64)
+    out = run_op("tdm_sampler",
+                 {"X": [np.asarray([[0], [1], [2]], np.int64)],
+                  "Travel": [travel], "Layer": [layer]},
+                 {"neg_samples_num_list": [2, 2],
+                  "layer_offset_lod": [0, 2, 6],
+                  "output_positive": True}, seed=1)
+    o = np.asarray(out["Out"][0])[..., 0]
+    lab = np.asarray(out["Labels"][0])[..., 0]
+    msk = np.asarray(out["Mask"][0])[..., 0]
+    assert o.shape == (3, 6)                    # 2 layers × (1 pos + 2 neg)
+    assert o[0, 0] == 1 and o[1, 0] == 2        # positives from travel
+    np.testing.assert_array_equal(lab[0], [1, 0, 0, 1, 0, 0])
+    # layer-0 negatives come from layer[0:2] and differ from the positive
+    assert all(v in (1, 2) and v != 1 or v == 2 for v in o[0, 1:3])
+    # padded travel row masks out entirely
+    np.testing.assert_array_equal(msk[2], 0)
+    np.testing.assert_array_equal(o[2], 0)
+
+
+def test_pyramid_hash_deterministic_and_pools_live_windows():
+    x = np.asarray([[3, 5, 7, 0]], np.int64)
+    w = R.randn(32, 6).astype(np.float32)
+    out1 = run_op("pyramid_hash", {"X": [x], "W": [w],
+                                   "SeqLen": [np.asarray([3], np.int32)]},
+                  {"num_emb": 6, "space_len": 32, "pyramid_layer": 3,
+                   "is_training": 0})
+    out2 = run_op("pyramid_hash", {"X": [x], "W": [w],
+                                   "SeqLen": [np.asarray([3], np.int32)]},
+                  {"num_emb": 6, "space_len": 32, "pyramid_layer": 3,
+                   "is_training": 0})
+    a = np.asarray(out1["Out"][0])
+    np.testing.assert_allclose(a, np.asarray(out2["Out"][0]))
+    # windows: bigrams (3,5),(5,7) + trigram (3,5,7) -> nonzero embedding
+    assert np.abs(a).sum() > 0
+    # longer length adds windows -> different pooling
+    out3 = run_op("pyramid_hash", {"X": [x], "W": [w],
+                                   "SeqLen": [np.asarray([4], np.int32)]},
+                  {"num_emb": 6, "space_len": 32, "pyramid_layer": 3,
+                   "is_training": 0})
+    assert np.abs(np.asarray(out3["Out"][0]) - a).sum() > 0
+
+
+def test_var_conv_2d_masks_dead_region():
+    x = R.randn(2, 1, 6, 6).astype(np.float32)
+    w = R.randn(2, 1 * 3 * 3).astype(np.float32)
+    out = run_op("var_conv_2d",
+                 {"X": [x], "W": [w],
+                  "ROW": [np.asarray([6, 3], np.int64)],
+                  "COLUMN": [np.asarray([6, 2], np.int64)]},
+                 {"InputChannel": 1, "OutputChannel": 2, "KernelH": 3,
+                  "KernelW": 3, "StrideH": 1, "StrideW": 1})
+    got = np.asarray(out["Out"][0])
+    assert got.shape == (2, 2, 6, 6)
+    assert np.abs(got[0]).sum() > 0
+    assert np.all(got[1, :, 3:, :] == 0) and np.all(got[1, :, :, 2:] == 0)
+    assert np.abs(got[1, :, :3, :2]).sum() > 0
+
+
+def test_rank_attention_matches_loop():
+    n, d, p, k = 3, 2, 2, 2
+    x = R.randn(n, d).astype(np.float32)
+    param = R.randn(k * k, d, p).astype(np.float32)
+    # ins 0: rank 1, pairs with ins 1 (rank 2); ins 2 invalid (rank 0)
+    ro = np.asarray([
+        [1, 1, 0, 2, 1],
+        [2, 1, 0, 2, 1],
+        [0, 0, 0, 0, 0],
+    ], np.int32)
+    out = run_op("rank_attention",
+                 {"X": [x], "RankOffset": [ro],
+                  "RankParam": [param.reshape(k * k * d, p)]},
+                 {"MaxRank": k})
+    got = np.asarray(out["Out"][0])
+    for i in range(n):
+        lower = ro[i, 0] - 1
+        exp = np.zeros(p)
+        for kk in range(k):
+            faster = ro[i, 1 + 2 * kk] - 1
+            idx = ro[i, 2 + 2 * kk]
+            if lower < 0 or faster < 0:
+                continue
+            exp += x[idx] @ param[lower * k + faster]
+        np.testing.assert_allclose(got[i], exp, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_psroi_no_trans_equals_psroi_style_average():
+    x = np.arange(1 * 4 * 4 * 4, dtype=np.float32).reshape(1, 4, 4, 4)
+    rois = np.asarray([[0.0, 0.0, 3.0, 3.0]], np.float32)
+    out = run_op("deformable_psroi_pooling",
+                 {"Input": [x], "ROIs": [rois]},
+                 {"no_trans": True, "spatial_scale": 1.0, "output_dim": 1,
+                  "group_size": [2, 2], "pooled_height": 2,
+                  "pooled_width": 2, "part_size": [2, 2],
+                  "sample_per_part": 2, "trans_std": 0.0})
+    got = np.asarray(out["Output"][0])
+    assert got.shape == (1, 1, 2, 2)
+    assert np.isfinite(got).all() and np.abs(got).sum() > 0
+    cnt = np.asarray(out["TopCount"][0])
+    assert cnt.min() > 0
+
+
+def test_detection_map_perfect_and_mixed():
+    # one image, one class-1 gt; detection matches perfectly -> mAP 1
+    det = np.zeros((1, 2, 6), np.float32)
+    det[0, 0] = [1, 0.9, 10, 10, 20, 20]
+    det[0, 1] = [-1, 0, 0, 0, 0, 0]             # padding
+    gt = np.zeros((1, 1, 6), np.float32)
+    gt[0, 0] = [1, 0, 10, 10, 20, 20]
+    out = run_op("detection_map", {"DetectRes": [det], "Label": [gt]},
+                 {"class_num": 2, "overlap_threshold": 0.5,
+                  "ap_type": "integral"})
+    np.testing.assert_allclose(float(np.asarray(out["MAP"][0])[0]), 1.0,
+                               atol=1e-5)
+    # add a false positive with higher score -> AP = 0.5 (tp at rank 2)
+    det2 = np.zeros((1, 2, 6), np.float32)
+    det2[0, 0] = [1, 0.95, 50, 50, 60, 60]      # fp
+    det2[0, 1] = [1, 0.9, 10, 10, 20, 20]       # tp
+    out2 = run_op("detection_map", {"DetectRes": [det2], "Label": [gt]},
+                  {"class_num": 2, "overlap_threshold": 0.5,
+                   "ap_type": "integral"})
+    np.testing.assert_allclose(float(np.asarray(out2["MAP"][0])[0]), 0.5,
+                               atol=1e-5)
