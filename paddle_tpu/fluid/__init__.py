@@ -45,3 +45,36 @@ from ..dataloader import DataFeeder  # noqa: E402
 
 from ..flags import get_flags, set_flags  # noqa: E402  (fluid.set_flags)
 from .. import profiler  # noqa: E402     (fluid.profiler.profiler context)
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """Compatibility shim for the reference's fluid.create_lod_tensor
+    (python/paddle/fluid/lod_tensor.py): ragged rows + one LoD level in,
+    padded-dense + lengths out — the framework-wide ragged representation
+    (docs/lod_design.md). Returns (dense [B, Tmax, ...], lengths [B]);
+    feed the pair to ops that take a lengths/`length=` input."""
+    import numpy as np
+    data = np.asarray(data)
+    assert len(recursive_seq_lens) == 1, \
+        "one LoD level (docs/lod_design.md); nest higher levels yourself"
+    lens = [int(v) for v in recursive_seq_lens[0]]
+    assert sum(lens) == data.shape[0], \
+        f"lengths {lens} do not sum to rows {data.shape[0]}"
+    b = len(lens)
+    tmax = max(lens) if lens else 0
+    dense = np.zeros((b, tmax) + data.shape[1:], data.dtype)
+    off = 0
+    for i, ln in enumerate(lens):
+        dense[i, :ln] = data[off:off + ln]
+        off += ln
+    return dense, np.asarray(lens, np.int64)
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place, low,
+                                high):
+    """Reference fluid.create_random_int_lodtensor parity (lod_tensor.py)."""
+    import numpy as np
+    total = sum(int(v) for v in recursive_seq_lens[0])
+    data = np.random.randint(low, high + 1,
+                             (total,) + tuple(base_shape)).astype(np.int64)
+    return create_lod_tensor(data, recursive_seq_lens, place)

@@ -76,14 +76,11 @@ def encoder_layer(x, cfg: BertConfig, idx: int, attn_mask=None):
         return layers.transpose(t, [0, 2, 1, 3])  # [B, nh, S, hd]
 
     q, k, v = heads(q), heads(k), heads(v)
-    if cfg.sequence_parallel and cfg.attention_dropout and idx == 0:
-        import warnings
-        warnings.warn("sequence_parallel attention does not support "
-                      "attention_dropout; running with dropout=0.0 "
-                      "(set attention_dropout=0.0 to silence)")
+    # sp and non-sp train with the SAME dropout/mask semantics (round 4:
+    # the ring/ulysses paths take key-padding masks + counter dropout)
     ctx = layers.fused_attention(
         q, k, v, mask=attn_mask, scale=1.0 / math.sqrt(hd),
-        dropout=0.0 if cfg.sequence_parallel else cfg.attention_dropout,
+        dropout=cfg.attention_dropout,
         sequence_parallel=cfg.sequence_parallel, sp_mode=cfg.sp_mode)
     ctx = layers.transpose(ctx, [0, 2, 1, 3])
     ctx = layers.reshape(ctx, [0, 0, h])

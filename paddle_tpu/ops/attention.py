@@ -71,6 +71,14 @@ def _flash_probe():
     return _flash_probe_ok
 
 
+def _derive_seed(key):
+    """Squeeze the op's run key to the int32 the counter-based dropout
+    masks hash on — ONE derivation shared by the flash and sp paths so
+    they draw identical patterns for the same op seed."""
+    return jax.random.randint(key, (), jnp.iinfo(jnp.int32).min,
+                              jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+
+
 def _mask_flashable(mask, q):
     """Additive masks the kernels take in-kernel: any shape broadcastable to
     [B, nh, S(or 1), S]. Anything else (e.g. per-example ragged objects)
@@ -115,27 +123,22 @@ def _fused_attention(ctx, ins, attrs):
         mesh = _current_mesh()
         if mesh is not None and "sp" in mesh.axis_names \
                 and mesh.shape["sp"] > 1:
-            assert mask is None and dropout == 0.0, (
-                "sequence-parallel attention supports causal/plain masks "
-                "only (no custom mask, no dropout)")
             from ..parallel.ring_attention import (ring_attention,
                                                    ulysses_attention)
             fn = (ulysses_attention
                   if attrs.get("sp_mode") == "ulysses" else ring_attention)
+            sp_seed = _derive_seed(key) if dropout else None
+            # key-padding masks + in-body counter dropout ride the ring
+            # (round 4; full [S, S] masks still raise — see _check_mask)
             return {"Out": [fn(q, k, v, mesh=mesh, scale=scale,
-                               causal=causal)]}
+                               causal=causal, mask=mask,
+                               dropout=float(dropout), seed=sp_seed)]}
     if not ctx.is_eval_shape \
             and not isinstance(q, jax.ShapeDtypeStruct) and _use_pallas(q) \
             and (mask is None or _mask_flashable(mask, q)):
         try:
             from .pallas.flash_attention import flash_attention
-            seed = None
-            if dropout:
-                # fold the op's stable seed into the run key, then squeeze to
-                # the int32 the in-kernel counter-based mask hashes on
-                seed = jax.random.randint(
-                    key, (), jnp.iinfo(jnp.int32).min,
-                    jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+            seed = _derive_seed(key) if dropout else None
             return {"Out": [flash_attention(q, k, v, scale=scale,
                                             causal=causal, dropout=dropout,
                                             seed=seed, mask=mask)]}

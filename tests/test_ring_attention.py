@@ -125,3 +125,114 @@ def test_sequence_parallel_attention_in_program():
     np.testing.assert_allclose(a, b_, rtol=2e-4, atol=2e-5)
     print("OK")
     """)
+
+
+def test_ring_and_ulysses_key_padding_mask_matches_dense():
+    _run("""
+    import jax, jax.numpy as jnp, numpy as np
+    from paddle_tpu.parallel import build_mesh, ring_attention
+    from paddle_tpu.parallel.ring_attention import ulysses_attention
+
+    mesh = build_mesh(dp=2, sp=4)
+    b, nh, s, hd = 2, 4, 32, 16
+    rng = np.random.RandomState(1)
+    q, k, v = (jnp.asarray(rng.randn(b, nh, s, hd).astype(np.float32))
+               for _ in range(3))
+    pad = np.zeros((b, 1, 1, s), np.float32)
+    pad[0, :, :, 24:] = -1e9
+    pad[1, :, :, 28:] = -1e9
+    mask = jnp.asarray(pad)
+
+    def dense(q, k, v):
+        sc = jnp.einsum("bnqd,bnkd->bnqk", q, k) / np.sqrt(hd) + mask
+        return jnp.einsum("bnqk,bnkd->bnqd", jax.nn.softmax(sc, -1), v)
+
+    want = dense(q, k, v)
+    got_r = ring_attention(q, k, v, mesh=mesh, mask=mask)
+    np.testing.assert_allclose(np.asarray(got_r), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+    got_u = ulysses_attention(q, k, v, mesh=mesh, mask=mask)
+    np.testing.assert_allclose(np.asarray(got_u), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+    # full [S, S] masks are rejected with a clear message
+    try:
+        ring_attention(q, k, v, mesh=mesh,
+                       mask=jnp.zeros((b, 1, s, s)))
+        raise SystemExit("full mask not rejected")
+    except ValueError as e:
+        assert "KEY-PADDING" in str(e)
+    print("OK")
+    """)
+
+
+def test_ring_dropout_semantics_and_determinism():
+    _run("""
+    import jax, jax.numpy as jnp, numpy as np
+    from paddle_tpu.parallel import build_mesh, ring_attention
+
+    mesh = build_mesh(dp=2, sp=4)
+    b, nh, s, hd = 2, 2, 32, 32
+    rng = np.random.RandomState(2)
+    q = jnp.asarray(rng.randn(b, nh, s, hd).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(b, nh, s, hd).astype(np.float32)) * 0.3
+    v_eye = jnp.broadcast_to(jnp.eye(s, dtype=jnp.float32), (b, nh, s, s))
+
+    rate = 0.2
+    out = ring_attention(q, k, v_eye, mesh=mesh, dropout=rate, seed=9)
+    pd = np.asarray(out)
+    probs = np.asarray(jax.nn.softmax(
+        jnp.einsum("bnqd,bnkd->bnqk", q, k) / np.sqrt(hd), -1))
+    m = pd != 0
+    assert abs((1 - m.mean()) - rate) < 0.05, "drop fraction off"
+    np.testing.assert_allclose(pd[m] / probs[m], 1 / (1 - rate), rtol=1e-4)
+    out2 = ring_attention(q, k, v_eye, mesh=mesh, dropout=rate, seed=9)
+    assert np.array_equal(pd, np.asarray(out2)), "same seed must repeat"
+    out3 = ring_attention(q, k, v_eye, mesh=mesh, dropout=rate, seed=10)
+    assert not np.array_equal(pd, np.asarray(out3))
+    # grads flow through the dropped path
+    g = jax.grad(lambda a: jnp.sum(ring_attention(
+        a, k, v_eye, mesh=mesh, dropout=rate, seed=9)))(q)
+    assert np.isfinite(np.asarray(g)).all()
+    print("OK")
+    """)
+
+
+def test_sp_program_trains_with_mask_and_dropout():
+    """The BERT sp path no longer silently zeroes attention_dropout and
+    accepts the padded-batch input mask (round-4 weak-item fix)."""
+    _run("""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import bert
+    from paddle_tpu.parallel import build_mesh
+    from paddle_tpu.testing import reset_programs
+
+    reset_programs(seed=0)
+    mesh = build_mesh(sp=4)
+    cfg = bert.BertConfig(vocab_size=256, hidden_size=32, num_layers=1,
+                          num_heads=4, intermediate_size=64,
+                          max_position=32, seq_len=32,
+                          hidden_dropout=0.0, attention_dropout=0.1,
+                          sequence_parallel=True)
+    ids, labels, loss = bert.build_pretrain_program(cfg,
+                                                    use_input_mask=True)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3)
+    opt.minimize(loss)
+    from paddle_tpu.parallel import DistConfig, attach
+    attach(fluid.default_main_program(), DistConfig(mesh=mesh))
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    B = 4
+    lens = rng.randint(16, 33, (B, 1))
+    feed = {"input_ids": rng.randint(0, 256, (B, 32)).astype(np.int64),
+            "mlm_labels": rng.randint(0, 256, (B, 32, 1)).astype(np.int64),
+            "input_mask": (np.arange(32)[None, :] < lens)
+            .astype(np.float32)}
+    c = [float(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0])
+               .reshape(-1)[0]) for _ in range(8)]
+    assert np.isfinite(c).all(), c
+    assert c[-1] < c[0], c
+    print("OK")
+    """)
