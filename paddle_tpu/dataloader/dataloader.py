@@ -20,6 +20,15 @@ import numpy as np
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
+# Worker-death detection (reference: fluid/dataloader/dataloader_iter.py
+# _set_SIGCHLD_handler + core._set_process_signal_handler). The reference
+# forks workers directly, so a SIGCHLD handler in the trainer fires when one
+# is OOM-killed. Here workers come from a FORKSERVER context (they are the
+# forkserver's children, not ours — no SIGCHLD ever reaches this process),
+# so the equivalent is a ~1s liveness poll while blocked on the data queue:
+# same contract (fail in seconds with the culprit worker + exitcode instead
+# of hanging out the 300s timeout), different mechanism.
+
 
 def default_collate_fn(batch):
     """list of samples -> stacked arrays (tuple-of-fields or single field)."""
@@ -59,6 +68,7 @@ class _MultiprocessIter:
     """
 
     _GET_TIMEOUT = 300.0
+    _POLL = 1.0  # death-check cadence while blocked on the queue
 
     def __init__(self, dataset, batches: List[List[int]], collate_fn,
                  num_workers: int):
@@ -85,23 +95,42 @@ class _MultiprocessIter:
     def __iter__(self):
         return self
 
+    def _abnormal_deaths(self):
+        """(worker_id, exitcode) for workers that died WITHOUT finishing
+        their index queue — exitcode 0 after the None sentinel is a normal
+        retirement, not a failure."""
+        return [(w, p.exitcode) for w, p in enumerate(self._workers)
+                if not p.is_alive() and p.exitcode not in (0, None)]
+
     def __next__(self):
         if self._next_seq >= self._total:
             self._join()
             raise StopIteration
+        waited = 0.0
         while self._next_seq not in self._reorder:
             try:
-                seq, batch, err = self._data_queue.get(
-                    timeout=self._GET_TIMEOUT)
+                seq, batch, err = self._data_queue.get(timeout=self._POLL)
             except queue_mod.Empty:
-                dead = [w for p, w in zip(self._workers,
-                                          range(len(self._workers)))
-                        if not p.is_alive()]
-                self._join()
-                raise RuntimeError(
-                    f"DataLoader timed out after {self._GET_TIMEOUT}s waiting "
-                    f"for batch {self._next_seq}"
-                    + (f"; dead workers: {dead}" if dead else ""))
+                waited += self._POLL
+                dead = self._abnormal_deaths()
+                if dead:
+                    # drain whatever finished batches are still queued, then
+                    # fail fast with the culprit (reference SIGCHLD path:
+                    # "DataLoader worker exits unexpectedly")
+                    self._join()
+                    raise RuntimeError(
+                        "DataLoader worker(s) died unexpectedly "
+                        + ", ".join(f"worker {w} exitcode {c}"
+                                    for w, c in dead)
+                        + f" while waiting for batch {self._next_seq} "
+                        f"(liveness poll caught it after {waited:.0f}s, "
+                        f"not the {self._GET_TIMEOUT:.0f}s queue timeout)")
+                if waited >= self._GET_TIMEOUT:
+                    self._join()
+                    raise RuntimeError(
+                        f"DataLoader timed out after {waited:.0f}s waiting "
+                        f"for batch {self._next_seq}")
+                continue
             if err is not None:
                 self._join()
                 raise RuntimeError(f"DataLoader worker failed: {err}")

@@ -114,9 +114,8 @@ class FLTrainer:
 def program_param_spec(program=None) -> Dict[str, int]:
     """name -> flattened size for every trainable parameter of a program."""
     from ..framework.program import default_main_program
-    import numpy as _np
     program = program or default_main_program()
-    return {p.name: int(_np.prod(p.shape))
+    return {p.name: int(np.prod(p.shape))
             for p in program.all_parameters() if p.trainable}
 
 

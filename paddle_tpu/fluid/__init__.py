@@ -32,6 +32,9 @@ class core:
     'native core' here is jaxlib/XLA itself."""
 
     from ..framework.scope import Scope, global_scope
+    # typed error surface (reference pybind/exception.cc:22 binds these two;
+    # the typed subclasses come from framework/errors.py)
+    from ..framework.errors import EnforceNotMet, EOFException
 
     @staticmethod
     def get_all_op_names():
@@ -43,6 +46,8 @@ from .. import dataset  # noqa: E402  (fluid.dataset.DatasetFactory)
 from ..dataloader import DataFeeder  # noqa: E402
 
 
+from ..utils.custom_op import load_op_library  # noqa: E402  (reference
+# framework.py:5549 exposes fluid.load_op_library)
 from ..flags import get_flags, set_flags  # noqa: E402  (fluid.set_flags)
 from .. import profiler  # noqa: E402     (fluid.profiler.profiler context)
 

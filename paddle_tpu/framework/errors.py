@@ -1,0 +1,172 @@
+"""Typed error-code system.
+
+Reference counterparts: paddle/fluid/platform/errors.h:82-93 (the
+REGISTER_ERROR factories over error_codes.proto), enforce.h (PADDLE_ENFORCE*
+macros building EnforceNotMet with code + context), and
+pybind/exception.cc:22 (everything surfaces in python as core.EnforceNotMet /
+core.EOFException).
+
+TPU-native shape: exceptions ARE python objects here, so instead of a
+string-only translation each code is a distinct exception class carrying
+`.code`, and each also subclasses the idiomatic python builtin (ValueError,
+IndexError, ...) so call sites and user code can catch either the paddle
+type or the natural python type. Factories mirror the reference's
+`platform::errors::InvalidArgument(fmt, ...)` spelling, and `enforce*`
+helpers mirror PADDLE_ENFORCE_EQ/GT/... with the same
+"Expected X == Y, but received ..." message style (enforce.h:1086).
+"""
+from __future__ import annotations
+
+import enum
+
+
+class ErrorCode(enum.IntEnum):
+    """Mirrors platform/error_codes.proto."""
+    LEGACY = 0
+    INVALID_ARGUMENT = 1
+    NOT_FOUND = 2
+    OUT_OF_RANGE = 3
+    ALREADY_EXISTS = 4
+    RESOURCE_EXHAUSTED = 5
+    PRECONDITION_NOT_MET = 6
+    PERMISSION_DENIED = 7
+    EXECUTION_TIMEOUT = 8
+    UNIMPLEMENTED = 9
+    UNAVAILABLE = 10
+    FATAL = 11
+    EXTERNAL = 12
+
+
+class EnforceNotMet(Exception):
+    """Base paddle error (pybind/exception.cc:22). `.code` is the
+    ErrorCode; `.op` / `.var` name the op/variable being processed when the
+    raising site knows them (the reference appends the same context via
+    exception_holder / op callstack attachment)."""
+    code = ErrorCode.LEGACY
+
+    def __init__(self, message: str, *, op: str | None = None,
+                 var: str | None = None):
+        self.op, self.var = op, var
+        ctx = []
+        if op:
+            ctx.append(f"[operator < {op} > error]")
+        if var:
+            ctx.append(f"[variable < {var} >]")
+        full = " ".join([message] + ctx) if ctx else message
+        self.message = full
+        super().__init__(full)
+
+
+class EOFException(Exception):
+    """Raised by readers/data feeds on exhaustion (enforce.h EOFException;
+    the reference's pyreader protocol catches core.EOFException)."""
+
+
+def _typed(name, code_, base):
+    cls = type(name, (EnforceNotMet, base),
+               {"code": code_, "__doc__":
+                f"ErrorCode.{code_.name} (errors.h REGISTER_ERROR)."})
+    return cls
+
+
+InvalidArgumentError = _typed("InvalidArgumentError",
+                              ErrorCode.INVALID_ARGUMENT, ValueError)
+NotFoundError = _typed("NotFoundError", ErrorCode.NOT_FOUND, KeyError)
+OutOfRangeError = _typed("OutOfRangeError", ErrorCode.OUT_OF_RANGE,
+                         IndexError)
+AlreadyExistsError = _typed("AlreadyExistsError", ErrorCode.ALREADY_EXISTS,
+                            ValueError)
+ResourceExhaustedError = _typed("ResourceExhaustedError",
+                                ErrorCode.RESOURCE_EXHAUSTED, MemoryError)
+PreconditionNotMetError = _typed("PreconditionNotMetError",
+                                 ErrorCode.PRECONDITION_NOT_MET, RuntimeError)
+PermissionDeniedError = _typed("PermissionDeniedError",
+                               ErrorCode.PERMISSION_DENIED, PermissionError)
+ExecutionTimeoutError = _typed("ExecutionTimeoutError",
+                               ErrorCode.EXECUTION_TIMEOUT, TimeoutError)
+UnimplementedError = _typed("UnimplementedError", ErrorCode.UNIMPLEMENTED,
+                            NotImplementedError)
+UnavailableError = _typed("UnavailableError", ErrorCode.UNAVAILABLE,
+                          RuntimeError)
+FatalError = _typed("FatalError", ErrorCode.FATAL, SystemError)
+ExternalError = _typed("ExternalError", ErrorCode.EXTERNAL, OSError)
+
+_BY_CODE = {c.code: c for c in (
+    InvalidArgumentError, NotFoundError, OutOfRangeError, AlreadyExistsError,
+    ResourceExhaustedError, PreconditionNotMetError, PermissionDeniedError,
+    ExecutionTimeoutError, UnimplementedError, UnavailableError, FatalError,
+    ExternalError)}
+
+
+def error_class(code: ErrorCode):
+    return _BY_CODE.get(ErrorCode(code), EnforceNotMet)
+
+
+def _factory(cls):
+    def make(fmt, *args, op=None, var=None):
+        return cls(fmt % args if args else fmt, op=op, var=var)
+    make.__name__ = cls.code.name.title().replace("_", "")
+    make.__doc__ = (f"platform::errors::{make.__name__} — build (not raise) "
+                    f"a {cls.__name__}.")
+    return make
+
+
+# The reference's factory spellings (errors.h REGISTER_ERROR): build an
+# exception object to pass to `enforce(cond, err)` or raise directly.
+InvalidArgument = _factory(InvalidArgumentError)
+NotFound = _factory(NotFoundError)
+OutOfRange = _factory(OutOfRangeError)
+AlreadyExists = _factory(AlreadyExistsError)
+ResourceExhausted = _factory(ResourceExhaustedError)
+PreconditionNotMet = _factory(PreconditionNotMetError)
+PermissionDenied = _factory(PermissionDeniedError)
+ExecutionTimeout = _factory(ExecutionTimeoutError)
+Unimplemented = _factory(UnimplementedError)
+Unavailable = _factory(UnavailableError)
+Fatal = _factory(FatalError)
+External = _factory(ExternalError)
+
+
+def enforce(cond, err_or_msg="enforce failed"):
+    """PADDLE_ENFORCE: raise if `cond` is falsy. `err_or_msg` may be a
+    prebuilt exception (from a factory above) or a message string
+    (→ PreconditionNotMet, the reference's default severity)."""
+    if cond:
+        return
+    if isinstance(err_or_msg, BaseException):
+        raise err_or_msg
+    raise PreconditionNotMetError(str(err_or_msg))
+
+
+def _cmp_enforce(opname, pyop):
+    def check(a, b, msg=None, *, op=None, var=None):
+        if pyop(a, b):
+            return
+        detail = (f"Expected {a!r} {opname} {b!r}, but received "
+                  f"{a!r} {_NEG[opname]} {b!r}.")
+        if msg:
+            detail = f"{msg} {detail}"
+        raise InvalidArgumentError(detail, op=op, var=var)
+    check.__name__ = f"enforce_{_SUFFIX[opname]}"
+    check.__doc__ = f"PADDLE_ENFORCE_{_SUFFIX[opname].upper()} (enforce.h)."
+    return check
+
+
+_NEG = {"==": "!=", "!=": "==", ">": "<=", ">=": "<", "<": ">=", "<=": ">"}
+_SUFFIX = {"==": "eq", "!=": "ne", ">": "gt", ">=": "ge", "<": "lt",
+           "<=": "le"}
+
+enforce_eq = _cmp_enforce("==", lambda a, b: a == b)
+enforce_ne = _cmp_enforce("!=", lambda a, b: a != b)
+enforce_gt = _cmp_enforce(">", lambda a, b: a > b)
+enforce_ge = _cmp_enforce(">=", lambda a, b: a >= b)
+enforce_lt = _cmp_enforce("<", lambda a, b: a < b)
+enforce_le = _cmp_enforce("<=", lambda a, b: a <= b)
+
+
+def enforce_not_none(value, msg="expected a non-None value", *, op=None,
+                     var=None):
+    """PADDLE_ENFORCE_NOT_NULL."""
+    if value is None:
+        raise NotFoundError(msg, op=op, var=var)
+    return value

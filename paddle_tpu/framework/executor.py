@@ -456,8 +456,10 @@ class Executor:
         gb = program.global_block()
         for n in fetch_names:
             if not gb.has_var(n):
-                raise ValueError(
-                    f"fetch target {n!r} is not a variable of this program")
+                from . import errors
+                raise errors.NotFound(
+                    "fetch target %r is not a variable of this program", n,
+                    var=n)
 
         # parameter-server hooks (distributed_embedding): pull sparse rows
         # before the step, push their grads after (distributed/ps.py)
@@ -490,7 +492,8 @@ class Executor:
                             else np.iinfo(np.uint32))
                     if arr.size and (arr.max() > info.max
                                      or arr.min() < info.min):
-                        raise ValueError(
+                        from .errors import InvalidArgumentError
+                        raise InvalidArgumentError(
                             f"feed {name!r} holds {want.name} ids outside "
                             f"{info.dtype.name} range; device tensors are "
                             f"32-bit (see framework/dtype.py). Route "
@@ -521,6 +524,13 @@ class Executor:
         compiled = self._cache.get(key) if use_program_cache else None
         localsgd_k = getattr(program, "_localsgd_k", 0)
         if compiled is None:
+            if any(op.type == "fused_attention"
+                   for b in program.blocks for op in b.ops):
+                # flash-kernel availability must be probed EAGERLY, before
+                # any block class jit-traces (ops/attention.py); one shared
+                # choke point so LocalSGD/pipeline paths get it too
+                from ..ops.attention import prewarm_flash
+                prewarm_flash()
             if localsgd_k and localsgd_k > 1:
                 compiled = _LocalSGDBlock(program, 0, list(feed_vals),
                                           fetch_names, state_names,

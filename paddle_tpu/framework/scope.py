@@ -49,7 +49,8 @@ class Scope:
     def numpy(self, name: str) -> np.ndarray:
         v = self.find(name)
         if v is None:
-            raise KeyError(f"variable {name!r} not found in scope")
+            from . import errors
+            raise errors.NotFound("variable %r not found in scope", name)
         return np.asarray(v)
 
 

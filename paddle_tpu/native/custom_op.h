@@ -1,0 +1,67 @@
+/* Custom-op C ABI.
+ *
+ * Reference counterpart: paddle/fluid/framework/c/c_api.h:41-47 +
+ * load_op_lib.h (runtime-loadable operator libraries). TPU-native shape:
+ * the library exports plain-C compute/infer functions; the framework wraps
+ * them into the XLA graph as host callbacks (jax.pure_callback), so a
+ * custom C op runs on the host CPU with device<->host staging around it —
+ * the honest TPU equivalent of a custom CPU kernel. Device-side custom ops
+ * are written in Python/Pallas instead (docs/custom_ops.md).
+ *
+ * Build:  g++ -shared -fPIC -O2 my_ops.cc -o my_ops.so
+ * Load:   paddle_tpu.utils.load_op_library("./my_ops.so")
+ */
+#ifndef PADDLE_TPU_CUSTOM_OP_H_
+#define PADDLE_TPU_CUSTOM_OP_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define PD_CUSTOM_OP_MAX_DIMS 8
+
+/* dtype codes */
+enum PD_CDType {
+  PD_C_FLOAT32 = 0,
+  PD_C_FLOAT64 = 1,
+  PD_C_INT32 = 2,
+  PD_C_INT64 = 3,
+};
+
+typedef struct {
+  int32_t ndim;
+  int64_t dims[PD_CUSTOM_OP_MAX_DIMS];
+  int32_t dtype; /* PD_CDType */
+  void* data;    /* NULL during shape inference */
+} PD_CTensor;
+
+/* Fill outs[i].ndim/dims/dtype from ins (ins[i].data is NULL here).
+ * Return 0 on success, nonzero on error. */
+typedef int32_t (*PD_CustomOpInferShape)(const PD_CTensor* ins,
+                                         int32_t n_ins, PD_CTensor* outs,
+                                         int32_t n_outs);
+
+/* Compute outs from ins. All buffers are dense, C-contiguous, allocated by
+ * the caller (outs sized per infer_shape). Return 0 on success. */
+typedef int32_t (*PD_CustomOpCompute)(const PD_CTensor* ins, int32_t n_ins,
+                                      PD_CTensor* outs, int32_t n_outs);
+
+typedef struct {
+  const char* name;   /* op type; must not collide with built-ins */
+  int32_t n_inputs;
+  int32_t n_outputs;
+  PD_CustomOpInferShape infer_shape;
+  PD_CustomOpCompute compute;
+} PD_CustomOpDef;
+
+/* The ONE symbol a custom-op library must export: point *defs at a static
+ * array of op defs and return its length. */
+int32_t PD_GetCustomOps(const PD_CustomOpDef** defs);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* PADDLE_TPU_CUSTOM_OP_H_ */

@@ -45,10 +45,17 @@ def _flash_probe():
     """
     global _flash_probe_ok
     if _flash_probe_ok is None:
+        if not _trace_state_clean():
+            # Mid-trace, constants are tracers: the probe can neither run the
+            # kernels now nor trust a mid-trace compile. Fall back to dense
+            # for THIS lowering but leave the flag undecided so an eager
+            # probe (executor pre-probes before tracing) can still enable
+            # the flash path. (Round-4 bug: probing here cached False
+            # forever and silently benched the dense path.)
+            return False
         try:
             from .pallas.flash_attention import flash_attention
             x = jnp.zeros((1, 1, 256, 64), jnp.bfloat16)
-
             m = jnp.zeros((1, 1, 1, 256), jnp.float32)
 
             def f(q):
@@ -60,7 +67,11 @@ def _flash_probe():
                 return jnp.sum((plain + dropped + masked)
                                .astype(jnp.float32))
 
-            jax.jit(jax.grad(f))(x).block_until_ready()
+            # sync by pulling to host: jax.block_until_ready is a NO-OP on
+            # the axon plugin's arrays, and an execution fault must surface
+            # HERE (cache False + fall back), not inside the user's step
+            import numpy as _np
+            _np.asarray(jax.jit(jax.grad(f))(x)).reshape(-1)[0]
             _flash_probe_ok = True
         except Exception as e:  # pragma: no cover - platform specific
             import warnings
@@ -69,6 +80,26 @@ def _flash_probe():
                 f"using the XLA attention path")
             _flash_probe_ok = False
     return _flash_probe_ok
+
+
+def _trace_state_clean() -> bool:
+    try:
+        return jax.core.trace_state_clean()
+    except Exception:  # pragma: no cover - jax version drift
+        # fallback heuristic: a constant staying concrete means eager
+        return not isinstance(jnp.zeros(()), jax.core.Tracer)
+
+
+def prewarm_flash():
+    """Run the one-time flash-kernel compile probe NOW, eagerly — executor
+    calls this before tracing any block containing fused_attention so the
+    lowering can trust the cached verdict (probing mid-trace is impossible;
+    see _flash_probe)."""
+    try:
+        if jax.default_backend() in ("tpu", "axon"):
+            _flash_probe()
+    except RuntimeError:  # pragma: no cover - backend not initialized
+        pass
 
 
 def _derive_seed(key):

@@ -75,7 +75,10 @@ def register(name: str, *, infer=None, is_random=False, nondiff_slots=(),
 
 def get(name: str) -> OpDef:
     if name not in _REGISTRY:
-        raise NotImplementedError(f"op {name!r} is not registered")
+        from ..framework import errors
+        raise errors.Unimplemented(
+            "op %r is not registered; register a lowering with "
+            "paddle_tpu.ops.registry.register (docs/custom_ops.md)", name)
     return _REGISTRY[name]
 
 

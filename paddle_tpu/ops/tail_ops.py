@@ -984,22 +984,77 @@ def _dgc_clip_by_norm(ctx, ins, attrs):
     return {"Out": [out]}
 
 
+@register("dgc",
+          stateful_outputs=("UOut", "VOut"),
+          nondiff_slots=("U", "V", "Grad", "current_step"))
+def _dgc(ctx, ins, attrs):
+    """dgc_op (operators/dgc_op.h, Lin et al. Deep Gradient Compression):
+    momentum-corrected local accumulation + top-k sparsification with
+    residual feedback. u = m*u + g; v += u; entries of |v| above the current
+    sparsity threshold are EncodeGrad (what crosses the wire — under GSPMD
+    the allreduce itself stays dense over ICI, so this preserves the UPDATE
+    semantics: selected coordinates move, the rest accumulate locally);
+    selected positions reset in both u and v (momentum factor masking).
+    Threshold is estimated from a strided sample like the reference's
+    sampled top-k (libdgc get_sample_k). Before rampup_begin_step the op
+    passes the gradient through untouched."""
+    u, v, g = ins["U"][0], ins["V"][0], ins["Grad"][0]
+    step = ins["current_step"][0].reshape(()).astype(jnp.float32)
+    m = attrs.get("m", 0.9)
+    begin = float(attrs.get("rampup_begin_step", 0.0))
+    rampup = float(attrs.get("rampup_step", 1.0))
+    sched = jnp.asarray(attrs.get("sparsity", [0.999]), jnp.float32)
+    nseg = int(sched.shape[0])
+    # rampup schedule: which sparsity segment this step sits in
+    interval = max(rampup / nseg, 1.0)
+    idx = jnp.clip(((step - begin) / interval).astype(jnp.int32), 0, nseg - 1)
+    s = sched[idx]
+
+    u2 = m * u + g
+    v2 = v + u2
+    flat = jnp.abs(v2.reshape(-1))
+    n = int(flat.shape[0])
+    # ceil stride so the strided sample SPANS the tensor (a floor stride
+    # would never sample the tail, biasing the threshold)
+    stride = -(-n // min(n, 4096))
+    sample = jnp.sort(flat[::stride])
+    m = int(sample.shape[0])
+    pos = jnp.clip((s * m).astype(jnp.int32), 0, m - 1)
+    thr = sample[pos]
+    keep = (jnp.abs(v2) >= thr).astype(v2.dtype)
+
+    use_dgc = step >= begin
+    encoded = jnp.where(use_dgc, v2 * keep, g)
+    u_out = jnp.where(use_dgc, u2 * (1.0 - keep), u2)
+    v_out = jnp.where(use_dgc, v2 * (1.0 - keep), jnp.zeros_like(v2))
+    return {"UOut": [u_out], "VOut": [v_out], "EncodeGrad": [encoded]}
+
+
 @register("dgc_momentum",
           stateful_outputs=("ParamOut", "VelocityOut"),
           nondiff_slots=("Param", "Grad", "Velocity", "LearningRate",
                          "current_step"))
 def _dgc_momentum(ctx, ins, attrs):
-    """dgc_momentum_op: plain momentum before rampup, momentum-correction
-    mode after (the sparse-comm side lives in the DP hook)."""
+    """dgc_momentum_op.h:44: plain momentum BEFORE rampup_begin_step; plain
+    SGD after (the dgc op has already folded momentum into EncodeGrad)."""
     p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
     lr = ins["LearningRate"][0].reshape(())
     mu = attrs.get("mu", 0.9)
     v2 = mu * v + g
     if attrs.get("use_nesterov", False):
-        p2 = p - lr * (g + mu * v2)
+        p_mom = p - lr * (g + mu * v2)
     else:
-        p2 = p - lr * v2
-    return {"ParamOut": [p2], "VelocityOut": [v2]}
+        p_mom = p - lr * v2
+    step_in = ins.get("current_step")
+    if step_in:
+        step = step_in[0].reshape(()).astype(jnp.float32)
+        begin = float(attrs.get("rampup_begin_step", 0.0))
+        in_dgc = step >= begin
+        p2 = jnp.where(in_dgc, p - lr * g, p_mom)       # sgd branch
+        v_out = jnp.where(in_dgc, v, v2)                 # velocity frozen
+    else:  # no step input: behave as plain momentum (legacy call sites)
+        p2, v_out = p_mom, v2
+    return {"ParamOut": [p2], "VelocityOut": [v_out]}
 
 
 # ---------------------------------------------------------------------------

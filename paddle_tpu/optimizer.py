@@ -559,18 +559,86 @@ class DpsgdOptimizer(Optimizer):
 
 
 class DGCMomentumOptimizer(MomentumOptimizer):
-    """Reference optimizer.py:1185. On TPU the DGC top-k sparsified allreduce
-    has no role — gradients cross chips as XLA reduce-scatter/all-reduce over
-    ICI chosen by GSPMD — so this preserves the momentum-correction update
-    semantics and accepts (ignores) the compression knobs. Documented
-    divergence: no bandwidth compression is performed."""
+    """Reference optimizer.py:1185 + operators/dgc_op.h. Full DGC semantics:
+    per-param U (momentum-corrected accumulation) and V (residual) state, a
+    rampup sparsity schedule, sampled-top-k threshold selection, momentum
+    factor masking, and the momentum→SGD switch at rampup_begin_step
+    (dgc_momentum_op.h:44). Documented TPU divergence: the sparsified
+    gradient still crosses chips as a DENSE XLA allreduce over ICI (GSPMD
+    owns the collective; ICI makes wire compression pointless) — what DGC
+    changes here is the UPDATE RULE, which is the part that affects
+    convergence."""
 
     def __init__(self, learning_rate, momentum, rampup_begin_step=0,
                  rampup_step=1, sparsity=(0.999,), use_nesterov=False,
                  local_grad_clip_norm=None, num_trainers=None, **kw):
         super().__init__(learning_rate, momentum, use_nesterov, **kw)
-        self._rampup_begin_step = rampup_begin_step
-        self._sparsity = sparsity
+        self.type = "dgc_momentum"
+        self._rampup_begin_step = float(rampup_begin_step)
+        self._rampup_step = float(rampup_step)
+        self._sparsity = [float(s) for s in sparsity]
+        self._local_grad_clip_norm = local_grad_clip_norm
+        self._counter_var = None
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+            self._add_accumulator("dgc_u", p)
+            self._add_accumulator("dgc_v", p)
+        if self._counter_var is None:
+            self._counter_var = layers.create_global_var(
+                [1], 0.0, "float32", persistable=True,
+                name=unique_name.generate("dgc_counter"))
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        u = self._get_accumulator("dgc_u", p)
+        v = self._get_accumulator("dgc_v", p)
+        vel = self._get_accumulator("velocity", p)
+        step = self._counter_var
+        if self._local_grad_clip_norm is not None:
+            clipped = block.create_var(
+                name=unique_name.generate(f"{p.name}_dgc_clip"),
+                shape=p.shape, dtype=p.dtype)
+            block.append_op(
+                "dgc_clip_by_norm",
+                inputs={"X": [g], "current_step": [step]},
+                outputs={"Out": [clipped]},
+                attrs={"max_norm": float(self._local_grad_clip_norm),
+                       "rampup_begin_step": self._rampup_begin_step,
+                       "op_role": OpRole.Optimize})
+            g = clipped
+        encoded = block.create_var(
+            name=unique_name.generate(f"{p.name}_dgc_encoded"),
+            shape=p.shape, dtype=p.dtype)
+        block.append_op(
+            "dgc",
+            inputs={"U": [u], "V": [v], "Grad": [g],
+                    "current_step": [step]},
+            outputs={"UOut": [u], "VOut": [v], "EncodeGrad": [encoded]},
+            attrs={"m": self._momentum,
+                   "rampup_begin_step": self._rampup_begin_step,
+                   "rampup_step": self._rampup_step,
+                   "sparsity": self._sparsity,
+                   "op_role": OpRole.Optimize})
+        return block.append_op(
+            "dgc_momentum",
+            inputs={"Param": [p], "Grad": [encoded], "Velocity": [vel],
+                    "LearningRate": [self._lr_var],
+                    "current_step": [step]},
+            outputs={"ParamOut": [p], "VelocityOut": [vel]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov,
+                   "rampup_begin_step": self._rampup_begin_step,
+                   "op_role": OpRole.Optimize})
+
+    def apply_gradients(self, params_grads):
+        out = super().apply_gradients(params_grads)
+        block = default_main_program().global_block()
+        block.append_op("increment",
+                        inputs={"X": [self._counter_var]},
+                        outputs={"Out": [self._counter_var]},
+                        attrs={"step": 1.0, "op_role": OpRole.Optimize})
+        return out
 
 
 class LookaheadOptimizer:

@@ -204,8 +204,13 @@ def ulysses_attention(q, k, v, mesh: Optional[Mesh] = None, axis: str = "sp",
         mesh = get_mesh()
     assert mesh is not None and axis in mesh.axis_names
     p_size = mesh.shape[axis]
-    assert q.shape[1] % p_size == 0, (
-        f"ulysses needs heads ({q.shape[1]}) divisible by |{axis}|={p_size}")
+    # heads are already sharded over tp by _qkv_spec, so the all_to_all
+    # splits the PER-TP-SHARD head count — check that, not global nh
+    tp_shards = mesh.shape.get("tp", 1) if "tp" in mesh.axis_names else 1
+    local_heads = q.shape[1] // tp_shards if tp_shards else q.shape[1]
+    assert local_heads % p_size == 0, (
+        f"ulysses needs per-tp-shard heads ({q.shape[1]}//tp={local_heads}) "
+        f"divisible by |{axis}|={p_size}")
     if dropout > 0.0 and seed is None:
         raise ValueError("ulysses_attention dropout requires a seed")
     seed = jnp.asarray(0 if seed is None else seed, jnp.int32).reshape((1,))

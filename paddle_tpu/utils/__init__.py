@@ -1,0 +1,7 @@
+"""paddle_tpu.utils — custom-op surface and misc utilities.
+
+Reference counterpart: fluid.load_op_library
+(python/paddle/fluid/framework.py:5549) + framework/c/c_api.h.
+"""
+from .custom_op import (load_op_library, register_op, custom_layer,  # noqa
+                        CustomOpError)

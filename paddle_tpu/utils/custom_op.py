@@ -1,0 +1,244 @@
+"""Custom operators: the plugin registry + runtime-loadable op libraries.
+
+Reference counterparts:
+  * fluid.load_op_library — python/paddle/fluid/framework.py:5549 (loads a
+    .so whose static initializers hit the op registry, then refreshes the
+    OpProtoHolder so python wrappers appear);
+  * the C op surface — paddle/fluid/framework/c/c_api.h:41-47 +
+    load_op_lib.h.
+
+TPU-native design (docs/custom_ops.md):
+  * A PYTHON custom op is a jax-traceable lowering registered through the
+    same `ops.registry.register` every built-in op uses. It compiles into
+    the XLA program, fuses with its neighbors, and is DIFFERENTIABLE for
+    free — append_backward's generic `__vjp__` calls jax.vjp on the
+    lowering, so there is no grad-kernel to write (the reference makes you
+    write one in C++).
+  * A C custom op (built against native/custom_op.h) runs on the HOST via
+    jax.pure_callback with device<->host staging — the honest equivalent of
+    the reference's custom CPU kernel. Not differentiable; use it for IO,
+    lookups, or legacy numerics on the way in/out of the device program.
+"""
+from __future__ import annotations
+
+import ctypes
+import importlib
+import os
+import runpy
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from ..framework import errors
+from ..ops import registry
+
+PD_CUSTOM_OP_MAX_DIMS = 8
+_DTYPES = {0: np.dtype(np.float32), 1: np.dtype(np.float64),
+           2: np.dtype(np.int32), 3: np.dtype(np.int64)}
+_DTYPE_CODES = {v: k for k, v in _DTYPES.items()}
+
+
+class CustomOpError(errors.EnforceNotMet):
+    code = errors.ErrorCode.EXTERNAL
+
+
+class _PD_CTensor(ctypes.Structure):
+    _fields_ = [("ndim", ctypes.c_int32),
+                ("dims", ctypes.c_int64 * PD_CUSTOM_OP_MAX_DIMS),
+                ("dtype", ctypes.c_int32),
+                ("data", ctypes.c_void_p)]
+
+
+_FN = ctypes.CFUNCTYPE(ctypes.c_int32, ctypes.POINTER(_PD_CTensor),
+                       ctypes.c_int32, ctypes.POINTER(_PD_CTensor),
+                       ctypes.c_int32)
+
+
+class _PD_CustomOpDef(ctypes.Structure):
+    _fields_ = [("name", ctypes.c_char_p),
+                ("n_inputs", ctypes.c_int32),
+                ("n_outputs", ctypes.c_int32),
+                ("infer_shape", _FN),
+                ("compute", _FN)]
+
+
+def register_op(name: str, fn: Optional[Callable] = None, *,
+                n_outputs: int = 1, infer=None, is_random: bool = False,
+                nondiff_slots: Sequence[str] = ()):
+    """Register a PYTHON custom op.
+
+    `fn(*inputs, **attrs)` takes jax arrays, returns an array (or a tuple of
+    `n_outputs`). It is traced into the XLA program like any built-in op and
+    autodiff works through it. Use as a decorator or call directly:
+
+        @register_op("my_scaled_tanh")
+        def my_scaled_tanh(x, scale=1.0):
+            return jnp.tanh(x) * scale
+
+        y = custom_layer("my_scaled_tanh")(x, scale=2.0)
+    """
+    def deco(f):
+        if registry.has(name):
+            raise errors.AlreadyExists(
+                "op type %r already registered; custom ops must not collide "
+                "with existing operators (reference framework.py:5556)", name)
+
+        def lower(ctx, ins, attrs):
+            user_attrs = {k: v for k, v in attrs.items()
+                          if not k.startswith("__") and k != "op_role"}
+            out = f(*ins["X"], **user_attrs)
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            return {"Out": outs}
+
+        registry.register(name, infer=infer, is_random=is_random,
+                          nondiff_slots=nondiff_slots)(lower)
+        f._op_type = name
+        f._n_outputs = n_outputs
+        return f
+    return deco if fn is None else deco(fn)
+
+
+def custom_layer(op_type: str, n_outputs: int = 1):
+    """Layer-function sugar for a registered custom op: returns
+    `layer(*inputs, **attrs)` that appends the op to the current program
+    (static graph) or traces it (dygraph) — the counterpart of the python
+    wrappers OpProtoHolder generates after load_op_library."""
+    from ..layer_helper import LayerHelper
+
+    def layer(*inputs, **attrs):
+        if not registry.has(op_type):
+            raise errors.NotFound("custom op %r is not registered; call "
+                                  "load_op_library/register_op first", op_type)
+        helper = LayerHelper(op_type)
+        dtype = getattr(inputs[0], "dtype", "float32") if inputs else "float32"
+        outs = [helper.create_variable_for_type_inference(dtype)
+                for _ in range(n_outputs)]
+        helper.append_op(op_type, inputs={"X": list(inputs)},
+                         outputs={"Out": outs}, attrs=attrs)
+        return outs[0] if n_outputs == 1 else outs
+    layer.__name__ = op_type
+    return layer
+
+
+def _np_from_ct(t: _PD_CTensor) -> np.ndarray:
+    shape = tuple(t.dims[i] for i in range(t.ndim))
+    dt = _DTYPES[t.dtype]
+    n = int(np.prod(shape)) if shape else 1
+    buf = (ctypes.c_char * (n * dt.itemsize)).from_address(t.data)
+    return np.frombuffer(buf, dtype=dt).reshape(shape).copy()
+
+
+def _fill_ct(t: _PD_CTensor, arr: Optional[np.ndarray], shape, dtype) -> None:
+    t.ndim = len(shape)
+    for i, d in enumerate(shape):
+        t.dims[i] = int(d)
+    t.dtype = _DTYPE_CODES[np.dtype(dtype)]
+    t.data = arr.ctypes.data_as(ctypes.c_void_p) if arr is not None else None
+
+
+def _wrap_c_op(opdef: _PD_CustomOpDef):
+    import jax
+    name = opdef.name.decode()
+    n_in, n_out = int(opdef.n_inputs), int(opdef.n_outputs)
+    infer_fn, compute_fn = opdef.infer_shape, opdef.compute
+
+    def _infer_out_specs(in_specs):
+        ins = (_PD_CTensor * max(n_in, 1))()
+        for t, spec in zip(ins, in_specs):
+            if len(spec.shape) > PD_CUSTOM_OP_MAX_DIMS:
+                raise CustomOpError(
+                    f"custom op {name!r}: rank {len(spec.shape)} exceeds "
+                    f"PD_CUSTOM_OP_MAX_DIMS={PD_CUSTOM_OP_MAX_DIMS}")
+            _fill_ct(t, None, spec.shape, spec.dtype)
+        outs = (_PD_CTensor * max(n_out, 1))()
+        for i in range(n_out):  # default: like input 0
+            _fill_ct(outs[i], None, in_specs[0].shape, in_specs[0].dtype)
+        rc = infer_fn(ins, n_in, outs, n_out)
+        if rc != 0:
+            raise CustomOpError(f"custom op {name!r} infer_shape rc={rc}")
+        return [jax.ShapeDtypeStruct(
+            tuple(outs[i].dims[j] for j in range(outs[i].ndim)),
+            _DTYPES[outs[i].dtype]) for i in range(n_out)]
+
+    def lower(ctx, ins, attrs):
+        xs = ins["X"]
+        if len(xs) != n_in:
+            raise CustomOpError(
+                f"custom op {name!r} wants {n_in} inputs, got {len(xs)}")
+        in_specs = [jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+                    for x in xs]
+        out_specs = _infer_out_specs(in_specs)
+
+        def host(*arrays):
+            cins = (_PD_CTensor * max(n_in, 1))()
+            keep = []  # keep contiguous buffers alive through the call
+            for t, a in zip(cins, arrays):
+                a = np.ascontiguousarray(a)
+                keep.append(a)
+                _fill_ct(t, a, a.shape, a.dtype)
+            couts = (_PD_CTensor * max(n_out, 1))()
+            out_arrays = []
+            for t, spec in zip(couts, out_specs):
+                a = np.zeros(spec.shape, spec.dtype)
+                out_arrays.append(a)
+                _fill_ct(t, a, a.shape, a.dtype)
+            rc = compute_fn(cins, n_in, couts, n_out)
+            if rc != 0:
+                raise CustomOpError(f"custom op {name!r} compute rc={rc}")
+            return tuple(out_arrays)
+
+        outs = jax.pure_callback(host, tuple(out_specs), *xs)
+        return {"Out": list(outs)}
+
+    if registry.has(name):
+        raise errors.AlreadyExists(
+            "op type %r already registered (existing operator or an earlier "
+            "load_op_library)", name)
+    registry.register(name, nondiff_slots=("X",))(lower)
+    return name
+
+
+_loaded_libs = {}
+
+
+def load_op_library(path: str):
+    """Load custom operators from `path` and register them.
+
+    * `*.so` / `*.dylib`: a native library built against
+      native/custom_op.h; its ops run on host via pure_callback.
+    * `*.py`: executed; the file registers ops via `register_op`.
+    * anything else: imported as a module name.
+
+    Returns the list of op types the library added. Reference:
+    fluid.load_op_library (framework.py:5549)."""
+    if path in _loaded_libs:
+        return _loaded_libs[path]
+    before = set(registry.all_ops())
+    if path.endswith((".so", ".dylib")):
+        if not os.path.exists(path):
+            raise errors.NotFound("custom-op library %r does not exist", path)
+        lib = ctypes.CDLL(os.path.abspath(path))
+        try:
+            getter = lib.PD_GetCustomOps
+        except AttributeError:
+            raise CustomOpError(
+                f"{path!r} does not export PD_GetCustomOps "
+                f"(see native/custom_op.h)")
+        getter.restype = ctypes.c_int32
+        getter.argtypes = [ctypes.POINTER(ctypes.POINTER(_PD_CustomOpDef))]
+        defs_ptr = ctypes.POINTER(_PD_CustomOpDef)()
+        n = getter(ctypes.byref(defs_ptr))
+        if n <= 0:
+            raise CustomOpError(f"{path!r}: PD_GetCustomOps returned {n}")
+        added = [_wrap_c_op(defs_ptr[i]) for i in range(n)]
+        _loaded_libs[path] = added
+        # keep the CDLL alive: function pointers inside registered lowerings
+        _loaded_libs[path + "::handle"] = lib
+        return added
+    elif path.endswith(".py"):
+        runpy.run_path(path)
+    else:
+        importlib.import_module(path)
+    added = sorted(set(registry.all_ops()) - before)
+    _loaded_libs[path] = added
+    return added

@@ -1,0 +1,181 @@
+"""Custom-op surface (reference: fluid.load_op_library framework.py:5549,
+framework/c/c_api.h; reference test: test_custom_op.py building
+librelu2_op_from_op so via setup.py)."""
+import os
+import subprocess
+import tempfile
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.framework import errors
+from paddle_tpu.utils import register_op, custom_layer, load_op_library
+
+
+@pytest.fixture(scope="module")
+def scaled_tanh_registered():
+    from paddle_tpu.ops import registry
+    if not registry.has("test_scaled_tanh"):
+        import jax.numpy as jnp
+
+        @register_op("test_scaled_tanh")
+        def test_scaled_tanh(x, scale=1.0):
+            return jnp.tanh(x) * scale
+    return "test_scaled_tanh"
+
+
+def test_python_custom_op_forward(scaled_tanh_registered):
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    y = custom_layer("test_scaled_tanh")(x, scale=2.0)
+    exe = fluid.Executor()
+    xv = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    out, = exe.run(feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(out, np.tanh(xv) * 2.0, rtol=1e-6)
+
+
+def test_python_custom_op_is_differentiable(scaled_tanh_registered):
+    # the headline feature vs the reference: no grad kernel required
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    x.stop_gradient = False
+    y = custom_layer("test_scaled_tanh")(x, scale=3.0)
+    loss = layers.mean(y)
+    grads = fluid.gradients([loss], [x])
+    exe = fluid.Executor()
+    xv = np.random.RandomState(1).randn(2, 4).astype(np.float32)
+    g, = exe.run(feed={"x": xv}, fetch_list=[grads[0]])
+    expect = 3.0 * (1 - np.tanh(xv) ** 2) / xv.size
+    np.testing.assert_allclose(g, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_collision_rejected(scaled_tanh_registered):
+    with pytest.raises(errors.AlreadyExistsError):
+        register_op("relu")(lambda x: x)
+    with pytest.raises(errors.AlreadyExistsError):
+        register_op(scaled_tanh_registered)(lambda x: x)
+
+
+def test_load_py_library(tmp_path):
+    lib = tmp_path / "my_ops.py"
+    lib.write_text(textwrap.dedent("""
+        import jax.numpy as jnp
+        from paddle_tpu.utils import register_op
+
+        @register_op("test_softsign_from_lib")
+        def softsign(x):
+            return x / (1 + jnp.abs(x))
+    """))
+    added = load_op_library(str(lib))
+    assert "test_softsign_from_lib" in added
+    x = layers.data(name="x", shape=[3], dtype="float32")
+    y = custom_layer("test_softsign_from_lib")(x)
+    exe = fluid.Executor()
+    xv = np.array([[-2.0, 0.0, 2.0]], np.float32)
+    out, = exe.run(feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(out, xv / (1 + np.abs(xv)), rtol=1e-6)
+
+
+C_SRC = r"""
+#include "custom_op.h"
+#include <math.h>
+
+static int32_t relu_infer(const PD_CTensor* ins, int32_t n_ins,
+                          PD_CTensor* outs, int32_t n_outs) {
+  outs[0] = ins[0];
+  return 0;
+}
+
+static int32_t relu_compute(const PD_CTensor* ins, int32_t n_ins,
+                            PD_CTensor* outs, int32_t n_outs) {
+  long long n = 1;
+  for (int i = 0; i < ins[0].ndim; ++i) n *= ins[0].dims[i];
+  const float* src = (const float*)ins[0].data;
+  float* dst = (float*)outs[0].data;
+  for (long long i = 0; i < n; ++i) dst[i] = src[i] > 0 ? src[i] : 0.f;
+  return 0;
+}
+
+/* second op: row sums, proves non-trivial infer_shape */
+static int32_t rowsum_infer(const PD_CTensor* ins, int32_t n_ins,
+                            PD_CTensor* outs, int32_t n_outs) {
+  if (ins[0].ndim != 2) return 1;
+  outs[0].ndim = 1;
+  outs[0].dims[0] = ins[0].dims[0];
+  outs[0].dtype = ins[0].dtype;
+  return 0;
+}
+
+static int32_t rowsum_compute(const PD_CTensor* ins, int32_t n_ins,
+                              PD_CTensor* outs, int32_t n_outs) {
+  long long r = ins[0].dims[0], c = ins[0].dims[1];
+  const float* src = (const float*)ins[0].data;
+  float* dst = (float*)outs[0].data;
+  for (long long i = 0; i < r; ++i) {
+    float s = 0.f;
+    for (long long j = 0; j < c; ++j) s += src[i * c + j];
+    dst[i] = s;
+  }
+  return 0;
+}
+
+static const PD_CustomOpDef kOps[] = {
+    {"test_c_relu", 1, 1, relu_infer, relu_compute},
+    {"test_c_rowsum", 1, 1, rowsum_infer, rowsum_compute},
+};
+
+int32_t PD_GetCustomOps(const PD_CustomOpDef** defs) {
+  *defs = kOps;
+  return 2;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def c_oplib():
+    import shutil
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ toolchain")
+    d = tempfile.mkdtemp(prefix="pd_custom_op_")
+    src = os.path.join(d, "my_ops.cc")
+    with open(src, "w") as f:
+        f.write(C_SRC)
+    so = os.path.join(d, "my_ops.so")
+    hdr = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "paddle_tpu", "native")
+    subprocess.run(["g++", "-shared", "-fPIC", "-O2", f"-I{hdr}", src,
+                    "-o", so], check=True)
+    return so
+
+
+def test_c_custom_ops(c_oplib):
+    added = load_op_library(c_oplib)
+    assert set(added) == {"test_c_relu", "test_c_rowsum"}
+    assert load_op_library(c_oplib) == added  # idempotent
+
+    x = layers.data(name="x", shape=[5], dtype="float32")
+    r = custom_layer("test_c_relu")(x)
+    s = custom_layer("test_c_rowsum")(r)
+    exe = fluid.Executor()
+    xv = np.random.RandomState(2).randn(4, 5).astype(np.float32)
+    rv, sv = exe.run(feed={"x": xv}, fetch_list=[r, s])
+    np.testing.assert_allclose(rv, np.maximum(xv, 0), rtol=1e-6)
+    np.testing.assert_allclose(sv, np.maximum(xv, 0).sum(1), rtol=1e-5)
+    # declared shape from the C infer_shape: rank-1 with the dynamic batch
+    assert tuple(s.shape) == (-1,)
+
+
+def test_so_without_symbol_rejected(tmp_path):
+    import shutil
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ toolchain")
+    src = tmp_path / "empty.cc"
+    src.write_text("extern \"C\" int nothing() { return 0; }\n")
+    so = tmp_path / "empty.so"
+    subprocess.run(["g++", "-shared", "-fPIC", str(src), "-o", str(so)],
+                   check=True)
+    from paddle_tpu.utils import CustomOpError
+    with pytest.raises(CustomOpError, match="PD_GetCustomOps"):
+        load_op_library(str(so))

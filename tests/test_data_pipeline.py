@@ -4,6 +4,8 @@ Mirrors reference tests test_dataset.py, test_dataloader_*.py,
 test_multiprocess_dataloader_*.py.
 """
 import os
+import signal
+import time
 
 import numpy as np
 import pytest
@@ -341,3 +343,45 @@ def test_train_from_dataset_failed_step_does_not_leak_producer():
         assert not (t.name == "dataplane-prefetch" and t.is_alive()), \
             "prefetch thread leaked"
     assert threading.active_count() <= before + 1
+
+
+class _SlowAtZeroDataset(paddle.io.Dataset):
+    """Index 0 stalls long enough for the test to SIGKILL its worker."""
+
+    def __getitem__(self, i):
+        if i == 0:
+            time.sleep(120)
+        return np.float32([i])
+
+    def __len__(self):
+        return 8
+
+
+def test_dataloader_fast_worker_death_detection():
+    """A SIGKILLed worker must surface within the ~1s liveness poll, not the
+    300s queue timeout — the forkserver-context equivalent of the reference's
+    SIGCHLD handler (dataloader_iter.py _set_SIGCHLD_handler: 'DataLoader
+    worker exits unexpectedly')."""
+    from paddle_tpu.dataloader.dataloader import (_MultiprocessIter,
+                                                  default_collate_fn)
+    batches = [[i, i + 1] for i in range(0, 8, 2)]
+    it = _MultiprocessIter(_SlowAtZeroDataset(), batches,
+                           default_collate_fn, num_workers=2)
+    # worker 0 owns batch seq 0 (round-robin) and is stuck in sleep(120)
+    victim = it._workers[0]
+    time.sleep(1.0)  # let it enter __getitem__
+    os.kill(victim.pid, signal.SIGKILL)
+    t0 = time.perf_counter()
+    with pytest.raises(RuntimeError, match="died unexpectedly"):
+        next(it)
+    assert time.perf_counter() - t0 < 30, "death detection took too long"
+
+
+def test_dataloader_normal_completion_not_flagged_as_death():
+    """Workers retiring cleanly after the None sentinel must not trip the
+    SIGCHLD death path."""
+    ds = _SquaresDataset(16)
+    dl = paddle.io.DataLoader(ds, batch_size=4, shuffle=False, num_workers=2,
+                              use_buffer_reader=False)
+    out = np.concatenate([np.asarray(b[0]).ravel() for b in dl])
+    np.testing.assert_allclose(out, np.arange(16, dtype=np.float32))

@@ -1,0 +1,87 @@
+"""Typed error-code system (reference platform/errors.h + enforce.h +
+pybind/exception.cc; reference tests: errors_test.cc, enforce_test.cc)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.framework import errors
+from paddle_tpu.framework.errors import ErrorCode
+
+
+def test_every_code_has_a_class_and_factory():
+    for code in ErrorCode:
+        if code is ErrorCode.LEGACY:
+            continue
+        cls = errors.error_class(code)
+        assert issubclass(cls, errors.EnforceNotMet)
+        assert cls.code == code
+        factory = getattr(errors, code.name.title().replace("_", ""))
+        e = factory("x=%d", 3)
+        assert isinstance(e, cls) and "x=3" in str(e)
+
+
+def test_builtin_subclassing():
+    # each typed error is catchable as the natural python builtin
+    # (errors_test.cc checks code round-trip; here the pythonic contract)
+    assert issubclass(errors.InvalidArgumentError, ValueError)
+    assert issubclass(errors.NotFoundError, KeyError)
+    assert issubclass(errors.OutOfRangeError, IndexError)
+    assert issubclass(errors.UnimplementedError, NotImplementedError)
+    assert issubclass(errors.ResourceExhaustedError, MemoryError)
+    assert issubclass(errors.ExecutionTimeoutError, TimeoutError)
+    assert issubclass(errors.PermissionDeniedError, PermissionError)
+    assert issubclass(errors.FatalError, SystemError)
+    assert issubclass(errors.ExternalError, OSError)
+
+
+def test_enforce_helpers():
+    errors.enforce(True, "never raised")
+    with pytest.raises(errors.PreconditionNotMetError, match="bad state"):
+        errors.enforce(False, "bad state")
+    with pytest.raises(errors.InvalidArgumentError):
+        errors.enforce(False, errors.InvalidArgument("explicit type"))
+    errors.enforce_eq(3, 3)
+    with pytest.raises(errors.InvalidArgumentError,
+                       match=r"Expected 3 == 4"):
+        errors.enforce_eq(3, 4)
+    with pytest.raises(errors.InvalidArgumentError, match="rank"):
+        errors.enforce_ge(1, 2, "rank")
+    assert errors.enforce_not_none(5) == 5
+    with pytest.raises(errors.NotFoundError):
+        errors.enforce_not_none(None)
+
+
+def test_op_var_context_in_message():
+    e = errors.InvalidArgument("shape mismatch", op="matmul", var="X")
+    assert "operator < matmul >" in str(e) and "variable < X >" in str(e)
+
+
+def test_core_binding_surface():
+    # pybind/exception.cc binds exactly these two names on core
+    assert fluid.core.EnforceNotMet is errors.EnforceNotMet
+    assert fluid.core.EOFException is errors.EOFException
+
+
+def test_unregistered_op_is_unimplemented():
+    from paddle_tpu.ops import registry
+    with pytest.raises(errors.UnimplementedError, match="no_such_op"):
+        registry.get("no_such_op")
+    with pytest.raises(NotImplementedError):  # builtin alias still works
+        registry.get("no_such_op")
+
+
+def test_missing_scope_var_is_not_found():
+    from paddle_tpu.framework.scope import Scope
+    with pytest.raises(errors.NotFoundError):
+        Scope().numpy("nope")
+
+
+def test_bad_fetch_target_is_not_found():
+    from paddle_tpu.fluid import layers
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    y = layers.mean(x)
+    exe = fluid.Executor()
+    with pytest.raises(errors.NotFoundError, match="ghost"):
+        exe.run(feed={"x": np.zeros((2, 4), np.float32)},
+                fetch_list=["ghost"])
+    del y

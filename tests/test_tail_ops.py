@@ -579,6 +579,71 @@ def test_dgc_ops():
                                [1.0 - 0.01], rtol=1e-5)
 
 
+def test_dgc_op_sparsifies_with_residual():
+    """dgc_op.h semantics: u=m*u+g, v+=u; top-(1-s) of |v| leaves as
+    EncodeGrad, the rest stays in v (residual feedback); selected slots
+    reset in u too (momentum factor masking)."""
+    rng = np.random.RandomState(0)
+    g = rng.randn(64).astype(np.float32)
+    u = rng.randn(64).astype(np.float32) * 0.1
+    v = rng.randn(64).astype(np.float32) * 0.1
+    out = run_op("dgc",
+                 {"U": [u], "V": [v], "Grad": [g],
+                  "current_step": [np.array(5.0, np.float32)]},
+                 {"m": 0.9, "rampup_begin_step": 0.0, "rampup_step": 1.0,
+                  "sparsity": [0.75]})
+    enc = np.asarray(out["EncodeGrad"][0])
+    u_out = np.asarray(out["UOut"][0])
+    v_out = np.asarray(out["VOut"][0])
+    u2 = 0.9 * u + g
+    v2 = v + u2
+    # conservation: encoded + residual == full accumulated gradient
+    np.testing.assert_allclose(enc + v_out, v2, rtol=1e-5, atol=1e-6)
+    kept = enc != 0
+    assert 0 < kept.sum() <= 0.5 * 64  # ~25% kept (sampled threshold)
+    assert np.all(v_out[kept] == 0) and np.all(u_out[kept] == 0)
+    np.testing.assert_allclose(u_out[~kept], u2[~kept], rtol=1e-5)
+    # every surviving |entry| >= every dropped |entry| region boundary
+    assert np.abs(v2[kept]).min() >= np.abs(v2[~kept]).max() - 1e-6
+
+
+def test_dgc_op_passthrough_before_rampup():
+    g = np.array([1.0, -2.0, 3.0], np.float32)
+    u = np.zeros(3, np.float32)
+    v = np.zeros(3, np.float32)
+    out = run_op("dgc",
+                 {"U": [u], "V": [v], "Grad": [g],
+                  "current_step": [np.array(3.0, np.float32)]},
+                 {"m": 0.9, "rampup_begin_step": 10.0, "rampup_step": 4.0,
+                  "sparsity": [0.75, 0.9375]})
+    np.testing.assert_allclose(np.asarray(out["EncodeGrad"][0]), g)
+    np.testing.assert_allclose(np.asarray(out["VOut"][0]), np.zeros(3))
+
+
+def test_dgc_momentum_switches_to_sgd_after_rampup():
+    p = np.array([1.0], np.float32)
+    g = np.array([0.1], np.float32)
+    v = np.array([0.5], np.float32)   # pre-existing velocity
+    common = {"Param": [p], "Grad": [g], "Velocity": [v],
+              "LearningRate": [np.array([0.1], np.float32)]}
+    before = run_op("dgc_momentum",
+                    {**common,
+                     "current_step": [np.array(2.0, np.float32)]},
+                    {"mu": 0.9, "rampup_begin_step": 5.0})
+    after = run_op("dgc_momentum",
+                   {**common,
+                    "current_step": [np.array(7.0, np.float32)]},
+                   {"mu": 0.9, "rampup_begin_step": 5.0})
+    # before: momentum (v2 = .45+.1 = .55, p -= .055)
+    np.testing.assert_allclose(np.asarray(before["ParamOut"][0]), [0.945],
+                               rtol=1e-5)
+    # after: plain sgd (p -= lr*g), velocity untouched
+    np.testing.assert_allclose(np.asarray(after["ParamOut"][0]), [0.99],
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(after["VelocityOut"][0]), [0.5],
+                               rtol=1e-5)
+
+
 # --- metric tail -----------------------------------------------------------
 
 def test_mean_iou():
