@@ -130,22 +130,26 @@ def _drain(out):
     """Force the device queue dry. jax.block_until_ready is a NO-OP on the
     experimental axon plugin's arrays (seen round 4: 30 dispatches 'finished'
     in 0.17s while the device ground for 56s), so sync by actually pulling
-    the scalar loss to host — D2H cannot complete before every queued step
-    that produced it."""
-    return float(np.asarray(out).reshape(-1)[0])
+    the values to host — D2H cannot complete before every queued step that
+    produced them."""
+    return np.asarray(out)
 
 
-def _timed_steps(exe, feed, fetch, steps, warmup=3):
+def _timed_steps(exe, feed, fetch, steps):
+    """One device-side k-step scan per measurement (Executor.run_steps):
+    dispatch cost is paid once per k steps, so the recorded number reflects
+    device throughput, not host/tunnel round-trips. The warmup call runs the
+    SAME k so the timed call reuses the compiled loop."""
     _log("compiling + warmup...")
-    for _ in range(warmup):
-        out, = exe.run(feed=feed, fetch_list=[fetch], return_numpy=False)
+    out, = exe.run_steps(steps, feed=feed, fetch_list=[fetch],
+                         return_numpy=False)
     _drain(out)
-    _log(f"warm; timing {steps} steps")
+    _log(f"warm; timing {steps} steps (one dispatch)")
     t0 = time.perf_counter()
-    for _ in range(steps):
-        out, = exe.run(feed=feed, fetch_list=[fetch], return_numpy=False)
-    val = _drain(out)
-    return time.perf_counter() - t0, val
+    out, = exe.run_steps(steps, feed=feed, fetch_list=[fetch],
+                         return_numpy=False)
+    vals = _drain(out).reshape(-1)
+    return time.perf_counter() - t0, float(vals[-1])
 
 
 def bench_bert(batch, seq_len, steps, masked=False):

@@ -84,23 +84,31 @@ class _MultiprocessIter:
             p.start()
             self._index_queues.append(iq)
             self._workers.append(p)
+        self._assigned_worker = {}
         for seq, idxs in enumerate(batches):
             self._index_queues[seq % num_workers].put((seq, idxs))
+            self._assigned_worker[seq] = seq % num_workers
         for iq in self._index_queues:
             iq.put(None)
         self._total = len(batches)
         self._next_seq = 0
         self._reorder = {}
+        self._received = set()
 
     def __iter__(self):
         return self
 
     def _abnormal_deaths(self):
-        """(worker_id, exitcode) for workers that died WITHOUT finishing
-        their index queue — exitcode 0 after the None sentinel is a normal
-        retirement, not a failure."""
+        """(worker_id, exitcode) for dead workers that still OWE a batch —
+        a worker that delivered everything it was assigned and then died
+        (nonzero atexit of some native lib, say) is a retirement, not a
+        failure; only an undelivered assignment makes its death fatal."""
+        owing = {self._assigned_worker[s] for s in range(self._next_seq,
+                                                         self._total)
+                 if s not in self._received}
         return [(w, p.exitcode) for w, p in enumerate(self._workers)
-                if not p.is_alive() and p.exitcode not in (0, None)]
+                if w in owing and not p.is_alive()
+                and p.exitcode not in (0, None)]
 
     def __next__(self):
         if self._next_seq >= self._total:
@@ -134,6 +142,7 @@ class _MultiprocessIter:
             if err is not None:
                 self._join()
                 raise RuntimeError(f"DataLoader worker failed: {err}")
+            self._received.add(seq)
             self._reorder[seq] = batch
         batch = self._reorder.pop(self._next_seq)
         self._next_seq += 1

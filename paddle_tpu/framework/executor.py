@@ -31,7 +31,7 @@ class _CompiledBlock:
                  feed_names: Sequence[str], fetch_names: Sequence[str],
                  state_names: Sequence[str], donate: bool = True,
                  feed_shapes: Optional[dict] = None,
-                 state_shapes: Optional[dict] = None):
+                 state_shapes: Optional[dict] = None, multi_k: int = 0):
         self.program = program
         self.block = program.blocks[block_idx]
         self.feed_names = list(feed_names)
@@ -44,13 +44,21 @@ class _CompiledBlock:
         self.mut_names = [n for n in self.state_names if n in written]
         self.ro_names = [n for n in self.state_names if n not in written]
         micro_k = getattr(program, "_microbatch_k", 0)
-        runner = (functools.partial(_run_block_microbatched, micro_k)
-                  if micro_k and micro_k > 1 else _run_block)
+        if multi_k and multi_k > 1:
+            runner = functools.partial(_run_block_multistep, multi_k)
+        elif micro_k and micro_k > 1:
+            runner = functools.partial(_run_block_microbatched, micro_k)
+        else:
+            runner = _run_block
         fn = functools.partial(runner, self.block, self.feed_names,
                                self.fetch_names, self.mut_names, self.ro_names,
                                self.written_state)
         jit_kw = {}
         dist = getattr(program, "_dist_config", None)
+        if multi_k and multi_k > 1:
+            # multi-step scan: feeds carry a leading [k] axis the per-step
+            # sharding specs don't describe; let GSPMD infer placements
+            dist = None
         if dist is not None:
             # SPMD: shard feeds over the data axes, params per TP rules; XLA
             # GSPMD inserts every collective (the grad allreduce included)
@@ -276,6 +284,46 @@ def _run_block_inner(block, fetch_names, written_state, env, ctx):
     return fetches, new_state
 
 
+def _run_block_multistep(k_steps, block, feed_names, fetch_names, mut_names,
+                         ro_names, written_state, mut_state: dict,
+                         ro_state: dict, feeds: dict, rng_key):
+    """Device-side training loop: lax.scan over k_steps whole train steps in
+    ONE XLA program (one dispatch). The idiomatic TPU loop (the scaling-book
+    / MaxText pattern): host dispatch overhead — which dominates small steps
+    on high-latency links like the axon dev tunnel (~350 ms/call measured on
+    BERT-scale state regardless of compute) — is paid once per k steps, and
+    params/optimizer state never leave the device between steps.
+
+    feeds carry a leading [k_steps] axis; each step b draws rng
+    fold_in(run_key, b) so dropout differs per step exactly as k separate
+    run() calls would differ across their run keys."""
+    import jax
+
+    def body(mut, xs):
+        step_feeds, idx = xs
+        step_key = jax.random.fold_in(rng_key, idx)
+        fetches, new_state = _run_block(
+            block, feed_names, fetch_names, mut_names, ro_names,
+            written_state, mut, ro_state, step_feeds, step_key)
+        mut2 = dict(mut)
+        extra = {}
+        for n, v in new_state.items():
+            if n in mut2:
+                mut2[n] = v
+            else:
+                extra[n] = v
+        return mut2, (fetches, extra)
+
+    import jax.numpy as jnp
+    xs = (feeds, jnp.arange(k_steps))
+    final_mut, (stacked_fetches, stacked_extra) = jax.lax.scan(
+        body, dict(mut_state), xs, length=k_steps)
+    new_state = dict(final_mut)
+    for n, v in stacked_extra.items():
+        new_state[n] = jax.tree_util.tree_map(lambda a: a[-1], v)
+    return stacked_fetches, new_state
+
+
 def _run_block_microbatched(micro_k, block, feed_names, fetch_names,
                             mut_names, ro_names, written_state,
                             mut_state: dict, ro_state: dict, feeds: dict,
@@ -430,6 +478,52 @@ def _amp_cast(op, ins, low_dtype):
     return out
 
 
+def _coerce_feed_value(block, name, value):
+    """Feed coercion shared by run()/run_steps(): device-side casts for jax
+    arrays (feeding device arrays must NOT bounce through host numpy); 64-bit
+    ints live as int32 on device (framework/dtype.py policy) with a range
+    guard here instead of jax's silent truncation."""
+    arr = np.asarray(value) if not hasattr(value, "dtype") else value
+    v = block.find_var_recursive(name)
+    if v is not None and hasattr(arr, "astype"):
+        want = np.dtype(v.dtype)
+        if isinstance(arr, jax.Array):
+            want = jax.dtypes.canonicalize_dtype(want)
+        elif want in (np.dtype(np.int64), np.dtype(np.uint64)):
+            # 64-bit-int var: range-check ANY host feed (int64,
+            # float64-from-pandas, ...) against the 32-bit device
+            # dtype instead of jax's silent wraparound
+            info = (np.iinfo(np.int32) if want == np.dtype(np.int64)
+                    else np.iinfo(np.uint32))
+            if arr.size and (arr.max() > info.max or arr.min() < info.min):
+                from .errors import InvalidArgumentError
+                raise InvalidArgumentError(
+                    f"feed {name!r} holds {want.name} ids outside "
+                    f"{info.dtype.name} range; device tensors are "
+                    f"32-bit (see framework/dtype.py). Route "
+                    f">2B-row ids through distributed_embedding / "
+                    f"the sparse KV path, which keeps int64 keys "
+                    f"on host.")
+            want = np.dtype(info.dtype)
+        if np.dtype(arr.dtype) != want:
+            arr = arr.astype(want)
+    return arr
+
+
+def _referenced_state_names(block, scope, feed_vals):
+    """Persistable vars that already have values in the scope and are
+    referenced by this block (run()/run_steps() shared)."""
+    referenced = set()
+    for op in block.ops:
+        referenced.update(op.input_names())
+        referenced.update(op.output_names())
+    return sorted(
+        n for n in referenced
+        if n != "@EMPTY@"
+        and (v := block.find_var_recursive(n)) is not None
+        and v.persistable and scope.has(n) and n not in feed_vals)
+
+
 class Executor:
     """API-parity with fluid.Executor (reference executor.py:475).
 
@@ -471,51 +565,10 @@ class Executor:
                 feed.update(h.pre(feed))
                 if gb.has_var(h.grad_name) and h.grad_name not in fetch_names:
                     fetch_names.append(h.grad_name)
-        feed_vals = {}
         block = program.global_block()
-        for name, value in feed.items():
-            arr = np.asarray(value) if not hasattr(value, "dtype") else value
-            v = block.find_var_recursive(name)
-            if v is not None and hasattr(arr, "astype"):
-                # cast in place (device-side for jax arrays — feeding device
-                # arrays must NOT bounce through host numpy); 64-bit ints
-                # live as int32 on device (framework/dtype.py policy) with a
-                # range guard here instead of jax's silent truncation
-                want = np.dtype(v.dtype)
-                if isinstance(arr, jax.Array):
-                    want = jax.dtypes.canonicalize_dtype(want)
-                elif want in (np.dtype(np.int64), np.dtype(np.uint64)):
-                    # 64-bit-int var: range-check ANY host feed (int64,
-                    # float64-from-pandas, ...) against the 32-bit device
-                    # dtype instead of jax's silent wraparound
-                    info = (np.iinfo(np.int32) if want == np.dtype(np.int64)
-                            else np.iinfo(np.uint32))
-                    if arr.size and (arr.max() > info.max
-                                     or arr.min() < info.min):
-                        from .errors import InvalidArgumentError
-                        raise InvalidArgumentError(
-                            f"feed {name!r} holds {want.name} ids outside "
-                            f"{info.dtype.name} range; device tensors are "
-                            f"32-bit (see framework/dtype.py). Route "
-                            f">2B-row ids through distributed_embedding / "
-                            f"the sparse KV path, which keeps int64 keys "
-                            f"on host.")
-                    want = np.dtype(info.dtype)
-                if np.dtype(arr.dtype) != want:
-                    arr = arr.astype(want)
-            feed_vals[name] = arr
-
-        # State = persistable vars that already have values in the scope and
-        # are referenced by this program.
-        referenced = set()
-        for op in block.ops:
-            referenced.update(op.input_names())
-            referenced.update(op.output_names())
-        state_names = sorted(
-            n for n in referenced
-            if n != "@EMPTY@"
-            and (v := block.find_var_recursive(n)) is not None
-            and v.persistable and scope.has(n) and n not in feed_vals)
+        feed_vals = {name: _coerce_feed_value(block, name, value)
+                     for name, value in feed.items()}
+        state_names = _referenced_state_names(block, scope, feed_vals)
 
         feed_spec = tuple(sorted((k, tuple(v.shape), str(v.dtype))
                                  for k, v in feed_vals.items()))
@@ -530,7 +583,7 @@ class Executor:
                 # any block class jit-traces (ops/attention.py); one shared
                 # choke point so LocalSGD/pipeline paths get it too
                 from ..ops.attention import prewarm_flash
-                prewarm_flash()
+                prewarm_flash(program)
             if localsgd_k and localsgd_k > 1:
                 compiled = _LocalSGDBlock(program, 0, list(feed_vals),
                                           fetch_names, state_names,
@@ -582,6 +635,79 @@ class Executor:
             for h in ps_hooks:
                 h.post(fetched_by_name)
             fetches = fetches[:n_user_fetch]
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return fetches
+
+    def run_steps(self, k: int, program: Optional[Program] = None,
+                  feed: Optional[dict] = None,
+                  fetch_list: Optional[list] = None,
+                  scope: Optional[Scope] = None, return_numpy: bool = True):
+        """Run `k` train steps as ONE device dispatch (a lax.scan training
+        loop inside a single XLA program — the scaling-book/MaxText loop).
+
+        `feed` arrays either carry a leading [k] axis (per-step slices) or
+        per-step shapes (broadcast: every step sees the same batch).
+        Fetches come back stacked over steps ([k, ...] each). Parameters and
+        optimizer state stay device-resident across all k steps, and host
+        dispatch cost is paid once — on high-latency links (the axon dev
+        tunnel) this is the difference between dispatch-bound and
+        compute-bound training. Random ops draw a distinct key per step
+        (fold_in of the run key), matching k separate run() calls in
+        distribution. Simple single-block programs only (no PS hooks /
+        pipeline / LocalSGD / heter sections)."""
+        import jax.numpy as jnp
+        program = program or default_main_program()
+        if hasattr(program, "_is_data_parallel"):
+            program = program.program
+        from . import errors
+        if getattr(program, "_ps_hooks", None):
+            raise errors.Unimplemented("run_steps with PS hooks")
+        if getattr(program, "_localsgd_k", 0) or \
+                getattr(program, "_microbatch_k", 0):
+            raise errors.Unimplemented(
+                "run_steps with LocalSGD/pipeline programs")
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        scope = scope or global_scope()
+        fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                       for v in fetch_list]
+        gb = program.global_block()
+        for n in fetch_names:
+            if not gb.has_var(n):
+                raise errors.NotFound(
+                    "fetch target %r is not a variable of this program", n,
+                    var=n)
+        feed_vals = {}
+        for name, value in feed.items():
+            arr = _coerce_feed_value(gb, name, value)
+            v = gb.find_var_recursive(name)
+            if v is not None and hasattr(arr, "ndim"):
+                # leading axis: [k] slices, else broadcast the same batch
+                if arr.ndim == len(v.shape):
+                    arr = jnp.broadcast_to(jnp.asarray(arr)[None],
+                                           (k,) + tuple(arr.shape))
+            feed_vals[name] = arr
+        state_names = _referenced_state_names(gb, scope, feed_vals)
+        feed_spec = tuple(sorted((kk, tuple(v.shape), str(v.dtype))
+                                 for kk, v in feed_vals.items()))
+        key = ("multi", k, id(program), program._version, feed_spec,
+               tuple(fetch_names), tuple(state_names))
+        compiled = self._cache.get(key)
+        if compiled is None:
+            if any(op.type == "fused_attention"
+                   for b in program.blocks for op in b.ops):
+                from ..ops.attention import prewarm_flash
+                prewarm_flash(program)
+            compiled = _CompiledBlock(
+                program, 0, list(feed_vals), fetch_names, state_names,
+                multi_k=k)
+            self._cache[key] = compiled
+        rng_key = _next_rng_key(scope, program.random_seed)
+        state = {n: scope.find(n) for n in state_names}
+        fetches, new_state = compiled(state, feed_vals, rng_key)
+        for n, v in new_state.items():
+            scope.set(n, v)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches

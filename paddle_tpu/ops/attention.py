@@ -26,7 +26,8 @@ def _xla_attention(q, k, v, mask, scale, dropout, key):
         scores = scores + mask.astype(scores.dtype)
     probs = jax.nn.softmax(scores, axis=-1)
     if dropout and key is not None:
-        keep = jax.random.bernoulli(key, 1.0 - dropout, probs.shape)
+        from .rng import fast_keep_mask
+        keep = fast_keep_mask(key, 1.0 - dropout, probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout), 0.0)
     probs = probs.astype(v.dtype)
     return jnp.einsum("bnqk,bnkd->bnqd", probs, v)
@@ -90,11 +91,35 @@ def _trace_state_clean() -> bool:
         return not isinstance(jnp.zeros(()), jax.core.Tracer)
 
 
-def prewarm_flash():
+def prewarm_flash(program=None):
     """Run the one-time flash-kernel compile probe NOW, eagerly — executor
     calls this before tracing any block containing fused_attention so the
     lowering can trust the cached verdict (probing mid-trace is impossible;
-    see _flash_probe)."""
+    see _flash_probe). When `program` is given, the ~40s probe compile is
+    skipped unless some fused_attention in it can actually reach the flash
+    path (sequence >= PADDLE_TPU_FLASH_MIN_SEQ)."""
+    import os
+    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS"):
+        return
+    if program is not None:
+        min_seq = int(os.environ.get("PADDLE_TPU_FLASH_MIN_SEQ", "512"))
+        eligible = False
+        for b in program.blocks:
+            for op in b.ops:
+                if op.type != "fused_attention":
+                    continue
+                qv = b.find_var_recursive(op.inputs["Q"][0])
+                if qv is None or len(qv.shape) != 4:
+                    eligible = True          # unknown geometry: probe
+                    continue
+                s, hd = qv.shape[2], qv.shape[3]
+                # mirror _use_pallas's full gate so a model flash can never
+                # serve (odd head dim / non-128 seq) skips the ~40s probe
+                if s < 0 or (s >= min_seq and s % 128 == 0
+                             and hd in (64, 128, 256)):
+                    eligible = True
+        if not eligible:
+            return
     try:
         if jax.default_backend() in ("tpu", "axon"):
             _flash_probe()
@@ -134,6 +159,13 @@ def _use_pallas(q):
     except RuntimeError:
         return False
     b, nh, s, hd = q.shape
+    # short sequences: the [B,nh,S,S] score tensor fits XLA's fused softmax
+    # comfortably and the dense path WINS (round-4 A/B at S=128: dense
+    # 175 ms/step vs flash 230); flash pays off once the S^2 HBM traffic
+    # dominates. Crossover set conservatively at 512, env-overridable.
+    min_seq = int(os.environ.get("PADDLE_TPU_FLASH_MIN_SEQ", "512"))
+    if s < min_seq:
+        return False
     if not (s % 128 == 0 and hd in (64, 128, 256)):
         return False
     return _flash_probe()

@@ -318,7 +318,8 @@ def _dropout(ctx, ins, attrs):
         out = x if impl == "upscale_in_train" else x * (1.0 - p)
         return {"Out": [out], "Mask": [jnp.ones(x.shape, jnp.uint8)]}
     key = ctx.op_key(attrs)
-    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    from .rng import fast_keep_mask
+    keep = fast_keep_mask(key, 1.0 - p, x.shape)
     if impl == "upscale_in_train":
         out = jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
     else:

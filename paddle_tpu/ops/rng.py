@@ -1,0 +1,22 @@
+"""Fast dropout-mask RNG.
+
+Reference counterpart: the reference generates dropout masks with a
+counter-based Philox stream on device (dropout_op.cu GPUDropoutKernel).
+jax's default threefry lowers to a rolled while-loop that costs ~25% of a
+BERT train step in mask bits alone (measured round 4: 175→125 ms/step with
+dropout off); XLA's native RngBitGenerator (RBG) is a single fused pass.
+Masks stay deterministic per op key — the __vjp__ backward re-derives the
+same key and regenerates the identical mask."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def fast_keep_mask(key, keep_prob, shape):
+    """Bernoulli keep-mask drawn from the RBG generator seeded by `key`.
+    Same key -> same mask (what dropout's recompute-in-backward relies on);
+    different fold_in'd op keys -> independent masks."""
+    kd = jax.random.key_data(key).astype(jnp.uint32).reshape(-1)[:2]
+    rbg = jax.random.wrap_key_data(jnp.concatenate([kd, kd]), impl="rbg")
+    return jax.random.bernoulli(rbg, keep_prob, shape)

@@ -1018,8 +1018,8 @@ def _dgc(ctx, ins, attrs):
     # would never sample the tail, biasing the threshold)
     stride = -(-n // min(n, 4096))
     sample = jnp.sort(flat[::stride])
-    m = int(sample.shape[0])
-    pos = jnp.clip((s * m).astype(jnp.int32), 0, m - 1)
+    n_sample = int(sample.shape[0])
+    pos = jnp.clip((s * n_sample).astype(jnp.int32), 0, n_sample - 1)
     thr = sample[pos]
     keep = (jnp.abs(v2) >= thr).astype(v2.dtype)
 
