@@ -207,14 +207,72 @@ class KVClient:
             self._h = None
 
 
+class HotRowCache:
+    """Client-side hot-row cache tier — the box_ps/pslib cache re-imagining
+    (reference box_wrapper caches hot embedding rows in device memory in
+    front of the PS core; here: an LRU of host rows in front of the TCP
+    pulls, the part of that design that is not closed-source).
+
+    Correctness contract: a push to a key INVALIDATES it (server-side
+    optimizers make local replay impossible to do honestly), and every
+    entry expires after `max_stale_pulls` pull calls so other workers'
+    pushes are picked up within a bounded staleness window — the standard
+    async-PS staleness semantics. With one worker the cache is therefore
+    EXACT (tests assert parity)."""
+
+    def __init__(self, capacity_rows: int = 100_000,
+                 max_stale_pulls: int = 16):
+        from collections import OrderedDict
+        self.capacity = int(capacity_rows)
+        self.max_stale = int(max_stale_pulls)
+        self._rows: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._tick = 0
+        self.hits = 0
+        self.misses = 0
+
+    def start_pull(self):
+        self._tick += 1
+
+    def get(self, table: int, key: int):
+        ent = self._rows.get((table, key))
+        if ent is None:
+            self.misses += 1
+            return None
+        row, birth = ent
+        if self._tick - birth > self.max_stale:
+            del self._rows[(table, key)]
+            self.misses += 1
+            return None
+        self._rows.move_to_end((table, key))
+        self.hits += 1
+        return row
+
+    def put(self, table: int, key: int, row) -> None:
+        self._rows[(table, key)] = (row, self._tick)
+        self._rows.move_to_end((table, key))
+        while len(self._rows) > self.capacity:
+            self._rows.popitem(last=False)
+
+    def invalidate(self, table: int, keys) -> None:
+        for k in np.asarray(keys).reshape(-1):
+            self._rows.pop((table, int(k)), None)
+
+    @property
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+
 class ShardedKVClient:
     """Key-sharded client over multiple pservers (reference ps_dispatcher.py
     round-robin param placement; here rows shard by key hash, the
     large-scale-KV convention). Exposes the same pull/push surface as
-    KVClient so hooks are agnostic."""
+    KVClient so hooks are agnostic. `cache_rows` > 0 puts a HotRowCache
+    tier in front of pulls (PADDLE_PS_CACHE_ROWS env default)."""
 
     def __init__(self, endpoints: List[str], worker_id: int = 0,
-                 a_sync: bool = False):
+                 a_sync: bool = False, cache_rows: int = None,
+                 cache_max_stale: int = 16):
         assert endpoints, "ShardedKVClient needs at least one endpoint"
         self.clients = []
         for ep in endpoints:
@@ -222,12 +280,20 @@ class ShardedKVClient:
             self.clients.append(KVClient(host, int(port), worker_id,
                                          a_sync=a_sync))
         self.a_sync = a_sync
+        if cache_rows is None:
+            cache_rows = int(os.environ.get("PADDLE_PS_CACHE_ROWS", "0"))
+        # a_sync buffers pushes client-side (~50ms flush): a post-push pull
+        # would re-cache the PRE-push server row and pin the worker's own
+        # gradient invisible for max_stale pulls — read-your-writes breaks.
+        # The cache tier is therefore a sync-mode feature.
+        self.cache = (HotRowCache(cache_rows, cache_max_stale)
+                      if cache_rows > 0 and not a_sync else None)
 
     def _shard(self, keys: np.ndarray):
         return (keys % len(self.clients)).astype(np.int64)
 
-    def pull(self, table: int, keys: np.ndarray, dim: int) -> np.ndarray:
-        keys = np.ascontiguousarray(keys, np.int64)
+    def _pull_remote(self, table: int, keys: np.ndarray,
+                     dim: int) -> np.ndarray:
         if len(self.clients) == 1:
             return self.clients[0].pull(table, keys, dim)
         out = np.empty((len(keys), dim), np.float32)
@@ -238,9 +304,31 @@ class ShardedKVClient:
                 out[m] = c.pull(table, keys[m], dim)
         return out
 
+    def pull(self, table: int, keys: np.ndarray, dim: int) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, np.int64)
+        if self.cache is None:
+            return self._pull_remote(table, keys, dim)
+        self.cache.start_pull()
+        out = np.empty((len(keys), dim), np.float32)
+        miss = []
+        for i, k in enumerate(keys):
+            row = self.cache.get(table, int(k))
+            if row is None:
+                miss.append(i)
+            else:
+                out[i] = row
+        if miss:
+            rows = self._pull_remote(table, keys[miss], dim)
+            for j, i in enumerate(miss):
+                out[i] = rows[j]
+                self.cache.put(table, int(keys[i]), rows[j].copy())
+        return out
+
     def push(self, table: int, keys: np.ndarray, grads: np.ndarray,
              lr: float):
         keys = np.ascontiguousarray(keys, np.int64)
+        if self.cache is not None:
+            self.cache.invalidate(table, keys)
         if len(self.clients) == 1:
             return self.clients[0].push(table, keys, grads, lr)
         shard = self._shard(keys)
@@ -251,6 +339,8 @@ class ShardedKVClient:
 
     def push_delta(self, table: int, keys: np.ndarray, deltas: np.ndarray):
         keys = np.ascontiguousarray(keys, np.int64)
+        if self.cache is not None:
+            self.cache.invalidate(table, keys)
         if len(self.clients) == 1:
             return self.clients[0].push_delta(table, keys, deltas)
         shard = self._shard(keys)

@@ -299,29 +299,39 @@ def _run_block_multistep(k_steps, block, feed_names, fetch_names, mut_names,
     run() calls would differ across their run keys."""
     import jax
 
-    def body(mut, xs):
+    import jax.numpy as jnp
+
+    # Written persistables NOT seeded in the scope (rare: vars first
+    # materialized by the program itself) must still carry step-to-step —
+    # run() gets that via the scope between calls. Discover their shapes
+    # with eval_shape and seed the carry with zeros; the body overwrites
+    # them before any legal read (run() would KeyError on read-before-
+    # write anyway). Carrying beats stacking them as scan ys ([k, ...]
+    # HBM for values only [-1] of which is used).
+    feeds0 = jax.tree_util.tree_map(lambda a: a[0], feeds)
+    _, st_shapes = jax.eval_shape(
+        lambda m, f, kk: _run_block(block, feed_names, fetch_names,
+                                    mut_names, ro_names, written_state,
+                                    m, ro_state, f, kk),
+        mut_state, feeds0, jax.random.key(0))
+    extra0 = {n: jnp.zeros(s.shape, s.dtype) for n, s in st_shapes.items()
+              if n not in mut_state}
+
+    def body(carry, xs):
+        mut, extra = carry
         step_feeds, idx = xs
         step_key = jax.random.fold_in(rng_key, idx)
         fetches, new_state = _run_block(
             block, feed_names, fetch_names, mut_names, ro_names,
-            written_state, mut, ro_state, step_feeds, step_key)
-        mut2 = dict(mut)
-        extra = {}
-        for n, v in new_state.items():
-            if n in mut2:
-                mut2[n] = v
-            else:
-                extra[n] = v
-        return mut2, (fetches, extra)
+            written_state, {**mut, **extra}, ro_state, step_feeds, step_key)
+        mut2 = {n: new_state.get(n, v) for n, v in mut.items()}
+        extra2 = {n: new_state.get(n, v) for n, v in extra.items()}
+        return (mut2, extra2), fetches
 
-    import jax.numpy as jnp
     xs = (feeds, jnp.arange(k_steps))
-    final_mut, (stacked_fetches, stacked_extra) = jax.lax.scan(
-        body, dict(mut_state), xs, length=k_steps)
-    new_state = dict(final_mut)
-    for n, v in stacked_extra.items():
-        new_state[n] = jax.tree_util.tree_map(lambda a: a[-1], v)
-    return stacked_fetches, new_state
+    (final_mut, final_extra), stacked_fetches = jax.lax.scan(
+        body, (dict(mut_state), extra0), xs, length=k_steps)
+    return stacked_fetches, {**final_mut, **final_extra}
 
 
 def _run_block_microbatched(micro_k, block, feed_names, fetch_names,
@@ -680,13 +690,24 @@ class Executor:
                     var=n)
         feed_vals = {}
         for name, value in feed.items():
-            arr = _coerce_feed_value(gb, name, value)
+            arr = jnp.asarray(_coerce_feed_value(gb, name, value))
             v = gb.find_var_recursive(name)
-            if v is not None and hasattr(arr, "ndim"):
-                # leading axis: [k] slices, else broadcast the same batch
-                if arr.ndim == len(v.shape):
-                    arr = jnp.broadcast_to(jnp.asarray(arr)[None],
-                                           (k,) + tuple(arr.shape))
+            # every scan xs leaf needs a leading [k] axis: a feed whose rank
+            # equals the var's (or any unknown-name feed) is the SAME batch
+            # every step -> broadcast; rank+1 with dim0==k is per-step
+            # slices; anything else is ambiguous -> typed error, no silent
+            # mis-slicing
+            if v is not None and arr.ndim == len(v.shape) + 1 \
+                    and arr.shape[0] == k:
+                pass                                 # per-step slices
+            elif v is None or arr.ndim == len(v.shape):
+                arr = jnp.broadcast_to(arr[None], (k,) + tuple(arr.shape))
+            else:
+                raise errors.InvalidArgument(
+                    "run_steps feed %r: shape %s matches neither the "
+                    "per-step var shape %s nor [k=%d] + that shape", name,
+                    tuple(arr.shape),
+                    tuple(v.shape) if v is not None else None, k)
             feed_vals[name] = arr
         state_names = _referenced_state_names(gb, scope, feed_vals)
         feed_spec = tuple(sorted((kk, tuple(v.shape), str(v.dtype))

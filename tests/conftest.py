@@ -44,6 +44,15 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Persistent XLA compilation cache: re-runs of the suite skip recompiling
+# unchanged programs (compile dominates suite wall time; the cache survives
+# across processes in .jax_cache/, gitignored).
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1.0")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

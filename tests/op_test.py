@@ -107,9 +107,11 @@ def _check_grad_x64(op_type, base, attrs, wrt, out_slots, delta,
     for (slot, idx), a_grad, p in zip(wrt, analytic, primals):
         flat = np.asarray(p, dtype=np.float64).ravel()
         num = np.zeros_like(flat)
-        # probe a bounded sample of coordinates for large inputs
+        # probe a bounded sample of coordinates for large inputs (32 random
+        # coords of a fixed-seed sample keep the check strong; every probe
+        # is 2 full objective evals, so this bounds op-test wall time)
         n = flat.size
-        probe = range(n) if n <= 64 else rng.choice(n, 64, replace=False)
+        probe = range(n) if n <= 32 else rng.choice(n, 32, replace=False)
         for j in probe:
             for sgn in (+1, -1):
                 pert = flat.copy()

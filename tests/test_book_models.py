@@ -155,11 +155,13 @@ def test_machine_translation_seq2seq_beam_decode():
         import numpy as np
         rng = np.random.RandomState(0)
         net = Seq2Seq()
-        opt = paddle.optimizer.Adam(learning_rate=0.01,
+        opt = paddle.optimizer.Adam(learning_rate=0.02,
                                     parameters=net.parameters())
         src_np = rng.randint(2, V, (8, T)).astype(np.int64)
         # teacher-forced training on the copy task: target == source + end
-        for step in range(250):
+        # (140 steps at lr .02 memorizes the 8 fixed sequences; eager-mode
+        # op dispatch makes each step expensive on CPU — suite hygiene)
+        for step in range(140):
             src = paddle.to_tensor(src_np)
             h = net.encode(src)
             tok = paddle.to_tensor(np.full((8,), start, np.int64))

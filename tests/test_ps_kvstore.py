@@ -336,3 +336,49 @@ def test_geo_sgd_convergence_parity_vs_sync():
     # steps — final losses must agree within a small delta
     assert abs(geo[-1] - sync[-1]) <= max(0.25 * sync[-1], 0.05), \
         f"geo={geo[-1]:.4f} vs sync={sync[-1]:.4f}"
+
+
+def test_hot_row_cache_hits_and_parity(server):
+    """Cache tier (box_ps re-imagining): read-mostly pulls hit the cache;
+    pushes invalidate so a 1-worker cached client is EXACT vs uncached."""
+    from paddle_tpu.distributed.ps import ShardedKVClient
+    srv, port = server
+    cached = ShardedKVClient([f"127.0.0.1:{port}"], cache_rows=1000)
+    plain = ShardedKVClient([f"127.0.0.1:{port}"], cache_rows=0)
+    keys = np.arange(10, dtype=np.int64)
+    a = cached.pull(0, keys, 4)
+    np.testing.assert_allclose(a, plain.pull(0, keys, 4))
+    # read-mostly: repeat pulls are all hits
+    for _ in range(5):
+        b = cached.pull(0, keys, 4)
+        np.testing.assert_allclose(b, a)
+    assert cached.cache.hit_rate > 0.7, cached.cache.hit_rate
+    # push invalidates: the next pull sees the server-side SGD update
+    g = np.ones((3, 4), np.float32)
+    cached.push(0, keys[:3], g, lr=0.5)
+    after = cached.pull(0, keys, 4)
+    np.testing.assert_allclose(after[:3], a[:3] - 0.5 * g, atol=1e-6)
+    np.testing.assert_allclose(after[3:], a[3:])
+    np.testing.assert_allclose(after, plain.pull(0, keys, 4))
+
+
+def test_hot_row_cache_staleness_bound(server):
+    """Another worker's push becomes visible within max_stale_pulls."""
+    from paddle_tpu.distributed.ps import ShardedKVClient
+    srv, port = server
+    reader = ShardedKVClient([f"127.0.0.1:{port}"], cache_rows=100,
+                             cache_max_stale=3)
+    writer = ShardedKVClient([f"127.0.0.1:{port}"], cache_rows=0,
+                             worker_id=1)
+    keys = np.array([42], np.int64)
+    v0 = reader.pull(0, keys, 4).copy()
+    writer.push(0, keys, np.ones((1, 4), np.float32), lr=1.0)
+    fresh = writer.pull(0, keys, 4)
+    assert not np.allclose(fresh, v0)
+    seen = [reader.pull(0, keys, 4).copy() for _ in range(5)]
+    assert np.allclose(seen[0], v0)          # still cached
+    np.testing.assert_allclose(seen[-1], fresh)  # expired within bound
+    # LRU eviction respects capacity
+    small = ShardedKVClient([f"127.0.0.1:{port}"], cache_rows=4)
+    small.pull(0, np.arange(10, dtype=np.int64), 4)
+    assert len(small.cache._rows) <= 4

@@ -119,7 +119,7 @@ def test_pipeline_end_to_end_trains_against_live_server():
     loss = _vanilla_program()
     main = fluid.default_main_program()
     startup = fluid.default_startup_program()
-    cfg = PsPassConfig(lr=0.05)
+    cfg = PsPassConfig(lr=0.1)
     build_trainer_program_pipeline(main, startup, cfg)
 
     srv = KVServer(main._ps_tables)
@@ -134,7 +134,7 @@ def test_pipeline_end_to_end_trains_against_live_server():
         w_true = rng.randn(SLOTS * DIM, 1).astype(np.float32)
         yv = (fixed[ids[..., 0]].reshape(B, -1) @ w_true).astype(np.float32)
         losses = []
-        for _ in range(120):
+        for _ in range(60):  # each step round-trips the live KV server
             out, = exe.run(feed={"ids": ids, "y": yv}, fetch_list=[loss])
             losses.append(float(np.asarray(out).reshape(-1)[0]))
         assert np.isfinite(losses).all()

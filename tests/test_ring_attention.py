@@ -51,7 +51,8 @@ def test_ring_attention_is_differentiable():
     import jax, jax.numpy as jnp, numpy as np
     from paddle_tpu.parallel import build_mesh, ring_attention
 
-    mesh = build_mesh(dp=2, sp=4)
+    mesh = build_mesh(dp=2, sp=2)  # 2 hops exercise rotation; sp=4 only
+    # inflates compile time (suite-hygiene round 4)
     b, nh, s, hd = 2, 2, 16, 8
     rng = np.random.RandomState(1)
     q, k, v = (jnp.asarray(rng.randn(b, nh, s, hd).astype(np.float32))
@@ -73,7 +74,7 @@ def test_ring_attention_is_differentiable():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=2e-3, atol=2e-4)
     print("OK")
-    """)
+    """, n_devices=4)
 
 
 def test_ulysses_matches_dense():
@@ -170,7 +171,8 @@ def test_ring_dropout_semantics_and_determinism():
     import jax, jax.numpy as jnp, numpy as np
     from paddle_tpu.parallel import build_mesh, ring_attention
 
-    mesh = build_mesh(dp=2, sp=4)
+    mesh = build_mesh(dp=2, sp=2)  # 2 hops: same cross-shard dropout
+    # semantics, half the ring-program compile (suite hygiene)
     b, nh, s, hd = 2, 2, 32, 32
     rng = np.random.RandomState(2)
     q = jnp.asarray(rng.randn(b, nh, s, hd).astype(np.float32)) * 0.3
@@ -194,7 +196,7 @@ def test_ring_dropout_semantics_and_determinism():
         a, k, v_eye, mesh=mesh, dropout=rate, seed=9)))(q)
     assert np.isfinite(np.asarray(g)).all()
     print("OK")
-    """)
+    """, n_devices=4)
 
 
 def test_sp_program_trains_with_mask_and_dropout():

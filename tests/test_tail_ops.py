@@ -652,7 +652,7 @@ def test_mean_iou():
     out = run_op("mean_iou", {"Predictions": [pred], "Labels": [lab]},
                  {"num_classes": 3})
     # class0: 1/1, class1: 1/2, class2: 1/2 → mean = 2/3
-    np.testing.assert_allclose(float(np.asarray(out["OutMeanIou"][0])),
+    np.testing.assert_allclose(float(np.asarray(out["OutMeanIou"][0]).reshape(-1)[0]),
                                2 / 3, rtol=1e-5)
 
 
@@ -662,8 +662,8 @@ def test_positive_negative_pair():
     q = np.array([7, 7, 7, 7], np.int64)
     out = run_op("positive_negative_pair",
                  {"Score": [s], "Label": [l], "QueryID": [q]}, {})
-    assert float(np.asarray(out["PositivePair"][0])) == 4.0
-    assert float(np.asarray(out["NegativePair"][0])) == 0.0
+    assert float(np.asarray(out["PositivePair"][0]).reshape(-1)[0]) == 4.0
+    assert float(np.asarray(out["NegativePair"][0]).reshape(-1)[0]) == 0.0
 
 
 def test_chunk_eval_iob():
@@ -673,10 +673,10 @@ def test_chunk_eval_iob():
     out = run_op("chunk_eval", {"Inference": [inf], "Label": [lab]},
                  {"num_chunk_types": 2, "chunk_scheme": "IOB"})
     # inferred chunks: (0,2,0),(3,5,1); label chunks: (0,2,0),(3,4,1)
-    assert int(np.asarray(out["NumInferChunks"][0])) == 2
-    assert int(np.asarray(out["NumLabelChunks"][0])) == 2
-    assert int(np.asarray(out["NumCorrectChunks"][0])) == 1
-    np.testing.assert_allclose(float(np.asarray(out["Precision"][0])), 0.5)
+    assert int(np.asarray(out["NumInferChunks"][0]).reshape(-1)[0]) == 2
+    assert int(np.asarray(out["NumLabelChunks"][0]).reshape(-1)[0]) == 2
+    assert int(np.asarray(out["NumCorrectChunks"][0]).reshape(-1)[0]) == 1
+    np.testing.assert_allclose(float(np.asarray(out["Precision"][0]).reshape(-1)[0]), 0.5)
 
 
 def test_chunk_eval_ioe():
@@ -685,10 +685,10 @@ def test_chunk_eval_ioe():
     lab = np.array([[0, 1, 4, 3]], np.int64)   # chunks (0,1,0),(3,3,1)
     out = run_op("chunk_eval", {"Inference": [inf], "Label": [lab]},
                  {"num_chunk_types": 2, "chunk_scheme": "IOE"})
-    assert int(np.asarray(out["NumInferChunks"][0])) == 2
-    assert int(np.asarray(out["NumLabelChunks"][0])) == 2
-    assert int(np.asarray(out["NumCorrectChunks"][0])) == 1
-    np.testing.assert_allclose(float(np.asarray(out["Precision"][0])), 0.5)
+    assert int(np.asarray(out["NumInferChunks"][0]).reshape(-1)[0]) == 2
+    assert int(np.asarray(out["NumLabelChunks"][0]).reshape(-1)[0]) == 2
+    assert int(np.asarray(out["NumCorrectChunks"][0]).reshape(-1)[0]) == 1
+    np.testing.assert_allclose(float(np.asarray(out["Precision"][0]).reshape(-1)[0]), 0.5)
 
 
 def test_chunk_eval_iobes():
@@ -697,9 +697,9 @@ def test_chunk_eval_iobes():
     lab = np.array([[3, 8, 4, 5, 8]], np.int64)  # (0,0,0),(2,3,1)
     out = run_op("chunk_eval", {"Inference": [inf], "Label": [lab]},
                  {"num_chunk_types": 2, "chunk_scheme": "IOBES"})
-    assert int(np.asarray(out["NumInferChunks"][0])) == 2
-    assert int(np.asarray(out["NumCorrectChunks"][0])) == 1
-    np.testing.assert_allclose(float(np.asarray(out["Recall"][0])), 0.5)
+    assert int(np.asarray(out["NumInferChunks"][0]).reshape(-1)[0]) == 2
+    assert int(np.asarray(out["NumCorrectChunks"][0]).reshape(-1)[0]) == 1
+    np.testing.assert_allclose(float(np.asarray(out["Recall"][0]).reshape(-1)[0]), 0.5)
 
 
 def test_chunk_eval_plain_groups_runs():
@@ -709,9 +709,9 @@ def test_chunk_eval_plain_groups_runs():
     lab = np.array([[0, 0, 1, 2]], np.int64)
     out = run_op("chunk_eval", {"Inference": [inf], "Label": [lab]},
                  {"num_chunk_types": 2, "chunk_scheme": "plain"})
-    assert int(np.asarray(out["NumInferChunks"][0])) == 2
-    assert int(np.asarray(out["NumCorrectChunks"][0])) == 2
-    np.testing.assert_allclose(float(np.asarray(out["F1-Score"][0])), 1.0)
+    assert int(np.asarray(out["NumInferChunks"][0]).reshape(-1)[0]) == 2
+    assert int(np.asarray(out["NumCorrectChunks"][0]).reshape(-1)[0]) == 2
+    np.testing.assert_allclose(float(np.asarray(out["F1-Score"][0]).reshape(-1)[0]), 1.0)
 
 
 def test_chunk_eval_excluded_types():
@@ -721,10 +721,10 @@ def test_chunk_eval_excluded_types():
     out = run_op("chunk_eval", {"Inference": [inf], "Label": [lab]},
                  {"num_chunk_types": 2, "chunk_scheme": "IOB",
                   "excluded_chunk_types": [0]})
-    assert int(np.asarray(out["NumInferChunks"][0])) == 1
-    assert int(np.asarray(out["NumLabelChunks"][0])) == 1
-    assert int(np.asarray(out["NumCorrectChunks"][0])) == 0
-    np.testing.assert_allclose(float(np.asarray(out["Precision"][0])), 0.0)
+    assert int(np.asarray(out["NumInferChunks"][0]).reshape(-1)[0]) == 1
+    assert int(np.asarray(out["NumLabelChunks"][0]).reshape(-1)[0]) == 1
+    assert int(np.asarray(out["NumCorrectChunks"][0]).reshape(-1)[0]) == 0
+    np.testing.assert_allclose(float(np.asarray(out["Precision"][0]).reshape(-1)[0]), 0.0)
 
 
 def test_chunk_eval_unknown_scheme_raises():
