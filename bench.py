@@ -167,6 +167,8 @@ def bench_bert(batch, seq_len, steps, masked=False):
     _fresh_programs()
     cfg = bert.BertConfig()          # BERT-base geometry
     cfg.seq_len = seq_len
+    if seq_len > cfg.max_position:
+        cfg.max_position = seq_len   # long-context configs (seq 1024)
     ids, labels, loss = bert.build_pretrain_program(
         cfg, use_input_mask=masked)
     gb = fluid.default_main_program().global_block()
@@ -347,6 +349,22 @@ def main():
         except Exception as e:  # pragma: no cover
             print(f"masked-bert bench failed: {e!r}", file=sys.stderr)
             errors.append(f"masked-bert: {e!r}")
+    if tokens_per_sec is not None and which in ("all", "longseq"):
+        try:
+            # long-context config: S=1024 engages the pallas flash kernels
+            # (gated off below PADDLE_TPU_FLASH_MIN_SEQ=512 where dense XLA
+            # wins) — this row certifies the in-kernel mask+dropout flash
+            # path on hardware at the seq lengths it exists for
+            tps_l, mfu_l = bench_bert(int(os.environ.get("BENCH_LONG_BATCH",
+                                                         "16")),
+                                      1024, max(steps // 2, 5), masked=True)
+            extras.append({
+                "metric": "bert_base_seq1024_flash_tokens_per_sec_per_chip",
+                "value": round(tps_l, 1), "unit": "tokens/s",
+                "mfu": round(mfu_l, 4)})
+        except Exception as e:  # pragma: no cover
+            print(f"long-seq bench failed: {e!r}", file=sys.stderr)
+            errors.append(f"longseq: {e!r}")
     if tokens_per_sec is not None and which in ("all", "resnet"):
         try:
             ips = bench_resnet50(int(os.environ.get("BENCH_RESNET_BATCH",

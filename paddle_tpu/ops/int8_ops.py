@@ -1,0 +1,205 @@
+"""INT8 dequantize tail + fused CPU-era LSTM ops.
+
+Reference counterparts:
+  * dequantize_abs_max_op.cc — int8 rows back to float via scale/127;
+  * dequantize_log_op.cc — sign-folded 128-entry log dictionary lookup;
+  * lookup_table_dequant_op.h:31 (`dequant`) — embedding rows stored as
+    [min, max, uint8 payload]; out = min + scale * byte;
+  * fake_quantize_op.cc FakeQuantizeMovingAverageAbsMax — quantize-only
+    twin of the already-registered fake_quantize_dequantize_* family;
+  * attention_lstm_op.cc:333-434 — per-step attention over the full
+    sequence conditioned on the previous cell, then one LSTM step; LSTM
+    weight rows are [D hidden | M input], gate order
+    [forget, input, output, candidate] (:404);
+  * fused/fused_embedding_fc_lstm_op.cc:149 — ids looked up in an
+    embedding table PRE-multiplied with the FC weight ([V, 4D]), then the
+    recurrent LSTM half;
+  * conv_transpose_op.cc depthwise_conv2d_transpose — grouped transpose
+    conv, groups == channels.
+
+LoD convention: padded-dense [B, T, ...] + optional SeqLen lengths
+(docs/lod_design.md).
+
+The mkldnn-only quantize/dequantize/requantize runtime ops
+(quantize_op.cc et al.) are accelerator-specific and intentionally absent
+(README scope cuts).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, get as get_op
+
+
+@register("dequantize_abs_max", nondiff_slots=("X", "Scale"))
+def _dequantize_abs_max(ctx, ins, attrs):
+    x = ins["X"][0]                       # int8 payload
+    scale = ins["Scale"][0].reshape(())
+    max_range = attrs.get("max_range", 127.0)
+    return {"Out": [x.astype(jnp.float32) * (scale / max_range)]}
+
+
+@register("dequantize_log", nondiff_slots=("X", "Dict"))
+def _dequantize_log(ctx, ins, attrs):
+    x = ins["X"][0]                       # int8
+    dic = ins["Dict"][0].reshape(-1)      # [128] float
+    xi = x.astype(jnp.int32)
+    neg = xi < 0
+    idx = jnp.where(neg, xi + 128, xi)
+    vals = dic[jnp.clip(idx, 0, dic.shape[0] - 1)]
+    return {"Out": [jnp.where(neg, -vals, vals)]}
+
+
+@register("lookup_table_dequant", nondiff_slots=("W", "Ids"))
+def _lookup_table_dequant(ctx, ins, attrs):
+    """Rows of W are [min, max, byte0..byteK] with the payload stored as
+    uint8 reinterpreted through float32 lanes (lookup_table_dequant_op.h
+    packs 4 bytes per float); here W is the already-byte-expanded
+    [V, 2 + row_width] float table: col0=min, col1=max, rest=bytes."""
+    w, ids = ins["W"][0], ins["Ids"][0]
+    idx = ids.astype(jnp.int32)
+    if idx.shape and idx.shape[-1] == 1:
+        idx = jnp.squeeze(idx, -1)
+    pow_2_bits = float(1 << int(attrs.get("quant_bits", 8)))
+    rows = w[jnp.clip(idx, 0, w.shape[0] - 1)]
+    mn = rows[..., 0:1]
+    mx = rows[..., 1:2]
+    bytes_ = rows[..., 2:]
+    out = (mx - mn) / pow_2_bits * bytes_ + mn
+    padding_idx = attrs.get("padding_idx", -1)
+    if padding_idx is not None and padding_idx != -1:
+        out = jnp.where((idx == padding_idx)[..., None],
+                        jnp.zeros_like(out), out)
+    return {"Out": [out]}
+
+
+@register("fake_quantize_moving_average_abs_max",
+          stateful_outputs=("OutState", "OutAccum", "OutScale"),
+          nondiff_slots=("InScale", "InState", "InAccum"))
+def _fake_quantize_moving_average_abs_max(ctx, ins, attrs):
+    x = ins["X"][0]
+    bit_length = attrs.get("bit_length", 8)
+    bin_cnt = float(2 ** (bit_length - 1) - 1)
+    rate = attrs.get("moving_rate", 0.9)
+    state = ins.get("InState", [None])[0]
+    accum = ins.get("InAccum", [None])[0]
+    cur = jnp.max(jnp.abs(x))
+    if state is not None and accum is not None:
+        new_state = state * rate + 1.0
+        new_accum = accum * rate + cur
+        scale = (new_accum / new_state).reshape(())
+        extra = {"OutState": [new_state], "OutAccum": [new_accum]}
+    else:
+        scale = cur
+        extra = {}
+    q = jnp.clip(jnp.round(x / jnp.maximum(scale, 1e-9) * bin_cnt),
+                 -bin_cnt, bin_cnt)
+    return {"Out": [q.astype(x.dtype)], "OutScale": [scale.reshape(1)],
+            **extra}
+
+
+def _bias_relu(v, b):
+    if b is not None:
+        v = v + b.reshape(-1)[0] if b.size == 1 else v + b.reshape(-1)
+    return jnp.maximum(v, 0.0)
+
+
+@register("attention_lstm",
+          nondiff_slots=("SeqLen",))
+def _attention_lstm(ctx, ins, attrs):
+    x = ins["X"][0]                          # [B, T, M] padded
+    c0 = ins["C0"][0]                        # [B, D]
+    h0 = ins.get("H0", [None])[0]
+    attn_w = ins["AttentionWeight"][0]       # [M+D, 1]
+    attn_b = ins.get("AttentionBias", [None])[0]
+    attn_s = ins.get("AttentionScalar", [None])[0]
+    attn_sb = ins.get("AttentionScalarBias", [None])[0]
+    lstm_w = ins["LSTMWeight"][0]            # [D+M, 4D] rows [Wh | Wx]
+    lstm_b = ins["LSTMBias"][0].reshape(-1)  # [4D]
+    seq_len = ins.get("SeqLen", [None])[0]
+    b_, t, m = x.shape
+    d = c0.shape[-1]
+    wh, wx = lstm_w[:d], lstm_w[d:]
+    if h0 is None:
+        h0 = jnp.zeros_like(c0)
+    if seq_len is None:
+        valid = jnp.ones((b_, t), bool)
+    else:
+        valid = jnp.arange(t)[None, :] < seq_len.reshape(-1, 1)
+
+    gact = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "relu": jax.nn.relu, "identity": lambda v: v}
+    act_gate = gact[attrs.get("gate_activation", "sigmoid")]
+    act_cell = gact[attrs.get("cell_activation", "tanh")]
+    act_cand = gact[attrs.get("candidate_activation", "tanh")]
+
+    def step(carry, tt):
+        h_prev, c_prev = carry
+        # attention over the FULL sequence conditioned on c_prev
+        cat = jnp.concatenate(
+            [x, jnp.broadcast_to(c_prev[:, None, :], (b_, t, d))], -1)
+        fc = _bias_relu(jnp.einsum("btf,fo->bto", cat, attn_w)[..., 0],
+                        attn_b)                                   # [B, T]
+        if attn_s is not None:
+            fc = _bias_relu(fc * attn_s.reshape(-1)[0], attn_sb)
+        fc = jnp.where(valid, fc, -jnp.inf)
+        probs = jax.nn.softmax(fc, -1)
+        lstm_x = jnp.einsum("bt,btm->bm", probs, x)               # [B, M]
+        gates = lstm_x @ wx + h_prev @ wh + lstm_b                # [B, 4D]
+        f = act_gate(gates[:, :d])
+        i = act_gate(gates[:, d:2 * d])
+        o = act_gate(gates[:, 2 * d:3 * d])
+        cand = act_cand(gates[:, 3 * d:])
+        c = f * c_prev + i * cand
+        h = o * act_cell(c)
+        live = valid[:, tt][:, None]
+        h = jnp.where(live, h, h_prev)
+        c = jnp.where(live, c, c_prev)
+        out_h = jnp.where(live, h, jnp.zeros_like(h))
+        out_c = jnp.where(live, c, jnp.zeros_like(c))
+        return (h, c), (out_h, out_c)
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), jnp.arange(t))
+    hidden = jnp.moveaxis(hs, 0, 1)          # [B, T, D]
+    cell = jnp.moveaxis(cs, 0, 1)
+    return {"Hidden": [hidden], "Cell": [cell]}
+
+
+@register("fused_embedding_fc_lstm", nondiff_slots=("Ids", "SeqLen"))
+def _fused_embedding_fc_lstm(ctx, ins, attrs):
+    """Ids -> rows of the fc-premultiplied embedding table ([V, 4D]), then
+    the recurrent LSTM half via the registered lstm lowering (the same
+    delegation fusion_lstm uses)."""
+    ids = ins["Ids"][0]
+    table = ins["Embeddings"][0]
+    idx = ids.astype(jnp.int32)
+    if idx.shape and idx.shape[-1] == 1:
+        idx = jnp.squeeze(idx, -1)
+    proj = table[jnp.clip(idx, 0, table.shape[0] - 1)]   # [B, T, 4D]
+    sub_ins = {"Input": [proj], "Weight": [ins["WeightH"][0]],
+               "Bias": [ins.get("Bias", [None])[0]]}
+    for slot in ("SeqLen", "H0", "C0"):
+        if slot in ins:
+            sub_ins[slot] = ins[slot]
+    out = get_op("lstm").lower(ctx, sub_ins, dict(attrs))
+    hidden = out.get("Hidden", out.get("Out"))
+    return {"Hidden": hidden, "Cell": out.get("Cell", hidden),
+            "XX": [proj]}
+
+
+@register("depthwise_conv2d_transpose")
+def _depthwise_conv2d_transpose(ctx, ins, attrs):
+    """Transpose conv with groups == channels: each channel deconvolves
+    with its own [1,1,kh,kw] filter — vmapped single-channel conv_transpose
+    (XLA fuses the batched grouped conv; jax.lax.conv_transpose itself has
+    no feature_group knob)."""
+    x, w = ins["Input"][0], ins["Filter"][0]   # x [N,C,H,W]; w [C,1,kh,kw]
+
+    def one(xc, wc):      # xc [N,1,H,W], wc [1,1,kh,kw]
+        return get_op("conv2d_transpose").lower(
+            ctx, {"Input": [xc], "Filter": [wc]}, dict(attrs))["Output"][0]
+
+    out = jax.vmap(one, in_axes=(1, 0), out_axes=1)(x[:, :, None],
+                                                    w[:, None])
+    return {"Output": [out[:, :, 0]]}

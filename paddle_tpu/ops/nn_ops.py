@@ -54,16 +54,24 @@ def _depthwise_conv2d(ctx, ins, attrs):
 
 @register("conv2d_transpose")
 def _conv2d_transpose(ctx, ins, attrs):
+    """conv2d_transpose_op.cc: Filter is [C_in, C_out/groups, kh, kw];
+    H_out = (H-1)*stride - 2*pad + dilation*(k-1) + 1. transpose_kernel
+    swaps the kernel's channel axes, so paddle's layout must be DECLARED
+    as OIHW (post-swap the in-channel axis lands on dim 0), and paddle
+    padding p maps to the gradient-conv padding dil*(k-1) - p."""
     x, w = ins["Input"][0], ins["Filter"][0]
     strides = _pair(attrs.get("strides", [1, 1]))
     paddings = _pair(attrs.get("paddings", [0, 0]))
     dilations = _pair(attrs.get("dilations", [1, 1]))
+    kh, kw = w.shape[2], w.shape[3]
+    ph = dilations[0] * (kh - 1) - paddings[0]
+    pw = dilations[1] * (kw - 1) - paddings[1]
     out = jax.lax.conv_transpose(
         x, w,
         strides=strides,
-        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
+        padding=[(ph, ph), (pw, pw)],
         rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
         transpose_kernel=True,
     )
     return {"Output": [out]}

@@ -59,7 +59,11 @@ def _assign(ctx, ins, attrs):
 @register("assign_value")
 def _assign_value(ctx, ins, attrs):
     shape = attrs["shape"]
-    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    # canonicalize first: a float64 request under the 32-bit device policy
+    # (framework/dtype.py) silently means f32 — asking asarray for f64
+    # would warn-and-truncate to the same result
+    dtype = jax.dtypes.canonicalize_dtype(
+        convert_dtype(attrs.get("dtype", "float32")))
     values = attrs.get("values", attrs.get("fp32_values", []))
     return {"Out": [jnp.asarray(np.array(values), dtype=dtype).reshape(shape)]}
 

@@ -233,4 +233,4 @@ def test_array_write_grows_for_static_index():
     exe = fluid.Executor()
     out, ln = exe.run(feed={}, fetch_list=[got, n])
     np.testing.assert_allclose(out, [14.0, 14.0, 14.0])
-    assert int(np.asarray(ln)) == 6
+    assert int(np.asarray(ln).reshape(-1)[0]) == 6

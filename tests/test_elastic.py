@@ -84,6 +84,9 @@ def test_sigterm_checkpoints_and_exits_143(tmp_path):
     lines = []
     while time.time() < deadline:
         line = proc.stdout.readline()
+        if line == "":          # EOF: child died before reaching step 2
+            assert proc.poll() is None, (proc.returncode, lines)
+            break
         lines.append(line)
         if line.startswith("STEP 2"):
             break

@@ -1,0 +1,181 @@
+"""INT8 dequantize tail + fused LSTM ops (reference tests:
+test_dequantize_abs_max_op.py, test_dequantize_log_op.py,
+test_lookup_table_dequant_op.py, test_fake_quantize_op.py,
+test_attention_lstm_op.py, test_fused_emb_fc_lstm_op.py)."""
+import numpy as np
+
+import paddle_tpu  # noqa: F401
+from op_test import run_op
+
+R = np.random.RandomState(0)
+
+
+def test_dequantize_abs_max():
+    x = R.randint(-127, 128, (4, 6)).astype(np.int8)
+    scale = np.array([3.5], np.float32)
+    out = run_op("dequantize_abs_max",
+                 {"X": [x], "Scale": [scale]}, {"max_range": 127.0})
+    np.testing.assert_allclose(np.asarray(out["Out"][0]),
+                               x.astype(np.float32) * 3.5 / 127.0,
+                               rtol=1e-6)
+
+
+def test_dequantize_log_sign_folding():
+    dic = np.linspace(0.1, 12.8, 128).astype(np.float32)
+    x = np.array([[-128, -1, 0, 5, 127]], np.int8)
+    out = np.asarray(run_op("dequantize_log",
+                            {"X": [x], "Dict": [dic]}, {})["Out"][0])
+    expect = np.array([[-dic[0], -dic[127], dic[0], dic[5], dic[127]]])
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+def test_lookup_table_dequant():
+    v, width = 5, 6
+    mn = R.uniform(-2, -1, (v, 1)).astype(np.float32)
+    mx = R.uniform(1, 2, (v, 1)).astype(np.float32)
+    payload = R.randint(0, 256, (v, width)).astype(np.float32)
+    w = np.concatenate([mn, mx, payload], axis=1)
+    ids = np.array([[0], [3], [4]], np.int64)
+    out = np.asarray(run_op("lookup_table_dequant",
+                            {"W": [w], "Ids": [ids]},
+                            {"quant_bits": 8})["Out"][0])
+    for r, i in enumerate([0, 3, 4]):
+        scale = (mx[i, 0] - mn[i, 0]) / 256.0
+        np.testing.assert_allclose(out[r], scale * payload[i] + mn[i, 0],
+                                   rtol=1e-5)
+
+
+def test_fake_quantize_moving_average_abs_max():
+    x = R.randn(8, 8).astype(np.float32) * 2
+    state = np.array([1.0], np.float32)
+    accum = np.array([1.5], np.float32)
+    out = run_op("fake_quantize_moving_average_abs_max",
+                 {"X": [x], "InState": [state], "InAccum": [accum]},
+                 {"bit_length": 8, "moving_rate": 0.9})
+    new_state = 0.9 * 1.0 + 1.0
+    new_accum = 0.9 * 1.5 + np.abs(x).max()
+    scale = new_accum / new_state
+    np.testing.assert_allclose(
+        float(np.asarray(out["OutScale"][0]).reshape(-1)[0]), scale,
+        rtol=1e-5)
+    q = np.asarray(out["Out"][0])
+    np.testing.assert_allclose(
+        q, np.clip(np.round(x / scale * 127), -127, 127), atol=1e-4)
+
+
+def _np_attention_lstm(x, lens, c0, h0, attn_w, lstm_w, lstm_b):
+    """Loop oracle mirroring attention_lstm_op.cc:333-434."""
+    b, t, m = x.shape
+    d = c0.shape[-1]
+    wh, wx = lstm_w[:d], lstm_w[d:]
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    hidden = np.zeros((b, t, d), np.float32)
+    cell = np.zeros((b, t, d), np.float32)
+    for bi in range(b):
+        h, c = h0[bi].copy(), c0[bi].copy()
+        for tt in range(lens[bi]):
+            seq = x[bi, :lens[bi]]
+            cat = np.concatenate(
+                [seq, np.tile(c[None, :], (lens[bi], 1))], -1)
+            fc = np.maximum(cat @ attn_w[:, 0], 0.0)
+            e = np.exp(fc - fc.max())
+            probs = e / e.sum()
+            lx = probs @ seq
+            gates = lx @ wx + h @ wh + lstm_b
+            f, i, o = sig(gates[:d]), sig(gates[d:2 * d]), \
+                sig(gates[2 * d:3 * d])
+            cand = np.tanh(gates[3 * d:])
+            c = f * c + i * cand
+            h = o * np.tanh(c)
+            hidden[bi, tt], cell[bi, tt] = h, c
+    return hidden, cell
+
+
+def test_attention_lstm_matches_loop_oracle():
+    b, t, m, d = 2, 5, 3, 4
+    x = R.randn(b, t, m).astype(np.float32) * 0.5
+    lens = np.array([5, 3], np.int64)
+    c0 = R.randn(b, d).astype(np.float32) * 0.3
+    h0 = R.randn(b, d).astype(np.float32) * 0.3
+    attn_w = R.randn(m + d, 1).astype(np.float32)
+    lstm_w = R.randn(d + m, 4 * d).astype(np.float32) * 0.4
+    lstm_b = R.randn(1, 4 * d).astype(np.float32) * 0.1
+    out = run_op("attention_lstm",
+                 {"X": [x], "SeqLen": [lens], "C0": [c0], "H0": [h0],
+                  "AttentionWeight": [attn_w], "LSTMWeight": [lstm_w],
+                  "LSTMBias": [lstm_b]}, {})
+    want_h, want_c = _np_attention_lstm(x, lens, c0, h0, attn_w, lstm_w,
+                                        lstm_b.reshape(-1))
+    np.testing.assert_allclose(np.asarray(out["Hidden"][0]), want_h,
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(out["Cell"][0]), want_c,
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_fused_embedding_fc_lstm_matches_lstm():
+    """ids -> premultiplied table rows == feeding those rows to the lstm op
+    directly (fused_embedding_fc_lstm_op.cc's contract)."""
+    v, b, t, d = 7, 2, 4, 3
+    table = R.randn(v, 4 * d).astype(np.float32) * 0.3
+    ids = R.randint(0, v, (b, t, 1)).astype(np.int64)
+    wh = R.randn(d, 4 * d).astype(np.float32) * 0.3
+    bias = R.randn(4 * d).astype(np.float32) * 0.1
+    lens = np.array([4, 2], np.int64)
+    fused = run_op("fused_embedding_fc_lstm",
+                   {"Ids": [ids], "Embeddings": [table], "WeightH": [wh],
+                    "Bias": [bias], "SeqLen": [lens]}, {})
+    proj = table[ids[..., 0]]
+    plain = run_op("lstm", {"Input": [proj], "Weight": [wh],
+                            "Bias": [bias], "SeqLen": [lens]}, {})
+    np.testing.assert_allclose(np.asarray(fused["Hidden"][0]),
+                               np.asarray(plain["Hidden"][0]), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(fused["Cell"][0]),
+                               np.asarray(plain["Cell"][0]), rtol=1e-6)
+
+
+def test_depthwise_conv2d_transpose():
+    c = 3
+    x = R.randn(2, c, 5, 5).astype(np.float32)
+    w = R.randn(c, 1, 3, 3).astype(np.float32)
+    out = run_op("depthwise_conv2d_transpose",
+                 {"Input": [x], "Filter": [w]},
+                 {"strides": [1, 1], "paddings": [0, 0],
+                  "dilations": [1, 1]})
+    got = np.asarray(out["Output"][0])
+    assert got.shape == (2, c, 7, 7)
+    # depthwise independence: zeroing channel 1's input zeroes ONLY its out
+    x2 = x.copy()
+    x2[:, 1] = 0
+    got2 = np.asarray(run_op("depthwise_conv2d_transpose",
+                             {"Input": [x2], "Filter": [w]},
+                             {"strides": [1, 1], "paddings": [0, 0],
+                              "dilations": [1, 1]})["Output"][0])
+    np.testing.assert_allclose(got2[:, 1], 0, atol=1e-6)
+    np.testing.assert_allclose(got2[:, 0], got[:, 0], rtol=1e-5)
+    np.testing.assert_allclose(got2[:, 2], got[:, 2], rtol=1e-5)
+
+
+def test_conv2d_transpose_matches_scatter_oracle():
+    """Base-op value check (conv2d_transpose_op.cc semantics): scatter-add
+    formulation out[co, i*s+ki-p, j*s+kj-p] += x[ci,i,j] * w[ci,co,ki,kj].
+    Round 4 fixed the kernel-layout declaration (C_in != C_out crashed
+    before) and the stride-1 padding mapping."""
+    n, ci, co, h, k, s, p = 2, 2, 3, 4, 3, 2, 1
+    x = R.randn(n, ci, h, h).astype(np.float32)
+    w = R.randn(ci, co, k, k).astype(np.float32)
+    out = np.asarray(run_op("conv2d_transpose",
+                            {"Input": [x], "Filter": [w]},
+                            {"strides": [s, s], "paddings": [p, p],
+                             "dilations": [1, 1]})["Output"][0])
+    ho = (h - 1) * s - 2 * p + k
+    assert out.shape == (n, co, ho, ho)
+    want = np.zeros((n, co, ho + 2 * p, ho + 2 * p), np.float32)
+    for bi in range(n):
+        for c_in in range(ci):
+            for c_out in range(co):
+                for i in range(h):
+                    for j in range(h):
+                        want[bi, c_out, i * s:i * s + k, j * s:j * s + k] \
+                            += x[bi, c_in, i, j] * w[c_in, c_out]
+    want = want[:, :, p:p + ho, p:p + ho]
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)

@@ -15,7 +15,7 @@ def test_fake_qdq_numerics_and_ste_grad():
     out = run_op("fake_quantize_dequantize_abs_max", {"X": [x]},
                  {"bit_length": 8})
     o = np.asarray(out["Out"][0])
-    scale = float(np.asarray(out["OutScale"][0]))
+    scale = float(np.asarray(out["OutScale"][0]).reshape(-1)[0])
     assert abs(scale - np.abs(x).max()) < 1e-6
     q = np.clip(np.round(x / scale * 127), -127, 127)
     np.testing.assert_allclose(o, q * scale / 127, rtol=1e-5, atol=1e-6)
