@@ -119,6 +119,8 @@ class DistributedStrategy:
     build_strategy: dict = field(default_factory=dict)
     a_sync: bool = False                   # PS async mode (host KV path)
     a_sync_configs: dict = field(default_factory=dict)
+    sparse_cache_rows: int = 0             # client hot-row cache tier
+    # (box_ps re-imagining, ps.py HotRowCache; sync mode only)
 
 
 class _Fleet:
@@ -241,9 +243,14 @@ class _Fleet:
             eps = [endpoint] if isinstance(endpoint, str) else list(endpoint)
         if a_sync is None:
             a_sync = bool(self._strategy and self._strategy.a_sync)
+        # strategy value 0 = "not requested" -> the PADDLE_PS_CACHE_ROWS
+        # env default still applies inside the client
+        cache_rows = (int(self._strategy.sparse_cache_rows) or None
+                      if self._strategy else None)
         self._kv_client = ShardedKVClient(eps,
                                           worker_id=self.worker_index(),
-                                          a_sync=a_sync)
+                                          a_sync=a_sync,
+                                          cache_rows=cache_rows)
         # Geo-SGD: a_sync + k_steps>0 turns hooks into k-step local training
         # with param-delta pushes (reference geo_sgd_transpiler.py +
         # communicator.h:413)

@@ -82,17 +82,23 @@ def _fake_quantize_moving_average_abs_max(ctx, ins, attrs):
     bit_length = attrs.get("bit_length", 8)
     bin_cnt = float(2 ** (bit_length - 1) - 1)
     rate = attrs.get("moving_rate", 0.9)
+    in_scale = ins.get("InScale", [None])[0]
     state = ins.get("InState", [None])[0]
     accum = ins.get("InAccum", [None])[0]
-    cur = jnp.max(jnp.abs(x))
-    if state is not None and accum is not None:
-        new_state = state * rate + 1.0
-        new_accum = accum * rate + cur
-        scale = (new_accum / new_state).reshape(())
-        extra = {"OutState": [new_state], "OutAccum": [new_accum]}
+    extra = {}
+    if attrs.get("is_test", False) and in_scale is not None:
+        # inference: the CALIBRATED scale, moving-average state untouched
+        # (fake_quantize_op.cc test-mode branch)
+        scale = in_scale.reshape(())
     else:
-        scale = cur
-        extra = {}
+        cur = jnp.max(jnp.abs(x))
+        if state is not None and accum is not None:
+            new_state = state * rate + 1.0
+            new_accum = accum * rate + cur
+            scale = (new_accum / new_state).reshape(())
+            extra = {"OutState": [new_state], "OutAccum": [new_accum]}
+        else:
+            scale = cur
     q = jnp.clip(jnp.round(x / jnp.maximum(scale, 1e-9) * bin_cnt),
                  -bin_cnt, bin_cnt)
     return {"Out": [q.astype(x.dtype)], "OutScale": [scale.reshape(1)],
@@ -128,11 +134,10 @@ def _attention_lstm(ctx, ins, attrs):
     else:
         valid = jnp.arange(t)[None, :] < seq_len.reshape(-1, 1)
 
-    gact = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
-            "relu": jax.nn.relu, "identity": lambda v: v}
-    act_gate = gact[attrs.get("gate_activation", "sigmoid")]
-    act_cell = gact[attrs.get("cell_activation", "tanh")]
-    act_cand = gact[attrs.get("candidate_activation", "tanh")]
+    from .sequence_ops import _ACTS
+    act_gate = _ACTS[attrs.get("gate_activation", "sigmoid")]
+    act_cell = _ACTS[attrs.get("cell_activation", "tanh")]
+    act_cand = _ACTS[attrs.get("candidate_activation", "tanh")]
 
     def step(carry, tt):
         h_prev, c_prev = carry

@@ -110,7 +110,7 @@ def test_faster_rcnn_style_pipeline_trains():
     curve = []
     for _ in range(12):
         out, = exe.run(feed=feed, fetch_list=[loss])
-        curve.append(float(out))
+        curve.append(float(np.asarray(out).reshape(-1)[0]))
     assert np.isfinite(curve).all(), curve
     assert curve[-1] < curve[0] * 0.8, f"rcnn loss did not fall: {curve}"
 
@@ -167,7 +167,7 @@ def test_ssd_style_pipeline_trains_and_decodes():
     curve = []
     for _ in range(12):
         out, = exe.run(feed=feed, fetch_list=[loss])
-        curve.append(float(out))
+        curve.append(float(np.asarray(out).reshape(-1)[0]))
     assert np.isfinite(curve).all(), curve
     assert curve[-1] < curve[0] * 0.8, f"ssd loss did not fall: {curve}"
 

@@ -179,3 +179,20 @@ def test_conv2d_transpose_matches_scatter_oracle():
                             += x[bi, c_in, i, j] * w[c_in, c_out]
     want = want[:, :, p:p + ho, p:p + ho]
     np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_fake_quantize_moving_average_is_test_uses_calibrated_scale():
+    """clone(for_test=True) programs must quantize with the trained
+    calibration, not batch stats (fake_quantize_op.cc test branch)."""
+    x = R.randn(4, 4).astype(np.float32) * 10
+    in_scale = np.array([2.0], np.float32)
+    out = run_op("fake_quantize_moving_average_abs_max",
+                 {"X": [x], "InScale": [in_scale],
+                  "InState": [np.array([1.0], np.float32)],
+                  "InAccum": [np.array([1.0], np.float32)]},
+                 {"bit_length": 8, "is_test": True})
+    np.testing.assert_allclose(
+        np.asarray(out["OutScale"][0]).reshape(-1), [2.0])
+    assert "OutState" not in out  # moving average untouched in eval
+    np.testing.assert_allclose(np.asarray(out["Out"][0]),
+                               np.clip(np.round(x / 2.0 * 127), -127, 127))

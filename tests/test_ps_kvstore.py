@@ -382,3 +382,22 @@ def test_hot_row_cache_staleness_bound(server):
     small = ShardedKVClient([f"127.0.0.1:{port}"], cache_rows=4)
     small.pull(0, np.arange(10, dtype=np.int64), 4)
     assert len(small.cache._rows) <= 4
+
+
+def test_fleet_strategy_sparse_cache_rows(server):
+    """strategy.sparse_cache_rows wires the HotRowCache into the fleet
+    worker client."""
+    from paddle_tpu.distributed import fleet
+    srv, port = server
+    st = fleet.DistributedStrategy()
+    st.sparse_cache_rows = 64
+    fleet.init(role_maker=fleet.UserDefinedRoleMaker(
+        server_endpoints=[f"127.0.0.1:{port}"]), strategy=st)
+    fleet.init_worker()
+    client = fleet.fleet._kv_client
+    assert client.cache is not None and client.cache.capacity == 64
+    keys = np.arange(8, dtype=np.int64)
+    a = client.pull(0, keys, 4)
+    b = client.pull(0, keys, 4)
+    np.testing.assert_allclose(a, b)
+    assert client.cache.hits >= 8
