@@ -735,7 +735,8 @@ class Executor:
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
-                           fetch_info=None, print_period=100):
+                           fetch_info=None, print_period=100,
+                           steps_per_loop=1):
         """Drain one epoch of a fluid.dataset through the jitted train step
         (reference executor.py:1598 -> TrainerFactory/MultiTrainer threads).
 
@@ -745,7 +746,13 @@ class Executor:
         jax dispatch is async, so step N computes while batch N+1 parses.
         This is the reference Trainer/DeviceWorker design's purpose
         (trainer.h:51: keep the device busy) in two threads + XLA async
-        dispatch instead of a DeviceWorker pool."""
+        dispatch instead of a DeviceWorker pool.
+
+        `steps_per_loop > 1` groups that many uniform-shape batches into
+        ONE run_steps dispatch (the device-side scan loop) — same numbers,
+        1/k the dispatch cost; odd-shaped tails and the final partial
+        group fall back to per-step run(). Ignored for PS/pipeline/
+        LocalSGD programs, which run_steps does not take."""
         assert dataset is not None, "train_from_dataset needs a dataset"
         import queue as _queue
         import threading
@@ -787,24 +794,76 @@ class Executor:
         producer.start()
         fetched = None
         step = 0
+        group_k = int(steps_per_loop)
+        real_prog = (program.program
+                     if hasattr(program, "_is_data_parallel") else program)
+        if group_k > 1 and (getattr(real_prog, "_ps_hooks", None)
+                            or getattr(real_prog, "_localsgd_k", 0)
+                            or getattr(real_prog, "_microbatch_k", 0)):
+            group_k = 1
+
+        def _shapes(feed):
+            return {k: np.shape(v) for k, v in feed.items()}
+
+        def _debug_print(vals, n_done=1):
+            # grouped mode: fire when the group CROSSED a print_period
+            # boundary, labelled with the step the values belong to (the
+            # group's last)
+            crossed = (step == 0
+                       or step // print_period
+                       != (step + n_done) // print_period)
+            if debug and fetch_list and crossed:
+                names = fetch_info or [getattr(v, "name", str(v))
+                                       for v in fetch_list]
+                print(f"step {step + n_done - 1}: " + ", ".join(
+                    f"{n}={np.asarray(v).ravel()[:4]}"
+                    for n, v in zip(names, vals)))
+
+        buf = []
+
+        def _flush():
+            nonlocal fetched, step
+            if not buf:
+                return
+            if len(buf) < group_k:
+                # tail / odd group: per-step run() — no extra scan compile
+                # for a one-off size
+                for f in buf:
+                    fetched = self.run(program=program, feed=f,
+                                       fetch_list=fetch_list, scope=scope,
+                                       return_numpy=False)
+            else:
+                stacked = {k: np.stack([np.asarray(f[k]) for f in buf])
+                           for k in buf[0]}
+                stacked_fetch = self.run_steps(
+                    len(buf), program=program, feed=stacked,
+                    fetch_list=fetch_list, scope=scope, return_numpy=False)
+                fetched = [v[-1] for v in stacked_fetch]
+            _debug_print(fetched, n_done=len(buf))
+            step += len(buf)
+            buf.clear()
+
         try:
             while True:
                 feed = q.get()
                 if feed is _END:
                     break
-                # return_numpy=False: dispatch without blocking on the
-                # result — only debug prints (and the final return)
-                # materialize to host
-                fetched = self.run(program=program, feed=feed,
-                                   fetch_list=fetch_list, scope=scope,
-                                   return_numpy=False)
-                if debug and fetch_list and step % print_period == 0:
-                    names = fetch_info or [getattr(v, "name", str(v))
-                                           for v in fetch_list]
-                    print(f"step {step}: " + ", ".join(
-                        f"{n}={np.asarray(v).ravel()[:4]}"
-                        for n, v in zip(names, fetched)))
-                step += 1
+                if group_k <= 1:
+                    # return_numpy=False: dispatch without blocking on the
+                    # result — only debug prints (and the final return)
+                    # materialize to host
+                    fetched = self.run(program=program, feed=feed,
+                                       fetch_list=fetch_list, scope=scope,
+                                       return_numpy=False)
+                    _debug_print(fetched)
+                    step += 1
+                    continue
+                if buf and _shapes(buf[0]) != _shapes(feed):
+                    _flush()          # odd-shaped batch breaks the group
+                buf.append(feed)
+                if len(buf) == group_k:
+                    _flush()
+            _flush()                  # the final partial group
         finally:
             # a failed step must not leave the producer blocked on the
             # bounded queue holding the dataset open: signal + drain

@@ -385,3 +385,40 @@ def test_dataloader_normal_completion_not_flagged_as_death():
                               use_buffer_reader=False)
     out = np.concatenate([np.asarray(b[0]).ravel() for b in dl])
     np.testing.assert_allclose(out, np.arange(16, dtype=np.float32))
+
+
+def test_train_from_dataset_steps_per_loop_parity(tmp_path):
+    """steps_per_loop=k (one run_steps dispatch per k batches) must produce
+    the SAME final parameters as per-step training over the same stream."""
+    def build_and_train(steps_per_loop):
+        from paddle_tpu.framework import program as pm, scope as sm
+        from paddle_tpu.framework import unique_name
+        pm._main_program = pm.Program()
+        pm._startup_program = pm.Program()
+        sm._reset_global_scope()
+        unique_name.switch()
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1, name="p")
+        loss = layers.reduce_mean(layers.square(pred - y))
+        paddle.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+
+        rng = np.random.RandomState(0)
+        batches = [{"x": rng.randn(8, 4).astype(np.float32),
+                    "y": rng.randn(8, 1).astype(np.float32)}
+                   for _ in range(7)]   # 7 = 2 full groups of 3 + tail 1
+        out = exe.train_from_dataset(
+            fluid.default_main_program(), iter(batches),
+            fetch_list=[loss], steps_per_loop=steps_per_loop)
+        params = {p.name: np.asarray(fluid.global_scope().find(p.name))
+                  for p in fluid.default_main_program().all_parameters()}
+        return float(np.asarray(out[0]).reshape(-1)[0]), params
+
+    l1, p1 = build_and_train(1)
+    l3, p3 = build_and_train(3)
+    assert abs(l1 - l3) < 1e-5, (l1, l3)
+    for name in p1:
+        np.testing.assert_allclose(p3[name], p1[name], rtol=1e-5,
+                                   atol=1e-6)
