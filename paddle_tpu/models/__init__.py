@@ -3,5 +3,7 @@
 2. ResNet-50 (dygraph paddle.nn)    -> resnet.py
 3/4. BERT/ERNIE transformer (static, SPMD-ready with TP rules) -> bert.py
 5. Wide&Deep CTR (sparse embeddings) -> wide_deep.py
+Plus a GPT-style causal-decoder LM (tied embeddings, pre-LN, causal flash
+attention, TP rules) -> gpt.py
 """
-from . import lenet, resnet, bert, wide_deep
+from . import lenet, resnet, bert, wide_deep, gpt

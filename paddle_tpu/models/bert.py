@@ -187,17 +187,11 @@ def build_pretrain_program(cfg: BertConfig, use_input_mask=False):
 
 
 def tp_sharding_rules() -> ShardingRules:
-    """Megatron-style tensor-parallel rules for this model's param names:
-    column-parallel QKV & FFN-in (shard output dim over tp), row-parallel
-    attn-proj & FFN-out (shard input dim), vocab-sharded embeddings/head."""
-    from ..parallel.mesh import moe_sharding_rules
-    return moe_sharding_rules(extra=[
-        (r"_attn_qkv_w$", P(None, "tp")),
-        (r"_attn_qkv_b$", P("tp")),
-        (r"_ffn_in_w$", P(None, "tp")),
-        (r"_ffn_in_b$", P("tp")),
-        (r"_attn_proj_w$", P("tp", None)),
-        (r"_ffn_out_w$", P("tp", None)),
+    """Megatron-style tensor-parallel rules: the shared transformer table
+    (parallel/mesh.py transformer_tp_rules) + vocab-sharded embeddings and
+    MLM head."""
+    from ..parallel.mesh import transformer_tp_rules
+    return transformer_tp_rules(extra=[
         (r"^word_embedding$", P("tp", None)),
         (r"^mlm_head_w$", P(None, "tp")),
         (r"^mlm_head_b$", P("tp")),

@@ -1,0 +1,140 @@
+"""GPT-style causal-decoder LM, static-graph builder.
+
+Beyond-reference flagship (the reference era predates GPT in-tree; its
+transformer LM counterpart is the fluid transformer of dist_transformer.py
+with causal masking). TPU-first like models/bert.py: pre-LN blocks,
+batch-major [B, S, H], fused causal attention (the flash kernels take
+`causal=True` in-kernel above the seq gate — ops/attention.py), TIED
+input/output embeddings (one [V, H] table serves the lookup and the LM
+head matmul), and Megatron TP rules as data.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from jax.sharding import PartitionSpec as P
+
+from .. import layers
+from ..layer_helper import ParamAttr
+from .. import initializer as I
+from ..parallel.mesh import ShardingRules
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 1024
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    seq_len: int = 128
+    sequence_parallel: bool = False
+    sp_mode: str = "ring"
+
+    @staticmethod
+    def small():
+        return GPTConfig()
+
+    @staticmethod
+    def tiny():
+        return GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                         num_heads=4, intermediate_size=128,
+                         max_position=64, seq_len=32,
+                         hidden_dropout=0.0, attention_dropout=0.0)
+
+
+def _attr(name):
+    return ParamAttr(name=name, initializer=I.TruncatedNormal(0.0, 0.02))
+
+
+def _ln(x, name):
+    return layers.layer_norm(x, begin_norm_axis=2,
+                             param_attr=ParamAttr(name=f"{name}_scale"),
+                             bias_attr=ParamAttr(name=f"{name}_bias"))
+
+
+def decoder_layer(x, cfg: GPTConfig, idx: int):
+    """Pre-LN causal block (GPT-2 ordering). Param names carry the same
+    qkv/proj/ffn markers as bert.py so tp_sharding_rules transfer."""
+    h, nh = cfg.hidden_size, cfg.num_heads
+    hd = h // nh
+
+    a = _ln(x, f"dec{idx}_ln1")
+    qkv = layers.fc(a, 3 * h, num_flatten_dims=2,
+                    param_attr=_attr(f"dec{idx}_attn_qkv_w"),
+                    bias_attr=ParamAttr(name=f"dec{idx}_attn_qkv_b"))
+    q, k, v = layers.split(qkv, 3, dim=2)
+
+    def heads(t):
+        t = layers.reshape(t, [0, 0, nh, hd])
+        return layers.transpose(t, [0, 2, 1, 3])   # [B, nh, S, hd]
+
+    ctx = layers.fused_attention(
+        heads(q), heads(k), heads(v), causal=True,
+        scale=1.0 / math.sqrt(hd), dropout=cfg.attention_dropout,
+        sequence_parallel=cfg.sequence_parallel, sp_mode=cfg.sp_mode)
+    ctx = layers.reshape(layers.transpose(ctx, [0, 2, 1, 3]), [0, 0, h])
+    proj = layers.fc(ctx, h, num_flatten_dims=2,
+                     param_attr=_attr(f"dec{idx}_attn_proj_w"),
+                     bias_attr=ParamAttr(name=f"dec{idx}_attn_proj_b"))
+    if cfg.hidden_dropout:
+        proj = layers.dropout(proj, cfg.hidden_dropout,
+                              dropout_implementation="upscale_in_train")
+    x = layers.elementwise_add(x, proj)
+
+    f = _ln(x, f"dec{idx}_ln2")
+    ffn = layers.fc(f, cfg.intermediate_size, num_flatten_dims=2,
+                    act="gelu", param_attr=_attr(f"dec{idx}_ffn_in_w"),
+                    bias_attr=ParamAttr(name=f"dec{idx}_ffn_in_b"))
+    ffn = layers.fc(ffn, h, num_flatten_dims=2,
+                    param_attr=_attr(f"dec{idx}_ffn_out_w"),
+                    bias_attr=ParamAttr(name=f"dec{idx}_ffn_out_b"))
+    if cfg.hidden_dropout:
+        ffn = layers.dropout(ffn, cfg.hidden_dropout,
+                             dropout_implementation="upscale_in_train")
+    return layers.elementwise_add(x, ffn)
+
+
+def gpt_decoder(token_ids, cfg: GPTConfig):
+    """Tied embeddings + N pre-LN causal blocks + final LN.
+    Returns (seq_out [B, S, H], wte var for the tied head)."""
+    wte = layers.create_parameter([cfg.vocab_size, cfg.hidden_size],
+                                  "float32", attr=_attr("wte"))
+    wpe = layers.create_parameter([cfg.max_position, cfg.hidden_size],
+                                  "float32", attr=_attr("wpe"))
+    tok = layers.gather(wte, layers.reshape(token_ids, [-1]))
+    tok = layers.reshape(tok, [-1, cfg.seq_len, cfg.hidden_size])
+    pos = layers.unsqueeze(
+        layers.slice(wpe, [0], [0], [cfg.seq_len]), [0])
+    x = layers.elementwise_add(tok, pos)
+    if cfg.hidden_dropout:
+        x = layers.dropout(x, cfg.hidden_dropout,
+                           dropout_implementation="upscale_in_train")
+    for i in range(cfg.num_layers):
+        x = decoder_layer(x, cfg, i)
+    return _ln(x, "final_ln"), wte
+
+
+def build_lm_program(cfg: GPTConfig):
+    """Next-token LM objective: predict tokens[1:] from tokens[:-1].
+    Returns (tokens, loss)."""
+    tokens = layers.data(name="tokens", shape=[cfg.seq_len], dtype="int64")
+    seq, wte = gpt_decoder(tokens, cfg)
+    logits = layers.matmul(seq, wte, transpose_y=True)   # tied head
+    shift_logits = layers.slice(logits, [1], [0], [cfg.seq_len - 1])
+    shift_labels = layers.slice(tokens, [1], [1], [cfg.seq_len])
+    shift_labels = layers.unsqueeze(shift_labels, [2])
+    loss = layers.softmax_with_cross_entropy(shift_logits, shift_labels)
+    return tokens, layers.mean(loss)
+
+
+def tp_sharding_rules() -> ShardingRules:
+    """The shared transformer TP table + the tied vocab table."""
+    from ..parallel.mesh import transformer_tp_rules
+    return transformer_tp_rules(extra=[
+        (r"^wte$", P("tp", None)),
+    ])

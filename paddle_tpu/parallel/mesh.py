@@ -145,3 +145,20 @@ def moe_sharding_rules(extra=()) -> "ShardingRules":
     dispatch einsum to an all-to-all over ICI."""
     rules = [(r"_expert_(w|b)[12]_?\d*$", P("ep"))]
     return ShardingRules(list(extra) + rules)
+
+
+def transformer_tp_rules(extra=()) -> "ShardingRules":
+    """The Megatron marker -> PartitionSpec table shared by every
+    transformer in models/ (bert.py / gpt.py use the same param-name
+    markers): column-parallel QKV & FFN-in (shard the output dim over tp),
+    row-parallel attn-proj & FFN-out (shard the input dim). Models append
+    only their embedding/head rules via `extra`."""
+    rules = [
+        (r"_attn_qkv_w$", P(None, "tp")),
+        (r"_attn_qkv_b$", P("tp")),
+        (r"_ffn_in_w$", P(None, "tp")),
+        (r"_ffn_in_b$", P("tp")),
+        (r"_attn_proj_w$", P("tp", None)),
+        (r"_ffn_out_w$", P("tp", None)),
+    ]
+    return moe_sharding_rules(extra=list(extra) + rules)

@@ -1,0 +1,69 @@
+"""GPT causal-decoder LM (models/gpt.py): trains, respects causality, and
+ties the embedding/head weights."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.models import gpt
+
+
+def _build(cfg):
+    tokens, loss = gpt.build_lm_program(cfg)
+    paddle.optimizer.Adam(learning_rate=2e-3).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    return exe, tokens, loss
+
+
+def test_gpt_lm_trains_on_structured_sequences():
+    cfg = gpt.GPTConfig.tiny()
+    exe, tokens, loss = _build(cfg)
+    rng = np.random.RandomState(0)
+    # learnable structure: arithmetic mod-V sequences (next = prev + step)
+    def batch(n=16):
+        start = rng.randint(0, cfg.vocab_size, (n, 1))
+        step = rng.randint(1, 5, (n, 1))
+        seq = (start + step * np.arange(cfg.seq_len)) % cfg.vocab_size
+        return seq.astype(np.int64)
+    curve, = zip(*[exe.run(feed={"tokens": batch()}, fetch_list=[loss])
+                   for _ in range(80)])
+    curve = [float(np.asarray(v).reshape(-1)[0]) for v in curve]
+    assert np.isfinite(curve).all()
+    # measured: 6.25 -> ~2.6 by step 80 on this task
+    assert curve[-1] < curve[0] * 0.6, curve[::10]
+
+
+def test_gpt_is_causal():
+    """Perturbing a future token must not change past positions' loss
+    contributions — check via logits directly."""
+    from paddle_tpu.fluid import layers as L
+    cfg = gpt.GPTConfig.tiny()
+    tokens = L.data(name="tokens", shape=[cfg.seq_len], dtype="int64")
+    seq, wte = gpt.gpt_decoder(tokens, cfg)
+    logits = L.matmul(seq, wte, transpose_y=True)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(1)
+    t1 = rng.randint(0, cfg.vocab_size, (2, cfg.seq_len)).astype(np.int64)
+    t2 = t1.copy()
+    t2[:, -1] = (t2[:, -1] + 7) % cfg.vocab_size   # change ONLY the last
+    a, = exe.run(feed={"tokens": t1}, fetch_list=[logits])
+    b, = exe.run(feed={"tokens": t2}, fetch_list=[logits])
+    np.testing.assert_allclose(a[:, :-1], b[:, :-1], rtol=1e-5, atol=1e-6)
+    assert np.abs(a[:, -1] - b[:, -1]).max() > 1e-4  # last DOES differ
+
+
+def test_gpt_embeddings_are_tied():
+    """One [V, H] table serves lookup and head: training must move the
+    SAME persistable (no separate lm_head param exists)."""
+    cfg = gpt.GPTConfig.tiny()
+    exe, tokens, loss = _build(cfg)
+    names = [p.name for p in fluid.default_main_program().all_parameters()]
+    assert "wte" in names and not any("head" in n for n in names)
+    before = np.asarray(fluid.global_scope().find("wte")).copy()
+    rng = np.random.RandomState(0)
+    seq = rng.randint(0, cfg.vocab_size, (8, cfg.seq_len)).astype(np.int64)
+    exe.run(feed={"tokens": seq}, fetch_list=[loss])
+    after = np.asarray(fluid.global_scope().find("wte"))
+    assert np.abs(after - before).max() > 0  # grads reached the tied table

@@ -196,3 +196,33 @@ def test_fake_quantize_moving_average_is_test_uses_calibrated_scale():
     assert "OutState" not in out  # moving average untouched in eval
     np.testing.assert_allclose(np.asarray(out["Out"][0]),
                                np.clip(np.round(x / 2.0 * 127), -127, 127))
+
+
+def test_attention_lstm_grads_flow():
+    """attention_lstm is on the training path (unlike the reference's
+    inference-only fusion): grads must flow to x and both weight sets."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import registry
+
+    b, t, m, d = 2, 4, 3, 4
+    x = R.randn(b, t, m).astype(np.float32) * 0.5
+    c0 = np.zeros((b, d), np.float32)
+    attn_w = R.randn(m + d, 1).astype(np.float32)
+    lstm_w = R.randn(d + m, 4 * d).astype(np.float32) * 0.4
+    lstm_b = np.zeros((1, 4 * d), np.float32)
+    opdef = registry.get("attention_lstm")
+
+    def loss(xv, aw, lw):
+        out = opdef.lower(
+            registry.LowerCtx(rng_key=jax.random.PRNGKey(0)),
+            {"X": [xv], "C0": [jnp.asarray(c0)],
+             "AttentionWeight": [aw], "LSTMWeight": [lw],
+             "LSTMBias": [jnp.asarray(lstm_b)]}, {})
+        return jnp.sum(out["Hidden"][0] ** 2)
+
+    gx, gaw, glw = jax.grad(loss, argnums=(0, 1, 2))(
+        jnp.asarray(x), jnp.asarray(attn_w), jnp.asarray(lstm_w))
+    for g in (gx, gaw, glw):
+        arr = np.asarray(g)
+        assert np.isfinite(arr).all() and np.abs(arr).max() > 0
