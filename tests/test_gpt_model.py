@@ -67,3 +67,47 @@ def test_gpt_embeddings_are_tied():
     exe.run(feed={"tokens": seq}, fetch_list=[loss])
     after = np.asarray(fluid.global_scope().find("wte"))
     assert np.abs(after - before).max() > 0  # grads reached the tied table
+
+
+def test_gpt_sequence_parallel_matches_dense():
+    """The causal LM over an sp mesh (ring attention) must produce the same
+    loss as the single-device build — the long-context training config."""
+    import subprocess
+    import sys
+    import textwrap
+    from conftest import cpu_mesh_env
+
+    code = textwrap.dedent("""
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.models import gpt
+        from paddle_tpu.parallel import build_mesh, DistConfig, attach
+        from paddle_tpu.testing import reset_programs
+
+        losses = {}
+        for sp in (False, True):
+            reset_programs(seed=0)
+            cfg = gpt.GPTConfig.tiny()
+            cfg.sequence_parallel = sp
+            tokens, loss = gpt.build_lm_program(cfg)
+            if sp:
+                mesh = build_mesh(sp=4)
+                attach(fluid.default_main_program(),
+                       DistConfig(mesh=mesh,
+                                  param_rules=gpt.tp_sharding_rules()))
+            exe = fluid.Executor()
+            exe.run(fluid.default_startup_program())
+            rng = np.random.RandomState(0)
+            seq = rng.randint(0, cfg.vocab_size,
+                              (8, cfg.seq_len)).astype(np.int64)
+            out, = exe.run(feed={"tokens": seq}, fetch_list=[loss])
+            losses[sp] = float(np.asarray(out).reshape(-1)[0])
+        delta = abs(losses[True] - losses[False])
+        assert delta < 2e-4, losses
+        print("OK", losses)
+    """)
+    r = subprocess.run([sys.executable, "-c", code], env=cpu_mesh_env(4),
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+    assert "OK" in r.stdout
