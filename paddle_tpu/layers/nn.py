@@ -23,7 +23,7 @@ __all__ = [
     "softplus", "swish", "hard_swish", "hard_sigmoid", "exp", "sqrt", "abs",
     "square", "log", "floor", "ceil", "round", "sign", "pow", "cos", "sin",
     "hsigmoid", "edit_distance", "bilinear_tensor_product",
-    "add_position_encoding",
+    "add_position_encoding", "cos_sim",
     "equal", "not_equal", "less_than", "less_equal", "greater_than",
     "greater_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
     "where", "cond_take", "unique", "cumsum", "prelu", "brelu",
@@ -1027,4 +1027,16 @@ def add_position_encoding(input, alpha, beta, name=None):
     helper.append_op("add_position_encoding", inputs={"X": [input]},
                      outputs={"Out": [out]},
                      attrs={"alpha": float(alpha), "beta": float(beta)})
+    return out
+
+
+def cos_sim(X, Y, name=None):
+    """Reference layers/nn.py cos_sim (cos_sim_op.cc): row-wise cosine
+    similarity -> [B, 1] (the recommender-system book model's scorer)."""
+    helper = LayerHelper("cos_sim")
+    out = helper.create_variable_for_type_inference(X.dtype)
+    xn = helper.create_variable_for_type_inference(X.dtype)
+    yn = helper.create_variable_for_type_inference(X.dtype)
+    helper.append_op("cos_sim", inputs={"X": [X], "Y": [Y]},
+                     outputs={"Out": [out], "XNorm": [xn], "YNorm": [yn]})
     return out

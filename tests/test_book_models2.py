@@ -1,0 +1,102 @@
+"""Book tests #2: recommender system and understand-sentiment (reference
+book/test_recommender_system.py and notest_understand_sentiment.py — the
+remaining untested book chapters)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def test_recommender_system_dual_tower():
+    """User tower (id/gender/age/job embeddings -> fc) and movie tower
+    (id/category) scored by cos_sim, trained with square error on synthetic
+    preferences that depend on a hidden (user_bucket, movie_bucket)
+    affinity — learnable structure, reference model shape."""
+    USERS, MOVIES, CATS = 30, 40, 4
+
+    uid = layers.data(name="uid", shape=[1], dtype="int64")
+    gender = layers.data(name="gender", shape=[1], dtype="int64")
+    age = layers.data(name="age", shape=[1], dtype="int64")
+    job = layers.data(name="job", shape=[1], dtype="int64")
+    mid = layers.data(name="mid", shape=[1], dtype="int64")
+    cat = layers.data(name="cat", shape=[1], dtype="int64")
+    score = layers.data(name="score", shape=[1], dtype="float32")
+
+    def emb(x, size, dim=8):
+        e = layers.embedding(x, size=[size, dim])
+        return layers.reshape(e, [-1, dim])
+
+    usr = layers.concat([emb(uid, USERS), emb(gender, 2), emb(age, 7),
+                         emb(job, 10)], axis=1)
+    usr = layers.fc(usr, 16, act="tanh")
+    mov = layers.concat([emb(mid, MOVIES), emb(cat, CATS)], axis=1)
+    mov = layers.fc(mov, 16, act="tanh")
+    sim = layers.cos_sim(usr, mov)
+    pred = layers.scale(sim, scale=5.0)   # book scales cosine to 0..5
+    loss = layers.mean(layers.square_error_cost(pred, score))
+    paddle.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    affinity = rng.rand(3, 2) * 4 + 0.5     # hidden bucket affinity
+
+    def batch(n=64):
+        u = rng.randint(0, USERS, (n, 1))
+        m = rng.randint(0, MOVIES, (n, 1))
+        f = {"uid": u.astype(np.int64),
+             "gender": (u % 2).astype(np.int64),
+             "age": (u % 7).astype(np.int64),
+             "job": (u % 10).astype(np.int64),
+             "mid": m.astype(np.int64),
+             "cat": (m % CATS).astype(np.int64)}
+        s = affinity[u[:, 0] % 3, m[:, 0] % 2]
+        f["score"] = (s[:, None] + 0.1 * rng.randn(n, 1)).astype(np.float32)
+        return f
+
+    curve = []
+    for _ in range(120):
+        out, = exe.run(feed=batch(), fetch_list=[loss])
+        curve.append(float(np.asarray(out).reshape(-1)[0]))
+    assert np.isfinite(curve).all()
+    assert curve[-1] < curve[0] * 0.45, (curve[0], curve[-1])
+
+
+def test_understand_sentiment_lstm():
+    """Stacked embedding -> gate-projected LSTM -> last-state pooling ->
+    softmax classifier on synthetic separable 'sentiment': positive
+    sequences draw from the top half of the vocab."""
+    V, T, H = 64, 12, 32
+    words = layers.data(name="words", shape=[T], dtype="int64")
+    lens = layers.data(name="lens", shape=[], dtype="int64")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+
+    e = layers.embedding(layers.unsqueeze(words, [2]), size=[V, H])
+    e = layers.reshape(e, [-1, T, H])
+    proj = layers.fc(e, 4 * H, num_flatten_dims=2)
+    hidden, _ = layers.dynamic_lstm(proj, 4 * H, length=lens)
+    feat = layers.sequence_pool(hidden, "last", length=lens)
+    logits = layers.fc(feat, 2)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    acc = layers.accuracy(layers.softmax(logits), label)
+    paddle.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+
+    def batch(n=32):
+        y = rng.randint(0, 2, (n, 1))
+        lo = np.where(y[:, 0] == 1, V // 2, 0)
+        w = (lo[:, None] + rng.randint(0, V // 2, (n, T)))
+        ln = rng.randint(T // 2, T + 1, (n,))
+        return {"words": w.astype(np.int64), "lens": ln.astype(np.int64),
+                "label": y.astype(np.int64)}
+
+    accs = []
+    for _ in range(60):
+        feed = batch()
+        _, a = exe.run(feed=feed, fetch_list=[loss, acc])
+        accs.append(float(np.asarray(a).reshape(-1)[0]))
+    assert np.mean(accs[-10:]) > 0.9, accs[::10]
