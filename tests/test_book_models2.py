@@ -1,6 +1,7 @@
-"""Book tests #2: recommender system and understand-sentiment (reference
-book/test_recommender_system.py and notest_understand_sentiment.py — the
-remaining untested book chapters)."""
+"""Book tests #2: recommender system, understand-sentiment, and label
+semantic roles (reference book/test_recommender_system.py,
+notest_understand_sentiment.py, test_label_semantic_roles.py) — with these,
+every reference book chapter has a training test."""
 import numpy as np
 
 import paddle_tpu as paddle
@@ -100,3 +101,65 @@ def test_understand_sentiment_lstm():
         _, a = exe.run(feed=feed, fetch_list=[loss, acc])
         accs.append(float(np.asarray(a).reshape(-1)[0]))
     assert np.mean(accs[-10:]) > 0.9, accs[::10]
+
+
+def test_label_semantic_roles_srl():
+    """Book chapter test_label_semantic_roles.py: multi-feature embeddings
+    (word, predicate, context mark) -> stacked forward+backward LSTM ->
+    CRF over role labels; Viterbi decode beats chance after training."""
+    from paddle_tpu.layer_helper import ParamAttr
+    B, T, V, ROLES, H = 8, 8, 40, 5, 24
+
+    word = layers.data(name="word", shape=[T], dtype="int64")
+    pred = layers.data(name="pred", shape=[T], dtype="int64")
+    mark = layers.data(name="mark", shape=[T], dtype="int64")
+    roles = layers.data(name="roles", shape=[T], dtype="int64")
+    lens = layers.data(name="lens", shape=[1], dtype="int32")
+
+    def emb(x, size, dim=16):
+        e = layers.embedding(layers.unsqueeze(x, [2]), [size, dim])
+        return layers.reshape(e, [0, 0, dim])
+
+    feat = layers.concat([emb(word, V), emb(pred, V), emb(mark, 2, 4)],
+                         axis=2)
+    # stacked bi-directional pass (the book stacks depth alternating
+    # directions; one fwd + one bwd layer keeps the shape, CPU-test sized)
+    fwd_in = layers.fc(feat, 4 * H, num_flatten_dims=2)
+    fwd, _ = layers.dynamic_lstm(fwd_in, 4 * H, length=layers.reshape(
+        lens, [-1]))
+    bwd_in = layers.fc(feat, 4 * H, num_flatten_dims=2)
+    bwd, _ = layers.dynamic_lstm(bwd_in, 4 * H, is_reverse=True,
+                                 length=layers.reshape(lens, [-1]))
+    hidden = layers.concat([fwd, bwd], axis=2)
+    emission = layers.fc(hidden, ROLES, num_flatten_dims=2)
+    nll = layers.linear_chain_crf(
+        emission, roles, param_attr=ParamAttr(name="srl_crf_trans"),
+        length=lens)
+    loss = layers.mean(nll)
+    test_prog = fluid.default_main_program().clone(for_test=True)
+    paddle.optimizer.Adam(learning_rate=0.03).minimize(loss)
+    with fluid.program_guard(test_prog):
+        path = layers.crf_decoding(
+            test_prog.global_block().var(emission.name),
+            param_attr=ParamAttr(name="srl_crf_trans"),
+            length=test_prog.global_block().var(lens.name))
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    wv = rng.randint(0, V, (B, T)).astype(np.int64)
+    pv = np.tile(wv[:, :1], (1, T))            # predicate broadcast
+    mv = (np.arange(T)[None, :] == 0).astype(np.int64) * np.ones(
+        (B, 1), np.int64)
+    # role rule: depends on word parity and predicate parity — learnable
+    rv = ((wv % 2) * 2 + (pv % 2)).astype(np.int64) % ROLES
+    lv = rng.randint(4, T + 1, (B, 1)).astype(np.int32)
+    feed = {"word": wv, "pred": pv, "mark": mv, "roles": rv, "lens": lv}
+
+    losses = [float(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0])
+                    .reshape(-1)[0]) for _ in range(60)]
+    assert losses[-1] < losses[0] * 0.35, (losses[0], losses[-1])
+    got, = exe.run(test_prog, feed=feed, fetch_list=[path])
+    live = np.arange(T)[None, :] < lv
+    acc = (np.asarray(got) == rv)[live].mean()
+    assert acc > 0.8, f"SRL viterbi accuracy {acc:.2f}"
