@@ -13,6 +13,7 @@ import itertools
 import multiprocessing as mp
 import queue as queue_mod
 import threading
+import time
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -122,7 +123,28 @@ class _MultiprocessIter:
                 waited += self._POLL
                 dead = self._abnormal_deaths()
                 if dead:
-                    # drain whatever finished batches are still queued, then
+                    # a worker can put its final owed batch on the queue
+                    # (still in the feeder pipe) and THEN exit nonzero:
+                    # drain whatever finished batches are in flight before
+                    # deciding the death is fatal
+                    deadline = time.monotonic() + 2.0
+                    while (self._next_seq not in self._reorder
+                           and time.monotonic() < deadline):
+                        try:
+                            seq, batch, err = self._data_queue.get(
+                                timeout=0.1)
+                        except queue_mod.Empty:
+                            continue
+                        if err is not None:
+                            self._join()
+                            raise RuntimeError(
+                                f"DataLoader worker failed: {err}")
+                        self._received.add(seq)
+                        self._reorder[seq] = batch
+                    if self._next_seq in self._reorder:
+                        break          # the awaited batch made it out
+                    dead = self._abnormal_deaths()
+                if dead:
                     # fail fast with the culprit (reference SIGCHLD path:
                     # "DataLoader worker exits unexpectedly")
                     self._join()

@@ -56,6 +56,11 @@ class EnforceNotMet(Exception):
         self.message = full
         super().__init__(full)
 
+    def __str__(self):
+        # KeyError/IndexError-based subclasses would otherwise render via
+        # repr(args[0]) — quotes and escapes around the message
+        return self.message
+
 
 class EOFException(Exception):
     """Raised by readers/data feeds on exhaustion (enforce.h EOFException;

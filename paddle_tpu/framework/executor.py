@@ -44,7 +44,7 @@ class _CompiledBlock:
         self.mut_names = [n for n in self.state_names if n in written]
         self.ro_names = [n for n in self.state_names if n not in written]
         micro_k = getattr(program, "_microbatch_k", 0)
-        if multi_k and multi_k > 1:
+        if multi_k:      # any k >= 1: feeds always carry the leading [k] axis
             runner = functools.partial(_run_block_multistep, multi_k)
         elif micro_k and micro_k > 1:
             runner = functools.partial(_run_block_microbatched, micro_k)
@@ -55,10 +55,6 @@ class _CompiledBlock:
                                self.written_state)
         jit_kw = {}
         dist = getattr(program, "_dist_config", None)
-        if multi_k and multi_k > 1:
-            # multi-step scan: feeds carry a leading [k] axis the per-step
-            # sharding specs don't describe; let GSPMD infer placements
-            dist = None
         if dist is not None:
             # SPMD: shard feeds over the data axes, params per TP rules; XLA
             # GSPMD inserts every collective (the grad allreduce included)
@@ -69,10 +65,23 @@ class _CompiledBlock:
                 return {n: dist.state_sharding(
                     mesh, n, (state_shapes or {}).get(n)) for n in names}
 
-            feeds_shard = {n: dist.feed_sharding(
-                mesh, n, (feed_shapes or {}).get(n, ()))
-                for n in self.feed_names}
             from jax.sharding import NamedSharding, PartitionSpec
+
+            def feed_shard(n):
+                shp = tuple((feed_shapes or {}).get(n, ()))
+                if multi_k:
+                    # multi-step scan feeds carry a leading [k] steps axis:
+                    # shard the per-step dims per the dist rules and leave
+                    # the steps axis unsharded — params/state specs apply
+                    # unchanged, so TP placements survive run_steps (a
+                    # replicated fallback can OOM exactly where TP rules
+                    # exist because params don't fit one device)
+                    per_step = dist.feed_sharding(mesh, n, shp[1:])
+                    return NamedSharding(
+                        mesh, PartitionSpec(None, *per_step.spec))
+                return dist.feed_sharding(mesh, n, shp)
+
+            feeds_shard = {n: feed_shard(n) for n in self.feed_names}
             repl = NamedSharding(mesh, PartitionSpec())
             mut_shard = state_shard(self.mut_names)
             jit_kw["in_shardings"] = (mut_shard, state_shard(self.ro_names),
@@ -582,7 +591,7 @@ class Executor:
 
         feed_spec = tuple(sorted((k, tuple(v.shape), str(v.dtype))
                                  for k, v in feed_vals.items()))
-        key = (id(program), program._version, feed_spec, tuple(fetch_names),
+        key = (program._uid, program._version, feed_spec, tuple(fetch_names),
                tuple(state_names))
         compiled = self._cache.get(key) if use_program_cache else None
         localsgd_k = getattr(program, "_localsgd_k", 0)
@@ -671,6 +680,10 @@ class Executor:
         if hasattr(program, "_is_data_parallel"):
             program = program.program
         from . import errors
+        if not isinstance(k, (int, np.integer)) or k < 1:
+            raise errors.InvalidArgument(
+                "run_steps needs an integer k >= 1, got %r", k)
+        k = int(k)
         if getattr(program, "_ps_hooks", None):
             raise errors.Unimplemented("run_steps with PS hooks")
         if getattr(program, "_localsgd_k", 0) or \
@@ -712,7 +725,7 @@ class Executor:
         state_names = _referenced_state_names(gb, scope, feed_vals)
         feed_spec = tuple(sorted((kk, tuple(v.shape), str(v.dtype))
                                  for kk, v in feed_vals.items()))
-        key = ("multi", k, id(program), program._version, feed_spec,
+        key = ("multi", k, program._uid, program._version, feed_spec,
                tuple(fetch_names), tuple(state_names))
         compiled = self._cache.get(key)
         if compiled is None:
@@ -722,6 +735,10 @@ class Executor:
                 prewarm_flash(program)
             compiled = _CompiledBlock(
                 program, 0, list(feed_vals), fetch_names, state_names,
+                feed_shapes={kk: tuple(v.shape)
+                             for kk, v in feed_vals.items()},
+                state_shapes={n: tuple(scope.find(n).shape)
+                              for n in state_names},
                 multi_k=k)
             self._cache[key] = compiled
         rng_key = _next_rng_key(scope, program.random_seed)

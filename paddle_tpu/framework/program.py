@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import contextlib
 import copy
+import itertools
 from collections import OrderedDict
 from typing import Dict, List, Optional
 
@@ -289,11 +290,18 @@ class Program:
     its compile-cache key so stale jitted functions are never reused.
     """
 
+    _uid_counter = itertools.count()
+
     def __init__(self):
         self.blocks = [Block(self, 0)]
         self.current_block_idx = 0
         self.random_seed = 0
         self._version = 0
+        # monotonic identity for executor cache keys — unlike id(), never
+        # reused, so cache-key correctness survives if eviction is ever
+        # added (today entries hold strong program refs, so id() reuse
+        # cannot actually occur)
+        self._uid = next(Program._uid_counter)
         # list of (fetch-stage transform hooks) applied at lowering; unused in v1
         self._appending_grad = False
 

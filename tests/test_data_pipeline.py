@@ -377,6 +377,52 @@ def test_dataloader_fast_worker_death_detection():
     assert time.perf_counter() - t0 < 30, "death detection took too long"
 
 
+def test_dataloader_drains_in_flight_batch_before_failing():
+    """A worker that enqueued its final owed batch (still in the feeder
+    pipe) and exited nonzero must NOT be reported as a fatal death: the
+    drain pass recovers the batch (dataloader.py __next__ drain branch).
+
+    Deterministic simulation of the put-then-exit race: the first queue
+    poll is forced Empty (batch "still in the pipe") while the death check
+    reports the worker gone; the drain must then pick the batch up."""
+    import queue as queue_mod
+    from paddle_tpu.dataloader.dataloader import (_MultiprocessIter,
+                                                  default_collate_fn)
+
+    class _FirstPollMisses:
+        def __init__(self, q):
+            self._q = q
+            self._missed = False
+
+        def get(self, timeout=None):
+            if not self._missed:
+                self._missed = True
+                raise queue_mod.Empty
+            return self._q.get(timeout=timeout)
+
+        def __getattr__(self, name):
+            return getattr(self._q, name)
+
+    it = _MultiprocessIter(_SquaresDataset(2), [[0, 1]], default_collate_fn,
+                           num_workers=1)
+    # wait out the (slow, 1-core-host) worker start so the batch really is
+    # "in the pipe" when the forced-miss poll fires, then re-enqueue it
+    in_flight = it._data_queue.get(timeout=60)
+    it._data_queue.put(in_flight)
+    it._data_queue = _FirstPollMisses(it._data_queue)
+    orig = it._abnormal_deaths
+
+    def fake_deaths():
+        if 0 in it._received:
+            return orig()
+        return [(0, 1)]   # "died nonzero, still owing batch 0"
+
+    it._abnormal_deaths = fake_deaths
+    feats, squares = next(it)   # must recover via the drain, not raise
+    np.testing.assert_allclose(np.asarray(feats).ravel(), [0.0, 1.0])
+    np.testing.assert_allclose(np.asarray(squares).ravel(), [0.0, 1.0])
+
+
 def test_dataloader_normal_completion_not_flagged_as_death():
     """Workers retiring cleanly after the None sentinel must not trip the
     SIGCHLD death path."""

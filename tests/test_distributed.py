@@ -121,6 +121,55 @@ print(json.dumps({"plain": plain, "tp": tp}))
     np.testing.assert_allclose(out["plain"], out["tp"], rtol=2e-4, atol=1e-5)
 
 
+def test_run_steps_preserves_tp_sharding():
+    """run_steps must keep the DistConfig (TP placements) rather than fall
+    back to GSPMD inference — replicated params can OOM precisely where TP
+    rules exist. Parity: k scanned steps == k sequential run() calls, and the
+    compiled entry must carry the mesh."""
+    out = run_sub(COMMON + """
+from jax.sharding import PartitionSpec as P
+from paddle_tpu.parallel import ShardingRules, DistConfig, attach, build_mesh
+
+def build():
+    from paddle_tpu.framework import program as pm, scope as sm, unique_name
+    pm._main_program = pm.Program(); pm._startup_program = pm.Program()
+    sm._reset_global_scope(); unique_name.switch()
+    paddle.seed(7)
+    x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    h = fluid.layers.fc(x, 32, act="relu",
+                        param_attr=paddle.ParamAttr(name="w1"))
+    pred = fluid.layers.fc(h, 1, param_attr=paddle.ParamAttr(name="w2"))
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    paddle.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    prog = fluid.default_main_program()
+    rules = ShardingRules([("w1", P(None, "tp")), ("w2", P("tp", None))])
+    attach(prog, DistConfig(mesh=build_mesh(dp=2, tp=4), param_rules=rules))
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    return exe, loss
+
+rng = np.random.RandomState(2)
+xs = rng.rand(4, 8, 16).astype(np.float32)
+ys = xs.sum(2, keepdims=True).astype(np.float32) * 0.3
+
+exe, loss = build()
+seq = [float(exe.run(feed={"x": xs[i], "y": ys[i]}, fetch_list=[loss])[0])
+       for i in range(4)]
+
+exe2, loss2 = build()
+stacked, = exe2.run_steps(4, feed={"x": xs, "y": ys}, fetch_list=[loss2])
+multi_entries = [c for k, c in exe2._cache.items() if k[0] == "multi"]
+print(json.dumps({"seq": seq, "scanned": np.asarray(stacked).reshape(-1).tolist(),
+                  "mesh_kept": all(c.mesh is not None for c in multi_entries),
+                  "n_multi": len(multi_entries)}))
+""")
+    assert out["n_multi"] == 1 and out["mesh_kept"], \
+        "run_steps dropped the DistConfig mesh"
+    np.testing.assert_allclose(out["seq"], out["scanned"], rtol=2e-4,
+                               atol=1e-5)
+
+
 def test_collective_allreduce_numerics():
     """reference test_collective_base.py: allreduce across dp shards."""
     out = run_sub(COMMON + """

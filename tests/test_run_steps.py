@@ -81,6 +81,39 @@ def test_run_steps_dropout_varies_per_step():
         "each scanned step must draw fresh dropout"
 
 
+def test_run_steps_k1_matches_run():
+    """k=1 is a legal degenerate scan (feeds still carry the [1] axis)."""
+    rng = np.random.RandomState(3)
+    xb = rng.randn(16, 6).astype(np.float32)
+    yb = xb.sum(1, keepdims=True).astype(np.float32)
+
+    exe, loss = _build(seed=3)
+    ref, = exe.run(feed={"x": xb, "y": yb}, fetch_list=[loss])
+    ref_params = {p.name: np.asarray(fluid.global_scope().find(p.name))
+                  for p in fluid.default_main_program().all_parameters()}
+
+    from paddle_tpu.framework import program as pm, scope as sm, unique_name
+    pm._main_program = pm.Program()
+    pm._startup_program = pm.Program()
+    sm._reset_global_scope()
+    unique_name.switch()
+    exe2, loss2 = _build(seed=3)
+    stacked, = exe2.run_steps(1, feed={"x": xb, "y": yb},
+                              fetch_list=[loss2])
+    assert stacked.shape[0] == 1
+    np.testing.assert_allclose(stacked[0], ref, rtol=2e-4, atol=1e-5)
+    for p in fluid.default_main_program().all_parameters():
+        np.testing.assert_allclose(
+            np.asarray(fluid.global_scope().find(p.name)),
+            ref_params[p.name], rtol=2e-4, atol=1e-5)
+
+
+def test_run_steps_rejects_k_below_one():
+    exe, loss = _build(seed=4)
+    with pytest.raises(errors.InvalidArgumentError):
+        exe.run_steps(0, feed={}, fetch_list=[loss])
+
+
 def test_run_steps_rejects_ps_and_pipeline():
     exe, loss = _build(seed=2)
     prog = fluid.default_main_program()
