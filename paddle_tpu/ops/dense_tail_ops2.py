@@ -573,3 +573,29 @@ def _detection_map(ctx, ins, attrs):
             "AccumPosCount": [pos_all],
             "AccumTruePos": [tp_all],
             "AccumFalsePos": [fp_all]}
+
+
+@register("fc")
+def _fc(ctx, ins, attrs):
+    """fc_op.cc (the fused FC the CPU fusion passes emit): flatten Input to
+    2D at in_num_col_dims, matmul W, broadcast-add Bias, optional
+    activation. One XLA dot — the MXU does the fusing the reference's
+    hand-written kernel exists for."""
+    x, w = ins["Input"][0], ins["W"][0]
+    num_col = int(attrs.get("in_num_col_dims", 1))
+    lead = x.shape[:num_col]
+    x2 = x.reshape((int(np.prod(lead)) if lead else 1, -1))
+    if attrs.get("padding_weights", False):
+        # reference stores W padded by 4 zero rows/cols for its vectorized
+        # kernel (fc_op.h:33-34); the math uses W[:-4, :-4]
+        w = w[:-4, :-4]
+    out = x2 @ w
+    bias = ins.get("Bias", [None])[0]
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    act = attrs.get("activation_type", "") or attrs.get("activation", "")
+    if act == "relu":
+        out = jnp.maximum(out, 0.0)
+    elif act:
+        raise NotImplementedError(f"fc activation_type={act!r}")
+    return {"Out": [out.reshape(lead + (w.shape[1],))]}

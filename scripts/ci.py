@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import glob
 import os
+import re
 import subprocess
 import sys
 import time
@@ -80,17 +81,34 @@ def main():
                                        stdout=open(logp, "w"),
                                        stderr=subprocess.STDOUT)))
     failed = False
+    totals = {}
     for i, fs, logp, p in procs:
         rc = p.wait()
         tail = ""
         try:
             with open(logp) as f:
-                tail = "".join(f.readlines()[-3:])
+                text = f.read()
+            tail = "".join(text.splitlines(keepends=True)[-3:])
+            # pytest's final summary line: "N passed, M skipped, K warnings
+            # in 12.3s" — aggregate across shards so the round notes can
+            # quote ONE line that matches the artifacts byte-for-byte
+            lines = text.splitlines()
+            m = re.findall(
+                r"(\d+) (passed|failed|errors?|skipped|warnings?|"
+                r"xfailed|xpassed|deselected)", lines[-1]) if lines else []
+            for n, kind in m:
+                kind = {"error": "errors", "warning": "warnings"}.get(
+                    kind, kind)
+                totals[kind] = totals.get(kind, 0) + int(n)
         except OSError:
             pass
         status = "OK " if rc == 0 else "FAIL"
         print(f"[shard {i}] {status} rc={rc} files={len(fs)}\n{tail}")
         failed = failed or rc != 0
+    kinds = ["passed", "failed", "skipped", "warnings"]
+    kinds += sorted(k for k in totals if k not in kinds)
+    agg = ", ".join(f"{totals.get(k, 0)} {k}" for k in kinds)
+    print(f"CI aggregate: {agg}")
     print(f"CI total: {time.time() - t0:.0f}s over {len(shards)} shards -> "
           f"{'FAILED' if failed else 'PASSED'}")
     return 1 if failed else 0

@@ -400,3 +400,31 @@ def test_detection_map_perfect_and_mixed():
                    "ap_type": "integral"})
     np.testing.assert_allclose(float(np.asarray(out2["MAP"][0])[0]), 0.5,
                                atol=1e-5)
+
+
+def test_fc_matches_numpy_with_bias_relu_and_col_dims():
+    # fc_op.cc: flatten at in_num_col_dims, matmul, bias, activation
+    x = R.randn(2, 3, 4).astype(np.float32)
+    w = R.randn(12, 5).astype(np.float32)
+    b = R.randn(5).astype(np.float32)
+    out = run_op("fc", {"Input": [x], "W": [w], "Bias": [b]},
+                 {"in_num_col_dims": 1, "activation_type": "relu"})
+    got = np.asarray(out["Out"][0])
+    exp = np.maximum(x.reshape(2, 12) @ w + b, 0.0)
+    assert got.shape == (2, 5)
+    np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-5)
+    # in_num_col_dims=2 keeps the leading (2,3) batch shape
+    w2 = R.randn(4, 6).astype(np.float32)
+    out2 = run_op("fc", {"Input": [x], "W": [w2]}, {"in_num_col_dims": 2})
+    got2 = np.asarray(out2["Out"][0])
+    assert got2.shape == (2, 3, 6)
+    np.testing.assert_allclose(got2, (x.reshape(6, 4) @ w2).reshape(2, 3, 6),
+                               rtol=1e-5, atol=1e-5)
+    # padding_weights: reference stores W with 4 extra zero rows/cols
+    wp = np.zeros((16, 9), np.float32)
+    wp[:12, :5] = w[:, :5]
+    outp = run_op("fc", {"Input": [x], "W": [wp]},
+                  {"in_num_col_dims": 1, "padding_weights": True})
+    np.testing.assert_allclose(np.asarray(outp["Out"][0]),
+                               x.reshape(2, 12) @ w[:, :5],
+                               rtol=1e-5, atol=1e-5)

@@ -1,0 +1,51 @@
+"""Mechanical audit of docs/op_manifest.json (the coverage claim artifact).
+
+Reference counterpart: the REGISTER_OPERATOR surface under
+paddle/fluid/operators. Every name the reference registers must be
+classified registered | subsumed | cut | n/a, and every 'registered' claim
+must hold against the live runtime registry."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MANIFEST = os.path.join(ROOT, "docs", "op_manifest.json")
+
+
+def test_manifest_exists_and_classifies_everything():
+    with open(MANIFEST) as f:
+        doc = json.load(f)
+    assert doc["ops"], "empty manifest"
+    statuses = {e["status"] for e in doc["ops"].values()}
+    assert "UNCLASSIFIED" not in statuses
+    assert statuses <= {"registered", "subsumed", "cut", "n/a"}
+    # every subsumed entry names its mechanism; cut/n-a entries say why
+    for n, e in doc["ops"].items():
+        if e["status"] == "subsumed":
+            assert e.get("via"), f"{n}: subsumed without a mechanism"
+        if e["status"] in ("cut", "n/a"):
+            assert e.get("why"), f"{n}: {e['status']} without a reason"
+
+
+def test_manifest_check_passes_against_live_registry():
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "op_manifest.py"),
+         "--check"],
+        capture_output=True, text=True, timeout=300, cwd=ROOT)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_registered_claims_hold():
+    with open(MANIFEST) as f:
+        doc = json.load(f)
+    import paddle_tpu  # noqa: F401
+    import paddle_tpu.contrib.slim.quantization  # noqa: F401
+    import paddle_tpu.distributed.ps_pass  # noqa: F401
+    import paddle_tpu.parallel.transforms  # noqa: F401
+    from paddle_tpu.ops import registry
+    missing = [n for n, e in doc["ops"].items()
+               if e["status"] == "registered" and n not in registry._REGISTRY]
+    assert not missing, f"manifest over-claims: {missing}"
