@@ -603,7 +603,22 @@ class Executor:
                 # choke point so LocalSGD/pipeline paths get it too
                 from ..ops.attention import prewarm_flash
                 prewarm_flash(program)
-            if localsgd_k and localsgd_k > 1:
+            dist = getattr(program, "_dist_config", None)
+            pp = (int(dist.resolve_mesh().shape.get("pp", 1))
+                  if dist is not None else 1)
+            if pp > 1:
+                # the pp mesh axis engages true pipeline parallelism: stages
+                # partitioned by device_guard, placed on pp submeshes
+                # (parallel/pipeline.py)
+                if localsgd_k and localsgd_k > 1:
+                    from . import errors
+                    raise errors.Unimplemented(
+                        "LocalSGD over a pp>1 mesh (pipeline stages and "
+                        "per-replica parameter copies are incompatible)")
+                from ..parallel.pipeline import _PipelineBlock
+                compiled = _PipelineBlock(program, 0, list(feed_vals),
+                                          fetch_names, state_names)
+            elif localsgd_k and localsgd_k > 1:
                 compiled = _LocalSGDBlock(program, 0, list(feed_vals),
                                           fetch_names, state_names,
                                           localsgd_k)
@@ -625,7 +640,8 @@ class Executor:
             _prof.start_profiler()
 
         def _dispatch():
-            if isinstance(compiled, _LocalSGDBlock):
+            if not isinstance(compiled, _CompiledBlock):
+                # _LocalSGDBlock / _PipelineBlock drive the scope themselves
                 return compiled.step(scope, feed_vals, rng_key)
             state = {n: scope.find(n) for n in state_names}
             return compiled(state, feed_vals, rng_key)
@@ -690,6 +706,11 @@ class Executor:
                 getattr(program, "_microbatch_k", 0):
             raise errors.Unimplemented(
                 "run_steps with LocalSGD/pipeline programs")
+        dist = getattr(program, "_dist_config", None)
+        if dist is not None and \
+                int(dist.resolve_mesh().shape.get("pp", 1)) > 1:
+            raise errors.Unimplemented(
+                "run_steps over a pp>1 mesh (pipeline stages run per-step)")
         feed = feed or {}
         fetch_list = fetch_list or []
         scope = scope or global_scope()

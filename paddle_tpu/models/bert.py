@@ -36,6 +36,9 @@ class BertConfig:
     sp_mode: str = "ring"
     moe_experts: int = 0              # >0: switch-MoE FFN (ep mesh axis)
     moe_capacity_factor: float = 2.0
+    # >0: annotate device_guard stages for pipeline parallelism over the pp
+    # mesh axis (embeddings stage 0, layers round-robin, head last stage)
+    pipeline_stages: int = 0
 
     @staticmethod
     def base():
@@ -126,6 +129,39 @@ def bert_encoder(input_ids, cfg: BertConfig, position_ids=None,
     moe_experts>0, per-layer aux load-balancing losses accumulate on the
     returned var's `_moe_aux_losses` (build_pretrain_program adds them)."""
     aux_losses = []
+    stage = _stage_guard(cfg)
+    with stage(0):
+        x = _bert_embeddings(input_ids, cfg)
+    for i in range(cfg.num_layers):
+        with stage(_layer_stage(cfg, i)):
+            x = encoder_layer(x, cfg, i, attn_mask)
+        if cfg.moe_experts > 0:
+            x, aux = x
+            aux_losses.append(aux)
+    x._moe_aux_losses = aux_losses
+    return x
+
+
+def _stage_guard(cfg: BertConfig):
+    """device_guard factory: a no-op context when pipeline is off."""
+    import contextlib
+    from ..framework.program import device_guard
+    if cfg.pipeline_stages and cfg.pipeline_stages > 1:
+        return lambda s: device_guard(f"gpu:{s}")
+    return lambda s: contextlib.nullcontext()
+
+
+def _layer_stage(cfg: BertConfig, i: int) -> int:
+    if not cfg.pipeline_stages or cfg.pipeline_stages <= 1:
+        return 0
+    if cfg.pipeline_stages > cfg.num_layers:
+        raise ValueError(
+            f"pipeline_stages={cfg.pipeline_stages} > num_layers="
+            f"{cfg.num_layers}: some pp submeshes would hold no ops")
+    return i * cfg.pipeline_stages // cfg.num_layers
+
+
+def _bert_embeddings(input_ids, cfg: BertConfig):
     word_emb = layers.embedding(
         layers.unsqueeze(input_ids, [2]), [cfg.vocab_size, cfg.hidden_size],
         param_attr=_attr("word_embedding"))
@@ -142,22 +178,17 @@ def bert_encoder(input_ids, cfg: BertConfig, position_ids=None,
     if cfg.hidden_dropout:
         x = layers.dropout(x, cfg.hidden_dropout,
                            dropout_implementation="upscale_in_train")
-    for i in range(cfg.num_layers):
-        x = encoder_layer(x, cfg, i, attn_mask)
-        if cfg.moe_experts > 0:
-            x, aux = x
-            aux_losses.append(aux)
-    x._moe_aux_losses = aux_losses
     return x
 
 
 def bert_pretrain_loss(seq_out, mlm_labels, cfg: BertConfig):
     """Masked-LM head + loss (ERNIE pretraining objective)."""
-    logits = layers.fc(seq_out, cfg.vocab_size, num_flatten_dims=2,
-                       param_attr=_attr("mlm_head_w"),
-                       bias_attr=ParamAttr(name="mlm_head_b"))
-    loss = layers.softmax_with_cross_entropy(logits, mlm_labels)
-    return layers.mean(loss)
+    with _stage_guard(cfg)(max(1, cfg.pipeline_stages or 1) - 1):
+        logits = layers.fc(seq_out, cfg.vocab_size, num_flatten_dims=2,
+                           param_attr=_attr("mlm_head_w"),
+                           bias_attr=ParamAttr(name="mlm_head_b"))
+        loss = layers.softmax_with_cross_entropy(logits, mlm_labels)
+        return layers.mean(loss)
 
 
 def build_pretrain_program(cfg: BertConfig, use_input_mask=False):

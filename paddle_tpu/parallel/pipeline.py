@@ -1,0 +1,477 @@
+"""True multi-device pipeline parallelism over the mesh's `pp` axis.
+
+Reference counterpart: PipelineTrainer + SectionWorker
+(paddle/fluid/framework/trainer.h:230, section_worker.cc:82 — each section
+thread runs num_microbatches scopes on its device) and the program splitter
+(python/paddle/fluid/optimizer.py:3695 PipelineOptimizer, which partitions
+ops by the `device_guard` annotation and inserts send/recv pairs).
+
+TPU-native design — no send/recv ops, no section threads:
+
+* `fluid.device_guard("gpu:<s>")` stage annotations partition the lowered
+  program into per-stage sections: forward, backward (the per-op `__vjp__`
+  ops inherit their forward op's stage) and optimizer ops (placed with the
+  parameter they update).
+* Each stage owns a **pp submesh** — `mesh.devices[:, :, s:s+1]` — so every
+  other axis (dp/tp/sp/ep) keeps its meaning INSIDE a stage: stage-local
+  parameters are sharded by the same TP rules, feeds by dp, and XLA GSPMD
+  still inserts all intra-stage collectives.
+* Stage state (params, Adam moments, BN stats) lives only on its stage's
+  submesh; boundary activations (forward) and boundary gradients (backward)
+  move between submeshes as `jax.device_put` transfers — ICI/DCN
+  device-to-device on hardware, the send/recv of the reference collapsed
+  into the runtime.
+* The schedule is GPipe with the reference's semantics (gradients averaged
+  over microbatches, BN stats sequential across microbatches, LR sched once
+  per step): dispatch is asynchronous, so while stage s executes microbatch
+  m, stage s+1 executes microbatch m-1 — the reference's section threads
+  collapse into per-device XLA execution queues.
+* RNG: every stage call uses the SAME run key; random ops key off their
+  stable `__rng_seed__` attr (ops/registry.py LowerCtx.op_key), so dropout
+  masks match between a stage's forward and backward calls AND match the
+  single-device microbatch scan — loss parity holds with dropout on.
+
+Multi-host note: in multi-controller JAX every process dispatches every
+stage computation (the per-stage jits span only that stage's devices);
+that is the standard JAX contract and needs no code change here.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..framework.program import OpRole
+
+
+def _op_reads(op) -> List[str]:
+    return [n for names in op.inputs.values() for n in names
+            if n != "@EMPTY@"]
+
+
+def _op_writes(op) -> List[str]:
+    return [n for names in op.outputs.values() for n in names
+            if n != "@EMPTY@"]
+
+
+def _grad_base(name: str) -> str:
+    return name.split("@GRAD")[0]
+
+
+class _Segment:
+    """A contiguous run of same-stage ops compiled as one jitted function.
+
+    in_names: externally-produced vars the ops read (resolved through the
+    runner's logical env, with a device transfer when the value lives on a
+    different stage's submesh). out_names: writes needed outside the segment
+    (later segments, fetches, persistables)."""
+
+    def __init__(self, runner: "_PipelineBlock", ops, stage: int, name: str,
+                 out_keep: Set[str]):
+        self.runner = runner
+        self.ops = list(ops)
+        self.stage = stage
+        self.name = name
+        produced: Set[str] = set()
+        reads: List[str] = []
+        for op in self.ops:
+            for n in _op_reads(op):
+                if n not in produced and n not in reads:
+                    reads.append(n)
+            produced.update(_op_writes(op))
+        self.in_names = reads
+        self.out_names = [n for n in dict.fromkeys(
+            n for op in self.ops for n in _op_writes(op)) if n in out_keep]
+        self.jit = jax.jit(functools.partial(
+            _segment_call, runner.block, self.ops, self.out_names))
+
+    def writes(self) -> List[str]:
+        return list(dict.fromkeys(
+            n for op in self.ops for n in _op_writes(op)))
+
+
+def _segment_call(block_proto, ops, out_names, env, rng_key):
+    """The traced body: run `ops` over env, return the kept outputs."""
+    from ..framework import executor as ex
+    from ..ops import registry
+
+    pseudo = type(block_proto)(block_proto.program, block_proto.idx,
+                               block_proto.parent_idx)
+    pseudo.vars = block_proto.vars
+    pseudo.ops = list(ops)
+    env = dict(env)
+    ctx = registry.LowerCtx(rng_key=rng_key)
+    ex._lowering_programs.append(block_proto.program)
+    try:
+        fetches, _ = ex._run_block_inner(pseudo, out_names, [], env, ctx)
+    finally:
+        ex._lowering_programs.pop()
+    return dict(zip(out_names, fetches))
+
+
+class _PipelineBlock:
+    """Pipeline-parallel train step over the pp axis (see module docstring).
+
+    Interface mirrors _LocalSGDBlock: step(scope, feeds, rng_key) ->
+    (fetches, logical_state_updates_for_scope)."""
+
+    def __init__(self, program, block_idx: int, feed_names: Sequence[str],
+                 fetch_names: Sequence[str], state_names: Sequence[str]):
+        from ..framework import errors
+
+        self.program = program
+        self.block = program.blocks[block_idx]
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        self.state_names = list(state_names)
+        self.micro_k = max(1, int(getattr(program, "_microbatch_k", 0) or 1))
+        dist = program._dist_config
+        self.dist = dist
+        mesh = dist.resolve_mesh()
+        self.mesh = mesh
+        pp = int(mesh.shape.get("pp", 1))
+
+        # ---- partition ops by role ----
+        sched_ops, fwd_ops, bwd_ops, opt_ops = [], [], [], []
+        for op in self.block.ops:
+            role = op.attrs.get("op_role", 0)
+            if role == OpRole.LRSched:
+                sched_ops.append(op)
+            elif role & OpRole.Optimize:
+                opt_ops.append(op)
+            elif role & OpRole.Backward:
+                bwd_ops.append(op)
+            else:
+                fwd_ops.append(op)
+
+        state_set = set(self.state_names)
+        var_stage: Dict[str, int] = {}
+
+        # ---- stage assignment: forward (device_guard attrs + propagation) --
+        def _known_in_stages(op):
+            return [var_stage[n] for n in _op_reads(op) if n in var_stage]
+
+        fwd_assigned: List[Tuple[object, int]] = []
+        for op in fwd_ops:
+            ins = _known_in_stages(op)
+            s = op.attrs.get("pipeline_stage")
+            if s is None:
+                s = max(ins) if ins else 0
+            elif ins and s < max(ins):
+                raise errors.InvalidArgument(
+                    "pipeline: op %r at device_guard stage %d consumes a "
+                    "var produced at stage %d — stages must be "
+                    "non-decreasing along the program", op.type, s, max(ins))
+            s = int(s)
+            fwd_assigned.append((op, s))
+            for n in _op_reads(op):       # params: home = first reader stage
+                if n not in var_stage and n in state_set:
+                    var_stage[n] = s
+            for n in _op_writes(op):
+                var_stage[n] = s
+
+        num_stages = 1 + max((s for _, s in fwd_assigned), default=0)
+        if num_stages != pp:
+            raise errors.InvalidArgument(
+                "pipeline: program has %d device_guard stages but the mesh "
+                "pp axis is %d — they must match (annotate ops with "
+                "fluid.device_guard('gpu:<stage>'))", num_stages, pp)
+        self.num_stages = num_stages
+
+        # ---- stage assignment: backward ----
+        bwd_assigned: List[Tuple[object, int]] = []
+        for op in bwd_ops:
+            s = None
+            if op.type == "__vjp__":
+                s = op.attrs.get("fwd_attrs", {}).get("pipeline_stage")
+            if s is None:
+                known = [var_stage[n] for n in _op_reads(op)
+                         if n in var_stage]
+                if op.type == "sum" and known:
+                    # grad aggregation runs where the EARLIEST contribution
+                    # lives; later-stage contributions flow backward to it
+                    s = min(known)
+                elif known:
+                    s = max(known)
+                else:
+                    # loss-grad seed (no inputs): stage of the seeded var
+                    s = num_stages - 1
+                    for n in _op_writes(op):
+                        if _grad_base(n) in var_stage:
+                            s = var_stage[_grad_base(n)]
+                            break
+            s = int(s)
+            bwd_assigned.append((op, s))
+            for n in _op_reads(op):
+                if n not in var_stage and n in state_set:
+                    var_stage[n] = s
+            for n in _op_writes(op):
+                var_stage[n] = s
+
+        # ---- stage assignment: optimizer (with the param it updates) ----
+        self.param_of_grad: Dict[str, str] = {}
+        opt_assigned: List[Tuple[object, int]] = []
+        for op in opt_ops:
+            s = None
+            pnames = op.inputs.get("Param", [])
+            if pnames and pnames[0] in var_stage:
+                s = var_stage[pnames[0]]
+            if s is None:
+                known = [var_stage[n] for n in _op_reads(op)
+                         if n in var_stage]
+                s = max(known) if known else 0
+            s = int(s)
+            opt_assigned.append((op, s))
+            for n in _op_reads(op):       # opt state (moments): home with op
+                if n not in var_stage and n in state_set:
+                    var_stage[n] = s
+            for n in _op_writes(op):
+                var_stage.setdefault(n, s)
+            gnames = op.inputs.get("Grad", [])
+            for pn, gn in zip(pnames, gnames):
+                self.param_of_grad[gn] = pn
+        self.var_stage = var_stage
+
+        # remaining state never read by any op section (e.g. vars only read
+        # via sub-blocks) default to stage 0
+        for n in self.state_names:
+            var_stage.setdefault(n, 0)
+
+        # ---- submeshes: one pp slice each, all axis names retained so the
+        # dp/tp/sp/ep sharding rules apply unchanged within a stage ----
+        axes = mesh.axis_names
+        pp_dim = axes.index("pp")
+        dev = mesh.devices
+        self.submeshes: List[Mesh] = []
+        for s in range(num_stages):
+            idx = [slice(None)] * dev.ndim
+            idx[pp_dim] = slice(s, s + 1)
+            self.submeshes.append(Mesh(dev[tuple(idx)], axes))
+
+        # ---- segments ----
+        # out_keep: everything read across segment boundaries, fetched, or
+        # persisted back to the scope
+        all_segments_ops: List[Tuple[List, int, str]] = []
+        all_segments_ops.append((sched_ops, 0, "sched"))
+        for s in range(num_stages):
+            all_segments_ops.append(
+                ([op for op, st in fwd_assigned if st == s], s, f"fwd{s}"))
+        for s in reversed(range(num_stages)):
+            all_segments_ops.append(
+                ([op for op, st in bwd_assigned if st == s], s, f"bwd{s}"))
+        opt_segments_ops: List[Tuple[List, int, str]] = []
+        for op, s in opt_assigned:
+            if opt_segments_ops and opt_segments_ops[-1][1] == s:
+                opt_segments_ops[-1][0].append(op)
+            else:
+                opt_segments_ops.append(([op], s,
+                                         f"opt{len(opt_segments_ops)}@{s}"))
+        all_segments_ops.extend(opt_segments_ops)
+
+        produced_by: Dict[str, str] = {}
+        reads_by_others: Set[str] = set()
+        for ops, _, name in all_segments_ops:
+            local: Set[str] = set()
+            for op in ops:
+                for n in _op_reads(op):
+                    if n not in local:
+                        reads_by_others.add(n)
+                local.update(_op_writes(op))
+                for n in _op_writes(op):
+                    produced_by.setdefault(n, name)
+        self.written_pers: List[str] = []
+        for ops, _, _n in all_segments_ops:
+            for op in ops:
+                for n in _op_writes(op):
+                    v = self.block.find_var_recursive(n)
+                    if (v is not None and v.persistable
+                            and n not in self.written_pers):
+                        self.written_pers.append(n)
+        out_keep = (reads_by_others | set(self.fetch_names)
+                    | set(self.written_pers))
+
+        self.sched_seg = _Segment(self, sched_ops, 0, "sched", out_keep) \
+            if sched_ops else None
+        self.fwd_segs = [
+            _Segment(self, [op for op, st in fwd_assigned if st == s], s,
+                     f"fwd{s}", out_keep) for s in range(num_stages)]
+        self.bwd_segs = [
+            _Segment(self, [op for op, st in bwd_assigned if st == s], s,
+                     f"bwd{s}", out_keep)
+            for s in reversed(range(num_stages))]
+        self.opt_segs = [
+            _Segment(self, ops, s, name, out_keep)
+            for ops, s, name in opt_segments_ops]
+
+        # body-produced vars the optimizer reads: accumulated over
+        # microbatches and averaged (floats) / last value (ints) — the exact
+        # semantics of executor._run_block_microbatched
+        body_writes: Set[str] = set()
+        for seg in self.fwd_segs + self.bwd_segs:
+            body_writes.update(seg.writes())
+        opt_reads: Set[str] = set()
+        for seg in self.opt_segs:
+            opt_reads.update(seg.in_names)
+        self.acc_names = sorted(body_writes & opt_reads)
+        self.body_writes = body_writes
+
+        self._placement_cache: Dict[Tuple[str, int], NamedSharding] = {}
+
+    # -- placement --------------------------------------------------------
+    def _placement(self, name: str, stage: int, shape) -> NamedSharding:
+        key = (name, stage)
+        hit = self._placement_cache.get(key)
+        if hit is not None:
+            return hit
+        sub = self.submeshes[stage]
+        pname = self.param_of_grad.get(name, name)
+        if pname in set(self.state_names):
+            sh = self.dist.state_sharding(sub, pname, tuple(shape))
+        else:
+            sh = self.dist.feed_sharding(sub, name, tuple(shape))
+        self._placement_cache[key] = sh
+        return sh
+
+    def _to_stage(self, name: str, v, stage: int):
+        target = self._placement(name, stage, np.shape(v))
+        if isinstance(v, jax.Array) and v.sharding == target:
+            return v
+        return jax.device_put(v, target)
+
+    # -- the step ---------------------------------------------------------
+    def _stage_key(self, rng_key, stage: int):
+        """The run key replicated onto a stage's submesh (a jit whose array
+        inputs are committed to different device sets is an error)."""
+        cache = getattr(self, "_key_cache", None)
+        if cache is None or cache[0] is not rng_key:
+            cache = (rng_key, {})
+            self._key_cache = cache
+        per_stage = cache[1]
+        if stage not in per_stage:
+            per_stage[stage] = jax.device_put(
+                rng_key, NamedSharding(self.submeshes[stage], P()))
+        return per_stage[stage]
+
+    def _run_seg(self, seg: _Segment, lookup, rng_key) -> Dict[str, jax.Array]:
+        if not seg.ops or not seg.out_names:
+            return {}
+        env = {}
+        for n in seg.in_names:
+            v = lookup(n)
+            env[n] = self._to_stage(n, v, seg.stage)
+        return seg.jit(env, self._stage_key(rng_key, seg.stage))
+
+    def step(self, scope, feeds: Dict[str, np.ndarray], rng_key):
+        from ..framework import errors
+
+        K = self.micro_k
+        micro_feeds: List[Dict[str, np.ndarray]] = [dict() for _ in range(K)]
+        for name, arr in feeds.items():
+            b = arr.shape[0] if arr.ndim else 0
+            if K > 1 and b % K:
+                raise errors.InvalidArgument(
+                    "pipeline: feed %r batch %d is not divisible by "
+                    "num_microbatches=%d", name, b, K)
+            mb = b // K if K > 1 else b
+            for m in range(K):
+                micro_feeds[m][name] = (arr[m * mb:(m + 1) * mb]
+                                        if K > 1 and arr.ndim else arr)
+
+        # per-step env: stage state + sched outputs + opt results
+        env_step: Dict[str, jax.Array] = {}
+
+        def lookup_static(n):
+            if n in env_step:
+                return env_step[n]
+            v = scope.find(n)
+            if v is None:
+                raise errors.NotFound(
+                    "pipeline: var %r is not in the scope and no pipeline "
+                    "section produces it before use", n, var=n)
+            return v
+
+        # LR schedulers once per step (reference section_worker.cc:113)
+        if self.sched_seg is not None and self.sched_seg.ops:
+            env_step.update(self._run_seg(self.sched_seg, lookup_static,
+                                          rng_key))
+
+        # forward wave, then backward wave (GPipe); async dispatch overlaps
+        # stage s's microbatch m with stage s+1's microbatch m-1
+        env_mb: List[Dict[str, jax.Array]] = [dict(mf) for mf in micro_feeds]
+        acc: Dict[str, jax.Array] = {}
+
+        def lookup_mb(m):
+            def f(n):
+                if n in env_mb[m]:
+                    return env_mb[m][n]
+                return lookup_static(n)
+            return f
+
+        for m in range(K):
+            for seg in self.fwd_segs:
+                out = self._run_seg(seg, lookup_mb(m), rng_key)
+                for n, v in out.items():
+                    if n in self.written_pers:
+                        env_step[n] = v      # BN stats: sequential across mb
+                    else:
+                        env_mb[m][n] = v
+        for m in reversed(range(K)):
+            for seg in self.bwd_segs:
+                out = self._run_seg(seg, lookup_mb(m), rng_key)
+                for n, v in out.items():
+                    if n in self.written_pers:
+                        env_step[n] = v
+                    else:
+                        env_mb[m][n] = v
+
+        # accumulate the opt-consumed body outputs over microbatches
+        fetch_stack: Dict[str, List[jax.Array]] = {
+            n: [] for n in self.fetch_names if n in self.body_writes}
+        for m in range(K):
+            for n in self.acc_names:
+                v = env_mb[m].get(n, env_step.get(n))
+                if v is None:
+                    continue
+                if jnp.issubdtype(v.dtype, jnp.floating):
+                    acc[n] = v if n not in acc else jnp.add(acc[n], v)
+                else:
+                    acc[n] = v               # non-float: last value wins
+            for n in fetch_stack:
+                if n in env_mb[m]:
+                    fetch_stack[n].append(env_mb[m][n])
+        for n, v in acc.items():
+            if jnp.issubdtype(v.dtype, jnp.floating):
+                v = v / K
+            env_step[n] = v
+
+        # optimizer segments in program order (cross-stage reads transfer)
+        for seg in self.opt_segs:
+            env_step.update(self._run_seg(seg, lookup_static, rng_key))
+
+        # fetches: body-produced -> microbatch mean (floats) / last, exactly
+        # like _run_block_microbatched; otherwise the final step value
+        fetches = []
+        for n in self.fetch_names:
+            if n in fetch_stack and fetch_stack[n]:
+                vs = fetch_stack[n]
+                if (n not in self.written_pers
+                        and jnp.issubdtype(vs[0].dtype, jnp.floating)):
+                    fetches.append(sum(vs[1:], vs[0]) / len(vs)
+                                   if len(vs) > 1 else vs[0])
+                else:
+                    fetches.append(vs[-1])
+            else:
+                fetches.append(lookup_static(n))
+
+        new_state = {n: env_step[n] for n in self.written_pers
+                     if n in env_step}
+        return fetches, new_state
+
+
+def stage_devices(pipeline_block: "_PipelineBlock", stage: int):
+    """Device list of a stage's submesh (for placement assertions)."""
+    return list(pipeline_block.submeshes[stage].devices.flat)
