@@ -152,20 +152,24 @@ def _timed_steps(exe, feed, fetch, steps):
     return time.perf_counter() - t0, float(vals[-1])
 
 
-def bench_bert(batch, seq_len, steps, masked=False):
+def bench_bert(batch, seq_len, steps, masked=False, large=False,
+               recompute=False):
     """masked=True runs the padded-batch path: a per-example key-padding
     mask feeds the flash kernels' in-kernel additive-mask operand, so the
     recorded number certifies the real-data BERT path, not just synthetic
-    unpadded batches."""
+    unpadded batches. large=True benches the 24L/1024H/16-head geometry
+    (BASELINE metric 'BERT-large tokens/sec/chip', config 4 ERNIE-large);
+    recompute=True wraps each encoder layer in jax.remat so bigger batches
+    fit HBM at ~4/3 the model FLOPs."""
     import paddle_tpu as paddle
     import paddle_tpu.fluid as fluid
     from paddle_tpu.models import bert
     from paddle_tpu.distributed import fleet
 
     _log(f"bert: building program (batch={batch}, seq={seq_len}, "
-         f"masked={masked})")
+         f"masked={masked}, large={large}, remat={recompute})")
     _fresh_programs()
-    cfg = bert.BertConfig()          # BERT-base geometry
+    cfg = bert.BertConfig.large() if large else bert.BertConfig()
     cfg.seq_len = seq_len
     if seq_len > cfg.max_position:
         cfg.max_position = seq_len   # long-context configs (seq 1024)
@@ -178,6 +182,10 @@ def bench_bert(batch, seq_len, steps, masked=False):
     fleet.init(is_collective=True)
     strategy = fleet.DistributedStrategy()
     strategy.amp = True              # bf16 matmuls on the MXU
+    if recompute:
+        strategy.recompute = True
+        strategy.recompute_configs = {
+            "checkpoints": loss._layer_checkpoints}
     opt = fleet.distributed_optimizer(
         paddle.optimizer.Adam(learning_rate=1e-4), strategy)
     opt.minimize(loss)
@@ -197,6 +205,46 @@ def bench_bert(batch, seq_len, steps, masked=False):
         np_feed["input_mask"] = (
             np.arange(seq_len)[None, :] < lens).astype(np.float32)
     feed = _device_feed(np_feed)
+    dt, _ = _timed_steps(exe, feed, loss, steps)
+    tokens_per_sec = batch * seq_len * steps / dt
+    peak = float(os.environ.get("BENCH_PEAK_TFLOPS", "197")) * 1e12
+    mfu = tokens_per_sec * 6.0 * n_params / peak
+    return tokens_per_sec, mfu
+
+
+def bench_gpt(batch, seq_len, steps):
+    """GPT-2-small causal LM train step (models/gpt.py, the causal-flash
+    kernel configuration: causal=True + dropout at S>=512 — exactly the
+    fused path the reference's multihead_matmul_op.cu exists for)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import gpt
+    from paddle_tpu.distributed import fleet
+
+    _log(f"gpt: building program (batch={batch}, seq={seq_len})")
+    _fresh_programs()
+    cfg = gpt.GPTConfig()            # GPT-2 small geometry
+    cfg.seq_len = seq_len
+    if seq_len > cfg.max_position:
+        cfg.max_position = seq_len
+    tokens, loss = gpt.build_lm_program(cfg)
+    gb = fluid.default_main_program().global_block()
+    n_params = sum(
+        int(np.prod(v.shape)) for v in gb.vars.values()
+        if v.persistable and v.shape and all(d > 0 for d in v.shape))
+    fleet.init(is_collective=True)
+    strategy = fleet.DistributedStrategy()
+    strategy.amp = True
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.Adam(learning_rate=1e-4), strategy)
+    opt.minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = _device_feed({
+        "tokens": rng.randint(0, cfg.vocab_size,
+                              (batch, seq_len)).astype(np.int64)})
     dt, _ = _timed_steps(exe, feed, loss, steps)
     tokens_per_sec = batch * seq_len * steps / dt
     peak = float(os.environ.get("BENCH_PEAK_TFLOPS", "197")) * 1e12
@@ -228,6 +276,12 @@ def bench_resnet50(batch, steps):
     })
     dt, _ = _timed_steps(exe, feed, loss, steps)
     return batch * steps / dt
+
+
+# ResNet-50 model FLOPs: 2 * 2.05G MACs forward per 224x224 image (the
+# canonical 4.1 GFLOP figure, He et al. 2015 table 1), x3 for fwd+bwd
+# (bwd does ~2x fwd work) — used for the images/s -> MFU conversion
+RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 4.1e9
 
 
 def bench_wide_deep(batch, steps):
@@ -270,19 +324,23 @@ def bench_wide_deep(batch, steps):
         exe = fluid.Executor()
         exe.run(fluid.default_startup_program())
         rng = np.random.RandomState(0)
+        # k-step PS windows (run_steps + pre_multi/post_multi): one pull /
+        # one summed push / ONE device dispatch per k batches — the
+        # amortization that lifts the path off the per-dispatch floor
+        # (docs/perf_notes.md roofline)
+        k = int(os.environ.get("BENCH_CTR_WINDOW", "16"))
         feed = {
-            "dense_input": rng.randn(batch, 13).astype(np.float32),
-            "ids": rng.randint(0, vocab, (batch, slots)).astype(np.int64),
-            "label": rng.randint(0, 2, (batch, 1)).astype(np.float32),
+            "dense_input": rng.randn(k, batch, 13).astype(np.float32),
+            "ids": rng.randint(0, vocab, (k, batch, slots)).astype(np.int64),
+            "label": rng.randint(0, 2, (k, batch, 1)).astype(np.float32),
         }
-        # PS pull/push happens on host per step — feeds stay numpy here
-        for _ in range(3):
-            exe.run(feed=feed, fetch_list=[loss])
+        windows = max(steps // k, 2)
+        exe.run_steps(k, feed=feed, fetch_list=[loss])   # compile + warm
         t0 = time.perf_counter()
-        for _ in range(steps):
-            exe.run(feed=feed, fetch_list=[loss])
+        for _ in range(windows):
+            exe.run_steps(k, feed=feed, fetch_list=[loss])
         dt = time.perf_counter() - t0
-        return batch * steps / dt
+        return batch * k * windows / dt
     finally:
         srv.stop()
 
@@ -365,12 +423,45 @@ def main():
         except Exception as e:  # pragma: no cover
             print(f"long-seq bench failed: {e!r}", file=sys.stderr)
             errors.append(f"longseq: {e!r}")
+    if tokens_per_sec is not None and which in ("all", "bertlarge"):
+        try:
+            # BERT/ERNIE-large geometry (BASELINE config 4 / the named
+            # 'BERT-large tokens/sec/chip' metric): per-layer remat keeps
+            # batch 64 resident, see docs/perf_notes.md
+            tps_xl, mfu_xl = bench_bert(
+                int(os.environ.get("BENCH_LARGE_BATCH", "64")),
+                seq_len, max(steps // 2, 5), large=True,
+                recompute=os.environ.get("BENCH_LARGE_REMAT", "1") == "1")
+            extras.append({
+                "metric": "bert_large_pretrain_tokens_per_sec_per_chip",
+                "value": round(tps_xl, 1), "unit": "tokens/s",
+                "mfu": round(mfu_xl, 4)})
+        except Exception as e:  # pragma: no cover
+            print(f"bert-large bench failed: {e!r}", file=sys.stderr)
+            errors.append(f"bert-large: {e!r}")
+    if tokens_per_sec is not None and which in ("all", "gpt"):
+        try:
+            tps_g, mfu_g = bench_gpt(
+                int(os.environ.get("BENCH_GPT_BATCH", "32")),
+                int(os.environ.get("BENCH_GPT_SEQ", "512")),
+                max(steps // 2, 5))
+            extras.append({
+                "metric": "gpt2_small_seq512_causal_lm_tokens_per_sec_per_chip",
+                "value": round(tps_g, 1), "unit": "tokens/s",
+                "mfu": round(mfu_g, 4)})
+        except Exception as e:  # pragma: no cover
+            print(f"gpt bench failed: {e!r}", file=sys.stderr)
+            errors.append(f"gpt: {e!r}")
     if tokens_per_sec is not None and which in ("all", "resnet"):
         try:
             ips = bench_resnet50(int(os.environ.get("BENCH_RESNET_BATCH",
                                                     "64")), steps)
+            peak = float(os.environ.get("BENCH_PEAK_TFLOPS", "197")) * 1e12
             extras.append({"metric": "resnet50_train_images_per_sec_per_chip",
-                           "value": round(ips, 1), "unit": "images/s"})
+                           "value": round(ips, 1), "unit": "images/s",
+                           "mfu": round(
+                               ips * RESNET50_TRAIN_FLOPS_PER_IMAGE / peak,
+                               4)})
         except Exception as e:  # pragma: no cover
             print(f"resnet bench failed: {e!r}", file=sys.stderr)
             errors.append(f"resnet: {e!r}")

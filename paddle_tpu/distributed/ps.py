@@ -429,6 +429,39 @@ class _PsHook:
                 self.ids_name + "@inverse":
                     inverse.reshape(batch_shape).astype(np.int32)}
 
+    def pre_multi(self, feed: dict) -> dict:
+        """k-step window pull (reference communicator.h async mode +
+        DistMultiTrainer thread pools, trainer.h:121): ONE KV round-trip
+        covers the union of the window's ids, the device runs k steps in
+        one dispatch (Executor.run_steps), and post_multi pushes the summed
+        row grads in one round-trip. Rows are frozen within the window —
+        the declared a_sync staleness (k dispatch costs and 2k-2 RPCs are
+        saved per window; see docs/perf_notes.md roofline). The ids feed is
+        either [k, ...] per-step slices or run_steps' broadcast form (one
+        batch replicated each step); both reshape consistently below."""
+        ids = np.asarray(feed[self.ids_name])
+        uniq, inverse = np.unique(ids.reshape(-1), return_inverse=True)
+        rows = self.client.pull(self.table_idx, uniq, self.dim)
+        bucket = max(8, 1 << int(np.ceil(np.log2(max(len(uniq), 1)))))
+        padded = np.zeros((bucket, self.dim), np.float32)
+        padded[:len(uniq)] = rows
+        self._last_uniq = uniq
+        # pulled rows broadcast to every step (per-step rank, no [k] axis);
+        # inverse indices keep the [k, ...] per-step slicing
+        return {self.pulled_name: padded,
+                self.ids_name + "@inverse":
+                    inverse.reshape(ids.shape).astype(np.int32)}
+
+    def post_multi(self, fetched: dict):
+        """Push the window's summed grads: with rows frozen intra-window,
+        sum-of-step-grads applied once equals the k sequential updates."""
+        g = fetched.get(self.grad_name)
+        if g is None or self._last_uniq is None:
+            return
+        g = np.asarray(g)                       # [k, bucket, dim]
+        g = g.sum(axis=0)[:len(self._last_uniq)]
+        self.client.push(self.table_idx, self._last_uniq, g, self.lr)
+
     def post(self, fetched: dict):
         g = fetched.get(self.grad_name)
         if g is None or self._last_uniq is None:

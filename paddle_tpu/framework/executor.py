@@ -689,8 +689,11 @@ class Executor:
         tunnel) this is the difference between dispatch-bound and
         compute-bound training. Random ops draw a distinct key per step
         (fold_in of the run key), matching k separate run() calls in
-        distribution. Simple single-block programs only (no PS hooks /
-        pipeline / LocalSGD / heter sections)."""
+        distribution. Sparse-PS programs run in WINDOW mode: one KV pull
+        covering all k batches' ids, rows frozen for the window, one summed
+        push after (_PsHook.pre_multi/post_multi — the reference's async
+        communicator batching). Not supported: Geo-SGD or dense-send hooks,
+        pipeline / LocalSGD programs, heter sections."""
         import jax.numpy as jnp
         program = program or default_main_program()
         if hasattr(program, "_is_data_parallel"):
@@ -700,8 +703,15 @@ class Executor:
             raise errors.InvalidArgument(
                 "run_steps needs an integer k >= 1, got %r", k)
         k = int(k)
-        if getattr(program, "_ps_hooks", None):
-            raise errors.Unimplemented("run_steps with PS hooks")
+        ps_hooks = getattr(program, "_ps_hooks", None) or []
+        if any(not hasattr(h, "pre_multi") for h in ps_hooks):
+            raise errors.Unimplemented(
+                "run_steps with PS hooks that lack window support (e.g. "
+                "dense-send hooks); use per-step run()")
+        if any(getattr(h, "geo_k", 0) > 0 for h in ps_hooks):
+            raise errors.Unimplemented(
+                "run_steps with Geo-SGD hooks (geo needs per-step local "
+                "updates; use per-step run())")
         if getattr(program, "_localsgd_k", 0) or \
                 getattr(program, "_microbatch_k", 0):
             raise errors.Unimplemented(
@@ -722,6 +732,16 @@ class Executor:
                 raise errors.NotFound(
                     "fetch target %r is not a variable of this program", n,
                     var=n)
+        # PS hooks, k-step window mode: ONE pull covering all k batches'
+        # ids, ONE summed push after — the reference's async-communicator
+        # batching (communicator.h), amortizing dispatch + RPC cost over k
+        n_user_fetch = len(fetch_names)
+        if ps_hooks:
+            feed = dict(feed)
+            for h in ps_hooks:
+                feed.update(h.pre_multi(feed))
+                if gb.has_var(h.grad_name) and h.grad_name not in fetch_names:
+                    fetch_names.append(h.grad_name)
         feed_vals = {}
         for name, value in feed.items():
             arr = jnp.asarray(_coerce_feed_value(gb, name, value))
@@ -767,6 +787,11 @@ class Executor:
         fetches, new_state = compiled(state, feed_vals, rng_key)
         for n, v in new_state.items():
             scope.set(n, v)
+        if ps_hooks:
+            fetched_by_name = dict(zip(fetch_names, fetches))
+            for h in ps_hooks:
+                h.post_multi(fetched_by_name)
+            fetches = fetches[:n_user_fetch]
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return fetches
@@ -835,9 +860,14 @@ class Executor:
         group_k = int(steps_per_loop)
         real_prog = (program.program
                      if hasattr(program, "_is_data_parallel") else program)
-        if group_k > 1 and (getattr(real_prog, "_ps_hooks", None)
+        hooks = getattr(real_prog, "_ps_hooks", None) or []
+        ps_window_ok = all(hasattr(h, "pre_multi")
+                           and getattr(h, "geo_k", 0) <= 0 for h in hooks)
+        if group_k > 1 and ((hooks and not ps_window_ok)
                             or getattr(real_prog, "_localsgd_k", 0)
                             or getattr(real_prog, "_microbatch_k", 0)):
+            # geo / dense-send hooks need per-step pull-push; sparse window
+            # hooks ride the grouped run_steps path (pre_multi/post_multi)
             group_k = 1
 
         def _shapes(feed):

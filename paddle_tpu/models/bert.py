@@ -129,6 +129,7 @@ def bert_encoder(input_ids, cfg: BertConfig, position_ids=None,
     moe_experts>0, per-layer aux load-balancing losses accumulate on the
     returned var's `_moe_aux_losses` (build_pretrain_program adds them)."""
     aux_losses = []
+    ckpts = []
     stage = _stage_guard(cfg)
     with stage(0):
         x = _bert_embeddings(input_ids, cfg)
@@ -138,7 +139,10 @@ def bert_encoder(input_ids, cfg: BertConfig, position_ids=None,
         if cfg.moe_experts > 0:
             x, aux = x
             aux_losses.append(aux)
+        ckpts.append(x.name)
     x._moe_aux_losses = aux_losses
+    # per-layer boundary vars: the natural RecomputeOptimizer checkpoints
+    x._layer_checkpoints = ckpts
     return x
 
 
@@ -214,6 +218,7 @@ def build_pretrain_program(cfg: BertConfig, use_input_mask=False):
     if aux:   # switch_moe load-balancing term (Switch eq. 4, scale 0.01)
         loss = layers.elementwise_add(
             loss, layers.scale(layers.sums(aux), 0.01 / len(aux)))
+    loss._layer_checkpoints = getattr(seq, "_layer_checkpoints", [])
     return input_ids, mlm_labels, loss
 
 
