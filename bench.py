@@ -345,6 +345,30 @@ def bench_wide_deep(batch, steps):
         srv.stop()
 
 
+def _device_tflops_probe(n=4096, iters=32):
+    """Raw sustained bf16 matmul rate, framework-free: one jit dispatch of
+    a fori_loop of n x n matmuls. Separates 'the chip/tunnel is degraded'
+    from 'the framework regressed' — round 5 observed the SAME commit that
+    recorded 114k tok/s measuring 5.5k in a window where this probe also
+    collapsed, pinning the cause on the environment."""
+    import jax
+    import jax.numpy as jnp
+
+    a = jax.device_put(jnp.full((n, n), 1.0, jnp.bfloat16))
+    inv = jnp.bfloat16(1.0 / n)
+
+    @jax.jit
+    def chain(x):
+        return jax.lax.fori_loop(
+            0, iters, lambda i, y: (y @ y) * inv, x)
+
+    _drain(chain(a))                       # compile + warm
+    t0 = time.perf_counter()
+    _drain(chain(a))
+    dt = time.perf_counter() - t0
+    return 2.0 * n ** 3 * iters / dt / 1e12
+
+
 def _prev_recorded_value():
     """Newest BENCH_r*.json that actually recorded a number.
 
@@ -361,6 +385,10 @@ def _prev_recorded_value():
                 d = json.load(f)
         except Exception:
             continue
+        if d.get("tunnel_degraded") or (
+                isinstance(d.get("parsed"), dict)
+                and d["parsed"].get("tunnel_degraded")):
+            continue   # a degraded-window number is not a comparison point
         v = d.get("value")
         if v is None and isinstance(d.get("parsed"), dict):
             v = d["parsed"].get("value")
@@ -381,7 +409,32 @@ def main():
         errors.append(f"backend init: {init_err!r}")
 
     tokens_per_sec = mfu = None
+    health_tflops = None
     if init_err is None:
+        import jax
+        on_tpu = jax.default_backend() not in ("cpu",)
+        if on_tpu:
+            try:
+                health_tflops = _device_tflops_probe()
+                _log(f"device health probe: {health_tflops:.1f} "
+                     "bf16 TFLOP/s")
+            except Exception as e:
+                print(f"health probe failed: {e!r}", file=sys.stderr)
+        try:
+            wait = int(os.environ.get("BENCH_DEGRADED_WAIT", "600"))
+        except ValueError:
+            wait = 600
+        # a degraded tunnel (health far below the ~197 peak / ~60+ typical)
+        # sometimes recovers with quiet — one bounded wait before measuring
+        if health_tflops is not None and health_tflops < 30 and wait > 0:
+            _log(f"tunnel degraded ({health_tflops:.1f} TF/s); quiet "
+                 f"{wait}s then re-probe")
+            time.sleep(wait)
+            try:
+                health_tflops = _device_tflops_probe()
+                _log(f"re-probe: {health_tflops:.1f} bf16 TFLOP/s")
+            except Exception as e:
+                print(f"health re-probe failed: {e!r}", file=sys.stderr)
         # the primary metric also gets one retry: a mid-bench transient
         # (device grant revoked) shouldn't zero the round either
         for attempt in (1, 2):
@@ -485,6 +538,14 @@ def main():
         "mfu": round(mfu, 4) if mfu is not None else None,
         "extras": extras,
     }
+    if health_tflops is not None:
+        rec["device_bf16_tflops_probe"] = round(health_tflops, 1)
+        if health_tflops < 30:
+            # framework-free evidence: the chip/tunnel itself is running
+            # far below its bf16 peak in this window (docs/perf_notes.md
+            # round-5 notes), so tok/s here is not comparable to healthy
+            # rounds
+            rec["tunnel_degraded"] = True
     if errors:
         rec["error"] = "; ".join(errors)
     # ONE parseable JSON line, even on unrecoverable failure

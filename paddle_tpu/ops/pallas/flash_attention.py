@@ -30,15 +30,34 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 512
 _LANES = 128  # Mosaic lane width; lse stored broadcast over it
+
+
+def _env_block(name: str, default: int) -> int:
+    """Env-sweepable block size; must be a positive multiple of 128."""
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r} is not an integer")
+    if v < _LANES or v % _LANES:
+        raise ValueError(
+            f"{name}={v} must be a multiple of {_LANES} and >= {_LANES}")
+    return v
+
+
+# sweepable on hardware without a rebuild (docs/perf_notes.md block sweep)
+DEFAULT_BLOCK_Q = _env_block("PADDLE_TPU_FLASH_BLOCK_Q", 256)
+DEFAULT_BLOCK_K = _env_block("PADDLE_TPU_FLASH_BLOCK_K", 512)
 
 # odd constants for the counter-based dropout hash (murmur3 fmix32 mixers)
 _H1 = 0x85EB_CA6B
@@ -74,7 +93,6 @@ def _keep_mask(seed, head, q_off, k_off, block_q, block_k, rate):
 
 def _interpret():
     """Interpreter mode: lets the kernels run (and be tested) on CPU."""
-    import os
     return (os.environ.get("PADDLE_TPU_PALLAS_INTERPRET") == "1"
             or jax.default_backend() == "cpu")
 
@@ -133,7 +151,10 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest, scale, causal,
     hd = q_ref.shape[1]
     head = pl.program_id(0)
     q_idx = pl.program_id(1)
-    q = q_ref[:].astype(jnp.float32) * scale
+    # MXU operands stay in the input dtype (bf16 under AMP — v5e runs bf16
+    # matmuls ~4x f32); accumulation is f32 via preferred_element_type, and
+    # the scale multiplies the f32 scores AFTER the dot
+    q = q_ref[:]
 
     m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
@@ -143,10 +164,10 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest, scale, causal,
 
     def body(kb, carry):
         m_prev, l_prev, acc = carry
-        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[pl.ds(kb * block_k, block_k), :]
+        v = v_ref[pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if mask_ref is not None:
             # the q-grid BlockSpec already delivered THIS q block's rows,
             # so the row offset here is 0, not q_idx * block_q
@@ -174,8 +195,9 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest, scale, causal,
             p_acc = jnp.where(keep, p / (1.0 - dropout), 0.0)
         else:
             p_acc = p
+        # probs ride the MXU in the value dtype (f32 accumulate)
         acc_new = acc * alpha + jax.lax.dot_general(
-            p_acc, v, (((1,), (0,)), ((), ())),
+            p_acc.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
@@ -265,18 +287,20 @@ def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, o_ref,
     hd = q_ref.shape[1]
     head = pl.program_id(0)
     q_idx = pl.program_id(1)
-    q = q_ref[:].astype(jnp.float32)
-    do = do_ref[:].astype(jnp.float32)
-    o = o_ref[:].astype(jnp.float32)
+    # MXU operands keep the input dtype (bf16 under AMP), f32 accumulate
+    q = q_ref[:]
+    do = do_ref[:]
+    o = o_ref[:]
     lse = lse_ref[:, :1]  # [block_q, 1]
     lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
-    delta = jnp.sum(do * o, axis=1, keepdims=True)  # [block_q, 1]
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=1, keepdims=True)          # [block_q, 1]
 
     num_k_blocks = seq_len // block_k
 
     def body(kb, dq_acc):
-        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[pl.ds(kb * block_k, block_k), :]
+        v = v_ref[pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if mask_ref is not None:
@@ -300,7 +324,7 @@ def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, o_ref,
             dp = jnp.where(keep, dp / (1.0 - dropout), 0.0)
         ds = p * (dp - delta) * scale
         return dq_acc + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -328,19 +352,21 @@ def _flash_bwd_dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, o_ref,
     hd = k_ref.shape[1]
     head = pl.program_id(0)
     k_idx = pl.program_id(1)
-    k = k_ref[:].astype(jnp.float32)
-    v = v_ref[:].astype(jnp.float32)
+    # MXU operands keep the input dtype (bf16 under AMP), f32 accumulate
+    k = k_ref[:]
+    v = v_ref[:]
 
     num_q_blocks = seq_len // block_q
 
     def body(qb, carry):
         dk_acc, dv_acc = carry
-        q = q_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        o = o_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[pl.ds(qb * block_q, block_q), :]
+        do = do_ref[pl.ds(qb * block_q, block_q), :]
+        o = o_ref[pl.ds(qb * block_q, block_q), :]
         lse = lse_ref[pl.ds(qb * block_q, block_q), :1]
         lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
-        delta = jnp.sum(do * o, axis=1, keepdims=True)
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=1, keepdims=True)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if mask_ref is not None:
@@ -362,7 +388,7 @@ def _flash_bwd_dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, o_ref,
             p_drop = p
         # dv += dropout(P)^T @ dO : contract over q rows
         dv_new = dv_acc + jax.lax.dot_general(
-            p_drop, do, (((0,), (0,)), ((), ())),
+            p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -370,7 +396,7 @@ def _flash_bwd_dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, o_ref,
             dp = jnp.where(keep, dp / (1.0 - dropout), 0.0)
         ds = p * (dp - delta) * scale
         dk_new = dk_acc + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return dk_new, dv_new
 
@@ -530,6 +556,12 @@ def flash_attention(q, k, v, scale=None, causal=False,
         scale = 1.0 / math.sqrt(q.shape[-1])
     if dropout > 0.0 and seed is None:
         raise ValueError("flash_attention dropout requires a seed")
+    if not (q.dtype == k.dtype == v.dtype):
+        # the kernels feed MXU dots in the operand dtype; mixed inputs
+        # would crash inside the backward kernels mid-training
+        raise ValueError(
+            f"flash_attention requires matching q/k/v dtypes, got "
+            f"{q.dtype}/{k.dtype}/{v.dtype}")
     seed = jnp.asarray(0 if seed is None else seed, jnp.int32).reshape((1,))
     mask_mode = None
     if mask is not None:

@@ -254,3 +254,39 @@ def test_flash_bf16_grads_finite():
     for g in (gq, gk, gv):
         assert np.isfinite(np.asarray(g, dtype=np.float32)).all()
         assert g.dtype == jnp.bfloat16
+
+
+def test_flash_bf16_matches_f32_dense_reference():
+    """The MXU dots run in the INPUT dtype (bf16 under AMP) with f32
+    accumulation — outputs and grads must stay close to the f32 dense
+    oracle within bf16 tolerance."""
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+    rng = np.random.RandomState(3)
+    qf = rng.randn(1, 2, 256, 64).astype(np.float32)
+    kf = rng.randn(1, 2, 256, 64).astype(np.float32)
+    vf = rng.randn(1, 2, 256, 64).astype(np.float32)
+    scale = 1.0 / np.sqrt(64.0)
+
+    def dense(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+
+    ref = dense(jnp.asarray(qf), jnp.asarray(kf), jnp.asarray(vf))
+    gref = jax.grad(lambda a, b, c: jnp.sum(dense(a, b, c) ** 2),
+                    argnums=(0, 1, 2))(
+        jnp.asarray(qf), jnp.asarray(kf), jnp.asarray(vf))
+
+    qb = jnp.asarray(qf).astype(jnp.bfloat16)
+    kb = jnp.asarray(kf).astype(jnp.bfloat16)
+    vb = jnp.asarray(vf).astype(jnp.bfloat16)
+    out = flash_attention(qb, kb, vb, scale, False, 128, 128)
+    gb = jax.grad(lambda a, b, c: jnp.sum(
+        flash_attention(a, b, c, scale, False, 128, 128)
+        .astype(jnp.float32) ** 2), argnums=(0, 1, 2))(qb, kb, vb)
+
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), rtol=0.05, atol=0.05)
+    for g, gr in zip(gb, gref):
+        np.testing.assert_allclose(np.asarray(g, np.float32),
+                                   np.asarray(gr), rtol=0.1, atol=0.25)
