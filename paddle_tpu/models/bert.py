@@ -165,6 +165,10 @@ def _layer_stage(cfg: BertConfig, i: int) -> int:
     return i * cfg.pipeline_stages // cfg.num_layers
 
 
+def _last_stage(cfg: BertConfig) -> int:
+    return max(1, cfg.pipeline_stages or 1) - 1
+
+
 def _bert_embeddings(input_ids, cfg: BertConfig):
     word_emb = layers.embedding(
         layers.unsqueeze(input_ids, [2]), [cfg.vocab_size, cfg.hidden_size],
@@ -187,7 +191,7 @@ def _bert_embeddings(input_ids, cfg: BertConfig):
 
 def bert_pretrain_loss(seq_out, mlm_labels, cfg: BertConfig):
     """Masked-LM head + loss (ERNIE pretraining objective)."""
-    with _stage_guard(cfg)(max(1, cfg.pipeline_stages or 1) - 1):
+    with _stage_guard(cfg)(_last_stage(cfg)):
         logits = layers.fc(seq_out, cfg.vocab_size, num_flatten_dims=2,
                            param_attr=_attr("mlm_head_w"),
                            bias_attr=ParamAttr(name="mlm_head_b"))

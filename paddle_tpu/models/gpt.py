@@ -34,6 +34,10 @@ class GPTConfig:
     seq_len: int = 128
     sequence_parallel: bool = False
     sp_mode: str = "ring"
+    # >0: device_guard stages for pipeline parallelism over the pp mesh
+    # axis (embeddings stage 0, blocks in contiguous chunks, tied head last
+    # stage — the shared wte gets cross-stage grads summed by the pp runner)
+    pipeline_stages: int = 0
 
     @staticmethod
     def small():
@@ -99,24 +103,53 @@ def decoder_layer(x, cfg: GPTConfig, idx: int):
     return layers.elementwise_add(x, ffn)
 
 
+def _stage_guard(cfg: GPTConfig):
+    """device_guard factory: a no-op context when pipeline is off."""
+    import contextlib
+    from ..framework.program import device_guard
+    if cfg.pipeline_stages and cfg.pipeline_stages > 1:
+        return lambda s: device_guard(f"gpu:{s}")
+    return lambda s: contextlib.nullcontext()
+
+
+def _layer_stage(cfg: GPTConfig, i: int) -> int:
+    if not cfg.pipeline_stages or cfg.pipeline_stages <= 1:
+        return 0
+    if cfg.pipeline_stages > cfg.num_layers:
+        raise ValueError(
+            f"pipeline_stages={cfg.pipeline_stages} > num_layers="
+            f"{cfg.num_layers}: some pp submeshes would hold no ops")
+    return i * cfg.pipeline_stages // cfg.num_layers
+
+
+def _last_stage(cfg: GPTConfig) -> int:
+    return max(1, cfg.pipeline_stages or 1) - 1
+
+
 def gpt_decoder(token_ids, cfg: GPTConfig):
     """Tied embeddings + N pre-LN causal blocks + final LN.
     Returns (seq_out [B, S, H], wte var for the tied head)."""
-    wte = layers.create_parameter([cfg.vocab_size, cfg.hidden_size],
-                                  "float32", attr=_attr("wte"))
-    wpe = layers.create_parameter([cfg.max_position, cfg.hidden_size],
-                                  "float32", attr=_attr("wpe"))
-    tok = layers.gather(wte, layers.reshape(token_ids, [-1]))
-    tok = layers.reshape(tok, [-1, cfg.seq_len, cfg.hidden_size])
-    pos = layers.unsqueeze(
-        layers.slice(wpe, [0], [0], [cfg.seq_len]), [0])
-    x = layers.elementwise_add(tok, pos)
-    if cfg.hidden_dropout:
-        x = layers.dropout(x, cfg.hidden_dropout,
-                           dropout_implementation="upscale_in_train")
+    stage = _stage_guard(cfg)
+    last = _last_stage(cfg)
+    with stage(0):
+        wte = layers.create_parameter([cfg.vocab_size, cfg.hidden_size],
+                                      "float32", attr=_attr("wte"))
+        wpe = layers.create_parameter([cfg.max_position, cfg.hidden_size],
+                                      "float32", attr=_attr("wpe"))
+        tok = layers.gather(wte, layers.reshape(token_ids, [-1]))
+        tok = layers.reshape(tok, [-1, cfg.seq_len, cfg.hidden_size])
+        pos = layers.unsqueeze(
+            layers.slice(wpe, [0], [0], [cfg.seq_len]), [0])
+        x = layers.elementwise_add(tok, pos)
+        if cfg.hidden_dropout:
+            x = layers.dropout(x, cfg.hidden_dropout,
+                               dropout_implementation="upscale_in_train")
     for i in range(cfg.num_layers):
-        x = decoder_layer(x, cfg, i)
-    return _ln(x, "final_ln"), wte
+        with stage(_layer_stage(cfg, i)):
+            x = decoder_layer(x, cfg, i)
+    with stage(last):
+        out = _ln(x, "final_ln")
+    return out, wte
 
 
 def build_lm_program(cfg: GPTConfig):
@@ -124,12 +157,13 @@ def build_lm_program(cfg: GPTConfig):
     Returns (tokens, loss)."""
     tokens = layers.data(name="tokens", shape=[cfg.seq_len], dtype="int64")
     seq, wte = gpt_decoder(tokens, cfg)
-    logits = layers.matmul(seq, wte, transpose_y=True)   # tied head
-    shift_logits = layers.slice(logits, [1], [0], [cfg.seq_len - 1])
-    shift_labels = layers.slice(tokens, [1], [1], [cfg.seq_len])
-    shift_labels = layers.unsqueeze(shift_labels, [2])
-    loss = layers.softmax_with_cross_entropy(shift_logits, shift_labels)
-    return tokens, layers.mean(loss)
+    with _stage_guard(cfg)(_last_stage(cfg)):
+        logits = layers.matmul(seq, wte, transpose_y=True)   # tied head
+        shift_logits = layers.slice(logits, [1], [0], [cfg.seq_len - 1])
+        shift_labels = layers.slice(tokens, [1], [1], [cfg.seq_len])
+        shift_labels = layers.unsqueeze(shift_labels, [2])
+        loss = layers.softmax_with_cross_entropy(shift_logits, shift_labels)
+        return tokens, layers.mean(loss)
 
 
 def tp_sharding_rules() -> ShardingRules:

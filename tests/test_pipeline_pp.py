@@ -195,3 +195,38 @@ def test_pp2_shared_param_across_stages_sums_grads():
     ref_losses, rw = run({})
     np.testing.assert_allclose(pipe_losses, ref_losses, rtol=1e-4, atol=1e-6)
     np.testing.assert_allclose(pw, rw, rtol=1e-4, atol=1e-6)
+
+
+def test_gpt_pp2_tied_embeddings_parity():
+    """GPT over pp=2: the tied wte is read at stage 0 (lookup) AND the last
+    stage (LM head) — the pipeline runner must transfer the table forward
+    and sum both stages' grad contributions. Loss/param parity vs the
+    single-device GPipe run proves it."""
+    from paddle_tpu.models import gpt
+
+    def run(pp):
+        _fresh()
+        cfg = gpt.GPTConfig.tiny()
+        cfg.pipeline_stages = pp if pp > 1 else 0
+        tokens, loss = gpt.build_lm_program(cfg)
+        opt = paddle.optimizer.PipelineOptimizer(
+            paddle.optimizer.Adam(learning_rate=1e-2), num_microbatches=2)
+        opt.minimize(loss)
+        prog = fluid.default_main_program()
+        if pp > 1:
+            mesh = build_mesh(dp=1, pp=pp, devices=jax.devices()[:pp])
+            attach(prog, DistConfig(mesh=mesh))
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(0)
+        feed = {"tokens": rng.randint(0, cfg.vocab_size,
+                                      (8, cfg.seq_len)).astype(np.int64)}
+        losses = [float(exe.run(prog, feed=feed, fetch_list=[loss])[0])
+                  for _ in range(3)]
+        return losses, np.asarray(global_scope().find("wte"))
+
+    pipe_losses, pw = run(2)
+    ref_losses, rw = run(1)
+    np.testing.assert_allclose(pipe_losses, ref_losses, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(pw, rw, rtol=1e-4, atol=1e-6)
+    assert pipe_losses[-1] < pipe_losses[0]
