@@ -21,11 +21,14 @@ TPU-native design — no send/recv ops, no section threads:
   move between submeshes as `jax.device_put` transfers — ICI/DCN
   device-to-device on hardware, the send/recv of the reference collapsed
   into the runtime.
-* The schedule is GPipe with the reference's semantics (gradients averaged
-  over microbatches, BN stats sequential across microbatches, LR sched once
-  per step): dispatch is asynchronous, so while stage s executes microbatch
-  m, stage s+1 executes microbatch m-1 — the reference's section threads
-  collapse into per-device XLA execution queues.
+* The schedule issues in 1F1B order — num_stages warmup forwards, then
+  alternating fwd/bwd (bwd(m) is enqueued after fwd(m+S-1)) — with the
+  reference's semantics (gradients averaged over microbatches, BN stats
+  sequential across microbatches, LR sched once per step): dispatch is
+  asynchronous, so while stage s executes microbatch m, stage s+1
+  executes microbatch m-1 — the reference's section threads collapse into
+  per-device XLA execution queues — and at most ~num_stages+1 microbatch
+  activation sets are in flight.
 * RNG: every stage call uses the SAME run key; random ops key off their
   stable `__rng_seed__` attr (ops/registry.py LowerCtx.op_key), so dropout
   masks match between a stage's forward and backward calls AND match the
@@ -399,41 +402,45 @@ class _PipelineBlock:
             env_step.update(self._run_seg(self.sched_seg, lookup_static,
                                           rng_key))
 
-        # forward wave, then backward wave (GPipe); async dispatch overlaps
-        # stage s's microbatch m with stage s+1's microbatch m-1
-        env_mb: List[Dict[str, jax.Array]] = [dict(mf) for mf in micro_feeds]
+        # 1F1B issue order with num_stages warmup forwards: bwd(m) is only
+        # enqueued after fwd(m + S - 1), so every stage's FIFO queue keeps
+        # a forward to run while earlier microbatches' backwards drain
+        # through later stages (per-device queues execute strictly in
+        # order — a bwd issued too early would head-of-line-block the next
+        # fwd). Steady state alternates 1 fwd / 1 bwd per stage; at most
+        # ~S+1 microbatch activation envs are live; grad sums are order-
+        # independent, so numerics equal the GPipe/scan reference exactly.
+        # If a BACKWARD segment writes a persistable (so microbatch m+1's
+        # forward must see m's backward write), fall back to the strict
+        # sequential delay of 1.
         acc: Dict[str, jax.Array] = {}
+        fetch_stack: Dict[str, List[jax.Array]] = {
+            n: [] for n in self.fetch_names if n in self.body_writes}
+        live_envs: Dict[int, Dict[str, jax.Array]] = {}
+        bwd_writes_pers = any(n in self.written_pers
+                              for seg in self.bwd_segs
+                              for n in seg.out_names)
+        delay = 1 if bwd_writes_pers else self.num_stages
 
-        def lookup_mb(m):
-            def f(n):
-                if n in env_mb[m]:
-                    return env_mb[m][n]
-                return lookup_static(n)
-            return f
-
-        for m in range(K):
-            for seg in self.fwd_segs:
-                out = self._run_seg(seg, lookup_mb(m), rng_key)
+        def run_phase(segs, env_m):
+            def lookup(n):
+                return env_m[n] if n in env_m else lookup_static(n)
+            for seg in segs:
+                out = self._run_seg(seg, lookup, rng_key)
                 for n, v in out.items():
                     if n in self.written_pers:
                         env_step[n] = v      # BN stats: sequential across mb
                     else:
-                        env_mb[m][n] = v
-        for m in reversed(range(K)):
-            for seg in self.bwd_segs:
-                out = self._run_seg(seg, lookup_mb(m), rng_key)
-                for n, v in out.items():
-                    if n in self.written_pers:
-                        env_step[n] = v
-                    else:
-                        env_mb[m][n] = v
+                        env_m[n] = v
 
-        # accumulate the opt-consumed body outputs over microbatches
-        fetch_stack: Dict[str, List[jax.Array]] = {
-            n: [] for n in self.fetch_names if n in self.body_writes}
-        for m in range(K):
+        def issue_bwd(m):
+            env_m = live_envs.pop(m)
+            run_phase(self.bwd_segs, env_m)
+            # fold this microbatch's opt-consumed outputs into the window
+            # accumulators, then release its env (device buffers free once
+            # the dispatched computations consume them)
             for n in self.acc_names:
-                v = env_mb[m].get(n, env_step.get(n))
+                v = env_m.get(n, env_step.get(n))
                 if v is None:
                     continue
                 if jnp.issubdtype(v.dtype, jnp.floating):
@@ -441,8 +448,19 @@ class _PipelineBlock:
                 else:
                     acc[n] = v               # non-float: last value wins
             for n in fetch_stack:
-                if n in env_mb[m]:
-                    fetch_stack[n].append(env_mb[m][n])
+                if n in env_m:
+                    fetch_stack[n].append(env_m[n])
+
+        next_bwd = 0
+        for m in range(K):
+            live_envs[m] = dict(micro_feeds[m])
+            run_phase(self.fwd_segs, live_envs[m])
+            if m - next_bwd >= delay - 1:
+                issue_bwd(next_bwd)
+                next_bwd += 1
+        while next_bwd < K:
+            issue_bwd(next_bwd)
+            next_bwd += 1
         for n, v in acc.items():
             if jnp.issubdtype(v.dtype, jnp.floating):
                 v = v / K

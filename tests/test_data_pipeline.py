@@ -468,3 +468,58 @@ def test_train_from_dataset_steps_per_loop_parity(tmp_path):
     for name in p1:
         np.testing.assert_allclose(p3[name], p1[name], rtol=1e-5,
                                    atol=1e-6)
+
+
+def test_train_from_dataset_ps_window_groups_batches(tmp_path):
+    """Sparse-PS programs ride the grouped run_steps path under
+    steps_per_loop>1: ONE pull per k-batch window (counted via the client)
+    instead of one per batch, and training still moves the server table."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.ps import (KVServer, SparseTableConfig,
+                                           distributed_embedding)
+
+    paths = _write_multislot(tmp_path, n_files=2, rows=16)
+    srv = KVServer([SparseTableConfig("wtab", dim=4, init_scale=0.01)])
+    port = srv.start(0)
+    try:
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+        ids = fluid.layers.data(name="ids", shape=[3], dtype="int64")
+        emb = distributed_embedding(ids, "wtab", dim=4, lr=0.1)
+        feat = layers.concat([layers.reduce_sum(emb, dim=1), x], axis=1)
+        pred = layers.fc(feat, size=1)
+        loss = layers.reduce_mean(layers.square(pred))
+        fleet.init(role_maker=fleet.UserDefinedRoleMaker(
+            server_endpoints=[f"127.0.0.1:{port}"]))
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.01),
+            fleet.DistributedStrategy())
+        opt.minimize(loss)
+        client = fleet.init_worker()
+
+        ds = fluid.dataset.DatasetFactory().create_dataset("InMemoryDataset")
+        ds.set_batch_size(8)
+        ds.set_thread(1)
+        ds.set_use_var([x, ids])
+        ds.set_filelist(paths)
+        ds.load_into_memory()       # 32 rows -> 4 batches of 8
+
+        hook = fluid.default_main_program()._ps_hooks[0]
+        pulls = []
+        orig_pull = hook.client.pull
+        hook.client.pull = lambda *a, **kw: (pulls.append(1),
+                                             orig_pull(*a, **kw))[1]
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        out = exe.train_from_dataset(fluid.default_main_program(), ds,
+                                     fetch_list=[loss], steps_per_loop=4)
+        assert out is not None and np.isfinite(np.asarray(out[0])).all()
+        # 4 batches in ONE window -> exactly 1 pull (per-batch mode would be 4)
+        assert len(pulls) == 1, f"expected 1 windowed pull, saw {len(pulls)}"
+        t = client.pull(0, np.arange(16, dtype=np.int64), 4)
+        assert np.isfinite(t).all()
+    finally:
+        try:
+            fleet.stop_worker()
+        except Exception:
+            pass
+        srv.stop()
