@@ -4,6 +4,8 @@
 3/4. BERT/ERNIE transformer (static, SPMD-ready with TP rules) -> bert.py
 5. Wide&Deep CTR (sparse embeddings) -> wide_deep.py
 Plus a GPT-style causal-decoder LM (tied embeddings, pre-LN, causal flash
-attention, TP rules) -> gpt.py
+attention, TP rules) -> gpt.py, and SE-ResNeXt 50/101/152 (the reference's
+canonical dist-test model, grouped convs + squeeze-excitation)
+-> se_resnext.py
 """
-from . import lenet, resnet, bert, wide_deep, gpt
+from . import lenet, resnet, bert, wide_deep, gpt, se_resnext

@@ -112,11 +112,19 @@ def resnet101(num_classes=1000, **kw):
 # (dist_se_resnext.py) at the API level.
 # ---------------------------------------------------------------------------
 
-def _static_conv_bn(x, ch, filter_size, stride=1, act=None, is_test=False):
+def _static_conv_bn(x, ch, filter_size, stride=1, act=None, is_test=False,
+                    groups=1, name=None):
     from .. import layers
+    from ..layer_helper import ParamAttr
     y = layers.conv2d(x, ch, filter_size, stride=stride,
-                      padding=(filter_size - 1) // 2, bias_attr=False)
-    return layers.batch_norm(y, act=act, is_test=is_test)
+                      padding=(filter_size - 1) // 2, bias_attr=False,
+                      groups=groups,
+                      param_attr=(ParamAttr(name=f"{name}_w")
+                                  if name else None))
+    return layers.batch_norm(
+        y, act=act, is_test=is_test,
+        param_attr=ParamAttr(name=f"{name}_bn_s") if name else None,
+        bias_attr=ParamAttr(name=f"{name}_bn_b") if name else None)
 
 
 def _static_bottleneck(x, ch, stride, is_test=False):
