@@ -1,0 +1,287 @@
+"""KV-cache autoregressive decoding for the GPT flagship (models/gpt.py).
+
+The reference era has no in-tree autoregressive serving loop (its
+inference story is the feed-forward AnalysisPredictor,
+paddle/fluid/inference/api/analysis_predictor.cc); decoding is where a
+TPU-native design diverges hardest from a CUDA one, so it is built
+jax-first here:
+
+  * static shapes end to end — the cache is a preallocated
+    [B, nh, max_len, hd] ring per layer, written with
+    `lax.dynamic_update_slice`; the decode loop is ONE `lax.scan`
+    compiled once, not a python token loop re-tracing every step;
+  * prefill is a single dense causal forward over the whole prompt
+    (MXU-shaped: one [B, S, H] pass), not token-at-a-time;
+  * sampling (greedy / temperature / top-k) happens on-device inside the
+    scan so no logits ever travel host-side during generation.
+
+Weights come straight from the trained Program's scope by parameter name
+(`params_from_scope`): the decode path is a pure-jax re-expression of the
+same ops the static graph trains (fc = x @ w + b, pre-LN eps 1e-5, exact
+tanh-free gelu), so cached decode is bit-compatible with a full forward.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+import os
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .gpt import GPTConfig
+
+
+def params_from_scope(cfg: GPTConfig, scope=None) -> Dict[str, jnp.ndarray]:
+    """Pull the GPT parameter set out of a (trained) scope by name."""
+    if scope is None:
+        from ..framework.scope import global_scope
+        scope = global_scope()
+    names = ["wte", "wpe", "final_ln_scale", "final_ln_bias"]
+    for i in range(cfg.num_layers):
+        names += [f"dec{i}_ln1_scale", f"dec{i}_ln1_bias",
+                  f"dec{i}_attn_qkv_w", f"dec{i}_attn_qkv_b",
+                  f"dec{i}_attn_proj_w", f"dec{i}_attn_proj_b",
+                  f"dec{i}_ln2_scale", f"dec{i}_ln2_bias",
+                  f"dec{i}_ffn_in_w", f"dec{i}_ffn_in_b",
+                  f"dec{i}_ffn_out_w", f"dec{i}_ffn_out_b"]
+    from ..framework.errors import NotFoundError
+    params = {}
+    for n in names:
+        v = scope.find(n)
+        if v is None:
+            raise NotFoundError(
+                f"parameter {n!r} not found in scope — build the model with "
+                "models.gpt.gpt_decoder and run the startup program first",
+                var=n)
+        params[n] = jnp.asarray(np.asarray(v))
+    return params
+
+
+def _ln(x, scale, bias, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mean) / jnp.sqrt(var + eps)
+    return (y * scale.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def _split_heads(t, nh):
+    b, s, h = t.shape
+    return t.reshape(b, s, nh, h // nh).transpose(0, 2, 1, 3)
+
+
+def _merge_heads(t):
+    b, nh, s, hd = t.shape
+    return t.transpose(0, 2, 1, 3).reshape(b, s, nh * hd)
+
+
+def _attend(q, k, v, mask, scale):
+    # q: [B, nh, Sq, hd]; k/v: [B, nh, Sk, hd]; mask additive [.., Sq, Sk]
+    scores = jnp.einsum("bnqd,bnkd->bnqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    scores = scores + mask
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bnqk,bnkd->bnqd", probs, v)
+
+
+def _block(x, p, i, cfg, mask, merge=None):
+    """One pre-LN decoder block; the SINGLE transformer-block body both
+    prefill and cached decode run through (bit-compatibility between the
+    two paths holds because there is exactly one implementation).
+
+    merge(k_new, v_new) -> (k, v) maps this call's freshly projected
+    keys/values to the pair attention runs against: prefill passes None
+    (attend against this pass's own k/v); decode passes a hook that
+    writes the new position into the running cache and returns the
+    merged cache. Returns (x_out, (k, v)) with the attended pair."""
+    nh, h = cfg.num_heads, cfg.hidden_size
+    hd = h // nh
+    a = _ln(x, p[f"dec{i}_ln1_scale"], p[f"dec{i}_ln1_bias"])
+    qkv = a @ p[f"dec{i}_attn_qkv_w"] + p[f"dec{i}_attn_qkv_b"]
+    q, k_new, v_new = jnp.split(qkv, 3, axis=-1)
+    q = _split_heads(q, nh)
+    k_new = _split_heads(k_new, nh)
+    v_new = _split_heads(v_new, nh)
+    k, v = (k_new, v_new) if merge is None else merge(k_new, v_new)
+    ctx = _attend(q, k, v, mask, 1.0 / math.sqrt(hd))
+    proj = _merge_heads(ctx) @ p[f"dec{i}_attn_proj_w"] \
+        + p[f"dec{i}_attn_proj_b"]
+    x = x + proj
+    f = _ln(x, p[f"dec{i}_ln2_scale"], p[f"dec{i}_ln2_bias"])
+    ffn = jax.nn.gelu(f @ p[f"dec{i}_ffn_in_w"] + p[f"dec{i}_ffn_in_b"],
+                      approximate=False)
+    ffn = ffn @ p[f"dec{i}_ffn_out_w"] + p[f"dec{i}_ffn_out_b"]
+    return x + ffn, (k, v)
+
+
+def _embed(p, tokens, pos_start):
+    # tokens [B, S] -> [B, S, H] with positions pos_start..pos_start+S-1
+    tok = p["wte"][tokens]
+    s = tokens.shape[1]
+    pos = jax.lax.dynamic_slice_in_dim(p["wpe"], pos_start, s, 0)
+    return tok + pos[None]
+
+
+def _sample(logits, temperature, top_k, key):
+    """Greedy when temperature == 0 (static python float), else
+    temperature softmax, optionally truncated to the top_k logits."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    scaled = logits.astype(jnp.float32) / temperature
+    if top_k:
+        k = min(top_k, logits.shape[-1])  # clamp: top_k > vocab means "all"
+        kth = jnp.sort(scaled, axis=-1)[..., -k][..., None]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    return jax.random.categorical(key, scaled, axis=-1)
+
+
+def prefill(params, cfg: GPTConfig, prompt, prompt_len, max_len):
+    """Dense causal forward over the padded prompt; returns
+    (cache_k, cache_v, last_logits). prompt is [B, Sp] (padded), with
+    prompt_len <= Sp the number of real tokens; cache_* are per-layer
+    lists of [B, nh, max_len, hd] holding positions < prompt_len.
+
+    Contract for padded prompts (prompt_len < Sp): decode MUST resume at
+    ``pos = prompt_len``, not Sp. Slots [prompt_len, Sp) hold zeroed
+    pad material and are overwritten in order by subsequent decode
+    writes, so the attention window (keys <= pos) only ever covers real
+    positions. Resuming at pos >= prompt_len + 1 would leave unwritten
+    gap slots inside the window (and a gap in position ids) — that is a
+    contract violation, not a supported mode."""
+    b, sp = prompt.shape
+    nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    x = _embed(params, prompt, 0)
+    qpos = jnp.arange(sp)[:, None]
+    kpos = jnp.arange(sp)[None, :]
+    causal = jnp.where(qpos >= kpos, 0.0, -jnp.inf).astype(jnp.float32)
+    cache_k, cache_v = [], []
+    keep = (jnp.arange(max_len) < prompt_len)[None, None, :, None]
+    for i in range(cfg.num_layers):
+        x, (k, v) = _block(x, params, i, cfg, causal)
+        pad = max_len - sp
+        kc = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        vc = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        # zero any padded-prompt positions so stale keys can't leak into
+        # the decode-phase attention window
+        cache_k.append(jnp.where(keep, kc, 0.0).astype(kc.dtype))
+        cache_v.append(jnp.where(keep, vc, 0.0).astype(vc.dtype))
+    x = _ln(x, params["final_ln_scale"], params["final_ln_bias"])
+    # slice the last real position BEFORE the [H, V] head matmul: the head
+    # is the most vocab-heavy op in prefill and only one row is needed
+    x_last = jax.lax.dynamic_slice_in_dim(x, prompt_len - 1, 1, axis=1)
+    last = (x_last @ params["wte"].T)[:, 0]           # tied head [B, V]
+    return cache_k, cache_v, last
+
+
+def decode_step(params, cfg: GPTConfig, cache_k, cache_v, token, pos):
+    """One cached decode step: token [B] at position pos (scalar).
+    Returns (cache_k, cache_v, logits [B, V]). See prefill's docstring
+    for the resume-position contract after a padded prefill."""
+    max_len = cache_k[0].shape[2]
+    x = _embed(params, token[:, None], pos)
+    # keys 0..pos are valid after this step's write
+    mask = jnp.where(jnp.arange(max_len)[None, :] <= pos,
+                     0.0, -jnp.inf).astype(jnp.float32)
+    new_k, new_v = [], []
+    for i in range(cfg.num_layers):
+        def merge(k1, v1, _i=i):
+            # write-then-attend: this position's k/v into the cache,
+            # attention runs against the merged cache
+            return tuple(
+                jax.lax.dynamic_update_slice_in_dim(
+                    cache, fresh.astype(cache.dtype), pos, axis=2)
+                for cache, fresh in ((cache_k[_i], k1), (cache_v[_i], v1)))
+
+        x, (ck, cv) = _block(x, params, i, cfg, mask, merge)
+        new_k.append(ck)
+        new_v.append(cv)
+    x = _ln(x, params["final_ln_scale"], params["final_ln_bias"])
+    return new_k, new_v, (x @ params["wte"].T)[:, 0]
+
+
+# compiled (prefill + scan) executables, keyed by every static knob so
+# repeated generate() calls (a serving loop) reuse the XLA program; params
+# and the PRNG key are runtime arguments — weights are NOT baked into the
+# executable as constants. LRU-bounded: naturally varying prompt lengths
+# would otherwise accumulate executables forever — serving loops should
+# additionally bucket Sp to a few padded sizes (prefill supports
+# prompt_len < Sp) so the cache stays hot.
+_GEN_CACHE: "collections.OrderedDict[tuple, object]" = \
+    collections.OrderedDict()
+_GEN_CACHE_MAX = int(os.environ.get("PADDLE_TPU_GEN_CACHE_MAX", "32"))
+
+
+def _compiled_generate(cfg: GPTConfig, sp: int, max_new_tokens: int,
+                       temperature: float, top_k: int,
+                       eos_token: Optional[int]):
+    key = (dataclasses.astuple(cfg), sp, max_new_tokens, temperature,
+           top_k, eos_token)
+    fn = _GEN_CACHE.get(key)
+    if fn is not None:
+        _GEN_CACHE.move_to_end(key)
+        return fn
+    max_len = sp + max_new_tokens
+
+    def run(params, prompt, rng_key):
+        cache_k, cache_v, logits = prefill(params, cfg, prompt,
+                                           jnp.int32(sp), max_len)
+        first = _sample(logits, temperature, top_k,
+                        jax.random.fold_in(rng_key, 0)).astype(jnp.int32)
+        done0 = (first == eos_token) if eos_token is not None \
+            else jnp.zeros(first.shape, bool)
+
+        def step(carry, t):
+            ck, cv, tok, done = carry
+            ck, cv, logits = decode_step(params, cfg, ck, cv, tok, sp + t)
+            nxt = _sample(logits, temperature, top_k,
+                          jax.random.fold_in(rng_key,
+                                             t + 1)).astype(jnp.int32)
+            if eos_token is not None:
+                nxt = jnp.where(done, eos_token, nxt)
+                done = done | (nxt == eos_token)
+            return (ck, cv, nxt, done), nxt
+
+        if max_new_tokens == 1:
+            return jnp.concatenate([prompt, first[:, None]], axis=1)
+        (_, _, _, _), rest = jax.lax.scan(
+            step, (cache_k, cache_v, first, done0),
+            jnp.arange(max_new_tokens - 1))
+        return jnp.concatenate(
+            [prompt, first[:, None], rest.T.astype(jnp.int32)], axis=1)
+
+    fn = jax.jit(run)
+    _GEN_CACHE[key] = fn
+    while len(_GEN_CACHE) > _GEN_CACHE_MAX:
+        _GEN_CACHE.popitem(last=False)
+    return fn
+
+
+def generate(params: Dict[str, jnp.ndarray], cfg: GPTConfig,
+             prompt_ids, max_new_tokens: int, *,
+             temperature: float = 0.0, top_k: int = 0,
+             seed: int = 0, eos_token: Optional[int] = None):
+    """Autoregressive generation with a static KV cache.
+
+    prompt_ids: [B, Sp] int tokens (no padding — all rows same length).
+    Returns [B, Sp + max_new_tokens]. Greedy when temperature == 0.
+    When eos_token is set, rows that have emitted it keep emitting
+    eos_token (the scan stays static-length; trim host-side)."""
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    _, sp = prompt_ids.shape
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0, got "
+                         f"{max_new_tokens}")
+    if max_new_tokens == 0:
+        return prompt_ids
+    if sp + max_new_tokens > cfg.max_position:
+        raise ValueError(
+            f"prompt {sp} + {max_new_tokens} new tokens exceeds "
+            f"max_position {cfg.max_position}")
+    fn = _compiled_generate(cfg, sp, max_new_tokens, float(temperature),
+                            int(top_k), eos_token)
+    return fn(params, prompt_ids, jax.random.PRNGKey(seed))

@@ -124,7 +124,9 @@ def _static_conv_bn(x, ch, filter_size, stride=1, act=None, is_test=False,
     return layers.batch_norm(
         y, act=act, is_test=is_test,
         param_attr=ParamAttr(name=f"{name}_bn_s") if name else None,
-        bias_attr=ParamAttr(name=f"{name}_bn_b") if name else None)
+        bias_attr=ParamAttr(name=f"{name}_bn_b") if name else None,
+        moving_mean_name=f"{name}_bn_mean" if name else None,
+        moving_variance_name=f"{name}_bn_var" if name else None)
 
 
 def _static_bottleneck(x, ch, stride, is_test=False):

@@ -268,7 +268,7 @@ def _flash_fwd(q, k, v, seed, mask, scale, causal, dropout, block_q, block_k,
             jax.ShapeDtypeStruct((b * nh, s, _LANES), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel")),
         interpret=_interpret(),
     )(*operands)
     return out.reshape(b, nh, s, hd), lse
@@ -449,7 +449,7 @@ def _flash_bwd(q, k, v, o, lse, do, seed, mask, scale, causal, dropout,
         out_specs=pl.BlockSpec((None, bq, hd), lambda h, i: (h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * nh, s, hd), q.dtype),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel")),
         interpret=_interpret(),
     )(*dq_operands)
 
@@ -482,7 +482,7 @@ def _flash_bwd(q, k, v, o, lse, do, seed, mask, scale, causal, dropout,
             jax.ShapeDtypeStruct((b * nh, s, hd), v.dtype),
         ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel")),
         interpret=_interpret(),
     )(*dkdv_operands)
 

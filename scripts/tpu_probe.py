@@ -1,0 +1,31 @@
+"""One-dispatch bf16 matmul probe: prints sustained TFLOP/s on the default
+backend. Used to find a healthy axon-tunnel window before benching
+(docs/perf_notes.md round-5 notes: degraded windows measure <30 TF/s and
+make every framework number meaningless)."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def probe(n: int = 4096, chain: int = 8) -> float:
+    x = jnp.ones((n, n), jnp.bfloat16)
+
+    def f(a):
+        for i in range(chain):
+            # data-dependent chain so XLA cannot elide any dot
+            a = jnp.dot(a, a, preferred_element_type=jnp.bfloat16) * 1e-6 + a
+        return a
+
+    g = jax.jit(f)
+    np.asarray(g(x))  # compile + warm
+    t0 = time.perf_counter()
+    np.asarray(g(x))
+    dt = time.perf_counter() - t0
+    return chain * 2 * n ** 3 / dt / 1e12
+
+
+if __name__ == "__main__":
+    print(f"backend={jax.default_backend()} "
+          f"tflops={probe():.1f}")

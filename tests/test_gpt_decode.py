@@ -1,0 +1,129 @@
+"""KV-cache decode parity: the scan/cached generation loop
+(models/gpt_decode.py) must emit exactly the tokens a full causal forward
+through the static-graph executor emits (the reference has no in-tree
+autoregressive loop — its predictor re-runs full forwards; cached decode
+must be indistinguishable from that)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import layers
+from paddle_tpu.models.gpt import GPTConfig, gpt_decoder
+from paddle_tpu.models import gpt_decode
+
+PROMPT, NEW = 8, 6
+
+
+def _build(total_len):
+    cfg = GPTConfig.tiny()
+    cfg.seq_len = total_len
+    cfg.max_position = 64
+    tokens = layers.data(name="tokens", shape=[cfg.seq_len], dtype="int64")
+    seq, wte = gpt_decoder(tokens, cfg)
+    logits = layers.matmul(seq, wte, transpose_y=True)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    return cfg, exe, tokens, logits
+
+
+def _naive_generate(exe, logits, prompt, new_tokens, total_len):
+    """Full-recompute argmax decoding through the executor: position t's
+    logits only see tokens <= t (causal), so junk padding is harmless."""
+    b = prompt.shape[0]
+    toks = np.zeros((b, total_len), np.int64)
+    toks[:, :prompt.shape[1]] = prompt
+    cur = prompt.shape[1]
+    for _ in range(new_tokens):
+        lg = exe.run(feed={"tokens": toks},
+                     fetch_list=[logits])[0]
+        toks[:, cur] = np.argmax(lg[:, cur - 1], axis=-1)
+        cur += 1
+    return toks
+
+
+def test_cached_decode_matches_full_recompute():
+    total = PROMPT + NEW
+    cfg, exe, _, logits = _build(total)
+    rng = np.random.RandomState(7)
+    prompt = rng.randint(0, cfg.vocab_size, (2, PROMPT)).astype(np.int64)
+
+    expect = _naive_generate(exe, logits, prompt, NEW, total)
+    params = gpt_decode.params_from_scope(cfg)
+    got = np.asarray(gpt_decode.generate(params, cfg, prompt, NEW))
+    assert got.shape == (2, total)
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_sampled_decode_deterministic_and_in_range():
+    total = PROMPT + NEW
+    cfg, exe, _, _ = _build(total)
+    rng = np.random.RandomState(3)
+    prompt = rng.randint(0, cfg.vocab_size, (2, PROMPT)).astype(np.int64)
+    params = gpt_decode.params_from_scope(cfg)
+    a = np.asarray(gpt_decode.generate(params, cfg, prompt, NEW,
+                                       temperature=0.8, top_k=16, seed=11))
+    b = np.asarray(gpt_decode.generate(params, cfg, prompt, NEW,
+                                       temperature=0.8, top_k=16, seed=11))
+    c = np.asarray(gpt_decode.generate(params, cfg, prompt, NEW,
+                                       temperature=0.8, top_k=16, seed=12))
+    np.testing.assert_array_equal(a, b)
+    assert a.min() >= 0 and a.max() < cfg.vocab_size
+    assert not np.array_equal(a, c)  # different seed explores
+
+
+def test_eos_latches():
+    total = PROMPT + NEW
+    cfg, exe, _, logits = _build(total)
+    rng = np.random.RandomState(5)
+    prompt = rng.randint(0, cfg.vocab_size, (1, PROMPT)).astype(np.int64)
+    params = gpt_decode.params_from_scope(cfg)
+    greedy = np.asarray(gpt_decode.generate(params, cfg, prompt, NEW))
+    eos = int(greedy[0, PROMPT + 1])  # force the 2nd generated token as eos
+    out = np.asarray(gpt_decode.generate(params, cfg, prompt, NEW,
+                                         eos_token=eos))
+    tail = out[0, PROMPT:]
+    hit = np.where(tail == eos)[0]
+    assert hit.size, "eos never emitted despite matching the greedy path"
+    # every position after the first eos is eos (latched)
+    assert (tail[hit[0]:] == eos).all()
+
+
+def test_padded_prefill_resumes_at_prompt_len():
+    """prefill's padded-prompt contract: with prompt_len < Sp, decoding
+    from pos = prompt_len (pad slots overwritten in order) must emit the
+    same tokens as an unpadded prefill of just the real prompt."""
+    import jax.numpy as jnp
+
+    total = PROMPT + NEW
+    cfg, exe, _, _ = _build(total)
+    rng = np.random.RandomState(9)
+    prompt = rng.randint(0, cfg.vocab_size, (2, PROMPT)).astype(np.int64)
+    params = gpt_decode.params_from_scope(cfg)
+    max_len = PROMPT + NEW
+
+    def run(padded, prompt_len):
+        ck, cv, logits = gpt_decode.prefill(
+            params, cfg, jnp.asarray(padded, jnp.int32),
+            jnp.int32(prompt_len), max_len)
+        toks = [np.asarray(jnp.argmax(logits, -1))]
+        pos = prompt_len
+        for _ in range(NEW - 1):
+            ck, cv, logits = gpt_decode.decode_step(
+                params, cfg, ck, cv, jnp.asarray(toks[-1], jnp.int32),
+                jnp.int32(pos))
+            toks.append(np.asarray(jnp.argmax(logits, -1)))
+            pos += 1
+        return np.stack(toks, 1)
+
+    clean = run(prompt, PROMPT)
+    # pad with junk tokens beyond prompt_len; same real prefix
+    padded = np.concatenate(
+        [prompt, rng.randint(0, cfg.vocab_size, (2, 3))], axis=1)
+    np.testing.assert_array_equal(run(padded, PROMPT), clean)
+
+
+def test_max_position_guard():
+    cfg = GPTConfig.tiny()
+    params = {}
+    with pytest.raises(ValueError, match="max_position"):
+        gpt_decode.generate(params, cfg, np.zeros((1, 60), np.int64), 10)
