@@ -252,6 +252,45 @@ def bench_gpt(batch, seq_len, steps):
     return tokens_per_sec, mfu
 
 
+def bench_gpt_decode(batch, prompt_len, new_tokens, iters):
+    """KV-cache autoregressive generation throughput (models/gpt_decode.py):
+    prefill + the whole decode scan compile to ONE XLA program, so the
+    recorded number is device decode rate, not host/tunnel round-trips.
+    The reference has no in-tree serving loop to compare against (its
+    inference story is the feed-forward AnalysisPredictor) — this row
+    certifies the TPU-native capability the reference lacks."""
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import gpt
+    from paddle_tpu.models.gpt_decode import generate, params_from_scope
+
+    _log(f"gpt-decode: batch={batch}, prompt={prompt_len}, "
+         f"new={new_tokens}")
+    _fresh_programs()
+    cfg = gpt.GPTConfig()
+    cfg.seq_len = prompt_len
+    if prompt_len + new_tokens > cfg.max_position:
+        cfg.max_position = prompt_len + new_tokens
+    gpt.build_lm_program(cfg)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    params = {k: jax.device_put(v)
+              for k, v in params_from_scope(cfg).items()}
+    rng = np.random.RandomState(0)
+    prompt = np.asarray(rng.randint(0, cfg.vocab_size,
+                                    (batch, prompt_len)), np.int32)
+    out = generate(params, cfg, prompt, max_new_tokens=new_tokens)
+    _drain(out)                                    # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = generate(params, cfg, prompt, max_new_tokens=new_tokens,
+                       seed=1)
+    _drain(out)
+    dt = time.perf_counter() - t0
+    return batch * new_tokens * iters / dt
+
+
 def bench_resnet50(batch, steps):
     import paddle_tpu as paddle
     import paddle_tpu.fluid as fluid
@@ -505,6 +544,18 @@ def main():
         except Exception as e:  # pragma: no cover
             print(f"gpt bench failed: {e!r}", file=sys.stderr)
             errors.append(f"gpt: {e!r}")
+    if tokens_per_sec is not None and which in ("all", "decode"):
+        try:
+            dps = bench_gpt_decode(
+                int(os.environ.get("BENCH_DECODE_BATCH", "8")),
+                int(os.environ.get("BENCH_DECODE_PROMPT", "128")),
+                int(os.environ.get("BENCH_DECODE_NEW", "128")), 2)
+            extras.append({
+                "metric": "gpt2_small_kvcache_decode_tokens_per_sec",
+                "value": round(dps, 1), "unit": "tokens/s"})
+        except Exception as e:  # pragma: no cover
+            print(f"gpt-decode bench failed: {e!r}", file=sys.stderr)
+            errors.append(f"gpt-decode: {e!r}")
     if tokens_per_sec is not None and which in ("all", "resnet"):
         try:
             ips = bench_resnet50(int(os.environ.get("BENCH_RESNET_BATCH",
