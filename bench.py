@@ -474,6 +474,16 @@ def main():
                 _log(f"re-probe: {health_tflops:.1f} bf16 TFLOP/s")
             except Exception as e:
                 print(f"health re-probe failed: {e!r}", file=sys.stderr)
+        # a still-degraded chip (rounds 4-5 saw 0.8-4.3 TF/s vs 197 peak)
+        # runs every dispatch ~50-250x slow: a full 7-row bench would take
+        # hours and risk the driver killing the process before the ONE
+        # required JSON line prints. Shrink the step count (the number is
+        # stamped tunnel_degraded and never used as a comparison point
+        # anyway) and skip the expensive extras below.
+        degraded = health_tflops is not None and health_tflops < 30
+        if degraded:
+            steps = min(steps, 4)
+            _log(f"degraded mode: steps={steps}, extras trimmed")
         # the primary metric also gets one retry: a mid-bench transient
         # (device grant revoked) shouldn't zero the round either
         for attempt in (1, 2):
@@ -487,9 +497,29 @@ def main():
                     errors.append(f"bert: {e!r}")
                 else:
                     _backend_ready(attempts=3)
+    else:
+        degraded = False
+
+    # hard wall-clock budget for the optional rows: whatever happens, the
+    # JSON line must print before any driver-side timeout fires
+    try:
+        budget = float(os.environ.get("BENCH_TIME_BUDGET", "2700"))
+    except ValueError:
+        budget = 2700.0
+    skipped_rows = []
+
+    def _row_ok(name):
+        if degraded:
+            skipped_rows.append(f"{name} (degraded chip)")
+            return False
+        if time.perf_counter() - _T0 > budget:
+            skipped_rows.append(f"{name} (time budget {budget:.0f}s)")
+            return False
+        return True
 
     extras = []
-    if tokens_per_sec is not None and which in ("all", "masked"):
+    if tokens_per_sec is not None and which in ("all", "masked") \
+            and _row_ok("masked"):
         try:
             tps_m, mfu_m = bench_bert(batch, seq_len, steps, masked=True)
             extras.append({
@@ -499,7 +529,8 @@ def main():
         except Exception as e:  # pragma: no cover
             print(f"masked-bert bench failed: {e!r}", file=sys.stderr)
             errors.append(f"masked-bert: {e!r}")
-    if tokens_per_sec is not None and which in ("all", "longseq"):
+    if tokens_per_sec is not None and which in ("all", "longseq") \
+            and _row_ok("longseq"):
         try:
             # long-context config: S=1024 engages the pallas flash kernels
             # (gated off below PADDLE_TPU_FLASH_MIN_SEQ=512 where dense XLA
@@ -515,7 +546,8 @@ def main():
         except Exception as e:  # pragma: no cover
             print(f"long-seq bench failed: {e!r}", file=sys.stderr)
             errors.append(f"longseq: {e!r}")
-    if tokens_per_sec is not None and which in ("all", "bertlarge"):
+    if tokens_per_sec is not None and which in ("all", "bertlarge") \
+            and _row_ok("bertlarge"):
         try:
             # BERT/ERNIE-large geometry (BASELINE config 4 / the named
             # 'BERT-large tokens/sec/chip' metric): per-layer remat keeps
@@ -531,7 +563,8 @@ def main():
         except Exception as e:  # pragma: no cover
             print(f"bert-large bench failed: {e!r}", file=sys.stderr)
             errors.append(f"bert-large: {e!r}")
-    if tokens_per_sec is not None and which in ("all", "gpt"):
+    if tokens_per_sec is not None and which in ("all", "gpt") \
+            and _row_ok("gpt"):
         try:
             tps_g, mfu_g = bench_gpt(
                 int(os.environ.get("BENCH_GPT_BATCH", "32")),
@@ -544,7 +577,8 @@ def main():
         except Exception as e:  # pragma: no cover
             print(f"gpt bench failed: {e!r}", file=sys.stderr)
             errors.append(f"gpt: {e!r}")
-    if tokens_per_sec is not None and which in ("all", "decode"):
+    if tokens_per_sec is not None and which in ("all", "decode") \
+            and _row_ok("decode"):
         try:
             dps = bench_gpt_decode(
                 int(os.environ.get("BENCH_DECODE_BATCH", "8")),
@@ -556,7 +590,8 @@ def main():
         except Exception as e:  # pragma: no cover
             print(f"gpt-decode bench failed: {e!r}", file=sys.stderr)
             errors.append(f"gpt-decode: {e!r}")
-    if tokens_per_sec is not None and which in ("all", "resnet"):
+    if tokens_per_sec is not None and which in ("all", "resnet") \
+            and _row_ok("resnet"):
         try:
             ips = bench_resnet50(int(os.environ.get("BENCH_RESNET_BATCH",
                                                     "64")), steps)
@@ -569,7 +604,8 @@ def main():
         except Exception as e:  # pragma: no cover
             print(f"resnet bench failed: {e!r}", file=sys.stderr)
             errors.append(f"resnet: {e!r}")
-    if tokens_per_sec is not None and which in ("all", "widedeep"):
+    if tokens_per_sec is not None and which in ("all", "widedeep") \
+            and _row_ok("widedeep"):
         try:
             eps = bench_wide_deep(int(os.environ.get("BENCH_CTR_BATCH",
                                                      "512")), steps)
@@ -589,6 +625,8 @@ def main():
         "mfu": round(mfu, 4) if mfu is not None else None,
         "extras": extras,
     }
+    if skipped_rows:
+        rec["skipped_rows"] = skipped_rows
     if health_tflops is not None:
         rec["device_bf16_tflops_probe"] = round(health_tflops, 1)
         if health_tflops < 30:

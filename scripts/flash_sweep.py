@@ -32,7 +32,7 @@ def main():
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--min-tflops", type=float, default=30.0,
                     help="abort if the chip probes below this (degraded)")
-    ap.add_argument("--grid", default="128,256,512")
+    ap.add_argument("--grid", default="128,256,512,1024")
     ap.add_argument("--seq", type=int, default=1024)
     args = ap.parse_args()
     sizes = [int(s) for s in args.grid.split(",")]
@@ -83,6 +83,9 @@ def main():
         code = (
             "import sys; sys.path.insert(0, %r)\n"
             "import json, bench\n"
+            "import jax\n"
+            "assert jax.default_backend() != 'cpu', "
+            "'device grant lost: CPU fallback would record garbage'\n"
             "tps, mfu = bench.bench_bert(%d, %d, %d, masked=True)\n"
             "print(json.dumps({'tps': tps, 'mfu': mfu}))\n"
             % (ROOT, args.batch, args.seq, args.steps))
