@@ -437,6 +437,22 @@ def _prev_recorded_value():
 
 
 def main():
+    # persistent XLA compile cache: TPU compiles of BERT-scale programs are
+    # 20-40 s each, so bench re-runs (and the warm/timed pair's retry path)
+    # benefit; single-process here, so no LRU eviction races. Must go
+    # through jax.config.update, NOT env vars: the axon sitecustomize
+    # imports jax at interpreter start, so jax has already read its env
+    # defaults before this line runs.
+    try:
+        import jax as _jax
+        _jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache"))
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+        _jax.config.update("jax_compilation_cache_max_size", 2 * 1024 ** 3)
+    except Exception as e:  # cache is an optimization, never a hard dep
+        print(f"compile cache not enabled: {e!r}", file=sys.stderr)
     batch = int(os.environ.get("BENCH_BATCH", "128"))
     seq_len = int(os.environ.get("BENCH_SEQ", "128"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))

@@ -1,0 +1,37 @@
+"""Static-graph basics: build layers, minimize, run the Executor.
+
+The whole block compiles to ONE XLA program per (shapes, fetch) signature;
+parameters live device-side in the global scope between steps.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def main():
+    x = layers.data(name="x", shape=[13], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    pred = layers.fc(x, 1)
+    loss = layers.mean(layers.square(pred - y))
+    paddle.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(13, 1).astype(np.float32)
+    xs = rng.randn(256, 13).astype(np.float32)
+    ys = xs @ w_true + 0.01 * rng.randn(256, 1).astype(np.float32)
+
+    for epoch in range(80):
+        lv, = exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+        if epoch % 20 == 0 or epoch == 79:
+            print(f"epoch {epoch:2d}  loss {float(lv):.5f}")
+    assert float(lv) < 0.01, "did not converge"
+    print("ok")
+
+
+if __name__ == "__main__":
+    main()

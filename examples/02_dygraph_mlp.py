@@ -1,0 +1,38 @@
+"""Eager (dygraph) mode: define-by-run with tape autograd.
+
+`paddle.disable_static()` switches to the imperative tracer
+(dygraph/tracer.py — jax.vjp under a tape); `loss.backward()` populates
+`.grad` and `opt.step()` applies them.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def main():
+    paddle.disable_static()
+    model = nn.Sequential(nn.Linear(8, 32), nn.ReLU(),
+                          nn.Linear(32, 1))
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameter_list=model.parameters())
+    rng = np.random.RandomState(0)
+    xs = rng.rand(64, 8).astype(np.float32)
+    ys = (xs.sum(1, keepdims=True) > 4.0).astype(np.float32)
+
+    for step in range(60):
+        x = paddle.to_tensor(xs)
+        y = paddle.to_tensor(ys)
+        loss = F.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if step % 20 == 0 or step == 59:
+            print(f"step {step:2d}  loss {float(loss):.5f}")
+    assert float(loss) < 0.05
+    print("ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""True multi-device pipeline parallelism over the `pp` mesh axis.
+
+`fluid.device_guard("gpu:<stage>")` annotations partition the program;
+over a mesh with pp>1 the Executor places each stage on its own pp
+submesh and streams microbatches between them in 1F1B order
+(parallel/pipeline.py). Needs >= 2 devices:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/06_pipeline_parallel.py
+"""
+import numpy as np
+
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed import fleet
+from paddle_tpu.models import bert
+from paddle_tpu.parallel import DistConfig, attach, build_mesh
+
+
+def main():
+    if jax.device_count() < 2:
+        raise SystemExit(
+            "needs >= 2 devices; run with JAX_PLATFORMS=cpu "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8")
+    cfg = bert.BertConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                          num_heads=4, intermediate_size=128,
+                          max_position=64, seq_len=32,
+                          pipeline_stages=2)
+    ids, labels, loss = bert.build_pretrain_program(cfg)
+
+    fleet.init(is_collective=True)
+    strategy = fleet.DistributedStrategy()
+    strategy.pipeline = True
+    strategy.pipeline_configs = {"accumulate_steps": 4}  # microbatches
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.Adam(learning_rate=1e-3), strategy)
+    opt.minimize(loss)
+
+    mesh = build_mesh(pp=2, devices=jax.devices()[:2])
+    attach(fluid.default_main_program(), DistConfig(mesh=mesh))
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {
+        "input_ids": rng.randint(0, cfg.vocab_size,
+                                 (8, cfg.seq_len)).astype(np.int64),
+        "mlm_labels": rng.randint(0, cfg.vocab_size,
+                                  (8, cfg.seq_len, 1)).astype(np.int64),
+    }
+    for step in range(3):
+        lv, = exe.run(feed=feed, fetch_list=[loss])
+        print(f"step {step}: loss {float(lv):.4f}")
+    print("ok (stage 0 on", jax.devices()[0], ", stage 1 on",
+          jax.devices()[1], ")")
+
+
+if __name__ == "__main__":
+    main()

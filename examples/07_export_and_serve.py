@@ -1,0 +1,52 @@
+"""Export a trained model and serve it with the inference Predictor.
+
+`fluid.io.save_inference_model` prunes the program to the feed->fetch
+slice and saves program + params; `inference.create_predictor` loads it
+into the XLA predictor (clone() gives cheap per-thread handles sharing
+the compiled executable — the AnalysisPredictor serving pattern).
+"""
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu import inference
+
+
+def main():
+    x = layers.data(name="x", shape=[8], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    h = layers.fc(x, 16, act="relu")
+    pred = layers.fc(h, 1)
+    loss = layers.mean(layers.square(pred - y))
+    paddle.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    xs = rng.randn(128, 8).astype(np.float32)
+    ys = xs[:, :1] * 2.0 + 1.0
+    for _ in range(300):
+        exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+
+    with tempfile.TemporaryDirectory() as d:
+        fluid.io.save_inference_model(d, ["x"], [pred], exe)
+
+        config = inference.Config(d)
+        predictor = inference.create_predictor(config)
+        h_in = predictor.get_input_handle(predictor.get_input_names()[0])
+        h_in.copy_from_cpu(xs[:4])
+        predictor.run()
+        out = predictor.get_output_handle(
+            predictor.get_output_names()[0]).copy_to_cpu()
+        print("served prediction:", out.ravel())
+        print("expected approx  :", ys[:4].ravel())
+        assert np.allclose(out.ravel(), ys[:4].ravel(), atol=0.3)
+    print("ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -30,16 +30,12 @@ def cpu_mesh_env(n_devices: int = 8, base_env: dict | None = None) -> dict:
     env["XLA_FLAGS"] = flags.strip()
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
-    # Persistent XLA compilation cache: per-test jit compiles dominate suite
-    # wall time (~22 min single-core, most of it tracing+compiling the same
-    # programs every run). Keyed by HLO hash, so re-runs — including CI
-    # shards and judge verification runs — load executables from disk
-    # instead of recompiling. LRU-bounded; safe to delete at any time.
-    env.setdefault("JAX_COMPILATION_CACHE_DIR",
-                   os.path.join(root, ".jax_cache"))
-    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.3")
-    env.setdefault("JAX_COMPILATION_CACHE_MAX_SIZE",
-                   str(2 * 1024 ** 3))
+    # NOTE: the persistent XLA compilation cache is deliberately NOT set
+    # here. A/B measurement showed no suite speedup (XLA *CPU* compiles
+    # are ~0.2 s; tracing dominates), and the cache's LRU atime tracking
+    # emits warnings when concurrent test processes race on eviction —
+    # which would break the suite's zero-warnings contract. bench.py sets
+    # it for TPU-side runs, where single compiles are 20-40 s.
     return env
 
 

@@ -27,6 +27,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # measured single-process seconds (suite_r04 report); unlisted files get 10
 WEIGHTS = {
     "test_ring_attention.py": 230, "test_book_models.py": 200,
+    "test_examples.py": 90,
     "test_vision_text.py": 140, "test_detection_pipelines.py": 90,
     "test_ps_pass.py": 60, "test_data_pipeline.py": 80,
     "test_detection_train_ops.py": 60, "test_moe.py": 100,

@@ -1,0 +1,26 @@
+"""Every script in examples/ must run green end-to-end (they are the
+user-facing quickstart surface; a broken example is a broken front door).
+Each runs as a real user subprocess on the virtual CPU mesh."""
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = sorted(glob.glob(os.path.join(ROOT, "examples", "0*.py")))
+
+
+@pytest.mark.parametrize("script", EXAMPLES,
+                         ids=[os.path.basename(p) for p in EXAMPLES])
+def test_example_runs(script):
+    from conftest import cpu_mesh_env
+    env = cpu_mesh_env(8)
+    r = subprocess.run([sys.executable, script], cwd=ROOT, env=env,
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, (
+        f"{os.path.basename(script)} failed:\n{r.stdout[-500:]}\n"
+        f"{r.stderr[-1000:]}")
+    last = (r.stdout.strip().splitlines() or [""])[-1]
+    assert last.startswith("ok"), f"missing final 'ok': {last!r}"
