@@ -1,0 +1,56 @@
+"""Long-context training: sequence parallelism over the `sp` mesh axis.
+
+With `sequence_parallel=True` the model shards the sequence dimension
+over `sp` devices and attention runs as ring attention
+(parallel/ring_attention.py) — each device holds S/sp of the sequence
+and K/V blocks rotate around the ring, so the S x S score matrix never
+materializes on one device. This is the mechanism that trains S=1024+
+where dense attention OOMs (docs/perf_notes.md). Needs >= 4 devices:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/08_sequence_parallel.py
+"""
+import numpy as np
+
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.models import bert
+from paddle_tpu.parallel import DistConfig, attach, build_mesh
+
+
+def main():
+    if jax.device_count() < 4:
+        raise SystemExit(
+            "needs >= 4 devices; run with JAX_PLATFORMS=cpu "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8")
+    cfg = bert.BertConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                          num_heads=4, intermediate_size=128,
+                          max_position=128, seq_len=128,
+                          sequence_parallel=True)
+    ids, labels, loss = bert.build_pretrain_program(cfg)
+    paddle.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+
+    mesh = build_mesh(sp=4, devices=jax.devices()[:4])
+    attach(fluid.default_main_program(),
+           DistConfig(mesh=mesh, param_rules=bert.tp_sharding_rules()))
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {
+        "input_ids": rng.randint(0, cfg.vocab_size,
+                                 (4, cfg.seq_len)).astype(np.int64),
+        "mlm_labels": rng.randint(0, cfg.vocab_size,
+                                  (4, cfg.seq_len, 1)).astype(np.int64),
+    }
+    for step in range(3):
+        lv, = exe.run(feed=feed, fetch_list=[loss])
+        print(f"step {step}: loss {float(lv):.4f} "
+              f"(seq {cfg.seq_len} sharded over sp=4)")
+    print("ok")
+
+
+if __name__ == "__main__":
+    main()
