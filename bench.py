@@ -275,8 +275,12 @@ def bench_gpt_decode(batch, prompt_len, new_tokens, iters):
     gpt.build_lm_program(cfg)
     exe = fluid.Executor()
     exe.run(fluid.default_startup_program())
+    # bf16 weights: decode reads every weight per generated token, so
+    # halving the bytes ~doubles the bandwidth-bound serving rate
     params = {k: jax.device_put(v)
-              for k, v in params_from_scope(cfg).items()}
+              for k, v in params_from_scope(
+                  cfg, dtype=os.environ.get("BENCH_DECODE_DTYPE",
+                                            "bfloat16")).items()}
     rng = np.random.RandomState(0)
     prompt = np.asarray(rng.randint(0, cfg.vocab_size,
                                     (batch, prompt_len)), np.int32)
@@ -602,7 +606,8 @@ def main():
                 int(os.environ.get("BENCH_DECODE_NEW", "128")), 2)
             extras.append({
                 "metric": "gpt2_small_kvcache_decode_tokens_per_sec",
-                "value": round(dps, 1), "unit": "tokens/s"})
+                "value": round(dps, 1), "unit": "tokens/s",
+                "dtype": os.environ.get("BENCH_DECODE_DTYPE", "bfloat16")})
         except Exception as e:  # pragma: no cover
             print(f"gpt-decode bench failed: {e!r}", file=sys.stderr)
             errors.append(f"gpt-decode: {e!r}")

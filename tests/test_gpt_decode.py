@@ -127,3 +127,34 @@ def test_max_position_guard():
     params = {}
     with pytest.raises(ValueError, match="max_position"):
         gpt_decode.generate(params, cfg, np.zeros((1, 60), np.int64), 10)
+
+
+def test_bf16_params_decode_precision_and_validity():
+    """Serving-dtype path: params_from_scope(dtype='bfloat16') halves the
+    weight bytes each generated token reads. Precision is asserted where
+    it is measurable without decode-chain divergence effects: the
+    prefill logits of the bf16 path must track the f32 path within bf16
+    rounding tolerance (LN params stay f32, LN/score/head matmuls
+    accumulate f32). The generate() output is checked for shape/range
+    validity only — token-level agreement is chaotic by construction
+    (one near-tie flip changes every later position's context)."""
+    import jax.numpy as jnp
+
+    total = PROMPT + NEW
+    cfg, exe, _, logits = _build(total)
+    rng = np.random.RandomState(11)
+    prompt = rng.randint(0, cfg.vocab_size, (2, PROMPT)).astype(np.int64)
+
+    p32 = gpt_decode.params_from_scope(cfg)
+    p16 = gpt_decode.params_from_scope(cfg, dtype="bfloat16")
+    assert p16["wte"].dtype == jnp.bfloat16
+    assert p16["final_ln_scale"].dtype == jnp.float32   # LN excluded
+    _, _, lg32 = gpt_decode.prefill(p32, cfg, jnp.asarray(prompt),
+                                    jnp.int32(PROMPT), total)
+    _, _, lg16 = gpt_decode.prefill(p16, cfg, jnp.asarray(prompt),
+                                    jnp.int32(PROMPT), total)
+    np.testing.assert_allclose(np.asarray(lg16), np.asarray(lg32),
+                               rtol=0.05, atol=0.05)
+    got16 = np.asarray(gpt_decode.generate(p16, cfg, prompt, NEW))
+    assert got16.shape == (2, total)
+    assert ((0 <= got16) & (got16 < cfg.vocab_size)).all()
