@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Audit the collectives in the compiled sharded train step.
+
+The BASELINE "8→64 chip scaling efficiency" metric cannot be measured in
+a single-chip environment, but the thing that DETERMINES it — what
+collectives the compiled program runs per step, and how their volume
+scales with mesh width — is fully auditable from the optimized HLO on a
+virtual device mesh. This script compiles the BERT train step under
+several meshes and reports each collective kind, its count, and its
+total tensor bytes.
+
+What to expect (and what round-5 runs showed — docs/perf_notes.md
+"Collective audit"):
+
+* dp=N: ONE fused tupled all-reduce per step carrying every gradient
+  (the program's DataParallel sync; XLA fuses all grads natively — the
+  reference needs its fuse_all_reduce_ops pass for this). Bytes are
+  constant in N, so ring time approaches a flat 2x gradient bytes as N
+  grows: that is the weak-scaling story.
+* tp=2: GSPMD inserts the Megatron activation all-reduces (2 per layer
+  per direction) plus gather/scatter around the sharded embedding/head.
+* sp=4: collective-permute dominates — the ring-attention K/V rotation
+  (hops x layers x fwd/bwd), with almost nothing else: sequence
+  parallelism rides ICI neighbor links, not global collectives.
+
+Usage: run under a virtual mesh (or a real one):
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python scripts/collective_audit.py
+"""
+from __future__ import annotations
+
+import collections
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+DT_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "f64": 8,
+            "pred": 1, "s8": 1, "u8": 1, "s64": 8, "u64": 8}
+
+
+def compiled_text(axes, batch, sp_flag=False):
+    """Build + attach + compile the tiny-BERT train step; return HLO."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import bert
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.parallel import build_mesh, DistConfig, attach
+    from paddle_tpu.framework.scope import global_scope
+    from paddle_tpu.testing import reset_programs
+
+    reset_programs(seed=0)
+    cfg = bert.BertConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                          num_heads=4, intermediate_size=128,
+                          max_position=64, seq_len=32, hidden_dropout=0.0,
+                          attention_dropout=0.0, sequence_parallel=sp_flag)
+    ids, labels, loss = bert.build_pretrain_program(cfg)
+    fleet.init(is_collective=True)
+    strategy = fleet.DistributedStrategy(
+        tensor_parallel_degree=axes.get("tp", 1),
+        tensor_parallel_rules=bert.tp_sharding_rules())
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.Adam(learning_rate=1e-3), strategy)
+    opt.minimize(loss)
+    prog = fluid.default_main_program()
+    ndev = 1
+    for v in axes.values():
+        ndev *= v
+    if ndev > 1:
+        mesh = build_mesh(devices=jax.devices()[:ndev], **axes)
+        attach(prog, DistConfig(mesh=mesh,
+                                param_rules=bert.tp_sharding_rules()))
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    scope = global_scope()
+    feed = {"input_ids": np.zeros((batch, 32), np.int64),
+            "mlm_labels": np.zeros((batch, 32, 1), np.int64)}
+    exe.run(feed=feed, fetch_list=[loss])
+    cb = list(exe._cache.values())[-1]    # the train-step entry, not startup
+    return cb.jitted.lower(
+        {n: scope.find(n) for n in cb.mut_names},
+        {n: scope.find(n) for n in cb.ro_names},
+        {k: jnp.asarray(v) for k, v in feed.items()},
+        jax.random.key(0)).compile().as_text()
+
+
+def audit(txt):
+    """(kind -> count, kind -> total bytes) over every collective HLO op;
+    tuple-typed ops (XLA's fused gradient all-reduce) sum their leaves."""
+    counts = collections.Counter()
+    byte_tot = collections.Counter()
+    for line in txt.splitlines():
+        m = re.search(r"%\S+ = (.*?) (all-reduce|all-gather|reduce-scatter|"
+                      r"collective-permute|all-to-all)(?:-start)?\(", line)
+        if not m:
+            continue
+        ty, kind = m.groups()
+        n_bytes = 0
+        for dm in re.finditer(r"(\w+)\[([\d,]*)\]", ty):
+            dt, shape = dm.groups()
+            n = 1
+            for d in shape.split(","):
+                if d:
+                    n *= int(d)
+            n_bytes += n * DT_BYTES.get(dt, 4)
+        counts[kind] += 1
+        byte_tot[kind] += n_bytes
+    return counts, byte_tot
+
+
+def main():
+    # On hosts where the TPU plugin pins the backend at interpreter start
+    # (env vars are read too late), re-exec once into a sanitized
+    # subprocess with the 8-device virtual CPU mesh — same recipe as
+    # __graft_entry__.dryrun_multichip.
+    if os.environ.get("PADDLE_TPU_AUDIT_CHILD") != "1":
+        from paddle_tpu.testing import cpu_mesh_env, virtual_cpu_mesh_ready
+        if not virtual_cpu_mesh_ready(8):
+            import subprocess
+            env = cpu_mesh_env(8)
+            env["PADDLE_TPU_AUDIT_CHILD"] = "1"
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], cwd=ROOT,
+                env=env, timeout=1800)
+            sys.exit(proc.returncode)
+
+    import jax
+    nd = jax.device_count()
+    rows = [({"dp": 1}, 8, False), ({"dp": 2}, 16, False),
+            ({"dp": 4}, 32, False), ({"dp": 8}, 64, False),
+            ({"tp": 2}, 8, False), ({"dp": 2, "tp": 2}, 8, False),
+            ({"sp": 4}, 8, True)]
+    for axes, batch, spf in rows:
+        needed = 1
+        for v in axes.values():
+            needed *= v
+        if needed > nd:
+            print(f"{axes}: skipped (need {needed} devices, have {nd})")
+            continue
+        counts, byts = audit(compiled_text(axes, batch, spf))
+        desc = " ".join(f"{k}={v}" for k, v in axes.items())
+        summary = ", ".join(
+            f"{k} x{counts[k]} ({byts[k] / 1e6:.2f} MB)"
+            for k in sorted(counts)) or "none"
+        print(f"{desc:12s} batch {batch:3d}: {summary}")
+
+
+if __name__ == "__main__":
+    main()
