@@ -13,6 +13,9 @@ import jax.numpy as jnp
 # ops cast to low precision (reference white list: compute-bound MXU ops)
 white_list = {
     "conv2d", "depthwise_conv2d", "conv2d_transpose", "matmul", "matmul_v2",
+    # chunked LM head: bf16 operands are safe — every einsum accumulates
+    # f32 (preferred_element_type) and the loss returns f32 (ops/fused_ce.py)
+    "fused_lm_head_ce",
     "mul", "bmm", "fc",
 }
 # ops forced to float32 (reference black list: reductions/normalizations)

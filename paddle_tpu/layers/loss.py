@@ -6,8 +6,27 @@ from ..layer_helper import LayerHelper
 __all__ = [
     "cross_entropy", "softmax_with_cross_entropy", "square_error_cost",
     "sigmoid_cross_entropy_with_logits", "huber_loss", "mse_loss",
-    "log_loss", "smooth_l1",
+    "log_loss", "smooth_l1", "fused_lm_head_ce",
 ]
+
+
+def fused_lm_head_ce(x, w, label, chunk=8192):
+    """Streaming LM-head + cross-entropy: per-token CE of logits
+    `x @ w^T` against `label`, WITHOUT materializing the [B, S, V]
+    logits (vocab-chunked online logsumexp; backward recomputes chunks
+    — ops/fused_ce.py). Numerically equivalent to
+    `softmax_with_cross_entropy(matmul(x, w, transpose_y=True), label)`
+    at a fraction of the peak memory when V is large.
+
+    x: [B, S, H]; w: [V, H] (e.g. the tied embedding); label: [B, S, 1]
+    int. Returns per-token loss [B, S, 1] (f32)."""
+    helper = LayerHelper("fused_lm_head_ce")
+    loss = helper.create_variable_for_type_inference("float32")
+    helper.append_op("fused_lm_head_ce",
+                     inputs={"X": [x], "W": [w], "Label": [label]},
+                     outputs={"Loss": [loss]},
+                     attrs={"chunk": chunk})
+    return loss
 
 
 def cross_entropy(input, label, soft_label=False, ignore_index=-100):

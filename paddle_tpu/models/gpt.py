@@ -152,17 +152,34 @@ def gpt_decoder(token_ids, cfg: GPTConfig):
     return out, wte
 
 
-def build_lm_program(cfg: GPTConfig):
+def build_lm_program(cfg: GPTConfig, fused_head: bool = None):
     """Next-token LM objective: predict tokens[1:] from tokens[:-1].
-    Returns (tokens, loss)."""
+    Returns (tokens, loss).
+
+    fused_head=None auto-selects: at real LM vocab (>= 2x the 8192
+    chunk, so the streaming trade is real — at least halved peak) the
+    [B, S, V] logits tensor is the step's memory peak, so the head+CE
+    runs as the vocab-chunked streaming op (`layers.fused_lm_head_ce`,
+    ops/fused_ce.py) that never materializes it; smaller vocabs keep
+    the dense pair (single-chunk streaming would pay the backward
+    recompute for no memory win). Pass True/False to force either."""
     tokens = layers.data(name="tokens", shape=[cfg.seq_len], dtype="int64")
     seq, wte = gpt_decoder(tokens, cfg)
+    if fused_head is None:
+        from ..ops.fused_ce import DEFAULT_CHUNK
+        fused_head = cfg.vocab_size >= 2 * DEFAULT_CHUNK
     with _stage_guard(cfg)(_last_stage(cfg)):
-        logits = layers.matmul(seq, wte, transpose_y=True)   # tied head
-        shift_logits = layers.slice(logits, [1], [0], [cfg.seq_len - 1])
         shift_labels = layers.slice(tokens, [1], [1], [cfg.seq_len])
         shift_labels = layers.unsqueeze(shift_labels, [2])
-        loss = layers.softmax_with_cross_entropy(shift_logits, shift_labels)
+        if fused_head:
+            shift_seq = layers.slice(seq, [1], [0], [cfg.seq_len - 1])
+            loss = layers.fused_lm_head_ce(shift_seq, wte, shift_labels)
+        else:
+            logits = layers.matmul(seq, wte, transpose_y=True)  # tied head
+            shift_logits = layers.slice(logits, [1], [0],
+                                        [cfg.seq_len - 1])
+            loss = layers.softmax_with_cross_entropy(shift_logits,
+                                                     shift_labels)
         return tokens, layers.mean(loss)
 
 

@@ -1,0 +1,141 @@
+"""fused_lm_head_ce: vocab-chunked streaming LM-head cross-entropy.
+
+Parity: forward loss and BOTH gradients (hidden states and weight) must
+match the dense matmul+softmax_with_cross_entropy pair to float
+tolerance, with a chunk size that forces multiple scan steps AND a
+ragged final chunk. Memory: the fused program's largest live tensor
+must stay chunk-sized where the dense one materializes [B, S, V]
+logits (asserted on optimized HLO — no hardware needed)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.testing import reset_programs
+
+
+def _build_ce(fused, b, s, h, v, chunk=None, seed=0):
+    """Tiny LM-ish program: trainable x-projection + head table, CE loss.
+    Returns (exe, feed, loss, names of grads to fetch)."""
+    reset_programs(seed=seed)
+    feat = layers.data(name="feat", shape=[s, h], dtype="float32")
+    label = layers.data(name="label", shape=[s, 1], dtype="int64")
+    proj = layers.create_parameter([h, h], "float32", name="proj")
+    w = layers.create_parameter([v, h], "float32", name="head_w")
+    x = layers.matmul(feat, proj)
+    if fused:
+        loss_tok = layers.fused_lm_head_ce(x, w, label,
+                                           chunk=chunk or 8192)
+    else:
+        logits = layers.matmul(x, w, transpose_y=True)
+        loss_tok = layers.softmax_with_cross_entropy(logits, label)
+    loss = layers.mean(loss_tok)
+    paddle.optimizer.SGD(learning_rate=0.0).minimize(loss)  # grads only
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(7)
+    feed = {"feat": rng.randn(b, s, h).astype(np.float32) * 0.3,
+            "label": rng.randint(0, v, (b, s, 1)).astype(np.int64)}
+    return exe, feed, loss
+
+
+def _loss_and_grads(fused, chunk=None, v=37):
+    exe, feed, loss = _build_ce(fused, b=2, s=5, h=16, v=v, chunk=chunk)
+    gb = fluid.default_main_program().global_block()
+    fetches = [loss.name, "proj@GRAD", "head_w@GRAD"]
+    fetches = [f for f in fetches if gb.has_var(f)]
+    return exe.run(feed=feed, fetch_list=fetches)
+
+
+def test_fused_ce_matches_dense_loss_and_grads():
+    # chunk 8 over v=37: 5 scan steps with a ragged 5-row final chunk
+    dense = _loss_and_grads(fused=False)
+    fused = _loss_and_grads(fused=True, chunk=8)
+    assert len(dense) == len(fused) == 3
+    for d, f in zip(dense, fused):
+        np.testing.assert_allclose(np.asarray(f), np.asarray(d),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_fused_ce_single_chunk_matches():
+    dense = _loss_and_grads(fused=False)
+    fused = _loss_and_grads(fused=True, chunk=64)   # one chunk covers all
+    np.testing.assert_allclose(np.asarray(fused[0]), np.asarray(dense[0]),
+                               rtol=2e-5)
+
+
+def test_gpt_auto_selects_fused_head():
+    from paddle_tpu.models import gpt
+    reset_programs(seed=0)
+    cfg = gpt.GPTConfig(vocab_size=20000, hidden_size=32, num_layers=1,
+                        num_heads=4, intermediate_size=64, max_position=16,
+                        seq_len=16)
+    gpt.build_lm_program(cfg)
+    ops = [op.type for op in fluid.default_main_program()
+           .global_block().ops]
+    assert "fused_lm_head_ce" in ops
+    reset_programs(seed=0)
+    cfg.vocab_size = 512
+    gpt.build_lm_program(cfg)
+    ops = [op.type for op in fluid.default_main_program()
+           .global_block().ops]
+    assert "fused_lm_head_ce" not in ops
+    assert "softmax_with_cross_entropy" in ops
+
+
+def test_fused_ce_largest_live_tensor_is_bounded():
+    """Compile both variants at a vocab where [B,S,V] logits dominate and
+    compare the LARGEST tensor in the optimized HLO (memory_analysis
+    reports no temp bytes on the CPU backend, so assert on structure:
+    the fused program must never materialize a vocab-sized tensor)."""
+    import re
+
+    import jax
+
+    DT = {"f32": 4, "f64": 8, "bf16": 2, "f16": 2, "s64": 8, "u64": 8,
+          "s32": 4, "u32": 4, "pred": 1, "s8": 1, "u8": 1}
+
+    def largest_tensor_bytes(fused):
+        exe, feed, loss = _build_ce(fused, b=4, s=64, h=64, v=16384,
+                                    chunk=2048, seed=0)
+        exe.run(feed=feed, fetch_list=[loss])       # compile via executor
+        cb = list(exe._cache.values())[-1]
+        from paddle_tpu.framework.scope import global_scope
+        import jax.numpy as jnp
+        scope = global_scope()
+        txt = cb.jitted.lower(
+            {n: scope.find(n) for n in cb.mut_names},
+            {n: scope.find(n) for n in cb.ro_names},
+            {k: jnp.asarray(v) for k, v in feed.items()},
+            jax.random.key(0)).compile().as_text()
+        biggest = 0
+        for m in re.finditer(r"= (\w+)\[([\d,]+)\]", txt):
+            dt, shape = m.groups()
+            n = 1
+            for d in shape.split(","):
+                n *= int(d)
+            biggest = max(biggest, n * DT.get(dt, 4))
+        return biggest
+
+    dense = largest_tensor_bytes(False)
+    fused = largest_tensor_bytes(True)
+    # dense materializes f32[4,64,16384] = 16.8 MB logits; the fused
+    # program's biggest tensor is a [4,64,2048] chunk (2 MB) or the
+    # [16384,64] weight (4.2 MB). A surviving vocab-x-seq-sized tensor
+    # means the streaming structure broke.
+    assert dense >= 4 * 64 * 16384 * 4, dense       # sanity: logits seen
+    assert fused * 3 < dense, (dense, fused)
+
+
+def test_fused_ce_under_amp_bf16():
+    """fused_lm_head_ce is AMP white-listed (amp/auto_cast.py): bf16-cast
+    operands with f32 einsum accumulation must track the f32 loss within
+    bf16 tolerance — the GPT bench row runs exactly this combination."""
+    exe, feed, loss = _build_ce(True, b=2, s=5, h=16, v=37, chunk=8)
+    ref, = exe.run(feed=feed, fetch_list=[loss])
+    exe2, feed2, loss2 = _build_ce(True, b=2, s=5, h=16, v=37, chunk=8)
+    fluid.default_main_program()._amp = True        # what strategy.amp sets
+    amp, = exe2.run(feed=feed2, fetch_list=[loss2])
+    np.testing.assert_allclose(np.asarray(amp), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2)
