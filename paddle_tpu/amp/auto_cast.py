@@ -18,6 +18,14 @@ white_list = {
     "fused_lm_head_ce",
     "mul", "bmm", "fc",
 }
+# per-op input slots excluded from the white-list cast: tiny O(V)/O(H)
+# operands whose quantization buys no MXU time but drifts parity with the
+# dense path (which applies them in f32 via non-white-listed elementwise
+# ops)
+keep_f32_slots = {
+    "fused_lm_head_ce": {"Bias"},
+}
+
 # ops forced to float32 (reference black list: reductions/normalizations)
 black_list = {
     "softmax", "softmax_with_cross_entropy", "cross_entropy", "layer_norm",

@@ -477,7 +477,7 @@ def _amp_cast(op, ins, low_dtype):
     insertion — here done at lowering time, zero extra graph ops). Grad ops
     (__vjp__) re-derive the policy from their wrapped forward type."""
     import jax.numpy as jnp
-    from ..amp.auto_cast import white_list, black_list
+    from ..amp.auto_cast import white_list, black_list, keep_f32_slots
     op_type = op.attrs.get("fwd_type", op.type) if op.type == "__vjp__" \
         else op.type
     if op_type in white_list:
@@ -486,8 +486,15 @@ def _amp_cast(op, ins, low_dtype):
         target = jnp.float32
     else:
         return ins
+    skip = keep_f32_slots.get(op_type, ())
     out = {}
     for slot, vals in ins.items():
+        # grad ops see forward slots plus OG:<slot> cotangents; keep both
+        # f32 for an excluded slot
+        base_slot = slot[3:] if slot.startswith(("OG:", "IG:")) else slot
+        if base_slot in skip:
+            out[slot] = vals
+            continue
         out[slot] = [
             v.astype(target)
             if (v is not None and hasattr(v, "dtype")

@@ -10,22 +10,29 @@ __all__ = [
 ]
 
 
-def fused_lm_head_ce(x, w, label, chunk=8192):
-    """Streaming LM-head + cross-entropy: per-token CE of logits
-    `x @ w^T` against `label`, WITHOUT materializing the [B, S, V]
-    logits (vocab-chunked online logsumexp; backward recomputes chunks
-    — ops/fused_ce.py). Numerically equivalent to
-    `softmax_with_cross_entropy(matmul(x, w, transpose_y=True), label)`
-    at a fraction of the peak memory when V is large.
+def fused_lm_head_ce(x, w, label, chunk=None, bias=None, w_layout="vh"):
+    """Streaming LM-head + cross-entropy: per-token CE of the logits
+    `x @ w^T (+ bias)` against `label`, WITHOUT materializing the
+    [B, S, V] logits (vocab-chunked online logsumexp; backward
+    recomputes chunks — ops/fused_ce.py). Numerically equivalent to the
+    dense matmul/fc + softmax_with_cross_entropy pair at a fraction of
+    the peak memory when V is large.
 
-    x: [B, S, H]; w: [V, H] (e.g. the tied embedding); label: [B, S, 1]
-    int. Returns per-token loss [B, S, 1] (f32)."""
+    x: [B, S, H]; w: [V, H] (`w_layout="vh"`, e.g. a tied embedding) or
+    [H, V] (`w_layout="hv"`, an fc head weight); bias: optional [V];
+    label: [B, S, 1] int in [0, V) — out-of-range labels (pad/ignore id
+    conventions) yield NaN for that token; mask pad tokens out of the
+    loss instead. chunk=None uses ops/fused_ce.DEFAULT_CHUNK (the same
+    constant the models' auto-select thresholds key on). Returns
+    per-token loss [B, S, 1] (f32)."""
     helper = LayerHelper("fused_lm_head_ce")
     loss = helper.create_variable_for_type_inference("float32")
-    helper.append_op("fused_lm_head_ce",
-                     inputs={"X": [x], "W": [w], "Label": [label]},
+    inputs = {"X": [x], "W": [w], "Label": [label]}
+    if bias is not None:
+        inputs["Bias"] = [bias]
+    helper.append_op("fused_lm_head_ce", inputs=inputs,
                      outputs={"Loss": [loss]},
-                     attrs={"chunk": chunk})
+                     attrs={"chunk": chunk, "w_layout": w_layout})
     return loss
 
 

@@ -39,6 +39,13 @@ class BertConfig:
     # >0: annotate device_guard stages for pipeline parallelism over the pp
     # mesh axis (embeddings stage 0, layers round-robin, head last stage)
     pipeline_stages: int = 0
+    # MLM head as the vocab-chunked streaming CE (ops/fused_ce.py).
+    # None = auto: only at long sequence (>= 512) AND real vocab
+    # (>= 2x the chunk), where the [B,S,V] logits are the memory peak —
+    # at the short-seq bench geometry the dense head fits fine and the
+    # fused backward's chunk recompute (~+7% model FLOPs) would be pure
+    # loss. True/False forces.
+    fused_mlm_head: "bool | None" = None
 
     @staticmethod
     def base():
@@ -190,12 +197,35 @@ def _bert_embeddings(input_ids, cfg: BertConfig):
 
 
 def bert_pretrain_loss(seq_out, mlm_labels, cfg: BertConfig):
-    """Masked-LM head + loss (ERNIE pretraining objective)."""
+    """Masked-LM head + loss (ERNIE pretraining objective).
+
+    With `cfg.fused_mlm_head` (auto at long seq + real vocab) the head
+    runs as the vocab-chunked fused_lm_head_ce (ops/fused_ce.py), which
+    never materializes the [B, S, V] logits — same parameter
+    names/shapes as the dense fc head, so checkpoints are
+    interchangeable."""
+    from ..ops.fused_ce import DEFAULT_CHUNK
+    fused = cfg.fused_mlm_head
+    if fused is None:
+        fused = (cfg.seq_len >= 512
+                 and cfg.vocab_size >= 2 * DEFAULT_CHUNK)
     with _stage_guard(cfg)(_last_stage(cfg)):
-        logits = layers.fc(seq_out, cfg.vocab_size, num_flatten_dims=2,
-                           param_attr=_attr("mlm_head_w"),
-                           bias_attr=ParamAttr(name="mlm_head_b"))
-        loss = layers.softmax_with_cross_entropy(logits, mlm_labels)
+        if fused:
+            hidden = cfg.hidden_size
+            w = layers.create_parameter([hidden, cfg.vocab_size],
+                                        "float32",
+                                        attr=_attr("mlm_head_w"))
+            b = layers.create_parameter([cfg.vocab_size], "float32",
+                                        attr=ParamAttr(name="mlm_head_b"),
+                                        is_bias=True)
+            loss = layers.fused_lm_head_ce(seq_out, w, mlm_labels,
+                                           bias=b, w_layout="hv")
+        else:
+            logits = layers.fc(seq_out, cfg.vocab_size,
+                               num_flatten_dims=2,
+                               param_attr=_attr("mlm_head_w"),
+                               bias_attr=ParamAttr(name="mlm_head_b"))
+            loss = layers.softmax_with_cross_entropy(logits, mlm_labels)
         return layers.mean(loss)
 
 

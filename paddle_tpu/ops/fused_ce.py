@@ -1,26 +1,28 @@
 """Vocab-chunked LM-head cross-entropy (`fused_lm_head_ce`).
 
 Reference counterpart (what it replaces, not how it works): the
-`matmul(seq, wte^T)` + `softmax_with_cross_entropy` pair every LM builds
-(reference fluid/layers/loss.py:1080 softmax_with_cross_entropy over the
-full logits tensor; the fused-op family in operators/fused/ exists for
-exactly this class of HBM-bound epilogues).
+`matmul(seq, wte^T)` / `fc` + `softmax_with_cross_entropy` pair every LM
+builds (reference fluid/layers/loss.py:1080 softmax_with_cross_entropy
+over the full logits tensor; the fused-op family in operators/fused/
+exists for exactly this class of HBM-bound epilogues).
 
 Why: at real LM scale the `[B, S, V]` logits tensor IS the memory peak —
 GPT-2's V=50257 at B=32, S=512 is 3.3 GB in f32 before the softmax's own
-intermediates, while the whole rest of the step needs far less. The
-TPU-native fix is streaming: `lax.scan` over vocab chunks computes an
-online logsumexp (flash-attention's trick applied to the classifier),
-so at most one `[B, S, C]` chunk of logits is ever live, and a
-`jax.custom_vjp` recomputes each chunk in the backward pass instead of
-saving it (same FLOPs trade as activation remat: one extra head matmul
-per chunk in exchange for never materializing the logits).
+intermediates, and BERT's V=30522 at the bench geometry (B=128, S=128)
+is 2.0 GB. The TPU-native fix is streaming: `lax.scan` over vocab chunks
+computes an online logsumexp (flash-attention's trick applied to the
+classifier), so at most one `[B, S, C]` chunk of logits is ever live,
+and a `jax.custom_vjp` recomputes each chunk in the backward pass
+instead of saving it (same FLOPs trade as activation remat: one extra
+head matmul per chunk in exchange for never materializing the logits).
 
 Both matmuls per chunk stay MXU-shaped ([B*S, H] x [H, C]) and
 accumulate f32 (`preferred_element_type`), so bf16 AMP inputs lose no
-loss precision. The label's logit rides the same scan (gathered from the
-chunk that contains it); padded tail rows of a ragged final chunk are
-masked to -inf so they never enter the logsumexp.
+loss precision (the op is AMP white-listed). The label's logit rides the
+same scan (gathered from the chunk that contains it); padded tail rows
+of a ragged final chunk are masked to -inf so they never enter the
+logsumexp. Supports both weight layouts — `[V, H]` (GPT's tied
+embedding) and `[H, V]` (BERT's fc head) — plus an optional `[V]` bias.
 """
 from __future__ import annotations
 
@@ -34,40 +36,44 @@ from .registry import register
 DEFAULT_CHUNK = 8192
 
 
-def _pad_w(w, chunk):
-    v = w.shape[0]
+def _pad_w(w, b, chunk):
+    """w: [V, H]; b: [V]. Pad the vocab dim to a chunk multiple and
+    reshape into per-chunk leaves for the scan."""
+    v, h = w.shape
     n_chunks = -(-v // chunk)
     pad = n_chunks * chunk - v
     if pad:
         w = jnp.pad(w, ((0, pad), (0, 0)))
-    return w, n_chunks, v
+        b = jnp.pad(b, (0, pad))
+    return (w.reshape(n_chunks, chunk, h),
+            b.reshape(n_chunks, chunk), n_chunks, v)
 
 
-def _chunk_logits(x, w_c, c0, chunk, v):
+def _chunk_logits(x, w_c, b_c, c0, chunk, v):
     """f32 logits for one chunk, padded-vocab tail masked to -inf.
-    x: [B, S, H]; w_c: [C, H] -> [B, S, C]."""
+    x: [B, S, H]; w_c: [C, H]; b_c: [C] -> [B, S, C]."""
     l_c = jnp.einsum("bsh,ch->bsc", x, w_c,
                      preferred_element_type=jnp.float32)
+    l_c = l_c + b_c.astype(jnp.float32)[None, None, :]
     valid = (c0 + jnp.arange(chunk)) < v
     return jnp.where(valid[None, None, :], l_c, -jnp.inf)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _chunked_lm_ce(x, w, labels, chunk):
-    loss, _ = _fwd_scan(x, w, labels, chunk)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _chunked_lm_ce(x, w, b, labels, chunk):
+    loss, _ = _fwd_scan(x, w, b, labels, chunk)
     return loss
 
 
-def _fwd_scan(x, w, labels, chunk):
-    wp, n_chunks, v = _pad_w(w, chunk)
-    w_chunks = wp.reshape(n_chunks, chunk, w.shape[1])
-    b, s = labels.shape
+def _fwd_scan(x, w, b, labels, chunk):
+    w_chunks, b_chunks, n_chunks, v = _pad_w(w, b, chunk)
+    bsz, s = labels.shape
 
-    def body(carry, wc_and_idx):
+    def body(carry, leaves):
         m, ssum, lab = carry
-        w_c, idx = wc_and_idx
+        w_c, b_c, idx = leaves
         c0 = idx * chunk
-        l_c = _chunk_logits(x, w_c, c0, chunk, v)
+        l_c = _chunk_logits(x, w_c, b_c, c0, chunk, v)
         m_new = jnp.maximum(m, jnp.max(l_c, axis=-1))
         ssum = ssum * jnp.exp(m - m_new) + jnp.sum(
             jnp.exp(l_c - m_new[..., None]), axis=-1)
@@ -77,44 +83,53 @@ def _fwd_scan(x, w, labels, chunk):
         lab = jnp.where(in_chunk, picked, lab)
         return (m_new, ssum, lab), None
 
-    init = (jnp.full((b, s), -jnp.inf, jnp.float32),
-            jnp.zeros((b, s), jnp.float32),
-            jnp.zeros((b, s), jnp.float32))
+    init = (jnp.full((bsz, s), -jnp.inf, jnp.float32),
+            jnp.zeros((bsz, s), jnp.float32),
+            jnp.zeros((bsz, s), jnp.float32))
     (m, ssum, lab), _ = jax.lax.scan(
-        body, init, (w_chunks, jnp.arange(n_chunks)))
+        body, init, (w_chunks, b_chunks, jnp.arange(n_chunks)))
     lse = m + jnp.log(ssum)
+    # Contract: labels must lie in [0, V). Out-of-range labels (e.g. a
+    # -1/-100 pad convention this op does not implement) yield NaN for
+    # that token — loud and deterministic, where the dense pair's
+    # out-of-bounds gather is backend-defined garbage. Mask pad tokens
+    # out of the loss instead of feeding ignore ids.
+    valid = (labels >= 0) & (labels < v)
+    lab = jnp.where(valid, lab, jnp.nan)
     return (lse - lab)[..., None], lse
 
 
-def _ce_fwd(x, w, labels, chunk):
-    loss, lse = _fwd_scan(x, w, labels, chunk)
-    return loss, (x, w, labels, lse)
+def _ce_fwd(x, w, b, labels, chunk):
+    loss, lse = _fwd_scan(x, w, b, labels, chunk)
+    return loss, (x, w, b, labels, lse)
 
 
 def _ce_bwd(chunk, res, g):
-    x, w, labels, lse = res
-    wp, n_chunks, v = _pad_w(w, chunk)
-    w_chunks = wp.reshape(n_chunks, chunk, w.shape[1])
+    x, w, b, labels, lse = res
+    w_chunks, b_chunks, n_chunks, v = _pad_w(w, b, chunk)
     gf = g[..., 0].astype(jnp.float32)              # [B, S]
 
-    def body(dx, wc_and_idx):
-        w_c, idx = wc_and_idx
+    def body(dx, leaves):
+        w_c, b_c, idx = leaves
         c0 = idx * chunk
-        l_c = _chunk_logits(x, w_c, c0, chunk, v)
+        l_c = _chunk_logits(x, w_c, b_c, c0, chunk, v)
         p_c = jnp.exp(l_c - lse[..., None])          # -inf rows -> 0
-        off = labels - c0
+        off = labels - c0                            # out-of-range -> all-0
         onehot = jax.nn.one_hot(off, chunk, dtype=jnp.float32)
         dl = (p_c - onehot) * gf[..., None]          # [B, S, C] f32
         dx = dx + jnp.einsum("bsc,ch->bsh", dl,
                              w_c.astype(jnp.float32))
         dw_c = jnp.einsum("bsc,bsh->ch", dl, x.astype(jnp.float32))
-        return dx, dw_c
+        db_c = jnp.sum(dl, axis=(0, 1))
+        return dx, (dw_c, db_c)
 
     dx0 = jnp.zeros(x.shape, jnp.float32)
-    dx, dw_stack = jax.lax.scan(body, dx0,
-                                (w_chunks, jnp.arange(n_chunks)))
+    dx, (dw_stack, db_stack) = jax.lax.scan(
+        body, dx0, (w_chunks, b_chunks, jnp.arange(n_chunks)))
     dw = dw_stack.reshape(n_chunks * chunk, w.shape[1])[:v]
-    return dx.astype(x.dtype), dw.astype(w.dtype), None
+    db = db_stack.reshape(n_chunks * chunk)[:v]
+    return (dx.astype(x.dtype), dw.astype(w.dtype), db.astype(b.dtype),
+            None)
 
 
 _chunked_lm_ce.defvjp(_ce_fwd, _ce_bwd)
@@ -123,10 +138,15 @@ _chunked_lm_ce.defvjp(_ce_fwd, _ce_bwd)
 @register("fused_lm_head_ce", nondiff_slots=("Label",))
 def _fused_lm_head_ce(ctx, ins, attrs):
     x, w, label = ins["X"][0], ins["W"][0], ins["Label"][0]
-    chunk = int(attrs.get("chunk", DEFAULT_CHUNK))
+    bias = (ins.get("Bias") or [None])[0]
+    if attrs.get("w_layout", "vh") == "hv":          # fc-style [H, V]
+        w = w.T                                      # XLA folds into the dot
+    chunk = int(attrs.get("chunk") or DEFAULT_CHUNK)
     labels = label.astype(jnp.int32)
     if labels.ndim == x.ndim:                        # [B, S, 1] -> [B, S]
         labels = labels[..., 0]
     chunk = min(chunk, max(int(w.shape[0]), 1))
-    loss = _chunked_lm_ce(x, w, labels, chunk)
+    if bias is None:
+        bias = jnp.zeros((w.shape[0],), x.dtype)
+    loss = _chunked_lm_ce(x, w, bias, labels, chunk)
     return {"Loss": [loss.astype(jnp.float32)]}
