@@ -388,12 +388,15 @@ def bench_wide_deep(batch, steps):
         srv.stop()
 
 
-def _device_tflops_probe(n=4096, iters=32):
+def _device_tflops_probe(n=4096, iters=256):
     """Raw sustained bf16 matmul rate, framework-free: one jit dispatch of
-    a fori_loop of n x n matmuls. Separates 'the chip/tunnel is degraded'
-    from 'the framework regressed' — round 5 observed the SAME commit that
-    recorded 114k tok/s measuring 5.5k in a window where this probe also
-    collapsed, pinning the cause on the environment."""
+    a fori_loop of n x n matmuls, synced by draining a SCALAR of the
+    result. Draining the full [n, n] matrix (the round-5 original)
+    measured the tunnel's ~72 MB/s D2H bandwidth, not the chip — it
+    capped every reading at ~4.4 TF/s and misdiagnosed a healthy chip as
+    degraded for two sessions (scalar-drain on the same chip in the same
+    minute: 49+ TF/s). iters=256 makes compute ~0.18 s at peak so the
+    ~0.07 s dispatch overhead doesn't dominate the reading."""
     import jax
     import jax.numpy as jnp
 
@@ -402,14 +405,55 @@ def _device_tflops_probe(n=4096, iters=32):
 
     @jax.jit
     def chain(x):
-        return jax.lax.fori_loop(
+        y = jax.lax.fori_loop(
             0, iters, lambda i, y: (y @ y) * inv, x)
+        return y[0, 0]                     # 2-byte D2H, full compute
 
     _drain(chain(a))                       # compile + warm
     t0 = time.perf_counter()
     _drain(chain(a))
     dt = time.perf_counter() - t0
     return 2.0 * n ** 3 * iters / dt / 1e12
+
+
+def _hbm_gbps_probe(mb=256):
+    """Device-memory bandwidth, dispatch-amortized: a fori_loop of
+    elementwise y = y + 1 over a [mb] MB f32 array — a carried
+    dependency XLA cannot hoist, streaming mb MB read + mb MB write per
+    iteration (the array exceeds VMEM, so every pass touches HBM).
+    Adaptive: a short 4-iteration pass first (bounded time on a
+    degraded path), escalating to 64 iterations for precision when the
+    short pass implies a healthy rate that overhead could be masking.
+    This is the second health axis — round 5 caught a window where the
+    MXU probe read 140 TF/s while the memory path ran at single-digit
+    GB/s vs the ~819 GB/s v5e spec: the VMEM-resident matmul chain was
+    fine but every real (HBM-streaming) program ran 10-40x slow. Model
+    throughput needs BOTH probes healthy."""
+    import jax
+    import jax.numpy as jnp
+
+    n = mb * 1024 * 1024 // 4
+    a = jax.device_put(jnp.ones((n,), jnp.float32))
+
+    def make(iters):
+        @jax.jit
+        def bump(x):
+            y = jax.lax.fori_loop(0, iters, lambda i, y: y + 1.0, x)
+            return y[0]                    # 4-byte D2H, full traffic
+        return bump
+
+    def measure(iters):
+        fn = make(iters)
+        _drain(fn(a))                      # compile + warm
+        t0 = time.perf_counter()
+        _drain(fn(a))
+        dt = time.perf_counter() - t0
+        return 2.0 * (mb / 1024.0) * iters / dt
+
+    bw = measure(4)
+    if bw > 20.0:      # plausibly overhead-masked: amortize further
+        bw = measure(64)
+    return bw
 
 
 def _prev_recorded_value():
@@ -469,38 +513,51 @@ def main():
 
     tokens_per_sec = mfu = None
     health_tflops = None
+    hbm_gbps = None
+
+    def _probe_both():
+        t = g = None
+        try:
+            t = _device_tflops_probe()
+            _log(f"device health probe: {t:.1f} bf16 TFLOP/s (MXU/VMEM)")
+        except Exception as e:
+            print(f"MXU probe failed: {e!r}", file=sys.stderr)
+        try:
+            g = _hbm_gbps_probe()
+            _log(f"device health probe: {g:.1f} GB/s (HBM read)")
+        except Exception as e:
+            print(f"HBM probe failed: {e!r}", file=sys.stderr)
+        return t, g
+
+    def _is_degraded(t, g):
+        # two independent failure axes, both seen in rounds 4-5: the MXU
+        # path (compute) and the device-memory path (round-5 diagnosis:
+        # MXU at 140 TF/s while HBM read 3.5 GB/s vs ~819 spec — every
+        # real model 10-40x slow while the VMEM-resident probe was fine)
+        return (t is not None and t < 30) or (g is not None and g < 50)
+
     if init_err is None:
         import jax
         on_tpu = jax.default_backend() not in ("cpu",)
         if on_tpu:
-            try:
-                health_tflops = _device_tflops_probe()
-                _log(f"device health probe: {health_tflops:.1f} "
-                     "bf16 TFLOP/s")
-            except Exception as e:
-                print(f"health probe failed: {e!r}", file=sys.stderr)
+            health_tflops, hbm_gbps = _probe_both()
         try:
             wait = int(os.environ.get("BENCH_DEGRADED_WAIT", "600"))
         except ValueError:
             wait = 600
-        # a degraded tunnel (health far below the ~197 peak / ~60+ typical)
-        # sometimes recovers with quiet — one bounded wait before measuring
-        if health_tflops is not None and health_tflops < 30 and wait > 0:
-            _log(f"tunnel degraded ({health_tflops:.1f} TF/s); quiet "
-                 f"{wait}s then re-probe")
+        # a degraded tunnel sometimes recovers with quiet — one bounded
+        # wait before measuring
+        if on_tpu and _is_degraded(health_tflops, hbm_gbps) and wait > 0:
+            _log(f"tunnel degraded; quiet {wait}s then re-probe")
             time.sleep(wait)
-            try:
-                health_tflops = _device_tflops_probe()
-                _log(f"re-probe: {health_tflops:.1f} bf16 TFLOP/s")
-            except Exception as e:
-                print(f"health re-probe failed: {e!r}", file=sys.stderr)
-        # a still-degraded chip (rounds 4-5 saw 0.8-4.3 TF/s vs 197 peak)
-        # runs every dispatch ~50-250x slow: a full 7-row bench would take
-        # hours and risk the driver killing the process before the ONE
-        # required JSON line prints. Shrink the step count (the number is
-        # stamped tunnel_degraded and never used as a comparison point
-        # anyway) and skip the expensive extras below.
-        degraded = health_tflops is not None and health_tflops < 30
+            health_tflops, hbm_gbps = _probe_both()
+        # a still-degraded chip runs every HBM-bound dispatch 10-250x
+        # slow: a full 8-row bench would take hours and risk the driver
+        # killing the process before the ONE required JSON line prints.
+        # Shrink the step count (the number is stamped tunnel_degraded
+        # and never used as a comparison point anyway) and skip the
+        # expensive extras below.
+        degraded = _is_degraded(health_tflops, hbm_gbps)
         if degraded:
             steps = min(steps, 4)
             _log(f"degraded mode: steps={steps}, extras trimmed")
@@ -650,7 +707,10 @@ def main():
         rec["skipped_rows"] = skipped_rows
     if health_tflops is not None:
         rec["device_bf16_tflops_probe"] = round(health_tflops, 1)
-        if health_tflops < 30:
+    if hbm_gbps is not None:
+        rec["device_hbm_read_gbps_probe"] = round(hbm_gbps, 1)
+    if health_tflops is not None or hbm_gbps is not None:
+        if _is_degraded(health_tflops, hbm_gbps):
             # framework-free evidence: the chip/tunnel itself is running
             # far below its bf16 peak in this window (docs/perf_notes.md
             # round-5 notes), so tok/s here is not comparable to healthy

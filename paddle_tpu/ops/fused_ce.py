@@ -108,6 +108,11 @@ def _ce_bwd(chunk, res, g):
     x, w, b, labels, lse = res
     w_chunks, b_chunks, n_chunks, v = _pad_w(w, b, chunk)
     gf = g[..., 0].astype(jnp.float32)              # [B, S]
+    # out-of-range labels NaN the forward loss; make the gradients loud
+    # too (an all-zero one_hot would otherwise emit a finite,
+    # label-term-free gradient that silently corrupts training)
+    valid = (labels >= 0) & (labels < v)
+    gf = jnp.where(valid, gf, jnp.nan)
 
     def body(dx, leaves):
         w_c, b_c, idx = leaves

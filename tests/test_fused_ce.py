@@ -222,5 +222,14 @@ def test_fused_ce_out_of_range_label_is_nan():
     bad[0, 0, 0] = -1
     bad[1, 2, 0] = 37
     feed["label"] = bad
-    lv, = exe.run(feed=feed, fetch_list=[loss])
-    assert np.isnan(np.asarray(lv)), "out-of-range label must surface NaN"
+    gb = fluid.default_main_program().global_block()
+    fetches = [loss.name] + [f for f in ("proj@GRAD", "head_w@GRAD")
+                             if gb.has_var(f)]
+    vals = exe.run(feed=feed, fetch_list=fetches)
+    assert np.isnan(np.asarray(vals[0])), \
+        "out-of-range label must surface NaN loss"
+    # the custom-VJP backward must be loud too: a finite gradient with
+    # the label term silently missing would corrupt training
+    for name, g in zip(fetches[1:], vals[1:]):
+        assert np.isnan(np.asarray(g)).any(), \
+            f"{name} must carry NaN for the invalid token"
