@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""One-shot diagnostic battery for the TPU tunnel/backend.
+
+Runs the probes that untangled round 5's perf mystery (see
+docs/perf_notes.md "Round 5" for the full story), in order:
+
+1. MXU rate      — scalar-drain chained matmul (VMEM-resident).
+2. Memory rate   — amortized y=y+1 streaming loop.
+3. D2H rate      — time pulling a 64 MB array to host.
+4. Kernel cost   — same-FLOPs program at 64 vs 2048 kernels.
+5. State round-trip — THE discriminating experiment for the ~20x
+   framework-vs-pure-jax gap: feed a jit its own large output as the
+   next call's input. The framework's functional state threading does
+   exactly this every call; if the runtime host-materializes outputs,
+   call 2 pays size/D2H+H2D through the tunnel while a fresh
+   device_put-fed call does not.
+
+Usage: python scripts/tunnel_diag.py  (dials the real TPU; ~2 min)
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main():
+    import bench
+    err = bench._backend_ready(attempts=1)
+    if err is not None:
+        print(f"backend init failed: {err!r}")
+        return 2
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    print(f"backend: {jax.default_backend()}  devices: {jax.devices()}")
+
+    t = bench._device_tflops_probe()
+    print(f"1. MXU scalar-drain probe : {t:8.1f} bf16 TF/s (peak ~197)")
+    g = bench._hbm_gbps_probe()
+    print(f"2. memory amortized probe : {g:8.1f} GB/s      (spec ~819)")
+
+    a = jax.device_put(jnp.ones((16 * 1024 * 1024,), jnp.float32))  # 64MB
+    np.asarray(a[0])
+    t0 = time.perf_counter()
+    np.asarray(a)
+    dt = time.perf_counter() - t0
+    print(f"3. D2H pull 64 MB         : {0.0625 / dt:8.1f} GB/s")
+
+    def kernels(K, n, iters=64):
+        # FLOPs-matched across calls: K matmuls of ~n per iter. Sizes
+        # within a call differ by +8 so XLA cannot horizontally fuse
+        # them into one batched dot; working sets stay VMEM-scale in
+        # both variants so a memory-path problem cannot masquerade as
+        # per-kernel cost (an earlier version of this probe had both
+        # confounds).
+        mats = [jax.device_put(
+            jnp.ones((n + 8 * k, n + 8 * k), jnp.bfloat16))
+            for k in range(K)]
+
+        @jax.jit
+        def f(ms):
+            out = jax.lax.fori_loop(
+                0, iters,
+                lambda i, ms: tuple((m @ m) * jnp.bfloat16(1.0 / n)
+                                    for m in ms),
+                tuple(ms))
+            return out[0][0, 0]
+
+        np.asarray(f(mats))
+        t0 = time.perf_counter()
+        np.asarray(f(mats))
+        return time.perf_counter() - t0
+
+    # 64 kernels of 2048^3 vs 512 kernels of ~1024^3: ~1.1e12 FLOPs both
+    t_few, t_many = kernels(1, 2048), kernels(8, 1024)
+    print(f"4. kernel-count scaling   : 64 kernels {t_few * 1000:6.0f} ms, "
+          f"512 kernels (same FLOPs) {t_many * 1000:6.0f} ms "
+          f"({'flat — launches fine' if t_many < 3 * t_few else 'SCALING — per-kernel cost!'})")
+
+    # 5. state round-trip: x -> y (500 MB out); then feed y back in.
+    n = 128 * 1024 * 1024 // 4 * 4   # 512 MB f32
+    big = jax.device_put(jnp.ones((n,), jnp.float32))
+
+    @jax.jit
+    def step(x):
+        return x + 1.0
+
+    y = step(big)
+    np.asarray(y[0])                  # sync call 1
+    t0 = time.perf_counter()
+    z = step(big)                     # fresh device_put-origin input
+    np.asarray(z[0])
+    t_fresh = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    w = step(y)                       # feed a previous OUTPUT back
+    np.asarray(w[0])
+    t_fed = time.perf_counter() - t0
+    # Interpretation needs ABSOLUTE times, not just the ratio: if the
+    # runtime EAGERLY host-materializes every output, the fresh call
+    # also pays ~512 MB D2H (~7 s at the tunnel's ~72 MB/s) inside the
+    # timed region and a ratio test reads 'OK' in exactly the broken
+    # case. Device-side cost of x+1 on 512 MB is ~4 ms at spec; ~0.5 s
+    # is a generous bound including the dispatch floor.
+    if t_fresh > 0.5:
+        verdict = ("EAGER OUTPUT MATERIALIZATION — every call pays "
+                   "output D2H (the framework-gap cause)")
+    elif t_fed > max(3 * t_fresh, 0.5):
+        verdict = ("OUTPUT BOUNCE on feed-back — state round-trips "
+                   "host-side (the framework-gap cause)")
+    else:
+        verdict = "OK — outputs stay device-resident"
+    print(f"5. state round-trip       : fresh-input call "
+          f"{t_fresh * 1000:6.0f} ms, output-fed call "
+          f"{t_fed * 1000:6.0f} ms ({verdict})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
