@@ -1,0 +1,36 @@
+"""The scripts/ directory is part of the deliverable (CI driver, op
+manifest, diagnostics, sweep/audit harnesses): each must at least
+compile, and the argparse-bearing ones must answer --help — so a repo
+refactor cannot silently rot the tooling the docs point at."""
+import glob
+import os
+import py_compile
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = sorted(glob.glob(os.path.join(ROOT, "scripts", "*.py")))
+
+
+@pytest.mark.parametrize("script", SCRIPTS,
+                         ids=[os.path.basename(p) for p in SCRIPTS])
+def test_script_compiles(script):
+    py_compile.compile(script, doraise=True)
+
+
+def test_flash_sweep_help():
+    r = subprocess.run([sys.executable,
+                        os.path.join(ROOT, "scripts", "flash_sweep.py"),
+                        "--help"], capture_output=True, text=True,
+                       timeout=120)
+    assert r.returncode == 0, r.stderr[-300:]
+    assert "--grid" in r.stdout
+
+
+def test_ci_driver_help():
+    r = subprocess.run([sys.executable,
+                        os.path.join(ROOT, "scripts", "ci.py"), "--help"],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-300:]
