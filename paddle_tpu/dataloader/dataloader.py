@@ -9,6 +9,7 @@ runs (XLA async dispatch gives the overlap).
 """
 from __future__ import annotations
 
+import collections
 import itertools
 import multiprocessing as mp
 import queue as queue_mod
@@ -42,13 +43,23 @@ def default_collate_fn(batch):
     return np.stack([np.asarray(s) for s in batch])
 
 
-def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id):
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
+                 fault_spec="", fault_seed=0):
+    # fault injection rides in as a picklable (spec, seed) pair because
+    # forkserver children don't share the parent's installed plan object;
+    # each worker replays its own deterministic counter stream
+    plan = None
+    if fault_spec:
+        from ..resilience.faults import FaultPlan
+        plan = FaultPlan(fault_spec, fault_seed)
     while True:
         item = index_queue.get()
         if item is None:
             break
         seq, indices = item
         try:
+            if plan is not None:
+                plan.fire("dataloader.worker")   # may raise or os._exit
             batch = collate_fn([dataset[i] for i in indices])
             data_queue.put((seq, batch, None))
         except Exception as e:  # surface worker errors to the main process
@@ -63,38 +74,120 @@ class _MultiprocessIter:
     before it touches JAX, so workers never inherit JAX's internal threads
     and locks (forking the JAX-multithreaded parent directly can deadlock).
     Datasets must therefore be picklable, as in the reference's multiprocess
-    mode. The bounded data queue gives backpressure: workers stall once
-    2*num_workers batches are waiting, so memory stays at a small window
-    rather than an epoch (reference: C++ blocking queue, capacity knob).
+    mode. Backpressure comes from windowed index dispatch: each worker holds
+    at most _PREFETCH outstanding assignments, refilled as its results come
+    back, so memory stays at a small window rather than an epoch (reference:
+    dataloader_iter.py _outstanding_capacity over the index queues).
     """
 
     _GET_TIMEOUT = 300.0
     _POLL = 1.0  # death-check cadence while blocked on the queue
+    _PREFETCH = 2  # outstanding batches per worker (the backpressure bound)
 
     def __init__(self, dataset, batches: List[List[int]], collate_fn,
-                 num_workers: int):
-        ctx = mp.get_context("forkserver")
-        self._data_queue = ctx.Queue(maxsize=2 * num_workers)
+                 num_workers: int, max_respawns: int = None):
+        from ..resilience.faults import current_plan
+        if max_respawns is None:
+            from ..flags import flag
+            max_respawns = int(flag("FLAGS_dataloader_max_respawns"))
+        plan = current_plan()
+        self._fault_spec = plan.spec if plan is not None else ""
+        self._fault_seed = plan.seed if plan is not None else 0
+        self._ctx = ctx = mp.get_context("forkserver")
+        self._dataset = dataset
+        self._collate_fn = collate_fn
+        self._batches = list(batches)
+        self._respawns_left = max_respawns
+        # The data queue must be UNBOUNDED: a bounded mp.Queue's capacity
+        # semaphore is acquired by the producer's put() and only released
+        # when the consumer reads the item, so a worker that dies between
+        # put() and its feeder-thread flush leaks a capacity slot forever —
+        # enough abrupt deaths and every later put() blocks for good.
+        # Backpressure comes from the dispatch window instead (reference
+        # dataloader_iter.py: _outstanding_capacity on the index queues).
+        self._data_queue = ctx.Queue()
         self._index_queues = []
         self._workers = []
         for w in range(num_workers):
-            iq = ctx.Queue()
-            p = ctx.Process(target=_worker_loop,
-                            args=(dataset, iq, self._data_queue, collate_fn, w),
-                            daemon=True)
-            p.start()
+            iq, p = self._spawn_worker(w)
             self._index_queues.append(iq)
             self._workers.append(p)
-        self._assigned_worker = {}
-        for seq, idxs in enumerate(batches):
-            self._index_queues[seq % num_workers].put((seq, idxs))
-            self._assigned_worker[seq] = seq % num_workers
-        for iq in self._index_queues:
-            iq.put(None)
         self._total = len(batches)
         self._next_seq = 0
         self._reorder = {}
         self._received = set()
+        self._undispatched = collections.deque(range(len(batches)))
+        self._inflight = {w: set() for w in range(num_workers)}
+        self._closed = set()   # workers already sent the end sentinel
+        for _ in range(self._PREFETCH):
+            for w in range(num_workers):
+                self._dispatch(w)
+
+    def _dispatch(self, w):
+        """Feed worker `w` its next batch (at most _PREFETCH outstanding
+        per worker, refilled as results come back), or the end sentinel
+        once — and only once per incarnation — when nothing is left."""
+        if self._undispatched:
+            s = self._undispatched.popleft()
+            self._inflight[w].add(s)
+            self._index_queues[w].put((s, self._batches[s]))
+        elif w not in self._closed:
+            self._closed.add(w)
+            self._index_queues[w].put(None)
+
+    def _on_batch(self, seq, batch):
+        """Record an arrived batch and refill whichever worker produced it.
+        A duplicate arrival (respawn re-queued a batch whose original was
+        still in the dead worker's pipe) is dropped outright — re-inserting
+        an already-consumed seq into _reorder would pin the arrays for the
+        rest of the epoch; the re-queued copy handles the accounting when
+        it lands."""
+        if seq in self._received:
+            return
+        self._received.add(seq)
+        self._reorder[seq] = batch
+        for w, inflight in self._inflight.items():
+            if seq in inflight:
+                inflight.discard(seq)
+                self._dispatch(w)
+                break
+
+    def _spawn_worker(self, w):
+        iq = self._ctx.Queue()
+        p = self._ctx.Process(
+            target=_worker_loop,
+            args=(self._dataset, iq, self._data_queue, self._collate_fn, w,
+                  self._fault_spec, self._fault_seed),
+            daemon=True)
+        p.start()
+        return iq, p
+
+    def _respawn(self, w, exitcode):
+        """Replace dead worker `w` with a fresh process owning exactly its
+        undelivered assignments (bounded by FLAGS_dataloader_max_respawns,
+        counted in monitor 'resilience.worker_respawns')."""
+        import warnings
+        from ..monitor import stat_add
+        self._respawns_left -= 1
+        stat_add("resilience.worker_respawns")
+        warnings.warn(
+            f"DataLoader worker {w} died ({_describe_exit(exitcode)}); "
+            f"respawning ({self._respawns_left} respawn(s) left)")
+        old_iq = self._index_queues[w]
+        old_iq.cancel_join_thread()
+        old_iq.close()
+        owed = sorted(self._inflight[w] - self._received)
+        self._inflight[w] = set()
+        self._closed.discard(w)
+        iq, p = self._spawn_worker(w)
+        self._index_queues[w] = iq
+        self._workers[w] = p
+        for s in owed:
+            self._inflight[w].add(s)
+            iq.put((s, self._batches[s]))
+        if not self._undispatched:
+            self._closed.add(w)
+            iq.put(None)
 
     def __iter__(self):
         return self
@@ -104,11 +197,8 @@ class _MultiprocessIter:
         a worker that delivered everything it was assigned and then died
         (nonzero atexit of some native lib, say) is a retirement, not a
         failure; only an undelivered assignment makes its death fatal."""
-        owing = {self._assigned_worker[s] for s in range(self._next_seq,
-                                                         self._total)
-                 if s not in self._received}
         return [(w, p.exitcode) for w, p in enumerate(self._workers)
-                if w in owing and not p.is_alive()
+                if self._inflight[w] and not p.is_alive()
                 and p.exitcode not in (0, None)]
 
     def __next__(self):
@@ -139,19 +229,29 @@ class _MultiprocessIter:
                             self._join()
                             raise RuntimeError(
                                 f"DataLoader worker failed: {err}")
-                        self._received.add(seq)
-                        self._reorder[seq] = batch
+                        self._on_batch(seq, batch)
                     if self._next_seq in self._reorder:
                         break          # the awaited batch made it out
                     dead = self._abnormal_deaths()
+                if dead and self._respawns_left > 0:
+                    # graceful degradation: replace the dead worker(s) and
+                    # requeue their owed batches instead of aborting the
+                    # epoch (bounded by FLAGS_dataloader_max_respawns)
+                    for w, c in dead:
+                        if self._respawns_left <= 0:
+                            break
+                        self._respawn(w, c)
+                    waited = 0.0
+                    continue
                 if dead:
                     # fail fast with the culprit (reference SIGCHLD path:
                     # "DataLoader worker exits unexpectedly")
                     self._join()
                     raise RuntimeError(
                         "DataLoader worker(s) died unexpectedly "
-                        + ", ".join(f"worker {w} exitcode {c}"
-                                    for w, c in dead)
+                        + ", ".join(
+                            f"worker {w} exitcode {c} ({_describe_exit(c)})"
+                            for w, c in dead)
                         + f" while waiting for batch {self._next_seq} "
                         f"(liveness poll caught it after {waited:.0f}s, "
                         f"not the {self._GET_TIMEOUT:.0f}s queue timeout)")
@@ -164,8 +264,7 @@ class _MultiprocessIter:
             if err is not None:
                 self._join()
                 raise RuntimeError(f"DataLoader worker failed: {err}")
-            self._received.add(seq)
-            self._reorder[seq] = batch
+            self._on_batch(seq, batch)
         batch = self._reorder.pop(self._next_seq)
         self._next_seq += 1
         return batch
@@ -175,7 +274,42 @@ class _MultiprocessIter:
             p.join(timeout=1)
             if p.is_alive():
                 p.terminate()
+                p.join(timeout=1)
+        # Drain + detach the queues so a dead worker's feeder pipe can't
+        # wedge teardown: a terminated child may leave items in the data
+        # queue's pipe, and OUR feeder threads for the index queues would
+        # otherwise block interpreter exit flushing to a reader that is
+        # gone (the reference's _shutdown_workers does the same drain).
+        try:
+            while True:
+                self._data_queue.get_nowait()
+        except (queue_mod.Empty, OSError, ValueError):
+            pass
+        for q in [self._data_queue] + self._index_queues:
+            try:
+                q.cancel_join_thread()
+                q.close()
+            except (OSError, ValueError):
+                pass   # already closed (e.g. by a respawn)
         self._workers = []
+        self._index_queues = []
+
+
+def _describe_exit(exitcode):
+    """Human-readable worker exit: signal name for negative codes, the
+    fault-injection kill code called out explicitly."""
+    if exitcode is None:
+        return "still running"
+    if exitcode < 0:
+        import signal as _signal
+        try:
+            return f"killed by signal {_signal.Signals(-exitcode).name}"
+        except ValueError:
+            return f"killed by signal {-exitcode}"
+    from ..resilience.faults import FaultPlan
+    if exitcode == FaultPlan.KILL_EXIT_CODE:
+        return "fault-injection kill"
+    return f"exited with status {exitcode}"
 
 
 class _Prefetcher:

@@ -16,10 +16,17 @@ import pickle
 import socket
 import struct
 import threading
-import time
 from typing import List, Optional
 
 import numpy as np
+
+from ..framework.errors import DeadlineExceeded
+from ..resilience import FaultInjected, RetryPolicy, fault_point
+
+
+def _gloo_timeout_s() -> float:
+    from ..flags import flag
+    return flag("FLAGS_gloo_timeout_ms") / 1000.0
 
 
 def _send_msg(sock, obj):
@@ -48,8 +55,11 @@ class _Store:
     """Rank-0 TCP store: gathers one value per rank per round, then serves
     the full set back (one round-trip collective primitive)."""
 
-    def __init__(self, world_size: int, port: int = 0):
+    def __init__(self, world_size: int, port: int = 0,
+                 round_timeout_s: Optional[float] = None):
         self.world = world_size
+        self.round_timeout_s = (round_timeout_s if round_timeout_s is not None
+                                else _gloo_timeout_s())
         self.srv = socket.socket()
         self.srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.srv.bind(("0.0.0.0", port))
@@ -80,7 +90,7 @@ class _Store:
                     rnd["values"][rank] = value
                     self._lock.notify_all()
                     while len(self._rounds[tag]["values"]) < self.world:
-                        if not self._lock.wait(timeout=60):
+                        if not self._lock.wait(timeout=self.round_timeout_s):
                             self._rounds.pop(tag, None)  # poison removed
                             raise TimeoutError(
                                 f"gloo round {tag} timed out waiting for "
@@ -108,30 +118,50 @@ class _Store:
 
 
 class Gloo:
-    """Reference GlooWrapper surface: init/barrier/all_reduce/all_gather."""
+    """Reference GlooWrapper surface: init/barrier/all_reduce/all_gather.
+
+    Timeouts are first-class (docs/resilience.md): rendezvous dials under a
+    RetryPolicy bounded by `rendezvous_timeout_s`, and every collective
+    round is bounded by `op_timeout_s` — a dead peer/store raises the typed
+    DeadlineExceededError instead of parking the rank forever (reference
+    gloo_wrapper barrier timeouts). Fault sites: "gloo.rendezvous" (per
+    dial), "gloo.exchange" (per round)."""
 
     def __init__(self, rank: int, world_size: int,
-                 store_addr: Optional[str] = None, port: int = 0):
+                 store_addr: Optional[str] = None, port: int = 0,
+                 rendezvous_timeout_s: Optional[float] = None,
+                 op_timeout_s: Optional[float] = None):
         self.rank = rank
         self.world = world_size
         self._store = None
         self._round = 0
+        if rendezvous_timeout_s is None:
+            rendezvous_timeout_s = _gloo_timeout_s()
+        self.op_timeout_s = (op_timeout_s if op_timeout_s is not None
+                             else _gloo_timeout_s())
+        # injected faults fire before any byte moves, so retrying them is
+        # always stream-safe; a real mid-round socket error is NOT retried
+        # (the length-prefixed stream would desync) — it propagates
+        self._op_retry = RetryPolicy(max_attempts=None,
+                                     deadline_s=self.op_timeout_s,
+                                     retry_on=(FaultInjected,))
         if rank == 0 and store_addr is None:
-            self._store = _Store(world_size, port)
+            self._store = _Store(world_size, port,
+                                 round_timeout_s=self.op_timeout_s)
             host, sport = "127.0.0.1", self._store.port
         else:
             assert store_addr, "non-root ranks need store_addr host:port"
             host, sport = store_addr.rsplit(":", 1)
-        deadline = time.time() + 60
-        while True:
-            try:
-                self.sock = socket.create_connection((host, int(sport)),
-                                                     timeout=60)
-                break
-            except OSError:
-                if time.time() > deadline:
-                    raise
-                time.sleep(0.05)
+
+        def dial():
+            fault_point("gloo.rendezvous")
+            return socket.create_connection((host, int(sport)),
+                                            timeout=rendezvous_timeout_s)
+
+        dial_retry = RetryPolicy(max_attempts=None, base_delay_s=0.05,
+                                 max_delay_s=1.0,
+                                 deadline_s=rendezvous_timeout_s)
+        self.sock = dial_retry.call(dial, site="gloo.rendezvous")
 
     @property
     def store_port(self):
@@ -140,8 +170,28 @@ class Gloo:
     def _exchange(self, value):
         tag = self._round
         self._round += 1
-        _send_msg(self.sock, (tag, self.rank, value))
-        return _recv_msg(self.sock)
+
+        def op():
+            fault_point("gloo.exchange")
+            self.sock.settimeout(self.op_timeout_s)
+            try:
+                _send_msg(self.sock, (tag, self.rank, value))
+                return _recv_msg(self.sock)
+            except socket.timeout as e:
+                # poison the socket (kvstore.cc PingDeadline does the
+                # same): the round's late reply is still owed on this
+                # stream, so a caller that catches the error and issues
+                # round N+1 here would read round N's values as its own
+                try:
+                    self.sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                raise DeadlineExceeded(
+                    "gloo round %d timed out after %.1fs (rank %d/%d) — "
+                    "peer or store dead?", tag, self.op_timeout_s,
+                    self.rank, self.world) from e
+
+        return self._op_retry.call(op, site="gloo.exchange")
 
     def barrier(self):
         self._exchange(None)

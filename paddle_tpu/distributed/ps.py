@@ -23,7 +23,11 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..framework.errors import (DeadlineExceededError, Unavailable,
+                                UnavailableError)
+from ..monitor import stat_add
 from ..native import load_native
+from ..resilience import RetryPolicy, fault_point
 
 
 def _lib():
@@ -65,9 +69,16 @@ def _lib():
                                        ctypes.c_longlong,
                                        ctypes.POINTER(ctypes.c_float),
                                        ctypes.c_uint]
+        lib.kvc_flush.restype = ctypes.c_int
         lib.kvc_flush.argtypes = [ctypes.c_void_p]
         lib.kvc_ping.restype = ctypes.c_int
         lib.kvc_ping.argtypes = [ctypes.c_void_p]
+        lib.kvc_ping_deadline.restype = ctypes.c_int
+        lib.kvc_ping_deadline.argtypes = [ctypes.c_void_p, ctypes.c_double]
+        lib.kvc_reconnect.restype = ctypes.c_int
+        lib.kvc_reconnect.argtypes = [ctypes.c_void_p]
+        lib.kvc_set_io_timeout.restype = None
+        lib.kvc_set_io_timeout.argtypes = [ctypes.c_void_p, ctypes.c_double]
         lib.kvc_table_size.restype = ctypes.c_longlong
         lib.kvc_table_size.argtypes = [ctypes.c_void_p, ctypes.c_uint]
         lib.kvc_save.restype = ctypes.c_int
@@ -137,69 +148,201 @@ class KVServer:
 
 
 class KVClient:
-    """Trainer-side client (reference Communicator + RPCClient)."""
+    """Trainer-side client (reference Communicator + RPCClient).
+
+    Resilience contract (resilience/, docs/resilience.md): every RPC method
+    passes a fault_point ("kv.pull"/"kv.push"/"kv.flush"/"kv.ping") and runs
+    under one RetryPolicy — transient failures back off and retry; an
+    exhausted budget raises the typed DeadlineExceededError (an IOError
+    subclass, so legacy call sites still catch it) instead of hanging.
+    Retried pushes are at-least-once against a REAL half-applied network
+    failure (same as the reference's async communicator, whose merged
+    resends carry no dedup either); injected faults fire before any byte
+    hits the wire, so chaos-run retries replay identical arithmetic.
+
+    Every recv/send on the connection carries a persistent socket deadline
+    (`io_timeout_s`, default FLAGS_rpc_deadline_ms) so a hung-but-connected
+    server fails the op within the deadline instead of parking the trainer
+    in recv() forever. A failed op leaves the length-prefixed stream
+    desynced, so the connection is marked dead and the next attempt
+    RECONNECTS (fresh socket, clean stream; reference brpc reconnect
+    loops) before re-issuing the request.
+    """
 
     def __init__(self, host: str, port: int, worker_id: int = 0,
-                 a_sync: bool = False, flush_ms: int = 50):
+                 a_sync: bool = False, flush_ms: int = 50,
+                 retry: Optional[RetryPolicy] = None,
+                 io_timeout_s: Optional[float] = None):
         self._lib = _lib()
         self.a_sync = a_sync
-        self._h = self._lib.kvc_connect(host.encode(), int(port),
-                                        int(worker_id),
-                                        int(flush_ms) if a_sync else 0)
+        # Default policy: attempt-bounded, NOT wall-clock-bounded. Each
+        # attempt is already capped by the per-op socket deadline
+        # (FLAGS_rpc_deadline_ms); reusing that same flag as the policy
+        # deadline would let ONE hung RPC spend the whole budget and skip
+        # the reconnect-and-retry path entirely. Worker_id is folded into
+        # the jitter seed so N trainers retrying the same outage don't all
+        # back off on one identical schedule (thundering herd); jitter
+        # shifts timing only, never arithmetic.
+        if retry is None:
+            from ..flags import flag
+            retry = RetryPolicy(deadline_s=None,
+                                seed=int(flag("FLAGS_fault_seed"))
+                                + int(worker_id) * 1000003)
+        self._retry = retry
+        self._host, self._port = host, int(port)
+        self._worker_id = int(worker_id)
+        self._flush_ms = int(flush_ms) if a_sync else 0
+        if io_timeout_s is None:
+            from ..flags import flag
+            io_timeout_s = flag("FLAGS_rpc_deadline_ms") / 1000.0
+        self._io_timeout_s = float(io_timeout_s)
+        self._dead = False
+        self._h = self._lib.kvc_connect(host.encode(), self._port,
+                                        self._worker_id, self._flush_ms)
         if not self._h:
             raise ConnectionError(f"cannot reach pserver {host}:{port}")
+        if self._io_timeout_s > 0:
+            self._lib.kvc_set_io_timeout(
+                self._h, ctypes.c_double(self._io_timeout_s))
+
+    def _mark_dead(self):
+        self._dead = True
+
+    def _ensure_connected(self):
+        """Reconnect after a failed op: the failure left the request/
+        response stream desynced, so retrying on the old socket could read
+        a stale reply as its own. The native client object survives the
+        re-dial — crucially including merged-but-unsent async gradients a
+        failed flush re-buffered — only the socket is replaced. Raises
+        Unavailable (retryable) when the server is still unreachable."""
+        if not self._h:
+            raise Unavailable("pserver client %s:%d is closed",
+                              self._host, self._port)
+        if not self._dead:
+            return
+        if self._lib.kvc_reconnect(self._h) != 0:
+            raise Unavailable("reconnect to pserver %s:%d failed",
+                              self._host, self._port)
+        self._dead = False
+        stat_add("resilience.reconnects")
 
     def pull(self, table: int, keys: np.ndarray, dim: int) -> np.ndarray:
         keys = np.ascontiguousarray(keys, np.int64)
-        out = np.empty((len(keys), dim), np.float32)
-        rc = self._lib.kvc_pull(
-            self._h, table,
-            keys.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)), len(keys),
-            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), dim)
-        if rc != 0:
-            raise IOError("kv pull failed")
-        return out
+
+        def op():
+            fault_point("kv.pull")
+            self._ensure_connected()
+            out = np.empty((len(keys), dim), np.float32)
+            rc = self._lib.kvc_pull(
+                self._h, table,
+                keys.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+                len(keys),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), dim)
+            if rc != 0:
+                self._mark_dead()
+                raise Unavailable("kv pull failed (table %d, %d keys)",
+                                  table, len(keys))
+            return out
+
+        return self._retry.call(op, site="kv.pull")
 
     def push(self, table: int, keys: np.ndarray, grads: np.ndarray,
              lr: float):
         keys = np.ascontiguousarray(keys, np.int64)
         grads = np.ascontiguousarray(grads, np.float32)
-        fn = (self._lib.kvc_push_async if self.a_sync else self._lib.kvc_push)
-        rc = fn(self._h, table,
-                keys.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
-                len(keys),
-                grads.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-                grads.shape[1], float(lr))
-        if not self.a_sync and rc != 0:
-            raise IOError("kv push failed")
+
+        def op():
+            fault_point("kv.push")
+            self._ensure_connected()
+            fn = (self._lib.kvc_push_async if self.a_sync
+                  else self._lib.kvc_push)
+            rc = fn(self._h, table,
+                    keys.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+                    len(keys),
+                    grads.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    grads.shape[1], float(lr))
+            if not self.a_sync and rc != 0:
+                self._mark_dead()
+                raise Unavailable("kv push failed (table %d, %d keys)",
+                                  table, len(keys))
+
+        self._retry.call(op, site="kv.push")
 
     def push_delta(self, table: int, keys: np.ndarray, deltas: np.ndarray):
         """Geo-SGD: server applies w += delta (no lr)."""
         keys = np.ascontiguousarray(keys, np.int64)
         deltas = np.ascontiguousarray(deltas, np.float32)
-        rc = self._lib.kvc_push_delta(
-            self._h, table,
-            keys.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
-            len(keys),
-            deltas.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            deltas.shape[1])
-        if rc != 0:
-            raise IOError("kv push_delta failed")
+
+        def op():
+            fault_point("kv.push")
+            self._ensure_connected()
+            rc = self._lib.kvc_push_delta(
+                self._h, table,
+                keys.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+                len(keys),
+                deltas.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                deltas.shape[1])
+            if rc != 0:
+                self._mark_dead()
+                raise Unavailable("kv push_delta failed (table %d)", table)
+
+        self._retry.call(op, site="kv.push")
 
     def flush(self):
-        self._lib.kvc_flush(self._h)
+        def op():
+            fault_point("kv.flush")
+            self._ensure_connected()
+            if self._lib.kvc_flush(self._h) != 0:
+                # the native side re-buffered the unsent gradients, so the
+                # retried flush (post-reconnect) resends them
+                self._mark_dead()
+                raise Unavailable("kv flush failed")
 
-    def ping(self) -> bool:
-        return self._lib.kvc_ping(self._h) == 0
+        self._retry.call(op, site="kv.flush")
 
+    def ping(self, timeout_s: Optional[float] = None) -> bool:
+        """Heartbeat with an explicit deadline (default
+        FLAGS_rpc_deadline_ms): a dead-but-connected endpoint answers False
+        within the deadline instead of blocking recv() forever — the
+        round-5 'dead relay ⇒ every dial hangs' class of bug. A timed-out
+        ping poisons the connection (native side shuts the socket down), so
+        later ops fail fast rather than desync."""
+        if timeout_s is None:
+            from ..flags import flag
+            timeout_s = flag("FLAGS_rpc_deadline_ms") / 1000.0
+
+        def op():
+            fault_point("kv.ping")
+            self._ensure_connected()
+            ok = self._lib.kvc_ping_deadline(
+                self._h, ctypes.c_double(float(timeout_s))) == 0
+            if not ok:          # native side shut the socket down already;
+                self._mark_dead()  # the next op reconnects first
+            return ok
+
+        try:
+            return self._retry.call(op, site="kv.ping")
+        except DeadlineExceededError:
+            return False
+
+    # table_size/save/load must also reconnect first: after an exhausted
+    # retry budget the handle is None, and handing that to ctypes would
+    # nullptr-deref in the native client instead of raising.
     def table_size(self, table: int) -> int:
+        self._ensure_connected()
         return int(self._lib.kvc_table_size(self._h, table))
 
     def save(self, table: int, path: str):
-        assert self._lib.kvc_save(self._h, table, path.encode()) == 0
+        self._ensure_connected()
+        if self._lib.kvc_save(self._h, table, path.encode()) != 0:
+            self._mark_dead()
+            raise Unavailable("kv save failed (table %d -> %s)", table, path)
 
     def load(self, table: int, path: str):
-        assert self._lib.kvc_load(self._h, table, path.encode()) == 0
+        self._ensure_connected()
+        if self._lib.kvc_load(self._h, table, path.encode()) != 0:
+            self._mark_dead()
+            raise Unavailable("kv load failed (table %d <- %s)", table, path)
 
     def close(self):
         if self._h:
@@ -240,12 +383,21 @@ class HotRowCache:
             return None
         row, birth = ent
         if self._tick - birth > self.max_stale:
-            del self._rows[(table, key)]
+            # expired: report a miss but KEEP the entry — it is the
+            # degraded-mode fallback peek() serves when the re-pull finds
+            # the server unreachable; LRU capacity still bounds memory
             self.misses += 1
             return None
         self._rows.move_to_end((table, key))
         self.hits += 1
         return row
+
+    def peek(self, table: int, key: int):
+        """Raw entry ignoring the staleness window — the degraded-mode read
+        used when the server is unreachable within deadline (stale rows beat
+        a dead run; staleness is counted via resilience.stale_served)."""
+        ent = self._rows.get((table, key))
+        return ent[0] if ent is not None else None
 
     def put(self, table: int, key: int, row) -> None:
         self._rows[(table, key)] = (row, self._tick)
@@ -256,6 +408,9 @@ class HotRowCache:
     def invalidate(self, table: int, keys) -> None:
         for k in np.asarray(keys).reshape(-1):
             self._rows.pop((table, int(k)), None)
+
+    def clear(self) -> None:
+        self._rows.clear()
 
     @property
     def hit_rate(self) -> float:
@@ -272,13 +427,14 @@ class ShardedKVClient:
 
     def __init__(self, endpoints: List[str], worker_id: int = 0,
                  a_sync: bool = False, cache_rows: int = None,
-                 cache_max_stale: int = 16):
+                 cache_max_stale: int = 16,
+                 retry: Optional[RetryPolicy] = None):
         assert endpoints, "ShardedKVClient needs at least one endpoint"
         self.clients = []
         for ep in endpoints:
             host, port = ep.rsplit(":", 1)
             self.clients.append(KVClient(host, int(port), worker_id,
-                                         a_sync=a_sync))
+                                         a_sync=a_sync, retry=retry))
         self.a_sync = a_sync
         if cache_rows is None:
             cache_rows = int(os.environ.get("PADDLE_PS_CACHE_ROWS", "0"))
@@ -318,10 +474,26 @@ class ShardedKVClient:
             else:
                 out[i] = row
         if miss:
-            rows = self._pull_remote(table, keys[miss], dim)
+            try:
+                rows = self._pull_remote(table, keys[miss], dim)
+            except (UnavailableError, OSError) as e:
+                # degraded mode: server unreachable within the retry budget —
+                # serve expired-but-cached rows rather than kill the step
+                # (standard async-PS staleness, just a wider window; counted
+                # so operators see it happening)
+                return self._stale_rows(table, keys, miss, out, e)
             for j, i in enumerate(miss):
                 out[i] = rows[j]
                 self.cache.put(table, int(keys[i]), rows[j].copy())
+        return out
+
+    def _stale_rows(self, table, keys, miss, out, err):
+        for i in miss:
+            row = self.cache.peek(table, int(keys[i]))
+            if row is None:   # never seen this key: nothing to degrade to
+                raise err
+            out[i] = row
+        stat_add("resilience.stale_served", len(miss))
         return out
 
     def push(self, table: int, keys: np.ndarray, grads: np.ndarray,
@@ -353,11 +525,35 @@ class ShardedKVClient:
         for c in self.clients:
             c.flush()
 
-    def ping(self):
-        return all(c.ping() for c in self.clients)
+    def ping(self, timeout_s: Optional[float] = None):
+        return all(c.ping(timeout_s=timeout_s) for c in self.clients)
 
     def table_size(self, table: int) -> int:
         return sum(c.table_size(table) for c in self.clients)
+
+    def save(self, table: int, path: str) -> List[str]:
+        """Checkpoint `table` server-side; sharded deployments write one
+        `<path>.shard<i>` per endpoint. Returns the written paths (the
+        CheckpointManager puts each in the manifest)."""
+        if len(self.clients) == 1:
+            self.clients[0].save(table, path)
+            return [path]
+        paths = []
+        for i, c in enumerate(self.clients):
+            p = f"{path}.shard{i}"
+            c.save(table, p)
+            paths.append(p)
+        return paths
+
+    def load(self, table: int, path: str):
+        """Restore `table` from a save() of the same endpoint count. Cached
+        rows are dropped: they describe the pre-restore table."""
+        if self.cache is not None:
+            self.cache.clear()
+        if len(self.clients) == 1:
+            return self.clients[0].load(table, path)
+        for i, c in enumerate(self.clients):
+            c.load(table, f"{path}.shard{i}")
 
     def close(self):
         for c in self.clients:

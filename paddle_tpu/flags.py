@@ -28,6 +28,21 @@ _DEFS: Dict[str, tuple] = {
     "FLAGS_profile_start_step": (-1, "auto-start profiler at this step"),
     "FLAGS_profile_stop_step": (-1, "auto-stop profiler at this step"),
     "FLAGS_tensor_array_capacity": (128, "default LoDTensorArray capacity"),
+    # --- resilience tier (resilience/, docs/resilience.md) ---------------
+    "FLAGS_fault_plan": ("", "fault-injection plan spec, e.g. "
+                             "'kv.pull:error:every=3;ckpt.write:kill:at=2'"),
+    "FLAGS_fault_seed": (0, "seed for probabilistic (p=) fault rules and "
+                            "retry jitter"),
+    "FLAGS_retry_max_attempts": (4, "RetryPolicy default attempt budget"),
+    "FLAGS_retry_base_delay_ms": (20.0, "RetryPolicy first-backoff delay"),
+    "FLAGS_retry_max_delay_ms": (2000.0, "RetryPolicy backoff ceiling"),
+    "FLAGS_rpc_deadline_ms": (10000.0, "per-op deadline on PS RPC / gloo "
+                                       "paths; DeadlineExceeded after"),
+    "FLAGS_gloo_timeout_ms": (60000.0, "gloo rendezvous + collective-round "
+                                       "timeout"),
+    "FLAGS_dataloader_max_respawns": (0, "respawn budget for abnormally-"
+                                         "dead dataloader workers "
+                                         "(0 = fail fast, seed behavior)"),
 }
 
 _values: Dict[str, Any] = {}

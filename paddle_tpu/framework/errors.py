@@ -96,6 +96,14 @@ UnavailableError = _typed("UnavailableError", ErrorCode.UNAVAILABLE,
 FatalError = _typed("FatalError", ErrorCode.FATAL, SystemError)
 ExternalError = _typed("ExternalError", ErrorCode.EXTERNAL, OSError)
 
+class DeadlineExceededError(ExecutionTimeoutError):
+    """A retry/backoff budget (resilience.RetryPolicy) or explicit per-op
+    deadline was exhausted. Distinct from its ExecutionTimeoutError base so
+    retry loops can tell "this op timed out once" (retryable) from "the
+    whole budget is spent" (propagate). Being a TimeoutError/OSError
+    subclass, legacy `except IOError` call sites still catch it."""
+
+
 _BY_CODE = {c.code: c for c in (
     InvalidArgumentError, NotFoundError, OutOfRangeError, AlreadyExistsError,
     ResourceExhaustedError, PreconditionNotMetError, PermissionDeniedError,
@@ -130,6 +138,11 @@ Unimplemented = _factory(UnimplementedError)
 Unavailable = _factory(UnavailableError)
 Fatal = _factory(FatalError)
 External = _factory(ExternalError)
+
+
+def DeadlineExceeded(fmt, *args, op=None, var=None):
+    """Build (not raise) a DeadlineExceededError, factory-style."""
+    return DeadlineExceededError(fmt % args if args else fmt, op=op, var=var)
 
 
 def enforce(cond, err_or_msg="enforce failed"):

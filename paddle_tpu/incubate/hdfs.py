@@ -1,16 +1,29 @@
 """HDFS/AFS shell-out client (reference incubate/fleet/utils/hdfs.py:74
 HDFSClient — wraps `hadoop fs` subcommands; used by Dataset file lists and
 fleet checkpoint paths). Same surface; gracefully errors when the hadoop
-binary is absent (this build's environments usually have none)."""
+binary is absent (this build's environments usually have none).
+
+Resilience wiring: every shell-out passes the 'hdfs.run' fault site, and
+upload's retry loop is the shared resilience.RetryPolicy (backoff + jitter
++ deadline) instead of the reference's fixed-cadence retry_times loop. A
+missing hadoop binary is a permanent condition and is NOT retried."""
 from __future__ import annotations
 
 import os
 import subprocess
 from typing import List, Optional, Tuple
 
+from ..framework.errors import DeadlineExceededError
+from ..resilience import RetryPolicy
+from ..resilience.faults import FaultInjected, fault_point
+
 
 class ExecuteError(RuntimeError):
     pass
+
+
+class _TransientHdfsError(ExecuteError):
+    """A nonzero `hadoop fs` exit — retryable, unlike a missing binary."""
 
 
 class HDFSClient:
@@ -24,6 +37,7 @@ class HDFSClient:
         self._timeout_s = time_out / 1000.0
 
     def _run(self, *fs_args) -> Tuple[int, str]:
+        fault_point("hdfs.run")
         cmd = [self._hadoop, "fs", *self._conf_flags, *fs_args]
         try:
             r = subprocess.run(cmd, capture_output=True, text=True,
@@ -71,12 +85,22 @@ class HDFSClient:
     def upload(self, hdfs_path, local_path, overwrite=False, retry_times=5):
         args = ["-put"] + (["-f"] if overwrite else []) + \
             [local_path, hdfs_path]
-        last = ""
-        for _ in range(max(retry_times, 1)):
-            rc, last = self._run(*args)
-            if rc == 0:
-                return True
-        raise ExecuteError(f"hdfs upload failed: {last}")
+
+        def attempt():
+            rc, out = self._run(*args)
+            if rc != 0:
+                raise _TransientHdfsError(f"hdfs upload failed: {out}")
+            return True
+
+        # deadline_s=None: the per-attempt subprocess timeout already bounds
+        # wall time; attempts are the contract retry_times exposes
+        policy = RetryPolicy(max_attempts=max(retry_times, 1),
+                             deadline_s=None,
+                             retry_on=(_TransientHdfsError, FaultInjected))
+        try:
+            return policy.call(attempt, site="hdfs.upload")
+        except DeadlineExceededError as e:
+            raise ExecuteError(str(e.__cause__ or e)) from e
 
     def download(self, hdfs_path, local_path, overwrite=False, unzip=False):
         if overwrite and os.path.exists(local_path):

@@ -5,6 +5,14 @@ Reference counterpart: python/paddle/fluid/io.py (save/load_persistables :598,
 TPU-native: tensors serialize via numpy .npz (threaded orbax checkpointing is
 used by the higher-level paddle.distributed path); programs serialize as JSON
 descs (framework/program.py to_desc/from_desc).
+
+Crash safety (docs/resilience.md): every tensor payload is written to a
+sibling temp file and atomically os.replace()d into place — a save that
+dies mid-write (the 'ckpt.write' fault site fires right before publish)
+leaves the previous file intact, never a torn one. save_persistables also
+emits a checksum manifest that load_persistables verifies, so silent
+corruption surfaces as a typed error instead of garbage weights; versioned
+keep-N checkpoints with fallback live in resilience.CheckpointManager.
 """
 from __future__ import annotations
 
@@ -16,6 +24,7 @@ import numpy as np
 
 from .framework.program import Program, default_main_program
 from .framework.scope import global_scope
+from .resilience.faults import fault_point
 
 __all__ = ["save_persistables", "load_persistables", "save_params",
            "load_params", "save_inference_model", "load_inference_model",
@@ -30,6 +39,23 @@ def _persistable_names(program: Program, scope):
     return names
 
 
+def _atomic_savez(path: str, arrays: dict):
+    """Write an npz to `path` via temp file + fsync + atomic rename. The
+    'ckpt.write' fault fires before the rename: an injected (or real) crash
+    there leaves only the .tmp file, so the previous checkpoint survives."""
+    tmp = path + f".tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:     # open fh: np.savez must not append .npz
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    fault_point("ckpt.write")
+    os.replace(tmp, path)
+
+
+def _manifest_path(path: str) -> str:
+    return path + ".manifest.json"
+
+
 def save_persistables(executor=None, dirname=None, main_program=None,
                       filename=None):
     program = main_program or default_main_program()
@@ -38,13 +64,29 @@ def save_persistables(executor=None, dirname=None, main_program=None,
     arrays = {n: np.asarray(scope.find(n))
               for n in _persistable_names(program, scope)}
     path = os.path.join(dirname, filename or "persistables.npz")
-    np.savez(path, **arrays)
+    _atomic_savez(path, arrays)
+    from .resilience.checkpoint import write_manifest
+    write_manifest(dirname, -1, [os.path.basename(path)],
+                   manifest_name=os.path.basename(_manifest_path(path)))
     return path
 
 
 def load_persistables(executor=None, dirname=None, main_program=None,
                       filename=None):
     path = os.path.join(dirname, filename or "persistables.npz")
+    mpath = _manifest_path(path)
+    if os.path.exists(mpath):     # legacy checkpoints carry no manifest
+        from .framework.errors import PreconditionNotMet
+        from .resilience.checkpoint import validate_manifest
+        if validate_manifest(dirname,
+                             manifest_name=os.path.basename(mpath)) is None:
+            raise PreconditionNotMet(
+                "checkpoint %s fails its manifest checksum — corrupted or "
+                "torn data/manifest, or a save crashed between publishing "
+                "the data file and its manifest (two flat files cannot "
+                "publish atomically together; for real crash-tolerance use "
+                "resilience.CheckpointManager, whose directory checkpoints "
+                "publish in one rename and fall back automatically)", path)
     scope = global_scope()
     with np.load(path) as data:
         for n in data.files:
@@ -57,15 +99,21 @@ load_params = load_persistables
 
 def save(program: Optional[Program] = None, model_path: str = "model"):
     """Whole-model save: program desc JSON + persistables npz
-    (reference io.py:1669 save)."""
+    (reference io.py:1669 save). Each file publishes atomically; a crash
+    between the two renames can still pair a new desc with old params —
+    use resilience.CheckpointManager when that window matters."""
     program = program or default_main_program()
     os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
-    with open(model_path + ".pdmodel", "w") as f:
+    dtmp = model_path + f".pdmodel.tmp.{os.getpid()}"
+    with open(dtmp, "w") as f:
         json.dump(program.to_desc(), f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(dtmp, model_path + ".pdmodel")
     scope = global_scope()
     arrays = {n: np.asarray(scope.find(n))
               for n in _persistable_names(program, scope)}
-    np.savez(model_path + ".pdparams", **arrays)
+    _atomic_savez(model_path + ".pdparams", arrays)
 
 
 def load(program: Optional[Program] = None, model_path: str = "model"):

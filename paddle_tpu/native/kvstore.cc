@@ -32,8 +32,12 @@
 //            GeoCommunicator; trainers train locally, send deltas)
 
 #include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
 #include <netinet/in.h>
+#include <poll.h>
 #include <sys/socket.h>
+#include <sys/time.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -417,17 +421,26 @@ class KVServer {
 class KVClient {
  public:
   KVClient(const char* host, int port, int worker_id, int flush_ms)
-      : worker_id_(worker_id), flush_ms_(flush_ms) {
-    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
-    sockaddr_in addr{};
-    addr.sin_family = AF_INET;
-    addr.sin_port = htons((uint16_t)port);
-    inet_pton(AF_INET, host, &addr.sin_addr);
-    ok_ = ::connect(fd_, (sockaddr*)&addr, sizeof(addr)) == 0;
+      : host_(host), port_(port), worker_id_(worker_id), flush_ms_(flush_ms) {
+    fd_ = Dial();
+    ok_ = fd_ >= 0;
     if (ok_ && flush_ms_ > 0) {
       async_running_.store(true);
       flusher_ = std::thread([this] { FlushLoop(); });
     }
+  }
+
+  // Re-dial the server on the SAME client object: the merged-but-unsent
+  // async gradient buffer, flush thread, worker id, and io timeout all
+  // survive — only the (desynced) socket is replaced. io_mu_ serializes
+  // against in-flight ops and the background flusher.
+  bool Reconnect() {
+    std::lock_guard<std::mutex> lk(io_mu_);
+    if (fd_ >= 0) ::close(fd_);
+    fd_ = Dial();
+    if (fd_ < 0) return false;
+    if (io_timeout_s_ > 0) SetIoTimeout(io_timeout_s_);
+    return true;
   }
 
   ~KVClient() { Close(); }
@@ -488,12 +501,21 @@ class KVClient {
     }
   }
 
-  void FlushNow() {
+  // Returns false if any table's push failed. Failed gradients are merged
+  // BACK into the buffer for the retried flush to resend — at-least-once,
+  // same as the sync push path (a timeout after SendAll may mean the
+  // server applied them and only the ack was lost). The socket is also
+  // shut down on failure: the reply stream is desynced, and the
+  // timer-driven FlushLoop would otherwise re-send on it every flush_ms
+  // and read the stale ack as the new push's reply. After shutdown every
+  // sender fails fast until the Python side reconnects.
+  bool FlushNow() {
     std::map<uint32_t, Buffer> drained;
     {
       std::lock_guard<std::mutex> lk(buf_mu_);
       drained.swap(buffer_);
     }
+    bool ok = true;
     for (auto& kv : drained) {
       auto& b = kv.second;
       if (b.grads.empty()) continue;
@@ -505,20 +527,72 @@ class KVClient {
         keys.push_back(g.first);
         grads.insert(grads.end(), g.second.begin(), g.second.end());
       }
-      std::lock_guard<std::mutex> lk(io_mu_);
-      PushLocked(kv.first, keys.data(), keys.size(), grads.data(), b.dim,
-                 b.lr);
+      bool sent;
+      {
+        std::lock_guard<std::mutex> lk(io_mu_);
+        sent = PushLocked(kv.first, keys.data(), keys.size(), grads.data(),
+                          b.dim, b.lr);
+      }
+      if (!sent) {
+        ok = false;
+        {
+          std::lock_guard<std::mutex> lk(io_mu_);
+          ::shutdown(fd_, SHUT_RDWR);
+        }
+        std::lock_guard<std::mutex> lk(buf_mu_);
+        auto& tb = buffer_[kv.first];
+        tb.dim = b.dim;
+        tb.lr = b.lr;
+        for (auto& g : b.grads) {
+          auto& acc = tb.grads[g.first];
+          if (acc.empty()) {
+            acc = std::move(g.second);
+          } else {
+            for (size_t j = 0; j < acc.size(); ++j) acc[j] += g.second[j];
+          }
+        }
+      }
     }
+    return ok;
   }
 
-  bool Ping() {
+  bool Ping() { return PingDeadline(0.0); }
+
+  // Persistent per-recv/send deadline for EVERY op on this connection
+  // (pull/push/flush/save/load, not just ping): a hung-but-connected
+  // server makes the op fail within the deadline instead of parking the
+  // trainer in RecvAll forever. Per-syscall, so a large transfer that IS
+  // making progress never trips it. A failed op leaves the stream
+  // desynced — the Python side reconnects before retrying.
+  void SetDefaultIoTimeout(double seconds) {
     std::lock_guard<std::mutex> lk(io_mu_);
-    if (!Send(3, 0, 0, 0)) return false;
-    uint32_t wid = (uint32_t)worker_id_;
-    if (!SendAll(fd_, &wid, 4)) return false;
-    uint64_t nb;
-    uint8_t ok;
-    return RecvAll(fd_, &nb, 8) && RecvAll(fd_, &ok, 1) && ok == 1;
+    io_timeout_s_ = seconds;
+    SetIoTimeout(seconds);
+  }
+
+  // Heartbeat with an explicit deadline: SO_SNDTIMEO/SO_RCVTIMEO bound the
+  // whole round-trip, so a dead-but-connected endpoint (the round-5 "dead
+  // relay" failure) answers false in timeout_s instead of blocking forever.
+  // A timed-out ping leaves the request/response stream desynced, so the
+  // socket is shut down — later ops fail fast rather than read a stale
+  // ping reply as their own response.
+  bool PingDeadline(double timeout_s) {
+    std::lock_guard<std::mutex> lk(io_mu_);
+    if (timeout_s > 0) SetIoTimeout(timeout_s);
+    bool ok = false;
+    do {
+      if (!Send(3, 0, 0, 0)) break;
+      uint32_t wid = (uint32_t)worker_id_;
+      if (!SendAll(fd_, &wid, 4)) break;
+      uint64_t nb;
+      uint8_t r;
+      ok = RecvAll(fd_, &nb, 8) && RecvAll(fd_, &r, 1) && r == 1;
+    } while (false);
+    if (timeout_s > 0) {
+      SetIoTimeout(io_timeout_s_);  // back to the connection default
+      if (!ok) ::shutdown(fd_, SHUT_RDWR);
+    }
+    return ok;
   }
 
   uint64_t TableSize(uint32_t table) {
@@ -546,6 +620,53 @@ class KVClient {
     float lr = 0.0f;
     std::map<int64_t, std::vector<float>> grads;
   };
+
+  // Non-blocking connect bounded by the io timeout: a black-holed server
+  // (SYNs dropped — the "dead relay" failure) must fail the dial within
+  // the deadline, not the kernel's multi-minute TCP connect timeout.
+  // Reconnect() holds io_mu_ while dialing, so an unbounded connect here
+  // would also freeze the background flush thread.
+  int Dial() {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)port_);
+    inet_pton(AF_INET, host_.c_str(), &addr.sin_addr);
+    double t = io_timeout_s_ > 0 ? io_timeout_s_ : 30.0;
+    int fl = fcntl(fd, F_GETFL, 0);
+    fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+    int rc = ::connect(fd, (sockaddr*)&addr, sizeof(addr));
+    if (rc != 0) {
+      if (errno != EINPROGRESS) {
+        ::close(fd);
+        return -1;
+      }
+      pollfd pf{fd, POLLOUT, 0};
+      if (::poll(&pf, 1, (int)(t * 1000)) != 1) {
+        ::close(fd);
+        return -1;
+      }
+      int err = 0;
+      socklen_t len = sizeof(err);
+      if (getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len) != 0 ||
+          err != 0) {
+        ::close(fd);
+        return -1;
+      }
+    }
+    fcntl(fd, F_SETFL, fl);
+    return fd;
+  }
+
+  void SetIoTimeout(double seconds) {
+    timeval tv{};
+    tv.tv_sec = (time_t)seconds;
+    tv.tv_usec = (suseconds_t)((seconds - (double)tv.tv_sec) * 1e6);
+    setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd_, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  }
+
+  double io_timeout_s_ = 0.0;
 
   bool Send(uint8_t op, uint32_t table, uint64_t n, uint32_t dim) {
     struct __attribute__((packed)) {
@@ -578,6 +699,8 @@ class KVClient {
   }
 
   int fd_ = -1;
+  std::string host_;
+  int port_ = 0;
   int worker_id_;
   int flush_ms_;
   std::mutex io_mu_, buf_mu_, flush_mu_;
@@ -647,9 +770,21 @@ void kvc_push_async(void* c, unsigned table, const long long* keys,
                                        (uint64_t)n, grads, dim, lr);
 }
 
-void kvc_flush(void* c) { static_cast<KVClient*>(c)->FlushNow(); }
+int kvc_flush(void* c) { return static_cast<KVClient*>(c)->FlushNow() ? 0 : -1; }
 
 int kvc_ping(void* c) { return static_cast<KVClient*>(c)->Ping() ? 0 : -1; }
+
+int kvc_reconnect(void* c) {
+  return static_cast<KVClient*>(c)->Reconnect() ? 0 : -1;
+}
+
+int kvc_ping_deadline(void* c, double timeout_s) {
+  return static_cast<KVClient*>(c)->PingDeadline(timeout_s) ? 0 : -1;
+}
+
+void kvc_set_io_timeout(void* c, double timeout_s) {
+  static_cast<KVClient*>(c)->SetDefaultIoTimeout(timeout_s);
+}
 
 long long kvc_table_size(void* c, unsigned table) {
   return (long long)static_cast<KVClient*>(c)->TableSize(table);

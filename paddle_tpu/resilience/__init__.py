@@ -1,0 +1,26 @@
+"""Cross-cutting fault-tolerance subsystem (docs/resilience.md).
+
+Three legs, wired into distributed/ps.py, distributed/gloo.py,
+dataloader/dataloader.py, io.py and incubate/hdfs.py:
+
+  faults      deterministic seedable fault injection (FaultPlan +
+              fault_point sites) so every recovery path is testable on CPU
+  retry       one RetryPolicy (backoff + jitter + deadline + max-attempts)
+              replacing the ad-hoc timeouts; exhaustion raises the typed
+              DeadlineExceededError instead of hanging
+  checkpoint  crash-safe CheckpointManager: temp dir + checksummed manifest
+              + atomic rename + keep-N + fallback-to-last-complete
+"""
+from .faults import (FaultPlan, FaultRule, FaultInjected, fault_point,
+                     install_plan, clear_plan, current_plan)
+from .retry import RetryPolicy, DEFAULT_RETRYABLE
+from .checkpoint import (CheckpointManager, validate_manifest,
+                         write_manifest, sha256_file)
+
+__all__ = [
+    "FaultPlan", "FaultRule", "FaultInjected", "fault_point",
+    "install_plan", "clear_plan", "current_plan",
+    "RetryPolicy", "DEFAULT_RETRYABLE",
+    "CheckpointManager", "validate_manifest", "write_manifest",
+    "sha256_file",
+]

@@ -1,0 +1,215 @@
+"""Crash-safe checkpointing: temp dir + checksummed manifest + atomic rename.
+
+Reference counterpart: incubate/checkpoint/checkpoint_saver.py versioned
+dirs + fleet's HDFS _DONE markers. Hardened here: a checkpoint is a
+directory `ckpt_<step>/` that becomes visible ONLY via an atomic
+os.replace() of a fully-written temp dir, and it is trusted ONLY if its
+MANIFEST.json validates (every listed file present with a matching sha256).
+A crash mid-save therefore leaves a `.tmp` dir that loaders never look at;
+a torn/corrupted checkpoint fails validation and restore falls back to the
+newest older complete one (counted in `resilience.ckpt_fallbacks`).
+
+Manifest format (docs/resilience.md):
+
+    {"format": 1, "step": <int>,
+     "files": {"params.npz": {"sha256": "<hex>", "bytes": <int>}, ...}}
+
+Dense persistables go to params.npz; sparse PS tables (when a client is
+passed) go to table_<i>.bin via the server's SAVE op — both covered by the
+manifest.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from ..monitor import stat_add
+from .faults import fault_point
+
+MANIFEST = "MANIFEST.json"
+PARAMS_FILE = "params.npz"
+
+
+def sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def write_manifest(dirname: str, step: int, filenames: Sequence[str],
+                   manifest_name: str = MANIFEST):
+    files = {}
+    for name in filenames:
+        p = os.path.join(dirname, name)
+        files[name] = {"sha256": sha256_file(p),
+                       "bytes": os.path.getsize(p)}
+    payload = {"format": 1, "step": int(step), "files": files}
+    tmp = os.path.join(dirname, manifest_name + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())   # rename durability without content
+                               # durability would publish a torn manifest
+    os.replace(tmp, os.path.join(dirname, manifest_name))
+
+
+def validate_manifest(dirname: str,
+                      manifest_name: str = MANIFEST) -> Optional[dict]:
+    """The parsed manifest when every listed file checks out, else None."""
+    mpath = os.path.join(dirname, manifest_name)
+    try:
+        with open(mpath) as f:
+            payload = json.load(f)
+        for name, meta in payload.get("files", {}).items():
+            p = os.path.join(dirname, name)
+            if not os.path.exists(p):
+                return None
+            if os.path.getsize(p) != meta["bytes"]:
+                return None
+            if sha256_file(p) != meta["sha256"]:
+                return None
+        return payload
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+def _collect_persistables(program=None, scope=None) -> Dict[str, np.ndarray]:
+    from ..framework.program import default_main_program
+    from ..framework.scope import global_scope
+    program = program or default_main_program()
+    scope = scope or global_scope()
+    out = {}
+    for v in program.list_vars():
+        if v.persistable and scope.has(v.name):
+            out[v.name] = np.asarray(scope.find(v.name))
+    return out
+
+
+class CheckpointManager:
+    """Keeps the newest `max_keep` complete checkpoints under `root`, each
+    tagged with the global step so a crashed run resumes mid-run:
+
+        mgr = CheckpointManager(workdir, max_keep=3)
+        ...
+        mgr.save(step, sparse_client=client, sparse_tables=[0])
+        # after a crash/restart:
+        step = mgr.restore_latest(sparse_client=client, sparse_tables=[0])
+        start = 0 if step is None else step + 1
+    """
+
+    def __init__(self, root: str, max_keep: int = 3):
+        self.root = root
+        self.max_keep = int(max_keep)
+        os.makedirs(root, exist_ok=True)
+
+    # -- introspection ------------------------------------------------------
+    def steps(self):
+        """Published checkpoint steps, oldest first (validation deferred to
+        restore; publishing is atomic so these are at least fully renamed)."""
+        out = []
+        for d in os.listdir(self.root):
+            if d.startswith("ckpt_") and d[5:].isdigit():
+                out.append(int(d[5:]))
+        return sorted(out)
+
+    def path(self, step: int) -> str:
+        return os.path.join(self.root, f"ckpt_{step}")
+
+    # -- save ---------------------------------------------------------------
+    def save(self, step: int, arrays: Optional[Dict[str, np.ndarray]] = None,
+             program=None, scope=None, sparse_client=None,
+             sparse_tables: Sequence[int] = ()) -> str:
+        """Write checkpoint `step`. Order of operations is the crash-safety
+        contract: data files -> fault_point('ckpt.write') -> manifest ->
+        atomic publish. A crash anywhere before the final os.replace leaves
+        only a .tmp dir, which restore ignores."""
+        if arrays is None:
+            arrays = _collect_persistables(program, scope)
+        final = self.path(step)
+        tmp = final + f".tmp.{os.getpid()}"
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        names = [PARAMS_FILE]
+        with open(os.path.join(tmp, PARAMS_FILE), "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        for t in sparse_tables:
+            name = f"table_{int(t)}.bin"
+            written = sparse_client.save(int(t), os.path.join(tmp, name))
+            if isinstance(written, (list, tuple)):   # sharded client: one
+                names.extend(os.path.basename(p) for p in written)  # file/shard
+            else:
+                names.append(name)
+        fault_point("ckpt.write")
+        write_manifest(tmp, step, names)
+        old = None
+        if os.path.exists(final):      # re-save of the same step: move the
+            old = final + f".old.{os.getpid()}"   # published dir aside
+            shutil.rmtree(old, ignore_errors=True)  # rather than rmtree it,
+            os.replace(final, old)     # so a crash here never destroys the
+        os.replace(tmp, final)         # only copy of a complete checkpoint
+        if old is not None:
+            shutil.rmtree(old, ignore_errors=True)
+        self._prune()
+        return final
+
+    def _prune(self):
+        for s in self.steps()[:-self.max_keep]:
+            shutil.rmtree(self.path(s), ignore_errors=True)
+        # stale temp/displaced dirs from CRASHED saves only: the
+        # .tmp.<pid> / .old.<pid> suffix names the writer, so skip dirs
+        # whose owner is still running — another live process sharing
+        # this root may be mid-save
+        for d in os.listdir(self.root):
+            _, sep, pid = d.rpartition(".tmp.")
+            if not sep:
+                _, sep, pid = d.rpartition(".old.")
+            if not sep:
+                continue
+            if pid.isdigit() and pid != str(os.getpid()):
+                try:
+                    os.kill(int(pid), 0)
+                    continue          # owner alive: not ours to clean
+                except ProcessLookupError:
+                    pass              # owner gone: crashed save, reap it
+                except OSError:
+                    continue          # can't tell (EPERM): leave it
+            shutil.rmtree(os.path.join(self.root, d), ignore_errors=True)
+
+    # -- restore ------------------------------------------------------------
+    def load_arrays(self, step: int) -> Dict[str, np.ndarray]:
+        with np.load(os.path.join(self.path(step), PARAMS_FILE)) as data:
+            return {n: data[n] for n in data.files}
+
+    def restore_latest(self, program=None, scope=None, sparse_client=None,
+                       sparse_tables: Sequence[int] = ()) -> Optional[int]:
+        """Restore the newest VALID checkpoint into the scope (and sparse
+        tables); invalid/torn ones are skipped (resilience.ckpt_fallbacks)
+        and the next older complete one is used. Returns the restored step,
+        or None when no complete checkpoint exists."""
+        from ..framework.scope import global_scope
+        scope = scope or global_scope()
+        for step in reversed(self.steps()):
+            payload = validate_manifest(self.path(step))
+            if payload is None:
+                stat_add("resilience.ckpt_fallbacks")
+                continue
+            for n, arr in self.load_arrays(step).items():
+                scope.set(n, arr)
+            for t in sparse_tables:
+                sparse_client.load(
+                    int(t), os.path.join(self.path(step),
+                                         f"table_{int(t)}.bin"))
+            return int(payload.get("step", step))
+        return None

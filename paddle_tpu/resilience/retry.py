@@ -1,0 +1,120 @@
+"""RetryPolicy: one exponential-backoff/jitter/deadline policy for every
+transient-failure path (PS RPCs, gloo rendezvous, hdfs shell-outs).
+
+Reference counterparts: the brpc client's bounded reconnect loops
+(grpc/brpc_client.cc retry-on-EAGAIN), communicator send retries, and the
+HDFSClient retry_times loops — each ad hoc in the reference; one typed
+policy here. Exhausting the policy raises DeadlineExceededError (a
+TimeoutError/IOError subclass, so legacy `except IOError` call sites still
+catch hard failures) instead of hanging — the round-5 "dead relay ⇒ every
+dial hangs forever" class of bug.
+
+Stats (monitor.py): `resilience.retries` per retried attempt,
+`resilience.gave_up` per policy exhaustion.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Tuple
+
+from ..framework.errors import (DeadlineExceededError, DeadlineExceeded,
+                                UnavailableError)
+from ..monitor import stat_add
+from .faults import _hash01
+
+# Transient by default: socket/IO errors and the typed "service not
+# reachable right now" (FaultInjected subclasses UnavailableError).
+DEFAULT_RETRYABLE: Tuple[type, ...] = (OSError, ConnectionError,
+                                       UnavailableError)
+
+
+def _flag_default(name: str, scale: float = 1.0):
+    from ..flags import flag
+    return flag(name) * scale
+
+
+class RetryPolicy:
+    """Exponential backoff + deterministic jitter + deadline + max-attempts.
+
+    delay(attempt) = min(max_delay, base * multiplier**attempt)
+                     * (1 + jitter * (2u - 1)),  u = hash01(seed, attempt)
+
+    Jitter is hashed, not drawn from global RNG state: a retried run
+    schedules the same sleeps every time, keeping chaos runs reproducible.
+    `max_attempts=None` means unbounded (the deadline is then the only
+    bound); `deadline_s=None` means no wall-clock bound.
+    """
+
+    def __init__(self, max_attempts: Optional[int] = -1,
+                 base_delay_s: float = None, max_delay_s: float = None,
+                 multiplier: float = 2.0, jitter: float = 0.25,
+                 deadline_s: float = -1.0,
+                 retry_on: Tuple[type, ...] = None,
+                 seed: Optional[int] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        # -1 sentinels -> flag defaults (None stays None = unbounded)
+        if max_attempts == -1:
+            max_attempts = int(_flag_default("FLAGS_retry_max_attempts"))
+        if seed is None:   # the flag's help text promises it seeds jitter
+            seed = int(_flag_default("FLAGS_fault_seed"))
+        if base_delay_s is None:
+            base_delay_s = _flag_default("FLAGS_retry_base_delay_ms", 1e-3)
+        if max_delay_s is None:
+            max_delay_s = _flag_default("FLAGS_retry_max_delay_ms", 1e-3)
+        if deadline_s == -1.0:
+            deadline_s = _flag_default("FLAGS_rpc_deadline_ms", 1e-3)
+        self.max_attempts = max_attempts
+        self.base_delay_s = float(base_delay_s)
+        self.max_delay_s = float(max_delay_s)
+        self.multiplier = float(multiplier)
+        self.jitter = float(jitter)
+        self.deadline_s = deadline_s
+        self.retry_on = retry_on or DEFAULT_RETRYABLE
+        self.seed = int(seed)
+        self._sleep = sleep
+
+    def backoff(self, attempt: int) -> float:
+        """Delay before retry number `attempt` (0-based)."""
+        d = min(self.max_delay_s,
+                self.base_delay_s * (self.multiplier ** attempt))
+        u = _hash01(self.seed, "backoff", attempt)
+        return max(0.0, d * (1.0 + self.jitter * (2.0 * u - 1.0)))
+
+    def call(self, fn: Callable, *args, site: str = "?", **kwargs):
+        """Run fn(*args, **kwargs), retrying transient failures under the
+        policy. Raises DeadlineExceededError (chaining the last real error)
+        on exhaustion; non-retryable exceptions propagate untouched."""
+        start = time.monotonic()
+        attempt = 0
+        while True:
+            try:
+                return fn(*args, **kwargs)
+            except DeadlineExceededError:
+                raise              # a nested policy already gave up
+            except self.retry_on as e:
+                attempt += 1
+                elapsed = time.monotonic() - start
+                out_of_attempts = (self.max_attempts is not None
+                                   and attempt >= self.max_attempts)
+                out_of_time = (self.deadline_s is not None
+                               and elapsed >= self.deadline_s)
+                if out_of_attempts or out_of_time:
+                    stat_add("resilience.gave_up")
+                    raise DeadlineExceeded(
+                        "%s: gave up after %d attempt(s) / %.2fs (%s); "
+                        "last error: %r", site, attempt, elapsed,
+                        "deadline" if out_of_time else "max_attempts",
+                        e) from e
+                stat_add("resilience.retries")
+                delay = self.backoff(attempt - 1)
+                if self.deadline_s is not None:
+                    delay = min(delay,
+                                max(0.0, self.deadline_s - elapsed))
+                if delay > 0:
+                    self._sleep(delay)
+
+    def wrap(self, fn: Callable, site: str = "?") -> Callable:
+        def wrapped(*args, **kwargs):
+            return self.call(fn, *args, site=site, **kwargs)
+        wrapped.__name__ = getattr(fn, "__name__", site)
+        return wrapped

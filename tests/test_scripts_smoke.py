@@ -29,6 +29,15 @@ def test_flash_sweep_help():
     assert "--grid" in r.stdout
 
 
+def test_chaos_smoke_help():
+    r = subprocess.run([sys.executable,
+                        os.path.join(ROOT, "scripts", "chaos_smoke.py"),
+                        "--help"], capture_output=True, text=True,
+                       timeout=120)
+    assert r.returncode == 0, r.stderr[-300:]
+    assert "--pull-error-p" in r.stdout
+
+
 def test_ci_driver_help():
     r = subprocess.run([sys.executable,
                         os.path.join(ROOT, "scripts", "ci.py"), "--help"],
