@@ -118,6 +118,12 @@ def _device_feed(feed):
     return {k: jax.device_put(v) for k, v in feed.items()}
 
 
+def _layer_scan_enabled():
+    """PADDLE_TPU_LAYER_SCAN=1: run the transformer benches with the
+    rolled-layer step program (parallel/transforms.apply_layer_scan)."""
+    return os.environ.get("PADDLE_TPU_LAYER_SCAN", "0") == "1"
+
+
 def _log(msg):
     print(f"[bench +{time.perf_counter() - _T0:.1f}s] {msg}",
           file=sys.stderr, flush=True)
@@ -182,6 +188,10 @@ def bench_bert(batch, seq_len, steps, masked=False, large=False,
     fleet.init(is_collective=True)
     strategy = fleet.DistributedStrategy()
     strategy.amp = True              # bf16 matmuls on the MXU
+    # PADDLE_TPU_LAYER_SCAN=1 rolls the 12/24 isomorphic encoder layers
+    # into ONE lax.scan over [L]-stacked weights (~L x smaller step HLO,
+    # ~L x faster trace+compile) — the A/B toggle for the primary metric
+    strategy.layer_scan = _layer_scan_enabled()
     if recompute:
         strategy.recompute = True
         strategy.recompute_configs = {
@@ -235,6 +245,7 @@ def bench_gpt(batch, seq_len, steps):
     fleet.init(is_collective=True)
     strategy = fleet.DistributedStrategy()
     strategy.amp = True
+    strategy.layer_scan = _layer_scan_enabled()
     opt = fleet.distributed_optimizer(
         paddle.optimizer.Adam(learning_rate=1e-4), strategy)
     opt.minimize(loss)
@@ -787,6 +798,10 @@ def main():
         "mfu": round(mfu, 4) if mfu is not None else None,
         "extras": extras,
     }
+    if _layer_scan_enabled():
+        # stamp the A/B arm: numbers recorded under the rolled-layer step
+        # program are a different configuration, not a baseline drift
+        rec["layer_scan"] = True
     if skipped_rows:
         rec["skipped_rows"] = skipped_rows
     if health_tflops is not None:

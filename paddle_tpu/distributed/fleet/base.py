@@ -83,6 +83,14 @@ class DistributedStrategy:
         "init_loss_scaling": 32768.0, "use_pure_bf16": True})
     recompute: bool = False
     recompute_configs: dict = field(default_factory=lambda: {"checkpoints": []})
+    # Rolled-layer programs: roll the model's N isomorphic per-layer op
+    # segments into ONE lax.scan over [L]-stacked weights — ~L x smaller
+    # step HLO and ~L x faster trace+compile (apply_layer_scan,
+    # parallel/transforms.py; docs/perf_notes.md "Rolled-layer programs").
+    # Segments default to the model's `loss._layer_checkpoints` annotation;
+    # non-isomorphic segments fall back to the unrolled program.
+    layer_scan: bool = False
+    layer_scan_configs: dict = field(default_factory=lambda: {"segments": []})
     gradient_merge: bool = False
     gradient_merge_configs: dict = field(default_factory=lambda: {"k_steps": 1})
     # LocalSGD: k local steps on per-replica parameter copies, then a dp-axis
@@ -272,6 +280,39 @@ class _Fleet:
             self._kv_client = None
 
 
+def _warn_tp_fused_head(program, strategy):
+    """Build-then-init ordering hole of the model builders' fused-head
+    auto-gate (models/bert.py `_tp_vocab_shards_head`): when the program
+    was BUILT before the tp mesh existed, an AUTO-selected
+    fused_lm_head_ce can reach minimize with tp rules that vocab-shard
+    its weight — the chunked scan then makes GSPMD regather the sharded
+    weight per chunk (tests/test_fused_ce.py collective audit). Warn
+    loudly with the fix; a user-forced fused head carries no
+    `auto_selected` attr and is respected silently."""
+    rules = strategy.tensor_parallel_rules
+    if rules is None:
+        return
+    for op in program.global_block().ops:
+        if op.type != "fused_lm_head_ce" \
+                or not op.attrs.get("auto_selected"):
+            continue
+        w = (op.inputs.get("W") or [None])[0]
+        if w is None:
+            continue
+        vdim = 1 if op.attrs.get("w_layout", "vh") == "hv" else 0
+        spec = list(rules.spec_for(w))
+        ax = spec[vdim] if vdim < len(spec) else None
+        if ax == "tp" or (isinstance(ax, (tuple, list)) and "tp" in ax):
+            import warnings
+            warnings.warn(
+                f"auto-selected fused_lm_head_ce uses weight {w!r} that the "
+                "tensor-parallel rules vocab-shard: the chunked scan will "
+                "make GSPMD regather the sharded weight per chunk, undoing "
+                "the vocab-parallel head. Build the model AFTER "
+                "fleet.init(strategy) so the auto-select sees the tp mesh, "
+                "or force fused_mlm_head/fused_head=False.")
+
+
 class DistributedOptimizer:
     """Applies the strategy as program transforms then delegates to the inner
     optimizer. Mirrors StrategyCompiler.generate_optimizer chaining
@@ -310,9 +351,35 @@ class DistributedOptimizer:
                                   else "float16")
             program.bump_version()
 
+        if s.tensor_parallel_degree > 1:
+            _warn_tp_fused_head(program, s)
+
+        # layer scan runs BEFORE recompute: the roll consumes the interior
+        # layer boundaries, and remat-per-layer becomes remat-of-the-scan-
+        # body (the standard JAX pairing) instead of per-layer __segment__s
+        rolled = None
+        from ...flags import flag
+        if s.layer_scan or flag("FLAGS_layer_scan"):
+            segs = ((s.layer_scan_configs or {}).get("segments")
+                    or getattr(loss, "_layer_checkpoints", None) or [])
+            if segs:
+                from ...framework.program import default_startup_program
+                from ...parallel.transforms import apply_layer_scan
+                rolled = apply_layer_scan(
+                    program, segs, remat=bool(s.recompute),
+                    startup_program=startup_program
+                    or default_startup_program())
+
         if s.recompute and s.recompute_configs.get("checkpoints"):
             from ...parallel.transforms import apply_recompute
-            apply_recompute(program, s.recompute_configs["checkpoints"])
+            ck = s.recompute_configs["checkpoints"]
+            if rolled:
+                consumed = set(rolled)
+                ck = [c for c in ck
+                      if (c.name if hasattr(c, "name") else str(c))
+                      not in consumed]
+            if ck:
+                apply_recompute(program, ck)
 
         if s.gradient_merge and s.gradient_merge_configs.get("k_steps", 1) > 1:
             from ...parallel.transforms import GradientMergeWrapper

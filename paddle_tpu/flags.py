@@ -28,6 +28,11 @@ _DEFS: Dict[str, tuple] = {
     "FLAGS_profile_start_step": (-1, "auto-start profiler at this step"),
     "FLAGS_profile_stop_step": (-1, "auto-stop profiler at this step"),
     "FLAGS_tensor_array_capacity": (128, "default LoDTensorArray capacity"),
+    "FLAGS_layer_scan": (False, "roll isomorphic per-layer segments into "
+                                "one lax.scan at fleet minimize time "
+                                "(parallel/transforms.apply_layer_scan; "
+                                "same switch as DistributedStrategy."
+                                "layer_scan)"),
     # --- resilience tier (resilience/, docs/resilience.md) ---------------
     "FLAGS_fault_plan": ("", "fault-injection plan spec, e.g. "
                              "'kv.pull:error:every=3;ckpt.write:kill:at=2'"),

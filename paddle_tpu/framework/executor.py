@@ -476,10 +476,18 @@ def _amp_cast(op, ins, low_dtype):
     ops in f32 (reference contrib/mixed_precision/fp16_utils.py cast
     insertion — here done at lowering time, zero extra graph ops). Grad ops
     (__vjp__) re-derive the policy from their wrapped forward type."""
-    import jax.numpy as jnp
-    from ..amp.auto_cast import white_list, black_list, keep_f32_slots
     op_type = op.attrs.get("fwd_type", op.type) if op.type == "__vjp__" \
         else op.type
+    return _amp_cast_ins(op_type, ins, low_dtype)
+
+
+def _amp_cast_ins(op_type, ins, low_dtype):
+    """AMP cast core keyed by resolved forward op type — shared with the
+    fused sub-graph lowerings (__segment__/__layer_scan__,
+    parallel/transforms.py), whose inner ops must see the same casts the
+    top-level op loop applies."""
+    import jax.numpy as jnp
+    from ..amp.auto_cast import white_list, black_list, keep_f32_slots
     if op_type in white_list:
         target = low_dtype
     elif op_type in black_list:
@@ -536,6 +544,28 @@ def _coerce_feed_value(block, name, value):
     return arr
 
 
+def _ensure_stacked_params(program, scope):
+    """Scope round-trip for rolled-layer programs (apply_layer_scan,
+    parallel/transforms.py): whenever all of a stack's per-layer source
+    entries are present in the scope — an un-transformed startup program
+    ran, or an UNROLLED checkpoint was just loaded — restack them under
+    the `<name>@LAYERS` entry the program reads and drop the per-layer
+    copies (they are stale the moment training writes the stack). Loaded
+    per-layer values therefore always win over a previously stacked
+    value, which is what makes old checkpoints load into rolled
+    programs."""
+    stacks = getattr(program, "_layer_stacks", None)
+    if not stacks:
+        return
+    import jax.numpy as jnp
+    for sname, parts in stacks.items():
+        if parts and all(scope.has(p) for p in parts):
+            scope.set(sname, jnp.stack([jnp.asarray(scope.find(p))
+                                        for p in parts]))
+            for p in parts:
+                scope.erase(p)
+
+
 def _referenced_state_names(block, scope, feed_vals):
     """Persistable vars that already have values in the scope and are
     referenced by this block (run()/run_steps() shared)."""
@@ -548,6 +578,38 @@ def _referenced_state_names(block, scope, feed_vals):
         if n != "@EMPTY@"
         and (v := block.find_var_recursive(n)) is not None
         and v.persistable and scope.has(n) and n not in feed_vals)
+
+
+def _block_cache_key(program, feed_vals, fetch_names, state_names):
+    """The ONE compile-cache key shape shared by run()/run_steps()/
+    compiled_hlo() — they must agree byte-for-byte or compiled_hlo would
+    audit a different block than run() executes."""
+    feed_spec = tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                             for k, v in feed_vals.items()))
+    return (program._uid, program._version, feed_spec, tuple(fetch_names),
+            tuple(state_names))
+
+
+def _prewarm_flash_ops(program):
+    """Flash-kernel availability must be probed EAGERLY, before any block
+    class jit-traces (ops/attention.py); one shared choke point so the
+    LocalSGD/pipeline paths get it too."""
+    if any(op.type == "fused_attention"
+           for b in program.blocks for op in b.ops):
+        from ..ops.attention import prewarm_flash
+        prewarm_flash(program)
+
+
+def _make_compiled_block(program, feed_vals, fetch_names, state_names,
+                         scope, multi_k=0):
+    """_CompiledBlock constructor call shared by run()/run_steps()/
+    compiled_hlo() (callers run _prewarm_flash_ops first and store into
+    the cache themselves)."""
+    return _CompiledBlock(
+        program, 0, list(feed_vals), fetch_names, state_names,
+        feed_shapes={k: tuple(v.shape) for k, v in feed_vals.items()},
+        state_shapes={n: tuple(scope.find(n).shape) for n in state_names},
+        multi_k=multi_k)
 
 
 class Executor:
@@ -594,22 +656,14 @@ class Executor:
         block = program.global_block()
         feed_vals = {name: _coerce_feed_value(block, name, value)
                      for name, value in feed.items()}
+        _ensure_stacked_params(program, scope)
         state_names = _referenced_state_names(block, scope, feed_vals)
 
-        feed_spec = tuple(sorted((k, tuple(v.shape), str(v.dtype))
-                                 for k, v in feed_vals.items()))
-        key = (program._uid, program._version, feed_spec, tuple(fetch_names),
-               tuple(state_names))
+        key = _block_cache_key(program, feed_vals, fetch_names, state_names)
         compiled = self._cache.get(key) if use_program_cache else None
         localsgd_k = getattr(program, "_localsgd_k", 0)
         if compiled is None:
-            if any(op.type == "fused_attention"
-                   for b in program.blocks for op in b.ops):
-                # flash-kernel availability must be probed EAGERLY, before
-                # any block class jit-traces (ops/attention.py); one shared
-                # choke point so LocalSGD/pipeline paths get it too
-                from ..ops.attention import prewarm_flash
-                prewarm_flash(program)
+            _prewarm_flash_ops(program)
             dist = getattr(program, "_dist_config", None)
             pp = (int(dist.resolve_mesh().shape.get("pp", 1))
                   if dist is not None else 1)
@@ -630,12 +684,9 @@ class Executor:
                                           fetch_names, state_names,
                                           localsgd_k)
             else:
-                compiled = _CompiledBlock(
-                    program, 0, list(feed_vals), fetch_names, state_names,
-                    feed_shapes={k: tuple(v.shape)
-                                 for k, v in feed_vals.items()},
-                    state_shapes={n: tuple(scope.find(n).shape)
-                                  for n in state_names})
+                compiled = _make_compiled_block(program, feed_vals,
+                                                fetch_names, state_names,
+                                                scope)
             if use_program_cache:
                 self._cache[key] = compiled
 
@@ -770,24 +821,15 @@ class Executor:
                     tuple(arr.shape),
                     tuple(v.shape) if v is not None else None, k)
             feed_vals[name] = arr
+        _ensure_stacked_params(program, scope)
         state_names = _referenced_state_names(gb, scope, feed_vals)
-        feed_spec = tuple(sorted((kk, tuple(v.shape), str(v.dtype))
-                                 for kk, v in feed_vals.items()))
-        key = ("multi", k, program._uid, program._version, feed_spec,
-               tuple(fetch_names), tuple(state_names))
+        key = ("multi", k) + _block_cache_key(program, feed_vals,
+                                              fetch_names, state_names)
         compiled = self._cache.get(key)
         if compiled is None:
-            if any(op.type == "fused_attention"
-                   for b in program.blocks for op in b.ops):
-                from ..ops.attention import prewarm_flash
-                prewarm_flash(program)
-            compiled = _CompiledBlock(
-                program, 0, list(feed_vals), fetch_names, state_names,
-                feed_shapes={kk: tuple(v.shape)
-                             for kk, v in feed_vals.items()},
-                state_shapes={n: tuple(scope.find(n).shape)
-                              for n in state_names},
-                multi_k=k)
+            _prewarm_flash_ops(program)
+            compiled = _make_compiled_block(program, feed_vals, fetch_names,
+                                            state_names, scope, multi_k=k)
             self._cache[key] = compiled
         rng_key = _next_rng_key(scope, program.random_seed)
         state = {n: scope.find(n) for n in state_names}
@@ -954,6 +996,66 @@ class Executor:
         if fetched is not None:
             fetched = [np.asarray(f) for f in fetched]
         return fetched
+
+    def compiled_hlo(self, feed=None, fetch_list=None, program=None,
+                     scope=None):
+        """Optimized-HLO text of the jitted step for this (feed, fetch)
+        signature — the PUBLIC surface for compile-stats tooling
+        (scripts/collective_audit.py, HLO-structure tests) that previously
+        poked `exe._cache` internals. Shares run()'s compile cache (same
+        key), so calling after run() reuses the compiled block and calling
+        before run() pre-populates it. The program is only lowered and
+        compiled, never executed: donation marks do not consume the
+        scope's buffers. Requires initialized state (run the startup
+        program first); pipeline/LocalSGD/PS programs are not supported —
+        their steps are not one jitted computation."""
+        import jax.numpy as jnp
+
+        from . import errors
+        program = program or default_main_program()
+        if hasattr(program, "_is_data_parallel"):
+            program = program.program
+        if getattr(program, "_ps_hooks", None) \
+                or getattr(program, "_localsgd_k", 0):
+            raise errors.Unimplemented(
+                "compiled_hlo on PS/LocalSGD programs (their step is not "
+                "one jitted computation)")
+        dist = getattr(program, "_dist_config", None)
+        if dist is not None and \
+                int(dist.resolve_mesh().shape.get("pp", 1)) > 1:
+            raise errors.Unimplemented(
+                "compiled_hlo over a pp>1 mesh (per-stage programs)")
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        scope = scope or global_scope()
+        fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                       for v in fetch_list]
+        block = program.global_block()
+        for n in fetch_names:
+            if not block.has_var(n):
+                raise errors.NotFound(
+                    "fetch target %r is not a variable of this program", n,
+                    var=n)
+        feed_vals = {name: _coerce_feed_value(block, name, value)
+                     for name, value in feed.items()}
+        _ensure_stacked_params(program, scope)
+        state_names = _referenced_state_names(block, scope, feed_vals)
+        key = _block_cache_key(program, feed_vals, fetch_names, state_names)
+        compiled = self._cache.get(key)
+        if compiled is None:
+            _prewarm_flash_ops(program)
+            compiled = _make_compiled_block(program, feed_vals, fetch_names,
+                                            state_names, scope)
+            self._cache[key] = compiled
+        if not isinstance(compiled, _CompiledBlock):
+            raise errors.Unimplemented(
+                "compiled_hlo: cached entry for this signature is not a "
+                "single jitted block")
+        mut = {n: scope.find(n) for n in compiled.mut_names}
+        ro = {n: scope.find(n) for n in compiled.ro_names}
+        feeds = {k: jnp.asarray(v) for k, v in feed_vals.items()}
+        return compiled.jitted.lower(
+            mut, ro, feeds, jax.random.key(0)).compile().as_text()
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,

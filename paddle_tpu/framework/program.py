@@ -350,6 +350,13 @@ class Program:
                 nop = Operator(nb, op.type, op.inputs, op.outputs, dict(op.attrs))
                 if for_test and "is_test" in nop.attrs:
                     nop.attrs["is_test"] = True
+                if for_test and "sub_ops" in nop.attrs:
+                    # fused sub-graph ops (__segment__/__layer_scan__) carry
+                    # op descs in attrs: flip their train-only switches too,
+                    # recursively (a scan op can sit inside a recompute
+                    # segment's sub_ops)
+                    nop.attrs["sub_ops"] = _sub_ops_for_test(
+                        nop.attrs["sub_ops"])
                 nb.ops.append(nop)
             new.blocks.append(nb)
         new.current_block_idx = 0
@@ -402,6 +409,20 @@ class Program:
                 b.ops.append(Operator(b, od["type"], od["inputs"], od["outputs"], attrs))
             p.blocks.append(b)
         return p
+
+
+def _sub_ops_for_test(sub_ops):
+    """clone(for_test) helper: flip is_test in fused sub-graph op descs at
+    every nesting depth (__layer_scan__ inside a __segment__ etc.)."""
+    out = []
+    for od in sub_ops:
+        attrs = dict(od["attrs"])
+        if "is_test" in attrs:
+            attrs["is_test"] = True
+        if "sub_ops" in attrs:
+            attrs["sub_ops"] = _sub_ops_for_test(attrs["sub_ops"])
+        out.append({**od, "attrs": attrs})
+    return out
 
 
 def grad_var_name(name: str) -> str:

@@ -10,7 +10,8 @@ __all__ = [
 ]
 
 
-def fused_lm_head_ce(x, w, label, chunk=None, bias=None, w_layout="vh"):
+def fused_lm_head_ce(x, w, label, chunk=None, bias=None, w_layout="vh",
+                     ignore_index=-100):
     """Streaming LM-head + cross-entropy: per-token CE of the logits
     `x @ w^T (+ bias)` against `label`, WITHOUT materializing the
     [B, S, V] logits (vocab-chunked online logsumexp; backward
@@ -20,11 +21,13 @@ def fused_lm_head_ce(x, w, label, chunk=None, bias=None, w_layout="vh"):
 
     x: [B, S, H]; w: [V, H] (`w_layout="vh"`, e.g. a tied embedding) or
     [H, V] (`w_layout="hv"`, an fc head weight); bias: optional [V];
-    label: [B, S, 1] int in [0, V) — out-of-range labels (pad/ignore id
-    conventions) yield NaN for that token; mask pad tokens out of the
-    loss instead. chunk=None uses ops/fused_ce.DEFAULT_CHUNK (the same
-    constant the models' auto-select thresholds key on). Returns
-    per-token loss [B, S, 1] (f32)."""
+    label: [B, S, 1] int in [0, V). Tokens labelled `ignore_index`
+    (default -100, matching softmax_with_cross_entropy) contribute zero
+    loss AND zero grads; any OTHER out-of-range label yields NaN for
+    that token — loud where the dense gather would be garbage.
+    chunk=None uses ops/fused_ce.DEFAULT_CHUNK (the same constant the
+    models' auto-select thresholds key on). Returns per-token loss
+    [B, S, 1] (f32)."""
     helper = LayerHelper("fused_lm_head_ce")
     loss = helper.create_variable_for_type_inference("float32")
     inputs = {"X": [x], "W": [w], "Label": [label]}
@@ -32,7 +35,8 @@ def fused_lm_head_ce(x, w, label, chunk=None, bias=None, w_layout="vh"):
         inputs["Bias"] = [bias]
     helper.append_op("fused_lm_head_ce", inputs=inputs,
                      outputs={"Loss": [loss]},
-                     attrs={"chunk": chunk, "w_layout": w_layout})
+                     attrs={"chunk": chunk, "w_layout": w_layout,
+                            "ignore_index": ignore_index})
     return loss
 
 
@@ -49,13 +53,17 @@ def cross_entropy(input, label, soft_label=False, ignore_index=-100):
 
 def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1,
                                ignore_index=-100, return_softmax=False):
+    """Hard-label tokens equal to `ignore_index` contribute zero loss and
+    zero grads (reference softmax_with_cross_entropy_op.cc semantics —
+    the kwarg is honored, not silently dropped)."""
     helper = LayerHelper("softmax_with_cross_entropy")
     softmax = helper.create_variable_for_type_inference(logits.dtype)
     loss = helper.create_variable_for_type_inference(logits.dtype)
     helper.append_op("softmax_with_cross_entropy",
                      inputs={"Logits": [logits], "Label": [label]},
                      outputs={"Softmax": [softmax], "Loss": [loss]},
-                     attrs={"soft_label": soft_label, "axis": axis})
+                     attrs={"soft_label": soft_label, "axis": axis,
+                            "ignore_index": ignore_index})
     if return_softmax:
         return loss, softmax
     return loss

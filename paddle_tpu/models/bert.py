@@ -196,19 +196,43 @@ def _bert_embeddings(input_ids, cfg: BertConfig):
     return x
 
 
+def _tp_vocab_shards_head() -> bool:
+    """True when the active mesh tensor-parallelizes and this model's TP
+    rules vocab-shard `mlm_head_w` (P(None, 'tp') on the [H, V] fc weight):
+    the fused head's chunked scan would make GSPMD regather the sharded
+    weight per chunk, undoing the Megatron vocab-parallel head — so the
+    AUTO-select must stay dense there (forcing fused_mlm_head=True still
+    wins). Reads the CURRENTLY-set mesh, so it only covers builds that run
+    after fleet.init/set_mesh; for the build-then-init order the
+    auto-selected op carries an `auto_selected` attr and
+    DistributedOptimizer.minimize warns when tp rules will shard it
+    (distributed/fleet/base.py) — force `fused_mlm_head=False` there."""
+    from ..parallel.mesh import get_mesh
+    mesh = get_mesh()
+    if mesh is None or int(mesh.shape.get("tp", 1)) <= 1:
+        return False
+    spec = tp_sharding_rules().spec_for("mlm_head_w")
+    return any(ax == "tp" or (isinstance(ax, (tuple, list)) and "tp" in ax)
+               for ax in spec)
+
+
 def bert_pretrain_loss(seq_out, mlm_labels, cfg: BertConfig):
     """Masked-LM head + loss (ERNIE pretraining objective).
 
-    With `cfg.fused_mlm_head` (auto at long seq + real vocab) the head
-    runs as the vocab-chunked fused_lm_head_ce (ops/fused_ce.py), which
-    never materializes the [B, S, V] logits — same parameter
-    names/shapes as the dense fc head, so checkpoints are
-    interchangeable."""
+    With `cfg.fused_mlm_head` (auto at long seq + real vocab, and only
+    when tensor parallelism does not vocab-shard the head weight —
+    `_tp_vocab_shards_head`) the head runs as the vocab-chunked
+    fused_lm_head_ce (ops/fused_ce.py), which never materializes the
+    [B, S, V] logits — same parameter names/shapes as the dense fc head,
+    so checkpoints are interchangeable. Label contract is identical on
+    both paths for the default ignore_index (-100): ignored tokens
+    contribute zero loss and zero grads."""
     from ..ops.fused_ce import DEFAULT_CHUNK
     fused = cfg.fused_mlm_head
     if fused is None:
         fused = (cfg.seq_len >= 512
-                 and cfg.vocab_size >= 2 * DEFAULT_CHUNK)
+                 and cfg.vocab_size >= 2 * DEFAULT_CHUNK
+                 and not _tp_vocab_shards_head())
     with _stage_guard(cfg)(_last_stage(cfg)):
         if fused:
             hidden = cfg.hidden_size
@@ -220,6 +244,10 @@ def bert_pretrain_loss(seq_out, mlm_labels, cfg: BertConfig):
                                         is_bias=True)
             loss = layers.fused_lm_head_ce(seq_out, w, mlm_labels,
                                            bias=b, w_layout="hv")
+            if cfg.fused_mlm_head is None:
+                # auto-selected (not user-forced): lets minimize warn if
+                # tp rules later vocab-shard the head weight
+                loss.block.ops[-1].attrs["auto_selected"] = True
         else:
             logits = layers.fc(seq_out, cfg.vocab_size,
                                num_flatten_dims=2,

@@ -128,7 +128,11 @@ def _last_stage(cfg: GPTConfig) -> int:
 
 def gpt_decoder(token_ids, cfg: GPTConfig):
     """Tied embeddings + N pre-LN causal blocks + final LN.
-    Returns (seq_out [B, S, H], wte var for the tied head)."""
+    Returns (seq_out [B, S, H], wte var for the tied head). Per-layer
+    boundary var names land on the returned var's `_layer_checkpoints` —
+    the RecomputeOptimizer checkpoints AND the layer-scan segment
+    annotation (parallel/transforms.apply_layer_scan), exactly as
+    models/bert.py annotates."""
     stage = _stage_guard(cfg)
     last = _last_stage(cfg)
     with stage(0):
@@ -144,15 +148,18 @@ def gpt_decoder(token_ids, cfg: GPTConfig):
         if cfg.hidden_dropout:
             x = layers.dropout(x, cfg.hidden_dropout,
                                dropout_implementation="upscale_in_train")
+    ckpts = []
     for i in range(cfg.num_layers):
         with stage(_layer_stage(cfg, i)):
             x = decoder_layer(x, cfg, i)
+        ckpts.append(x.name)
     with stage(last):
         out = _ln(x, "final_ln")
+    out._layer_checkpoints = ckpts
     return out, wte
 
 
-def build_lm_program(cfg: GPTConfig, fused_head: bool = None):
+def build_lm_program(cfg: GPTConfig, fused_head: "bool | None" = None):
     """Next-token LM objective: predict tokens[1:] from tokens[:-1].
     Returns (tokens, loss).
 
@@ -165,7 +172,8 @@ def build_lm_program(cfg: GPTConfig, fused_head: bool = None):
     recompute for no memory win). Pass True/False to force either."""
     tokens = layers.data(name="tokens", shape=[cfg.seq_len], dtype="int64")
     seq, wte = gpt_decoder(tokens, cfg)
-    if fused_head is None:
+    auto_head = fused_head is None
+    if auto_head:
         from ..ops.fused_ce import DEFAULT_CHUNK
         fused_head = cfg.vocab_size >= 2 * DEFAULT_CHUNK
     with _stage_guard(cfg)(_last_stage(cfg)):
@@ -174,13 +182,19 @@ def build_lm_program(cfg: GPTConfig, fused_head: bool = None):
         if fused_head:
             shift_seq = layers.slice(seq, [1], [0], [cfg.seq_len - 1])
             loss = layers.fused_lm_head_ce(shift_seq, wte, shift_labels)
+            if auto_head:
+                # auto-selected: minimize warns if tp rules vocab-shard wte
+                # (distributed/fleet/base.py _warn_tp_fused_head)
+                loss.block.ops[-1].attrs["auto_selected"] = True
         else:
             logits = layers.matmul(seq, wte, transpose_y=True)  # tied head
             shift_logits = layers.slice(logits, [1], [0],
                                         [cfg.seq_len - 1])
             loss = layers.softmax_with_cross_entropy(shift_logits,
                                                      shift_labels)
-        return tokens, layers.mean(loss)
+        mean_loss = layers.mean(loss)
+        mean_loss._layer_checkpoints = getattr(seq, "_layer_checkpoints", [])
+        return tokens, mean_loss
 
 
 def tp_sharding_rules() -> ShardingRules:

@@ -59,13 +59,22 @@ def _chunk_logits(x, w_c, b_c, c0, chunk, v):
     return jnp.where(valid[None, None, :], l_c, -jnp.inf)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
-def _chunked_lm_ce(x, w, b, labels, chunk):
-    loss, _ = _fwd_scan(x, w, b, labels, chunk)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _chunked_lm_ce(x, w, b, labels, chunk, ignore_index):
+    loss, _ = _fwd_scan(x, w, b, labels, chunk, ignore_index)
     return loss
 
 
-def _fwd_scan(x, w, b, labels, chunk):
+def _token_grade(labels, v, ignore_index):
+    """(ignored, valid): ignore_index tokens are dropped from the loss
+    (zero loss AND zero grads — reference softmax_with_cross_entropy
+    ignore_index semantics); other out-of-range labels stay loud NaN."""
+    ignored = labels == ignore_index
+    valid = (labels >= 0) & (labels < v) & ~ignored
+    return ignored, valid
+
+
+def _fwd_scan(x, w, b, labels, chunk, ignore_index):
     w_chunks, b_chunks, n_chunks, v = _pad_w(w, b, chunk)
     bsz, s = labels.shape
 
@@ -89,30 +98,33 @@ def _fwd_scan(x, w, b, labels, chunk):
     (m, ssum, lab), _ = jax.lax.scan(
         body, init, (w_chunks, b_chunks, jnp.arange(n_chunks)))
     lse = m + jnp.log(ssum)
-    # Contract: labels must lie in [0, V). Out-of-range labels (e.g. a
-    # -1/-100 pad convention this op does not implement) yield NaN for
-    # that token — loud and deterministic, where the dense pair's
-    # out-of-bounds gather is backend-defined garbage. Mask pad tokens
-    # out of the loss instead of feeding ignore ids.
-    valid = (labels >= 0) & (labels < v)
-    lab = jnp.where(valid, lab, jnp.nan)
-    return (lse - lab)[..., None], lse
+    # Label contract: `ignore_index` tokens (default -100, the reference
+    # convention) contribute ZERO loss and zero grads. Any OTHER label
+    # outside [0, V) yields NaN for that token — loud and deterministic,
+    # where the dense pair's out-of-bounds gather is backend-defined
+    # garbage.
+    ignored, valid = _token_grade(labels, v, ignore_index)
+    loss = jnp.where(valid, lse - lab, jnp.nan)
+    loss = jnp.where(ignored, 0.0, loss)
+    return loss[..., None], lse
 
 
-def _ce_fwd(x, w, b, labels, chunk):
-    loss, lse = _fwd_scan(x, w, b, labels, chunk)
+def _ce_fwd(x, w, b, labels, chunk, ignore_index):
+    loss, lse = _fwd_scan(x, w, b, labels, chunk, ignore_index)
     return loss, (x, w, b, labels, lse)
 
 
-def _ce_bwd(chunk, res, g):
+def _ce_bwd(chunk, ignore_index, res, g):
     x, w, b, labels, lse = res
     w_chunks, b_chunks, n_chunks, v = _pad_w(w, b, chunk)
     gf = g[..., 0].astype(jnp.float32)              # [B, S]
-    # out-of-range labels NaN the forward loss; make the gradients loud
-    # too (an all-zero one_hot would otherwise emit a finite,
+    # ignored tokens drop out of every gradient term; remaining
+    # out-of-range labels NaN the forward loss, so make the gradients
+    # loud too (an all-zero one_hot would otherwise emit a finite,
     # label-term-free gradient that silently corrupts training)
-    valid = (labels >= 0) & (labels < v)
+    ignored, valid = _token_grade(labels, v, ignore_index)
     gf = jnp.where(valid, gf, jnp.nan)
+    gf = jnp.where(ignored, 0.0, gf)
 
     def body(dx, leaves):
         w_c, b_c, idx = leaves
@@ -153,5 +165,6 @@ def _fused_lm_head_ce(ctx, ins, attrs):
     chunk = min(chunk, max(int(w.shape[0]), 1))
     if bias is None:
         bias = jnp.zeros((w.shape[0],), x.dtype)
-    loss = _chunked_lm_ce(x, w, bias, labels, chunk)
+    ignore_index = int(attrs.get("ignore_index", -100))
+    loss = _chunked_lm_ce(x, w, bias, labels, chunk, ignore_index)
     return {"Loss": [loss.astype(jnp.float32)]}

@@ -181,6 +181,9 @@ def _cross_entropy(ctx, ins, attrs):
 
 @register("softmax_with_cross_entropy", nondiff_slots=("Label",))
 def _softmax_with_cross_entropy(ctx, ins, attrs):
+    """Hard labels equal to ignore_index get zero loss — and zero grads,
+    because the where() routes their cotangent to the constant branch
+    (reference softmax_with_cross_entropy_op.cc ignore_index)."""
     logits, label = ins["Logits"][0], ins["Label"][0]
     axis = attrs.get("axis", -1)
     logp = jax.nn.log_softmax(logits, axis=axis)
@@ -190,8 +193,11 @@ def _softmax_with_cross_entropy(ctx, ins, attrs):
         idx = label.astype(jnp.int32)
         if idx.ndim == logits.ndim:
             idx = jnp.squeeze(idx, axis)
-        picked = jnp.take_along_axis(logp, idx[..., None], axis=axis)
-        loss = -picked
+        keep = idx != attrs.get("ignore_index", -100)
+        safe = jnp.where(keep, idx, 0)     # in-range gather for ignored rows
+        picked = jnp.take_along_axis(logp, safe[..., None], axis=axis)
+        loss = jnp.where(keep[..., None], -picked,
+                         jnp.zeros_like(picked))
     return {"Softmax": [jnp.exp(logp)], "Loss": [loss]}
 
 

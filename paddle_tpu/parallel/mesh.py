@@ -115,6 +115,13 @@ class ShardingRules:
         self._default = default
 
     def spec_for(self, name: str, shape=None) -> PartitionSpec:
+        # [L]-stacked per-layer params (apply_layer_scan,
+        # parallel/transforms.py): the per-layer rule applies shifted one
+        # dim right — the stacked layer axis stays unsharded
+        if name.endswith("@LAYERS"):
+            base = self.spec_for(name[:-len("@LAYERS")],
+                                 tuple(shape[1:]) if shape else None)
+            return P(None, *base)
         for pat, spec in self._rules:
             if pat.search(name):
                 return spec

@@ -1,53 +1,422 @@
-"""Program-level strategy transforms: recompute, gradient merge.
+"""Program-level strategy transforms: layer scan (rolled layers), recompute,
+gradient merge.
 
-Reference counterparts: RecomputeOptimizer (optimizer.py:4547 +
-backward.py:689 _append_backward_ops_with_checkpoints_) and
-GradientMergeOptimizer (optimizer.py:5025). TPU-native: recompute collapses a
-forward segment into ONE __segment__ op whose lowering is wrapped in
-jax.checkpoint — the generic __vjp__ then stores only segment boundaries and
-re-runs the segment in backward (XLA schedules the rematerialization).
-Gradient merge gates the (arbitrary) optimizer update ops with a step-counter
-mask using where-selects — no control-flow blocks needed.
+Reference counterparts: the reference expresses repeated structure through
+control-flow ops rather than unrolling (operators/controlflow/while_op.cc,
+recurrent_op.cc); RecomputeOptimizer (optimizer.py:4547 + backward.py:689
+_append_backward_ops_with_checkpoints_) and GradientMergeOptimizer
+(optimizer.py:5025). TPU-native: `apply_layer_scan` rolls the N isomorphic
+per-layer op segments of a deep model into ONE `__layer_scan__` op whose
+lowering is a `lax.scan` over the per-layer weights stacked along a new
+leading [L] axis — the compiled step program then contains each layer's HLO
+once instead of N times (docs/perf_notes.md "Rolled-layer programs").
+Recompute collapses a forward segment into ONE __segment__ op whose lowering
+is wrapped in jax.checkpoint — the generic __vjp__ then stores only segment
+boundaries and re-runs the segment in backward (XLA schedules the
+rematerialization). Gradient merge gates the (arbitrary) optimizer update ops
+with a step-counter mask using where-selects — no control-flow blocks needed.
 """
 from __future__ import annotations
 
-from typing import List
+from typing import Dict, List, Optional
+
+import numpy as np
 
 import jax
 
-from ..framework.program import OpRole, Program
+from ..framework.program import OpRole, Operator, Parameter, Program
 from ..ops import registry
 from ..ops.registry import register
+
+# Suffix of the stacked-per-layer parameter vars apply_layer_scan creates;
+# sharding rules key on it (parallel/mesh.py: per-layer specs shift by one
+# dim, the stacked [L] axis stays unsharded) and the Executor's scope
+# round-trip restacks per-layer checkpoint entries under it.
+LAYER_STACK_SUFFIX = "@LAYERS"
+
+
+def _current_amp_dtype():
+    """bf16/f16 when the program being lowered has static-graph AMP on —
+    sub-graph ops (inside __segment__ / __layer_scan__) must apply the same
+    white/black-list casts the top-level op loop applies."""
+    from ..framework import executor as _ex
+    if not _ex._lowering_programs:
+        return None
+    prog = _ex._lowering_programs[-1]
+    if not getattr(prog, "_amp", False):
+        return None
+    import jax.numpy as jnp
+    return (jnp.bfloat16
+            if getattr(prog, "_amp_dtype", "bfloat16") == "bfloat16"
+            else jnp.float16)
 
 
 # ---------------------------------------------------------------------------
 # __segment__: a fused sub-graph op (the recompute unit)
 # ---------------------------------------------------------------------------
 
+def _run_sub_ops(ctx, sub_ops, env, amp_dtype, seed_overrides=None):
+    """Shared sub-graph interpreter for __segment__/__layer_scan__ bodies:
+    applies each op desc's lowering over `env`, with the program's AMP
+    casts (the top-level executor loop applies these per op; fused
+    sub-graphs must match) and optional per-op __rng_seed__ overrides
+    (traced per-layer seeds inside the scan body)."""
+    for j, od in enumerate(sub_ops):
+        opdef = registry.get(od["type"])
+        op_ins = {s: [None if n == "@EMPTY@" else env[n] for n in ns]
+                  for s, ns in od["inputs"].items()}
+        at = od["attrs"]
+        if seed_overrides is not None and seed_overrides[j] is not None:
+            at = dict(at)
+            at["__rng_seed__"] = seed_overrides[j]
+        if amp_dtype is not None:
+            from ..framework.executor import _amp_cast_ins
+            op_ins = _amp_cast_ins(od["type"], op_ins, amp_dtype)
+        outs = opdef.lower(ctx, op_ins, at)
+        for s, ns in od["outputs"].items():
+            if s not in outs:
+                continue
+            for n, v in zip(ns, outs[s]):
+                if n == "@EMPTY@" or v is None:
+                    continue
+                env[n] = v
+    return env
+
+
 @register("__segment__")
 def _lower_segment(ctx, ins, attrs):
     sub_ops = attrs["sub_ops"]          # list of op descs
     in_names = attrs["in_names"]
     out_names = attrs["out_names"]
+    amp_dtype = _current_amp_dtype()
 
     def run(in_vals):
-        env = dict(zip(in_names, in_vals))
-        for od in sub_ops:
-            opdef = registry.get(od["type"])
-            op_ins = {s: [env[n] for n in ns]
-                      for s, ns in od["inputs"].items()}
-            outs = opdef.lower(ctx, op_ins, od["attrs"])
-            for s, ns in od["outputs"].items():
-                if s not in outs:
-                    continue
-                for n, v in zip(ns, outs[s]):
-                    env[n] = v
+        env = _run_sub_ops(ctx, sub_ops, dict(zip(in_names, in_vals)),
+                           amp_dtype)
         return [env[n] for n in out_names]
 
     if attrs.get("remat", True):
         run = jax.checkpoint(run)
     outs = run(ins["X"])
     return {"Out": outs}
+
+
+# ---------------------------------------------------------------------------
+# __layer_scan__: N isomorphic layer segments rolled into one lax.scan
+# ---------------------------------------------------------------------------
+
+def _infer_layer_scan(block, op):
+    """The scan carries one activation: Out is shaped exactly like X."""
+    block.program.bump_version()
+    vi = block.find_var_recursive(op.inputs["X"][0])
+    vo = block.find_var_recursive(op.outputs["Out"][0])
+    if vi is not None and vo is not None:
+        vo.shape = tuple(vi.shape)
+        vo.dtype = vi.dtype
+
+
+@register("__layer_scan__", infer=_infer_layer_scan)
+def _lower_layer_scan(ctx, ins, attrs):
+    """ONE lax.scan over the [L]-stacked per-layer weights. The body is the
+    template layer's op sequence; per-layer rng seeds ride the scan as xs
+    (fold_in of a traced seed reproduces exactly the per-op masks the
+    unrolled program draws, so rolled == unrolled bit-for-bit under
+    dropout); remat=True wraps the body in jax.checkpoint — the standard
+    JAX remat-per-layer pairing. The generic __vjp__ differentiates this
+    lowering with jax.vjp, which transposes the scan into the backward
+    scan — the compiled program contains each layer's HLO once in each
+    direction."""
+    import jax.numpy as jnp
+
+    sub_ops = attrs["sub_ops"]
+    n_layers = int(attrs["num_layers"])
+    carry_in, carry_out = attrs["carry_in"], attrs["carry_out"]
+    inv_env = dict(zip(attrs["inv_names"], ins.get("Inv", [])))
+    stacked_names = attrs["stacked_names"]        # template (layer-0) names
+    stacked_vals = tuple(ins.get("Stacked", []))
+    seeds = tuple(None if s is None else jnp.asarray(s, jnp.uint32)
+                  for s in attrs["layer_seeds"])
+    amp_dtype = _current_amp_dtype()
+
+    def body(carry, xs):
+        slices, seed_slices = xs
+        env = dict(inv_env)
+        env[carry_in] = carry
+        env.update(zip(stacked_names, slices))
+        env = _run_sub_ops(ctx, sub_ops, env, amp_dtype,
+                           seed_overrides=seed_slices)
+        return env[carry_out], None
+
+    if attrs.get("remat", False):
+        body = jax.checkpoint(body)
+    carry, _ = jax.lax.scan(body, ins["X"][0], (stacked_vals, seeds),
+                            length=n_layers)
+    return {"Out": [carry]}
+
+
+def _attr_val_equal(a, b):
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        return (isinstance(a, np.ndarray) and isinstance(b, np.ndarray)
+                and a.shape == b.shape and bool(np.array_equal(a, b)))
+    return type(a) == type(b) and a == b            # noqa: E721
+
+
+def _attrs_equal(a: dict, b: dict) -> bool:
+    if set(a) != set(b):
+        return False
+    return all(_attr_val_equal(a[k], b[k]) for k in a)
+
+
+class _SegmentMapper:
+    """Builds the name correspondence template-segment -> segment i, or
+    reports non-isomorphism. Two segments are isomorphic when their op
+    sequences match type/slot/attr-wise (attrs modulo the per-op
+    __rng_seed__) under a consistent bijective renaming of vars."""
+
+    def __init__(self, template):
+        self.template = template
+
+    def map_segment(self, seg) -> Optional[Dict[str, str]]:
+        if len(seg) != len(self.template):
+            return None
+        f: Dict[str, str] = {}
+        rev: Dict[str, str] = {}
+
+        def bind(n0, ni):
+            if n0 == "@EMPTY@" or ni == "@EMPTY@":
+                return n0 == ni
+            if n0 in f:
+                return f[n0] == ni
+            if ni in rev:
+                return False
+            f[n0] = ni
+            rev[ni] = n0
+            return True
+
+        for op0, opi in zip(self.template, seg):
+            if op0.type != opi.type:
+                return None
+            if sorted(op0.inputs) != sorted(opi.inputs) \
+                    or sorted(op0.outputs) != sorted(opi.outputs):
+                return None
+            a0 = {k: v for k, v in op0.attrs.items() if k != "__rng_seed__"}
+            ai = {k: v for k, v in opi.attrs.items() if k != "__rng_seed__"}
+            if not _attrs_equal(a0, ai):
+                return None
+            if ("__rng_seed__" in op0.attrs) != ("__rng_seed__" in opi.attrs):
+                return None
+            for slots0, slotsi in ((op0.inputs, opi.inputs),
+                                   (op0.outputs, opi.outputs)):
+                for slot in slots0:
+                    if len(slots0[slot]) != len(slotsi[slot]):
+                        return None
+                    for n0, ni in zip(slots0[slot], slotsi[slot]):
+                        if not bind(n0, ni):
+                            return None
+        return f
+
+
+def _segment_externals(seg) -> List[str]:
+    """Segment inputs produced outside it, in first-read order."""
+    ext, seen, internal = [], set(), set()
+    for op in seg:
+        for n in op.input_names():
+            if n != "@EMPTY@" and n not in internal and n not in seen:
+                seen.add(n)
+                ext.append(n)
+        internal.update(n for n in op.output_names() if n != "@EMPTY@")
+    return ext
+
+
+def apply_layer_scan(program: Program, boundaries: List,
+                     remat: bool = False, startup_program=None,
+                     min_layers: int = 2) -> Optional[List[str]]:
+    """Roll the N isomorphic per-layer segments ending at `boundaries` into
+    one `__layer_scan__` op over [L]-stacked weights.
+
+    `boundaries` are the per-layer output vars (the models' natural
+    recompute checkpoints, `loss._layer_checkpoints`): segment i is the op
+    run producing boundaries[i] from boundaries[i-1]. Segments are verified
+    by op-topology isomorphism — equal op types/slots/attrs under a
+    consistent renaming where the only renamed externals are the carried
+    activation and per-layer persistable parameters. Anything else (MoE aux
+    outputs consumed outside the layers, per-layer written persistables
+    like BN stats, differing attrs such as pipeline_stage under pp) falls
+    back to the unrolled program, untouched.
+
+    Per-layer params are replaced by stacked `<layer0 name>@LAYERS` vars
+    ([L, ...], the stacked axis unsharded under TP — parallel/mesh.py).
+    When `startup_program` is given, a `stack` op is appended to it so the
+    stacked value lands in the Scope at init (the per-layer init vars flip
+    non-persistable there); the Executor also restacks lazily from
+    per-layer Scope entries, so unrolled checkpoints load into rolled
+    programs (framework/executor.py _ensure_stacked_params).
+
+    Must run before append_backward. Returns the interior boundary names
+    the roll consumed (callers drop them from recompute checkpoint lists —
+    `remat=True` already rematerializes per layer), or None on fallback.
+    """
+    block = program.global_block()
+    bounds = [b.name if hasattr(b, "name") else str(b) for b in boundaries]
+    if len(bounds) < max(int(min_layers), 2):
+        return None
+    ops = block.ops
+    assert all(op.attrs.get("op_role", 0) == OpRole.Forward for op in ops), \
+        "apply_layer_scan must run before append_backward"
+
+    producer = {}
+    for idx, op in enumerate(ops):
+        for n in op.output_names():
+            if n != "@EMPTY@":
+                producer[n] = idx
+    if any(b not in producer for b in bounds):
+        return None
+    e = [producer[b] for b in bounds]
+    n_layers = len(bounds)
+    if any(e[i] >= e[i + 1] for i in range(n_layers - 1)):
+        return None
+    seg_len = e[1] - e[0]
+    # equal spacing is the cheap pre-check; unequal op counts can never be
+    # isomorphic (and fixes segment 0's start, which has no left boundary)
+    if seg_len <= 0 or any(e[i + 1] - e[i] != seg_len
+                           for i in range(n_layers - 1)):
+        return None
+    start0 = e[0] - seg_len + 1
+    if start0 < 0:
+        return None
+    segments = [ops[e[i] - seg_len + 1: e[i] + 1] for i in range(n_layers)]
+
+    template = segments[0]
+    mapper = _SegmentMapper(template)
+    maps = [None] + [mapper.map_segment(s) for s in segments[1:]]
+    if any(m is None for m in maps[1:]):
+        return None
+    if any(maps[i].get(bounds[0]) != bounds[i] for i in range(1, n_layers)):
+        return None
+
+    # no segment may write a persistable (BN running stats etc.): those
+    # would need scan-carry state threading the roll does not do
+    for seg in segments:
+        for op in seg:
+            for n in op.output_names():
+                v = block.find_var_recursive(n)
+                if v is not None and v.persistable:
+                    return None
+
+    # classify template externals: loop-invariant / the carry / stacked
+    externals = _segment_externals(template)
+    carry_in = None
+    stacked_templates: List[str] = []
+    for n0 in externals:
+        images = [maps[i].get(n0, n0) for i in range(1, n_layers)]
+        if all(ni == n0 for ni in images):
+            continue                                   # loop-invariant
+        if images == bounds[:-1]:
+            if carry_in is not None:
+                return None                            # two carried vars
+            carry_in = n0
+            continue
+        v0 = block.find_var_recursive(n0)
+        if v0 is None or not v0.persistable:
+            return None
+        for ni in images:
+            vi = block.find_var_recursive(ni)
+            if vi is None or not vi.persistable \
+                    or tuple(vi.shape) != tuple(v0.shape) \
+                    or vi.dtype != v0.dtype \
+                    or vi.trainable != v0.trainable \
+                    or vi.stop_gradient != v0.stop_gradient:
+                return None
+        stacked_templates.append(n0)
+    if carry_in is None:
+        return None
+    cv = block.find_var_recursive(carry_in)
+    bv = block.find_var_recursive(bounds[0])
+    if cv is None or bv is None or tuple(cv.shape) != tuple(bv.shape) \
+            or cv.dtype != bv.dtype:
+        return None
+
+    # nothing produced inside the rolled region may be read outside it,
+    # except the final boundary (the scan's Out)
+    inner_produced = set()
+    for seg in segments:
+        for op in seg:
+            inner_produced.update(n for n in op.output_names()
+                                  if n != "@EMPTY@")
+    inner_produced.discard(bounds[-1])
+    outside_ops = ops[:start0] + ops[e[-1] + 1:]
+    for op in outside_ops:
+        if inner_produced & set(op.input_names()):
+            return None
+
+    inv_names = [n for n in externals
+                 if n != carry_in and n not in stacked_templates]
+
+    # template op descs (seeds stripped — they ride the scan as xs)
+    sub_descs, layer_seeds = [], []
+    for j, op0 in enumerate(template):
+        at = {k: v for k, v in op0.attrs.items() if k != "__rng_seed__"}
+        sub_descs.append({"type": op0.type,
+                          "inputs": {k: list(v)
+                                     for k, v in op0.inputs.items()},
+                          "outputs": {k: list(v)
+                                      for k, v in op0.outputs.items()},
+                          "attrs": at})
+        if "__rng_seed__" in op0.attrs:
+            layer_seeds.append([int(segments[i][j].attrs["__rng_seed__"])
+                                for i in range(n_layers)])
+        else:
+            layer_seeds.append(None)
+
+    # stacked parameter vars (+ drop the now-dead per-layer Parameters)
+    stacks: Dict[str, List[str]] = {}
+    for n0 in stacked_templates:
+        group = [n0] + [maps[i][n0] for i in range(1, n_layers)]
+        tvar = block.var(n0)
+        sname = n0 + LAYER_STACK_SUFFIX
+        p = Parameter(block, name=sname,
+                      shape=(n_layers,) + tuple(tvar.shape),
+                      dtype=tvar.dtype, trainable=tvar.trainable)
+        p.regularizer = getattr(tvar, "regularizer", None)
+        if hasattr(tvar, "optimize_attrs"):
+            p.optimize_attrs = dict(tvar.optimize_attrs)
+        block.vars[sname] = p
+        stacks[sname] = group
+    for group in stacks.values():
+        for n in group:
+            block.vars.pop(n, None)
+
+    scan_op = Operator(
+        block, "__layer_scan__",
+        {"X": [carry_in], "Inv": inv_names,
+         "Stacked": [n0 + LAYER_STACK_SUFFIX for n0 in stacked_templates]},
+        {"Out": [bounds[-1]]},
+        {"sub_ops": sub_descs, "num_layers": n_layers,
+         "carry_in": carry_in, "carry_out": bounds[0],
+         "inv_names": inv_names, "stacked_names": list(stacked_templates),
+         "layer_seeds": layer_seeds, "remat": bool(remat),
+         "op_role": OpRole.Forward})
+    block.ops = ops[:start0] + [scan_op] + ops[e[-1] + 1:]
+    registry.infer_op(block, scan_op)
+
+    program._layer_stacks = {**getattr(program, "_layer_stacks", {}),
+                             **stacks}
+    program.bump_version()
+
+    if startup_program is not None:
+        sb = startup_program.global_block()
+        for sname, group in stacks.items():
+            if not all(g in sb.vars for g in group):
+                continue        # params initialized elsewhere: the
+            for g in group:     # executor's lazy restack covers them
+                sb.vars[g].persistable = False
+            sv = block.var(sname)
+            sb.create_var(name=sname, shape=sv.shape, dtype=sv.dtype,
+                          persistable=True, stop_gradient=True)
+            sb.append_op("stack", inputs={"X": list(group)},
+                         outputs={"Y": [sname]}, attrs={"axis": 0})
+        startup_program.bump_version()
+    return bounds[:-1]
 
 
 def apply_recompute(program: Program, checkpoints: List[str]):

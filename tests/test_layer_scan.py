@@ -1,0 +1,378 @@
+"""Rolled-layer step programs (parallel/transforms.apply_layer_scan).
+
+The N isomorphic per-layer segments of a deep model collapse into ONE
+__layer_scan__ op whose lowering is a lax.scan over [L]-stacked weights.
+Contract under test: rolled == unrolled to float tolerance for loss AND
+updated params (with remat and dropout, under dp/tp meshes), graceful
+fallback on non-isomorphic segments, stacked-param checkpoint round-trip
+through io.save/load (including loading an UNROLLED checkpoint into a
+rolled program), and the compile-stats win — the rolled step's
+optimized-HLO instruction count must be <= 40% of the unrolled step's.
+
+Tests here deliberately merge related assertions: every BERT build costs
+an XLA compile, and the tier-1 suite runs under a hard wall-clock budget.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.framework.scope import global_scope
+from paddle_tpu.parallel.transforms import apply_layer_scan
+from paddle_tpu.testing import reset_programs
+
+# Adam's g/sqrt(v) normalization amplifies reassociation-level float
+# noise in near-zero gradients; atol floors those elements while rtol
+# 1e-5 governs everything of magnitude.
+TOL = dict(rtol=1e-5, atol=1e-7)
+
+
+def _build_bert(rolled, num_layers=4, dropout=0.0, remat=False, seed=0,
+                lr=0.01):
+    from paddle_tpu.models import bert
+    reset_programs(seed)
+    cfg = bert.BertConfig(vocab_size=256, hidden_size=16,
+                          num_layers=num_layers, num_heads=2,
+                          intermediate_size=32, max_position=32, seq_len=8,
+                          hidden_dropout=dropout, attention_dropout=dropout)
+    ids, labels, loss = bert.build_pretrain_program(cfg)
+    if rolled:
+        consumed = apply_layer_scan(
+            fluid.default_main_program(), loss._layer_checkpoints,
+            remat=remat, startup_program=fluid.default_startup_program())
+        assert consumed == loss._layer_checkpoints[:-1]
+    paddle.optimizer.Adam(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(1)
+    feed = {"input_ids": rng.randint(0, cfg.vocab_size,
+                                     (4, cfg.seq_len)).astype(np.int64),
+            "mlm_labels": rng.randint(0, cfg.vocab_size,
+                                      (4, cfg.seq_len, 1)).astype(np.int64)}
+    return exe, feed, loss, cfg
+
+
+def test_roll_structure_and_fleet_knob_cheap():
+    """Tier-1 structural coverage (build-only, no XLA compiles): the roll
+    replaces the 4 layer segments with one __layer_scan__ op, creates
+    [L]-stacked Parameters (per-layer ones leave the program), appends
+    the startup stack ops, and the fleet strategy knob engages the pass
+    (composing with recompute: the scan lands inside the prologue
+    __segment__ with the interior boundaries dropped from the checkpoint
+    list). Numeric parity lives in the slow-marked tests below."""
+    from paddle_tpu.models import bert
+    reset_programs(0)
+    cfg = bert.BertConfig(vocab_size=256, hidden_size=16, num_layers=4,
+                          num_heads=2, intermediate_size=32,
+                          max_position=32, seq_len=8,
+                          hidden_dropout=0.1, attention_dropout=0.1)
+    ids, labels, loss = bert.build_pretrain_program(cfg)
+    prog = fluid.default_main_program()
+    n_before = len(prog.global_block().ops)
+    consumed = apply_layer_scan(
+        prog, loss._layer_checkpoints,
+        startup_program=fluid.default_startup_program())
+    assert consumed == loss._layer_checkpoints[:-1]
+    gb = prog.global_block()
+    scan_ops = [op for op in gb.ops if op.type == "__layer_scan__"]
+    assert len(scan_ops) == 1
+    assert len(gb.ops) * 3 < n_before
+    assert scan_ops[0].attrs["num_layers"] == 4
+    # per-layer rng seeds (dropout) ride the scan as xs
+    assert any(s is not None and len(s) == 4
+               for s in scan_ops[0].attrs["layer_seeds"])
+    sv = gb.var("enc0_attn_qkv_w@LAYERS")
+    assert sv.persistable and tuple(sv.shape)[0] == 4
+    assert not gb.has_var("enc1_attn_qkv_w")         # per-layer params gone
+    assert prog._layer_stacks["enc0_attn_qkv_w@LAYERS"] == [
+        f"enc{i}_attn_qkv_w" for i in range(4)]
+    sb = fluid.default_startup_program().global_block()
+    stacks = [op for op in sb.ops if op.type == "stack"]
+    assert stacks and all(op.outputs["Y"][0].endswith("@LAYERS")
+                          for op in stacks)
+    assert not sb.vars["enc1_attn_qkv_w"].persistable
+
+    # fleet knob + recompute composition, build-only
+    from paddle_tpu.distributed import fleet
+    reset_programs(0)
+    ids, labels, loss = bert.build_pretrain_program(cfg)
+    fleet.init(is_collective=True)
+    s = fleet.DistributedStrategy()
+    s.layer_scan = True
+    s.recompute = True
+    s.recompute_configs = {"checkpoints": list(loss._layer_checkpoints)}
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.Adam(learning_rate=0.01), s)
+    opt.minimize(loss)
+    types = []
+    for op in fluid.default_main_program().global_block().ops:
+        types.append(op.type)
+        for od in op.attrs.get("sub_ops", []):
+            types.append(od["type"])
+            if od["type"] == "__layer_scan__":
+                assert od["attrs"]["remat"] is True   # remat-of-scan-body
+    assert "__layer_scan__" in types and "__segment__" in types
+
+    # clone(for_test) must flip is_test at EVERY sub_ops nesting depth
+    # (dropout descs inside the scan inside the recompute segment)
+    test_prog = fluid.default_main_program().clone(for_test=True)
+
+    def _check_descs(sub_ops, depth=0):
+        flipped = 0
+        for od in sub_ops:
+            if "is_test" in od["attrs"]:
+                assert od["attrs"]["is_test"] is True, (depth, od["type"])
+                flipped += 1
+            flipped += _check_descs(od["attrs"].get("sub_ops", []),
+                                    depth + 1)
+        return flipped
+
+    n_flipped = sum(_check_descs(op.attrs.get("sub_ops", []))
+                    for op in test_prog.global_block().ops)
+    assert n_flipped > 0        # the dropout descs were actually reached
+
+
+@pytest.mark.slow
+def test_rolled_bert_matches_unrolled():
+    """Acceptance: rolled tiny-BERT (4 layers, dropout ON) matches the
+    unrolled program's losses over two steps BIT-FOR-BIT (per-layer rng
+    seeds ride the scan as xs and fold into the run key exactly as the
+    unrolled ops fold their static seeds, so dropout masks agree), every
+    per-layer updated param slice matches to tolerance, remat=True
+    (remat-of-the-scan-body) changes nothing, the rolled program is
+    several times smaller, and the layer scan nests inside the k-step
+    run_steps training-loop scan."""
+    exe, feed, loss, cfg = _build_bert(False, dropout=0.1)
+    n_ops_unrolled = len(fluid.default_main_program().global_block().ops)
+    lu = [np.asarray(exe.run(feed=feed, fetch_list=[loss])[0])
+          for _ in range(2)]
+    params_u = {}
+    for i in range(cfg.num_layers):
+        for stem in ("attn_qkv_w", "attn_proj_w", "ffn_in_w", "ffn_out_w",
+                     "ln1_scale", "ln2_bias"):
+            n = f"enc{i}_{stem}"
+            params_u[n] = np.asarray(global_scope().find(n)).copy()
+    su = np.asarray(exe.run_steps(3, feed=feed, fetch_list=[loss])[0])
+
+    exe, feed, loss, cfg = _build_bert(True, dropout=0.1)
+    gb = fluid.default_main_program().global_block()
+    assert "__layer_scan__" in [op.type for op in gb.ops]
+    n_ops_rolled = len(gb.ops)
+    assert n_ops_rolled * 3 < n_ops_unrolled, (n_ops_rolled, n_ops_unrolled)
+    lr_ = [np.asarray(exe.run(feed=feed, fetch_list=[loss])[0])
+           for _ in range(2)]
+    np.testing.assert_array_equal(lr_[0], lu[0])    # bit-for-bit
+    np.testing.assert_allclose(lr_[1], lu[1], **TOL)
+    for i in range(cfg.num_layers):
+        for stem in ("attn_qkv_w", "attn_proj_w", "ffn_in_w", "ffn_out_w",
+                     "ln1_scale", "ln2_bias"):
+            stacked = np.asarray(
+                global_scope().find(f"enc0_{stem}@LAYERS"))
+            np.testing.assert_allclose(stacked[i],
+                                       params_u[f"enc{i}_{stem}"], **TOL)
+    sr = np.asarray(exe.run_steps(3, feed=feed, fetch_list=[loss])[0])
+    np.testing.assert_allclose(sr.ravel(), su.ravel(), **TOL)
+
+    exe, feed, loss, _ = _build_bert(True, dropout=0.1, remat=True)
+    lm = [np.asarray(exe.run(feed=feed, fetch_list=[loss])[0])
+          for _ in range(2)]
+    np.testing.assert_allclose(lm, lu, **TOL)
+
+
+@pytest.mark.slow
+def test_rolled_gpt_matches_unrolled():
+    """GPT rolls through its new _layer_checkpoints annotation; the tied
+    wte stays a loop-invariant (consumed by prologue AND epilogue, never
+    stacked)."""
+    from paddle_tpu.models import gpt
+
+    def build(rolled):
+        reset_programs(0)
+        cfg = gpt.GPTConfig(vocab_size=256, hidden_size=16, num_layers=4,
+                            num_heads=2, intermediate_size=32,
+                            max_position=32, seq_len=8, hidden_dropout=0.0,
+                            attention_dropout=0.0)
+        tokens, loss = gpt.build_lm_program(cfg)
+        if rolled:
+            assert apply_layer_scan(
+                fluid.default_main_program(), loss._layer_checkpoints,
+                startup_program=fluid.default_startup_program()) is not None
+        paddle.optimizer.Adam(learning_rate=0.01).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(2)
+        feed = {"tokens": rng.randint(0, cfg.vocab_size,
+                                      (4, cfg.seq_len)).astype(np.int64)}
+        return [np.asarray(exe.run(feed=feed, fetch_list=[loss])[0])
+                for _ in range(2)]
+
+    ref = build(False)
+    got = build(True)                 # rolled build last: scope assertions
+    np.testing.assert_allclose(got, ref, **TOL)
+    assert global_scope().find("wte") is not None      # tied table unstacked
+    assert global_scope().find("dec0_attn_qkv_w@LAYERS") is not None
+
+
+@pytest.mark.slow
+def test_rolled_hlo_instruction_count_under_40pct():
+    """Acceptance: the rolled step's optimized-HLO instruction count is
+    <= 40% of the unrolled step's at 8 tiny-BERT layers (the rolled count
+    is ~constant in L — the layer body compiles once). Audited through
+    the public Executor.compiled_hlo."""
+    def n_instr(txt):
+        return sum(1 for line in txt.splitlines() if " = " in line)
+
+    exe, feed, loss, _ = _build_bert(False, num_layers=8)
+    unrolled = n_instr(exe.compiled_hlo(feed, [loss]))
+    exe, feed, loss, _ = _build_bert(True, num_layers=8)
+    rolled = n_instr(exe.compiled_hlo(feed, [loss]))
+    assert rolled <= 0.40 * unrolled, (rolled, unrolled)
+
+
+@pytest.mark.slow
+def test_rolled_matches_unrolled_under_dp_and_tp_mesh():
+    """Stacked params compose with SPMD: the [L] axis stays unsharded and
+    the per-layer TP specs shift one dim right (parallel/mesh.py), so a
+    dp=2 and a tp=2 mesh both train to the same losses as unrolled."""
+    import jax
+    from paddle_tpu.models import bert
+    from paddle_tpu.parallel import DistConfig, attach, build_mesh
+
+    for axes in ({"dp": 2}, {"tp": 2}):
+        losses = {}
+        for rolled in (False, True):
+            exe, feed, loss, _ = _build_bert(rolled)
+            mesh = build_mesh(devices=jax.devices()[:2], **axes)
+            attach(fluid.default_main_program(),
+                   DistConfig(mesh=mesh,
+                              param_rules=bert.tp_sharding_rules()))
+            losses[rolled] = [
+                np.asarray(exe.run(feed=feed, fetch_list=[loss])[0])
+                for _ in range(2)]
+        np.testing.assert_allclose(losses[True], losses[False], **TOL)
+
+
+@pytest.mark.slow
+def test_fleet_strategy_layer_scan_knob():
+    """DistributedStrategy.layer_scan engages the pass at minimize time
+    (segments default to loss._layer_checkpoints); composing with
+    recompute rolls the scan with a remat body and drops the consumed
+    interior boundaries from the recompute checkpoint list (the scan op
+    then sits inside the prologue __segment__)."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.models import bert
+
+    def train(layer_scan, recompute=False):
+        reset_programs(0)
+        cfg = bert.BertConfig(vocab_size=256, hidden_size=16, num_layers=4,
+                              num_heads=2, intermediate_size=32,
+                              max_position=32, seq_len=8,
+                              hidden_dropout=0.0, attention_dropout=0.0)
+        ids, labels, loss = bert.build_pretrain_program(cfg)
+        fleet.init(is_collective=True)
+        s = fleet.DistributedStrategy()
+        s.layer_scan = layer_scan
+        if recompute:
+            s.recompute = True
+            s.recompute_configs = {
+                "checkpoints": list(loss._layer_checkpoints)}
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.Adam(learning_rate=0.01), s)
+        opt.minimize(loss)
+        types = []
+        for op in fluid.default_main_program().global_block().ops:
+            types.append(op.type)
+            types += [od["type"] for od in op.attrs.get("sub_ops", [])]
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(1)
+        feed = {"input_ids": rng.randint(0, 256, (8, 8)).astype(np.int64),
+                "mlm_labels": rng.randint(0, 256,
+                                          (8, 8, 1)).astype(np.int64)}
+        return ([np.asarray(exe.run(feed=feed, fetch_list=[loss])[0])
+                 for _ in range(2)], types)
+
+    base, t_off = train(False)
+    on, t_on = train(True)
+    assert "__layer_scan__" not in t_off and "__layer_scan__" in t_on
+    np.testing.assert_allclose(on, base, **TOL)
+    rc, t_rc = train(True, recompute=True)
+    assert "__layer_scan__" in t_rc and "__segment__" in t_rc
+    np.testing.assert_allclose(rc, base, **TOL)
+
+
+def test_non_isomorphic_segments_fall_back_unrolled():
+    """A segment whose op sequence differs (third fc lacks the relu)
+    leaves the program untouched — and still trainable — while an
+    isomorphic fc stack rolls and matches its unrolled twin (the pass is
+    model-agnostic)."""
+    reset_programs(0)
+    x = layers.data(name="x", shape=[8], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    h1 = layers.fc(x, 8, act="relu")
+    h2 = layers.fc(h1, 8, act="relu")
+    h3 = layers.fc(h2, 8)                      # no act: not isomorphic
+    loss = layers.mean(layers.square_error_cost(layers.fc(h3, 1), y))
+    prog = fluid.default_main_program()
+    n_before = len(prog.global_block().ops)
+    assert apply_layer_scan(prog, [h1.name, h2.name, h3.name]) is None
+    assert len(prog.global_block().ops) == n_before
+
+    def train(rolled):
+        reset_programs(3)
+        x = layers.data(name="x", shape=[8], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="float32")
+        h, bounds = x, []
+        for _ in range(3):
+            h = layers.fc(h, 8, act="relu")
+            bounds.append(h.name)
+        loss = layers.mean(layers.square_error_cost(layers.fc(h, 1), y))
+        if rolled:
+            assert apply_layer_scan(
+                fluid.default_main_program(), bounds,
+                startup_program=fluid.default_startup_program()) is not None
+        paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(5)
+        feed = {"x": rng.randn(16, 8).astype(np.float32),
+                "y": rng.randn(16, 1).astype(np.float32)}
+        return [float(np.asarray(exe.run(feed=feed,
+                                         fetch_list=[loss])[0]))
+                for _ in range(4)]
+
+    np.testing.assert_allclose(train(True), train(False), **TOL)
+
+
+@pytest.mark.slow
+def test_stacked_param_checkpoints_roundtrip(tmp_path):
+    """Stacked params flow through io.save_persistables/load_persistables
+    as ordinary [L, ...] persistables, AND an UNROLLED checkpoint's
+    per-layer entries load into a rolled program: the executor restacks
+    them on the next run (loaded per-layer values win over the
+    startup-stacked value) and drops the stale per-layer copies."""
+    from paddle_tpu import io
+    exe, feed, loss, _ = _build_bert(False)
+    io.save_persistables(exe, str(tmp_path), fluid.default_main_program())
+    l_ref = np.asarray(exe.run(feed=feed, fetch_list=[loss])[0])
+
+    exe, feed, loss, _ = _build_bert(True, seed=7)   # different init
+    io.load_persistables(exe, str(tmp_path), fluid.default_main_program())
+    l_rolled = np.asarray(exe.run(feed=feed, fetch_list=[loss])[0])
+    np.testing.assert_allclose(l_rolled, l_ref, **TOL)
+    assert global_scope().find("enc1_attn_qkv_w") is None, \
+        "stale per-layer scope entries must be dropped after restacking"
+
+    # rolled -> rolled round-trip of the stacked form
+    d2 = str(tmp_path) + "_rolled"
+    io.save_persistables(exe, d2, fluid.default_main_program())
+    before = np.asarray(
+        global_scope().find("enc0_attn_qkv_w@LAYERS")).copy()
+    l_next = np.asarray(exe.run(feed=feed, fetch_list=[loss])[0])
+    global_scope().set("enc0_attn_qkv_w@LAYERS", np.zeros_like(before))
+    io.load_persistables(exe, d2, fluid.default_main_program())
+    np.testing.assert_array_equal(
+        np.asarray(global_scope().find("enc0_attn_qkv_w@LAYERS")), before)
+    l_again = np.asarray(exe.run(feed=feed, fetch_list=[loss])[0])
+    np.testing.assert_allclose(l_again, l_next, **TOL)
