@@ -15,14 +15,19 @@ fetch blocks — the executor pipeline stays full.
 """
 from __future__ import annotations
 
-import glob
 import json
 import os
-import re
 import sys
 import time
 
 import numpy as np
+
+
+def _peak_flops():
+    """bf16 peak FLOP/s for MFU math (BENCH_PEAK_TFLOPS, default v5e=197).
+    ONE parse site: framework_tax inverts the mfu identity computed with
+    this value, so every consumer must agree on it."""
+    return float(os.environ.get("BENCH_PEAK_TFLOPS", "197")) * 1e12
 
 
 def _fresh_programs():
@@ -217,7 +222,7 @@ def bench_bert(batch, seq_len, steps, masked=False, large=False,
     feed = _device_feed(np_feed)
     dt, _ = _timed_steps(exe, feed, loss, steps)
     tokens_per_sec = batch * seq_len * steps / dt
-    peak = float(os.environ.get("BENCH_PEAK_TFLOPS", "197")) * 1e12
+    peak = _peak_flops()
     mfu = tokens_per_sec * 6.0 * n_params / peak
     return tokens_per_sec, mfu
 
@@ -258,7 +263,7 @@ def bench_gpt(batch, seq_len, steps):
                               (batch, seq_len)).astype(np.int64)})
     dt, _ = _timed_steps(exe, feed, loss, steps)
     tokens_per_sec = batch * seq_len * steps / dt
-    peak = float(os.environ.get("BENCH_PEAK_TFLOPS", "197")) * 1e12
+    peak = _peak_flops()
     mfu = tokens_per_sec * 6.0 * n_params / peak
     return tokens_per_sec, mfu
 
@@ -467,6 +472,14 @@ def _hbm_gbps_probe(mb=256):
     return bw
 
 
+# the canary's trainable-param count (4 layers x (qkv + 2 ffn mats) at
+# H=512, FF=2048) — framework_tax normalizes both sides to model FLOPs
+# (~6*params/token) so the mini canary compares against the BERT-base
+# primary row on the round-4 matched-geometry budget (paddle_tpu/
+# bench_gate.py)
+_CANARY_PARAMS = 4 * (512 * 3 * 512 + 2 * 512 * 2048)
+
+
 def _pure_jax_canary(steps=10):
     """Hand-written mini-transformer train step (4L/512H, batch 64,
     S=128, bf16, SGD, one lax.scan dispatch) — tokens/s with NO
@@ -490,6 +503,10 @@ def _pure_jax_canary(steps=10):
         p[f"qkv{i}"] = jax.random.normal(ks[0], (H, 3 * H)) * 0.02
         p[f"ff1{i}"] = jax.random.normal(ks[1], (H, FF)) * 0.02
         p[f"ff2{i}"] = jax.random.normal(ks[2], (FF, H)) * 0.02
+    # guard against the ACTUAL dict (not a re-derived formula): any edit to
+    # the canary's parameters must update _CANARY_PARAMS or the
+    # framework_tax normalization silently skews
+    assert _CANARY_PARAMS == sum(int(v.size) for v in p.values())
 
     x0 = jnp.ones((B, S, H), jnp.bfloat16)
 
@@ -526,32 +543,12 @@ def _pure_jax_canary(steps=10):
     return B * S * steps / dt
 
 
-def _prev_recorded_value():
-    """Newest BENCH_r*.json that actually recorded a number.
-
-    Records are driver envelopes ({"parsed": {"value": ...}}) or bare metric
-    lines; a round whose bench failed has parsed=null — skip it rather than
-    resetting vs_baseline to 1.0 (round 2's failed record must not erase the
-    round-1 comparison point).
-    """
-    recs = sorted(glob.glob("BENCH_r*.json"),
-                  key=lambda p: int(re.search(r"r(\d+)", p).group(1)))
-    for p in reversed(recs):
-        try:
-            with open(p) as f:
-                d = json.load(f)
-        except Exception:
-            continue
-        if d.get("tunnel_degraded") or (
-                isinstance(d.get("parsed"), dict)
-                and d["parsed"].get("tunnel_degraded")):
-            continue   # a degraded-window number is not a comparison point
-        v = d.get("value")
-        if v is None and isinstance(d.get("parsed"), dict):
-            v = d["parsed"].get("value")
-        if isinstance(v, (int, float)) and v > 0:
-            return float(v)
-    return None
+# Gate logic (degraded detection, canary skip, row gating, vs_baseline
+# history selection, framework-tax bounds) lives in paddle_tpu/bench_gate.py
+# — importable + unit-tested with synthetic probe values
+# (tests/test_bench_gate.py), because a wrong gate silently poisons the
+# project's only perf record (VERDICT round 5, weak #3).
+from paddle_tpu import bench_gate as _gate  # noqa: E402
 
 
 def main():
@@ -599,22 +596,11 @@ def main():
             print(f"HBM probe failed: {e!r}", file=sys.stderr)
         return t, g
 
-    CANARY_MIN_TPS = 20000.0
-
-    def _is_degraded(t, g, c=None):
-        # three independent failure axes, all seen in rounds 4-5: the
-        # MXU path, the device-memory path, and end-to-end program
-        # execution (the pure-jax canary — a window can pass both
-        # microprobes while real training programs run 20x slow)
-        return ((t is not None and t < 30)
-                or (g is not None and g < 50)
-                or (c is not None and c < CANARY_MIN_TPS))
-
     def _canary_probe(t, g, label="pure-jax canary"):
         # once a microprobe axis has already failed, the canary adds no
         # information and a full-size run could take minutes on a
         # 10-250x degraded path — skip it
-        if _is_degraded(t, g):
+        if _gate.should_skip_canary(t, g):
             _log(f"{label}: skipped (microprobe axis already degraded)")
             return None
         try:
@@ -638,8 +624,8 @@ def main():
             wait = 600
         # a degraded tunnel sometimes recovers with quiet — one bounded
         # wait before measuring
-        if on_tpu and _is_degraded(health_tflops, hbm_gbps, canary_tps) \
-                and wait > 0:
+        if on_tpu and _gate.is_degraded(health_tflops, hbm_gbps,
+                                        canary_tps) and wait > 0:
             _log(f"tunnel degraded; quiet {wait}s then re-probe")
             time.sleep(wait)
             health_tflops, hbm_gbps = _probe_both()
@@ -651,7 +637,7 @@ def main():
         # Shrink the step count (the number is stamped tunnel_degraded
         # and never used as a comparison point anyway) and skip the
         # expensive extras below.
-        degraded = _is_degraded(health_tflops, hbm_gbps, canary_tps)
+        degraded = _gate.is_degraded(health_tflops, hbm_gbps, canary_tps)
         if degraded:
             steps = min(steps, 4)
             _log(f"degraded mode: steps={steps}, extras trimmed")
@@ -678,16 +664,9 @@ def main():
         budget = float(os.environ.get("BENCH_TIME_BUDGET", "2700"))
     except ValueError:
         budget = 2700.0
-    skipped_rows = []
-
-    def _row_ok(name):
-        if degraded:
-            skipped_rows.append(f"{name} (degraded chip)")
-            return False
-        if time.perf_counter() - _T0 > budget:
-            skipped_rows.append(f"{name} (time budget {budget:.0f}s)")
-            return False
-        return True
+    row_gate = _gate.RowGate(degraded, _T0, budget)
+    _row_ok = row_gate.ok
+    skipped_rows = row_gate.skipped
 
     extras = []
     if tokens_per_sec is not None and which in ("all", "masked") \
@@ -768,7 +747,7 @@ def main():
         try:
             ips = bench_resnet50(int(os.environ.get("BENCH_RESNET_BATCH",
                                                     "64")), steps)
-            peak = float(os.environ.get("BENCH_PEAK_TFLOPS", "197")) * 1e12
+            peak = _peak_flops()
             extras.append({"metric": "resnet50_train_images_per_sec_per_chip",
                            "value": round(ips, 1), "unit": "images/s",
                            "mfu": round(
@@ -788,7 +767,7 @@ def main():
             print(f"wide&deep bench failed: {e!r}", file=sys.stderr)
             errors.append(f"wide&deep: {e!r}")
 
-    prev = _prev_recorded_value()
+    prev = _gate.load_prev_recorded()
     rec = {
         "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1) if tokens_per_sec else None,
@@ -810,7 +789,22 @@ def main():
         rec["device_hbm_read_gbps_probe"] = round(hbm_gbps, 1)
     if canary_tps is not None:
         rec["pure_jax_canary_tokens_per_sec"] = round(canary_tps, 1)
-        if (tokens_per_sec and canary_tps > CANARY_MIN_TPS
+        # framework tax (VERDICT round-5 item 7): the tracked
+        # FLOPs-normalized canary-vs-primary ratio with the round-4 ~14%
+        # gap as budget — the early warning that would have caught the
+        # round-5 20x state a round earlier. Primary params recovered
+        # from the mfu identity (mfu = tps * 6 * params / peak).
+        peak = _peak_flops()
+        primary_params = (mfu * peak / (6.0 * tokens_per_sec)
+                          if mfu and tokens_per_sec else None)
+        tax = _gate.framework_tax(tokens_per_sec, canary_tps,
+                                  primary_params, _CANARY_PARAMS)
+        if tax is not None:
+            rec["framework_tax"] = round(tax, 3)
+            rec["framework_tax_budget"] = _gate.FRAMEWORK_TAX_BUDGET
+            if _gate.framework_tax_alert(tax):
+                rec["framework_tax_alert"] = True
+        if (tokens_per_sec and canary_tps > _gate.CANARY_MIN_TPS
                 and tokens_per_sec < canary_tps / 5):
             # microprobes + canary healthy but the framework step is far
             # below the canary: an execution anomaly specific to
@@ -820,7 +814,7 @@ def main():
             rec["framework_env_anomaly"] = True
     if (health_tflops is not None or hbm_gbps is not None
             or canary_tps is not None):
-        if _is_degraded(health_tflops, hbm_gbps, canary_tps):
+        if _gate.is_degraded(health_tflops, hbm_gbps, canary_tps):
             # framework-free evidence: the chip/tunnel itself is running
             # far below its bf16 peak in this window (docs/perf_notes.md
             # round-5 notes), so tok/s here is not comparable to healthy

@@ -1,0 +1,171 @@
+"""Health-gate logic for bench.py, extracted to an importable module.
+
+bench.py is the project's ONLY perf record: a wrong gate silently
+poisons every `vs_baseline` comparison that follows (VERDICT round 5,
+weak #3). The gating decisions therefore live here, framework-free and
+unit-tested with synthetic probe values (tests/test_bench_gate.py),
+while bench.py keeps only the probing/measuring code.
+
+Three independent health axes, all seen failing in rounds 4-5:
+
+* the MXU path (`device_bf16_tflops_probe`, scalar-drain matmul chain),
+* the device-memory path (`device_hbm_read_gbps_probe`, amortized
+  bandwidth loop),
+* end-to-end program execution (the pure-jax canary — round 5 hit a
+  window where both microprobes were healthy yet real training programs
+  ran 20x slow).
+
+A window failing ANY axis is `tunnel_degraded`: its numbers are
+recorded but never used as comparison points, and expensive extra rows
+are skipped. The canary itself is skipped once a microprobe axis has
+already failed (it adds no information and could take minutes on a
+degraded path).
+
+`framework_tax` (VERDICT round-5 item 7) is the canary-vs-primary ratio
+recorded on every healthy row: pure-jax canary tok/s / framework BERT
+tok/s, normalized by the round-4 measured geometry gap. The round-4
+measured ~14% gap is the budget; above ~20% the record carries
+`framework_tax_alert` — the tracked early warning that would have
+caught the round-5 20x state a round earlier.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import re
+import time
+from typing import List, Optional, Sequence
+
+# degraded-mode thresholds (rounds 4-5 measured healthy floors: MXU
+# 140 TF/s scalar-drain, HBM 267 GB/s amortized, canary 205k tok/s)
+MIN_TFLOPS = 30.0
+MIN_HBM_GBPS = 50.0
+CANARY_MIN_TPS = 20000.0
+
+# framework tax: FLOPs-normalized pure-jax-canary tok/s over framework
+# tok/s. The canary (4L/512H mini transformer) does ~10x less work per
+# token than the BERT-base primary row, so the raw tok/s ratio is
+# meaningless; normalizing both sides by model params (FLOPs/token ~
+# 6*params) makes the ratio comparable to round 4's matched-geometry
+# measurement: pure-jax 149,677 tok/s at 108M params vs the framework's
+# ~131k no-dropout ceiling = the ~14% budget. Above ~20% the record
+# carries an alert — the round-5 failure mode (framework-shaped
+# programs degraded while pure-jax stays fast) trips it instantly
+# (tax there was ~20x).
+#
+# CALIBRATION CAVEAT: the 1.14/1.20 bounds were measured at MATCHED
+# geometry, but the ratio bench.py records uses the mini canary, whose
+# achievable per-FLOP throughput at H=512 differs from BERT-base — the
+# healthy value of THIS ratio has never been measured and may sit below
+# 1.0 (small matmuls run at lower MFU). The catastrophic class the
+# alert exists for (round 5's ~20x) trips it regardless of that offset;
+# a mild 2-3x regression might not until the first healthy window
+# re-pins the budget to the ratio's measured healthy value. Every
+# record carries the raw tax, so recalibration is one field edit here.
+FRAMEWORK_TAX_BUDGET = 1.14
+FRAMEWORK_TAX_ALERT = 1.20
+
+
+def is_degraded(tflops: Optional[float], gbps: Optional[float],
+                canary_tps: Optional[float] = None) -> bool:
+    """True when ANY health axis reads below its floor. Missing probes
+    (None) are inconclusive, never degraded — a failed probe read must
+    not zero the round by itself."""
+    return ((tflops is not None and tflops < MIN_TFLOPS)
+            or (gbps is not None and gbps < MIN_HBM_GBPS)
+            or (canary_tps is not None and canary_tps < CANARY_MIN_TPS))
+
+
+def should_skip_canary(tflops: Optional[float],
+                       gbps: Optional[float]) -> bool:
+    """Once a microprobe axis has failed, the canary adds no information
+    and a full-size run could take minutes on a 10-250x degraded path."""
+    return is_degraded(tflops, gbps)
+
+
+def framework_tax(primary_tps: Optional[float],
+                  canary_tps: Optional[float],
+                  primary_params: Optional[float] = None,
+                  canary_params: Optional[float] = None) -> Optional[float]:
+    """FLOPs-normalized framework tax:
+
+        (canary_tps * canary_params) / (primary_tps * primary_params)
+
+    i.e. pure-jax model-FLOPs-throughput over framework model-FLOPs-
+    throughput (~1.0 = no tax). Without the params the raw tok/s ratio
+    is returned — only comparable across rounds, not to the budget.
+    None when either side is absent or the canary itself reads degraded
+    (then the ratio reflects the environment, not the framework)."""
+    if not primary_tps or not canary_tps:
+        return None
+    if canary_tps < CANARY_MIN_TPS:
+        return None
+    ratio = canary_tps / primary_tps
+    if primary_params and canary_params:
+        ratio *= canary_params / primary_params
+    return ratio
+
+
+def framework_tax_alert(tax: Optional[float]) -> bool:
+    return tax is not None and tax > FRAMEWORK_TAX_ALERT
+
+
+class RowGate:
+    """Decides whether an optional bench row may run: refused on a
+    degraded chip (each row would take 10-250x its normal time) and
+    past the wall-clock budget (the one JSON line must print before any
+    driver-side timeout). Skips are recorded with reasons for the
+    bench record."""
+
+    def __init__(self, degraded: bool, t0: float, budget_s: float,
+                 now=time.perf_counter):
+        self.degraded = bool(degraded)
+        self.t0 = float(t0)
+        self.budget_s = float(budget_s)
+        self._now = now
+        self.skipped: List[str] = []
+
+    def ok(self, name: str) -> bool:
+        if self.degraded:
+            self.skipped.append(f"{name} (degraded chip)")
+            return False
+        if self._now() - self.t0 > self.budget_s:
+            self.skipped.append(f"{name} (time budget {self.budget_s:.0f}s)")
+            return False
+        return True
+
+
+def prev_recorded_value(records: Sequence[dict]) -> Optional[float]:
+    """Newest record (last in sequence) that holds a usable comparison
+    point. Records are driver envelopes ({"parsed": {"value": ...}}) or
+    bare metric dicts; entries stamped `tunnel_degraded` (either level)
+    are measurement artifacts of a broken window and NEVER comparison
+    points; a round whose bench failed has parsed=null — skipped rather
+    than resetting vs_baseline to 1.0."""
+    for d in reversed(list(records)):
+        if not isinstance(d, dict):
+            continue
+        if d.get("tunnel_degraded") or (
+                isinstance(d.get("parsed"), dict)
+                and d["parsed"].get("tunnel_degraded")):
+            continue
+        v = d.get("value")
+        if v is None and isinstance(d.get("parsed"), dict):
+            v = d["parsed"].get("value")
+        if isinstance(v, (int, float)) and v > 0:
+            return float(v)
+    return None
+
+
+def load_prev_recorded(pattern: str = "BENCH_r*.json") -> Optional[float]:
+    """File-reading wrapper over prev_recorded_value: globs the round
+    records in round order and ignores unreadable files."""
+    records = []
+    for p in sorted(glob.glob(pattern),
+                    key=lambda p: int(re.search(r"r(\d+)", p).group(1))):
+        try:
+            with open(p) as f:
+                records.append(json.load(f))
+        except Exception:
+            continue
+    return prev_recorded_value(records)

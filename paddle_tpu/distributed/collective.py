@@ -49,7 +49,7 @@ def _value(x):
 
 @functools.lru_cache(maxsize=64)
 def _allreduce_fn(mesh, axis, op):
-    from jax.experimental.shard_map import shard_map
+    from ..utils.jax_compat import shard_map
     if op == "prod":
         # no pprod primitive: gather shards then reduce on each device
         def body(v):

@@ -28,6 +28,15 @@ _DEFS: Dict[str, tuple] = {
     "FLAGS_profile_start_step": (-1, "auto-start profiler at this step"),
     "FLAGS_profile_stop_step": (-1, "auto-stop profiler at this step"),
     "FLAGS_tensor_array_capacity": (128, "default LoDTensorArray capacity"),
+    "FLAGS_min_donate_bytes": (65536, "buffer-donation size floor for "
+                               "written persistable state: smaller buffers "
+                               "are passed un-donated, because donating a "
+                               "tiny buffer saves almost nothing while its "
+                               "in-place aliasing makes XLA insert a "
+                               "value-preserving copy op whenever the "
+                               "update's live range crosses a remaining "
+                               "read (docs/perf_notes.md 'Copy census'); "
+                               "0 donates everything"),
     "FLAGS_layer_scan": (False, "roll isomorphic per-layer segments into "
                                 "one lax.scan at fleet minimize time "
                                 "(parallel/transforms.apply_layer_scan; "

@@ -40,9 +40,36 @@ class _CompiledBlock:
         self.written_state: List[str] = self._written_persistables()
         written = set(self.written_state)
         # donate only buffers that get overwritten (params/opt state); purely
-        # read state stays un-donated so XLA keeps it resident
-        self.mut_names = [n for n in self.state_names if n in written]
-        self.ro_names = [n for n in self.state_names if n not in written]
+        # read state stays un-donated so XLA keeps it resident. In the
+        # PER-STEP path, written buffers BELOW the FLAGS_min_donate_bytes
+        # floor are also left un-donated: donating a tiny buffer (an Adam
+        # beta-pow, a LayerNorm scale) saves a few bytes of HBM but forces
+        # in-place aliasing, and whenever XLA schedules the update before a
+        # remaining read of the old value it must insert a value-preserving
+        # copy op — at BERT scale those tiny-state copies dominated the
+        # compiled step's copy census (docs/perf_notes.md "Copy census").
+        # Un-donated writes just come back as fresh buffers the Scope
+        # adopts. The k-step scan path donates EVERYTHING written: the scan
+        # carry's buffers alias in place regardless (so the floor cannot
+        # remove in-body copies there), while an un-donated input would add
+        # an entry copy INTO the carry.
+        from ..flags import flag
+        floor = 0 if multi_k else int(flag("FLAGS_min_donate_bytes") or 0)
+
+        def _donate_ok(n):
+            if n not in written:
+                return False
+            if floor <= 0:
+                return True
+            shp = (state_shapes or {}).get(n)
+            if shp is None:
+                v = self.block.find_var_recursive(n)
+                shp = tuple(v.shape) if v is not None else ()
+            return _buffer_nbytes(self.block, n, shp) >= floor
+
+        self.mut_names = [n for n in self.state_names if _donate_ok(n)]
+        mut_set = set(self.mut_names)
+        self.ro_names = [n for n in self.state_names if n not in mut_set]
         micro_k = getattr(program, "_microbatch_k", 0)
         if multi_k:      # any k >= 1: feeds always carry the leading [k] axis
             runner = functools.partial(_run_block_multistep, multi_k)
@@ -142,7 +169,7 @@ class _LocalSGDBlock:
                  feed_names: Sequence[str], fetch_names: Sequence[str],
                  state_names: Sequence[str], k: int):
         import jax.numpy as jnp
-        from jax.experimental.shard_map import shard_map
+        from ..utils.jax_compat import shard_map
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         self.program = program
@@ -188,8 +215,7 @@ class _LocalSGDBlock:
                           {n: P("dp") for n in self.feed_names},
                           P()),
                 out_specs=([P("dp")] * len(self.fetch_names),
-                           {n: P("dp") for n in self.written_state}),
-                check_rep=False)
+                           {n: P("dp") for n in self.written_state}))
             return jax.jit(sm, donate_argnums=(0,))
 
         self._fn_local = make(False)
@@ -238,6 +264,19 @@ class _LocalSGDBlock:
         fetches = [gather(f) for f in fetches]
         logical = {n: v[0] for n, v in new_tiled.items()} if sync else {}
         return fetches, logical
+
+
+def _buffer_nbytes(block, name, shape) -> int:
+    """Size in bytes of a state buffer (donation-floor decisions)."""
+    v = block.find_var_recursive(name)
+    try:
+        itemsize = np.dtype(v.dtype).itemsize if v is not None else 4
+    except TypeError:
+        itemsize = 4
+    n = 1
+    for d in shape or ():
+        n *= max(int(d), 1)
+    return n * itemsize
 
 
 # Stack of programs being traced; sub-block ops (__cond__ etc.) look up their
@@ -310,12 +349,16 @@ def _run_block_multistep(k_steps, block, feed_names, fetch_names, mut_names,
 
     import jax.numpy as jnp
 
-    # Written persistables NOT seeded in the scope (rare: vars first
-    # materialized by the program itself) must still carry step-to-step —
-    # run() gets that via the scope between calls. Discover their shapes
-    # with eval_shape and seed the carry with zeros; the body overwrites
-    # them before any legal read (run() would KeyError on read-before-
-    # write anyway). Carrying beats stacking them as scan ys ([k, ...]
+    # Written persistables NOT in the donated mut set must still carry
+    # step-to-step. Today that is only vars first materialized by the
+    # program itself, absent from the scope entirely (seed zeros; the body
+    # overwrites them before any legal read — run() would KeyError on
+    # read-before-write anyway): the k-step path donates ALL written state,
+    # so the donation floor never routes written names into ro_state here.
+    # The ro_state lookup is defensive — if that donation policy ever
+    # changes, scope-backed state must seed the carry with its REAL value,
+    # and zeros would silently corrupt it (Adam beta-pows). Discover shapes
+    # with eval_shape. Carrying beats stacking them as scan ys ([k, ...]
     # HBM for values only [-1] of which is used).
     feeds0 = jax.tree_util.tree_map(lambda a: a[0], feeds)
     _, st_shapes = jax.eval_shape(
@@ -323,8 +366,9 @@ def _run_block_multistep(k_steps, block, feed_names, fetch_names, mut_names,
                                     mut_names, ro_names, written_state,
                                     m, ro_state, f, kk),
         mut_state, feeds0, jax.random.key(0))
-    extra0 = {n: jnp.zeros(s.shape, s.dtype) for n, s in st_shapes.items()
-              if n not in mut_state}
+    extra0 = {n: (ro_state[n] if n in ro_state
+                  else jnp.zeros(s.shape, s.dtype))
+              for n, s in st_shapes.items() if n not in mut_state}
 
     def body(carry, xs):
         mut, extra = carry
@@ -566,6 +610,40 @@ def _ensure_stacked_params(program, scope):
                 scope.erase(p)
 
 
+def _ensure_shared_beta_pows(program, scope):
+    """Legacy-checkpoint adoption for the shared Adam beta-pow pair
+    (optimizer.py _create_accumulators): checkpoints written before the
+    sharing carry one `<param>_beta{1,2}_pow_acc_0` entry PER PARAM — all
+    holding the identical beta^t. When such entries are in the scope (an
+    old checkpoint was just loaded; fresh programs never create them),
+    adopt their value into the shared var and drop the stale copies, so
+    resume keeps the correct bias-correction step instead of silently
+    restarting at beta^1. Mirrors _ensure_stacked_params: loaded legacy
+    values win; only the program's own RECORDED legacy names are ever
+    touched (an exact closed list — O(1) lookups per name, and another
+    live program's shared pow var can never be mistaken for legacy
+    state). Entries that DISAGREE are left untouched (two legacy
+    optimizers with different betas — ambiguous, never guess)."""
+    shared = getattr(program, "_shared_beta_pows", None)
+    if not shared:
+        return
+    import jax.numpy as jnp
+    gb = program.global_block()
+    for sname, legacy_names in shared.items():
+        legacy = [n for n in legacy_names
+                  if n != sname and not gb.has_var(n) and scope.has(n)]
+        if not legacy:
+            continue
+        vals = [np.asarray(scope.find(n)).reshape(-1) for n in legacy]
+        if any(v.shape != (1,) for v in vals):
+            continue
+        if any(abs(float(v[0]) - float(vals[0][0])) > 1e-12 for v in vals):
+            continue        # ambiguous legacy state: adopt nothing
+        scope.set(sname, jnp.asarray(vals[0], jnp.float32))
+        for n in legacy:
+            scope.erase(n)
+
+
 def _referenced_state_names(block, scope, feed_vals):
     """Persistable vars that already have values in the scope and are
     referenced by this block (run()/run_steps() shared)."""
@@ -588,6 +666,32 @@ def _block_cache_key(program, feed_vals, fetch_names, state_names):
                              for k, v in feed_vals.items()))
     return (program._uid, program._version, feed_spec, tuple(fetch_names),
             tuple(state_names))
+
+
+def _multi_step_feed_vals(gb, feed, k):
+    """Normalize run_steps feeds to a leading [k] steps axis (shared by
+    run_steps() and compiled_hlo(k=...)): rank==var rank broadcasts the
+    same batch to every step; rank+1 with dim0==k is per-step slices;
+    anything else is ambiguous -> typed error, no silent mis-slicing."""
+    import jax.numpy as jnp
+    from . import errors
+    feed_vals = {}
+    for name, value in feed.items():
+        arr = jnp.asarray(_coerce_feed_value(gb, name, value))
+        v = gb.find_var_recursive(name)
+        if v is not None and arr.ndim == len(v.shape) + 1 \
+                and arr.shape[0] == k:
+            pass                                 # per-step slices
+        elif v is None or arr.ndim == len(v.shape):
+            arr = jnp.broadcast_to(arr[None], (k,) + tuple(arr.shape))
+        else:
+            raise errors.InvalidArgument(
+                "run_steps feed %r: shape %s matches neither the "
+                "per-step var shape %s nor [k=%d] + that shape", name,
+                tuple(arr.shape),
+                tuple(v.shape) if v is not None else None, k)
+        feed_vals[name] = arr
+    return feed_vals
 
 
 def _prewarm_flash_ops(program):
@@ -657,6 +761,7 @@ class Executor:
         feed_vals = {name: _coerce_feed_value(block, name, value)
                      for name, value in feed.items()}
         _ensure_stacked_params(program, scope)
+        _ensure_shared_beta_pows(program, scope)
         state_names = _referenced_state_names(block, scope, feed_vals)
 
         key = _block_cache_key(program, feed_vals, fetch_names, state_names)
@@ -752,7 +857,6 @@ class Executor:
         push after (_PsHook.pre_multi/post_multi — the reference's async
         communicator batching). Not supported: Geo-SGD or dense-send hooks,
         pipeline / LocalSGD programs, heter sections."""
-        import jax.numpy as jnp
         program = program or default_main_program()
         if hasattr(program, "_is_data_parallel"):
             program = program.program
@@ -800,28 +904,9 @@ class Executor:
                 feed.update(h.pre_multi(feed))
                 if gb.has_var(h.grad_name) and h.grad_name not in fetch_names:
                     fetch_names.append(h.grad_name)
-        feed_vals = {}
-        for name, value in feed.items():
-            arr = jnp.asarray(_coerce_feed_value(gb, name, value))
-            v = gb.find_var_recursive(name)
-            # every scan xs leaf needs a leading [k] axis: a feed whose rank
-            # equals the var's (or any unknown-name feed) is the SAME batch
-            # every step -> broadcast; rank+1 with dim0==k is per-step
-            # slices; anything else is ambiguous -> typed error, no silent
-            # mis-slicing
-            if v is not None and arr.ndim == len(v.shape) + 1 \
-                    and arr.shape[0] == k:
-                pass                                 # per-step slices
-            elif v is None or arr.ndim == len(v.shape):
-                arr = jnp.broadcast_to(arr[None], (k,) + tuple(arr.shape))
-            else:
-                raise errors.InvalidArgument(
-                    "run_steps feed %r: shape %s matches neither the "
-                    "per-step var shape %s nor [k=%d] + that shape", name,
-                    tuple(arr.shape),
-                    tuple(v.shape) if v is not None else None, k)
-            feed_vals[name] = arr
+        feed_vals = _multi_step_feed_vals(gb, feed, k)
         _ensure_stacked_params(program, scope)
+        _ensure_shared_beta_pows(program, scope)
         state_names = _referenced_state_names(gb, scope, feed_vals)
         key = ("multi", k) + _block_cache_key(program, feed_vals,
                                               fetch_names, state_names)
@@ -998,17 +1083,20 @@ class Executor:
         return fetched
 
     def compiled_hlo(self, feed=None, fetch_list=None, program=None,
-                     scope=None):
+                     scope=None, k=None):
         """Optimized-HLO text of the jitted step for this (feed, fetch)
         signature — the PUBLIC surface for compile-stats tooling
-        (scripts/collective_audit.py, HLO-structure tests) that previously
-        poked `exe._cache` internals. Shares run()'s compile cache (same
-        key), so calling after run() reuses the compiled block and calling
-        before run() pre-populates it. The program is only lowered and
-        compiled, never executed: donation marks do not consume the
-        scope's buffers. Requires initialized state (run the startup
-        program first); pipeline/LocalSGD/PS programs are not supported —
-        their steps are not one jitted computation."""
+        (scripts/collective_audit.py, scripts/copy_audit.py, HLO-structure
+        tests) that previously poked `exe._cache` internals. Shares run()'s
+        compile cache (same key), so calling after run() reuses the
+        compiled block and calling before run() pre-populates it. With
+        `k`, the run_steps(k) device-side training-loop program is lowered
+        instead (same cache as run_steps — the copy/collective census of
+        the k-step dispatch is what executes on hardware). The program is
+        only lowered and compiled, never executed: donation marks do not
+        consume the scope's buffers. Requires initialized state (run the
+        startup program first); pipeline/LocalSGD/PS programs are not
+        supported — their steps are not one jitted computation."""
         import jax.numpy as jnp
 
         from . import errors
@@ -1025,6 +1113,15 @@ class Executor:
                 int(dist.resolve_mesh().shape.get("pp", 1)) > 1:
             raise errors.Unimplemented(
                 "compiled_hlo over a pp>1 mesh (per-stage programs)")
+        if k is not None:
+            if isinstance(k, bool) or not isinstance(k, (int, np.integer)) \
+                    or k < 1:
+                raise errors.InvalidArgument(
+                    "compiled_hlo k=%r: needs an integer k >= 1", k)
+            if getattr(program, "_microbatch_k", 0):
+                raise errors.Unimplemented(
+                    "compiled_hlo k=%d on a pipeline (microbatched) "
+                    "program — run_steps does not take those", int(k))
         feed = feed or {}
         fetch_list = fetch_list or []
         scope = scope or global_scope()
@@ -1036,16 +1133,23 @@ class Executor:
                 raise errors.NotFound(
                     "fetch target %r is not a variable of this program", n,
                     var=n)
-        feed_vals = {name: _coerce_feed_value(block, name, value)
-                     for name, value in feed.items()}
+        if k is not None:
+            feed_vals = _multi_step_feed_vals(block, feed, int(k))
+        else:
+            feed_vals = {name: _coerce_feed_value(block, name, value)
+                         for name, value in feed.items()}
         _ensure_stacked_params(program, scope)
+        _ensure_shared_beta_pows(program, scope)
         state_names = _referenced_state_names(block, scope, feed_vals)
         key = _block_cache_key(program, feed_vals, fetch_names, state_names)
+        if k is not None:
+            key = ("multi", int(k)) + key
         compiled = self._cache.get(key)
         if compiled is None:
             _prewarm_flash_ops(program)
             compiled = _make_compiled_block(program, feed_vals, fetch_names,
-                                            state_names, scope)
+                                            state_names, scope,
+                                            multi_k=int(k) if k else 0)
             self._cache[key] = compiled
         if not isinstance(compiled, _CompiledBlock):
             raise errors.Unimplemented(
@@ -1053,7 +1157,7 @@ class Executor:
                 "single jitted block")
         mut = {n: scope.find(n) for n in compiled.mut_names}
         ro = {n: scope.find(n) for n in compiled.ro_names}
-        feeds = {k: jnp.asarray(v) for k, v in feed_vals.items()}
+        feeds = {n: jnp.asarray(v) for n, v in feed_vals.items()}
         return compiled.jitted.lower(
             mut, ro, feeds, jax.random.key(0)).compile().as_text()
 

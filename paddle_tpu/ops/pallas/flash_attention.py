@@ -37,6 +37,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# API drift: new jax names the TPU compiler-params struct
+# pltpu.CompilerParams; 0.4.x calls it TPUCompilerParams — same fields
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 _LANES = 128  # Mosaic lane width; lse stored broadcast over it
 
 
@@ -267,7 +272,7 @@ def _flash_fwd(q, k, v, seed, mask, scale, causal, dropout, block_q, block_k,
             jax.ShapeDtypeStruct((b * nh, s, hd), q.dtype),
             jax.ShapeDtypeStruct((b * nh, s, _LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel")),
         interpret=_interpret(),
     )(*operands)
@@ -448,7 +453,7 @@ def _flash_bwd(q, k, v, o, lse, do, seed, mask, scale, causal, dropout,
         in_specs=dq_specs,
         out_specs=pl.BlockSpec((None, bq, hd), lambda h, i: (h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * nh, s, hd), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel")),
         interpret=_interpret(),
     )(*dq_operands)
@@ -481,7 +486,7 @@ def _flash_bwd(q, k, v, o, lse, do, seed, mask, scale, causal, dropout,
             jax.ShapeDtypeStruct((b * nh, s, hd), k.dtype),
             jax.ShapeDtypeStruct((b * nh, s, hd), v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel")),
         interpret=_interpret(),
     )(*dkdv_operands)

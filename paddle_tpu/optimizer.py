@@ -115,6 +115,13 @@ class Optimizer:
     def _create_accumulators(self, block, parameters):
         pass
 
+    def _finalize_optimize_ops(self, block):
+        """Ops appended ONCE after the per-parameter update ops (e.g. the
+        shared beta-pow advance, reference optimizer.py _finish_update).
+        Returns the list of appended Operators so wrappers (gradient merge)
+        can gate their state writes like any other optimizer op."""
+        return []
+
     # -- public API ---------------------------------------------------------
     def backward(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
@@ -135,6 +142,8 @@ class Optimizer:
             op = self._append_optimize_op(block, pg)
             if op is not None:
                 op.attrs["op_role"] = OpRole.Optimize
+        for op in self._finalize_optimize_ops(block):
+            op.attrs["op_role"] = OpRole.Optimize
         return []
 
     def _append_regularization(self, params_grads):
@@ -265,32 +274,77 @@ class AdamOptimizer(Optimizer):
         self.type = "adam"
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
 
+    # The beta-pow accumulators are SHARED across parameters: every
+    # per-param pow holds the identical value beta^t at every step, and one
+    # [1]-buffer per param per beta costs an in-place-aliasing copy per step
+    # in the compiled program — 2N copy ops that dominated the copy census
+    # of the BERT train step (docs/perf_notes.md "Copy census"). The pair
+    # advances ONCE per step via _finalize_optimize_ops, after every adam op
+    # has read the old value (reference AdamOptimizer._finish_update appends
+    # its pow scales after the update ops for the same reason).
+    def _shared_pow_accumulator(self, idx, beta):
+        accs = self._accumulators.setdefault(f"beta{idx}_pow_acc", {})
+        if "@SHARED@" not in accs:
+            var = layers.create_global_var(
+                [1], beta, "float32", persistable=True,
+                name=unique_name.generate(f"{self.type}_beta{idx}_pow_acc"))
+            accs["@SHARED@"] = var
+        return accs["@SHARED@"]
+
     def _create_accumulators(self, block, parameters):
         for p in parameters:
             self._add_accumulator("moment1", p)
             self._add_accumulator("moment2", p)
-            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1,
-                                  shape=[1])
-            self._add_accumulator("beta2_pow_acc", p, fill_value=self._beta2,
-                                  shape=[1])
+        for idx, beta in ((1, self._beta1), (2, self._beta2)):
+            var = self._shared_pow_accumulator(idx, beta)
+            # record the EXACT legacy-checkpoint names this shared var
+            # supersedes (checkpoints written before the sharing carried
+            # one <param>_beta{idx}_pow_acc_<n> per param) so the
+            # executor's adoption hook (_ensure_shared_beta_pows) can do
+            # O(1) lookups against a closed list — never a scope scan,
+            # and never another live program's shared pow var
+            prog = var.block.program
+            reg = dict(getattr(prog, "_shared_beta_pows", {}))
+            names = set(reg.get(var.name, ()))
+            names.update(f"{p.name}_beta{idx}_pow_acc_0"
+                         for p in parameters)
+            reg[var.name] = sorted(names)
+            prog._shared_beta_pows = reg
 
     def _append_optimize_op(self, block, pg):
         p, g = pg
         m1 = self._get_accumulator("moment1", p)
         m2 = self._get_accumulator("moment2", p)
-        b1p = self._get_accumulator("beta1_pow_acc", p)
-        b2p = self._get_accumulator("beta2_pow_acc", p)
+        b1p = self._shared_pow_accumulator(1, self._beta1)
+        b2p = self._shared_pow_accumulator(2, self._beta2)
+        # Beta{1,2}PowOut deliberately absent from the outputs: the shared
+        # advance is one scale op appended by _finalize_optimize_ops
         return block.append_op(
             self.type,
             inputs={"Param": [p], "Grad": [g],
                     "LearningRate": [self._lr_var],
                     "Moment1": [m1], "Moment2": [m2],
                     "Beta1Pow": [b1p], "Beta2Pow": [b2p]},
-            outputs={"ParamOut": [p], "Moment1Out": [m1], "Moment2Out": [m2],
-                     "Beta1PowOut": [b1p], "Beta2PowOut": [b2p]},
+            outputs={"ParamOut": [p], "Moment1Out": [m1], "Moment2Out": [m2]},
             attrs={"beta1": self._beta1, "beta2": self._beta2,
                    "epsilon": self._epsilon, "op_role": OpRole.Optimize,
                    **self._extra_attrs()})
+
+    def _finalize_optimize_ops(self, block):
+        ops = []
+        for idx, beta in ((1, self._beta1), (2, self._beta2)):
+            pow_var = self._shared_pow_accumulator(idx, beta)
+            already = any(
+                op.attrs.get("__adam_pow_advance__") == pow_var.name
+                for op in block.ops)
+            if already:   # a second apply_gradients on the same block must
+                continue  # not advance the pows twice per step
+            ops.append(block.append_op(
+                "scale", inputs={"X": [pow_var]},
+                outputs={"Out": [pow_var]},
+                attrs={"scale": beta, "op_role": OpRole.Optimize,
+                       "__adam_pow_advance__": pow_var.name}))
+        return ops
 
     def _extra_attrs(self):
         return {}

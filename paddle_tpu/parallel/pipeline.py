@@ -452,8 +452,10 @@ class _PipelineBlock:
                     fetch_stack[n].append(env_m[n])
 
         next_bwd = 0
+        max_live = 0
         for m in range(K):
             live_envs[m] = dict(micro_feeds[m])
+            max_live = max(max_live, len(live_envs))
             run_phase(self.fwd_segs, live_envs[m])
             if m - next_bwd >= delay - 1:
                 issue_bwd(next_bwd)
@@ -461,6 +463,11 @@ class _PipelineBlock:
         while next_bwd < K:
             issue_bwd(next_bwd)
             next_bwd += 1
+        # observability: the 1F1B window's peak live-activation count —
+        # ~num_stages (+1 transiently), NOT num_microbatches; asserted by
+        # tests/test_pipeline_pp.py so a schedule regression (e.g. GPipe-
+        # style drain-all-forwards-first) cannot land silently
+        self.last_max_live_envs = max_live
         for n, v in acc.items():
             if jnp.issubdtype(v.dtype, jnp.floating):
                 v = v / K

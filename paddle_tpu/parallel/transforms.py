@@ -557,6 +557,12 @@ class GradientMergeWrapper:
             op = self.inner._append_optimize_op(block, (p, g))
             op.attrs["op_role"] = OpRole.Optimize
             self._gate_outputs(block, op, apply_mask)
+        # epilogue ops (the shared adam beta-pow advance): gated like any
+        # other state write — pows only move on merge steps, matching the
+        # "moments only advance on merge steps" contract above
+        for op in self.inner._finalize_optimize_ops(block):
+            op.attrs["op_role"] = OpRole.Optimize
+            self._gate_outputs(block, op, apply_mask)
         # tag exactly the ops this transform appended (counter/mask/acc/select
         # plumbing) — never forward ops of the same types elsewhere in the
         # graph, which clone(for_test) would then wrongly prune

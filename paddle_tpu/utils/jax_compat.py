@@ -1,0 +1,37 @@
+"""jax API-drift shims, shared by every caller.
+
+The repo runs against more than one jax: the agent container pins 0.4.x
+while TPU hosts may carry newer builds where several spellings moved.
+One compat module so a drift fix lands everywhere at once (the round-6
+lesson: ring attention was fixed while _LocalSGDBlock and
+distributed/collective kept the old-only spelling).
+
+* `shard_map(fn, mesh=..., in_specs=..., out_specs=...)` — new jax
+  exposes it at top level with `check_vma`; 0.4.x has
+  jax.experimental.shard_map.shard_map with `check_rep`. Replication
+  checking stays OFF either way (our bodies use collectives the checker
+  cannot type).
+* `axis_size(axis_name)` — 0.4.x has no jax.lax.axis_size; psum of 1
+  over the axis is the portable size query (constant-folded, no
+  collective in the compiled program).
+"""
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(fn, *, mesh, in_specs, out_specs):
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    from jax.experimental.shard_map import shard_map as sm
+    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              check_rep=False)
+
+
+def axis_size(axis_name):
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)

@@ -1,0 +1,115 @@
+"""Unit tests for the bench health gate (paddle_tpu/bench_gate.py).
+
+bench.py's gate decides what the project's only perf record contains: a
+wrong gate silently poisons every later vs_baseline comparison (VERDICT
+round 5, weak #3). These tests drive the four gate paths with synthetic
+probe values — no hardware, no jax:
+
+1. both microprobes healthy but the canary slow -> the window is
+   degraded (stamped, never a comparison point),
+2. one microprobe axis degraded -> the canary is skipped AND rows are
+   refused,
+3. everything healthy -> rows run, framework_tax recorded with the
+   round-4 budget, no alert at healthy values,
+4. vs_baseline history selection skips tunnel_degraded and failed
+   (parsed=null) records instead of resetting to 1.0.
+"""
+import json
+
+from paddle_tpu import bench_gate as gate
+
+
+# ---- path 1: healthy microprobes + slow canary => degraded ----------------
+
+def test_healthy_probes_slow_canary_is_degraded():
+    # round-5 shape: MXU 140 TF/s, HBM 267 GB/s, but real programs 20x slow
+    assert not gate.is_degraded(140.0, 267.0)            # microprobes alone
+    assert gate.is_degraded(140.0, 267.0, canary_tps=10500.0)
+    # the canary itself is NOT skipped when microprobes are healthy — it is
+    # the only axis that can catch this window
+    assert not gate.should_skip_canary(140.0, 267.0)
+
+
+# ---- path 2: microprobe axis degraded => canary skipped, rows refused -----
+
+def test_degraded_microprobe_skips_canary_and_rows():
+    assert gate.is_degraded(4.4, 267.0)                  # MXU axis
+    assert gate.is_degraded(140.0, 3.5)                  # HBM axis
+    assert gate.should_skip_canary(4.4, 267.0)
+    assert gate.should_skip_canary(140.0, 3.5)
+    rg = gate.RowGate(degraded=True, t0=0.0, budget_s=2700.0,
+                      now=lambda: 10.0)
+    assert not rg.ok("resnet")
+    assert not rg.ok("widedeep")
+    assert rg.skipped == ["resnet (degraded chip)",
+                          "widedeep (degraded chip)"]
+    # missing probes are inconclusive, never degraded by themselves
+    assert not gate.is_degraded(None, None, None)
+
+
+# ---- path 3: healthy => rows run, tax recorded, no false alert ------------
+
+def test_healthy_rows_run_and_budget_gates_time():
+    clock = [100.0]
+    rg = gate.RowGate(degraded=False, t0=0.0, budget_s=2700.0,
+                      now=lambda: clock[0])
+    assert rg.ok("masked") and rg.skipped == []
+    clock[0] = 2800.0                                    # past the budget
+    assert not rg.ok("gpt")
+    assert rg.skipped == ["gpt (time budget 2700s)"]
+
+
+def test_framework_tax_normalized_and_alert():
+    # round-4 healthy shape: matched-params pure-jax 149,677 vs framework
+    # 131,114 tok/s => tax ~1.14 == budget, NO alert
+    tax = gate.framework_tax(131114.0, 149677.0,
+                             primary_params=108e6, canary_params=108e6)
+    assert tax is not None and abs(tax - 1.1416) < 1e-3
+    assert not gate.framework_tax_alert(tax)
+    # FLOPs normalization: a small canary's raw tok/s advantage must not
+    # read as tax — 10x fewer params at 10x the tok/s is tax 1.0
+    tax = gate.framework_tax(10000.0, 100000.0,
+                             primary_params=100e6, canary_params=10e6)
+    assert abs(tax - 1.0) < 1e-9
+    # round-5 anomaly shape: ~20x => alert fires
+    tax = gate.framework_tax(10526.0, 205211.0,
+                             primary_params=110e6, canary_params=110e6)
+    assert tax > 10 and gate.framework_tax_alert(tax)
+    # no tax when the canary itself is degraded or either side missing
+    assert gate.framework_tax(100000.0, 15000.0) is None
+    assert gate.framework_tax(None, 200000.0) is None
+    assert gate.framework_tax(100000.0, None) is None
+
+
+# ---- path 4: vs_baseline history skips degraded/failed records ------------
+
+def test_prev_recorded_skips_degraded_and_failed_records():
+    history = [
+        {"parsed": {"value": 74666.0}},                       # round 1
+        {"parsed": None},                                     # round 2 failed
+        {"value": 93391.0},                                   # bare record
+        {"parsed": {"value": 114372.0}},                      # round 4
+        {"parsed": {"value": 10512.0, "tunnel_degraded": True}},  # round 5
+    ]
+    assert gate.prev_recorded_value(history) == 114372.0
+    # top-level stamp is honored too
+    history.append({"value": 9000.0, "tunnel_degraded": True})
+    assert gate.prev_recorded_value(history) == 114372.0
+    # nothing usable -> None (bench then records vs_baseline 1.0)
+    assert gate.prev_recorded_value([{"parsed": None},
+                                     {"tunnel_degraded": True,
+                                      "value": 5.0}]) is None
+    assert gate.prev_recorded_value([]) is None
+
+
+def test_load_prev_recorded_reads_round_files(tmp_path, monkeypatch):
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps({"parsed": {"value": 50000.0}}))
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps({"parsed": {"value": 60000.0}}))
+    (tmp_path / "BENCH_r03.json").write_text(
+        json.dumps({"parsed": {"value": 1000.0,
+                               "tunnel_degraded": True}}))
+    (tmp_path / "BENCH_r04.json").write_text("not json at all")
+    monkeypatch.chdir(tmp_path)
+    assert gate.load_prev_recorded() == 60000.0

@@ -228,5 +228,88 @@ def test_gpt_pp2_tied_embeddings_parity():
     pipe_losses, pw = run(2)
     ref_losses, rw = run(1)
     np.testing.assert_allclose(pipe_losses, ref_losses, rtol=1e-4, atol=1e-5)
-    np.testing.assert_allclose(pw, rw, rtol=1e-4, atol=1e-6)
+    # Adam's rsqrt amplifies fusion-reassociation noise on the summed tied
+    # grads; the pp and single-device runs group the optimizer ops into
+    # different XLA fusions (the shared beta-pow advance is its own opt
+    # segment under pp), so the updated table matches to reassociation
+    # tolerance, not bit-for-bit
+    np.testing.assert_allclose(pw, rw, rtol=5e-4, atol=5e-6)
+    assert pipe_losses[-1] < pipe_losses[0]
+
+
+def test_gpt_pp4_8layers_parity_placement_and_1f1b_window():
+    """Four stages streaming >2 sections is where schedules break
+    (reference section_worker.cc:82 num_microbatches streaming): GPT-8L
+    over pp=4 with tied embeddings must (a) match the single-device GPipe
+    run on losses and the tied wte, (b) keep every stage's weights and
+    Adam moments stage-LOCAL, and (c) bound the 1F1B window's live
+    activation envs at ~S+1 for S=4 — NOT the GPipe drain-everything
+    num_microbatches=6."""
+    from paddle_tpu.models import gpt
+    from paddle_tpu.parallel.pipeline import _PipelineBlock, stage_devices
+
+    S, micro_k = 4, 6
+
+    def run(pp):
+        _fresh()
+        cfg = gpt.GPTConfig(vocab_size=512, hidden_size=32, num_layers=8,
+                            num_heads=2, intermediate_size=64,
+                            max_position=32, seq_len=16,
+                            hidden_dropout=0.0, attention_dropout=0.0,
+                            pipeline_stages=pp if pp > 1 else 0)
+        tokens, loss = gpt.build_lm_program(cfg)
+        opt = paddle.optimizer.PipelineOptimizer(
+            paddle.optimizer.Adam(learning_rate=1e-2),
+            num_microbatches=micro_k)
+        opt.minimize(loss)
+        prog = fluid.default_main_program()
+        if pp > 1:
+            mesh = build_mesh(dp=1, pp=pp, devices=jax.devices()[:pp])
+            attach(prog, DistConfig(mesh=mesh))
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(0)
+        feed = {"tokens": rng.randint(0, cfg.vocab_size,
+                                      (12, cfg.seq_len)).astype(np.int64)}
+        losses = [float(exe.run(prog, feed=feed, fetch_list=[loss])[0])
+                  for _ in range(3)]
+        return exe, losses, np.asarray(global_scope().find("wte"))
+
+    exe, pipe_losses, pw = run(S)
+    pb = [c for c in exe._cache.values() if isinstance(c, _PipelineBlock)][0]
+    assert pb.num_stages == S
+
+    # (c) 1F1B live-activation bound: at most S+1 envs ever live, and the
+    # steady state actually reaches the S-deep window (not running
+    # sequentially with window 1)
+    assert pb.last_max_live_envs <= S + 1, pb.last_max_live_envs
+    assert pb.last_max_live_envs >= S, pb.last_max_live_envs
+
+    # (b) stage-local placement: one sampled weight + its Adam moments per
+    # stage must live within that stage's submesh (8 layers / 4 stages ->
+    # layers 2m,2m+1 on stage m); the tied wte homes at its first reader
+    # (stage 0)
+    scope = global_scope()
+    stage_devs = [set(stage_devices(pb, s)) for s in range(S)]
+    homes = {f"dec{2 * s}_attn_qkv_w": s for s in range(S)}
+    homes["wte"] = 0
+    for name, home in homes.items():
+        arr = scope.find(name)
+        assert arr is not None, name
+        devs = set(arr.sharding.device_set)
+        assert devs <= stage_devs[home], (
+            f"{name} on {devs}, expected within stage {home}")
+        for suffix in ("_moment1_0", "_moment2_0"):
+            m = scope.find(name + suffix)
+            if m is not None:
+                assert set(m.sharding.device_set) <= stage_devs[home], \
+                    name + suffix
+
+    # (a) parity vs the single-device GPipe schedule (tied wte read at
+    # stage 0 AND stage 3: the runner must transfer it forward and sum
+    # both stages' grad contributions across the 4-deep pipeline)
+    _exe, ref_losses, rw = run(1)
+    np.testing.assert_allclose(pipe_losses, ref_losses,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(pw, rw, rtol=5e-4, atol=5e-6)
     assert pipe_losses[-1] < pipe_losses[0]
