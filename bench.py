@@ -35,8 +35,14 @@ def _fresh_programs():
     reset_programs(seed=0)
 
 
+class _WedgedTunnel(RuntimeError):
+    """Backend init gave up on a wedged claim (probe hang / deadline) —
+    the record is stamped tunnel_degraded so the round is never a
+    comparison point, and the ONE JSON line still prints."""
+
+
 def _backend_ready(attempts=5, probe_timeout=150.0, final_timeout=420.0,
-                   delays=(15.0, 60.0, 300.0, 600.0)):
+                   delays=(15.0, 60.0, 300.0, 600.0), deadline_s=None):
     """Force backend init, surviving BOTH failure modes seen in rounds 2-3:
 
     * 'Unable to initialize backend axon: UNAVAILABLE' raised quickly
@@ -46,14 +52,43 @@ def _backend_ready(attempts=5, probe_timeout=150.0, final_timeout=420.0,
       nanosleep bind loop (round 3, wedged tunnel after a killed holder) —
       jax.devices() never returns, so probe in a KILLABLE subprocess with
       a hard timeout before dialing in-process.
+
+    The WHOLE retry ladder runs under a hard deadline
+    (BENCH_INIT_DEADLINE, default 900 s): in round 5 a wedged claim ate
+    all five probe attempts PLUS their backoff sleeps (~27 min) and the
+    driver killed the run at rc=124 with no JSON line (BENCH_r05.json).
+    Exhausting the deadline returns a _WedgedTunnel error the caller
+    records as a tunnel_degraded row instead.
     """
     import subprocess
+    if deadline_s is None:
+        try:
+            deadline_s = float(os.environ.get("BENCH_INIT_DEADLINE", "900"))
+        except ValueError:
+            deadline_s = 900.0
+    t_start = time.monotonic()
+
+    def _remaining():
+        return deadline_s - (time.monotonic() - t_start)
+
+    def _sleep_backoff(i):
+        # ONE clamp policy for every failure branch: never sleep past
+        # the deadline minus a 30 s headroom for the final probe
+        time.sleep(min(delays[min(i, len(delays) - 1)],
+                       max(_remaining() - 30.0, 0.0)))
+
     last = None
     for i in range(attempts):
+        if _remaining() <= 10.0:
+            return _WedgedTunnel(
+                f"backend init deadline {deadline_s:.0f}s exhausted after "
+                f"{i} attempt(s); last: {last!r}")
         # late attempts: the pool needs 5-10 min of quiet to reclaim a
         # killed holder's grant (round-3 judging showed 90s is far too
-        # short), and the final probe deserves a judge-style long wait
+        # short), and the final probe deserves a judge-style long wait —
+        # all clamped to what the deadline still allows
         timeout_i = probe_timeout if i + 1 < attempts else final_timeout
+        timeout_i = min(timeout_i, max(_remaining(), 10.0))
         try:
             # Popen + SIGTERM-first: subprocess.run would SIGKILL on
             # timeout, and a probe killed mid-claim while holding the one
@@ -85,24 +120,33 @@ def _backend_ready(attempts=5, probe_timeout=150.0, final_timeout=420.0,
                 raise RuntimeError(
                     f"JAX_PLATFORMS={want} but probe saw only cpu")
         except subprocess.TimeoutExpired:
-            last = RuntimeError(
+            last = _WedgedTunnel(
                 f"backend probe hung >{timeout_i:.0f}s "
                 f"(wedged TPU claim — see axon notes)")
             print(f"attempt {i + 1}/{attempts}: {last}", file=sys.stderr)
             if i + 1 < attempts:
-                time.sleep(delays[min(i, len(delays) - 1)])
+                _sleep_backoff(i)
             continue
         except Exception as e:
             last = e
             print(f"backend init attempt {i + 1}/{attempts} failed: {e!r}",
                   file=sys.stderr)
             if i + 1 < attempts:
-                time.sleep(delays[min(i, len(delays) - 1)])
+                _sleep_backoff(i)
             continue
-        # probe OK: init in-process (should be fast — the pool answered)
+        # probe OK: init in-process (should be fast — the pool answered,
+        # but the claim can still wedge in THIS window: run the dial
+        # under the same hard deadline so the 'whole ladder is bounded'
+        # contract holds end to end)
         try:
             import jax
-            jax.devices()
+            _, hung = _with_deadline(
+                jax.devices, max(min(timeout_i, _remaining()), 10.0),
+                "in-process backend dial")
+            if hung:
+                raise _WedgedTunnel(
+                    "in-process dial hung after an OK probe (claim "
+                    "wedged between probe exit and dial)")
             return None
         except Exception as e:
             last = e
@@ -114,7 +158,7 @@ def _backend_ready(attempts=5, probe_timeout=150.0, final_timeout=420.0,
             except Exception:
                 pass
             if i + 1 < attempts:
-                time.sleep(delays[min(i, len(delays) - 1)])
+                _sleep_backoff(i)
     return last
 
 
@@ -141,9 +185,54 @@ def _drain(out):
     """Force the device queue dry. jax.block_until_ready is a NO-OP on the
     experimental axon plugin's arrays (seen round 4: 30 dispatches 'finished'
     in 0.17s while the device ground for 56s), so sync by actually pulling
-    the values to host — D2H cannot complete before every queued step that
-    produced them."""
+    a value to host — D2H cannot complete before every queued step that
+    produced it. Pull ONE trailing scalar, not the whole array: a full
+    [k]-stacked fetch rides the tunnel's ~72 MB/s D2H path and round 5's
+    full-tensor drain measured THAT instead of the device (the same trap
+    the TFLOPS probe hit) — a scalar syncs identically for bytes that are
+    noise. The device-side [-1] slice is dispatched behind everything
+    queued, so it cannot land early."""
+    if getattr(out, "ndim", 0):
+        out = out.reshape(-1)[-1]
     return np.asarray(out)
+
+
+def _with_deadline(fn, seconds, label):
+    """Hard-deadline watchdog for the IN-PROCESS health probes and dial:
+    a wedged tunnel claim can hang any device call forever (the round-5
+    nanosleep bind loop), and a hung PROBE — whose whole job is deciding
+    whether the window is degraded — must itself resolve to 'degraded'
+    instead of eating the run's wall clock until the driver kills it at
+    rc=124 (BENCH_r05.json).
+
+    Runs `fn` on a daemon worker thread and bounds the WAIT, not the
+    work: a call blocked inside C (the PJRT claim loop) cannot be
+    interrupted from Python at all — SIGALRM handlers only run between
+    bytecodes, so an alarm would be deferred exactly when it matters.
+    The deliverable guarantee is that THIS flow stops waiting, records
+    the wedge, and prints the one JSON line; the abandoned thread parks
+    on the dead dial (acceptable: the process is about to exit anyway).
+    Returns (value, timed_out); exceptions from fn re-raise here."""
+    import threading
+    box = {}
+
+    def _runner():
+        try:
+            box["v"] = fn()
+        except BaseException as e:   # deliver to the caller, not the log
+            box["e"] = e
+
+    t = threading.Thread(target=_runner, daemon=True,
+                         name=f"probe:{label}")
+    t.start()
+    t.join(seconds)
+    if t.is_alive():
+        print(f"{label} hit the {seconds:.0f}s probe deadline "
+              f"(wedged tunnel claim)", file=sys.stderr)
+        return None, True
+    if "e" in box:
+        raise box["e"]
+    return box.get("v"), False
 
 
 def _timed_steps(exe, feed, fetch, steps):
@@ -404,6 +493,89 @@ def bench_wide_deep(batch, steps):
         srv.stop()
 
 
+def bench_pipelined_loop(batch, seq_len, steps=20, log_every=5):
+    """Host–device overlap A/B (ISSUE-4 acceptance geometry): the SAME
+    per-step BERT train loop, logging loss every `log_every` steps, run
+    twice —
+
+    * sync arm: every run() drains its fetch to numpy (the seed behavior:
+      a full device sync + D2H per step);
+    * async arm: run(sync=False) returns lazy FetchHandles, only the
+      logged steps materialize, and the next step's feeds are staged
+      (Executor.stage) while the current one executes.
+
+    Both arms share one compiled program and report the executor's own
+    ledger: host_blocked_ms, fetch_sync_count, h2d_ms (paddle_tpu.monitor)
+    plus wall-clock tokens/s. The async arm must record fetch_sync_count
+    <= steps/log_every and lower host_blocked_ms — checked in
+    tests/test_async_dispatch.py and scripts/ci.py's host-stall budget;
+    recording it here makes the win a number in the round record, not a
+    claim."""
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import monitor
+    from paddle_tpu.models import bert
+    from paddle_tpu.distributed import fleet
+
+    _log(f"pipelined-loop A/B: batch={batch}, seq={seq_len}, "
+         f"steps={steps}, log_every={log_every}")
+    _fresh_programs()
+    cfg = bert.BertConfig()
+    cfg.seq_len = seq_len
+    ids, labels, loss = bert.build_pretrain_program(cfg)
+    fleet.init(is_collective=True)
+    strategy = fleet.DistributedStrategy()
+    strategy.amp = True
+    strategy.layer_scan = _layer_scan_enabled()
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.Adam(learning_rate=1e-4), strategy)
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    np_feed = {
+        "input_ids": rng.randint(0, cfg.vocab_size,
+                                 (batch, seq_len)).astype(np.int64),
+        "mlm_labels": rng.randint(0, cfg.vocab_size,
+                                  (batch, seq_len, 1)).astype(np.int64),
+    }
+    exe.run(feed=np_feed, fetch_list=[loss])       # compile + warm
+    stat_names = ("executor.host_blocked_ms", "executor.fetch_sync_count",
+                  "executor.h2d_ms")
+    arms = {}
+    for arm in ("sync", "async"):
+        for s in stat_names:
+            monitor.stat_reset(s)
+        is_async = arm == "async"
+        last = None
+        t0 = time.perf_counter()
+        for step in range(steps):
+            out, = exe.run(feed=np_feed, fetch_list=[loss],
+                           sync=not is_async)
+            if is_async and step + 1 < steps:
+                exe.stage(np_feed)   # next window's H2D rides this step
+            if (step + 1) % log_every == 0:
+                last = float(np.asarray(out).reshape(-1)[0])
+        if last is None:           # loop shorter than one logging period
+            last = float(np.asarray(out).reshape(-1)[0])
+        dt = time.perf_counter() - t0
+        arms[arm] = {
+            "wall_s": round(dt, 3),
+            "tokens_per_sec": round(batch * seq_len * steps / dt, 1),
+            "host_blocked_ms":
+                round(monitor.stat_get("executor.host_blocked_ms"), 1),
+            "fetch_sync_count":
+                int(monitor.stat_get("executor.fetch_sync_count")),
+            "h2d_ms": round(monitor.stat_get("executor.h2d_ms"), 1),
+            "last_loss": round(last, 6),
+        }
+        _log(f"pipelined {arm}: {arms[arm]}")
+    arms["async_wins"] = (
+        arms["async"]["host_blocked_ms"] < arms["sync"]["host_blocked_ms"]
+        and arms["async"]["fetch_sync_count"] <= steps // log_every)
+    return arms
+
+
 def _device_tflops_probe(n=4096, iters=256):
     """Raw sustained bf16 matmul rate, framework-free: one jit dispatch of
     a fori_loop of n x n matmuls, synced by draining a SCALAR of the
@@ -572,6 +744,12 @@ def main():
     seq_len = int(os.environ.get("BENCH_SEQ", "128"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     which = os.environ.get("BENCH_WHICH", "all")
+    if os.environ.get("PADDLE_TPU_ASYNC", "0") == "1":
+        # the A/B toggle: every executor call in this process defaults to
+        # lazy fetches (run(sync=False) semantics); the record is stamped
+        # async_dispatch below
+        from paddle_tpu.flags import set_flags
+        set_flags({"FLAGS_async_dispatch": True})
 
     errors = []
     init_err = _backend_ready()
@@ -582,16 +760,30 @@ def main():
     health_tflops = None
     hbm_gbps = None
 
+    probe_timeouts = []
+    try:
+        probe_deadline = float(os.environ.get("BENCH_PROBE_DEADLINE", "180"))
+    except ValueError:
+        probe_deadline = 180.0
+
     def _probe_both():
         t = g = None
         try:
-            t = _device_tflops_probe()
-            _log(f"device health probe: {t:.1f} bf16 TFLOP/s (MXU/VMEM)")
+            t, hung = _with_deadline(_device_tflops_probe, probe_deadline,
+                                     "MXU probe")
+            if hung:
+                probe_timeouts.append("mxu_probe")
+            else:
+                _log(f"device health probe: {t:.1f} bf16 TFLOP/s (MXU/VMEM)")
         except Exception as e:
             print(f"MXU probe failed: {e!r}", file=sys.stderr)
         try:
-            g = _hbm_gbps_probe()
-            _log(f"device health probe: {g:.1f} GB/s (HBM read)")
+            g, hung = _with_deadline(_hbm_gbps_probe, probe_deadline,
+                                     "HBM probe")
+            if hung:
+                probe_timeouts.append("hbm_probe")
+            else:
+                _log(f"device health probe: {g:.1f} GB/s (HBM read)")
         except Exception as e:
             print(f"HBM probe failed: {e!r}", file=sys.stderr)
         return t, g
@@ -600,11 +792,15 @@ def main():
         # once a microprobe axis has already failed, the canary adds no
         # information and a full-size run could take minutes on a
         # 10-250x degraded path — skip it
-        if _gate.should_skip_canary(t, g):
+        if _gate.should_skip_canary(t, g) or probe_timeouts:
             _log(f"{label}: skipped (microprobe axis already degraded)")
             return None
         try:
-            c = _pure_jax_canary()
+            c, hung = _with_deadline(_pure_jax_canary, probe_deadline * 2,
+                                     label)
+            if hung:
+                probe_timeouts.append("canary")
+                return None
             _log(f"{label}: {c:.0f} tok/s")
             return c
         except Exception as e:
@@ -623,11 +819,16 @@ def main():
         except ValueError:
             wait = 600
         # a degraded tunnel sometimes recovers with quiet — one bounded
-        # wait before measuring
-        if on_tpu and _gate.is_degraded(health_tflops, hbm_gbps,
-                                        canary_tps) and wait > 0:
+        # wait before measuring. A probe that hit its hard DEADLINE gets
+        # the same second chance (a transient wedge is the most likely
+        # cause), with the timeout ledger reset so a clean re-probe can
+        # fully clear the degraded stamp
+        if on_tpu and wait > 0 and (
+                probe_timeouts
+                or _gate.is_degraded(health_tflops, hbm_gbps, canary_tps)):
             _log(f"tunnel degraded; quiet {wait}s then re-probe")
             time.sleep(wait)
+            probe_timeouts.clear()
             health_tflops, hbm_gbps = _probe_both()
             canary_tps = _canary_probe(health_tflops, hbm_gbps,
                                        label="canary re-probe")
@@ -636,8 +837,11 @@ def main():
         # killing the process before the ONE required JSON line prints.
         # Shrink the step count (the number is stamped tunnel_degraded
         # and never used as a comparison point anyway) and skip the
-        # expensive extras below.
-        degraded = _gate.is_degraded(health_tflops, hbm_gbps, canary_tps)
+        # expensive extras below. A probe that hit its hard deadline is
+        # the degraded signal too — a wedged dispatch IS the failure the
+        # probes exist to catch (ISSUE-4 watchdog satellite).
+        degraded = _gate.is_degraded(health_tflops, hbm_gbps, canary_tps) \
+            or bool(probe_timeouts)
         if degraded:
             steps = min(steps, 4)
             _log(f"degraded mode: steps={steps}, extras trimmed")
@@ -766,6 +970,22 @@ def main():
         except Exception as e:  # pragma: no cover
             print(f"wide&deep bench failed: {e!r}", file=sys.stderr)
             errors.append(f"wide&deep: {e!r}")
+    if tokens_per_sec is not None and which in ("all", "pipelined") \
+            and _row_ok("pipelined"):
+        try:
+            # the ISSUE-4 acceptance row: 20-step per-step loop logging
+            # every 5, sync vs async dispatch in the SAME run — the
+            # async arm must record fetch_sync_count <= 4 and lower
+            # host_blocked_ms (both stamped below for the record)
+            arms = bench_pipelined_loop(batch, seq_len, steps=20,
+                                        log_every=5)
+            extras.append({
+                "metric": "pipelined_loop_host_blocked_ms_async",
+                "value": arms["async"]["host_blocked_ms"], "unit": "ms",
+                "arms": arms})
+        except Exception as e:  # pragma: no cover
+            print(f"pipelined-loop bench failed: {e!r}", file=sys.stderr)
+            errors.append(f"pipelined: {e!r}")
 
     prev = _gate.load_prev_recorded()
     rec = {
@@ -781,6 +1001,10 @@ def main():
         # stamp the A/B arm: numbers recorded under the rolled-layer step
         # program are a different configuration, not a baseline drift
         rec["layer_scan"] = True
+    # the async-dispatch A/B arm is stamped in EVERY record (0 or 1), so
+    # a number recorded under lazy fetches can never read as baseline
+    # drift against a sync round (same contract as layer_scan above)
+    rec["async_dispatch"] = os.environ.get("PADDLE_TPU_ASYNC", "0") == "1"
     if skipped_rows:
         rec["skipped_rows"] = skipped_rows
     if health_tflops is not None:
@@ -820,6 +1044,13 @@ def main():
             # round-5 notes), so tok/s here is not comparable to healthy
             # rounds
             rec["tunnel_degraded"] = True
+    if probe_timeouts:
+        # a probe that hit its hard deadline: the window is degraded BY
+        # CONSTRUCTION (the dispatch it was timing never came back)
+        rec["tunnel_degraded"] = True
+        rec["probe_timeouts"] = probe_timeouts
+    if isinstance(init_err, _WedgedTunnel):
+        rec["tunnel_degraded"] = True
     if errors:
         rec["error"] = "; ".join(errors)
     # ONE parseable JSON line, even on unrecoverable failure

@@ -21,15 +21,19 @@ def main():
     xs = rng.rand(64, 8).astype(np.float32)
     ys = (xs.sum(1, keepdims=True) > 4.0).astype(np.float32)
 
-    for step in range(60):
+    # 240 steps: the convergence bar (loss < 0.05) needs ~140 steps under
+    # this container's jax build — the 60-step original rode a faster
+    # early-loss trajectory of an older jax and flaked at ~0.13 (seed
+    # reproduction, ISSUE-4 deflake satellite); by 240 the margin is wide
+    for step in range(240):
         x = paddle.to_tensor(xs)
         y = paddle.to_tensor(ys)
         loss = F.mse_loss(model(x), y)
         loss.backward()
         opt.step()
         opt.clear_grad()
-        if step % 20 == 0 or step == 59:
-            print(f"step {step:2d}  loss {float(loss):.5f}")
+        if step % 40 == 0 or step == 239:
+            print(f"step {step:3d}  loss {float(loss):.5f}")
     assert float(loss) < 0.05
     print("ok")
 

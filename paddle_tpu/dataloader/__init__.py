@@ -8,6 +8,10 @@ TPU-native differences:
 - device transfer is `jax.device_put` onto the chip, overlapped by a
   double-buffer prefetch thread (the reference's BufferedReader
   operators/reader/buffered_reader.h does the same with CUDA streams);
+  `DataLoader.prefetch(executor, depth)` goes further and runs the
+  EXECUTOR'S feed coercion + H2D on that thread, yielding device-ready
+  feed dicts for `run(..., sync=False)` (docs/perf_notes.md
+  "Host–device overlap");
 - multiprocess workers ship numpy batches over pipes (fork start method);
   the reference uses mmap shared memory — same topology, simpler transport.
 """

@@ -312,42 +312,208 @@ def _describe_exit(exitcode):
     return f"exited with status {exitcode}"
 
 
+def _bounded_put(stop, q, item) -> bool:
+    """Bounded put that respects the stop event: True iff enqueued."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.2)
+            return True
+        except queue_mod.Full:
+            continue
+    return False
+
+
+def _drain_queue(stop, q):
+    """Finalizer body: unblock a filling thread and drop buffered items.
+    Module-level + argument-passed so weakref.finalize holds NO reference
+    back to the prefetcher (that would defeat collection)."""
+    stop.set()
+    while True:
+        try:
+            q.get_nowait()
+        except queue_mod.Empty:
+            break
+
+
+def _purge_executor_stages(exe_ref, tag):
+    """Finalizer body for executor-routed prefetch: drop this iterator's
+    pending windows from the executor's dispatch queue (weakref to the
+    executor so the finalizer never pins it)."""
+    exe = exe_ref()
+    if exe is not None:
+        exe._purge_staged(tag)
+
+
+def _prefetch_fill(ref, it, stop, q, end):
+    """Fill-thread body. Holds only a WEAK reference to the prefetcher
+    between batches: when the consumer abandons the iterator mid-epoch,
+    the prefetcher is garbage-collected, its finalizer sets `stop`, and
+    this thread exits at the next put/batch boundary instead of polling
+    forever (and releases the source iterator — multiprocess workers,
+    file handles — with it)."""
+    err_box = None
+    try:
+        for item in it:
+            if stop.is_set():
+                return
+            self = ref()
+            if self is None:
+                return
+            try:
+                out = self._transform(item)
+            except Exception as e:
+                self._err = e
+                del self                   # see below
+                return                     # END in finally
+            del self                       # no strong ref across a put:
+            #                a blocking put would otherwise pin the
+            #                prefetcher and defeat the weakref teardown
+            if not _bounded_put(stop, q, out):
+                return
+    except Exception as e:                 # the source iterator raised
+        err_box = e
+    finally:
+        if err_box is not None:
+            self = ref()
+            if self is not None:
+                self._err = err_box
+            del self
+        _bounded_put(stop, q, end)
+
+
 class _Prefetcher:
     """Double buffering: a thread stays `capacity` batches ahead, moving
-    arrays onto the device (reference BufferedReader, buffered_reader.h:33)."""
+    arrays onto the device (reference BufferedReader, buffered_reader.h:33).
+
+    Teardown contract (shared with the multiprocess iterator): close()
+    signals the fill thread, drains the bounded queue so a blocked put()
+    can't wedge interpreter exit, and joins. An ABANDONED iterator (user
+    breaks out of the epoch; nothing calls close) is handled by a
+    weakref.finalize: the fill thread never strongly pins the prefetcher,
+    so collection fires the finalizer, which stops + drains the thread
+    (test_data_pipeline.py pins the same no-leak property for
+    train_from_dataset's producer)."""
 
     _END = object()
 
     def __init__(self, it, capacity=2, device_put=True):
+        import weakref
         self._q = queue_mod.Queue(maxsize=capacity)
         self._device_put = device_put
-        self._thread = threading.Thread(target=self._fill, args=(it,),
-                                        daemon=True)
+        self._stop = threading.Event()
         self._err = None
+        self._thread = threading.Thread(
+            target=_prefetch_fill,
+            args=(weakref.ref(self), it, self._stop, self._q, self._END),
+            daemon=True, name="dataloader-prefetch")
+        self._finalizer = weakref.finalize(self, _drain_queue, self._stop,
+                                           self._q)
         self._thread.start()
 
-    def _fill(self, it):
-        try:
-            for item in it:
-                if self._device_put:
-                    import jax
-                    item = jax.tree_util.tree_map(jax.device_put, item)
-                self._q.put(item)
-        except Exception as e:
-            self._err = e
-        finally:
-            self._q.put(self._END)
+    def _transform(self, item):
+        """Per-batch work on the fill thread (overlaps the consumer)."""
+        if self._device_put:
+            import jax
+            item = jax.tree_util.tree_map(jax.device_put, item)
+        return item
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        item = self._q.get()
-        if item is self._END:
-            if self._err is not None:
-                raise self._err
-            raise StopIteration
-        return item
+        # stop-aware get: after close() the queue is drained (the END
+        # sentinel included) and the fill thread will not refill, so a
+        # plain blocking get() would hang a late/concurrent consumer
+        # forever; a closed+empty queue is end-of-iteration
+        while True:
+            if self._stop.is_set():
+                try:
+                    item = self._q.get_nowait()
+                except queue_mod.Empty:
+                    raise StopIteration
+            else:
+                try:
+                    item = self._q.get(timeout=0.2)
+                except queue_mod.Empty:
+                    continue
+            if item is self._END:
+                if self._err is not None:
+                    raise self._err
+                raise StopIteration
+            return item
+
+    def close(self):
+        """Stop + drain + join (idempotent); safe mid-epoch."""
+        self._finalizer()                    # stop + unblock the put
+        self._thread.join(timeout=10)
+        # drain AGAIN with the producer gone: a put blocked at stop-set
+        # time can slip one item in after the finalizer's drain, and a
+        # leftover batch would come back from a post-close next() instead
+        # of StopIteration
+        _drain_queue(self._stop, self._q)
+
+
+class _DevicePrefetcher(_Prefetcher):
+    """Device-prefetching iterator (DataLoader.prefetch): the fill thread
+    runs the EXECUTOR'S feed coercion + device_put per batch — dtype casts,
+    int64 range guards, H2D — so when the training loop reaches batch n+1
+    its arrays are already device-resident and `Executor.run(...,
+    sync=False)` dispatches without touching host memory. Host time spent
+    staging is counted in the `executor.h2d_ms` monitor stat; the buffer
+    is bounded at `depth` batches (double buffering at depth 2)."""
+
+    def __init__(self, it, program, executor=None, depth=2):
+        self._program = program
+        self._block = program.global_block()
+        self._executor = executor
+        # marks this iterator's entries in the executor's dispatch queue;
+        # abandoning the iterator purges them (they pin device memory)
+        self._stage_tag = object()
+        super().__init__(it, capacity=depth, device_put=True)
+        if executor is not None:
+            import weakref
+            self._purge_finalizer = weakref.finalize(
+                self, _purge_executor_stages, weakref.ref(executor),
+                self._stage_tag)
+
+    def _transform(self, item):
+        import time as _time
+
+        import jax
+
+        from ..framework.executor import _coerce_feed_value
+        from ..monitor import stat_add
+        if not isinstance(item, dict):
+            raise TypeError(
+                "DataLoader.prefetch needs feed dicts: construct the "
+                "loader with feed_list= and return_list=False (or yield "
+                "dicts from the generator)")
+        if self._executor is not None:
+            # route through the executor's dispatch queue: the consuming
+            # run() recognizes the yielded dict by identity, skips
+            # re-coercion, and applies the donation-conflict check. The
+            # depth override keeps FIFO consumption safe: up to
+            # buffer-capacity + 1 (in this transform) + 1 (popped by the
+            # consumer but not yet run) windows can be pending at once,
+            # and evicting a pending window would silently disable the
+            # identity match for it (stage()'s default bound serves
+            # MANUAL latest-wins staging, not this pipeline)
+            return self._executor.stage(item, program=self._program,
+                                        depth=self._q.maxsize + 2,
+                                        tag=self._stage_tag)
+        t0 = _time.perf_counter()
+        out = {}
+        for name, value in item.items():
+            v = _coerce_feed_value(self._block, name, value)
+            out[name] = v if isinstance(v, jax.Array) else jax.device_put(v)
+        stat_add("executor.h2d_ms", (_time.perf_counter() - t0) * 1000.0)
+        return out
+
+    def close(self):
+        super().close()
+        fin = getattr(self, "_purge_finalizer", None)
+        if fin is not None:
+            fin()           # drop this iterator's staged windows now
 
 
 class DataLoader:
@@ -428,7 +594,9 @@ class DataLoader:
             fields = batch if isinstance(batch, (tuple, list)) else (batch,)
             yield dict(zip(names, fields))
 
-    def __iter__(self):
+    def _base_iter(self):
+        """The un-buffered batch stream (multiprocess workers + feedify,
+        no prefetch thread) — shared by __iter__ and prefetch()."""
         if self._iterable_src is not None:
             it = self._iterable_src()
         elif self.batch_sampler is not None:
@@ -455,9 +623,41 @@ class DataLoader:
             it = gen()
         if self.feed_list is not None and not self.return_list:
             it = self._feedify(it)
+        return it
+
+    def __iter__(self):
+        it = self._base_iter()
         if self.use_buffer_reader:
             it = _Prefetcher(it, capacity=getattr(self, "_capacity", 2))
         return iter(it)
+
+    def prefetch(self, executor=None, depth: int = 2, program=None):
+        """Device-prefetching iterator: run feed coercion + H2D on a
+        background thread, `depth` batches ahead, yielding feed dicts of
+        DEVICE arrays ready for `executor.run(feed=..., sync=False)`.
+
+        The async counterpart of the reference's py_reader double
+        buffering: batch n+1 crosses the PCIe/ICI link while step n
+        executes, and the executor's dispatch never waits on host feed
+        prep. Worker-death resilience is inherited from the multiprocess
+        iterator underneath (bounded respawn, FLAGS_dataloader_max_
+        respawns), and the prefetch thread follows the resilience layer's
+        queue-drain teardown (close() or garbage collection never wedges
+        on a full buffer). With `executor`, each batch is staged through
+        that executor's dispatch queue (Executor.stage) — the consuming
+        run() recognizes it by identity, skips re-coercion, and the
+        donation-conflict rule applies; without, batches are coerced
+        locally against `program` (default main program). Staging time
+        lands in the `executor.h2d_ms` monitor stat either way.
+
+        Requires dict batches: construct the loader with `feed_list=` and
+        `return_list=False`, or yield dicts from the generator."""
+        from ..framework.program import default_main_program
+        prog = program or default_main_program()
+        if hasattr(prog, "_is_data_parallel"):
+            prog = prog.program
+        return _DevicePrefetcher(self._base_iter(), prog,
+                                 executor=executor, depth=max(1, int(depth)))
 
     def __len__(self):
         if self.batch_sampler is not None:

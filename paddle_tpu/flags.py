@@ -42,6 +42,23 @@ _DEFS: Dict[str, tuple] = {
                                 "(parallel/transforms.apply_layer_scan; "
                                 "same switch as DistributedStrategy."
                                 "layer_scan)"),
+    "FLAGS_async_dispatch": (False, "executor.run/run_steps default to "
+                             "sync=False: fetches come back as lazy "
+                             "FetchHandles that materialize to numpy only "
+                             "on access, so the host never blocks on steps "
+                             "nobody reads (framework/fetch.py; sync stays "
+                             "the default until parity is pinned — "
+                             "tests/test_async_dispatch.py). Falls back to "
+                             "sync while a fault plan is installed or on a "
+                             "staged-buffer donation conflict"),
+    "FLAGS_dispatch_queue_depth": (2, "max pre-staged feed windows held by "
+                                   "Executor.stage() (the host-side "
+                                   "dispatch queue): while window n "
+                                   "executes, window n+1's feeds coerce + "
+                                   "device_put ahead of time; depth 1-2 is "
+                                   "enough to hide host latency without "
+                                   "pinning extra HBM (monitor stat "
+                                   "executor.dispatch_queue_depth)"),
     # --- resilience tier (resilience/, docs/resilience.md) ---------------
     "FLAGS_fault_plan": ("", "fault-injection plan spec, e.g. "
                              "'kv.pull:error:every=3;ckpt.write:kill:at=2'"),

@@ -8,6 +8,7 @@ from ..framework.program import (Program, program_guard, device_guard,  # noqa
                                  default_startup_program, in_dygraph_mode,
                                  Variable, Parameter)
 from ..framework.executor import Executor
+from ..framework.fetch import FetchHandle
 from ..framework.scope import global_scope, Scope
 from ..framework.backward import append_backward, gradients
 from ..framework import unique_name

@@ -3,6 +3,7 @@ from .program import (Program, Block, Operator, Variable, Parameter, OpRole,
                       default_startup_program, in_dygraph_mode,
                       grad_var_name)
 from .executor import Executor
+from .fetch import FetchHandle
 from .scope import Scope, global_scope
 from .backward import append_backward, gradients
 from .dtype import convert_dtype, dtype_name

@@ -13,7 +13,9 @@ mirroring the reference's ExecutorPrepareContext caching.
 """
 from __future__ import annotations
 
+import collections
 import functools
+import time
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -21,6 +23,7 @@ import numpy as np
 
 from .program import Program, Variable, default_main_program
 from .scope import Scope, global_scope
+from .. import monitor
 from ..ops import registry
 
 
@@ -716,26 +719,285 @@ def _make_compiled_block(program, feed_vals, fetch_names, state_names,
         multi_k=multi_k)
 
 
+class _StagedFeeds:
+    """One pre-staged feed window in the executor's dispatch queue: the
+    coerced + device_put'd arrays for a run()/run_steps() call that has not
+    been dispatched yet (Executor.stage). Matching is by program identity,
+    window size, and VALUE IDENTITY of the original feed objects — the
+    caller passes the same arrays (or the staged device dict itself) to the
+    consuming run, so a non-matching call simply falls through to normal
+    coercion and the entry waits for its owner. `orig_vals` holds STRONG
+    references to the originals: identity must be checked with `is`
+    against live objects, never a stored id() — a freed original's address
+    can be reused by a later unrelated array (CPython id recycling), which
+    would silently match a stale window and train on the wrong batch.
+    `tag` marks the producer (the device-prefetching DataLoader), so an
+    abandoned prefetch iterator can purge ITS pending windows without
+    touching manually staged ones."""
+
+    __slots__ = ("prog_key", "k", "orig_vals", "device_feeds", "tag")
+
+    def __init__(self, prog_key, k, orig_vals, device_feeds, tag=None):
+        self.prog_key = prog_key
+        self.k = k
+        self.orig_vals = orig_vals
+        self.device_feeds = device_feeds
+        self.tag = tag
+
+    def matches(self, program, feed, k) -> bool:
+        if self.prog_key != (program._uid, program._version) or self.k != k:
+            return False
+        if set(feed) != set(self.orig_vals):
+            return False
+        return all(feed[n] is self.orig_vals[n]
+                   or feed[n] is self.device_feeds[n] for n in feed)
+
+
+def _package_fetches(fetches, fetch_names, return_numpy, sync):
+    """The ONE fetch-return site shared by run()/run_steps().
+
+    return_numpy=False: the live device arrays, UNSYNCED — jax dispatch is
+    asynchronous, so these may still be computing when returned; the
+    consumer's np.asarray (or .block_until_ready) is the sync point, and
+    pulling ONE scalar (bench.py _drain) syncs the whole dispatch without
+    paying full-tensor D2H. return_numpy=True + sync: the classic drain
+    (blocks; counted in executor.host_blocked_ms / fetch_sync_count).
+    return_numpy=True + sync=False: lazy FetchHandles (framework/fetch.py)
+    that pay the sync only on access."""
+    if not return_numpy:
+        return list(fetches)
+    if sync:
+        from .fetch import _record_sync
+        t0 = time.perf_counter()
+        out = [np.asarray(f) for f in fetches]
+        if out:
+            _record_sync(time.perf_counter() - t0, n_values=len(out))
+        return out
+    from .fetch import FetchHandle
+    return [FetchHandle(f, name=n) for f, n in zip(fetches, fetch_names)]
+
+
 class Executor:
     """API-parity with fluid.Executor (reference executor.py:475).
 
     `place` is accepted for source compatibility; devices are owned by the JAX
     runtime (reference Place/DeviceContext machinery collapses away).
+
+    Host–device overlap surface (docs/perf_notes.md "Host–device overlap"):
+
+    * ``run(..., sync=False)`` / ``FLAGS_async_dispatch`` — lazy fetches:
+      FetchHandles that materialize on access instead of draining every
+      step (the reference's py_reader/double-buffer philosophy applied to
+      the FETCH side).
+    * ``stage(feed, ...)`` — pre-coerce + H2D the next window's feeds while
+      the current one executes (a depth-1-2 dispatch queue; the reference's
+      BufferedReader applied to the FEED side).
+    * ``return_numpy=False`` — raw device arrays, unsynced (see
+      _package_fetches).
     """
 
     def __init__(self, place=None):
+        import threading
         self.place = place
         self._cache: Dict[tuple, _CompiledBlock] = {}
+        # the host-side dispatch queue (stage()): guarded because the
+        # device-prefetching DataLoader stages from its fill thread while
+        # the training loop consumes on the main thread
+        self._staged: "collections.deque[_StagedFeeds]" = collections.deque()
+        self._staged_lock = threading.Lock()
+
+    @staticmethod
+    def _resolve_sync(sync: Optional[bool]) -> bool:
+        """None -> the FLAGS_async_dispatch default. Async always falls
+        back to sync while a fault plan is installed: the resilience
+        layer's retry/backoff sites reason about materialized host values,
+        and the chaos parity contract (scripts/chaos_smoke.py) replays the
+        sync path bit-for-bit (counted in executor.async_fallbacks)."""
+        from ..flags import flag
+        if sync is None:
+            sync = not flag("FLAGS_async_dispatch")
+        if not sync:
+            from ..resilience.faults import current_plan
+            if current_plan() is not None:
+                monitor.stat_add("executor.async_fallbacks")
+                return True
+        return bool(sync)
+
+    def stage(self, feed, program: Optional[Program] = None,
+              scope: Optional[Scope] = None, k: Optional[int] = None,
+              depth: Optional[int] = None, tag=None):
+        """Pre-stage the NEXT run()/run_steps() call's feeds: coerce on
+        host and start the H2D transfers NOW, while the in-flight window
+        still executes — so dispatch time for the next window pays neither.
+        With `k`, feeds are normalized to run_steps(k)'s leading [k] axis.
+
+        Donation-aware placement: host arrays device_put into FRESH
+        buffers (they cannot alias anything), and a feed value that is
+        itself a scope-resident device array is defensively copied — the
+        in-flight window may donate that buffer, which would invalidate
+        the staged entry before its dispatch (the "donation-vs-staging"
+        aliasing rule, docs/perf_notes.md).
+
+        Staged feeds are SNAPSHOTS: the values are coerced and copied to
+        device AT STAGE TIME, so mutating the original host buffers in
+        place afterwards does not propagate to the staged window (the
+        un-staged sync path coerces at run time and WOULD see the
+        mutation). Refilling a pinned buffer per batch must therefore
+        stage after each refill, never between stage and run.
+
+        Returns the device-feed dict; the queue holds at most
+        FLAGS_dispatch_queue_depth windows (oldest dropped — for MANUAL
+        staging the latest window wins; the device-prefetching DataLoader
+        consumes FIFO and passes `depth` = its buffer depth + 2 so a
+        pending window is never evicted before its run). The consuming
+        call is matched by program + k + feed-value identity, so pass the
+        SAME feed dict (or the returned device dict) to the next run."""
+        program = program or default_main_program()
+        if hasattr(program, "_is_data_parallel"):
+            program = program.program
+        scope = scope or global_scope()
+        gb = program.global_block()
+        from ..flags import flag
+        t0 = time.perf_counter()
+        orig_vals = dict(feed)
+        if k is not None:
+            k = int(k)
+            feed_vals = _multi_step_feed_vals(gb, feed, k)
+        else:
+            feed_vals = {n: _coerce_feed_value(gb, n, v)
+                         for n, v in feed.items()}
+        import jax.numpy as jnp
+
+        scope_ids = None
+
+        def _all_scope_ids():
+            # walk the WHOLE scope chain: donation resolves state through
+            # scope.find() (parents included), so a parent-resident buffer
+            # needs the defensive copy just as much as a local one. Built
+            # LAZILY: only a USER-PROVIDED device array can possibly be
+            # scope-resident — the common numpy-feed hot path never pays
+            # the O(scope) walk
+            ids = set()
+            s = scope
+            while s is not None:
+                ids.update(id(s.find(n)) for n in s.local_names())
+                s = s.parent
+            return ids
+
+        dev = {}
+        for n, v in feed_vals.items():
+            if isinstance(v, jax.Array):
+                if v is orig_vals.get(n):   # coerced copies are fresh
+                    if scope_ids is None:
+                        scope_ids = _all_scope_ids()
+                    # scope-resident array: copy into a fresh buffer so
+                    # the in-flight window's donation cannot invalidate
+                    # the staged entry
+                    v = jnp.array(v, copy=True) if id(v) in scope_ids \
+                        else v
+                dev[n] = v
+            else:
+                dev[n] = jax.device_put(v)
+        monitor.stat_add("executor.h2d_ms",
+                         (time.perf_counter() - t0) * 1000.0)
+        if depth is None:
+            depth = int(flag("FLAGS_dispatch_queue_depth"))
+        depth = max(1, int(depth))
+        with self._staged_lock:
+            # the depth bound is PER TAG: manual staging (tag=None,
+            # latest-wins) must never evict a prefetch iterator's pending
+            # FIFO windows staged under its own larger bound, and vice
+            # versa — each producer only trims its own entries
+            same = [e for e in self._staged if e.tag is tag]
+            while len(same) >= depth:
+                self._staged.remove(same.pop(0))
+            self._staged.append(_StagedFeeds(
+                (program._uid, program._version), k, orig_vals, dev,
+                tag=tag))
+            monitor.stat_set("executor.dispatch_queue_depth",
+                             len(self._staged))
+        return dev
+
+    def _purge_staged(self, tag):
+        """Drop every staged window carrying `tag` (an abandoned
+        device-prefetching iterator's pending H2D buffers must not pin
+        HBM for the rest of the process)."""
+        with self._staged_lock:
+            kept = [e for e in self._staged if e.tag is not tag]
+            if len(kept) != len(self._staged):
+                self._staged = collections.deque(kept)
+                monitor.stat_set("executor.dispatch_queue_depth",
+                                 len(self._staged))
+
+    def _take_staged(self, program, feed, k):
+        """Pop and return the staged device feeds matching this call (or
+        None). Non-matching entries stay queued for their owner."""
+        with self._staged_lock:
+            for i, e in enumerate(self._staged):
+                if e.matches(program, feed, k):
+                    del self._staged[i]
+                    monitor.stat_set("executor.dispatch_queue_depth",
+                                     len(self._staged))
+                    return e.device_feeds
+        return None
+
+    def _resolve_staged_donation(self, compiled, staged_vals, scope):
+        """Donation-conflict resolution for consumed staged feeds: any
+        staged buffer that IS a scope buffer the block donates gets a
+        device-side copy BEFORE dispatch (the donation would invalidate
+        the feed's backing array mid-step — flipping fetch mode alone
+        would not help; only a fresh buffer does). stage() already copies
+        scope-resident values, so this only fires when state was
+        re-pointed at a staged array after staging. Returns
+        (feed_vals, n_conflicts); callers also fall back to sync when
+        n_conflicts > 0 (the conservative serialization the docs
+        promise). Covers the LocalSGD path's `<name>@LOCALSGD` entries
+        too — every block class donates its mut set."""
+        mut_names = getattr(compiled, "mut_names", None)
+        if not mut_names:
+            return staged_vals, 0
+        mut_ids = set()
+        for n in mut_names:
+            for cand in (scope.find(n), scope.find(n + "@LOCALSGD")):
+                if cand is not None:
+                    mut_ids.add(id(cand))
+        if not any(id(v) in mut_ids for v in staged_vals.values()):
+            return staged_vals, 0
+        import jax.numpy as jnp
+        out, n_conf = {}, 0
+        for name, v in staged_vals.items():
+            if id(v) in mut_ids:
+                out[name] = jnp.copy(v)
+                n_conf += 1
+            else:
+                out[name] = v
+        return out, n_conf
 
     def run(self, program: Optional[Program] = None, feed: Optional[dict] = None,
             fetch_list: Optional[list] = None, scope: Optional[Scope] = None,
-            return_numpy: bool = True, use_program_cache: bool = True):
+            return_numpy: bool = True, use_program_cache: bool = True,
+            sync: Optional[bool] = None):
+        """Run the program's global block once.
+
+        Fetch semantics (docs/perf_notes.md "Host–device overlap"):
+
+        * ``return_numpy=True, sync=True`` (default): fetches drain to
+          numpy — a full device sync + D2H every call.
+        * ``return_numpy=True, sync=False`` (or ``FLAGS_async_dispatch``):
+          fetches are lazy FetchHandles; the sync + D2H happens per handle
+          on first access. State writes are unaffected either way — the
+          Scope adopts the step's device buffers without draining them.
+        * ``return_numpy=False``: the live device arrays, UNSYNCED — jax
+          dispatch is async, so they may still be computing; np.asarray
+          (or .block_until_ready) at the consumer is the sync point.
+        """
         program = program or default_main_program()
         if hasattr(program, "_is_data_parallel"):   # CompiledProgram shim
             program = program.program
         feed = feed or {}
         fetch_list = fetch_list or []
         scope = scope or global_scope()
+        sync = self._resolve_sync(sync)
 
         fetch_names = [v.name if isinstance(v, Variable) else str(v)
                        for v in fetch_list]
@@ -747,6 +1009,10 @@ class Executor:
                     "fetch target %r is not a variable of this program", n,
                     var=n)
 
+        # staged windows match the USER feed — before PS hooks add their
+        # pulled-row keys, which stage() never saw (a post-hook match
+        # would always miss on PS programs and silently double the H2D)
+        staged_vals = self._take_staged(program, feed, k=None)
         # parameter-server hooks (distributed_embedding): pull sparse rows
         # before the step, push their grads after (distributed/ps.py)
         ps_hooks = getattr(program, "_ps_hooks", None) or []
@@ -758,8 +1024,16 @@ class Executor:
                 if gb.has_var(h.grad_name) and h.grad_name not in fetch_names:
                     fetch_names.append(h.grad_name)
         block = program.global_block()
-        feed_vals = {name: _coerce_feed_value(block, name, value)
-                     for name, value in feed.items()}
+        if staged_vals is not None:
+            # coercion + H2D already paid in stage(); hook-added entries
+            # (pulled rows) still coerce here
+            feed_vals = dict(staged_vals)
+            for name, value in feed.items():
+                if name not in feed_vals:
+                    feed_vals[name] = _coerce_feed_value(block, name, value)
+        else:
+            feed_vals = {name: _coerce_feed_value(block, name, value)
+                         for name, value in feed.items()}
         _ensure_stacked_params(program, scope)
         _ensure_shared_beta_pows(program, scope)
         state_names = _referenced_state_names(block, scope, feed_vals)
@@ -794,6 +1068,16 @@ class Executor:
                                                 scope)
             if use_program_cache:
                 self._cache[key] = compiled
+
+        if staged_vals is not None:
+            # the donation-vs-staging aliasing rule: a staged buffer the
+            # step donates is copied into a fresh buffer pre-dispatch,
+            # and the call serializes (sync) for good measure
+            feed_vals, n_conf = self._resolve_staged_donation(
+                compiled, feed_vals, scope)
+            if n_conf:
+                monitor.stat_add("executor.staging_conflicts", n_conf)
+                sync = True
 
         rng_key = _next_rng_key(scope, program.random_seed)
         from .. import profiler as _prof
@@ -833,14 +1117,27 @@ class Executor:
             for h in ps_hooks:
                 h.post(fetched_by_name)
             fetches = fetches[:n_user_fetch]
-        if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return fetches
+        user_names = fetch_names[:n_user_fetch] if ps_hooks else fetch_names
+        if not sync and return_numpy and fetches:
+            # lazy-fetch side of the donation rule: a fetch of a WRITTEN
+            # persistable shares (or may share) the buffer the scope just
+            # adopted — the NEXT dispatch donates that buffer, and a
+            # deferred .numpy() would read deleted memory. Snapshot those
+            # rare fetches with a device-side copy (bit-identical, async);
+            # ordinary fetches (losses, activations) pass through untouched.
+            # The sync path is immune (it drains before any next dispatch),
+            # and run_steps' stacked fetches are fresh [k,...] buffers.
+            import jax.numpy as jnp
+            fetches = [jnp.copy(f)
+                       if (n in new_state and hasattr(f, "dtype")) else f
+                       for f, n in zip(fetches, user_names)]
+        return _package_fetches(fetches, user_names, return_numpy, sync)
 
     def run_steps(self, k: int, program: Optional[Program] = None,
                   feed: Optional[dict] = None,
                   fetch_list: Optional[list] = None,
-                  scope: Optional[Scope] = None, return_numpy: bool = True):
+                  scope: Optional[Scope] = None, return_numpy: bool = True,
+                  sync: Optional[bool] = None):
         """Run `k` train steps as ONE device dispatch (a lax.scan training
         loop inside a single XLA program — the scaling-book/MaxText loop).
 
@@ -852,7 +1149,11 @@ class Executor:
         tunnel) this is the difference between dispatch-bound and
         compute-bound training. Random ops draw a distinct key per step
         (fold_in of the run key), matching k separate run() calls in
-        distribution. Sparse-PS programs run in WINDOW mode: one KV pull
+        distribution. Fetch semantics match run(): sync=False (or
+        FLAGS_async_dispatch) returns lazy FetchHandles over the stacked
+        device arrays; return_numpy=False returns them unsynced — so a
+        window loop that only logs every few windows never blocks the
+        host between dispatches. Sparse-PS programs run in WINDOW mode: one KV pull
         covering all k batches' ids, rows frozen for the window, one summed
         push after (_PsHook.pre_multi/post_multi — the reference's async
         communicator batching). Not supported: Geo-SGD or dense-send hooks,
@@ -886,6 +1187,7 @@ class Executor:
         feed = feed or {}
         fetch_list = fetch_list or []
         scope = scope or global_scope()
+        sync = self._resolve_sync(sync)
         fetch_names = [v.name if isinstance(v, Variable) else str(v)
                        for v in fetch_list]
         gb = program.global_block()
@@ -898,13 +1200,24 @@ class Executor:
         # ids, ONE summed push after — the reference's async-communicator
         # batching (communicator.h), amortizing dispatch + RPC cost over k
         n_user_fetch = len(fetch_names)
+        # match the USER feed before the hooks add pulled-row keys (see
+        # run(): a post-hook match would always miss on PS programs)
+        staged_vals = self._take_staged(program, feed, k=k)
         if ps_hooks:
             feed = dict(feed)
             for h in ps_hooks:
                 feed.update(h.pre_multi(feed))
                 if gb.has_var(h.grad_name) and h.grad_name not in fetch_names:
                     fetch_names.append(h.grad_name)
-        feed_vals = _multi_step_feed_vals(gb, feed, k)
+        if staged_vals is not None:
+            # coercion + H2D already paid in stage(); hook-added entries
+            # (the window's pulled rows) still normalize here
+            feed_vals = dict(staged_vals)
+            extra = {n: v for n, v in feed.items() if n not in feed_vals}
+            if extra:
+                feed_vals.update(_multi_step_feed_vals(gb, extra, k))
+        else:
+            feed_vals = _multi_step_feed_vals(gb, feed, k)
         _ensure_stacked_params(program, scope)
         _ensure_shared_beta_pows(program, scope)
         state_names = _referenced_state_names(gb, scope, feed_vals)
@@ -916,6 +1229,12 @@ class Executor:
             compiled = _make_compiled_block(program, feed_vals, fetch_names,
                                             state_names, scope, multi_k=k)
             self._cache[key] = compiled
+        if staged_vals is not None:
+            feed_vals, n_conf = self._resolve_staged_donation(
+                compiled, feed_vals, scope)
+            if n_conf:
+                monitor.stat_add("executor.staging_conflicts", n_conf)
+                sync = True
         rng_key = _next_rng_key(scope, program.random_seed)
         state = {n: scope.find(n) for n in state_names}
         fetches, new_state = compiled(state, feed_vals, rng_key)
@@ -926,9 +1245,9 @@ class Executor:
             for h in ps_hooks:
                 h.post_multi(fetched_by_name)
             fetches = fetches[:n_user_fetch]
-        if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return fetches
+        return _package_fetches(fetches, fetch_names[:n_user_fetch]
+                                if ps_hooks else fetch_names,
+                                return_numpy, sync)
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
@@ -1170,6 +1489,9 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+        with self._staged_lock:
+            self._staged.clear()
+            monitor.stat_set("executor.dispatch_queue_depth", 0)
 
 
 def op_count(program) -> int:

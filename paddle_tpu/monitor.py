@@ -1,6 +1,14 @@
 """Stat registry (reference platform/monitor.h:34-154 STAT_ADD/STAT_GET:
 named int/float counters exported through pybind; e.g. GPU mem watermarks).
 Host-side counters here; device memory watermarks come from the XLA client.
+
+Naming convention: dotted namespaces per subsystem. `resilience.*` is
+tabled in docs/resilience.md; the executor's host–device overlap ledger —
+`executor.host_blocked_ms`, `executor.fetch_sync_count`,
+`executor.h2d_ms`, `executor.dispatch_queue_depth`,
+`executor.staging_conflicts`, `executor.async_fallbacks` — is tabled in
+docs/perf_notes.md "Host–device overlap" and budget-checked by
+scripts/ci.py's host-stall check.
 """
 from __future__ import annotations
 

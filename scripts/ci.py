@@ -46,6 +46,83 @@ WEIGHTS = {
 }
 
 
+# Host-stall budget check (ISSUE-4 CI satellite): a 20-step loop logging
+# every 5 under async dispatch must emit the executor.host_blocked_ms stat
+# and sync EXACTLY steps/log_every times — a regression that silently
+# drains every step (or never materializes) flips the count and fails CI
+# before any hardware round records a poisoned number.
+HOST_STALL_CHECK = r'''
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu import monitor
+
+x = layers.data(name="x", shape=[6], dtype="float32")
+y = layers.data(name="y", shape=[1], dtype="float32")
+h = layers.fc(x, 8, act="tanh")
+pred = layers.fc(h, 1)
+loss = layers.mean(layers.square_error_cost(pred, y))
+paddle.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+exe = fluid.Executor()
+exe.run(fluid.default_startup_program())
+rng = np.random.RandomState(0)
+feed = {"x": rng.randn(16, 6).astype(np.float32)}
+feed["y"] = feed["x"].sum(1, keepdims=True).astype(np.float32)
+exe.run(feed=feed, fetch_list=[loss])          # compile + warm
+for s in ("executor.host_blocked_ms", "executor.fetch_sync_count"):
+    monitor.stat_reset(s)
+steps, log_every = 20, 5
+for step in range(steps):
+    out, = exe.run(feed=feed, fetch_list=[loss], sync=False)
+    if (step + 1) % log_every == 0:
+        float(out)                             # the ONLY materializations
+want = steps // log_every
+syncs = int(monitor.stat_get("executor.fetch_sync_count"))
+blocked = monitor.stat_get("executor.host_blocked_ms")
+assert syncs == want, f"fetch_sync_count {syncs} != {want}"
+assert blocked > 0.0, "host_blocked_ms stat was not emitted"
+print(f"host-stall budget OK: fetch_sync_count={syncs} "
+      f"(= {steps} steps / log every {log_every}), "
+      f"host_blocked_ms={blocked:.2f}")
+'''
+
+
+def start_host_stall(env):
+    """Launch the host-stall budget script in a fresh interpreter on the
+    CPU mesh. Started BEFORE the shard loop so its runtime overlaps the
+    shards instead of extending the critical path; collect_host_stall
+    reaps it after the shards finish."""
+    return subprocess.Popen([sys.executable, "-c", HOST_STALL_CHECK],
+                            cwd=ROOT, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def collect_host_stall(proc, timeout=600) -> bool:
+    """True iff the budget holds. A hung interpreter — the dispatch-stall
+    class this check exists for — must record a FAIL, not crash the CI
+    driver before its aggregate lines print."""
+    try:
+        out_s, err_s = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        print(f"[host-stall] FAIL timed out after {timeout}s "
+              "(wedged dispatch?)")
+        return False
+    out = (out_s or "").strip()
+    tail = (err_s or "").strip().splitlines()[-5:]
+    status = "OK " if proc.returncode == 0 else "FAIL"
+    print(f"[host-stall] {status} {out}" + (
+        "\n" + "\n".join(tail) if proc.returncode != 0 else ""))
+    return proc.returncode == 0
+
+
+def host_stall_check(env) -> bool:
+    """Serial convenience wrapper (tests / ad-hoc use)."""
+    return collect_host_stall(start_host_stall(env))
+
+
 def shard(files, n):
     """LPT bin packing by weight."""
     bins = [(0.0, []) for _ in range(n)]
@@ -62,6 +139,8 @@ def main():
     # shards beyond the core count only thrash (XLA CPU uses every core)
     ap.add_argument("-n", type=int, default=max(1, min(6, os.cpu_count()
                                                        or 1)))
+    ap.add_argument("--no-host-stall", action="store_true",
+                    help="skip the host-stall budget check")
     ap.add_argument("rest", nargs="*", help="extra pytest args")
     args = ap.parse_args()
 
@@ -69,6 +148,10 @@ def main():
     from conftest import cpu_mesh_env
     env = cpu_mesh_env(8)
     env["PADDLE_TPU_TEST_REEXEC"] = "1"
+
+    stall_proc = None
+    if not args.no_host_stall:
+        stall_proc = start_host_stall(env)   # overlaps the shards below
 
     files = sorted(glob.glob(os.path.join(ROOT, "tests", "test_*.py")))
     shards = shard(files, args.n)
@@ -110,6 +193,8 @@ def main():
     kinds += sorted(k for k in totals if k not in kinds)
     agg = ", ".join(f"{totals.get(k, 0)} {k}" for k in kinds)
     print(f"CI aggregate: {agg}")
+    if stall_proc is not None:
+        failed = failed or not collect_host_stall(stall_proc)
     print(f"CI total: {time.time() - t0:.0f}s over {len(shards)} shards -> "
           f"{'FAILED' if failed else 'PASSED'}")
     return 1 if failed else 0

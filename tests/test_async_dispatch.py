@@ -1,0 +1,544 @@
+"""Async host–device pipeline: lazy fetches, staged feeds, prefetch.
+
+The contract under test (docs/perf_notes.md "Host–device overlap"):
+async dispatch changes WHEN values cross to host, never WHAT is computed —
+so every parity assertion here is BIT-FOR-BIT (assert_array_equal), not
+tolerance-based: run(sync=False) / staged feeds / prefetched feeds must
+produce the identical losses and identical saved checkpoints as the
+serial sync path, on the single device and on a dp=2 virtual mesh.
+Sync remains the default (FLAGS_async_dispatch=False)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu import monitor
+from paddle_tpu.fluid import layers
+from paddle_tpu.framework.fetch import FetchHandle
+
+
+def _fresh():
+    from paddle_tpu.framework import program as pm, scope as sm, unique_name
+    pm._main_program = pm.Program()
+    pm._startup_program = pm.Program()
+    sm._reset_global_scope()
+    unique_name.switch()
+
+
+def _build(seed=0, dp2=False):
+    np.random.seed(seed)
+    x = layers.data(name="x", shape=[6], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    h = layers.fc(x, 8, act="tanh")
+    pred = layers.fc(h, 1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    paddle.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+    if dp2:
+        import jax
+        from paddle_tpu.parallel import DistConfig, attach, build_mesh
+        attach(fluid.default_main_program(),
+               DistConfig(mesh=build_mesh(dp=2,
+                                          devices=jax.devices()[:2])))
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    return exe, loss
+
+
+def _batches(n, seed=0):
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(n, 16, 6).astype(np.float32)
+    ys = xs.sum(2, keepdims=True).astype(np.float32)
+    return xs, ys
+
+
+def _params():
+    return {p.name: np.asarray(fluid.global_scope().find(p.name))
+            for p in fluid.default_main_program().all_parameters()}
+
+
+def _reset_exec_stats():
+    for s in ("executor.host_blocked_ms", "executor.fetch_sync_count",
+              "executor.h2d_ms", "executor.dispatch_queue_depth",
+              "executor.staging_conflicts", "executor.async_fallbacks"):
+        monitor.stat_reset(s)
+
+
+# --------------------------------------------------------------------------
+# bit-for-bit parity: async vs sync
+# --------------------------------------------------------------------------
+
+def _train(sync, steps=8, dp2=False, stage=False):
+    _fresh()
+    exe, loss = _build(seed=0, dp2=dp2)
+    xs, ys = _batches(steps)
+    losses = []
+    for i in range(steps):
+        feed = {"x": xs[i], "y": ys[i]}
+        if stage and i > 0:
+            feed = staged                      # noqa: F821  (set below)
+        out, = exe.run(feed=feed, fetch_list=[loss], sync=sync)
+        if stage and i + 1 < steps:
+            staged = exe.stage({"x": xs[i + 1], "y": ys[i + 1]})  # noqa
+        losses.append(np.asarray(out))
+    return np.stack(losses), _params()
+
+
+def test_async_parity_single_step_loop():
+    ref_losses, ref_params = _train(sync=True)
+    async_losses, async_params = _train(sync=False)
+    np.testing.assert_array_equal(ref_losses, async_losses)
+    for n in ref_params:
+        np.testing.assert_array_equal(ref_params[n], async_params[n])
+
+
+def test_async_parity_with_staged_feeds():
+    ref_losses, ref_params = _train(sync=True)
+    stg_losses, stg_params = _train(sync=False, stage=True)
+    np.testing.assert_array_equal(ref_losses, stg_losses)
+    for n in ref_params:
+        np.testing.assert_array_equal(ref_params[n], stg_params[n])
+
+
+def test_async_parity_dp2_mesh():
+    ref_losses, ref_params = _train(sync=True, steps=5, dp2=True)
+    async_losses, async_params = _train(sync=False, steps=5, dp2=True)
+    np.testing.assert_array_equal(ref_losses, async_losses)
+    for n in ref_params:
+        np.testing.assert_array_equal(ref_params[n], async_params[n])
+
+
+def test_async_parity_run_steps_windows_with_checkpoint(tmp_path):
+    """Two run_steps(4) windows with a checkpoint save between them: the
+    async arm's losses, SAVED checkpoint bytes, and final params must all
+    match the sync arm bit-for-bit (the mid-loop save materializes state
+    without perturbing the rng stream or the staged window)."""
+    from paddle_tpu import io
+
+    def arm(sync, ckpt_dir):
+        _fresh()
+        exe, loss = _build(seed=1)
+        xs, ys = _batches(8, seed=1)
+        w1, = exe.run_steps(4, feed={"x": xs[:4], "y": ys[:4]},
+                            fetch_list=[loss], sync=sync)
+        io.save_persistables(exe, str(ckpt_dir),
+                             fluid.default_main_program())
+        w2, = exe.run_steps(4, feed={"x": xs[4:], "y": ys[4:]},
+                            fetch_list=[loss], sync=sync)
+        losses = np.concatenate([np.asarray(w1), np.asarray(w2)])
+        return losses, _params()
+
+    ref_losses, ref_params = arm(True, tmp_path / "sync")
+    async_losses, async_params = arm(False, tmp_path / "async")
+    np.testing.assert_array_equal(ref_losses, async_losses)
+    for n in ref_params:
+        np.testing.assert_array_equal(ref_params[n], async_params[n])
+    with np.load(tmp_path / "sync" / "persistables.npz") as a, \
+            np.load(tmp_path / "async" / "persistables.npz") as b:
+        assert sorted(a.files) == sorted(b.files)
+        for n in a.files:
+            np.testing.assert_array_equal(a[n], b[n])
+
+
+# --------------------------------------------------------------------------
+# FetchHandle semantics
+# --------------------------------------------------------------------------
+
+def test_fetch_handle_lazy_and_counted():
+    exe, loss = _build()
+    xs, ys = _batches(1)
+    exe.run(feed={"x": xs[0], "y": ys[0]}, fetch_list=[loss])  # warm
+    _reset_exec_stats()
+    h, = exe.run(feed={"x": xs[0], "y": ys[0]}, fetch_list=[loss],
+                 sync=False)
+    assert isinstance(h, FetchHandle) and not h.is_materialized()
+    # metadata never blocks / never counts
+    assert h.shape == () and h.dtype == np.float32
+    assert monitor.stat_get("executor.fetch_sync_count") == 0
+    v = float(h)
+    assert h.is_materialized()
+    assert monitor.stat_get("executor.fetch_sync_count") == 1
+    assert monitor.stat_get("executor.host_blocked_ms") > 0
+    # cached: repeated access pays once
+    assert float(h) == v and np.asarray(h).shape == ()
+    assert monitor.stat_get("executor.fetch_sync_count") == 1
+
+
+def test_fetch_handle_lazy_indexing_on_stacked_fetch():
+    exe, loss = _build()
+    xs, ys = _batches(4)
+    h, = exe.run_steps(4, feed={"x": xs, "y": ys}, fetch_list=[loss],
+                       sync=False)
+    assert isinstance(h, FetchHandle) and h.shape == (4,) and len(h) == 4
+    _reset_exec_stats()
+    tail = h[-1]                      # device-side slice: still lazy
+    assert isinstance(tail, FetchHandle) and not h.is_materialized()
+    assert monitor.stat_get("executor.fetch_sync_count") == 0
+    last = float(tail)
+    assert monitor.stat_get("executor.fetch_sync_count") == 1
+    assert not h.is_materialized()    # the stack itself never drained
+    np.testing.assert_array_equal(last, np.asarray(h)[-1])
+    # type-stable indexing: AFTER materialization h[-1] is still a
+    # handle (pre-paid), so h[-1].numpy() works in either access order
+    tail2 = h[-1]
+    assert isinstance(tail2, FetchHandle) and tail2.is_materialized()
+    assert float(tail2.numpy()) == last
+
+
+def test_return_numpy_false_returns_unsynced_device_arrays():
+    """return_numpy=False is the raw device surface: jax Arrays, no
+    numpy copy, no forced sync (bench.py drains them with one scalar
+    pull). Scope state adopts device buffers the same way."""
+    import jax
+    exe, loss = _build()
+    xs, ys = _batches(1)
+    _reset_exec_stats()
+    out, = exe.run(feed={"x": xs[0], "y": ys[0]}, fetch_list=[loss],
+                   return_numpy=False)
+    assert isinstance(out, jax.Array) and not isinstance(out, np.ndarray)
+    assert monitor.stat_get("executor.fetch_sync_count") == 0
+    stacked, = exe.run_steps(3, feed={"x": xs[0], "y": ys[0]},
+                             fetch_list=[loss], return_numpy=False)
+    assert isinstance(stacked, jax.Array) and stacked.shape == (3,)
+    assert monitor.stat_get("executor.fetch_sync_count") == 0
+
+
+def test_sync_remains_the_default():
+    from paddle_tpu.flags import flag
+    assert flag("FLAGS_async_dispatch") is False
+    exe, loss = _build()
+    xs, ys = _batches(1)
+    out, = exe.run(feed={"x": xs[0], "y": ys[0]}, fetch_list=[loss])
+    assert isinstance(out, np.ndarray)
+
+
+# --------------------------------------------------------------------------
+# staging: the host-side dispatch queue
+# --------------------------------------------------------------------------
+
+def test_stage_consumed_by_matching_run():
+    exe, loss = _build()
+    xs, ys = _batches(2)
+    exe.run(feed={"x": xs[0], "y": ys[0]}, fetch_list=[loss])  # warm
+    _reset_exec_stats()
+    feed = {"x": xs[1], "y": ys[1]}
+    dev = exe.stage(feed)
+    import jax
+    assert all(isinstance(v, jax.Array) for v in dev.values())
+    assert monitor.stat_get("executor.dispatch_queue_depth") == 1
+    assert monitor.stat_get("executor.h2d_ms") > 0
+    exe.run(feed=feed, fetch_list=[loss], sync=False)
+    assert monitor.stat_get("executor.dispatch_queue_depth") == 0
+    # consuming again is a plain un-staged run (no stale match)
+    exe.run(feed=feed, fetch_list=[loss], sync=False)
+    assert monitor.stat_get("executor.dispatch_queue_depth") == 0
+
+
+def test_stage_run_steps_window():
+    exe, loss = _build()
+    xs, ys = _batches(4)
+    feed = {"x": xs, "y": ys}
+    exe.stage(feed, k=4)
+    h, = exe.run_steps(4, feed=feed, fetch_list=[loss], sync=False)
+    assert np.asarray(h).shape == (4,)
+
+
+def test_stage_depth_bound_drops_oldest():
+    from paddle_tpu.flags import flag
+    exe, loss = _build()
+    xs, ys = _batches(4)
+    depth = int(flag("FLAGS_dispatch_queue_depth"))
+    for i in range(4):
+        exe.stage({"x": xs[i], "y": ys[i]})
+    assert monitor.stat_get("executor.dispatch_queue_depth") == depth
+    # the dropped (oldest) windows simply fall back to normal coercion
+    out, = exe.run(feed={"x": xs[0], "y": ys[0]}, fetch_list=[loss])
+    assert np.isfinite(out).all()
+
+
+def test_stage_depth_bound_is_per_tag():
+    """Manual staging (tag=None) must never evict a prefetch iterator's
+    tagged windows — each producer trims only its own entries."""
+    exe, loss = _build()
+    xs, ys = _batches(6)
+    t = object()
+    for i in range(3):
+        exe.stage({"x": xs[i], "y": ys[i]}, tag=t, depth=4)
+    for i in range(3, 6):   # manual: default depth 2, oldest manual drops
+        exe.stage({"x": xs[i], "y": ys[i]})
+    tags = [e.tag for e in exe._staged]
+    assert tags.count(t) == 3, "manual staging evicted tagged windows"
+    assert tags.count(None) == 2
+
+
+def test_stage_copies_scope_resident_arrays():
+    """Donation-aware placement: a staged feed value that IS a
+    scope-resident device array is defensively copied, so the in-flight
+    window's donation can never invalidate the staged buffer."""
+    import jax
+    exe, loss = _build()
+    xs, ys = _batches(1)
+    exe.run(feed={"x": xs[0], "y": ys[0]}, fetch_list=[loss])
+    scope = fluid.global_scope()
+    w_name = fluid.default_main_program().all_parameters()[0].name
+    w = scope.find(w_name)
+    # a free-standing device array passes through by identity ...
+    free = jax.device_put(xs[0])
+    dev = exe.stage({"x": free, "y": ys[0]})
+    assert dev["x"] is free
+    # ... a scope-resident one is copied into a fresh buffer
+    dev2 = exe.stage({"x": w, "y": ys[0]})
+    assert dev2["x"] is not w
+    np.testing.assert_array_equal(np.asarray(dev2["x"]), np.asarray(w))
+
+
+def test_staging_donation_conflict_copies_before_dispatch():
+    """The donation-vs-staging aliasing rule: a staged entry holding a
+    buffer the step donates must be COPIED into a fresh buffer before
+    dispatch (a sync fallback alone would still feed the doomed buffer).
+    Exercised on the resolution helper — the public stage() path already
+    copies scope-resident arrays, so only a post-staging scope re-point
+    can produce the conflict."""
+    from paddle_tpu.flags import set_flags
+    set_flags({"FLAGS_min_donate_bytes": 0})   # donate even tiny params
+    try:
+        _fresh()
+        exe, loss = _build(seed=2)
+        xs, ys = _batches(1, seed=2)
+        feed = {"x": xs[0], "y": ys[0]}
+        exe.run(feed=feed, fetch_list=[loss])  # warm: compiled + donated
+        _reset_exec_stats()
+        prog = fluid.default_main_program()
+        w_name = prog.all_parameters()[0].name      # fc weight, donated
+        w = fluid.global_scope().find(w_name)
+        import jax
+        dev = {"x": jax.device_put(xs[0]), "y": w}  # y aliases donated w
+        # pick the TRAIN block (the startup entry has no mut state)
+        compiled = [c for c in exe._cache.values()
+                    if getattr(c, "mut_names", None)][-1]
+        out, n_conf = exe._resolve_staged_donation(compiled, dev,
+                                                   fluid.global_scope())
+        assert n_conf == 1
+        assert out["y"] is not w, "conflicting buffer was not copied"
+        assert out["x"] is dev["x"]
+        np.testing.assert_array_equal(np.asarray(out["y"]), np.asarray(w))
+        out2, n2 = exe._resolve_staged_donation(
+            compiled, {"x": dev["x"]}, fluid.global_scope())
+        assert n2 == 0 and out2["x"] is dev["x"]
+    finally:
+        set_flags({"FLAGS_min_donate_bytes": 65536})
+
+
+def test_lazy_fetch_of_written_state_survives_next_dispatch():
+    """Lazy-fetch side of the donation rule: fetching a WRITTEN
+    persistable with sync=False must snapshot it — the scope adopts the
+    same (or buffer-sharing) array and the NEXT dispatch donates it, so
+    an un-copied handle could read deleted memory. The handle must return
+    the value AT FETCH TIME, bit-for-bit, after later steps ran."""
+    from paddle_tpu.flags import set_flags
+    set_flags({"FLAGS_min_donate_bytes": 0})   # donate even tiny params
+    try:
+        _fresh()
+        exe, loss = _build(seed=8)
+        xs, ys = _batches(3, seed=8)
+        w_name = fluid.default_main_program().all_parameters()[0].name
+        exe.run(feed={"x": xs[0], "y": ys[0]}, fetch_list=[loss])
+        h, = exe.run(feed={"x": xs[1], "y": ys[1]}, fetch_list=[w_name],
+                     sync=False)
+        snap = np.asarray(fluid.global_scope().find(w_name))
+        exe.run(feed={"x": xs[2], "y": ys[2]},
+                fetch_list=[loss])             # donates the scope buffer
+        np.testing.assert_array_equal(h.numpy(), snap)
+    finally:
+        set_flags({"FLAGS_min_donate_bytes": 65536})
+
+
+def test_async_falls_back_to_sync_under_fault_plan():
+    from paddle_tpu.resilience.faults import clear_plan, install_plan
+    exe, loss = _build()
+    xs, ys = _batches(1)
+    _reset_exec_stats()
+    install_plan("kv.pull:error:every=1000000")
+    try:
+        out, = exe.run(feed={"x": xs[0], "y": ys[0]}, fetch_list=[loss],
+                       sync=False)
+        assert isinstance(out, np.ndarray)     # NOT a handle
+        assert monitor.stat_get("executor.async_fallbacks") == 1
+    finally:
+        clear_plan()
+
+
+# --------------------------------------------------------------------------
+# the acceptance loop: 20 steps, logging every 5
+# --------------------------------------------------------------------------
+
+def test_logging_loop_sync_budget():
+    """ISSUE-4 acceptance: a 20-step loop logging every 5 steps pays
+    fetch_sync_count <= 5 under async dispatch and less host-blocked
+    time than the sync arm of the same loop.
+
+    Geometry note: the model must do REAL per-step work (a few ms of
+    matmuls) — with a microsecond step both arms' blocked totals are
+    scheduler noise and the comparison is meaningless; at this size the
+    sync arm's 20 drains each pay a D2H + sync while the async arm's 4
+    materializations read already-finished values (measured ~10x apart,
+    docs/perf_notes.md "Host–device overlap"). The count assertions are
+    exact; the timing assertion gets a bounded retry because wall-clock
+    comparisons on a shared CI host can hiccup — noise only ever ADDS
+    blocked time, so one clean win demonstrates the overlap."""
+    np.random.seed(7)
+    x = layers.data(name="x", shape=[256], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    h = x
+    for _ in range(4):
+        h = layers.fc(h, 256, act="relu")
+    pred = layers.fc(h, 1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    paddle.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(7)
+    feed = {"x": rng.randn(128, 256).astype(np.float32),
+            "y": rng.randn(128, 1).astype(np.float32)}
+    exe.run(feed=feed, fetch_list=[loss])      # compile + warm
+
+    def run_arms():
+        arms = {}
+        for arm, sync in (("sync", True), ("async", False)):
+            _reset_exec_stats()
+            for step in range(20):
+                out, = exe.run(feed=feed, fetch_list=[loss], sync=sync)
+                if (step + 1) % 5 == 0:
+                    float(np.asarray(out).reshape(-1)[0])
+            arms[arm] = {
+                "syncs": int(
+                    monitor.stat_get("executor.fetch_sync_count")),
+                "blocked": monitor.stat_get("executor.host_blocked_ms")}
+        return arms
+
+    attempts = []
+    for _ in range(3):
+        arms = run_arms()
+        assert arms["async"]["syncs"] == 4 <= 5
+        assert arms["sync"]["syncs"] == 20
+        attempts.append(arms)
+        if arms["async"]["blocked"] < arms["sync"]["blocked"]:
+            break
+    else:
+        raise AssertionError(
+            f"async arm never beat sync host_blocked_ms: {attempts}")
+
+
+# --------------------------------------------------------------------------
+# device-prefetching DataLoader
+# --------------------------------------------------------------------------
+
+def _loader(xs, ys):
+    from paddle_tpu.dataloader import DataLoader
+
+    def gen():
+        for i in range(len(xs)):
+            yield {"x": xs[i], "y": ys[i]}
+    dl = DataLoader.from_generator(capacity=4)
+    dl.set_batch_generator(gen)
+    return dl
+
+
+def test_prefetch_yields_device_feeds_and_matches_host_path():
+    import jax
+    xs, ys = _batches(6, seed=3)
+
+    def arm(prefetched, use_executor):
+        _fresh()
+        exe, loss = _build(seed=3)
+        losses = []
+        if prefetched:
+            _reset_exec_stats()
+            it = _loader(xs, ys).prefetch(
+                executor=exe if use_executor else None, depth=2)
+        else:
+            it = iter([{"x": xs[i], "y": ys[i]} for i in range(len(xs))])
+        for feed in it:
+            if prefetched:
+                assert all(isinstance(v, jax.Array) for v in feed.values())
+            out, = exe.run(feed=feed, fetch_list=[loss],
+                           sync=not prefetched)
+            losses.append(np.asarray(out))
+        if prefetched:
+            assert monitor.stat_get("executor.h2d_ms") > 0
+        if prefetched and use_executor:
+            # every staged window must have been CONSUMED by its run (the
+            # identity match is live, not silently evicted) — leftovers
+            # would mean the dispatch queue and the FIFO consumption
+            # disagree about depth
+            assert monitor.stat_get("executor.dispatch_queue_depth") == 0
+        return np.stack(losses), _params()
+
+    ref_losses, ref_params = arm(False, False)
+    for use_exec in (False, True):
+        pf_losses, pf_params = arm(True, use_exec)
+        np.testing.assert_array_equal(ref_losses, pf_losses)
+        for n in ref_params:
+            np.testing.assert_array_equal(ref_params[n], pf_params[n])
+
+
+def test_prefetch_close_never_wedges_mid_epoch():
+    xs, ys = _batches(8, seed=4)
+    _fresh()
+    exe, loss = _build(seed=4)
+    it = _loader(xs, ys).prefetch(depth=1)
+    feed = next(iter(it))
+    exe.run(feed=feed, fetch_list=[loss])
+    it.close()                       # abandon mid-epoch: must not hang
+    it.close()                       # idempotent
+    assert not it._thread.is_alive()
+    with pytest.raises(StopIteration):
+        next(it)                     # closed + drained = end, not a hang
+
+
+def test_prefetch_abandoned_iterator_is_finalized():
+    """Breaking out of an epoch without close() must not leak the fill
+    thread: the thread holds only a weak reference to the prefetcher, so
+    garbage collection fires the finalizer, which stops + drains it."""
+    import gc
+    xs, ys = _batches(8, seed=6)
+    _fresh()
+    exe, loss = _build(seed=6)
+    it = _loader(xs, ys).prefetch(depth=1)
+    next(iter(it))                    # mid-epoch
+    th = it._thread
+    del it
+    gc.collect()
+    th.join(timeout=10)
+    assert not th.is_alive(), "abandoned prefetch iterator leaked thread"
+
+
+def test_prefetch_abandoned_with_executor_purges_staged():
+    """Executor-routed prefetch: abandoning the iterator must also purge
+    ITS pending windows from the executor's dispatch queue — staged
+    device buffers would otherwise pin HBM for the process lifetime."""
+    import gc
+    xs, ys = _batches(8, seed=9)
+    _fresh()
+    exe, loss = _build(seed=9)
+    it = _loader(xs, ys).prefetch(executor=exe, depth=2)
+    feed = next(iter(it))
+    exe.run(feed=feed, fetch_list=[loss], sync=False)
+    th = it._thread
+    del it, feed
+    gc.collect()
+    th.join(timeout=10)
+    assert not th.is_alive()
+    assert len(exe._staged) == 0, "abandoned prefetch left staged windows"
+    assert monitor.stat_get("executor.dispatch_queue_depth") == 0
+
+
+def test_prefetch_rejects_non_dict_batches():
+    from paddle_tpu.dataloader import DataLoader
+    dl = DataLoader.from_generator(capacity=2)
+    dl.set_batch_generator(lambda: iter([(np.zeros((2, 6), np.float32),)]))
+    _fresh()
+    _build(seed=5)
+    with pytest.raises((TypeError, RuntimeError)):
+        next(iter(dl.prefetch(depth=1)))

@@ -76,8 +76,12 @@ def test_dcgan_trains_toward_data_distribution():
         base = blob[None, None] * 1.2
         return (base + 0.05 * rng.randn(n, 1, 8, 8)).astype(np.float32)
 
+    # 300 steps: at 170 this container's jax build leaves the generator
+    # mid-overshoot (gen_mean ~0.99 vs the data's 0.43 — reproduced on the
+    # untouched seed; ISSUE-4 deflake satellite). The adversarial pair
+    # settles by ~300 steps (gap 0.05 vs the 0.29 bound), same lr/schedule.
     d_hist, g_hist = [], []
-    for step in range(170):
+    for step in range(300):
         zb = rng.randn(32, 4).astype(np.float32)
         dl, = exe.run(d_prog, feed={"real": real_batch(), "z": zb},
                       fetch_list=[d_loss])
