@@ -173,6 +173,37 @@ def _layer_scan_enabled():
     return os.environ.get("PADDLE_TPU_LAYER_SCAN", "0") == "1"
 
 
+def _zero_enabled():
+    """PADDLE_TPU_ZERO=1: the ZeRO-1 A/B arm — flat dp-sharded optimizer
+    state + reduce_scatter/all_gather bucket collectives
+    (parallel/zero.py; main() also sets FLAGS_zero_stage so every fleet
+    build in the process picks it up)."""
+    return os.environ.get("PADDLE_TPU_ZERO", "0") == "1"
+
+
+# structural optimizer-state accounting of the LAST bench_bert build
+# (per-device bytes from the program metadata + the compiled step's
+# memory_analysis — no wall clock involved; reported as an extras row)
+_OPT_STATE_REPORT = None
+
+
+def _stash_opt_state_report(prog, exe, feed, loss):
+    global _OPT_STATE_REPORT
+    try:
+        import jax
+        from paddle_tpu.parallel.zero import optimizer_state_bytes
+        dist = getattr(prog, "_dist_config", None)
+        dp = int(dist.resolve_mesh().shape.get("dp", 1)) if dist else 1
+        rep = optimizer_state_bytes(prog, dp=dp)
+        # shares bench_bert's compile cache: lower+memory_analysis only
+        ma = exe.compiled_memory_analysis(feed, [loss])
+        rep["compiled_argument_bytes_per_device"] = \
+            int(ma.argument_size_in_bytes)
+        _OPT_STATE_REPORT = rep
+    except Exception as e:  # structural extra, never a bench failure
+        print(f"opt-state report failed: {e!r}", file=sys.stderr)
+
+
 def _log(msg):
     print(f"[bench +{time.perf_counter() - _T0:.1f}s] {msg}",
           file=sys.stderr, flush=True)
@@ -286,6 +317,9 @@ def bench_bert(batch, seq_len, steps, masked=False, large=False,
     # into ONE lax.scan over [L]-stacked weights (~L x smaller step HLO,
     # ~L x faster trace+compile) — the A/B toggle for the primary metric
     strategy.layer_scan = _layer_scan_enabled()
+    # PADDLE_TPU_ZERO=1: ZeRO-1 flat dp-sharded optimizer state (the A/B
+    # arm; the record stamps zero_stage so numbers never read as drift)
+    strategy.sharding = _zero_enabled()
     if recompute:
         strategy.recompute = True
         strategy.recompute_configs = {
@@ -313,6 +347,8 @@ def bench_bert(batch, seq_len, steps, masked=False, large=False,
     tokens_per_sec = batch * seq_len * steps / dt
     peak = _peak_flops()
     mfu = tokens_per_sec * 6.0 * n_params / peak
+    _stash_opt_state_report(fluid.default_main_program(), exe, np_feed,
+                            loss)
     return tokens_per_sec, mfu
 
 
@@ -750,6 +786,11 @@ def main():
         # async_dispatch below
         from paddle_tpu.flags import set_flags
         set_flags({"FLAGS_async_dispatch": True})
+    if _zero_enabled():
+        # ZeRO-1 arm: every fleet build in this process shards optimizer
+        # state into flat dp buckets (parallel/zero.py); stamped zero_stage
+        from paddle_tpu.flags import set_flags
+        set_flags({"FLAGS_zero_stage": 1})
 
     errors = []
     init_err = _backend_ready()
@@ -987,6 +1028,15 @@ def main():
             print(f"pipelined-loop bench failed: {e!r}", file=sys.stderr)
             errors.append(f"pipelined: {e!r}")
 
+    if _OPT_STATE_REPORT is not None:
+        # structural row (no timing): optimizer-state bytes/device of the
+        # primary BERT step — under ZeRO-1 the flat buckets divide by dp,
+        # cross-checked against the compiled step's memory_analysis()
+        extras.append({
+            "metric": "optimizer_state_bytes_per_device",
+            "value": _OPT_STATE_REPORT["state_bytes_per_device"],
+            "unit": "bytes", **_OPT_STATE_REPORT})
+
     prev = _gate.load_prev_recorded()
     rec = {
         "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
@@ -1005,6 +1055,8 @@ def main():
     # a number recorded under lazy fetches can never read as baseline
     # drift against a sync round (same contract as layer_scan above)
     rec["async_dispatch"] = os.environ.get("PADDLE_TPU_ASYNC", "0") == "1"
+    # ... and so is the ZeRO-1 arm (PADDLE_TPU_ZERO=0/1 -> zero_stage)
+    rec["zero_stage"] = 1 if _zero_enabled() else 0
     if skipped_rows:
         rec["skipped_rows"] = skipped_rows
     if health_tflops is not None:

@@ -106,8 +106,17 @@ class DistributedStrategy:
     pipeline: bool = False
     pipeline_configs: dict = field(default_factory=lambda: {
         "micro_batch_size": 1, "accumulate_steps": 1})
-    sharding: bool = False                 # ZeRO-1: shard optimizer state
+    # ZeRO-1 (parallel/zero.py): bucket optimizer state into flat dp-sharded
+    # vars updated shard-locally (reduce_scatter -> update -> all_gather);
+    # sharding_configs: {"stage": 1, "fuse_grad_size_in_mb": override}
+    sharding: bool = False
     sharding_configs: dict = field(default_factory=dict)
+    # Gradient bucketing (the reference's fuse_all_reduce_op_pass +
+    # coalesce_grad_tensor_pass knob): coalesce the per-parameter dp
+    # gradient syncs into flat buckets of at most this many MB, so the
+    # compiled step carries <= ceil(grad_bytes/bucket) grouped collectives
+    # instead of one per parameter. 0 disables the pass entirely.
+    fuse_grad_size_in_mb: int = 32
     # mesh geometry (beyond-reference: TP/SP/EP are new capabilities)
     tensor_parallel_degree: int = 1
     pipeline_parallel_degree: int = 1
@@ -129,6 +138,17 @@ class DistributedStrategy:
     a_sync_configs: dict = field(default_factory=dict)
     sparse_cache_rows: int = 0             # client hot-row cache tier
     # (box_ps re-imagining, ps.py HotRowCache; sync mode only)
+
+    def __setattr__(self, name, value):
+        # A typo'd strategy attribute must fail LOUDLY: the reference's
+        # proto silently drops unknown fields, so `strategy.shardingg =
+        # True` (or a misremembered knob name) trains replicated without a
+        # whisper. Known keys are exactly the dataclass fields.
+        if name not in self.__dataclass_fields__:
+            raise AttributeError(
+                f"unknown DistributedStrategy attribute {name!r}; known "
+                f"attributes: {sorted(self.__dataclass_fields__)}")
+        object.__setattr__(self, name, value)
 
 
 class _Fleet:
@@ -423,17 +443,66 @@ class DistributedOptimizer:
             result = opt.minimize(loss, startup_program, parameter_list,
                                   no_grad_set)
 
-        # SPMD attach: data axis + TP rules (+ ZeRO-1 optimizer-state sharding)
-        rules = s.tensor_parallel_rules or ShardingRules()
+        # Bucketed gradient collectives + ZeRO-1 (parallel/zero.py): group
+        # the per-parameter dp gradient syncs into flat buckets, and under
+        # sharding/FLAGS_zero_stage=1 move each bucket's optimizer state
+        # into flat dp-sharded vars (reduce_scatter -> shard-local update ->
+        # all_gather). Program classes whose step is not the one plain
+        # jitted computation (PS hooks, gradient merge's gated updates,
+        # LocalSGD, pipeline microbatching) keep the GSPMD path untouched.
+        from ...flags import flag
+        zero_stage = 0
         if s.sharding:
+            zero_stage = int((s.sharding_configs or {}).get("stage", 1))
+        if flag("FLAGS_zero_stage"):
+            zero_stage = max(zero_stage, int(flag("FLAGS_zero_stage")))
+        if zero_stage not in (0, 1):
+            raise ValueError(
+                f"sharding stage {zero_stage} is not supported: this build "
+                "implements ZeRO stage 1 (optimizer-state sharding, "
+                "parallel/zero.py); set sharding_configs={'stage': 1}")
+        bucket_mb = float((s.sharding_configs or {}).get(
+            "fuse_grad_size_in_mb", s.fuse_grad_size_in_mb))
+        bucketable = (
+            bucket_mb > 0 and not ps_hooks
+            and not (s.gradient_merge
+                     and s.gradient_merge_configs.get("k_steps", 1) > 1)
+            and not getattr(program, "_localsgd_k", 0)
+            and not getattr(program, "_microbatch_k", 0)
+            and s.pipeline_parallel_degree <= 1
+            # device_guard-staged programs: a cross-stage bucket op would
+            # break the pipeline partitioner's stage assignment
+            and not any("pipeline_stage" in op.attrs
+                        for op in program.global_block().ops))
+        if bucketable:
+            from ...framework.program import default_startup_program
+            from ...parallel.zero import apply_grad_bucketing
+            apply_grad_bucketing(
+                program, startup_program or default_startup_program(),
+                result[1], bucket_bytes=int(bucket_mb * (1 << 20)),
+                stage=zero_stage)
+
+        # SPMD attach: data axis + TP rules (+ the flat ZeRO-1 state specs)
+        rules = s.tensor_parallel_rules or ShardingRules()
+        if zero_stage >= 1 and not getattr(program, "_zero_buckets", None):
+            # sharding requested but the bucket pass could not run (pipeline
+            # / gradient-merge / PS program) or found no flat-updatable
+            # bucket (lamb/lars rules): keep the pre-pass GSPMD fallback —
+            # per-param accumulator vars shard over dp by name pattern, so
+            # `sharding=True` still buys the optimizer-state HBM saving
+            # instead of silently no-opping
             import re
             from jax.sharding import PartitionSpec as P
             zero1 = (re.compile(r"_(moment\d?|velocity|mean_square|mean_grad"
                                 r"|momentum)_\d+$"), P("dp"))
-            rules = ShardingRules()
-            rules._rules = list((s.tensor_parallel_rules or
-                                 ShardingRules())._rules) + [zero1]
-        attach(program, DistConfig(mesh=self._fleet._mesh, param_rules=rules))
+            merged = ShardingRules()
+            merged._rules = list(rules._rules) + [zero1]
+            merged._default = rules._default
+            rules = merged
+        attach(program, DistConfig(
+            mesh=self._fleet._mesh, param_rules=rules,
+            state_specs=dict(getattr(program, "_zero_state_specs", None)
+                             or {})))
         return result
 
     def apply_gradients(self, params_grads):

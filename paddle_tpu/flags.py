@@ -37,6 +37,15 @@ _DEFS: Dict[str, tuple] = {
                                "update's live range crosses a remaining "
                                "read (docs/perf_notes.md 'Copy census'); "
                                "0 donates everything"),
+    "FLAGS_zero_stage": (0, "ZeRO optimizer-state sharding stage applied at "
+                            "fleet minimize time (parallel/zero.py): 1 moves "
+                            "each gradient bucket's optimizer state into "
+                            "flat dp-sharded vars updated shard-locally "
+                            "(reduce_scatter -> update -> all_gather), "
+                            "~dp x less optimizer-state HBM per device; "
+                            "0 keeps replicated state (grouped bucket "
+                            "all-reduces still apply). Same switch as "
+                            "DistributedStrategy.sharding"),
     "FLAGS_layer_scan": (False, "roll isomorphic per-layer segments into "
                                 "one lax.scan at fleet minimize time "
                                 "(parallel/transforms.apply_layer_scan; "

@@ -34,7 +34,9 @@ class _CompiledBlock:
                  feed_names: Sequence[str], fetch_names: Sequence[str],
                  state_names: Sequence[str], donate: bool = True,
                  feed_shapes: Optional[dict] = None,
-                 state_shapes: Optional[dict] = None, multi_k: int = 0):
+                 state_shapes: Optional[dict] = None, multi_k: int = 0,
+                 feed_dtypes: Optional[dict] = None,
+                 state_dtypes: Optional[dict] = None):
         self.program = program
         self.block = program.blocks[block_idx]
         self.feed_names = list(feed_names)
@@ -84,6 +86,7 @@ class _CompiledBlock:
                                self.fetch_names, self.mut_names, self.ro_names,
                                self.written_state)
         jit_kw = {}
+        self.manual_dp = False
         dist = getattr(program, "_dist_config", None)
         if dist is not None:
             # SPMD: shard feeds over the data axes, params per TP rules; XLA
@@ -91,9 +94,65 @@ class _CompiledBlock:
             mesh = dist.resolve_mesh()
             self.mesh = mesh
 
+            # Bucketed-collectives path (parallel/zero.py): on a dp-pure
+            # mesh a bucketed program runs the whole step under shard_map,
+            # so its gradient sync is the few grouped __bucket_sync__ /
+            # __zero_update__ collectives instead of one GSPMD all-reduce
+            # per parameter. Any structural obstacle (mixed mesh,
+            # cross-batch ops, indivisible batch, plan/trace failure) falls
+            # back to the GSPMD lowering below.
+            if getattr(program, "_grad_buckets", None) is not None \
+                    and not (micro_k and micro_k > 1):
+                from ..parallel import zero as zero_mod
+                feed_meta = {
+                    n: (tuple((feed_shapes or {}).get(n, ())),
+                        (feed_dtypes or {}).get(n, np.float32))
+                    for n in self.feed_names}
+                state_meta = {
+                    n: (tuple((state_shapes or {}).get(n, ())),
+                        (state_dtypes or {}).get(n, np.float32))
+                    for n in self.state_names}
+                try:
+                    plan = zero_mod.plan_manual_dp(
+                        program, dist, mesh, self.block, fn, feed_meta,
+                        state_meta, self.fetch_names, self.written_state,
+                        multi_k)
+                except Exception:
+                    monitor.stat_add("executor.zero_manual_fallbacks")
+                    plan = None
+                if plan is not None:
+                    self.jitted = zero_mod.build_manual_jit(
+                        plan, fn, self.mut_names, self.ro_names,
+                        donate=donate)
+                    self.manual_dp = True
+                    return
+
+            zero_specs = getattr(program, "_zero_state_specs", None) or {}
+
             def state_shard(names):
-                return {n: dist.state_sharding(
-                    mesh, n, (state_shapes or {}).get(n)) for n in names}
+                from jax.sharding import NamedSharding, PartitionSpec
+                out = {}
+                for n in names:
+                    shp = (state_shapes or {}).get(n)
+                    if shp is None:
+                        v = self.block.find_var_recursive(n)
+                        shp = tuple(v.shape) if v is not None else None
+                    if n in zero_specs:
+                        # flat ZeRO-1 bucket state: dp-sharded storage even
+                        # on the GSPMD path (mixed meshes keep the ~dp x
+                        # optimizer-state saving; GSPMD inserts the param
+                        # all-gather from the spec), replicated when the
+                        # padding does not divide the dp width
+                        ax = zero_specs[n]
+                        div = (shp and shp[0] and
+                               shp[0] % max(int(mesh.shape.get(ax, 1)), 1)
+                               == 0)
+                        out[n] = NamedSharding(
+                            mesh, PartitionSpec(ax) if div
+                            else PartitionSpec())
+                    else:
+                        out[n] = dist.state_sharding(mesh, n, shp)
+                return out
 
             from jax.sharding import NamedSharding, PartitionSpec
 
@@ -119,12 +178,7 @@ class _CompiledBlock:
             # pin written-state outputs to their declared shardings so the
             # arrays written back to the Scope match in_shardings next call
             # (fetches stay unconstrained = None → GSPMD chooses)
-            written_shard = {
-                n: dist.state_sharding(
-                    mesh, n,
-                    (state_shapes or {}).get(
-                        n, tuple(self.block.var(n).shape)))
-                for n in self.written_state}
+            written_shard = state_shard(self.written_state)
             jit_kw["out_shardings"] = ([None] * len(self.fetch_names),
                                        written_shard)
         else:
@@ -647,6 +701,16 @@ def _ensure_shared_beta_pows(program, scope):
             scope.erase(n)
 
 
+def _ensure_zero_state(program, scope):
+    """ZeRO-1 checkpoint adoption (parallel/zero.py): an UNSHARDED
+    checkpoint loaded into a ZeRO program leaves per-param accumulator
+    entries in the scope; pack them into the flat bucket vars the program
+    reads and drop the copies (the `_ensure_shared_beta_pows` /
+    `_ensure_stacked_params` pattern — loaded values win)."""
+    from ..parallel.zero import adopt_unsharded_state
+    adopt_unsharded_state(program, scope)
+
+
 def _referenced_state_names(block, scope, feed_vals):
     """Persistable vars that already have values in the scope and are
     referenced by this block (run()/run_steps() shared)."""
@@ -716,7 +780,10 @@ def _make_compiled_block(program, feed_vals, fetch_names, state_names,
         program, 0, list(feed_vals), fetch_names, state_names,
         feed_shapes={k: tuple(v.shape) for k, v in feed_vals.items()},
         state_shapes={n: tuple(scope.find(n).shape) for n in state_names},
-        multi_k=multi_k)
+        multi_k=multi_k,
+        feed_dtypes={k: np.asarray(v).dtype if not hasattr(v, "dtype")
+                     else v.dtype for k, v in feed_vals.items()},
+        state_dtypes={n: scope.find(n).dtype for n in state_names})
 
 
 class _StagedFeeds:
@@ -1036,6 +1103,7 @@ class Executor:
                          for name, value in feed.items()}
         _ensure_stacked_params(program, scope)
         _ensure_shared_beta_pows(program, scope)
+        _ensure_zero_state(program, scope)
         state_names = _referenced_state_names(block, scope, feed_vals)
 
         key = _block_cache_key(program, feed_vals, fetch_names, state_names)
@@ -1220,6 +1288,7 @@ class Executor:
             feed_vals = _multi_step_feed_vals(gb, feed, k)
         _ensure_stacked_params(program, scope)
         _ensure_shared_beta_pows(program, scope)
+        _ensure_zero_state(program, scope)
         state_names = _referenced_state_names(gb, scope, feed_vals)
         key = ("multi", k) + _block_cache_key(program, feed_vals,
                                               fetch_names, state_names)
@@ -1416,6 +1485,22 @@ class Executor:
         consume the scope's buffers. Requires initialized state (run the
         startup program first); pipeline/LocalSGD/PS programs are not
         supported — their steps are not one jitted computation."""
+        return self._inspect_compiled(feed, fetch_list, program, scope,
+                                      k).as_text()
+
+    def compiled_memory_analysis(self, feed=None, fetch_list=None,
+                                 program=None, scope=None, k=None):
+        """XLA's CompiledMemoryStats for the jitted step (per-DEVICE
+        argument/output/temp bytes) — the structural memory surface behind
+        the ZeRO-1 optimizer-state checks (tests/test_collective_budget.py,
+        bench.py extras): dp-sharded flat state shows up as
+        argument bytes divided by dp, with no wall-clock involved. Same
+        cache/signature rules as compiled_hlo."""
+        return self._inspect_compiled(feed, fetch_list, program, scope,
+                                      k).memory_analysis()
+
+    def _inspect_compiled(self, feed=None, fetch_list=None, program=None,
+                          scope=None, k=None):
         import jax.numpy as jnp
 
         from . import errors
@@ -1459,6 +1544,7 @@ class Executor:
                          for name, value in feed.items()}
         _ensure_stacked_params(program, scope)
         _ensure_shared_beta_pows(program, scope)
+        _ensure_zero_state(program, scope)
         state_names = _referenced_state_names(block, scope, feed_vals)
         key = _block_cache_key(program, feed_vals, fetch_names, state_names)
         if k is not None:
@@ -1478,7 +1564,7 @@ class Executor:
         ro = {n: scope.find(n) for n in compiled.ro_names}
         feeds = {n: jnp.asarray(v) for n, v in feed_vals.items()}
         return compiled.jitted.lower(
-            mut, ro, feeds, jax.random.key(0)).compile().as_text()
+            mut, ro, feeds, jax.random.key(0)).compile()
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,

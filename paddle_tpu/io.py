@@ -39,6 +39,19 @@ def _persistable_names(program: Program, scope):
     return names
 
 
+def _portable_arrays(program: Program, scope) -> dict:
+    """Checkpoint payload for `program`: persistable scope values, with
+    ZeRO-1 flat optimizer-state buckets split back into their per-param
+    views (parallel/zero.py) — checkpoints are ALWAYS the unsharded format,
+    so a replicated program loads them directly and a ZeRO program adopts
+    them back into flat shards (executor._ensure_zero_state), in either
+    direction."""
+    arrays = {n: np.asarray(scope.find(n))
+              for n in _persistable_names(program, scope)}
+    from .parallel.zero import unbucket_state_for_save
+    return unbucket_state_for_save(program, arrays)
+
+
 def _atomic_savez(path: str, arrays: dict):
     """Write an npz to `path` via temp file + fsync + atomic rename. The
     'ckpt.write' fault fires before the rename: an injected (or real) crash
@@ -61,8 +74,7 @@ def save_persistables(executor=None, dirname=None, main_program=None,
     program = main_program or default_main_program()
     scope = global_scope()
     os.makedirs(dirname, exist_ok=True)
-    arrays = {n: np.asarray(scope.find(n))
-              for n in _persistable_names(program, scope)}
+    arrays = _portable_arrays(program, scope)
     path = os.path.join(dirname, filename or "persistables.npz")
     _atomic_savez(path, arrays)
     from .resilience.checkpoint import write_manifest
@@ -111,9 +123,7 @@ def save(program: Optional[Program] = None, model_path: str = "model"):
         os.fsync(f.fileno())
     os.replace(dtmp, model_path + ".pdmodel")
     scope = global_scope()
-    arrays = {n: np.asarray(scope.find(n))
-              for n in _persistable_names(program, scope)}
-    _atomic_savez(model_path + ".pdparams", arrays)
+    _atomic_savez(model_path + ".pdparams", _portable_arrays(program, scope))
 
 
 def load(program: Optional[Program] = None, model_path: str = "model"):

@@ -9,4 +9,6 @@ from .mesh import (build_mesh, set_mesh, get_mesh, default_mesh,
                    ShardingRules, init_parallel_env, named_sharding, P)
 from .spmd import DistConfig, attach
 from .transforms import apply_recompute, GradientMergeWrapper
+from .zero import (apply_grad_bucketing, optimizer_state_bytes,  # noqa: F401
+                   unbucket_state_for_save)
 from .ring_attention import ring_attention, ulysses_attention  # noqa: E402,F401

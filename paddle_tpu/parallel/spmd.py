@@ -28,6 +28,13 @@ class DistConfig:
     batch_axes: Sequence[str] = ("dp",)
     # vars never sharded on the batch axis (e.g. global stats)
     replicated_feeds: Sequence[str] = ()
+    # exact-name -> mesh-axis overrides, checked BEFORE param_rules: the
+    # ZeRO-1 pass (parallel/zero.py) registers its flat [padded] optimizer
+    # state buckets here ({name: "dp"}), so their storage shards over the
+    # data axis wherever the program is attached (fleet.minimize copies
+    # program._zero_state_specs in; the Executor also consults the program
+    # metadata directly, so a manual re-attach cannot lose the sharding)
+    state_specs: dict = field(default_factory=dict)
 
     def resolve_mesh(self) -> Mesh:
         return self.mesh if self.mesh is not None else default_mesh()
@@ -49,6 +56,12 @@ class DistConfig:
         return NamedSharding(mesh, P(*spec))
 
     def state_sharding(self, mesh, name, shape):
+        ax = self.state_specs.get(name)
+        if ax is not None:
+            size = max(int(mesh.shape.get(ax, 1)), 1)
+            if shape and shape[0] and shape[0] % size == 0:
+                return NamedSharding(mesh, P(ax))
+            return NamedSharding(mesh, P())
         return self.param_rules.sharding_for(mesh, name, shape)
 
 

@@ -522,6 +522,13 @@ class GradientMergeWrapper:
         from .. import layers
         from ..framework import unique_name
         block = program.global_block()
+        # gradient-merge gates every optimizer-state write behind where-
+        # selects (outputs rewired to temps), so the optimizer section is
+        # no longer the uniform per-param update the bucketing/ZeRO pass
+        # (parallel/zero.py) rewrites — mark the program so the pass
+        # declines it even when this wrapper was applied manually, outside
+        # DistributedStrategy.gradient_merge
+        program._grad_bucketing_unsafe = True
         merge_start = len(block.ops)  # everything appended below is Optimize
 
         step = layers.create_global_var([1], 0.0, "float32", persistable=True,

@@ -1,0 +1,761 @@
+"""Bucketed gradient collectives + ZeRO-1 sharded optimizer state (dp axis).
+
+Reference counterparts: the fuse-all-reduce pass family —
+`fuse_all_reduce_op_pass.cc:29` + `coalesce_grad_tensor_pass.cc` (grouping the
+per-parameter gradient all-reduces into a few flat fused buffers, knob
+`fuse_grad_size_in_mb`) and the dygraph `_coalesce_tensors` path
+(`dygraph/parallel.py:229`); plus the sharding meta-optimizer's optimizer-state
+partitioning (ZeRO-1).
+
+TPU-native formulation, in three layers:
+
+1. **Program pass** (`apply_grad_bucketing`, run by
+   `fleet.DistributedOptimizer.minimize`): groups the per-parameter gradient
+   vars into dtype-homogeneous flat buckets of at most `fuse_grad_size_in_mb`
+   and inserts one `__bucket_sync__` op per bucket at the backward→optimize
+   boundary. Under ZeRO-1 (`DistributedStrategy.sharding` /
+   `FLAGS_zero_stage=1`) it additionally replaces the per-parameter update ops
+   of each bucket with ONE `__zero_update__` op whose optimizer state lives in
+   flat `[padded_total]` bucket vars sharded over dp — per-device
+   optimizer-state bytes drop by ~dp×.
+
+2. **Op lowerings**: `__bucket_sync__` lowers to ONE pmean per bucket when the
+   step is traced in manual-dp mode (a flatten→concat→psum→split), and to the
+   identity otherwise (GSPMD or a single device already sees summed
+   gradients). `__zero_update__` lowers each bucket as
+   reduce_scatter → shard-local elementwise update (reusing the registered
+   sgd/momentum/adam/adamw lowering on the flat shard) → all_gather of the
+   updated parameters; outside manual mode it runs the full-width flat update
+   (GSPMD then shards the state arithmetic from the flat vars' dp specs).
+
+3. **Manual-dp runner** (`plan_manual_dp` + `build_manual_jit`, hooked from
+   `framework/executor.py _CompiledBlock`): when the attached mesh is dp-pure
+   (tp=pp=sp=ep=1) the whole step is wrapped in `shard_map` over dp, so the
+   gradient sync is exactly the ops above — the compiled step carries
+   ≤ bucket-count grouped collectives instead of one all-reduce per parameter
+   (this jax 0.4.37 build emits 31 ungrouped ARs on the GSPMD path; see
+   docs/perf_notes.md "Bucketed collectives & ZeRO-1"). Any structural
+   obstacle (cross-batch ops like batch_norm, SelectedRows grads, microbatch
+   programs, indivisible batches, mixed meshes) falls back to the GSPMD path
+   untouched — bucketing degrades to identity, ZeRO-1 keeps its memory
+   sharding via GSPMD specs.
+
+Semantics under manual dp mirror the reference's GradAllReduce
+(`transpiler/collective.py:178`: scale 1/nranks + allreduce-sum): gradients
+are AVERAGED over replicas, which equals the GSPMD global-batch gradient for
+mean-reduced losses (every model in models/). Scalar fetches return the
+replica mean; batch-leading fetches concatenate shards in global batch order
+(the `_LocalSGDBlock` fetch contract). Random ops draw the SAME key on every
+replica (each applies it to its own shard) — per-replica masks differ from
+the GSPMD global-mask slicing in values, not distribution.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..framework.program import OpRole, Operator, Program
+from ..ops import registry
+from ..ops.registry import register
+
+# Padding multiple for flat ZeRO buckets: the flat state shape must not
+# depend on the mesh (the same program compiles under dp=1..N), so every
+# bucket pads its total element count to a multiple that any power-of-two
+# dp up to 64 divides.
+PAD_MULTIPLE = 64
+
+# Update op types the flat-shard ZeRO-1 update supports: exactly the
+# ELEMENTWISE rules, for which updating the flat concatenation shard-locally
+# is bit-identical to updating each parameter in full. (lamb/lars need
+# per-parameter norms — their params stay on per-param update ops and only
+# get the bucketed gradient sync.)
+_UPDATE_STATE_SLOTS: Dict[str, Dict[str, tuple]] = {
+    "sgd": {},
+    "momentum": {"velocity": ("Velocity", "VelocityOut")},
+    "adam": {"moment1": ("Moment1", "Moment1Out"),
+             "moment2": ("Moment2", "Moment2Out")},
+    "adamw": {"moment1": ("Moment1", "Moment1Out"),
+              "moment2": ("Moment2", "Moment2Out")},
+}
+# extra replicated [1]-inputs forwarded verbatim to the inner lowering
+_UPDATE_EXTRA_SLOTS = {
+    "sgd": (), "momentum": (),
+    "adam": ("Beta1Pow", "Beta2Pow"), "adamw": ("Beta1Pow", "Beta2Pow"),
+}
+
+# Ops whose semantics couple examples ACROSS the batch beyond a trailing
+# mean-reduced loss: under GSPMD they see the global batch (sync-BN by
+# construction); a manual-dp shard would silently compute LOCAL statistics,
+# so their presence disables the manual path entirely.
+_CROSS_BATCH_OPS = frozenset({"batch_norm", "data_norm", "inplace_abn"})
+
+
+# ---------------------------------------------------------------------------
+# manual-mode trace context (set by the shard_map body; read by lowerings)
+# ---------------------------------------------------------------------------
+
+_manual_dp: List[tuple] = []   # stack of (axis_name, dp_size)
+
+
+class _manual_ctx:
+    def __init__(self, axis: str, dp: int):
+        self._entry = (axis, int(dp))
+
+    def __enter__(self):
+        _manual_dp.append(self._entry)
+        return self
+
+    def __exit__(self, *exc):
+        _manual_dp.pop()
+        return False
+
+
+def current_manual_dp() -> Optional[tuple]:
+    """(axis_name, dp) while tracing inside the manual-dp shard_map body."""
+    return _manual_dp[-1] if _manual_dp else None
+
+
+# ---------------------------------------------------------------------------
+# op lowerings
+# ---------------------------------------------------------------------------
+
+def _infer_noop(block, op):
+    block.program.bump_version()
+
+
+@register("__bucket_sync__", infer=_infer_noop,
+          nondiff_slots=("X",), stateful_outputs=("Out",))
+def _lower_bucket_sync(ctx, ins, attrs):
+    """One grouped gradient sync per bucket: flatten → concat → pmean over
+    the dp axis → split back. Identity outside manual-dp mode (GSPMD/single
+    device gradients are already globally summed)."""
+    import jax
+    import jax.numpy as jnp
+
+    grads = ins["X"]
+    manual = current_manual_dp()
+    if manual is None:
+        return {"Out": list(grads)}
+    axis, dp = manual
+    dt = jnp.dtype(attrs["dtype"])
+    flat = jnp.concatenate([jnp.reshape(g, (-1,)).astype(dt) for g in grads])
+    # reference GradAllReduce semantics: allreduce-sum + 1/nranks scale
+    flat = jax.lax.psum(flat, axis) * np.asarray(1.0 / dp, dt)
+    outs, off = [], 0
+    for g, size, shape in zip(grads, attrs["sizes"], attrs["shapes"]):
+        piece = jax.lax.slice(flat, (off,), (off + size,))
+        outs.append(jnp.reshape(piece, tuple(shape)).astype(g.dtype))
+        off += size
+    return {"Out": outs}
+
+
+@register("__zero_update__", infer=_infer_noop,
+          nondiff_slots=("Param", "Grad", "LearningRate", "Beta1Pow",
+                         "Beta2Pow", "FlatState"),
+          stateful_outputs=("ParamOut", "FlatStateOut"))
+def _lower_zero_update(ctx, ins, attrs):
+    """ZeRO-1 bucket update. Manual-dp mode: reduce_scatter the bucket's
+    gradients (or slice pre-synced ones), run the registered elementwise
+    update rule on the rank-local flat shard against the flat sharded
+    optimizer state, then all_gather the updated parameters. Outside manual
+    mode the same math runs at full bucket width — with the flat state vars
+    carrying dp PartitionSpecs, GSPMD shards the state arithmetic and
+    inserts the parameter all-gather itself, so the ~dp× optimizer-state
+    memory saving survives mixed (dp×tp) meshes the manual path declines."""
+    import jax
+    import jax.numpy as jnp
+
+    op_type = attrs["update_op"]
+    sizes = list(attrs["sizes"])
+    shapes = [tuple(s) for s in attrs["shapes"]]
+    padded = int(attrs["padded"])
+    kinds = list(attrs["state_kinds"])
+    dt = jnp.dtype(attrs["dtype"])
+    params = ins["Param"]
+    grads = ins["Grad"]
+    state_vals = list(ins["FlatState"])
+    total = sum(sizes)
+
+    def flat_concat(vals):
+        flat = jnp.concatenate([jnp.reshape(v, (-1,)).astype(dt)
+                                for v in vals])
+        if padded > total:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((padded - total,), dt)])
+        return flat
+
+    flat_g = flat_concat(grads)
+    flat_p = flat_concat(params)
+
+    manual = current_manual_dp()
+    if manual is not None and padded % manual[1] == 0 and manual[1] > 1:
+        axis, dp = manual
+        shard = state_vals[0].shape[0] if state_vals else padded // dp
+        scale = np.asarray(1.0 / dp, dt)
+        idx = jax.lax.axis_index(axis)
+        if attrs.get("pre_synced"):
+            # gradients already bucket-synced (clip/regularization ops sit
+            # between sync and update): just take this rank's slice
+            g_shard = jax.lax.dynamic_slice(flat_g, (idx * shard,), (shard,))
+        else:
+            # the comm-optimal path: reduce_scatter INSTEAD of all-reduce —
+            # each rank receives only the bucket shard it will update
+            g_shard = jax.lax.psum_scatter(flat_g, axis,
+                                           scatter_dimension=0,
+                                           tiled=True) * scale
+        p_shard = jax.lax.dynamic_slice(flat_p, (idx * shard,), (shard,))
+    else:
+        # full-width update: single device, GSPMD fallback, or a dp the
+        # padding does not divide (state then stays replicated). In the
+        # last case the gradients are still LOCAL (the pass routed this
+        # bucket around __bucket_sync__) — they MUST be averaged here or
+        # the replicas silently train on divergent updates.
+        if manual is not None and not attrs.get("pre_synced"):
+            axis, dp = manual
+            flat_g = jax.lax.psum(flat_g, axis) * np.asarray(1.0 / dp, dt)
+        g_shard, p_shard = flat_g, flat_p
+
+    inner_ins = {"Param": [p_shard], "Grad": [g_shard],
+                 "LearningRate": ins["LearningRate"]}
+    for extra in _UPDATE_EXTRA_SLOTS[op_type]:
+        inner_ins[extra] = ins[extra]
+    slot_map = _UPDATE_STATE_SLOTS[op_type]
+    for kind, val in zip(kinds, state_vals):
+        inner_ins[slot_map[kind][0]] = [val]
+    res = registry.get(op_type).lower(ctx, inner_ins,
+                                      dict(attrs["update_attrs"]))
+
+    p_new = res["ParamOut"][0]
+    if p_new.shape[0] != padded:   # manual mode: reassemble the full params
+        p_new = jax.lax.all_gather(p_new, manual[0], tiled=True)
+    outs, off = [], 0
+    for size, shape, p in zip(sizes, shapes, params):
+        piece = jax.lax.slice(p_new, (off,), (off + size,))
+        outs.append(jnp.reshape(piece, shape).astype(p.dtype))
+        off += size
+    state_outs = [res[slot_map[kind][1]][0] for kind in kinds]
+    return {"ParamOut": outs, "FlatStateOut": state_outs}
+
+
+# ---------------------------------------------------------------------------
+# the program pass
+# ---------------------------------------------------------------------------
+
+def _plan_buckets(items: Sequence[tuple], bucket_bytes: int,
+                  key_fn) -> List[List[tuple]]:
+    """Greedy in-order grouping into buckets of <= bucket_bytes, split on a
+    change of key (dtype / update-op signature) — the reference
+    coalesce_grad_tensor grouping."""
+    buckets: List[List[tuple]] = []
+    cur: List[tuple] = []
+    cur_key, cur_bytes = None, 0
+    for it in items:
+        k = key_fn(it)
+        nb = it[-1]          # trailing element = nbytes
+        if cur and (k != cur_key or cur_bytes + nb > bucket_bytes):
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur_key = k
+        cur.append(it)
+        cur_bytes += nb
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def _var_nbytes(var) -> int:
+    n = 1
+    for d in var.shape:
+        n *= max(int(d), 1)
+    try:
+        item = np.dtype(var.dtype).itemsize
+    except TypeError:
+        item = 4
+    return n * item
+
+
+def _numel(var) -> int:
+    n = 1
+    for d in var.shape:
+        n *= max(int(d), 1)
+    return n
+
+
+def apply_grad_bucketing(program: Program, startup_program: Program,
+                         params_grads, bucket_bytes: int,
+                         stage: int = 0) -> Optional[dict]:
+    """Rewrite `program` in place; returns the bucket metadata (also stored
+    as `program._grad_buckets`) or None when nothing was bucketable.
+
+    stage=0: insert per-bucket `__bucket_sync__` ops only (grouped AR).
+    stage=1: additionally move each supported bucket's optimizer state into
+    flat `[padded]` vars (startup-initialized, dp-sharded via
+    `program._zero_state_specs`) and replace its per-param update ops with
+    one `__zero_update__`; unsupported update rules keep their per-param
+    ops and degrade to stage-0 sync.
+    """
+    if getattr(program, "_grad_bucketing_unsafe", False):
+        return None   # gated optimizer sections (gradient merge) opt out
+    block = program.global_block()
+    dense_pgs = []
+    for p, g in params_grads or []:
+        gv = block.find_var_recursive(g.name if hasattr(g, "name") else g)
+        pv = block.find_var_recursive(p.name if hasattr(p, "name") else p)
+        if gv is None or pv is None or \
+                getattr(gv, "_is_selected_rows", False):
+            continue
+        dense_pgs.append((pv, gv))
+    if not dense_pgs:
+        return None
+
+    raw_grads = {g.name for _, g in dense_pgs}
+    # grad -> the single per-param update op consuming it (stage 1 targets)
+    update_ops: Dict[str, Operator] = {}
+    grad_consumers: Dict[str, int] = {g: 0 for g in raw_grads}
+    for op in block.ops:
+        for n in op.input_names():
+            if n in grad_consumers:
+                grad_consumers[n] += 1
+        if op.type in _UPDATE_STATE_SLOTS \
+                and op.attrs.get("op_role", 0) == OpRole.Optimize:
+            gname = (op.inputs.get("Grad") or [None])[0]
+            pname = (op.inputs.get("Param") or [None])[0]
+            pouts = op.outputs.get("ParamOut") or [None]
+            if gname and pname and pouts[0] == pname:
+                update_ops[pname] = op
+
+    zero_meta: List[dict] = []
+    zero_removed: List[Operator] = []
+
+    if stage >= 1:
+        # group params whose update op shares (type, attrs, lr, pows, dtype)
+        def upd_key(item):
+            pv, gv = item[0], item[1]
+            op = update_ops.get(pv.name)
+            if op is None:
+                return None
+            at = tuple(sorted((k, repr(v)) for k, v in op.attrs.items()
+                              if k != "op_role"))
+            extras = tuple(tuple(op.inputs.get(s, ()))
+                           for s in _UPDATE_EXTRA_SLOTS[op.type])
+            return (op.type, at, str(pv.dtype),
+                    tuple(op.inputs.get("LearningRate", ())), extras)
+
+        items = [(pv, gv, _var_nbytes(pv)) for pv, gv in dense_pgs]
+        for group in _plan_buckets(items, bucket_bytes, upd_key):
+            if upd_key(group[0]) is None:
+                continue   # unsupported rule: stage-0 sync only (below)
+            zero_meta.append(_build_zero_bucket(
+                program, startup_program, block,
+                [(pv, gv) for pv, gv, _ in group],
+                update_ops, len(zero_meta), grad_consumers, zero_removed))
+
+    # stage-1 RS-mode buckets consume UNSYNCED grads (their __zero_update__
+    # reduce-scatters them itself); every other dense grad gets a grouped
+    # sync op at the backward->optimize boundary
+    sync_meta: List[dict] = []
+    rs_grads = {g for b in zero_meta if not b["pre_synced"]
+                for g in b["grads"]}
+    synced_grads = [(pv, gv) for pv, gv in dense_pgs
+                    if gv.name not in rs_grads]
+    if synced_grads:
+        items = [(pv, gv, _var_nbytes(gv)) for pv, gv in synced_grads]
+        for group in _plan_buckets(items, bucket_bytes,
+                                   lambda it: str(it[1].dtype)):
+            gvars = [gv for _, gv, _ in group]
+            sync_meta.append({
+                "grads": [g.name for g in gvars],
+                "sizes": [_numel(g) for g in gvars],
+                "shapes": [list(g.shape) for g in gvars],
+                "dtype": str(np.dtype(gvars[0].dtype)),
+            })
+        # insert all sync ops right after the last op writing any of the
+        # bucketed grads (the backward->optimize boundary); position only
+        # fixes dataflow order — XLA schedules the collectives itself
+        sync_names = {g for m in sync_meta for g in m["grads"]}
+        last_w = max((i for i, op in enumerate(block.ops)
+                      if sync_names & set(op.output_names())), default=None)
+        if last_w is None:
+            return None
+        at = last_w + 1
+        for m in sync_meta:
+            block._insert_op(
+                at, "__bucket_sync__",
+                inputs={"X": list(m["grads"])},
+                outputs={"Out": list(m["grads"])},
+                attrs={"sizes": m["sizes"], "shapes": m["shapes"],
+                       "dtype": m["dtype"], "op_role": OpRole.Optimize})
+            at += 1
+
+    meta = {"stage": int(stage), "bucket_bytes": int(bucket_bytes),
+            "sync_buckets": sync_meta, "zero_buckets": zero_meta}
+    program._grad_buckets = meta
+    program._zero_buckets = zero_meta
+    program._zero_state_specs = {
+        n: "dp" for b in zero_meta for n in b["flat"].values()}
+    program.bump_version()
+    return meta
+
+
+def _build_zero_bucket(program, startup_program, block, group, update_ops,
+                       idx, grad_consumers, removed_acc) -> dict:
+    """Replace `group`'s per-param update ops with one __zero_update__ over
+    flat bucket state; returns the bucket's metadata record."""
+    from ..framework import unique_name
+
+    ops = [update_ops[pv.name] for pv, _ in group]
+    op0 = ops[0]
+    params = [pv for pv, _ in group]
+    upd_grads = [op.inputs["Grad"][0] for op in ops]
+    sizes = [_numel(pv) for pv in params]
+    total = sum(sizes)
+    padded = int(math.ceil(total / PAD_MULTIPLE) * PAD_MULTIPLE)
+    dtype = str(np.dtype(params[0].dtype))
+    kinds = sorted(_UPDATE_STATE_SLOTS[op0.type])
+
+    # the update ops consume the raw grads directly (and nothing else reads
+    # them): reduce_scatter replaces the all-reduce entirely. Any
+    # intervening clip/regularization op keeps the bucket in pre-synced
+    # slice mode instead.
+    raw_direct = all(
+        g == pv.grad_name() and grad_consumers.get(g, 0) == 1
+        for (pv, _), g in zip(group, upd_grads))
+
+    per_param_state = {}
+    flat = {}
+    startup_block = startup_program.global_block() \
+        if startup_program is not None else None
+    for kind in kinds:
+        in_slot = _UPDATE_STATE_SLOTS[op0.type][kind][0]
+        per_param = {pv.name: op.inputs[in_slot][0]
+                     for (pv, _), op in zip(group, ops)}
+        fname = unique_name.generate(f"zero1_b{idx}_{kind}")
+        fv = block.create_var(name=fname, shape=(padded,), dtype=dtype,
+                              persistable=True, stop_gradient=True)
+        fv.persistable = True
+        flat[kind] = fname
+        for pn, mn in per_param.items():
+            per_param_state.setdefault(pn, {})[kind] = mn
+        # drop the per-param accumulators: main-program vars and their
+        # startup init ops (a full replica of them is exactly the memory
+        # ZeRO-1 exists to not allocate)
+        for mn in per_param.values():
+            block.vars.pop(mn, None)
+        if startup_block is not None:
+            doomed = set(per_param.values())
+            startup_block.ops = [
+                op for op in startup_block.ops
+                if not (set(op.output_names()) & doomed)]
+            for mn in doomed:
+                startup_block.vars.pop(mn, None)
+            startup_block.create_var(name=fname, shape=(padded,),
+                                     dtype=dtype, persistable=True,
+                                     stop_gradient=True)
+            startup_block.append_op(
+                "fill_constant", inputs={},
+                outputs={"Out": [fname]},
+                attrs={"shape": [padded], "dtype": dtype, "value": 0.0})
+
+    extra_inputs = {s: list(op0.inputs.get(s, ()))
+                    for s in _UPDATE_EXTRA_SLOTS[op0.type]}
+    update_attrs = {k: v for k, v in op0.attrs.items() if k != "op_role"}
+
+    pos = min(block.ops.index(op) for op in ops)
+    for op in ops:
+        block.ops.remove(op)
+    removed_acc.extend(ops)
+    inputs = {"Param": [pv.name for pv in params],
+              "Grad": list(upd_grads),
+              "LearningRate": list(op0.inputs.get("LearningRate", ())),
+              "FlatState": [flat[k] for k in kinds]}
+    inputs.update(extra_inputs)
+    block.ops.insert(pos, Operator(
+        block, "__zero_update__", inputs,
+        {"ParamOut": [pv.name for pv in params],
+         "FlatStateOut": [flat[k] for k in kinds]},
+        {"update_op": op0.type, "update_attrs": update_attrs,
+         "sizes": sizes, "shapes": [list(pv.shape) for pv in params],
+         "padded": padded, "dtype": dtype, "state_kinds": kinds,
+         "pre_synced": not raw_direct, "op_role": OpRole.Optimize}))
+
+    return {"op_type": op0.type, "params": [pv.name for pv in params],
+            "grads": list(upd_grads), "sizes": sizes,
+            "shapes": [list(pv.shape) for pv in params],
+            "padded": padded, "dtype": dtype, "flat": flat,
+            "per_param_state": per_param_state,
+            "pre_synced": not raw_direct}
+
+
+# ---------------------------------------------------------------------------
+# checkpoint round-trip (unsharded <-> flat-bucket state)
+# ---------------------------------------------------------------------------
+
+def adopt_unsharded_state(program, scope) -> None:
+    """Scope round-trip for ZeRO programs (the `_ensure_shared_beta_pows`
+    adoption pattern): when every per-param accumulator of a bucket×kind is
+    present in the scope — an UNSHARDED checkpoint was just loaded — pack
+    them into the flat bucket var the program reads and drop the per-param
+    copies. Loaded values win over a previously flat value; partial sets are
+    ambiguous and adopt nothing. Only the program's own RECORDED per-param
+    names are ever touched (a closed list, like the beta-pow adoption)."""
+    buckets = getattr(program, "_zero_buckets", None)
+    if not buckets:
+        return
+    import jax.numpy as jnp
+    gb = program.global_block()
+    for b in buckets:
+        for kind, fname in b["flat"].items():
+            legacy = [b["per_param_state"][p][kind] for p in b["params"]]
+            if any(gb.has_var(n) for n in legacy):
+                continue
+            if not all(scope.has(n) for n in legacy):
+                continue
+            pieces = []
+            ok = True
+            for n, size, shape in zip(legacy, b["sizes"], b["shapes"]):
+                v = np.asarray(scope.find(n))
+                if tuple(v.shape) != tuple(shape):
+                    ok = False
+                    break
+                pieces.append(v.reshape(-1))
+            if not ok:
+                continue
+            flat = np.concatenate(pieces)
+            if b["padded"] > flat.shape[0]:
+                flat = np.concatenate(
+                    [flat, np.zeros(b["padded"] - flat.shape[0],
+                                    flat.dtype)])
+            scope.set(fname, jnp.asarray(flat, np.dtype(b["dtype"])))
+            for n in legacy:
+                scope.erase(n)
+
+
+def unbucket_state_for_save(program, arrays: dict) -> dict:
+    """Checkpoint PORTABILITY (io.save_persistables hook): replace each flat
+    bucket entry with its per-param views, so checkpoints written under
+    ZeRO-1 are plain unsharded checkpoints — loadable by a replicated
+    program directly and by a ZeRO program via `adopt_unsharded_state`."""
+    buckets = getattr(program, "_zero_buckets", None)
+    if not buckets:
+        return arrays
+    out = dict(arrays)
+    for b in buckets:
+        for kind, fname in b["flat"].items():
+            flat = out.pop(fname, None)
+            if flat is None:
+                continue
+            flat = np.asarray(flat).reshape(-1)
+            off = 0
+            for p, size, shape in zip(b["params"], b["sizes"], b["shapes"]):
+                name = b["per_param_state"][p][kind]
+                out[name] = flat[off:off + size].reshape(tuple(shape))
+                off += size
+    return out
+
+
+def optimizer_state_bytes(program, dp: int = 1) -> dict:
+    """Structural per-device optimizer-state accounting (bench extras + the
+    tier-1 memory test): flat ZeRO bucket bytes divide by dp when the
+    padding does, replicated per-param accumulators count at full width on
+    every device; everything derived from program metadata, no timing."""
+    buckets = getattr(program, "_zero_buckets", None) or []
+    flat_total = 0
+    for b in buckets:
+        flat_total += b["padded"] * np.dtype(b["dtype"]).itemsize \
+            * len(b["flat"])
+    # per-param accumulators still on per-param update ops (replicated
+    # programs entirely; under ZeRO-1 the unsupported-rule leftovers)
+    block = program.global_block()
+    repl_total = 0
+    seen = set()
+    for op in block.ops:
+        if op.type not in _UPDATE_STATE_SLOTS \
+                or op.attrs.get("op_role", 0) != OpRole.Optimize:
+            continue
+        for kind, (in_slot, _out) in _UPDATE_STATE_SLOTS[op.type].items():
+            for n in op.inputs.get(in_slot, ()):
+                if n in seen:
+                    continue
+                seen.add(n)
+                v = block.find_var_recursive(n)
+                if v is not None:
+                    repl_total += _var_nbytes(v)
+    sharded = all(b["padded"] % max(dp, 1) == 0 for b in buckets)
+    flat_per_dev = flat_total // dp if (dp > 1 and sharded) else flat_total
+    return {"flat_state_bytes_total": int(flat_total),
+            "flat_state_bytes_per_device": int(flat_per_dev),
+            "replicated_state_bytes": int(repl_total),
+            "state_bytes_per_device": int(flat_per_dev + repl_total),
+            "dp": int(dp), "zero_stage": 1 if buckets else 0}
+
+
+# ---------------------------------------------------------------------------
+# the manual-dp execution plan (hooked from executor._CompiledBlock)
+# ---------------------------------------------------------------------------
+
+class ManualDpPlan:
+    __slots__ = ("axis", "dp", "mesh", "feed_specs", "state_specs",
+                 "fetch_gathers", "written_specs", "local_batch")
+
+    def __init__(self, axis, dp, mesh, feed_specs, state_specs,
+                 fetch_gathers, written_specs, local_batch):
+        self.axis = axis
+        self.dp = dp
+        self.mesh = mesh
+        self.feed_specs = feed_specs
+        self.state_specs = state_specs
+        self.fetch_gathers = fetch_gathers
+        self.written_specs = written_specs
+        self.local_batch = local_batch
+
+
+def plan_manual_dp(program, dist, mesh, block, fn, feed_meta, state_meta,
+                   fetch_names, written_state, multi_k) -> \
+        Optional[ManualDpPlan]:
+    """Decide whether this (program, mesh, signature) runs the manual-dp
+    bucketed step; returns the spec/gather plan or None for GSPMD.
+
+    feed_meta / state_meta: {name: (shape, dtype)} of the GLOBAL arrays.
+    `fn` is the runner partial (mut, ro, feeds, rng) -> (fetches, new_state);
+    fetch shapes come from one eval_shape with LOCAL feed shapes.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    if getattr(program, "_grad_buckets", None) is None or dist is None:
+        return None
+    dp = int(mesh.shape.get("dp", 1))
+    if dp <= 1:
+        return None
+    for ax in ("tp", "pp", "sp", "ep"):
+        if int(mesh.shape.get(ax, 1)) > 1:
+            return None          # mixed meshes stay on GSPMD
+    if getattr(program, "_microbatch_k", 0) and program._microbatch_k > 1:
+        return None
+    for b in program.blocks:
+        for op in b.ops:
+            if op.type in _CROSS_BATCH_OPS:
+                return None
+        for v in b.vars.values():
+            if getattr(v, "_is_selected_rows", False):
+                return None
+
+    # feed specs: the dist config's own batch-axis decision, converted to
+    # manual in_specs; at least one feed must actually shard over dp
+    feed_specs = {}
+    local_batch = None
+    for name, (shape, _dt) in feed_meta.items():
+        per_step = tuple(shape[1:]) if multi_k else tuple(shape)
+        ns = dist.feed_sharding(mesh, name, per_step)
+        spec = tuple(ns.spec)
+        sharded = bool(spec) and spec[0] is not None
+        if sharded:
+            local_batch = per_step[0] // dp
+        per_spec = P(*spec) if spec else P()
+        feed_specs[name] = P(None, *per_spec) if multi_k else per_spec
+    if local_batch is None:
+        return None              # nothing sharded: manual buys nothing
+
+    flat_state = set(getattr(program, "_zero_state_specs", {}) or ())
+    zero_divides = all(
+        (b["padded"] % dp) == 0
+        for b in getattr(program, "_zero_buckets", None) or [])
+
+    def state_spec(name):
+        if name in flat_state and zero_divides:
+            return P("dp")
+        return P()
+
+    state_specs = {n: state_spec(n) for n in state_meta}
+    written_specs = {n: state_spec(n) for n in written_state}
+
+    # fetch avals: LOCAL feeds + FULL state (fetch batch-ness only depends
+    # on the feeds; tracing here runs outside the manual context, where the
+    # bucket ops are width-preserving)
+    def _local_feed_aval(name):
+        shape, dt = feed_meta[name]
+        spec = feed_specs[name]
+        shape = list(shape)
+        bdim = 1 if multi_k else 0
+        eff = tuple(spec)[bdim] if len(tuple(spec)) > bdim else None
+        if eff is not None:
+            shape[bdim] = shape[bdim] // dp
+        return jax.ShapeDtypeStruct(tuple(shape), dt)
+
+    # the mut/ro split does not change shapes: evaluate with all state mut
+    mut_av = {n: jax.ShapeDtypeStruct(tuple(shape), dt)
+              for n, (shape, dt) in state_meta.items()}
+    feeds_av = {n: _local_feed_aval(n) for n in feed_meta}
+    key_av = jax.eval_shape(lambda: jax.random.key(0))
+    fetch_av, _ = jax.eval_shape(
+        lambda mut, feeds, key: fn(mut, {}, feeds, key),
+        mut_av, feeds_av, key_av)
+
+    fetch_gathers = []
+    for name, av in zip(fetch_names, fetch_av):
+        shape = tuple(av.shape)
+        eff = shape[1:] if multi_k else shape
+        floating = np.issubdtype(np.dtype(av.dtype), np.floating)
+        v = block.find_var_recursive(name)
+        persistable = v is not None and v.persistable
+        if len(eff) == 0:
+            fetch_gathers.append(("pmean" if floating else "replicate",
+                                  P()))
+        elif eff[0] == local_batch and not persistable:
+            # batch-leading activation: concat shards in global batch order
+            spec = P(None, "dp") if multi_k else P("dp")
+            fetch_gathers.append(("concat", spec))
+        else:
+            # params/state and non-batch tensors are replicated across
+            # ranks by construction (pmean'd grads -> identical updates)
+            fetch_gathers.append(("replicate", P()))
+    return ManualDpPlan("dp", dp, mesh, feed_specs, state_specs,
+                        fetch_gathers, written_specs, local_batch)
+
+
+def build_manual_jit(plan: ManualDpPlan, fn, mut_names, ro_names,
+                     donate: bool = True):
+    """shard_map-wrap the runner per the plan and jit it with matching
+    shardings. The returned callable has the _CompiledBlock.jitted signature
+    (mut, ro, feeds, rng) -> (fetches, new_state)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..utils.jax_compat import shard_map
+
+    axis, dp, mesh = plan.axis, plan.dp, plan.mesh
+
+    def body(mut, ro, feeds, rng):
+        with _manual_ctx(axis, dp):
+            fetches, new_state = fn(mut, ro, feeds, rng)
+        out = []
+        for f, (gather, _spec) in zip(fetches, plan.fetch_gathers):
+            if gather == "pmean":
+                f = jax.lax.pmean(f, axis)
+            out.append(f)
+        return out, new_state
+
+    # out_specs mirror the output tree: fetch list + the written-state dict
+    # (the donation floor may route small written buffers through ro — the
+    # specs are keyed by NAME, so both splits resolve the same)
+    in_specs = ({n: plan.state_specs[n] for n in mut_names},
+                {n: plan.state_specs[n] for n in ro_names},
+                dict(plan.feed_specs), P())
+    out_specs = ([spec for _g, spec in plan.fetch_gathers],
+                 dict(plan.written_specs))
+    sm = shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+    def ns(spec):
+        return NamedSharding(mesh, spec)
+
+    jit_kw = {
+        "in_shardings": ({n: ns(plan.state_specs[n]) for n in mut_names},
+                         {n: ns(plan.state_specs[n]) for n in ro_names},
+                         {n: ns(s) for n, s in plan.feed_specs.items()},
+                         ns(P())),
+        "out_shardings": ([ns(s) for _g, s in plan.fetch_gathers],
+                          {n: ns(s)
+                           for n, s in plan.written_specs.items()}),
+    }
+    return jax.jit(sm, donate_argnums=(0,) if donate else (), **jit_kw)

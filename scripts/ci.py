@@ -35,7 +35,8 @@ WEIGHTS = {
     "test_distributed.py": 45, "test_ps_kvstore.py": 45,
     "test_dense_tail_ops.py": 40, "test_flash_attention.py": 40,
     "test_detection_assign_ops.py": 40, "test_elastic.py": 40,
-    "test_strategies.py": 35, "test_lod_ops.py": 30, "test_heter_ps.py": 30,
+    "test_strategies.py": 35, "test_collective_budget.py": 90,
+    "test_lod_ops.py": 30, "test_heter_ps.py": 30,
     "test_federated.py": 25, "test_tail_ops.py": 35, "test_dy2static.py": 25,
     "test_jit_inference.py": 30, "test_executor_basic.py": 30,
     "test_crf_ner_book.py": 25, "test_quantization.py": 20,
@@ -123,6 +124,38 @@ def host_stall_check(env) -> bool:
     return collect_host_stall(start_host_stall(env))
 
 
+# Collective budget check (ISSUE-5 CI satellite): the per-mesh census of
+# scripts/collective_audit.py --assert — the dp rows must carry the
+# GROUPED bucket collectives (<= 4 per step, parallel/zero.py), not one
+# all-reduce per parameter; ZeRO-1's reduce_scatter/all_gather shape and
+# the tp/sp rows are budgeted too. Started alongside the shards so its
+# ~2-3 min of compiles overlap instead of extending the critical path.
+def start_collective_audit(env):
+    script = os.path.join(ROOT, "scripts", "collective_audit.py")
+    child_env = dict(env)
+    child_env["PADDLE_TPU_AUDIT_CHILD"] = "1"  # env already is the CPU mesh
+    return subprocess.Popen([sys.executable, script, "--assert"],
+                            cwd=ROOT, env=child_env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def collect_collective_audit(proc, timeout=1500) -> bool:
+    try:
+        out_s, err_s = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        print(f"[collective-budget] FAIL timed out after {timeout}s")
+        return False
+    lines = (out_s or "").strip().splitlines()
+    status = "OK " if proc.returncode == 0 else "FAIL"
+    body = "\n".join("    " + ln for ln in lines)
+    tail = (err_s or "").strip().splitlines()[-5:]
+    print(f"[collective-budget] {status}\n{body}" + (
+        "\n" + "\n".join(tail) if proc.returncode != 0 else ""))
+    return proc.returncode == 0
+
+
 def shard(files, n):
     """LPT bin packing by weight."""
     bins = [(0.0, []) for _ in range(n)]
@@ -141,6 +174,9 @@ def main():
                                                        or 1)))
     ap.add_argument("--no-host-stall", action="store_true",
                     help="skip the host-stall budget check")
+    ap.add_argument("--no-collective-audit", action="store_true",
+                    help="skip the collective budget check "
+                         "(scripts/collective_audit.py --assert)")
     ap.add_argument("rest", nargs="*", help="extra pytest args")
     args = ap.parse_args()
 
@@ -152,6 +188,9 @@ def main():
     stall_proc = None
     if not args.no_host_stall:
         stall_proc = start_host_stall(env)   # overlaps the shards below
+    audit_proc = None
+    if not args.no_collective_audit:
+        audit_proc = start_collective_audit(env)   # overlaps the shards too
 
     files = sorted(glob.glob(os.path.join(ROOT, "tests", "test_*.py")))
     shards = shard(files, args.n)
@@ -195,6 +234,8 @@ def main():
     print(f"CI aggregate: {agg}")
     if stall_proc is not None:
         failed = failed or not collect_host_stall(stall_proc)
+    if audit_proc is not None:
+        failed = failed or not collect_collective_audit(audit_proc)
     print(f"CI total: {time.time() - t0:.0f}s over {len(shards)} shards -> "
           f"{'FAILED' if failed else 'PASSED'}")
     return 1 if failed else 0

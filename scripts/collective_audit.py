@@ -23,9 +23,16 @@ What to expect (and what round-5 runs showed — docs/perf_notes.md
   (hops x layers x fwd/bwd), with almost nothing else: sequence
   parallelism rides ICI neighbor links, not global collectives.
 
+The `--assert` mode turns the census into a machine-checkable budget
+(BUDGETS below: per-mesh kind -> max count, max MB — CLOSED lists, an
+unbudgeted collective kind appearing is a failure too) and exits
+non-zero on any regression; scripts/ci.py runs it next to the
+host-stall check, so an ungrouping regression (back to one all-reduce
+per parameter) can never land silently.
+
 Usage: run under a virtual mesh (or a real one):
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-      python scripts/collective_audit.py
+      python scripts/collective_audit.py [--assert]
 """
 from __future__ import annotations
 
@@ -41,7 +48,7 @@ DT_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "f64": 8,
             "pred": 1, "s8": 1, "u8": 1, "s64": 8, "u64": 8}
 
 
-def compiled_text(axes, batch, sp_flag=False):
+def compiled_text(axes, batch, sp_flag=False, sharding=False):
     """Build + attach + compile the tiny-BERT train step; return HLO
     (via the public Executor.compiled_hlo — no executor internals)."""
     import numpy as np
@@ -63,6 +70,7 @@ def compiled_text(axes, batch, sp_flag=False):
     strategy = fleet.DistributedStrategy(
         tensor_parallel_degree=axes.get("tp", 1),
         tensor_parallel_rules=bert.tp_sharding_rules())
+    strategy.sharding = sharding                       # ZeRO-1 arm
     opt = fleet.distributed_optimizer(
         paddle.optimizer.Adam(learning_rate=1e-3), strategy)
     opt.minimize(loss)
@@ -72,8 +80,10 @@ def compiled_text(axes, batch, sp_flag=False):
         ndev *= v
     if ndev > 1:
         mesh = build_mesh(devices=jax.devices()[:ndev], **axes)
-        attach(prog, DistConfig(mesh=mesh,
-                                param_rules=bert.tp_sharding_rules()))
+        attach(prog, DistConfig(
+            mesh=mesh, param_rules=bert.tp_sharding_rules(),
+            state_specs=dict(getattr(prog, "_zero_state_specs", None)
+                             or {})))
     exe = fluid.Executor()
     exe.run(fluid.default_startup_program())
     feed = {"input_ids": np.zeros((batch, 32), np.int64),
@@ -105,7 +115,54 @@ def audit(txt):
     return counts, byte_tot
 
 
-def main():
+# --assert budgets: per-row kind -> (max count, max MB). CLOSED lists — a
+# kind not in a row's budget must not appear at all. Numbers are the
+# measured post-bucketing census (parallel/zero.py; docs/perf_notes.md
+# "Bucketed collectives & ZeRO-1") with headroom for XLA scheduling noise,
+# never enough to readmit the 31-ungrouped-AR state the bucketing pass
+# removed (count budget 4 << 31). The "dp=1" row compiles on fleet.init's
+# full default mesh (dp=8), so it carries the same budget as the dp rows.
+BUDGETS = {
+    "dp=1":        {"all-reduce": (4, 0.60)},
+    "dp=2":        {"all-reduce": (4, 0.60)},
+    "dp=4":        {"all-reduce": (4, 0.60)},
+    "dp=8":        {"all-reduce": (4, 0.60)},
+    # ZeRO-1: per-bucket reduce_scatter (half the AR bytes at dp=2) +
+    # parameter all_gather replace the gradient all-reduce entirely
+    "dp=2 zero1":  {"reduce-scatter": (2, 0.35), "all-gather": (2, 0.60),
+                    "all-reduce": (2, 0.10)},
+    # mixed/tp/sp meshes stay on the GSPMD lowering (measured round 6-8)
+    "tp=2":        {"all-reduce": (40, 1.0), "all-gather": (55, 2.2),
+                    "collective-permute": (16, 0.6)},
+    "dp=2 tp=2":   {"all-reduce": (75, 1.0), "all-gather": (55, 2.0),
+                    "collective-permute": (20, 0.5),
+                    "all-to-all": (12, 0.5)},
+    "sp=4":        {"all-reduce": (12, 0.2), "all-gather": (8, 0.7),
+                    "collective-permute": (45, 0.8)},
+}
+
+
+def check_budget(label, counts, byts):
+    """List of violation strings (empty = within budget)."""
+    budget = BUDGETS.get(label)
+    if budget is None:
+        return []
+    bad = []
+    for kind, n in counts.items():
+        if kind not in budget:
+            bad.append(f"unbudgeted {kind} x{n}")
+            continue
+        max_n, max_mb = budget[kind]
+        if n > max_n:
+            bad.append(f"{kind} count {n} > {max_n}")
+        if byts[kind] > max_mb * 1e6:
+            bad.append(f"{kind} {byts[kind] / 1e6:.2f} MB > {max_mb} MB")
+    return bad
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    assert_mode = "--assert" in argv
     # On hosts where the TPU plugin pins the backend at interpreter start
     # (env vars are read too late), re-exec once into a sanitized
     # subprocess with the 8-device virtual CPU mesh — same recipe as
@@ -117,17 +174,19 @@ def main():
             env = cpu_mesh_env(8)
             env["PADDLE_TPU_AUDIT_CHILD"] = "1"
             proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)], cwd=ROOT,
-                env=env, timeout=1800)
+                [sys.executable, os.path.abspath(__file__), *argv],
+                cwd=ROOT, env=env, timeout=1800)
             sys.exit(proc.returncode)
 
     import jax
     nd = jax.device_count()
-    rows = [({"dp": 1}, 8, False), ({"dp": 2}, 16, False),
-            ({"dp": 4}, 32, False), ({"dp": 8}, 64, False),
-            ({"tp": 2}, 8, False), ({"dp": 2, "tp": 2}, 8, False),
-            ({"sp": 4}, 8, True)]
-    for axes, batch, spf in rows:
+    rows = [({"dp": 1}, 8, {}), ({"dp": 2}, 16, {}),
+            ({"dp": 2}, 16, {"sharding": True}),
+            ({"dp": 4}, 32, {}), ({"dp": 8}, 64, {}),
+            ({"tp": 2}, 8, {}), ({"dp": 2, "tp": 2}, 8, {}),
+            ({"sp": 4}, 8, {"sp_flag": True})]
+    failures = 0
+    for axes, batch, kw in rows:
         needed = 1
         for v in axes.values():
             needed *= v
@@ -135,16 +194,35 @@ def main():
             print(f"{axes}: skipped (need {needed} devices, have {nd})")
             continue
         desc = " ".join(f"{k}={v}" for k, v in axes.items())
+        if kw.get("sharding"):
+            desc += " zero1"
         try:
-            counts, byts = audit(compiled_text(axes, batch, spf))
+            counts, byts = audit(compiled_text(
+                axes, batch, sp_flag=kw.get("sp_flag", False),
+                sharding=kw.get("sharding", False)))
         except Exception as e:   # one broken config must not kill the audit
             print(f"{desc:12s} batch {batch:3d}: FAILED ({e!r:.120})")
+            if assert_mode and desc in BUDGETS:
+                failures += 1
             continue
         summary = ", ".join(
             f"{k} x{counts[k]} ({byts[k] / 1e6:.2f} MB)"
             for k in sorted(counts)) or "none"
-        print(f"{desc:12s} batch {batch:3d}: {summary}")
+        verdict = ""
+        if assert_mode:
+            bad = check_budget(desc, counts, byts)
+            if bad:
+                failures += 1
+                verdict = "  BUDGET FAIL: " + "; ".join(bad)
+            elif desc in BUDGETS:
+                verdict = "  budget OK"
+        print(f"{desc:12s} batch {batch:3d}: {summary}{verdict}")
+    if assert_mode:
+        print(f"collective budget: {'FAILED' if failures else 'PASSED'} "
+              f"({failures} row(s) over budget)")
+        return 1 if failures else 0
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
