@@ -41,6 +41,18 @@ class _WedgedTunnel(RuntimeError):
     comparison point, and the ONE JSON line still prints."""
 
 
+# the probe the init ladder runs in a killable subprocess; module-level so
+# the deadline unit test (tests/test_bench_gate.py) can substitute a hang
+_PROBE_CODE = ("import jax; d=jax.devices(); "
+               "print(d[0].platform, len(d))")
+
+# per-attempt cleanup reserve: SIGTERM grace (10 s) + kill + bookkeeping.
+# Every wait in the ladder is clamped so that attempt + cleanup still fits
+# inside the remaining deadline — the WHOLE ladder (probes + terminate
+# grace + backoff sleeps + in-process dial) is <= BENCH_INIT_DEADLINE.
+_LADDER_GRACE = 20.0
+
+
 def _backend_ready(attempts=5, probe_timeout=150.0, final_timeout=420.0,
                    delays=(15.0, 60.0, 300.0, 600.0), deadline_s=None):
     """Force backend init, surviving BOTH failure modes seen in rounds 2-3:
@@ -53,57 +65,62 @@ def _backend_ready(attempts=5, probe_timeout=150.0, final_timeout=420.0,
       jax.devices() never returns, so probe in a KILLABLE subprocess with
       a hard timeout before dialing in-process.
 
-    The WHOLE retry ladder runs under a hard deadline
-    (BENCH_INIT_DEADLINE, default 900 s): in round 5 a wedged claim ate
-    all five probe attempts PLUS their backoff sleeps (~27 min) and the
-    driver killed the run at rc=124 with no JSON line (BENCH_r05.json).
-    Exhausting the deadline returns a _WedgedTunnel error the caller
-    records as a tunnel_degraded row instead.
+    The WHOLE retry ladder — all probe attempts, their SIGTERM grace
+    windows, the backoff sleeps AND the in-process dial — is hard-bounded
+    by BENCH_INIT_DEADLINE (default 600 s). Round 5 showed why the bound
+    must cover everything: the deadline nominally existed but each wait
+    was clamped only against the *remaining* time without reserving the
+    next attempt's terminate grace, so four hung 150 s probes plus
+    15+60+300 s of backoff overshot the driver's window and the run died
+    at rc=124 with `parsed: null` (BENCH_r05.json) — no attempt budget
+    was left to even return. Now every wait reserves _LADDER_GRACE for
+    its own cleanup, so exhausting the deadline RETURNS a _WedgedTunnel
+    which main() records as a tunnel_degraded JSON row (probes and bench
+    rows are skipped) instead of dying driver-side.
     """
     import subprocess
     if deadline_s is None:
         try:
-            deadline_s = float(os.environ.get("BENCH_INIT_DEADLINE", "900"))
+            deadline_s = float(os.environ.get("BENCH_INIT_DEADLINE", "600"))
         except ValueError:
-            deadline_s = 900.0
+            deadline_s = 600.0
     t_start = time.monotonic()
 
     def _remaining():
         return deadline_s - (time.monotonic() - t_start)
 
     def _sleep_backoff(i):
-        # ONE clamp policy for every failure branch: never sleep past
-        # the deadline minus a 30 s headroom for the final probe
+        # ONE clamp policy for every failure branch: never sleep into the
+        # slice the NEXT attempt (+ its cleanup grace) needs to exist
         time.sleep(min(delays[min(i, len(delays) - 1)],
-                       max(_remaining() - 30.0, 0.0)))
+                       max(_remaining() - 2 * _LADDER_GRACE, 0.0)))
 
     last = None
     for i in range(attempts):
-        if _remaining() <= 10.0:
+        if _remaining() <= _LADDER_GRACE + 5.0:
             return _WedgedTunnel(
                 f"backend init deadline {deadline_s:.0f}s exhausted after "
                 f"{i} attempt(s); last: {last!r}")
         # late attempts: the pool needs 5-10 min of quiet to reclaim a
         # killed holder's grant (round-3 judging showed 90s is far too
         # short), and the final probe deserves a judge-style long wait —
-        # all clamped to what the deadline still allows
+        # all clamped so the wait PLUS its terminate grace fits the
+        # deadline
         timeout_i = probe_timeout if i + 1 < attempts else final_timeout
-        timeout_i = min(timeout_i, max(_remaining(), 10.0))
+        timeout_i = max(min(timeout_i, _remaining() - _LADDER_GRACE), 5.0)
         try:
             # Popen + SIGTERM-first: subprocess.run would SIGKILL on
             # timeout, and a probe killed mid-claim while holding the one
             # axon grant manufactures the very wedge being probed for
             proc = subprocess.Popen(
-                [sys.executable, "-c",
-                 "import jax; d=jax.devices(); "
-                 "print(d[0].platform, len(d))"],
+                [sys.executable, "-c", _PROBE_CODE],
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
             try:
                 out_s, err_s = proc.communicate(timeout=timeout_i)
             except subprocess.TimeoutExpired:
                 proc.terminate()          # let it release the tunnel grant
                 try:
-                    proc.communicate(timeout=15)
+                    proc.communicate(timeout=10)
                 except subprocess.TimeoutExpired:
                     proc.kill()
                     proc.communicate()
@@ -141,7 +158,7 @@ def _backend_ready(attempts=5, probe_timeout=150.0, final_timeout=420.0,
         try:
             import jax
             _, hung = _with_deadline(
-                jax.devices, max(min(timeout_i, _remaining()), 10.0),
+                jax.devices, max(min(timeout_i, _remaining() - 10.0), 5.0),
                 "in-process backend dial")
             if hung:
                 raise _WedgedTunnel(
@@ -173,12 +190,19 @@ def _layer_scan_enabled():
     return os.environ.get("PADDLE_TPU_LAYER_SCAN", "0") == "1"
 
 
+def _zero_stage():
+    """PADDLE_TPU_ZERO=1|2|3: the ZeRO A/B arm — 1 shards optimizer state,
+    2 keeps gradient shards resident, 3 shards parameter storage with
+    on-demand gathers (parallel/zero.py; main() sets FLAGS_zero_stage so
+    every fleet build in the process picks it up). 0 = replicated arm."""
+    try:
+        return max(0, min(3, int(os.environ.get("PADDLE_TPU_ZERO", "0"))))
+    except ValueError:
+        return 0
+
+
 def _zero_enabled():
-    """PADDLE_TPU_ZERO=1: the ZeRO-1 A/B arm — flat dp-sharded optimizer
-    state + reduce_scatter/all_gather bucket collectives
-    (parallel/zero.py; main() also sets FLAGS_zero_stage so every fleet
-    build in the process picks it up)."""
-    return os.environ.get("PADDLE_TPU_ZERO", "0") == "1"
+    return _zero_stage() > 0
 
 
 # structural optimizer-state accounting of the LAST bench_bert build
@@ -317,9 +341,9 @@ def bench_bert(batch, seq_len, steps, masked=False, large=False,
     # into ONE lax.scan over [L]-stacked weights (~L x smaller step HLO,
     # ~L x faster trace+compile) — the A/B toggle for the primary metric
     strategy.layer_scan = _layer_scan_enabled()
-    # PADDLE_TPU_ZERO=1: ZeRO-1 flat dp-sharded optimizer state (the A/B
-    # arm; the record stamps zero_stage so numbers never read as drift)
-    strategy.sharding = _zero_enabled()
+    # PADDLE_TPU_ZERO=1|2|3: the ZeRO sharding arm (the record stamps
+    # zero_stage so numbers never read as drift)
+    strategy.sharding_stage = _zero_stage()
     if recompute:
         strategy.recompute = True
         strategy.recompute_configs = {
@@ -787,10 +811,10 @@ def main():
         from paddle_tpu.flags import set_flags
         set_flags({"FLAGS_async_dispatch": True})
     if _zero_enabled():
-        # ZeRO-1 arm: every fleet build in this process shards optimizer
-        # state into flat dp buckets (parallel/zero.py); stamped zero_stage
+        # ZeRO arm: every fleet build in this process shards per the
+        # requested stage (parallel/zero.py); stamped zero_stage
         from paddle_tpu.flags import set_flags
-        set_flags({"FLAGS_zero_stage": 1})
+        set_flags({"FLAGS_zero_stage": _zero_stage()})
 
     errors = []
     init_err = _backend_ready()
@@ -1055,8 +1079,8 @@ def main():
     # a number recorded under lazy fetches can never read as baseline
     # drift against a sync round (same contract as layer_scan above)
     rec["async_dispatch"] = os.environ.get("PADDLE_TPU_ASYNC", "0") == "1"
-    # ... and so is the ZeRO-1 arm (PADDLE_TPU_ZERO=0/1 -> zero_stage)
-    rec["zero_stage"] = 1 if _zero_enabled() else 0
+    # ... and so is the ZeRO arm (PADDLE_TPU_ZERO=0|1|2|3 -> zero_stage)
+    rec["zero_stage"] = _zero_stage()
     if skipped_rows:
         rec["skipped_rows"] = skipped_rows
     if health_tflops is not None:

@@ -106,10 +106,17 @@ class DistributedStrategy:
     pipeline: bool = False
     pipeline_configs: dict = field(default_factory=lambda: {
         "micro_batch_size": 1, "accumulate_steps": 1})
-    # ZeRO-1 (parallel/zero.py): bucket optimizer state into flat dp-sharded
-    # vars updated shard-locally (reduce_scatter -> update -> all_gather);
-    # sharding_configs: {"stage": 1, "fuse_grad_size_in_mb": override}
+    # ZeRO sharded training (parallel/zero.py): `sharding = True` turns on
+    # stage 1 (flat dp-sharded optimizer state, reduce_scatter ->
+    # shard-local update -> all_gather); `sharding_stage` (or
+    # sharding_configs={"stage": N}) selects the deeper stages —
+    # 2 keeps the averaged gradient shard resident (gradient bytes/device
+    # ÷ dp, never all-gathered), 3 additionally shards parameter STORAGE
+    # with on-demand __zero_gather__ (per layer-scan iteration for @LAYERS
+    # stacked params). sharding_configs also takes a
+    # "fuse_grad_size_in_mb" override for the bucket pipeline width.
     sharding: bool = False
+    sharding_stage: int = 0
     sharding_configs: dict = field(default_factory=dict)
     # Gradient bucketing (the reference's fuse_all_reduce_op_pass +
     # coalesce_grad_tensor_pass knob): coalesce the per-parameter dp
@@ -451,29 +458,55 @@ class DistributedOptimizer:
         # jitted computation (PS hooks, gradient merge's gated updates,
         # LocalSGD, pipeline microbatching) keep the GSPMD path untouched.
         from ...flags import flag
-        zero_stage = 0
+        zero_stage = int(s.sharding_stage or 0)
         if s.sharding:
-            zero_stage = int((s.sharding_configs or {}).get("stage", 1))
+            zero_stage = max(zero_stage,
+                             int((s.sharding_configs or {}).get("stage", 1)))
         if flag("FLAGS_zero_stage"):
             zero_stage = max(zero_stage, int(flag("FLAGS_zero_stage")))
-        if zero_stage not in (0, 1):
+        if zero_stage not in (0, 1, 2, 3):
             raise ValueError(
                 f"sharding stage {zero_stage} is not supported: this build "
-                "implements ZeRO stage 1 (optimizer-state sharding, "
-                "parallel/zero.py); set sharding_configs={'stage': 1}")
+                "implements ZeRO stages 1 (optimizer state), 2 (+resident "
+                "gradient shards) and 3 (+parameter storage) — "
+                "parallel/zero.py; set strategy.sharding_stage to 1, 2 "
+                "or 3")
+        if zero_stage >= 3 and s.tensor_parallel_degree > 1:
+            raise ValueError(
+                "sharding_stage=3 flat-shards parameter STORAGE over dp and "
+                "cannot compose with tensor_parallel_rules in this build "
+                "(the TP rules would shard the same storage a second way); "
+                "use stage <= 2 with tensor parallelism")
         bucket_mb = float((s.sharding_configs or {}).get(
             "fuse_grad_size_in_mb", s.fuse_grad_size_in_mb))
-        bucketable = (
-            bucket_mb > 0 and not ps_hooks
-            and not (s.gradient_merge
-                     and s.gradient_merge_configs.get("k_steps", 1) > 1)
-            and not getattr(program, "_localsgd_k", 0)
-            and not getattr(program, "_microbatch_k", 0)
-            and s.pipeline_parallel_degree <= 1
-            # device_guard-staged programs: a cross-stage bucket op would
-            # break the pipeline partitioner's stage assignment
-            and not any("pipeline_stage" in op.attrs
-                        for op in program.global_block().ops))
+        gm_on = (s.gradient_merge
+                 and s.gradient_merge_configs.get("k_steps", 1) > 1)
+        pipelined = (getattr(program, "_microbatch_k", 0)
+                     or s.pipeline_parallel_degree > 1
+                     # device_guard-staged programs: a cross-stage bucket op
+                     # would break the pipeline partitioner's stage
+                     # assignment
+                     or any("pipeline_stage" in op.attrs
+                            for op in program.global_block().ops))
+        bucketable = (bucket_mb > 0 and not ps_hooks and not gm_on
+                      and not getattr(program, "_localsgd_k", 0)
+                      and not pipelined)
+        if zero_stage >= 1 and not bucketable:
+            # the fallback matrix, observable from monitor stats alone: a
+            # sharding request that a pipeline/gradient-merge/PS program
+            # cannot take falls back to GSPMD state specs below, counted
+            # per cause under executor.zero_manual_fallbacks.<cause>
+            from ...parallel.zero import count_fallback
+            if ps_hooks:
+                count_fallback("ps_hooks")
+            elif gm_on:
+                count_fallback("grad_merge")
+            elif getattr(program, "_localsgd_k", 0):
+                count_fallback("localsgd")
+            elif pipelined:
+                count_fallback("pipeline")
+            elif bucket_mb <= 0:
+                count_fallback("bucketing_disabled")
         if bucketable:
             from ...framework.program import default_startup_program
             from ...parallel.zero import apply_grad_bucketing

@@ -37,15 +37,19 @@ _DEFS: Dict[str, tuple] = {
                                "update's live range crosses a remaining "
                                "read (docs/perf_notes.md 'Copy census'); "
                                "0 donates everything"),
-    "FLAGS_zero_stage": (0, "ZeRO optimizer-state sharding stage applied at "
-                            "fleet minimize time (parallel/zero.py): 1 moves "
-                            "each gradient bucket's optimizer state into "
-                            "flat dp-sharded vars updated shard-locally "
-                            "(reduce_scatter -> update -> all_gather), "
-                            "~dp x less optimizer-state HBM per device; "
+    "FLAGS_zero_stage": (0, "ZeRO sharding stage applied at fleet minimize "
+                            "time (parallel/zero.py): 1 moves each gradient "
+                            "bucket's optimizer state into flat dp-sharded "
+                            "vars updated shard-locally (reduce_scatter -> "
+                            "update -> all_gather); 2 additionally keeps "
+                            "the averaged gradient SHARD resident (grad "
+                            "bytes/device / dp, never all-gathered); 3 "
+                            "also flat-shards parameter STORAGE with "
+                            "on-demand __zero_gather__ (one all_gather per "
+                            "layer-scan iteration for @LAYERS stacks); "
                             "0 keeps replicated state (grouped bucket "
                             "all-reduces still apply). Same switch as "
-                            "DistributedStrategy.sharding"),
+                            "DistributedStrategy.sharding_stage"),
     "FLAGS_layer_scan": (False, "roll isomorphic per-layer segments into "
                                 "one lax.scan at fleet minimize time "
                                 "(parallel/transforms.apply_layer_scan; "

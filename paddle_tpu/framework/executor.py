@@ -118,7 +118,10 @@ class _CompiledBlock:
                         state_meta, self.fetch_names, self.written_state,
                         multi_k)
                 except Exception:
-                    monitor.stat_add("executor.zero_manual_fallbacks")
+                    # plan/trace failure: the structural causes are counted
+                    # inside plan_manual_dp itself (per-cause breakdown
+                    # under executor.zero_manual_fallbacks.<cause>)
+                    zero_mod.count_fallback("plan_failure")
                     plan = None
                 if plan is not None:
                     self.jitted = zero_mod.build_manual_jit(
@@ -138,18 +141,16 @@ class _CompiledBlock:
                         v = self.block.find_var_recursive(n)
                         shp = tuple(v.shape) if v is not None else None
                     if n in zero_specs:
-                        # flat ZeRO-1 bucket state: dp-sharded storage even
-                        # on the GSPMD path (mixed meshes keep the ~dp x
-                        # optimizer-state saving; GSPMD inserts the param
-                        # all-gather from the spec), replicated when the
-                        # padding does not divide the dp width
-                        ax = zero_specs[n]
-                        div = (shp and shp[0] and
-                               shp[0] % max(int(mesh.shape.get(ax, 1)), 1)
-                               == 0)
+                        # flat ZeRO bucket state (moments/grad/param):
+                        # dp-sharded storage even on the GSPMD path (mixed
+                        # meshes keep the ~dp x memory saving; GSPMD
+                        # inserts the collectives from the spec),
+                        # replicated when the padding does not divide the
+                        # dp width (one shared divisibility rule)
+                        from ..parallel.zero import flat_state_partition
                         out[n] = NamedSharding(
-                            mesh, PartitionSpec(ax) if div
-                            else PartitionSpec())
+                            mesh, flat_state_partition(zero_specs[n], shp,
+                                                       mesh))
                     else:
                         out[n] = dist.state_sharding(mesh, n, shp)
                 return out

@@ -56,12 +56,13 @@ class DistConfig:
         return NamedSharding(mesh, P(*spec))
 
     def state_sharding(self, mesh, name, shape):
-        ax = self.state_specs.get(name)
-        if ax is not None:
-            size = max(int(mesh.shape.get(ax, 1)), 1)
-            if shape and shape[0] and shape[0] % size == 0:
-                return NamedSharding(mesh, P(ax))
-            return NamedSharding(mesh, P())
+        spec = self.state_specs.get(name)
+        if spec is not None:
+            # flat ZeRO bucket storage: "dp" ([padded]) or an axes tuple
+            # like (None, "dp") ([L, padded] stacked stage-3 buckets)
+            from .zero import flat_state_partition
+            return NamedSharding(mesh, flat_state_partition(spec, shape,
+                                                            mesh))
         return self.param_rules.sharding_for(mesh, name, shape)
 
 

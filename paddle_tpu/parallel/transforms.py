@@ -137,12 +137,29 @@ def _lower_layer_scan(ctx, ins, attrs):
     seeds = tuple(None if s is None else jnp.asarray(s, jnp.uint32)
                   for s in attrs["layer_seeds"])
     amp_dtype = _current_amp_dtype()
+    # ZeRO-3 stacked storage (parallel/zero.py): flagged stacked inputs are
+    # [L, padded] flat buckets sharded over dp on the trailing axis — the
+    # body all_gathers ONE layer slice per scan iteration (discarded after
+    # use; the gather's jax.vjp transpose is a per-iteration psum_scatter,
+    # so the stacked grads arrive pre-reduce-scattered)
+    zero3 = attrs.get("zero3_flat") or [None] * len(stacked_names)
+
+    def _materialize(sl, z):
+        if z is None:
+            return sl
+        from .zero import current_manual_dp
+        manual = current_manual_dp()
+        if manual is not None and sl.shape[0] != int(z["padded"]):
+            sl = jax.lax.all_gather(sl, manual[0], tiled=True)
+        return jnp.reshape(jax.lax.slice(sl, (0,), (int(z["size"]),)),
+                           tuple(z["shape"]))
 
     def body(carry, xs):
         slices, seed_slices = xs
         env = dict(inv_env)
         env[carry_in] = carry
-        env.update(zip(stacked_names, slices))
+        env.update({n: _materialize(sl, z)
+                    for n, sl, z in zip(stacked_names, slices, zero3)})
         env = _run_sub_ops(ctx, sub_ops, env, amp_dtype,
                            seed_overrides=seed_slices)
         return env[carry_out], None
@@ -152,6 +169,36 @@ def _lower_layer_scan(ctx, ins, attrs):
     carry, _ = jax.lax.scan(body, ins["X"][0], (stacked_vals, seeds),
                             length=n_layers)
     return {"Out": [carry]}
+
+
+def sink_op_to_producers(block, op) -> int:
+    """Move `op` EARLIER in the block's op list, to right after the last op
+    it has a dataflow edge with: an op writing any of its inputs, or
+    reading/writing any of its outputs. Used by the gradient-bucket
+    pipeline (parallel/zero.py): a bucket's sync/update op placed at the
+    backward→optimize boundary sinks back to its bucket's ready point — the
+    moment its last gradient is produced — so XLA schedules the bucket's
+    collective overlapping the backward compute that still runs for later
+    buckets. Position only fixes dataflow order; the motion never crosses a
+    producer of an input, a reader of an output, or another writer of an
+    output, so program semantics are bit-identical."""
+    ops = block.ops
+    pos = ops.index(op)
+    ins = {n for n in op.input_names() if n != "@EMPTY@"}
+    outs = {n for n in op.output_names() if n != "@EMPTY@"}
+    new = pos
+    for i in range(pos - 1, -1, -1):
+        other = ops[i]
+        o_out = set(other.output_names())
+        if (o_out & ins) or (o_out & outs) \
+                or (set(other.input_names()) & outs):
+            break
+        new = i
+    if new < pos:
+        ops.pop(pos)
+        ops.insert(new, op)
+        block.program.bump_version()
+    return new
 
 
 def _attr_val_equal(a, b):

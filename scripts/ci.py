@@ -130,11 +130,14 @@ def host_stall_check(env) -> bool:
 # all-reduce per parameter; ZeRO-1's reduce_scatter/all_gather shape and
 # the tp/sp rows are budgeted too. Started alongside the shards so its
 # ~2-3 min of compiles overlap instead of extending the critical path.
-def start_collective_audit(env):
+def start_collective_audit(env, skip_zero_rows=False):
     script = os.path.join(ROOT, "scripts", "collective_audit.py")
     child_env = dict(env)
     child_env["PADDLE_TPU_AUDIT_CHILD"] = "1"  # env already is the CPU mesh
-    return subprocess.Popen([sys.executable, script, "--assert"],
+    cmd = [sys.executable, script, "--assert"]
+    if skip_zero_rows:
+        cmd.append("--skip-zero-rows")
+    return subprocess.Popen(cmd,
                             cwd=ROOT, env=child_env, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True)
 
@@ -177,6 +180,9 @@ def main():
     ap.add_argument("--no-collective-audit", action="store_true",
                     help="skip the collective budget check "
                          "(scripts/collective_audit.py --assert)")
+    ap.add_argument("--no-zero-rows", action="store_true",
+                    help="keep the collective audit but drop its ZeRO "
+                         "stage-2/3 + overlap rows (2 extra compiles)")
     ap.add_argument("rest", nargs="*", help="extra pytest args")
     args = ap.parse_args()
 
@@ -190,7 +196,8 @@ def main():
         stall_proc = start_host_stall(env)   # overlaps the shards below
     audit_proc = None
     if not args.no_collective_audit:
-        audit_proc = start_collective_audit(env)   # overlaps the shards too
+        audit_proc = start_collective_audit(       # overlaps the shards too
+            env, skip_zero_rows=args.no_zero_rows)
 
     files = sorted(glob.glob(os.path.join(ROOT, "tests", "test_*.py")))
     shards = shard(files, args.n)

@@ -48,7 +48,8 @@ DT_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "f64": 8,
             "pred": 1, "s8": 1, "u8": 1, "s64": 8, "u64": 8}
 
 
-def compiled_text(axes, batch, sp_flag=False, sharding=False):
+def compiled_text(axes, batch, sp_flag=False, sharding=False, stage=None,
+                  bucket_mb=None):
     """Build + attach + compile the tiny-BERT train step; return HLO
     (via the public Executor.compiled_hlo — no executor internals)."""
     import numpy as np
@@ -71,6 +72,10 @@ def compiled_text(axes, batch, sp_flag=False, sharding=False):
         tensor_parallel_degree=axes.get("tp", 1),
         tensor_parallel_rules=bert.tp_sharding_rules())
     strategy.sharding = sharding                       # ZeRO-1 arm
+    if stage is not None:                              # ZeRO-2/3 arms
+        strategy.sharding_stage = stage
+    if bucket_mb is not None:   # small buckets force the K-bucket pipeline
+        strategy.fuse_grad_size_in_mb = bucket_mb
     opt = fleet.distributed_optimizer(
         paddle.optimizer.Adam(learning_rate=1e-3), strategy)
     opt.minimize(loss)
@@ -115,6 +120,35 @@ def audit(txt):
     return counts, byte_tot
 
 
+_COLL_RE = re.compile(r"%\S+ = .*? (all-reduce|all-gather|reduce-scatter|"
+                      r"collective-permute|all-to-all)(-start|-done)?\(")
+_COMPUTE_RE = re.compile(r"%\S+ = .*? (fusion|dot|convolution)\(")
+
+
+def collective_segments(txt) -> int:
+    """Overlap evidence: the number of collective GROUPS separated by real
+    compute (fusion/dot) in the optimized module's printed instruction
+    order (post-scheduling). A bucket pipeline that emits each sync at its
+    bucket's backward-ready point shows K>1 groups interleaved with the
+    remaining backward compute (xCxCxC...); a single post-backward
+    synchronization wall shows 1-2. On TPU executables the same census
+    sees the async -start/-done pairs straddling the compute between
+    them — both orders count identically here."""
+    segments = 0
+    in_group = False
+    seen_compute = True
+    for line in txt.splitlines():
+        if _COLL_RE.search(line):
+            if not in_group and seen_compute:
+                segments += 1
+            in_group = True
+            seen_compute = False
+        elif _COMPUTE_RE.search(line):
+            in_group = False
+            seen_compute = True
+    return segments
+
+
 # --assert budgets: per-row kind -> (max count, max MB). CLOSED lists — a
 # kind not in a row's budget must not appear at all. Numbers are the
 # measured post-bucketing census (parallel/zero.py; docs/perf_notes.md
@@ -131,6 +165,19 @@ BUDGETS = {
     # parameter all_gather replace the gradient all-reduce entirely
     "dp=2 zero1":  {"reduce-scatter": (2, 0.35), "all-gather": (2, 0.60),
                     "all-reduce": (2, 0.10)},
+    # ZeRO-2 with a small bucket cap: K>1 buckets, each K x RS (grad
+    # shards stay RESIDENT — zero gradient all-gathers, so AG bytes are
+    # bounded by the PARAMETER volume alone) + K x param-AG + the scalar
+    # loss pmean. __min_segments__ is the overlap proof: the bucket
+    # collectives interleave with backward compute (collective_segments),
+    # never one post-backward wall.
+    "dp=2 zero2":  {"reduce-scatter": (14, 0.35), "all-gather": (14, 0.60),
+                    "all-reduce": (2, 0.10), "__min_segments__": 4},
+    # ZeRO-3: K x on-demand param-AG in FORWARD (gather-use-discard), K x
+    # RS in backward, NO post-update param all-gather; AG bytes still
+    # bounded by one parameter volume
+    "dp=2 zero3":  {"reduce-scatter": (14, 0.35), "all-gather": (14, 0.60),
+                    "all-reduce": (2, 0.10), "__min_segments__": 4},
     # mixed/tp/sp meshes stay on the GSPMD lowering (measured round 6-8)
     "tp=2":        {"all-reduce": (40, 1.0), "all-gather": (55, 2.2),
                     "collective-permute": (16, 0.6)},
@@ -142,7 +189,7 @@ BUDGETS = {
 }
 
 
-def check_budget(label, counts, byts):
+def check_budget(label, counts, byts, txt=None):
     """List of violation strings (empty = within budget)."""
     budget = BUDGETS.get(label)
     if budget is None:
@@ -157,12 +204,23 @@ def check_budget(label, counts, byts):
             bad.append(f"{kind} count {n} > {max_n}")
         if byts[kind] > max_mb * 1e6:
             bad.append(f"{kind} {byts[kind] / 1e6:.2f} MB > {max_mb} MB")
+    min_seg = budget.get("__min_segments__")
+    if min_seg is not None and txt is not None:
+        seg = collective_segments(txt)
+        if seg < min_seg:
+            bad.append(f"collective/compute interleaving: {seg} "
+                       f"segment(s) < {min_seg} (bucket pipeline "
+                       f"collapsed into a sync wall)")
     return bad
 
 
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     assert_mode = "--assert" in argv
+    # --skip-zero-rows (or PADDLE_TPU_AUDIT_SKIP_ZERO=1): drop the ZeRO
+    # stage-2/3 + overlap rows (scripts/ci.py --no-zero-rows passes this)
+    skip_zero = ("--skip-zero-rows" in argv
+                 or os.environ.get("PADDLE_TPU_AUDIT_SKIP_ZERO") == "1")
     # On hosts where the TPU plugin pins the backend at interpreter start
     # (env vars are read too late), re-exec once into a sanitized
     # subprocess with the 8-device virtual CPU mesh — same recipe as
@@ -182,9 +240,15 @@ def main(argv=None):
     nd = jax.device_count()
     rows = [({"dp": 1}, 8, {}), ({"dp": 2}, 16, {}),
             ({"dp": 2}, 16, {"sharding": True}),
+            # ZeRO-2/3 + overlap rows: a small bucket cap forces a K>1
+            # bucket pipeline so the interleaving budget has teeth
+            ({"dp": 2}, 16, {"stage": 2, "bucket_mb": 0.15}),
+            ({"dp": 2}, 16, {"stage": 3, "bucket_mb": 0.15}),
             ({"dp": 4}, 32, {}), ({"dp": 8}, 64, {}),
             ({"tp": 2}, 8, {}), ({"dp": 2, "tp": 2}, 8, {}),
             ({"sp": 4}, 8, {"sp_flag": True})]
+    if skip_zero:
+        rows = [r for r in rows if "stage" not in r[2]]
     failures = 0
     for axes, batch, kw in rows:
         needed = 1
@@ -196,10 +260,14 @@ def main(argv=None):
         desc = " ".join(f"{k}={v}" for k, v in axes.items())
         if kw.get("sharding"):
             desc += " zero1"
+        if kw.get("stage"):
+            desc += f" zero{kw['stage']}"
         try:
-            counts, byts = audit(compiled_text(
+            txt = compiled_text(
                 axes, batch, sp_flag=kw.get("sp_flag", False),
-                sharding=kw.get("sharding", False)))
+                sharding=kw.get("sharding", False),
+                stage=kw.get("stage"), bucket_mb=kw.get("bucket_mb"))
+            counts, byts = audit(txt)
         except Exception as e:   # one broken config must not kill the audit
             print(f"{desc:12s} batch {batch:3d}: FAILED ({e!r:.120})")
             if assert_mode and desc in BUDGETS:
@@ -208,9 +276,11 @@ def main(argv=None):
         summary = ", ".join(
             f"{k} x{counts[k]} ({byts[k] / 1e6:.2f} MB)"
             for k in sorted(counts)) or "none"
+        if kw.get("stage"):
+            summary += f", {collective_segments(txt)} interleaved segments"
         verdict = ""
         if assert_mode:
-            bad = check_budget(desc, counts, byts)
+            bad = check_budget(desc, counts, byts, txt)
             if bad:
                 failures += 1
                 verdict = "  BUDGET FAIL: " + "; ".join(bad)

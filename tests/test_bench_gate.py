@@ -113,3 +113,51 @@ def test_load_prev_recorded_reads_round_files(tmp_path, monkeypatch):
     (tmp_path / "BENCH_r04.json").write_text("not json at all")
     monkeypatch.chdir(tmp_path)
     assert gate.load_prev_recorded() == 60000.0
+
+
+# ---- the r05 wedge: the init ladder is bounded by BENCH_INIT_DEADLINE ----
+
+def test_backend_ready_ladder_bounded_by_deadline(monkeypatch):
+    """Round 5 died at rc=124: four hung 150 s probes + backoff sleeps
+    overshot the driver's window because each wait was clamped only
+    against the remaining time, reserving nothing for its own SIGTERM
+    grace. The contract now: the ENTIRE probe/retry/backoff ladder (all
+    attempts + sleeps + terminate grace) completes within the deadline
+    and RETURNS a _WedgedTunnel — which main() records as a
+    tunnel_degraded JSON row — instead of outliving the driver."""
+    import importlib.util
+    import os
+    import sys
+    import time
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    # bench.py's import section is light (heavy imports live in main());
+    # still guard against a jax pull at import time by pre-seeding cpu
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    spec.loader.exec_module(bench)
+
+    # a probe that NEVER returns = the wedged-claim failure mode. The
+    # sleep must comfortably exceed the deadline so only the ladder's own
+    # clamps can end the test in time. Grace shrunk so the test fits a few
+    # seconds while still running a REAL hung probe + SIGTERM cycle.
+    monkeypatch.setattr(bench, "_PROBE_CODE", "import time; time.sleep(600)")
+    monkeypatch.setattr(bench, "_LADDER_GRACE", 2.0)
+    deadline = 8.0
+    t0 = time.monotonic()
+    err = bench._backend_ready(attempts=5, probe_timeout=150.0,
+                               final_timeout=420.0,
+                               delays=(15.0, 60.0, 300.0, 600.0),
+                               deadline_s=deadline)
+    elapsed = time.monotonic() - t0
+    assert isinstance(err, bench._WedgedTunnel), err
+    # the ladder really probed (was not an instant bail)...
+    assert elapsed > 3.0, elapsed
+    # ...and the WHOLE ladder stayed bounded: deadline plus one terminate
+    # grace window, never the old unbounded attempts*timeout+sleeps
+    # (generous margin — a timing bound, not a knife edge)
+    assert elapsed <= deadline + 10.0 + 5.0, elapsed
+    # and the JSON-row path downstream: a _WedgedTunnel stamps the record
+    assert "deadline" in str(err) or "hung" in str(err), err

@@ -247,22 +247,32 @@ def test_train_from_dataset_overlaps_parse_with_compute():
     # warm the compile cache so timing measures steady-state
     exe.run(feed={"px": batches[0]}, fetch_list=[out])
 
-    t0 = time.perf_counter()
-    last = exe.train_from_dataset(fluid.default_main_program(),
-                                  SlowDataset(), fetch_list=[out])
-    overlapped = time.perf_counter() - t0
+    # bounded retry on the TIMING comparison only (correctness asserts stay
+    # single-shot): on the shared CPU backend a GC pause or scheduler blip
+    # can eat the 15% margin in any one sample — a real overlap regression
+    # fails every attempt (the jax-cpu-timing-tests rule: timing A/Bs need
+    # real per-step compute + bounded retry or they flake)
+    for attempt in range(3):
+        t0 = time.perf_counter()
+        last = exe.train_from_dataset(fluid.default_main_program(),
+                                      SlowDataset(), fetch_list=[out])
+        overlapped = time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    for b in batches:
-        time.sleep(parse_s)
-        serial_last = exe.run(feed={"px": b}, fetch_list=[out])
-    serial = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for b in batches:
+            time.sleep(parse_s)
+            serial_last = exe.run(feed={"px": b}, fetch_list=[out])
+        serial = time.perf_counter() - t0
 
-    np.testing.assert_allclose(np.asarray(last[0]),
-                               np.asarray(serial_last[0]), rtol=1e-6)
-    # parse alone is 0.3s; overlapped must beat serial by a clear margin
-    assert overlapped < serial * 0.85, \
-        f"no overlap: overlapped={overlapped:.3f}s serial={serial:.3f}s"
+        np.testing.assert_allclose(np.asarray(last[0]),
+                                   np.asarray(serial_last[0]), rtol=1e-6)
+        if overlapped < serial * 0.85:
+            break
+    else:
+        # parse alone is 0.3s; overlapped must beat serial clearly
+        raise AssertionError(
+            f"no overlap in 3 attempts: last overlapped={overlapped:.3f}s "
+            f"serial={serial:.3f}s")
 
 
 def test_train_from_dataset_fast_producer_slow_consumer_terminates():
