@@ -1,11 +1,44 @@
-"""Process launcher: `python -m paddle_tpu.distributed.launch train.py`.
+"""Supervised gang launcher: `python -m paddle_tpu.distributed.launch train.py`.
 
 Reference counterpart: distributed/launch.py:221 + fleet/launch.py:300
-(`fleetrun`): spawn one process per GPU with the PADDLE_* env contract. On
-TPU, devices within a host belong to ONE process (single-controller), so the
-launcher spawns one process per HOST (for multi-host pods, driven by
-TPU_WORKER_HOSTNAMES or --ips) and sets both the reference env contract and
-the jax.distributed coordinator variables.
+(`fleetrun`): spawn one process per device with the PADDLE_* env contract,
+plus the fleet elastic controller's relaunch-on-loss behavior. On TPU,
+devices within a host belong to ONE process (single-controller), so the
+unit of gang membership is the HOST process; `--nproc_per_node` > 1 is the
+single-host multi-process simulation used by tests and CPU meshes.
+
+Unlike the reference's fire-and-forget spawn loop, this launcher is a
+SUPERVISOR — trainer loss is a first-class event (ROADMAP item 5):
+
+* **Env contract** (`plan_gang`): `PADDLE_TRAINER_ENDPOINTS` enumerates
+  every rank in the world (nnodes x nproc_per_node entries — one per
+  process, not one per ip), and `PADDLE_TRAINERS_NUM` /
+  `JAX_NUM_PROCESSES` both equal the real world size.
+* **Deadline-bounded rendezvous**: every worker checks in (its bootstrap
+  creates a heartbeat file before user code runs) within
+  `FLAGS_rendezvous_deadline_ms` — polled under a `resilience.RetryPolicy`
+  whose exhaustion raises the typed `DeadlineExceededError` — or the whole
+  gang is killed. A straggler fails the launch; it never leaves the
+  punctual workers wedged in a first collective.
+* **Heartbeat-file liveness**: each worker's bootstrap touches its file
+  every `FLAGS_launch_heartbeat_interval_ms` from a daemon thread; with
+  `--heartbeat_timeout_ms > 0` the supervisor treats a stale file as a
+  hung worker (SIGSTOP'd, OOM-thrashing) and fails it.
+* **Fail-fast sibling kill**: one worker exiting non-zero (or hanging)
+  kills every sibling — SIGTERM first, so `PreemptionGuard` trainers write
+  a final checkpoint, SIGKILL past `--grace_period_s`. A dead peer must
+  never leave survivors blocked in a collective that cannot complete.
+* **Bounded elastic restart** (`--elastic_restarts N`): after a failure
+  the gang relaunches at the SURVIVING world size (with
+  `PADDLE_ELASTIC_RESTART` incremented), at most N times. Resuming from
+  the latest checkpoint is the trainer's own contract
+  (`incubate.elastic.PreemptionGuard` restores and re-sharded ZeRO state
+  repacks for the new dp width — docs/resilience.md "Elasticity &
+  preemption").
+
+Chaos hook: `PADDLE_LAUNCH_STALL_RANKS="1,3"` in the launcher's env makes
+those ranks sleep before check-in (the deterministic straggler used by
+tests/test_launch.py and the drills).
 """
 from __future__ import annotations
 
@@ -13,54 +46,322 @@ import argparse
 import os
 import subprocess
 import sys
+import tempfile
+import time
+from typing import Dict, List, Optional, Tuple
+
+# The worker bootstrap is STDLIB-ONLY and runs before any user import: the
+# check-in marker (heartbeat-file creation) means "the worker process is up
+# and executing", independent of how long the training script's own imports
+# take afterwards.
+_BOOTSTRAP = r'''
+import os, runpy, sys, threading, time
+_stall = os.environ.get("PADDLE_LAUNCH_STALL_RANKS", "")
+if _stall and os.environ.get("PADDLE_TRAINER_ID") in \
+        [r.strip() for r in _stall.split(",")]:
+    time.sleep(3600)          # chaos hook: a rendezvous straggler
+_hb = os.environ.get("PADDLE_LAUNCH_HEARTBEAT_FILE")
+if _hb:
+    with open(_hb, "w") as _f:
+        _f.write(str(os.getpid()))      # the rendezvous check-in
+    _iv = float(os.environ.get("PADDLE_LAUNCH_HEARTBEAT_INTERVAL_S", "1"))
+
+    def _beat():
+        while True:
+            time.sleep(_iv)
+            try:
+                os.utime(_hb)
+            except OSError:
+                try:                      # unlinked by a tmp reaper: a
+                    with open(_hb, "w") as _g:      # dead beat reads as a
+                        _g.write(str(os.getpid()))  # hung worker, so keep
+                except OSError:                     # beating, never exit
+                    pass
+
+    threading.Thread(target=_beat, daemon=True,
+                     name="launch-heartbeat").start()
+sys.argv = sys.argv[1:]
+runpy.run_path(sys.argv[0], run_name="__main__")
+'''
 
 
-def _parse_args():
+def _parse_args(argv=None):
     p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
     p.add_argument("--ips", type=str, default="127.0.0.1",
                    help="comma-separated host ips (reference --ips)")
     p.add_argument("--port", type=int, default=6170)
     p.add_argument("--nproc_per_node", type=int, default=1,
-                   help="kept for parity; on TPU one process drives all "
-                        "local chips, so this is normally 1")
+                   help="processes per host; on TPU one process drives all "
+                        "local chips, so this is normally 1 (tests use >1 "
+                        "for single-host gangs)")
     p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("--rendezvous_deadline_ms", type=float, default=-1.0,
+                   help="every worker must check in within this budget or "
+                        "the gang is killed with DeadlineExceededError "
+                        "(-1: FLAGS_rendezvous_deadline_ms)")
+    p.add_argument("--heartbeat_timeout_ms", type=float, default=0.0,
+                   help="treat a worker whose heartbeat file is stale past "
+                        "this as HUNG and fail it (0: disabled)")
+    p.add_argument("--grace_period_s", type=float, default=10.0,
+                   help="SIGTERM-to-SIGKILL grace when killing the gang "
+                        "(long enough for PreemptionGuard's final "
+                        "checkpoint)")
+    p.add_argument("--elastic_restarts", type=int, default=0,
+                   help="relaunch budget after a worker failure: the gang "
+                        "restarts at the surviving world size, trainers "
+                        "resume from their latest checkpoint")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
-    return p.parse_args()
+    return p.parse_args(argv)
 
 
-def launch():
-    args = _parse_args()
-    ips = args.ips.split(",")
-    nnodes = len(ips)
-    procs = []
-    coordinator = f"{ips[0]}:{args.port}"
-    endpoints = ",".join(f"{ip}:{args.port + i}"
-                         for i, ip in enumerate(ips))
-    if args.log_dir:
-        os.makedirs(args.log_dir, exist_ok=True)
-    for rank in range(args.nproc_per_node if nnodes == 1 else nnodes):
-        env = dict(os.environ)
-        env.update({
+def plan_gang(ips: List[str], port: int, nproc_per_node: int,
+              world: Optional[int] = None) -> List[Dict[str, str]]:
+    """Per-rank env contract for a gang of `len(ips) * nproc_per_node`
+    processes (or its first `world` ranks after an elastic shrink).
+
+    Fixes the reference-contract drift the fire-and-forget launcher had:
+    `PADDLE_TRAINER_ENDPOINTS` enumerates one endpoint PER PROCESS (so a
+    single-host `--nproc_per_node=4` gang sees 4 entries, not 1), and
+    `PADDLE_TRAINERS_NUM` / `JAX_NUM_PROCESSES` both equal the real world
+    size `nnodes * nproc_per_node`. The jax.distributed coordinator port
+    sits above every trainer endpoint port (`port + full world size`), so
+    the two services can never collide on rank 0's host."""
+    nproc = max(int(nproc_per_node), 1)
+    full_world = len(ips) * nproc
+    world = full_world if world is None else min(int(world), full_world)
+    endpoints = [f"{ip}:{port + local}"
+                 for ip in ips for local in range(nproc)][:world]
+    coordinator = f"{ips[0]}:{port + full_world}"
+    plans = []
+    for rank in range(world):
+        plans.append({
             # reference env contract (role_maker.py:673-737)
             "PADDLE_TRAINER_ID": str(rank),
-            "PADDLE_TRAINERS_NUM": str(max(nnodes, args.nproc_per_node)),
-            "PADDLE_TRAINER_ENDPOINTS": endpoints,
-            "PADDLE_CURRENT_ENDPOINT": f"{ips[min(rank, nnodes - 1)]}:{args.port + rank}",
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
             "TRAINING_ROLE": "TRAINER",
             # jax.distributed bootstrap (DCN)
             "JAX_COORDINATOR_ADDRESS": coordinator,
-            "JAX_NUM_PROCESSES": str(max(nnodes, 1)),
+            "JAX_NUM_PROCESSES": str(world),
             "JAX_PROCESS_ID": str(rank),
         })
-        log = (open(os.path.join(args.log_dir, f"worker.{rank}.log"), "w")
-               if args.log_dir else None)
-        procs.append(subprocess.Popen(
-            [sys.executable, args.training_script] + args.training_script_args,
-            env=env, stdout=log, stderr=subprocess.STDOUT if log else None))
-    rc = 0
-    for p in procs:
-        rc |= p.wait()
+    return plans
+
+
+class GangSupervisor:
+    """Launch, watch, and (boundedly) relaunch one training gang."""
+
+    def __init__(self, args):
+        from ..flags import flag
+        self.args = args
+        self.ips = [ip.strip() for ip in args.ips.split(",") if ip.strip()]
+        self.rendezvous_deadline_ms = (
+            args.rendezvous_deadline_ms
+            if args.rendezvous_deadline_ms >= 0
+            else float(flag("FLAGS_rendezvous_deadline_ms")))
+        self.heartbeat_interval_s = \
+            float(flag("FLAGS_launch_heartbeat_interval_ms")) / 1000.0
+        self.heartbeat_timeout_s = args.heartbeat_timeout_ms / 1000.0
+        self.grace_period_s = args.grace_period_s
+
+    # -- gang lifecycle ----------------------------------------------------
+    def _spawn(self, world: int, restart_idx: int, hb_dir: str):
+        args = self.args
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+        procs: Dict[int, subprocess.Popen] = {}
+        hb_files: Dict[int, str] = {}
+        logs = []
+        for rank, plan in enumerate(plan_gang(self.ips, args.port,
+                                              args.nproc_per_node, world)):
+            hb_files[rank] = os.path.join(hb_dir, f"worker.{rank}.alive")
+            env = dict(os.environ)
+            env.update(plan)
+            env.update({
+                "PADDLE_LAUNCH_HEARTBEAT_FILE": hb_files[rank],
+                "PADDLE_LAUNCH_HEARTBEAT_INTERVAL_S":
+                    str(self.heartbeat_interval_s),
+                "PADDLE_ELASTIC_RESTART": str(restart_idx),
+            })
+            log = None
+            if args.log_dir:
+                log = open(os.path.join(args.log_dir,
+                                        f"worker.{rank}.log"), "a")
+                logs.append(log)
+            procs[rank] = subprocess.Popen(
+                [sys.executable, "-c", _BOOTSTRAP, args.training_script]
+                + args.training_script_args,
+                env=env, stdout=log,
+                stderr=subprocess.STDOUT if log else None)
+        return procs, hb_files, logs
+
+    def _kill_gang(self, procs: Dict[int, subprocess.Popen]) -> None:
+        """SIGTERM everyone still alive (PreemptionGuard trainers write
+        their final checkpoint), SIGKILL whoever outlives the grace
+        window. A dead peer must never leave survivors wedged in a
+        collective."""
+        alive = [p for p in procs.values() if p.poll() is None]
+        for p in alive:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        deadline = time.monotonic() + self.grace_period_s
+        for p in alive:
+            try:
+                p.wait(timeout=max(0.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                pass
+        for p in alive:
+            if p.poll() is None:
+                try:
+                    p.kill()
+                    p.wait()
+                except OSError:
+                    pass
+
+    class _WorkerFailed(RuntimeError):
+        def __init__(self, rank: int, rc: int, why: str):
+            super().__init__(f"worker {rank} {why} (rc={rc})")
+            self.rank, self.rc = rank, rc
+
+    def _rendezvous(self, procs, hb_files) -> None:
+        """Block until every worker has checked in (created its heartbeat
+        file), bounded by the rendezvous deadline via the shared
+        resilience.RetryPolicy — exhaustion raises the typed
+        DeadlineExceededError (the caller kills the gang). A worker dying
+        during rendezvous fails immediately (_WorkerFailed, not
+        retryable)."""
+        from ..framework import errors
+        from ..resilience.retry import RetryPolicy
+
+        def probe():
+            for rank, p in procs.items():
+                rc = p.poll()
+                if rc is not None and rc != 0:
+                    raise self._WorkerFailed(rank, rc, "died in rendezvous")
+            missing = sorted(r for r in procs
+                             if not os.path.exists(hb_files[r]))
+            if missing:
+                raise errors.Unavailable(
+                    "rendezvous: waiting for rank(s) %s", missing)
+
+        policy = RetryPolicy(
+            max_attempts=None, base_delay_s=0.05, max_delay_s=0.2,
+            jitter=0.0, deadline_s=self.rendezvous_deadline_ms / 1000.0,
+            retry_on=(errors.UnavailableError,))
+        policy.call(probe, site="launch.rendezvous")
+
+    def _monitor(self, procs, hb_files) -> Tuple[str, int, int]:
+        """Watch the running gang. Returns ("ok", world, 0) when every
+        worker exits 0, else ("failed", survivors_at_failure, rc) after
+        the fail-fast sibling kill."""
+        done: set = set()
+        while len(done) < len(procs):
+            failed: Optional[Tuple[int, int, str]] = None
+            now = time.time()     # wall clock: compared against file mtimes
+            for rank, p in procs.items():
+                if rank in done:
+                    continue
+                rc = p.poll()
+                if rc is None:
+                    if self.heartbeat_timeout_s > 0:
+                        try:
+                            age = now - os.path.getmtime(hb_files[rank])
+                        except OSError:
+                            # fail CLOSED: the file existed at rendezvous,
+                            # so missing/unreadable now means the liveness
+                            # signal is gone, not that the worker is fresh
+                            age = float("inf")
+                        if age > self.heartbeat_timeout_s:
+                            why = ("missing" if age == float("inf")
+                                   else f"stale for {age:.1f}s")
+                            print(f"[launch] worker {rank} heartbeat {why} "
+                                  f"(> {self.heartbeat_timeout_s:.1f}s): "
+                                  "treating as hung", flush=True)
+                            try:
+                                p.kill()
+                                p.wait()
+                            except OSError:
+                                pass
+                            failed = (rank, -9, "hung (stale heartbeat)")
+                            break
+                    continue
+                if rc == 0:
+                    done.add(rank)
+                    continue
+                failed = (rank, rc, "exited")
+                break
+            if failed is not None:
+                rank, rc, why = failed
+                survivors = sum(1 for r, q in procs.items()
+                                if r != rank and q.poll() is None)
+                print(f"[launch] worker {rank} {why} rc={rc}: "
+                      f"fail-fast, terminating {survivors} sibling(s)",
+                      flush=True)
+                self._kill_gang(procs)
+                return ("failed", survivors, rc if rc > 0 else 1)
+            time.sleep(0.05)
+        return ("ok", len(procs), 0)
+
+    def launch_once(self, world: int, restart_idx: int) \
+            -> Tuple[str, int, int]:
+        import shutil
+        hb_dir = tempfile.mkdtemp(prefix="paddle_launch_hb_")
+        procs, hb_files, logs = self._spawn(world, restart_idx, hb_dir)
+        try:
+            try:
+                self._rendezvous(procs, hb_files)
+            except self._WorkerFailed as e:
+                survivors = sum(1 for p in procs.values()
+                                if p.poll() is None)
+                print(f"[launch] {e}: fail-fast, terminating "
+                      f"{survivors} sibling(s)", flush=True)
+                self._kill_gang(procs)
+                return ("failed", survivors, e.rc if e.rc > 0 else 1)
+            except Exception:
+                # rendezvous deadline (DeadlineExceededError) or any other
+                # supervisor error: never leave a half-launched gang behind
+                self._kill_gang(procs)
+                raise
+            return self._monitor(procs, hb_files)
+        finally:
+            for log in logs:
+                try:
+                    log.close()
+                except OSError:
+                    pass
+            shutil.rmtree(hb_dir, ignore_errors=True)
+
+    def run(self) -> int:
+        args = self.args
+        world = len(self.ips) * max(args.nproc_per_node, 1)
+        restarts = 0
+        while True:
+            status, survivors, rc = self.launch_once(world, restarts)
+            if status == "ok":
+                return 0
+            if restarts >= args.elastic_restarts or survivors < 1:
+                return rc
+            restarts += 1
+            world = survivors
+            print(f"[launch] elastic restart {restarts}/"
+                  f"{args.elastic_restarts}: relaunching at world size "
+                  f"{world}; trainers resume from their latest checkpoint "
+                  "(PreemptionGuard)", flush=True)
+
+
+def launch(argv=None):
+    sup = GangSupervisor(_parse_args(argv))
+    try:
+        rc = sup.run()
+    except Exception as e:
+        # typed failure (rendezvous DeadlineExceededError, ...): one clear
+        # line + non-zero exit — a broken launch must FAIL, never hang
+        print(f"[launch] FAILED: {e!r}", file=sys.stderr, flush=True)
+        raise SystemExit(1)
     sys.exit(rc)
 
 

@@ -87,6 +87,35 @@ _DEFS: Dict[str, tuple] = {
     "FLAGS_dataloader_max_respawns": (0, "respawn budget for abnormally-"
                                          "dead dataloader workers "
                                          "(0 = fail fast, seed behavior)"),
+    # --- elasticity / preemption tier (docs/resilience.md) ----------------
+    "FLAGS_step_deadline_ms": (0.0, "hang watchdog for the executor's "
+                               "SYNCHRONOUS step path: bound dispatch and "
+                               "fetch materialization by this wall-clock "
+                               "deadline; a trip raises the typed "
+                               "DeadlineExceededError with a full "
+                               "thread-stack dump and counts "
+                               "executor.step_deadline_trips, so a wedged "
+                               "collective (one dead pod host) surfaces as "
+                               "a typed error the gang supervisor can act "
+                               "on instead of an indefinite hang. 0 (the "
+                               "default) disables the watchdog"),
+    "FLAGS_rendezvous_deadline_ms": (60000.0, "gang-launch rendezvous "
+                                     "deadline (distributed/launch.py): "
+                                     "every worker must check in (create "
+                                     "its heartbeat file) within this "
+                                     "budget or the supervisor kills the "
+                                     "whole gang and raises "
+                                     "DeadlineExceededError — a straggler "
+                                     "must fail the launch, never wedge "
+                                     "the surviving workers in a "
+                                     "collective"),
+    "FLAGS_launch_heartbeat_interval_ms": (1000.0, "how often each "
+                                           "launched worker's heartbeat "
+                                           "thread touches its liveness "
+                                           "file; the supervisor treats a "
+                                           "file stale past the launcher's "
+                                           "--heartbeat_timeout_ms as a "
+                                           "hung worker"),
 }
 
 _values: Dict[str, Any] = {}

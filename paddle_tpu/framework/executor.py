@@ -1162,6 +1162,18 @@ class Executor:
             state = {n: scope.find(n) for n in state_names}
             return compiled(state, feed_vals, rng_key)
 
+        # step-level hang watchdog: bound the dispatch (and, below, the
+        # synchronous fetch drain) so a wedged collective surfaces as a
+        # typed error the gang supervisor can restart on, never a hang
+        step_deadline = float(flag("FLAGS_step_deadline_ms") or 0.0)
+        if step_deadline > 0:
+            _raw_dispatch = _dispatch
+
+            def _dispatch():
+                return _deadline_call(
+                    _raw_dispatch, step_deadline,
+                    f"step dispatch ({op_count(program)} ops)")
+
         benchmark = flag("FLAGS_benchmark")
         if _prof._enabled or benchmark:
             import time as _time
@@ -1200,6 +1212,11 @@ class Executor:
             fetches = [jnp.copy(f)
                        if (n in new_state and hasattr(f, "dtype")) else f
                        for f, n in zip(fetches, user_names)]
+        if step_deadline > 0 and sync and return_numpy:
+            return _deadline_call(
+                lambda: _package_fetches(fetches, user_names, return_numpy,
+                                         sync),
+                step_deadline, "fetch materialization")
         return _package_fetches(fetches, user_names, return_numpy, sync)
 
     def run_steps(self, k: int, program: Optional[Program] = None,
@@ -1307,7 +1324,16 @@ class Executor:
                 sync = True
         rng_key = _next_rng_key(scope, program.random_seed)
         state = {n: scope.find(n) for n in state_names}
-        fetches, new_state = compiled(state, feed_vals, rng_key)
+        from ..flags import flag
+        step_deadline = float(flag("FLAGS_step_deadline_ms") or 0.0)
+        if step_deadline > 0:
+            # the hang watchdog covers the k-step dispatch too (one wedged
+            # collective inside the scan blocks it exactly the same way)
+            fetches, new_state = _deadline_call(
+                lambda: compiled(state, feed_vals, rng_key), step_deadline,
+                f"run_steps(k={k}) dispatch")
+        else:
+            fetches, new_state = compiled(state, feed_vals, rng_key)
         for n, v in new_state.items():
             scope.set(n, v)
         if ps_hooks:
@@ -1315,9 +1341,13 @@ class Executor:
             for h in ps_hooks:
                 h.post_multi(fetched_by_name)
             fetches = fetches[:n_user_fetch]
-        return _package_fetches(fetches, fetch_names[:n_user_fetch]
-                                if ps_hooks else fetch_names,
-                                return_numpy, sync)
+        user_names = fetch_names[:n_user_fetch] if ps_hooks else fetch_names
+        if step_deadline > 0 and sync and return_numpy:
+            return _deadline_call(
+                lambda: _package_fetches(fetches, user_names, return_numpy,
+                                         sync),
+                step_deadline, "run_steps fetch materialization")
+        return _package_fetches(fetches, user_names, return_numpy, sync)
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
@@ -1583,6 +1613,57 @@ class Executor:
 
 def op_count(program) -> int:
     return sum(len(b.ops) for b in program.blocks)
+
+
+def _dump_thread_stacks() -> str:
+    """Stacks of every live thread — the watchdog's post-mortem payload:
+    WHICH thread is wedged, and where (typically a collective blocked in C
+    on a dead peer)."""
+    import sys as _sys
+    import threading
+    import traceback
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for tid, frame in _sys._current_frames().items():
+        out.append(f"--- thread {names.get(tid, '?')} ({tid}) ---\n"
+                   + "".join(traceback.format_stack(frame)))
+    return "".join(out)
+
+
+def _deadline_call(fn, deadline_ms: float, what: str):
+    """Step-level hang watchdog (FLAGS_step_deadline_ms): run `fn` on a
+    worker thread and join with the deadline. On a pod, one dead host
+    leaves every survivor's next collective blocked in C forever — a state
+    the gang supervisor (distributed/launch.py) can only act on if the
+    worker FAILS, so a trip raises the typed DeadlineExceededError
+    carrying a full thread-stack dump (counted in
+    `executor.step_deadline_trips`) instead of hanging. The abandoned
+    worker thread cannot be cancelled and keeps blocking (daemon): after a
+    trip this process's step state is indeterminate — the caller is
+    expected to checkpoint-from-last-complete and exit/restart, which is
+    exactly the supervisor's elastic-restart contract."""
+    import threading
+    from . import errors
+    result = {}
+
+    def target():
+        try:
+            result["value"] = fn()
+        except BaseException as e:        # re-raised on the caller thread
+            result["error"] = e
+
+    t = threading.Thread(target=target, daemon=True, name="executor-step")
+    t.start()
+    t.join(deadline_ms / 1000.0)
+    if t.is_alive():
+        monitor.stat_add("executor.step_deadline_trips")
+        raise errors.DeadlineExceeded(
+            "%s exceeded FLAGS_step_deadline_ms=%.0f (wedged collective / "
+            "dead peer?); thread stacks:\n%s", what, deadline_ms,
+            _dump_thread_stacks())
+    if "error" in result:
+        raise result["error"]
+    return result["value"]
 
 
 def _check_nan_inf(fetched: dict, new_state: dict):

@@ -9,22 +9,35 @@ TPU-native story (SURVEY §5): TPU slices are preempted with a SIGTERM
 notice (maintenance events, spot reclaim). `PreemptionGuard` converts that
 notice into a final checkpoint + clean exit; on restart
 `steps()`/`train_epoch_range` resume after the last completed step. Resume
-is ELASTIC: checkpoints hold full (unsharded) host arrays, and the
-executor's GSPMD `in_shardings` re-shard them on the first dispatch, so a
-job checkpointed on a dp=4 mesh restarts unchanged on dp=2 (or any other
-layout) — re-sharding is the compiler's job, not the checkpoint's. Test:
-tests/test_elastic.py::test_resume_on_smaller_mesh.
+is ELASTIC in two layers:
+
+* checkpoints hold full (unsharded) host arrays, and the executor's GSPMD
+  `in_shardings` re-shard them on the first dispatch, so a job
+  checkpointed on a dp=4 mesh restarts unchanged on dp=2 (or any other
+  layout) — re-sharding is the compiler's job, not the checkpoint's;
+* ZeRO flat-bucket state (parallel/zero.py) is saved as its per-param
+  views and REPACKED for the restoring program's own dp width by
+  `executor._ensure_zero_state` on the first post-restore dispatch
+  (`zero.adopt_unsharded_state`), so sharded optimizer/gradient/parameter
+  storage survives a train-on-N / resume-on-M resize bit-for-bit. A dp
+  the 64-element bucket padding does not divide takes the full-width
+  replicated fallback, counted under `executor.zero_manual_fallbacks`.
+
+Saves go through `resilience.CheckpointManager` (checksummed manifest +
+atomic publish): a SIGKILL past the grace window mid-final-save leaves only
+a `.tmp` dir and restore falls back to the last complete checkpoint.
+Tests: tests/test_elastic.py; drill: scripts/chaos_smoke.py
+--preemption-drill.
 """
 from __future__ import annotations
 
-import os
 import signal
 import threading
-from typing import Iterator, Optional
+from typing import Iterator
 
 from ..framework.program import default_main_program
 from ..framework.scope import global_scope
-from .checkpoint import CheckpointSaver, _collect_state
+from .checkpoint import CheckpointSaver, _collect_state, load_state
 
 
 class PreemptionGuard:
@@ -38,6 +51,11 @@ class PreemptionGuard:
     the CURRENT step finishes, a final checkpoint is written, and steps()
     raises SystemExit(143) so the process exits before the hard kill.
     Restart with the same directory resumes after the last completed step.
+
+    The guard also works as a context manager; leaving the `with` block
+    (or calling `uninstall()`) restores whatever SIGTERM/SIGUSR1 handlers
+    were installed before it, so guards never leak handlers across
+    trainers or tests.
     """
 
     _SIGNALS = (signal.SIGTERM, signal.SIGUSR1)
@@ -62,20 +80,39 @@ class PreemptionGuard:
         if callable(prev):
             prev(signum, frame)
 
+    def uninstall(self) -> None:
+        """Restore the SIGTERM/SIGUSR1 handlers that were active before
+        this guard installed its own. Idempotent; a no-op off the main
+        thread (where nothing was installed)."""
+        for sig, prev in list(self._prev.items()):
+            try:
+                if signal.getsignal(sig) == self._on_signal:
+                    signal.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+            self._prev.pop(sig, None)
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.uninstall()
+        return False
+
     # -- checkpoint plumbing -------------------------------------------------
     def checkpoint_now(self, step: int) -> int:
         program = self.program or default_main_program()
         return self.saver.save(_collect_state(program), {"step": step})
 
     def restore(self) -> int:
-        """Load the newest checkpoint into the global scope; returns the
+        """Load the newest COMPLETE checkpoint into the global scope (torn
+        mid-save checkpoints fall back to the previous one); returns the
         next step to run (0 if none)."""
         path, meta = self.saver.latest()
         if path is None:
             return 0
-        from ..native.ckptio import load_tensors
         scope = global_scope()
-        for name, arr in load_tensors(path).items():
+        for name, arr in load_state(path).items():
             scope.set(name, arr)
         return int(meta["step"]) + 1
 

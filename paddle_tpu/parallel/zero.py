@@ -114,7 +114,8 @@ def count_fallback(cause: str) -> None:
     `executor.zero_manual_fallbacks` plus a `.<cause>` breakdown — a silent
     fallback to GSPMD is diagnosable from monitor stats alone. Causes:
     mixed_mesh, batch_norm, selected_rows, pipeline, grad_merge, localsgd,
-    ps_hooks, indivisible_batch, plan_failure, unsupported_rule."""
+    ps_hooks, indivisible_batch, indivisible_padding, bucketing_disabled,
+    plan_failure, unsupported_rule."""
     from .. import monitor
     monitor.stat_add("executor.zero_manual_fallbacks")
     monitor.stat_add(f"executor.zero_manual_fallbacks.{cause}")
@@ -968,7 +969,16 @@ def adopt_unsharded_state(program, scope) -> None:
     adoption). Stage 3 additionally adopts the PARAMETERS themselves —
     per-param (or restacked `@LAYERS`) scope entries only exist right
     after an unsharded checkpoint load, never from training (the program
-    writes only the flat storage)."""
+    writes only the flat storage).
+
+    This adoption IS the elastic dp-resize resume path (train on N ranks,
+    resume on M): the flat layouts are mesh-independent by construction
+    ([padded-to-64] and [L, padded]), so a checkpoint written under ANY dp
+    width packs into byte-identical flat arrays here, and the executor's
+    in_shardings re-shard them for the restoring mesh on the first
+    dispatch — or replicate them when the new width does not divide the
+    padding (the full-width fallback, counted under
+    `executor.zero_manual_fallbacks.indivisible_padding`)."""
     buckets = getattr(program, "_zero_buckets", None)
     if not buckets:
         return
@@ -1188,9 +1198,15 @@ def plan_manual_dp(program, dist, mesh, block, fn, feed_meta, state_meta,
         return None              # nothing sharded: manual buys nothing
 
     flat_state = dict(getattr(program, "_zero_state_specs", None) or {})
-    zero_divides = all(
-        (b["padded"] % dp) == 0
-        for b in getattr(program, "_zero_buckets", None) or [])
+    zero_buckets = getattr(program, "_zero_buckets", None) or []
+    zero_divides = all((b["padded"] % dp) == 0 for b in zero_buckets)
+    if zero_buckets and not zero_divides:
+        # a dp width the 64-element bucket padding does not divide — the
+        # elastic-resume case of resuming onto an odd-sized slice: flat
+        # state stays replicated and __zero_update__ runs full-width
+        # (still averaging the grads), correct but unsharded, so count it
+        # like every other structural decline
+        count_fallback("indivisible_padding")
 
     def state_spec(name):
         ax = flat_state.get(name)

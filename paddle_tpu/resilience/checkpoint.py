@@ -47,13 +47,19 @@ def sha256_file(path: str, chunk: int = 1 << 20) -> str:
 
 
 def write_manifest(dirname: str, step: int, filenames: Sequence[str],
-                   manifest_name: str = MANIFEST):
+                   manifest_name: str = MANIFEST,
+                   meta: Optional[dict] = None):
     files = {}
     for name in filenames:
         p = os.path.join(dirname, name)
         files[name] = {"sha256": sha256_file(p),
                        "bytes": os.path.getsize(p)}
     payload = {"format": 1, "step": int(step), "files": files}
+    if meta:
+        # caller metadata (epoch counters, world size at save time, ...) —
+        # rides inside the checksummed manifest so it is published
+        # atomically with the data it describes
+        payload["meta"] = dict(meta)
     tmp = os.path.join(dirname, manifest_name + ".tmp")
     with open(tmp, "w") as f:
         json.dump(payload, f, indent=1)
@@ -84,15 +90,16 @@ def validate_manifest(dirname: str,
 
 
 def _collect_persistables(program=None, scope=None) -> Dict[str, np.ndarray]:
+    """Checkpoint payload: `io._portable_arrays` (the ONE collector —
+    persistable scope values with ZeRO flat buckets split back into their
+    per-param views), so every checkpoint is the PORTABLE unsharded format:
+    loadable by a replicated program directly and repacked on load by a
+    ZeRO program of ANY dp width (elastic train-on-N / resume-on-M)."""
     from ..framework.program import default_main_program
     from ..framework.scope import global_scope
-    program = program or default_main_program()
-    scope = scope or global_scope()
-    out = {}
-    for v in program.list_vars():
-        if v.persistable and scope.has(v.name):
-            out[v.name] = np.asarray(scope.find(v.name))
-    return out
+    from ..io import _portable_arrays
+    return _portable_arrays(program or default_main_program(),
+                            scope or global_scope())
 
 
 class CheckpointManager:
@@ -128,7 +135,8 @@ class CheckpointManager:
     # -- save ---------------------------------------------------------------
     def save(self, step: int, arrays: Optional[Dict[str, np.ndarray]] = None,
              program=None, scope=None, sparse_client=None,
-             sparse_tables: Sequence[int] = ()) -> str:
+             sparse_tables: Sequence[int] = (),
+             meta: Optional[dict] = None) -> str:
         """Write checkpoint `step`. Order of operations is the crash-safety
         contract: data files -> fault_point('ckpt.write') -> manifest ->
         atomic publish. A crash anywhere before the final os.replace leaves
@@ -152,7 +160,7 @@ class CheckpointManager:
             else:
                 names.append(name)
         fault_point("ckpt.write")
-        write_manifest(tmp, step, names)
+        write_manifest(tmp, step, names, meta=meta)
         old = None
         if os.path.exists(final):      # re-save of the same step: move the
             old = final + f".old.{os.getpid()}"   # published dir aside
@@ -188,6 +196,22 @@ class CheckpointManager:
             shutil.rmtree(os.path.join(self.root, d), ignore_errors=True)
 
     # -- restore ------------------------------------------------------------
+    def latest_valid(self):
+        """(step, manifest payload) of the newest VALID checkpoint, skipping
+        torn ones (counted in `resilience.ckpt_fallbacks`), or (None, None).
+        The payload carries any `meta` dict recorded at save time."""
+        for step in reversed(self.steps()):
+            payload = validate_manifest(self.path(step))
+            if payload is None:
+                # only a dir the manager itself published can be TORN: a
+                # dir with no manifest at all is a legacy (pre-manager)
+                # checkpoint, skipped without polluting the torn-save stat
+                if os.path.exists(os.path.join(self.path(step), MANIFEST)):
+                    stat_add("resilience.ckpt_fallbacks")
+                continue
+            return step, payload
+        return None, None
+
     def load_arrays(self, step: int) -> Dict[str, np.ndarray]:
         with np.load(os.path.join(self.path(step), PARAMS_FILE)) as data:
             return {n: data[n] for n in data.files}
@@ -200,16 +224,13 @@ class CheckpointManager:
         or None when no complete checkpoint exists."""
         from ..framework.scope import global_scope
         scope = scope or global_scope()
-        for step in reversed(self.steps()):
-            payload = validate_manifest(self.path(step))
-            if payload is None:
-                stat_add("resilience.ckpt_fallbacks")
-                continue
-            for n, arr in self.load_arrays(step).items():
-                scope.set(n, arr)
-            for t in sparse_tables:
-                sparse_client.load(
-                    int(t), os.path.join(self.path(step),
-                                         f"table_{int(t)}.bin"))
-            return int(payload.get("step", step))
-        return None
+        step, payload = self.latest_valid()
+        if step is None:
+            return None
+        for n, arr in self.load_arrays(step).items():
+            scope.set(n, arr)
+        for t in sparse_tables:
+            sparse_client.load(
+                int(t), os.path.join(self.path(step),
+                                     f"table_{int(t)}.bin"))
+        return int(payload.get("step", step))

@@ -63,3 +63,94 @@ def virtual_cpu_mesh_ready(n_devices: int) -> bool:
     m = re.search(r"xla_force_host_platform_device_count=(\d+)",
                   os.environ.get("XLA_FLAGS", ""))
     return m is not None and int(m.group(1)) >= n_devices
+
+# --- ZeRO dp-resize oracle harness ---------------------------------------
+# One canonical copy of the train-on-N / resume-on-M drill, consumed (in
+# cpu_mesh_env subprocesses) by BOTH tests/test_elastic.py and
+# scripts/chaos_smoke.py --preemption-drill — the CI drill and the tier-1
+# test must exercise the SAME arms or they drift apart silently.
+
+def zero_resize_attach(prog, dp) -> None:
+    """Attach a dp-wide mesh + the program's ZeRO state specs."""
+    import jax
+    from paddle_tpu.parallel import attach, DistConfig, build_mesh
+    attach(prog, DistConfig(
+        mesh=build_mesh(dp=dp, devices=jax.devices()[:dp]),
+        state_specs=dict(getattr(prog, "_zero_state_specs", None) or {})))
+
+
+def zero_resize_flat_build(dp, stage):
+    """The flat (unrolled) resize model: 8->32(tanh)->1 fc regression,
+    Adam, tiny buckets so every stage produces several. Returns
+    (exe, prog, loss, feed)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.distributed import fleet
+
+    reset_programs(0)
+    x = layers.data(name="x", shape=[8], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    h = layers.fc(x, 32, act="tanh")
+    pred = layers.fc(h, 1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    fleet.init(is_collective=True)
+    s = fleet.DistributedStrategy()
+    if stage:
+        s.sharding_stage = stage
+    s.fuse_grad_size_in_mb = 0.001        # force several tiny buckets
+    fleet.distributed_optimizer(
+        paddle.optimizer.Adam(learning_rate=1e-2), s).minimize(loss)
+    prog = fluid.default_main_program()
+    zero_resize_attach(prog, dp)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+
+    def feed(step):
+        rng = np.random.RandomState(100 + step)
+        xv = rng.randn(8, 8).astype(np.float32)
+        return {"x": xv, "y": xv.sum(1, keepdims=True).astype(np.float32)}
+
+    return exe, prog, loss, feed
+
+
+def zero_resize_case(build, stage, dp_from=4, dp_to=2, workdir=None,
+                     steps=3) -> dict:
+    """Three arms: train dp_from under ZeRO `stage` -> portable checkpoint
+    -> resume dp_to ZeRO (the flat-bucket repack under test) vs resume
+    dp_to REPLICATED from the SAME checkpoint (the oracle). Returns
+    {losses_equal, mismatched, l_zero, l_repl}; bit-for-bit means
+    losses_equal and an empty mismatched list."""
+    import tempfile
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.io import _portable_arrays
+    from paddle_tpu.resilience import CheckpointManager
+
+    workdir = workdir or tempfile.mkdtemp(prefix="resize_")
+
+    def arm(dp, arm_stage, resume, n):
+        exe, prog, loss, feed = build(dp, arm_stage)
+        mgr = CheckpointManager(workdir, max_keep=2)
+        start = 0
+        if resume:
+            restored = mgr.restore_latest()
+            assert restored is not None, "no checkpoint to resume"
+            start = restored + 1
+        losses = []
+        for step in range(start, start + n):
+            out, = exe.run(feed=feed(step), fetch_list=[loss])
+            losses.append(repr(float(np.asarray(out).ravel()[0])))
+        return losses, _portable_arrays(prog, paddle.global_scope()), prog
+
+    _, _, prog = arm(dp_from, stage, False, steps)
+    CheckpointManager(workdir, max_keep=2).save(
+        steps - 1, program=prog, scope=paddle.global_scope())
+    l_zero, p_zero, _ = arm(dp_to, stage, True, steps)
+    l_repl, p_repl, _ = arm(dp_to, 0, True, steps)
+    mismatched = sorted(set(p_zero) ^ set(p_repl)) + [
+        k for k in sorted(set(p_zero) & set(p_repl))
+        if not np.array_equal(p_zero[k], p_repl[k])]
+    return {"losses_equal": l_zero == l_repl, "mismatched": mismatched,
+            "l_zero": l_zero, "l_repl": l_repl}

@@ -19,8 +19,23 @@ invariant, not a tolerance check. This harness runs three legs on CPU:
 and asserts leg-3 final state equals leg-1 bit-for-bit (np.array_equal,
 no rtol). Exit 0 on parity, 1 on divergence — cheap enough for CI.
 
+`--preemption-drill` runs the POD-PREEMPTION drill instead (docs/
+resilience.md "Elasticity & preemption"; wired into scripts/ci.py as an
+overlapped subprocess, skippable with --no-preemption-drill):
+
+  A. SIGTERM mid-step: a trainer subprocess under
+     `incubate.elastic.PreemptionGuard` is SIGTERM'd mid-step (SIGKILL'd
+     past --grace-s, exercising the torn-save fallback), restarted, and
+     must finish with final state BIT-FOR-BIT equal to an uninterrupted
+     run of the same schedule.
+  B. dp-resize through ZeRO: train dp=4 with sharded state
+     (--zero-stage), checkpoint portable-unsharded, resume dp=2 ZeRO —
+     the repacked-flat-bucket path — and assert losses + final state
+     bit-identical to a replicated dp=2 resume from the SAME checkpoint.
+
 Usage: python scripts/chaos_smoke.py [--steps 50] [--seed 7]
        [--pull-error-p 0.25] [--ckpt-every 10] [--crash-at-save 2]
+       [--preemption-drill] [--zero-stage 3] [--grace-s 30]
 """
 from __future__ import annotations
 
@@ -122,6 +137,161 @@ def run_leg(args, ckpt_root=None, fault_spec="", resume=False):
         srv.stop()
 
 
+# --- preemption drill --------------------------------------------------
+# Trainer child for leg A: a deterministic Adam MLP under PreemptionGuard.
+# argv: ckpt_dir out_npz total_steps save_interval
+# Prints "STEP n <loss>" per step (the parent times its SIGTERM off these)
+# and dumps the final portable persistable state to out_npz on completion.
+_TRAINER = r'''
+import sys, time
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.incubate.checkpoint import _collect_state
+from paddle_tpu.incubate.elastic import PreemptionGuard
+
+ckpt, out, total, save_interval = (
+    sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4]))
+x = layers.data(name="x", shape=[8], dtype="float32")
+y = layers.data(name="y", shape=[1], dtype="float32")
+h = layers.fc(x, 16, act="tanh")
+pred = layers.fc(h, 1)
+loss = layers.mean(layers.square_error_cost(pred, y))
+paddle.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+exe = fluid.Executor()
+exe.run(fluid.default_startup_program())
+
+
+def batch(step):
+    rng = np.random.RandomState(1000 + step)
+    xv = rng.randn(8, 8).astype(np.float32)
+    return {"x": xv, "y": xv.sum(1, keepdims=True).astype(np.float32)}
+
+
+g = PreemptionGuard(ckpt)
+for step in g.steps(total, save_interval=save_interval):
+    out_v, = exe.run(feed=batch(step), fetch_list=[loss])
+    print("STEP", step, repr(float(np.asarray(out_v).ravel()[0])),
+          flush=True)
+    time.sleep(0.1)         # widen the mid-step window for the drill
+np.savez(out, **_collect_state(fluid.default_main_program()))
+print("DONE", flush=True)
+'''
+
+# Child for leg B: all three arms of the dp-resize drill in ONE process
+# (the 4-device CPU mesh covers both widths via devices()[:dp]). The arms
+# themselves are the SHARED paddle_tpu.testing harness — the same one
+# tests/test_elastic.py drives, so the CI drill and the tier-1 test cannot
+# drift apart. argv: workdir zero_stage
+_RESIZER = r'''
+import sys
+from paddle_tpu.testing import zero_resize_case, zero_resize_flat_build
+
+workdir, stage = sys.argv[1], int(sys.argv[2])
+r = zero_resize_case(zero_resize_flat_build, stage, workdir=workdir)
+if not r["losses_equal"]:
+    print("LOSSES DIVERGED", r["l_zero"], r["l_repl"])
+if r["mismatched"]:
+    print("STATE DIVERGED", r["mismatched"])
+ok = r["losses_equal"] and not r["mismatched"]
+print("RESIZE", "PASS" if ok else "FAIL", flush=True)
+sys.exit(0 if ok else 1)
+'''
+
+
+def _drill_env():
+    from paddle_tpu.testing import cpu_mesh_env
+    return cpu_mesh_env(4)
+
+
+def _load_npz(path):
+    with np.load(path) as data:
+        return {n: data[n] for n in data.files}
+
+
+def preemption_drill(args) -> bool:
+    """Leg A: SIGTERM mid-step -> restart -> bit-for-bit parity."""
+    import signal
+    import subprocess
+    env = _drill_env()
+    work = tempfile.mkdtemp(prefix="preempt_drill_")
+    total, save_interval = args.steps, 2
+
+    def trainer(ckpt, out):
+        return [sys.executable, "-c", _TRAINER, ckpt, out,
+                str(total), str(save_interval)]
+
+    print(f"[preempt-drill] uninterrupted arm: {total} steps")
+    a_npz = os.path.join(work, "a.npz")
+    r = subprocess.run(trainer(os.path.join(work, "ck_a"), a_npz),
+                       env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    print("[preempt-drill] preempted arm: SIGTERM mid-step "
+          f"(SIGKILL past {args.grace_s:.0f}s grace)")
+    b_npz = os.path.join(work, "b.npz")
+    ckpt_b = os.path.join(work, "ck_b")
+    proc = subprocess.Popen(trainer(ckpt_b, b_npz), env=env,
+                            stdout=subprocess.PIPE, text=True)
+    for line in proc.stdout:
+        if line.startswith("STEP 3"):       # mid-run: step 3 of `total`
+            break
+    proc.send_signal(signal.SIGTERM)
+    killed = False
+    try:
+        proc.communicate(timeout=args.grace_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()                  # past the grace window: hard kill;
+        proc.communicate()           # restore falls back past the torn save
+        killed = True
+    print(f"[preempt-drill] trainer exited rc={proc.returncode}"
+          + (" (SIGKILL past grace)" if killed else " (clean 143)"))
+
+    r = subprocess.run(trainer(ckpt_b, b_npz), env=env,
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    first = next((ln for ln in r.stdout.splitlines()
+                  if ln.startswith("STEP")), "")
+    resumed_at = int(first.split()[1]) if first else -1
+    assert 0 < resumed_at < total, \
+        f"resume did not skip completed steps (first={first!r})"
+    print(f"[preempt-drill] resumed at step {resumed_at}, "
+          f"ran through step {total - 1}")
+
+    a, b = _load_npz(a_npz), _load_npz(b_npz)
+    ok = set(a) == set(b)
+    if not ok:
+        print(f"[preempt-drill] FAIL: state keys differ "
+              f"{sorted(set(a) ^ set(b))}")
+    for n in sorted(set(a) & set(b)):
+        if not np.array_equal(a[n], b[n]):
+            print(f"[preempt-drill] FAIL: {n} diverged "
+                  f"(max abs diff {np.abs(a[n] - b[n]).max()})")
+            ok = False
+    shutil.rmtree(work, ignore_errors=True)
+    print("[preempt-drill] PASS: preempted+resumed state matches the "
+          "uninterrupted run bit-for-bit" if ok
+          else "[preempt-drill] FAIL")
+    return ok
+
+
+def dp_resize_drill(args) -> bool:
+    """Leg B: dp=4 ZeRO -> checkpoint -> dp=2 resume, ZeRO vs replicated."""
+    import subprocess
+    work = tempfile.mkdtemp(prefix="resize_drill_")
+    print(f"[resize-drill] dp=4 -> dp=2 through ZeRO stage "
+          f"{args.zero_stage} (oracle: replicated dp=2 resume)")
+    r = subprocess.run(
+        [sys.executable, "-c", _RESIZER, work, str(args.zero_stage)],
+        env=_drill_env(), capture_output=True, text=True, timeout=900)
+    for line in r.stdout.splitlines():
+        print(f"[resize-drill] {line}")
+    if r.returncode != 0 and "RESIZE" not in r.stdout:
+        print(f"[resize-drill] FAIL rc={r.returncode}\n{r.stderr[-2000:]}")
+    return r.returncode == 0
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="PS chaos smoke: seeded fault plan, bit-for-bit parity")
@@ -140,8 +310,29 @@ def main():
                     help="inject a crash during the N-th checkpoint save")
     ap.add_argument("--workdir", default=None,
                     help="checkpoint dir (default: fresh temp dir)")
+    ap.add_argument("--preemption-drill", action="store_true",
+                    help="run the pod-preemption drill (SIGTERM mid-step "
+                         "parity + ZeRO dp-resize resume) instead of the "
+                         "PS chaos legs")
+    ap.add_argument("--zero-stage", type=int, default=3,
+                    help="ZeRO sharding stage for the dp-resize leg "
+                         "(1|2|3, default 3: params+grads+optimizer "
+                         "state all sharded)")
+    ap.add_argument("--grace-s", type=float, default=30.0,
+                    help="SIGTERM-to-SIGKILL grace for the preempted "
+                         "trainer (past it, restore must fall back over "
+                         "the torn save)")
     args = ap.parse_args()
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    if args.preemption_drill:
+        if args.steps == 50:
+            args.steps = 8      # drill default: 8 deterministic steps/arm
+        ok = preemption_drill(args)
+        ok = dp_resize_drill(args) and ok
+        print("[chaos_smoke] preemption drill "
+              + ("PASS" if ok else "FAIL"))
+        return 0 if ok else 1
 
     from paddle_tpu import monitor
 

@@ -34,7 +34,8 @@ WEIGHTS = {
     "test_sequence_rnn.py": 50, "test_dygraph.py": 45,
     "test_distributed.py": 45, "test_ps_kvstore.py": 45,
     "test_dense_tail_ops.py": 40, "test_flash_attention.py": 40,
-    "test_detection_assign_ops.py": 40, "test_elastic.py": 40,
+    "test_detection_assign_ops.py": 40, "test_elastic.py": 55,
+    "test_launch.py": 10,
     "test_strategies.py": 35, "test_collective_budget.py": 90,
     "test_lod_ops.py": 30, "test_heter_ps.py": 30,
     "test_federated.py": 25, "test_tail_ops.py": 35, "test_dy2static.py": 25,
@@ -159,6 +160,36 @@ def collect_collective_audit(proc, timeout=1500) -> bool:
     return proc.returncode == 0
 
 
+# Preemption drill (ISSUE-7 CI satellite): scripts/chaos_smoke.py
+# --preemption-drill — SIGTERM-mid-step restart parity plus the ZeRO
+# dp=4 -> dp=2 resharded resume, both bit-for-bit (docs/resilience.md
+# "Elasticity & preemption"). Overlapped with the shards like the
+# collective audit.
+def start_preemption_drill(env):
+    script = os.path.join(ROOT, "scripts", "chaos_smoke.py")
+    return subprocess.Popen(
+        [sys.executable, script, "--preemption-drill"],
+        cwd=ROOT, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+
+
+def collect_preemption_drill(proc, timeout=1500) -> bool:
+    try:
+        out_s, err_s = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        print(f"[preemption-drill] FAIL timed out after {timeout}s")
+        return False
+    lines = (out_s or "").strip().splitlines()
+    status = "OK " if proc.returncode == 0 else "FAIL"
+    body = "\n".join("    " + ln for ln in lines)
+    tail = (err_s or "").strip().splitlines()[-5:]
+    print(f"[preemption-drill] {status}\n{body}" + (
+        "\n" + "\n".join(tail) if proc.returncode != 0 else ""))
+    return proc.returncode == 0
+
+
 def shard(files, n):
     """LPT bin packing by weight."""
     bins = [(0.0, []) for _ in range(n)]
@@ -183,6 +214,9 @@ def main():
     ap.add_argument("--no-zero-rows", action="store_true",
                     help="keep the collective audit but drop its ZeRO "
                          "stage-2/3 + overlap rows (2 extra compiles)")
+    ap.add_argument("--no-preemption-drill", action="store_true",
+                    help="skip the preemption drill "
+                         "(scripts/chaos_smoke.py --preemption-drill)")
     ap.add_argument("rest", nargs="*", help="extra pytest args")
     args = ap.parse_args()
 
@@ -198,6 +232,9 @@ def main():
     if not args.no_collective_audit:
         audit_proc = start_collective_audit(       # overlaps the shards too
             env, skip_zero_rows=args.no_zero_rows)
+    drill_proc = None
+    if not args.no_preemption_drill:
+        drill_proc = start_preemption_drill(env)   # overlaps the shards too
 
     files = sorted(glob.glob(os.path.join(ROOT, "tests", "test_*.py")))
     shards = shard(files, args.n)
@@ -243,6 +280,8 @@ def main():
         failed = failed or not collect_host_stall(stall_proc)
     if audit_proc is not None:
         failed = failed or not collect_collective_audit(audit_proc)
+    if drill_proc is not None:
+        failed = failed or not collect_preemption_drill(drill_proc)
     print(f"CI total: {time.time() - t0:.0f}s over {len(shards)} shards -> "
           f"{'FAILED' if failed else 'PASSED'}")
     return 1 if failed else 0

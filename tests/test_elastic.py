@@ -1,6 +1,13 @@
 """Preemption guard + elastic (mesh-resize) resume
 (reference auto_checkpoint tests: test_auto_checkpoint.py; slice-resize is
-TPU-native — SURVEY §5 failure-detection row)."""
+TPU-native — SURVEY §5 failure-detection row).
+
+The ZeRO-aware half (docs/resilience.md "Elasticity & preemption"): a
+checkpoint written under dp=N sharded state must resume under dp=M with
+bit-for-bit parity against a replicated resume from the SAME checkpoint —
+the flat-bucket repack of `zero.adopt_unsharded_state` is the unit under
+test, driven through subprocesses on a 4-device CPU mesh."""
+import json
 import os
 import signal
 import subprocess
@@ -164,3 +171,366 @@ def test_resume_on_smaller_mesh(tmp_path):
     shutil.rmtree(ckpt)
     straight = run(dp=1, n_done=100, total=12, n_devices=1)
     np.testing.assert_allclose(straight[6:], second, rtol=1e-4, atol=1e-6)
+
+
+# --- ZeRO-aware dp-resize resume -----------------------------------------
+# One subprocess, three arms per configuration (the
+# test_collective_budget.py pattern): train dp=4 ZeRO -> portable
+# checkpoint -> resume dp=2 ZeRO (the flat-bucket repack under test) vs
+# resume dp=2 REPLICATED from the same checkpoint (the oracle). Bit-for-bit
+# on losses AND every portable persistable.
+
+_RESIZE_COMMON = """
+import json
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed import fleet
+from paddle_tpu.models import bert
+from paddle_tpu.testing import (reset_programs, zero_resize_attach,
+                                zero_resize_case,
+                                zero_resize_flat_build as build_flat)
+
+
+def build_rolled(dp, stage):
+    reset_programs(0)
+    cfg = bert.BertConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                          num_heads=2, intermediate_size=64,
+                          max_position=32, seq_len=16, hidden_dropout=0.0,
+                          attention_dropout=0.0)
+    ids, labels, loss = bert.build_pretrain_program(cfg)
+    fleet.init(is_collective=True)
+    s = fleet.DistributedStrategy()
+    s.layer_scan = True                   # @LAYERS [L, padded] shards
+    if stage:
+        s.sharding_stage = stage
+    s.fuse_grad_size_in_mb = 0.05
+    fleet.distributed_optimizer(
+        paddle.optimizer.Adam(learning_rate=1e-3), s).minimize(loss)
+    prog = fluid.default_main_program()
+    zero_resize_attach(prog, dp)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+
+    def feed(step):
+        rng = np.random.RandomState(200 + step)
+        return {"input_ids":
+                    rng.randint(0, 64, (8, 16)).astype(np.int64),
+                "mlm_labels":
+                    rng.randint(0, 64, (8, 16, 1)).astype(np.int64)}
+
+    return exe, prog, loss, feed
+
+
+resize_case = zero_resize_case
+"""
+
+
+def _run_resize(code: str, n_devices=4, timeout=900) -> dict:
+    r = subprocess.run([sys.executable, "-c",
+                        _RESIZE_COMMON + textwrap.dedent(code)],
+                       env=cpu_mesh_env(n_devices), capture_output=True,
+                       text=True, timeout=timeout)
+    assert r.returncode == 0, f"subprocess failed:\n{r.stdout}\n{r.stderr}"
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def test_zero_dp_resize_resume_stages():
+    """dp=4 -> dp=2 resume through ZeRO stages 1/2/3 (flat buckets) plus
+    the stage-3 x rolled-@LAYERS composition ([L, padded] trailing-axis
+    shards), each bit-identical to a replicated dp=2 resume from the SAME
+    portable checkpoint."""
+    out = _run_resize("""
+res = {}
+for stage in (1, 2, 3):
+    res[f"flat{stage}"] = resize_case(build_flat, stage)
+res["rolled3"] = resize_case(build_rolled, 3)
+print(json.dumps(res))
+""")
+    for case, r in out.items():
+        assert r["losses_equal"], (case, r["l_zero"], r["l_repl"])
+        assert r["mismatched"] == [], (case, r["mismatched"])
+
+
+@pytest.mark.slow
+def test_zero_dp_resize_resume_sweeps():
+    """Heavier resize matrix: rolled stages 1/2, a dp=4 -> dp=3 resume
+    whose width does not divide the 64-element bucket padding (must take
+    the full-width replicated fallback and STILL match), and a grow
+    (dp=2 -> dp=4) through stage 3."""
+    out = _run_resize("""
+res = {"rolled1": resize_case(build_rolled, 1),
+       "rolled2": resize_case(build_rolled, 2),
+       "flat3_to_dp3": resize_case(build_flat, 3, dp_from=4, dp_to=3),
+       "flat3_grow": resize_case(build_flat, 3, dp_from=2, dp_to=4)}
+print(json.dumps(res))
+""")
+    for case, r in out.items():
+        assert r["losses_equal"], (case, r["l_zero"], r["l_repl"])
+        assert r["mismatched"] == [], (case, r["mismatched"])
+
+
+# --- PreemptionGuard handler hygiene -------------------------------------
+
+def test_preemption_guard_uninstall_restores_handlers(tmp_path):
+    """uninstall() (and the context-manager form) must restore the
+    previous SIGTERM/SIGUSR1 handlers — a guard may never leak its handler
+    past its trainer's lifetime."""
+    def custom(signum, frame):
+        pass
+
+    prev_term = signal.signal(signal.SIGTERM, custom)
+    prev_usr1 = signal.getsignal(signal.SIGUSR1)
+    try:
+        with PreemptionGuard(str(tmp_path), exit_on_preempt=False) as g:
+            assert signal.getsignal(signal.SIGTERM) == g._on_signal
+            assert signal.getsignal(signal.SIGUSR1) == g._on_signal
+        assert signal.getsignal(signal.SIGTERM) is custom
+        assert signal.getsignal(signal.SIGUSR1) == prev_usr1
+        g.uninstall()                       # idempotent
+        assert signal.getsignal(signal.SIGTERM) is custom
+
+        # a handler someone installed OVER the guard's must survive the
+        # guard's uninstall (restore only what is still ours)
+        g2 = PreemptionGuard(str(tmp_path), exit_on_preempt=False)
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        g2.uninstall()
+        assert signal.getsignal(signal.SIGTERM) == signal.SIG_IGN
+        assert signal.getsignal(signal.SIGUSR1) == prev_usr1
+    finally:
+        signal.signal(signal.SIGTERM, prev_term)
+        signal.signal(signal.SIGUSR1, prev_usr1)
+
+
+def test_preemption_guard_chains_previous_handler(tmp_path):
+    """A surviving pre-existing handler still fires through the guard's."""
+    hits = []
+    prev = signal.signal(signal.SIGUSR1, lambda s, f: hits.append(s))
+    try:
+        with PreemptionGuard(str(tmp_path), exit_on_preempt=False) as g:
+            os.kill(os.getpid(), signal.SIGUSR1)
+            deadline = time.time() + 5
+            while not hits and time.time() < deadline:
+                time.sleep(0.01)
+            assert g.preempted.is_set()
+            assert hits == [signal.SIGUSR1]
+    finally:
+        signal.signal(signal.SIGUSR1, prev)
+
+
+# --- crash-safe saves on the preemption path ------------------------------
+
+def test_saver_torn_latest_falls_back(tmp_path):
+    """A kill landing mid-final-save may tear the newest checkpoint; the
+    incubate CheckpointSaver (now CheckpointManager-backed) must fall back
+    to the previous COMPLETE one instead of serving torn state."""
+    from paddle_tpu.incubate.checkpoint import CheckpointSaver, load_state
+    s = CheckpointSaver(str(tmp_path), max_num=3)
+    good = np.arange(4, dtype=np.float32)
+    assert s.save({"w": good}, {"step": 3}) == 3
+    assert s.save({"w": np.full(4, 9.0, np.float32)}, {"step": 6}) == 6
+    path, meta = s.latest()
+    assert meta["step"] == 6
+    # tear the published step-6 data: checksum validation must reject it
+    with open(path, "r+b") as f:
+        f.write(b"torn bytes")
+    path2, meta2 = s.latest()
+    assert meta2["step"] == 3, meta2
+    np.testing.assert_array_equal(load_state(path2)["w"], good)
+
+    # a mid-save SIGKILL leaves only an unpublished tmp dir: ignored
+    os.makedirs(os.path.join(str(tmp_path), "ckpt_9.tmp.12345"))
+    _, meta3 = s.latest()
+    assert meta3["step"] == 3
+
+
+def test_guard_restore_skips_torn_checkpoint(tmp_path):
+    """End-to-end on PreemptionGuard: restore() must resume from the last
+    complete checkpoint when the newest one is torn."""
+    g = PreemptionGuard(str(tmp_path), exit_on_preempt=False)
+    try:
+        from paddle_tpu.framework import scope as sm
+        sm._reset_global_scope()
+        loss = _build_quadratic()
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        exe.run(fetch_list=[loss])
+        g.checkpoint_now(4)
+        w4 = np.asarray(fluid.global_scope().find("w")).copy()
+        exe.run(fetch_list=[loss])
+        g.checkpoint_now(9)
+        path, _ = g.saver.latest()
+        with open(path, "r+b") as f:
+            f.write(b"torn bytes")
+        sm._reset_global_scope()
+        assert g.restore() == 5        # step-9 save is torn -> resume at 5
+        np.testing.assert_array_equal(
+            np.asarray(fluid.global_scope().find("w")), w4)
+    finally:
+        g.uninstall()
+
+
+# --- incubate train_epoch_range (reference auto_checkpoint parity) --------
+
+def _epoch_run(n_epochs):
+    """One trainer life: fresh programs/scope, startup init, then the
+    resumable epoch range. Returns (epochs seen, final w)."""
+    from paddle_tpu.incubate.checkpoint import train_epoch_range
+    from paddle_tpu.testing import reset_programs
+    reset_programs(0)
+    loss = _build_quadratic()
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    seen = []
+    for epoch in train_epoch_range(n_epochs):
+        exe.run(fetch_list=[loss])
+        seen.append(epoch)
+    return seen, np.asarray(fluid.global_scope().find("w")).copy()
+
+
+def test_train_epoch_range_resumes_bit_for_bit(tmp_path, monkeypatch):
+    """The epoch loop the reference auto_checkpoint.py wraps: a restart
+    with the same job id resumes AFTER the last completed epoch, a torn
+    newest save falls back one epoch, and the resumed trajectory is
+    bit-identical to an uninterrupted run; without the env contract the
+    range degrades to plain range()."""
+    monkeypatch.setenv("PADDLE_RUNNING_ENV", "LOCAL")
+    monkeypatch.setenv("PADDLE_CHECKPOINT_DIR", str(tmp_path))
+    monkeypatch.setenv("PADDLE_JOB_ID", "job7")
+
+    first, _ = _epoch_run(3)
+    assert first == [0, 1, 2]
+    # "restart": fresh scope + programs; picks up at epoch 3
+    resumed, w_resumed = _epoch_run(5)
+    assert resumed == [3, 4]
+
+    # no env contract -> plain range(); also the 5-epoch oracle
+    monkeypatch.delenv("PADDLE_RUNNING_ENV")
+    straight, w_straight = _epoch_run(5)
+    assert straight == [0, 1, 2, 3, 4]
+    np.testing.assert_array_equal(w_resumed, w_straight)
+    monkeypatch.setenv("PADDLE_RUNNING_ENV", "LOCAL")
+
+    # tear the newest save (epoch 4): the next life must fall back to the
+    # epoch-3 checkpoint and re-run epoch 4, not serve torn state
+    from paddle_tpu.incubate.checkpoint import CheckpointSaver
+    saver = CheckpointSaver(str(tmp_path / "job7"))
+    path, meta = saver.latest()
+    assert meta["epoch"] == 4
+    with open(path, "r+b") as f:
+        f.write(b"torn bytes")
+    resumed2, w2 = _epoch_run(5)
+    assert resumed2 == [4]
+    np.testing.assert_array_equal(w2, w_straight)
+
+
+def test_train_epoch_range_reads_legacy_ptck(tmp_path, monkeypatch):
+    """Pre-CheckpointManager checkpoints (ckpt_<v>/state.ptck + meta.json,
+    the native threaded-IO layout) still resume: CheckpointSaver.latest()
+    falls through manifest validation to the legacy reader."""
+    from paddle_tpu.native.ckptio import save_tensors
+
+    from paddle_tpu import monitor
+    from paddle_tpu.incubate.checkpoint import CheckpointSaver
+
+    legacy = tmp_path / "legacy" / "ckpt_1"
+    os.makedirs(legacy)
+    w_saved = np.full(4, 2.5, np.float32)
+    save_tensors(str(legacy / "state.ptck"), {"w": w_saved})
+    with open(legacy / "meta.json", "w") as f:
+        json.dump({"epoch": 1}, f)
+
+    # an OLDER manager-format save must not shadow the newer legacy dir,
+    # and walking past healthy legacy dirs must not count as a torn-save
+    # fallback (resilience.ckpt_fallbacks is the torn-MANAGER-save stat)
+    saver = CheckpointSaver(str(tmp_path / "legacy"))
+    saver._mgr.save(0, arrays={"w": np.zeros(4, np.float32)},
+                    meta={"epoch": 0})
+    monitor.stat_reset("resilience.ckpt_fallbacks")
+    path, meta = saver.latest()
+    assert path.endswith("state.ptck") and meta["epoch"] == 1
+    assert monitor.stat_get("resilience.ckpt_fallbacks") == 0
+
+    monkeypatch.setenv("PADDLE_RUNNING_ENV", "LOCAL")
+    monkeypatch.setenv("PADDLE_CHECKPOINT_DIR", str(tmp_path))
+    monkeypatch.setenv("PADDLE_JOB_ID", "legacy")
+    from paddle_tpu.incubate.checkpoint import train_epoch_range
+    from paddle_tpu.testing import reset_programs
+    reset_programs(0)
+    loss = _build_quadratic()
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())      # w re-inits to 4.0 ...
+    seen = []
+    for epoch in train_epoch_range(4):
+        if not seen:                              # ... restore overrode it
+            np.testing.assert_array_equal(
+                np.asarray(fluid.global_scope().find("w")), w_saved)
+        exe.run(fetch_list=[loss])
+        seen.append(epoch)
+    assert seen == [2, 3]
+    # the new saves land in the crash-safe manager format and, being
+    # newer, now win the walk
+    _, meta = CheckpointSaver(str(tmp_path / "legacy")).latest()
+    assert meta["epoch"] == 3
+
+
+# --- step-level hang watchdog --------------------------------------------
+
+def test_step_deadline_watchdog_trips():
+    """FLAGS_step_deadline_ms's engine: a call that outlives the deadline
+    raises the typed DeadlineExceededError carrying a thread-stack dump and
+    counts executor.step_deadline_trips; fast calls pass values and
+    exceptions through unchanged."""
+    from paddle_tpu import monitor
+    from paddle_tpu.framework import errors
+    from paddle_tpu.framework.executor import _deadline_call
+    monitor.stat_reset("executor.step_deadline_trips")
+
+    with pytest.raises(errors.DeadlineExceededError) as ei:
+        _deadline_call(lambda: time.sleep(30), 150.0, "unit probe")
+    msg = str(ei.value)
+    assert "unit probe" in msg and "thread stacks" in msg
+    assert "executor-step" in msg          # the wedged thread is in the dump
+    assert monitor.stat_get("executor.step_deadline_trips") == 1
+
+    assert _deadline_call(lambda: 42, 5000.0, "fast") == 42
+
+    def boom():
+        raise ValueError("boom")
+
+    with pytest.raises(ValueError, match="boom"):
+        _deadline_call(boom, 5000.0, "raise")
+    assert monitor.stat_get("executor.step_deadline_trips") == 1
+
+
+def test_step_deadline_passthrough_parity():
+    """With the watchdog armed but not tripping, a training step returns
+    the same value as with it off (the default) — the deadline path must
+    be a pure wrapper."""
+    from paddle_tpu import monitor
+    from paddle_tpu.flags import set_flags
+    from paddle_tpu.framework import scope as sm
+
+    def one_run():
+        sm._reset_global_scope()
+        from paddle_tpu.framework import program as pm
+        from paddle_tpu.framework import unique_name
+        pm._main_program = pm.Program()
+        pm._startup_program = pm.Program()
+        unique_name.switch()
+        loss = _build_quadratic()
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        vals = [float(np.asarray(exe.run(fetch_list=[loss])[0]).ravel()[0])
+                for _ in range(3)]
+        return vals
+
+    monitor.stat_reset("executor.step_deadline_trips")
+    base = one_run()
+    set_flags({"FLAGS_step_deadline_ms": 60000.0})
+    try:
+        armed = one_run()
+    finally:
+        set_flags({"FLAGS_step_deadline_ms": 0.0})
+    assert armed == base
+    assert monitor.stat_get("executor.step_deadline_trips") == 0

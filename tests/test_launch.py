@@ -1,0 +1,191 @@
+"""Supervised gang launcher (distributed/launch.py): env contract,
+deadline-bounded rendezvous, fail-fast sibling kill, bounded elastic
+restart, and stale-heartbeat hang detection.
+
+The worker scripts are plain stdlib python (no jax import), so every test
+here is seconds, not minutes — the supervisor runs IN-PROCESS via
+launch(argv) and the gang members are real subprocesses."""
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np  # noqa: F401  (conftest import parity)
+import pytest
+
+from paddle_tpu.distributed.launch import launch, plan_gang
+
+
+# --- env contract (pure unit) --------------------------------------------
+
+def test_plan_gang_env_contract():
+    """One endpoint PER PROCESS and world-size-true PADDLE_TRAINERS_NUM /
+    JAX_NUM_PROCESSES — the two fields the fire-and-forget launcher got
+    wrong for single-host multi-process gangs."""
+    plans = plan_gang(["10.0.0.1", "10.0.0.2"], 6170, 2)
+    assert len(plans) == 4
+    eps = plans[0]["PADDLE_TRAINER_ENDPOINTS"].split(",")
+    assert eps == ["10.0.0.1:6170", "10.0.0.1:6171",
+                   "10.0.0.2:6170", "10.0.0.2:6171"]
+    for rank, p in enumerate(plans):
+        assert p["PADDLE_TRAINER_ID"] == str(rank)
+        assert p["PADDLE_TRAINERS_NUM"] == "4"
+        assert p["JAX_NUM_PROCESSES"] == "4"
+        assert p["JAX_PROCESS_ID"] == str(rank)
+        assert p["PADDLE_CURRENT_ENDPOINT"] == eps[rank]
+        assert p["PADDLE_TRAINER_ENDPOINTS"] == ",".join(eps)
+    # the jax coordinator port sits ABOVE every trainer endpoint port
+    assert plans[0]["JAX_COORDINATOR_ADDRESS"] == "10.0.0.1:6174"
+
+
+def test_plan_gang_single_host_multi_process():
+    """nnodes==1 with --nproc_per_node=4: 4 endpoints and world size 4
+    (the old code emitted ONE endpoint and JAX_NUM_PROCESSES=1)."""
+    plans = plan_gang(["127.0.0.1"], 6170, 4)
+    assert len(plans) == 4
+    assert len(plans[0]["PADDLE_TRAINER_ENDPOINTS"].split(",")) == 4
+    assert plans[0]["PADDLE_TRAINERS_NUM"] == "4"
+    assert plans[0]["JAX_NUM_PROCESSES"] == "4"
+
+
+def test_plan_gang_elastic_shrink():
+    """world=M < full keeps the FIRST M ranks with an M-wide contract —
+    the elastic-restart relaunch shape."""
+    plans = plan_gang(["127.0.0.1"], 6170, 4, world=3)
+    assert len(plans) == 3
+    assert plans[0]["PADDLE_TRAINERS_NUM"] == "3"
+    assert plans[0]["JAX_NUM_PROCESSES"] == "3"
+    assert len(plans[0]["PADDLE_TRAINER_ENDPOINTS"].split(",")) == 3
+
+
+# --- supervisor behavior (real gangs of stdlib workers) -------------------
+
+def _worker(tmp_path, body: str) -> str:
+    """Write a stdlib-only worker script; `body` sees rank/world/restart."""
+    path = str(tmp_path / "worker.py")
+    with open(path, "w") as f:
+        f.write(
+            "import os, sys, time\n"
+            "rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
+            "world = int(os.environ['PADDLE_TRAINERS_NUM'])\n"
+            "restart = int(os.environ.get('PADDLE_ELASTIC_RESTART', '0'))\n"
+            + body)
+    return path
+
+
+def _launch(argv) -> int:
+    with pytest.raises(SystemExit) as e:
+        launch(argv)
+    return int(e.value.code or 0)
+
+
+def test_rendezvous_straggler_kills_gang_typed(tmp_path, monkeypatch,
+                                               capsys):
+    """A worker that never checks in past FLAGS_rendezvous_deadline_ms
+    fails the whole launch with the typed DeadlineExceededError — never a
+    hang, never a wedged survivor."""
+    script = _worker(tmp_path, "time.sleep(0.2)\n")
+    monkeypatch.setenv("PADDLE_LAUNCH_STALL_RANKS", "1")
+    t0 = time.monotonic()
+    rc = _launch(["--nproc_per_node", "2", "--port", "7301",
+                  "--rendezvous_deadline_ms", "1500",
+                  "--grace_period_s", "1", script])
+    elapsed = time.monotonic() - t0
+    assert rc != 0
+    assert elapsed < 30, elapsed
+    err = capsys.readouterr().err
+    assert "DeadlineExceeded" in err, err
+
+
+def test_fail_fast_sibling_kill(tmp_path):
+    """One worker exiting non-zero must take the gang down within the
+    grace window: the surviving sibling (asleep for 600s) is terminated,
+    not left to wedge in its next collective."""
+    pid_file = str(tmp_path / "sibling.pid")
+    script = _worker(tmp_path, f"""
+if rank == 0:
+    time.sleep(0.3)
+    sys.exit(7)
+with open({pid_file!r}, "w") as f:
+    f.write(str(os.getpid()))
+time.sleep(600)
+""")
+    t0 = time.monotonic()
+    rc = _launch(["--nproc_per_node", "2", "--port", "7311",
+                  "--rendezvous_deadline_ms", "20000",
+                  "--grace_period_s", "1", script])
+    elapsed = time.monotonic() - t0
+    assert rc == 7
+    assert elapsed < 60, elapsed
+    with open(pid_file) as f:
+        sibling = int(f.read())
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            os.kill(sibling, 0)
+        except ProcessLookupError:
+            break                           # sibling is gone: fail-fast held
+        time.sleep(0.05)
+    else:
+        os.kill(sibling, signal.SIGKILL)
+        pytest.fail("sibling survived the fail-fast kill")
+
+
+def test_elastic_restart_at_surviving_world_size(tmp_path):
+    """--elastic_restarts: after a worker loss the gang relaunches at the
+    SURVIVING world size with PADDLE_ELASTIC_RESTART incremented, and a
+    clean second life exits 0."""
+    log = str(tmp_path / "lives.log")
+    script = _worker(tmp_path, f"""
+with open({log!r}, "a") as f:
+    f.write(f"restart={{restart}} world={{world}} rank={{rank}}\\n")
+if world == 2 and rank == 0:
+    sys.exit(3)          # first life: rank 0 dies immediately
+time.sleep(0.3 if world == 1 else 600)
+""")
+    rc = _launch(["--nproc_per_node", "2", "--port", "7321",
+                  "--rendezvous_deadline_ms", "20000",
+                  "--grace_period_s", "1", "--elastic_restarts", "2",
+                  script])
+    assert rc == 0
+    with open(log) as f:
+        lives = f.read().splitlines()
+    assert "restart=0 world=2 rank=0" in lives, lives
+    assert "restart=1 world=1 rank=0" in lives, lives
+
+
+def test_stale_heartbeat_detected_as_hung(tmp_path):
+    """A worker that stops beating (SIGSTOP — the OOM-thrash / wedged-C
+    simulation) is detected via its stale heartbeat file and fails the
+    gang instead of wedging it."""
+    pid_file = str(tmp_path / "victim.pid")
+    script = _worker(tmp_path, f"""
+if rank == 0:
+    with open({pid_file!r}, "w") as f:
+        f.write(str(os.getpid()))
+time.sleep(600)
+""")
+
+    def stopper():
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if os.path.exists(pid_file):
+                with open(pid_file) as f:
+                    txt = f.read()
+                if txt:
+                    os.kill(int(txt), signal.SIGSTOP)
+                    return
+            time.sleep(0.05)
+
+    t = threading.Thread(target=stopper, daemon=True)
+    t.start()
+    t0 = time.monotonic()
+    rc = _launch(["--nproc_per_node", "2", "--port", "7331",
+                  "--rendezvous_deadline_ms", "20000",
+                  "--heartbeat_timeout_ms", "2000",
+                  "--grace_period_s", "1", script])
+    elapsed = time.monotonic() - t0
+    t.join(timeout=5)
+    assert rc != 0
+    assert elapsed < 120, elapsed
