@@ -1129,6 +1129,25 @@ def main():
         rec["tunnel_degraded"] = True
     if errors:
         rec["error"] = "; ".join(errors)
+    try:
+        # every record carries the typed metrics snapshot (compile cache
+        # hits, fetch-sync histogram, fallback counters, ...) so a number
+        # is never divorced from the observability state it ran under —
+        # and a degraded row ships its own flight-recorder timeline, the
+        # black box the r05 wedge postmortem had to reconstruct by hand
+        from paddle_tpu.observability import flight as _obs_flight
+        from paddle_tpu.observability import metrics as _obs_metrics
+        rec["extras"].append({"metric": "observability_metrics_snapshot",
+                              "snapshot": _obs_metrics.snapshot()})
+        if rec.get("tunnel_degraded") or errors:
+            fp = _obs_flight.dump(
+                "bench_degraded",
+                extra={"errors": errors,
+                       "probe_timeouts": list(probe_timeouts)})
+            if fp:
+                rec["flight_dump"] = fp
+    except Exception as e:  # observability must never block the record
+        print(f"metrics stamp failed: {e!r}", file=sys.stderr)
     # ONE parseable JSON line, even on unrecoverable failure
     print(json.dumps(rec))
     sys.exit(0 if tokens_per_sec is not None else 1)

@@ -483,31 +483,37 @@ class _DevicePrefetcher(_Prefetcher):
 
         from ..framework.executor import _coerce_feed_value
         from ..monitor import stat_add
+        from ..observability import trace as _trace
         if not isinstance(item, dict):
             raise TypeError(
                 "DataLoader.prefetch needs feed dicts: construct the "
                 "loader with feed_list= and return_list=False (or yield "
                 "dicts from the generator)")
-        if self._executor is not None:
-            # route through the executor's dispatch queue: the consuming
-            # run() recognizes the yielded dict by identity, skips
-            # re-coercion, and applies the donation-conflict check. The
-            # depth override keeps FIFO consumption safe: up to
-            # buffer-capacity + 1 (in this transform) + 1 (popped by the
-            # consumer but not yet run) windows can be pending at once,
-            # and evicting a pending window would silently disable the
-            # identity match for it (stage()'s default bound serves
-            # MANUAL latest-wins staging, not this pipeline)
-            return self._executor.stage(item, program=self._program,
-                                        depth=self._q.maxsize + 2,
-                                        tag=self._stage_tag)
-        t0 = _time.perf_counter()
-        out = {}
-        for name, value in item.items():
-            v = _coerce_feed_value(self._block, name, value)
-            out[name] = v if isinstance(v, jax.Array) else jax.device_put(v)
-        stat_add("executor.h2d_ms", (_time.perf_counter() - t0) * 1000.0)
-        return out
+        with _trace.RecordEvent("prefetch.fill",
+                                args={"feeds": len(item)}):
+            if self._executor is not None:
+                # route through the executor's dispatch queue: the
+                # consuming run() recognizes the yielded dict by identity,
+                # skips re-coercion, and applies the donation-conflict
+                # check. The depth override keeps FIFO consumption safe:
+                # up to buffer-capacity + 1 (in this transform) + 1
+                # (popped by the consumer but not yet run) windows can be
+                # pending at once, and evicting a pending window would
+                # silently disable the identity match for it (stage()'s
+                # default bound serves MANUAL latest-wins staging, not
+                # this pipeline)
+                return self._executor.stage(item, program=self._program,
+                                            depth=self._q.maxsize + 2,
+                                            tag=self._stage_tag)
+            t0 = _time.perf_counter()
+            out = {}
+            for name, value in item.items():
+                v = _coerce_feed_value(self._block, name, value)
+                out[name] = (v if isinstance(v, jax.Array)
+                             else jax.device_put(v))
+            stat_add("executor.h2d_ms",
+                     (_time.perf_counter() - t0) * 1000.0)
+            return out
 
     def close(self):
         super().close()

@@ -343,6 +343,15 @@ class GangSupervisor:
             status, survivors, rc = self.launch_once(world, restarts)
             if status == "ok":
                 return 0
+            # black-box the failed launch: the supervisor's own timeline
+            # (rendezvous retry instants, heartbeat metrics) next to the
+            # trainers' logs — same flight-dump format as a watchdog trip
+            from ..observability import flight as _flight
+            path = _flight.dump("gang_failure",
+                                extra={"world": world, "survivors": survivors,
+                                       "rc": rc, "restart_idx": restarts})
+            if path:
+                print(f"[launch] flight-recorder dump: {path}", flush=True)
             if restarts >= args.elastic_restarts or survivors < 1:
                 return rc
             restarts += 1
@@ -360,7 +369,11 @@ def launch(argv=None):
     except Exception as e:
         # typed failure (rendezvous DeadlineExceededError, ...): one clear
         # line + non-zero exit — a broken launch must FAIL, never hang
-        print(f"[launch] FAILED: {e!r}", file=sys.stderr, flush=True)
+        from ..observability import flight as _flight
+        path = _flight.dump("gang_failure", extra={"error": repr(e)})
+        print(f"[launch] FAILED: {e!r}" + (
+            f" (flight-recorder dump: {path})" if path else ""),
+            file=sys.stderr, flush=True)
         raise SystemExit(1)
     sys.exit(rc)
 

@@ -72,6 +72,27 @@ _DEFS: Dict[str, tuple] = {
                                    "enough to hide host latency without "
                                    "pinning extra HBM (monitor stat "
                                    "executor.dispatch_queue_depth)"),
+    # --- observability tier (observability/, docs/observability.md) ------
+    "FLAGS_trace_events": (True, "record host RecordEvent spans / flow "
+                           "events / instants into the bounded trace ring "
+                           "(observability/trace.py). Always-on by design "
+                           "(the flight recorder's backing store; ring-"
+                           "bounded memory, ≤5% hot-path overhead pinned "
+                           "by tests/test_observability.py); 0 turns span "
+                           "recording into a no-op — the timing A/B's "
+                           "baseline arm"),
+    "FLAGS_trace_buffer_events": (65536, "trace ring capacity in events; "
+                                  "oldest events drop past it, counted in "
+                                  "the trace.dropped_events metric"),
+    "FLAGS_flight_recorder": (True, "keep the last FLAGS_flight_steps "
+                              "steps' wall windows + metric deltas and "
+                              "dump them (with the trace ring) on step-"
+                              "watchdog trips, gang failures, and "
+                              "degraded bench rows "
+                              "(observability/flight.py)"),
+    "FLAGS_flight_steps": (16, "flight-recorder step-ring depth"),
+    "FLAGS_flight_dump_dir": ("", "where flight dumps land; empty = "
+                              "<tmpdir>/paddle_tpu_flight"),
     # --- resilience tier (resilience/, docs/resilience.md) ---------------
     "FLAGS_fault_plan": ("", "fault-injection plan spec, e.g. "
                              "'kv.pull:error:every=3;ckpt.write:kill:at=2'"),

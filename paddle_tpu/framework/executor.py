@@ -14,7 +14,9 @@ mirroring the reference's ExecutorPrepareContext caching.
 from __future__ import annotations
 
 import collections
+import contextlib
 import functools
+import itertools
 import time
 from typing import Dict, List, Optional, Sequence
 
@@ -24,7 +26,14 @@ import numpy as np
 from .program import Program, Variable, default_main_program
 from .scope import Scope, global_scope
 from .. import monitor
+from ..observability import flight as _flight
+from ..observability import metrics as _metrics
+from ..observability import trace as _trace
 from ..ops import registry
+
+# flight-recorder owner ids: stable per Executor instance (id() can be
+# reused after GC), assigned lazily by _step_window
+_flight_owner_ids = itertools.count(1)
 
 
 class _CompiledBlock:
@@ -821,7 +830,7 @@ class _StagedFeeds:
                    or feed[n] is self.device_feeds[n] for n in feed)
 
 
-def _package_fetches(fetches, fetch_names, return_numpy, sync):
+def _package_fetches(fetches, fetch_names, return_numpy, sync, step=None):
     """The ONE fetch-return site shared by run()/run_steps().
 
     return_numpy=False: the live device arrays, UNSYNCED — jax dispatch is
@@ -831,18 +840,30 @@ def _package_fetches(fetches, fetch_names, return_numpy, sync):
     paying full-tensor D2H. return_numpy=True + sync: the classic drain
     (blocks; counted in executor.host_blocked_ms / fetch_sync_count).
     return_numpy=True + sync=False: lazy FetchHandles (framework/fetch.py)
-    that pay the sync only on access."""
+    that pay the sync only on access — each carries a trace FLOW id opened
+    here, closed by its materialization, so the chrome trace links a
+    step's dispatch to its (possibly cross-thread, much later) fetch."""
     if not return_numpy:
         return list(fetches)
     if sync:
         from .fetch import _record_sync
-        t0 = time.perf_counter()
-        out = [np.asarray(f) for f in fetches]
+        with _trace.RecordEvent("fetch.drain",
+                                args={"step": step, "n": len(fetches)}):
+            t0 = time.perf_counter()
+            out = [np.asarray(f) for f in fetches]
         if out:
             _record_sync(time.perf_counter() - t0, n_values=len(out))
         return out
     from .fetch import FetchHandle
-    return [FetchHandle(f, name=n) for f, n in zip(fetches, fetch_names)]
+    tracing = _trace.enabled()
+    out = []
+    for f, n in zip(fetches, fetch_names):
+        fid = None
+        if tracing:
+            fid = _trace.new_flow()
+            _trace.flow_start("fetch", fid, args={"name": n, "step": step})
+        out.append(FetchHandle(f, name=n, flow=fid))
+    return out
 
 
 class Executor:
@@ -873,6 +894,11 @@ class Executor:
         # the training loop consumes on the main thread
         self._staged: "collections.deque[_StagedFeeds]" = collections.deque()
         self._staged_lock = threading.Lock()
+        # device cost attribution per compiled program (annotate_step_cost):
+        # (program uid, version) -> {"device_flops": ..., ...}; dispatch
+        # spans attach the entry so every step in the trace carries its
+        # program's XLA cost analysis
+        self._step_costs: Dict[tuple, dict] = {}
 
     @staticmethod
     def _resolve_sync(sync: Optional[bool]) -> bool:
@@ -926,48 +952,50 @@ class Executor:
         scope = scope or global_scope()
         gb = program.global_block()
         from ..flags import flag
-        t0 = time.perf_counter()
-        orig_vals = dict(feed)
-        if k is not None:
-            k = int(k)
-            feed_vals = _multi_step_feed_vals(gb, feed, k)
-        else:
-            feed_vals = {n: _coerce_feed_value(gb, n, v)
-                         for n, v in feed.items()}
-        import jax.numpy as jnp
-
-        scope_ids = None
-
-        def _all_scope_ids():
-            # walk the WHOLE scope chain: donation resolves state through
-            # scope.find() (parents included), so a parent-resident buffer
-            # needs the defensive copy just as much as a local one. Built
-            # LAZILY: only a USER-PROVIDED device array can possibly be
-            # scope-resident — the common numpy-feed hot path never pays
-            # the O(scope) walk
-            ids = set()
-            s = scope
-            while s is not None:
-                ids.update(id(s.find(n)) for n in s.local_names())
-                s = s.parent
-            return ids
-
-        dev = {}
-        for n, v in feed_vals.items():
-            if isinstance(v, jax.Array):
-                if v is orig_vals.get(n):   # coerced copies are fresh
-                    if scope_ids is None:
-                        scope_ids = _all_scope_ids()
-                    # scope-resident array: copy into a fresh buffer so
-                    # the in-flight window's donation cannot invalidate
-                    # the staged entry
-                    v = jnp.array(v, copy=True) if id(v) in scope_ids \
-                        else v
-                dev[n] = v
+        with _trace.RecordEvent("stage", args={"k": 0 if k is None else int(k),
+                                               "feeds": len(feed)}):
+            t0 = time.perf_counter()
+            orig_vals = dict(feed)
+            if k is not None:
+                k = int(k)
+                feed_vals = _multi_step_feed_vals(gb, feed, k)
             else:
-                dev[n] = jax.device_put(v)
-        monitor.stat_add("executor.h2d_ms",
-                         (time.perf_counter() - t0) * 1000.0)
+                feed_vals = {n: _coerce_feed_value(gb, n, v)
+                             for n, v in feed.items()}
+            import jax.numpy as jnp
+
+            scope_ids = None
+
+            def _all_scope_ids():
+                # walk the WHOLE scope chain: donation resolves state
+                # through scope.find() (parents included), so a parent-
+                # resident buffer needs the defensive copy just as much as
+                # a local one. Built LAZILY: only a USER-PROVIDED device
+                # array can possibly be scope-resident — the common
+                # numpy-feed hot path never pays the O(scope) walk
+                ids = set()
+                s = scope
+                while s is not None:
+                    ids.update(id(s.find(n)) for n in s.local_names())
+                    s = s.parent
+                return ids
+
+            dev = {}
+            for n, v in feed_vals.items():
+                if isinstance(v, jax.Array):
+                    if v is orig_vals.get(n):   # coerced copies are fresh
+                        if scope_ids is None:
+                            scope_ids = _all_scope_ids()
+                        # scope-resident array: copy into a fresh buffer so
+                        # the in-flight window's donation cannot invalidate
+                        # the staged entry
+                        v = jnp.array(v, copy=True) if id(v) in scope_ids \
+                            else v
+                    dev[n] = v
+                else:
+                    dev[n] = jax.device_put(v)
+            monitor.stat_add("executor.h2d_ms",
+                             (time.perf_counter() - t0) * 1000.0)
         if depth is None:
             depth = int(flag("FLAGS_dispatch_queue_depth"))
         depth = max(1, int(depth))
@@ -1059,6 +1087,43 @@ class Executor:
           dispatch is async, so they may still be computing; np.asarray
           (or .block_until_ready) at the consumer is the sync point.
         """
+        with self._step_window():
+            return self._run_impl(program, feed, fetch_list, scope,
+                                  return_numpy, use_program_cache, sync)
+
+    @contextlib.contextmanager
+    def _step_window(self):
+        """One executor step: advance the counter, bracket the flight-
+        recorder window, and fire the FLAGS_profile_start/stop_step
+        triggers. Shared by run() AND run_steps() so a mixed loop (e.g.
+        train_from_dataset dispatching full groups via run_steps and tail
+        batches via run) sees every counter value exactly once — an
+        equality trigger can never be skipped."""
+        from .. import profiler as _prof
+        from ..flags import flag
+        self._step_counter = getattr(self, "_step_counter", 0) + 1
+        idx = self._step_counter
+        # flight windows are keyed (owner, idx): every Executor restarts
+        # its counter at 1, so a train+eval pair needs distinct owners
+        owner = getattr(self, "_flight_owner", None)
+        if owner is None:
+            owner = self._flight_owner = next(_flight_owner_ids)
+        if idx == flag("FLAGS_profile_start_step"):
+            _prof.start_profiler()
+        _flight.begin_step(idx, owner=owner)
+        status = "ok"
+        try:
+            yield idx
+        except BaseException:
+            status = "error"
+            raise
+        finally:
+            _flight.end_step(idx, status=status, owner=owner)
+            if idx == flag("FLAGS_profile_stop_step"):
+                _prof.stop_profiler()
+
+    def _run_impl(self, program, feed, fetch_list, scope, return_numpy,
+                  use_program_cache, sync):
         program = program or default_main_program()
         if hasattr(program, "_is_data_parallel"):   # CompiledProgram shim
             program = program.program
@@ -1111,32 +1176,38 @@ class Executor:
         compiled = self._cache.get(key) if use_program_cache else None
         localsgd_k = getattr(program, "_localsgd_k", 0)
         if compiled is None:
-            _prewarm_flash_ops(program)
-            dist = getattr(program, "_dist_config", None)
-            pp = (int(dist.resolve_mesh().shape.get("pp", 1))
-                  if dist is not None else 1)
-            if pp > 1:
-                # the pp mesh axis engages true pipeline parallelism: stages
-                # partitioned by device_guard, placed on pp submeshes
-                # (parallel/pipeline.py)
-                if localsgd_k and localsgd_k > 1:
-                    from . import errors
-                    raise errors.Unimplemented(
-                        "LocalSGD over a pp>1 mesh (pipeline stages and "
-                        "per-replica parameter copies are incompatible)")
-                from ..parallel.pipeline import _PipelineBlock
-                compiled = _PipelineBlock(program, 0, list(feed_vals),
-                                          fetch_names, state_names)
-            elif localsgd_k and localsgd_k > 1:
-                compiled = _LocalSGDBlock(program, 0, list(feed_vals),
-                                          fetch_names, state_names,
-                                          localsgd_k)
-            else:
-                compiled = _make_compiled_block(program, feed_vals,
-                                                fetch_names, state_names,
-                                                scope)
+            _metrics.inc("executor.compile_cache_misses")
+            with _trace.RecordEvent("compile", args={
+                    "step": self._step_counter,
+                    "ops": op_count(program)}):
+                _prewarm_flash_ops(program)
+                dist = getattr(program, "_dist_config", None)
+                pp = (int(dist.resolve_mesh().shape.get("pp", 1))
+                      if dist is not None else 1)
+                if pp > 1:
+                    # the pp mesh axis engages true pipeline parallelism:
+                    # stages partitioned by device_guard, placed on pp
+                    # submeshes (parallel/pipeline.py)
+                    if localsgd_k and localsgd_k > 1:
+                        from . import errors
+                        raise errors.Unimplemented(
+                            "LocalSGD over a pp>1 mesh (pipeline stages and "
+                            "per-replica parameter copies are incompatible)")
+                    from ..parallel.pipeline import _PipelineBlock
+                    compiled = _PipelineBlock(program, 0, list(feed_vals),
+                                              fetch_names, state_names)
+                elif localsgd_k and localsgd_k > 1:
+                    compiled = _LocalSGDBlock(program, 0, list(feed_vals),
+                                              fetch_names, state_names,
+                                              localsgd_k)
+                else:
+                    compiled = _make_compiled_block(program, feed_vals,
+                                                    fetch_names, state_names,
+                                                    scope)
             if use_program_cache:
                 self._cache[key] = compiled
+        else:
+            _metrics.inc("executor.compile_cache_hits")
 
         if staged_vals is not None:
             # the donation-vs-staging aliasing rule: a staged buffer the
@@ -1146,14 +1217,14 @@ class Executor:
                 compiled, feed_vals, scope)
             if n_conf:
                 monitor.stat_add("executor.staging_conflicts", n_conf)
+                _trace.instant("donation_conflict_copy",
+                               args={"n": n_conf,
+                                     "step": self._step_counter})
                 sync = True
 
         rng_key = _next_rng_key(scope, program.random_seed)
-        from .. import profiler as _prof
         from ..flags import flag
-        self._step_counter = getattr(self, "_step_counter", 0) + 1
-        if self._step_counter == flag("FLAGS_profile_start_step"):
-            _prof.start_profiler()
+        step_idx = self._step_counter
 
         def _dispatch():
             if not isinstance(compiled, _CompiledBlock):
@@ -1175,20 +1246,17 @@ class Executor:
                     f"step dispatch ({op_count(program)} ops)")
 
         benchmark = flag("FLAGS_benchmark")
-        if _prof._enabled or benchmark:
-            import time as _time
-            t0 = _time.perf_counter()
-            with _prof.RecordEvent(f"executor_run#{op_count(program)}ops"):
-                fetches, new_state = _dispatch()
-                if benchmark:  # sync so the wall time is the device time
-                    jax.block_until_ready(fetches)
-            if benchmark:
-                print(f"[benchmark] step {self._step_counter}: "
-                      f"{(_time.perf_counter() - t0) * 1000:.3f} ms")
-        else:
+        t0 = time.perf_counter()
+        with _trace.RecordEvent(f"executor_run#{op_count(program)}ops",
+                                args=self._dispatch_args(program, step_idx)):
             fetches, new_state = _dispatch()
-        if self._step_counter == flag("FLAGS_profile_stop_step"):
-            _prof.stop_profiler()
+            if benchmark:  # sync so the wall time is the device time
+                jax.block_until_ready(fetches)
+        _metrics.observe("executor.step_host_ms",
+                         (time.perf_counter() - t0) * 1000.0)
+        if benchmark:
+            print(f"[benchmark] step {step_idx}: "
+                  f"{(time.perf_counter() - t0) * 1000:.3f} ms")
         for n, v in new_state.items():
             scope.set(n, v)
         if flag("FLAGS_check_nan_inf"):
@@ -1215,9 +1283,70 @@ class Executor:
         if step_deadline > 0 and sync and return_numpy:
             return _deadline_call(
                 lambda: _package_fetches(fetches, user_names, return_numpy,
-                                         sync),
+                                         sync, step=step_idx),
                 step_deadline, "fetch materialization")
-        return _package_fetches(fetches, user_names, return_numpy, sync)
+        return _package_fetches(fetches, user_names, return_numpy, sync,
+                                step=step_idx)
+
+    def _dispatch_args(self, program, step_idx, k=None) -> dict:
+        """Per-step phase annotations for the dispatch span: step index,
+        window size, and — once annotate_step_cost() ran for this program
+        — the XLA device cost attribution (flops/bytes)."""
+        args = {"step": step_idx}
+        if k:
+            args["k"] = int(k)
+        cost = self._step_costs.get((program._uid, program._version))
+        if cost:
+            args.update(cost)
+        return args
+
+    def annotate_step_cost(self, feed=None, fetch_list=None, program=None,
+                           scope=None, k=None) -> dict:
+        """Device cost attribution per step: XLA's cost analysis (flops,
+        bytes accessed) + CompiledMemoryStats (argument/output/temp bytes)
+        of the jitted step for this signature — computed once via
+        _inspect_compiled (sharing run()'s compile cache), attached to
+        every subsequent dispatch span for this program, emitted as a
+        chrome counter track ("device_step_cost"), and mirrored into the
+        executor.step_flops / executor.step_bytes_accessed gauges. The
+        fields the backend cannot report are simply absent (CPU-mesh XLA
+        reports flops; memory stats availability varies by version)."""
+        prog = program or default_main_program()
+        if hasattr(prog, "_is_data_parallel"):
+            prog = prog.program
+        compiled = self._inspect_compiled(feed, fetch_list, prog, scope, k)
+        cost: dict = {}
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            for src, dst in (("flops", "device_flops"),
+                             ("bytes accessed", "device_bytes_accessed")):
+                v = ca.get(src)
+                if v is not None:
+                    cost[dst] = float(v)
+        except Exception:
+            pass
+        try:
+            ma = compiled.memory_analysis()
+            for attr, dst in (("argument_size_in_bytes", "argument_bytes"),
+                              ("output_size_in_bytes", "output_bytes"),
+                              ("temp_size_in_bytes", "temp_bytes")):
+                v = getattr(ma, attr, None)
+                if v is not None:
+                    cost[dst] = int(v)
+        except Exception:
+            pass
+        if cost:
+            self._step_costs[(prog._uid, prog._version)] = cost
+            _trace.counter_event("device_step_cost", cost)
+            if "device_flops" in cost:
+                _metrics.set_gauge("executor.step_flops",
+                                   cost["device_flops"])
+            if "device_bytes_accessed" in cost:
+                _metrics.set_gauge("executor.step_bytes_accessed",
+                                   cost["device_bytes_accessed"])
+        return cost
 
     def run_steps(self, k: int, program: Optional[Program] = None,
                   feed: Optional[dict] = None,
@@ -1244,6 +1373,15 @@ class Executor:
         push after (_PsHook.pre_multi/post_multi — the reference's async
         communicator batching). Not supported: Geo-SGD or dense-send hooks,
         pipeline / LocalSGD programs, heter sections."""
+        # one run_steps call is ONE dispatch: it advances the executor's
+        # step counter once, and the flight recorder records it as one
+        # step window (its dispatch span carries k)
+        with self._step_window():
+            return self._run_steps_impl(k, program, feed, fetch_list, scope,
+                                        return_numpy, sync)
+
+    def _run_steps_impl(self, k, program, feed, fetch_list, scope,
+                        return_numpy, sync):
         program = program or default_main_program()
         if hasattr(program, "_is_data_parallel"):
             program = program.program
@@ -1312,28 +1450,45 @@ class Executor:
                                               fetch_names, state_names)
         compiled = self._cache.get(key)
         if compiled is None:
-            _prewarm_flash_ops(program)
-            compiled = _make_compiled_block(program, feed_vals, fetch_names,
-                                            state_names, scope, multi_k=k)
+            _metrics.inc("executor.compile_cache_misses")
+            with _trace.RecordEvent("compile", args={
+                    "step": self._step_counter, "k": k,
+                    "ops": op_count(program)}):
+                _prewarm_flash_ops(program)
+                compiled = _make_compiled_block(program, feed_vals,
+                                                fetch_names, state_names,
+                                                scope, multi_k=k)
             self._cache[key] = compiled
+        else:
+            _metrics.inc("executor.compile_cache_hits")
         if staged_vals is not None:
             feed_vals, n_conf = self._resolve_staged_donation(
                 compiled, feed_vals, scope)
             if n_conf:
                 monitor.stat_add("executor.staging_conflicts", n_conf)
+                _trace.instant("donation_conflict_copy",
+                               args={"n": n_conf,
+                                     "step": self._step_counter})
                 sync = True
         rng_key = _next_rng_key(scope, program.random_seed)
         state = {n: scope.find(n) for n in state_names}
         from ..flags import flag
+        step_idx = self._step_counter
         step_deadline = float(flag("FLAGS_step_deadline_ms") or 0.0)
-        if step_deadline > 0:
-            # the hang watchdog covers the k-step dispatch too (one wedged
-            # collective inside the scan blocks it exactly the same way)
-            fetches, new_state = _deadline_call(
-                lambda: compiled(state, feed_vals, rng_key), step_deadline,
-                f"run_steps(k={k}) dispatch")
-        else:
-            fetches, new_state = compiled(state, feed_vals, rng_key)
+        t0 = time.perf_counter()
+        with _trace.RecordEvent(f"executor_run_steps#{k}",
+                                args=self._dispatch_args(program, step_idx,
+                                                         k=k)):
+            if step_deadline > 0:
+                # the hang watchdog covers the k-step dispatch too (one
+                # wedged collective inside the scan blocks it the same way)
+                fetches, new_state = _deadline_call(
+                    lambda: compiled(state, feed_vals, rng_key),
+                    step_deadline, f"run_steps(k={k}) dispatch")
+            else:
+                fetches, new_state = compiled(state, feed_vals, rng_key)
+        _metrics.observe("executor.step_host_ms",
+                         (time.perf_counter() - t0) * 1000.0)
         for n, v in new_state.items():
             scope.set(n, v)
         if ps_hooks:
@@ -1345,9 +1500,10 @@ class Executor:
         if step_deadline > 0 and sync and return_numpy:
             return _deadline_call(
                 lambda: _package_fetches(fetches, user_names, return_numpy,
-                                         sync),
+                                         sync, step=step_idx),
                 step_deadline, "run_steps fetch materialization")
-        return _package_fetches(fetches, user_names, return_numpy, sync)
+        return _package_fetches(fetches, user_names, return_numpy, sync,
+                                step=step_idx)
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
@@ -1657,10 +1813,18 @@ def _deadline_call(fn, deadline_ms: float, what: str):
     t.join(deadline_ms / 1000.0)
     if t.is_alive():
         monitor.stat_add("executor.step_deadline_trips")
+        stacks = _dump_thread_stacks()
+        # the flight recorder ships the wedge's own timeline: last-N step
+        # spans + metric deltas land next to the thread-stack dump, so the
+        # postmortem does not have to be reconstructed from prints
+        dump_path = _flight.dump(
+            "step_deadline",
+            extra={"what": what, "deadline_ms": deadline_ms,
+                   "thread_stacks": stacks})
         raise errors.DeadlineExceeded(
             "%s exceeded FLAGS_step_deadline_ms=%.0f (wedged collective / "
-            "dead peer?); thread stacks:\n%s", what, deadline_ms,
-            _dump_thread_stacks())
+            "dead peer?); flight-recorder dump: %s; thread stacks:\n%s",
+            what, deadline_ms, dump_path or "<disabled>", stacks)
     if "error" in result:
         raise result["error"]
     return result["value"]

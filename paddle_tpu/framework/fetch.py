@@ -24,12 +24,15 @@ from typing import Optional
 import numpy as np
 
 from .. import monitor
+from ..observability import metrics as _metrics
+from ..observability import trace as _trace
 
 
 def _record_sync(dt_s: float, n_values: int = 1):
     """One ledger for every host materialization (lazy or eager)."""
     monitor.stat_add("executor.fetch_sync_count", n_values)
     monitor.stat_add("executor.host_blocked_ms", dt_s * 1000.0)
+    _metrics.observe("executor.fetch_sync_ms", dt_s * 1000.0)
 
 
 class FetchHandle:
@@ -45,14 +48,29 @@ class FetchHandle:
     `handle[idx]` stays lazy: it dispatches a device-side slice and
     returns a new handle, so `loss_handle[-1].numpy()` of a stacked
     run_steps fetch pulls ONE scalar instead of the [k]-vector.
+
+    Tracing: a handle minted by the executor carries the FLOW id its
+    dispatch opened (observability/trace.py); the first materialization
+    records a `fetch.materialize` span and closes the flow — on whatever
+    thread it happens — so the chrome trace draws the dispatch→drain arrow
+    across threads.
     """
 
-    __slots__ = ("_value", "_materialized", "name")
+    __slots__ = ("_value", "_materialized", "name", "_flow")
 
-    def __init__(self, value, name: Optional[str] = None):
+    def __init__(self, value, name: Optional[str] = None,
+                 flow=None):
         self._value = value
         self._materialized: Optional[np.ndarray] = None
         self.name = name
+        # one-shot claim CELL shared by the parent and every lazy slice
+        # (__getitem__ passes the same list): whichever handle in the
+        # family materializes first pops it and closes the flow, so
+        # `h[0].numpy(); h[-1].numpy()` leaves no dangling flow-start
+        if flow is None or isinstance(flow, list):
+            self._flow = flow
+        else:
+            self._flow = [flow]
 
     # ---- metadata (never blocks) ----------------------------------------
     @property
@@ -83,9 +101,20 @@ class FetchHandle:
     # ---- materialization (blocks; counted) ------------------------------
     def numpy(self) -> np.ndarray:
         if self._materialized is None:
-            t0 = time.perf_counter()
-            self._materialized = np.asarray(self._value)
-            _record_sync(time.perf_counter() - t0)
+            with _trace.RecordEvent("fetch.materialize",
+                                    args={"name": self.name}):
+                t0 = time.perf_counter()
+                self._materialized = np.asarray(self._value)
+                _record_sync(time.perf_counter() - t0)
+            if self._flow is not None:
+                try:
+                    fid = self._flow.pop()   # atomic claim under the GIL
+                except IndexError:
+                    fid = None               # a sibling already closed it
+                if fid is not None:
+                    _trace.flow_end("fetch", fid,
+                                    args={"name": self.name})
+                self._flow = None
         return self._materialized
 
     def __array__(self, dtype=None, copy=None):
@@ -127,7 +156,12 @@ class FetchHandle:
             sub = FetchHandle(None, name=self.name)
             sub._materialized = self._materialized[key]
             return sub
-        return FetchHandle(self._value[key], name=self.name)
+        # SHARE the dispatch-flow claim with the slice: the documented
+        # `stacked[-1].numpy()` pattern materializes the slice, but the
+        # parent (or another slice) may drain first — whoever does closes
+        # the arrow, exactly once
+        return FetchHandle(self._value[key], name=self.name,
+                           flow=self._flow)
 
     def __repr__(self):
         state = ("materialized" if self._materialized is not None

@@ -1,50 +1,42 @@
-"""Stat registry (reference platform/monitor.h:34-154 STAT_ADD/STAT_GET:
-named int/float counters exported through pybind; e.g. GPU mem watermarks).
-Host-side counters here; device memory watermarks come from the XLA client.
+"""Stat registry COMPAT SHIM over observability/metrics.py.
 
-Naming convention: dotted namespaces per subsystem. `resilience.*` is
-tabled in docs/resilience.md; the executor's host–device overlap ledger —
-`executor.host_blocked_ms`, `executor.fetch_sync_count`,
-`executor.h2d_ms`, `executor.dispatch_queue_depth`,
-`executor.staging_conflicts`, `executor.async_fallbacks` — is tabled in
-docs/perf_notes.md "Host–device overlap" and budget-checked by
-scripts/ci.py's host-stall check.
+Reference counterpart: platform/monitor.h:34-154 STAT_ADD/STAT_GET (named
+int/float counters exported through pybind; e.g. GPU mem watermarks). The
+flat float dict this module used to be now lives as a view over the typed
+registry: `stat_add` records a counter, `stat_set` a gauge, and every
+existing call site (`executor.*`, `resilience.*`,
+`executor.zero_manual_fallbacks.*`) therefore lands in the same registry
+the tracer/flight recorder snapshot and diff. New code should use
+`paddle_tpu.observability.metrics` directly (histograms with p50/p99,
+snapshot/delta, JSONL export); the dotted-namespace tables formerly split
+across this docstring, docs/perf_notes.md and docs/resilience.md are
+consolidated in docs/observability.md.
 """
 from __future__ import annotations
 
-import threading
 from typing import Dict
 
-_lock = threading.Lock()
-_stats: Dict[str, float] = {}
+from .observability import metrics as _metrics
 
 
 def stat_add(name: str, value: float = 1):
-    with _lock:
-        _stats[name] = _stats.get(name, 0) + value
+    _metrics.inc(name, value)
 
 
 def stat_set(name: str, value: float):
-    with _lock:
-        _stats[name] = value
+    _metrics.set_gauge(name, value)
 
 
 def stat_get(name: str) -> float:
-    with _lock:
-        return _stats.get(name, 0)
+    return _metrics.get(name)
 
 
 def stat_reset(name: str = None):
-    with _lock:
-        if name is None:
-            _stats.clear()
-        else:
-            _stats.pop(name, None)
+    _metrics.reset(name)
 
 
 def all_stats() -> Dict[str, float]:
-    with _lock:
-        return dict(_stats)
+    return _metrics.flat()
 
 
 def device_memory_stats() -> Dict[str, int]:

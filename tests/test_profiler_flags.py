@@ -29,7 +29,15 @@ def test_profiler_collects_spans_and_exports_timeline(tmp_path):
     names = [e["name"] for e in trace["traceEvents"]]
     assert len(names) >= 3
     assert any("executor_run" in n for n in names)
-    assert all("ts" in e and "dur" in e for e in trace["traceEvents"])
+    # complete ("X") spans carry ts+dur; the export may also include
+    # thread-name metadata ("M") and instant/flow events (no dur)
+    spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    assert spans and all("ts" in e and "dur" in e for e in spans)
+    # lanes are labeled with REAL thread ids + name metadata
+    metas = [e for e in trace["traceEvents"] if e.get("ph") == "M"]
+    assert any(e["name"] == "thread_name" for e in metas)
+    span_tids = {e["tid"] for e in spans}
+    assert span_tids <= {e["tid"] for e in metas}
 
 
 def test_flags_set_get_and_env_rejects_unknown():
