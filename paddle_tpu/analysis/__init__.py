@@ -1,0 +1,37 @@
+"""Static program analysis: verifier, donation/alias analysis, collective
+consistency — the build-time safety rail under the pass pipeline.
+
+Reference counterpart: the `framework/ir` graph-rewrite layer — every
+reference pass is an `ir::Graph` rewrite checked by dedicated pass testers
+(`ir/pass.h`, `ir/*_tester.cc`, `pass_tester_helper.h`), and several memory
+passes (`reference_count_pass.cc`, `buffer_shared_inplace_op_pass.cc`) are
+themselves static analyses. This repo's pass pipeline (layer scan,
+recompute, gradient merge, bucketing + ZeRO 1/2/3, sink code motion)
+historically had only DYNAMIC checks — runtime copy census, bit-parity
+tests after a full compile. This package checks programs statically, in
+milliseconds, at build time:
+
+* `verifier`   — structural Program/Block well-formedness (def-before-use,
+                 dangling inputs, op slot/attr validation against the op
+                 registry, dtype propagation, sub-graph scoping).
+* `alias`      — predicts which buffers the compiled block will donate and
+                 flags write-after-donate / fetch-of-donated hazards (the
+                 static complement of scripts/copy_audit.py).
+* `collectives`— extracts the ordered collective sequence, rejects
+                 rank-divergent control dependence (the static deadlock
+                 detector for the manual-dp shard_map path), and validates
+                 `sink_op_to_producers` dataflow preservation.
+* `passes`     — the FLAGS_verify_passes harness: verify after each
+                 program pass, naming the offending pass and dumping a
+                 before/after op diff on failure.
+
+CLI: `scripts/program_lint.py` lints the examples/ model-program zoo and
+runs in CI (`scripts/ci.py`). Docs: docs/static_analysis.md.
+"""
+from .findings import Finding, errors_only, format_findings  # noqa: F401
+from .verifier import verify_program  # noqa: F401
+from .alias import analyze_donation  # noqa: F401
+from .collectives import (check_collectives, collective_sequence,  # noqa: F401
+                          dataflow_preserved)
+from .passes import (PassVerificationError, checked_pass,  # noqa: F401
+                     verify_passes_enabled)

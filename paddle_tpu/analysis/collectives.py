@@ -1,0 +1,204 @@
+"""Collective-consistency checking: the static deadlock detector.
+
+On the manual-dp shard_map path (parallel/zero.py) every rank executes the
+same program, so a deadlock can only come from CONTROL divergence: a
+collective op whose execution is conditional on a rank-varying value —
+one rank enters the psum, another doesn't, and the pod wedges until the
+step watchdog trips. This module checks that statically:
+
+* `collective_sequence` extracts the ordered collective sequence of a
+  program (`__bucket_sync__`, `__zero_update__`, `__zero_gather__`,
+  `__zero_pack__`, plus `__layer_scan__` bodies gathering ZeRO-3 shards
+  per iteration). Identity across ranks follows from SPMD (one program)
+  PLUS the absence of rank-divergent control flow — which is exactly what
+  `check_collectives` verifies.
+* `check_collectives` taints every value derived from feed data (the only
+  rank-varying inputs under dp sharding; parameters and optimizer state
+  are replicated or kept rank-consistent by the collectives themselves,
+  so `__bucket_sync__`/`__zero_update__` outputs UNTAINT) and errors on
+  any control-flow op whose condition is tainted while its sub-blocks
+  contain collectives.
+* `dataflow_preserved` validates `sink_op_to_producers` code motion
+  (parallel/transforms.py): a reordering of the same op list must keep
+  the relative order of every dataflow-dependent pair (write->read,
+  read->write, write->write on any var). Run by the FLAGS_verify_passes
+  harness around the bucketing pass's sink loop.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Set
+
+from .findings import Finding
+
+EMPTY = "@EMPTY@"
+
+# op types that lower to cross-replica collectives in manual-dp mode
+COLLECTIVE_OPS = frozenset({
+    "__bucket_sync__", "__zero_update__", "__zero_gather__", "__zero_pack__",
+})
+
+# collective outputs are rank-uniform by construction (averaged/summed over
+# the dp axis), so taint does not propagate through them
+_UNTAINTING_OPS = frozenset({"__bucket_sync__", "__zero_update__"})
+
+_SUB_BLOCK_ATTRS = ("sub_block", "true_block", "false_block")
+
+
+def _op_is_collective(op) -> bool:
+    if op.type in COLLECTIVE_OPS:
+        return True
+    if op.type == "__layer_scan__" and any(op.attrs.get("zero3_flat") or ()):
+        return True   # per-iteration all_gather inside the scan body
+    if op.type == "__vjp__":
+        fa = op.attrs.get("fwd_attrs") or {}
+        if op.attrs.get("fwd_type") == "__layer_scan__" \
+                and any(fa.get("zero3_flat") or ()):
+            return True   # its transpose psum_scatters per iteration
+    return False
+
+
+def collective_sequence(program) -> List[dict]:
+    """The ordered collective records of the program's global block (plus
+    any found in sub-blocks, which check_collectives treats as suspect)."""
+    seq: List[dict] = []
+    for block in program.blocks:
+        for i, op in enumerate(block.ops):
+            if not _op_is_collective(op):
+                continue
+            detail = {}
+            for key in ("dtype", "sizes", "padded", "stage", "layout",
+                        "update_op"):
+                if key in op.attrs:
+                    detail[key] = op.attrs[key]
+            seq.append({"block": block.idx, "op_index": i, "type": op.type,
+                        "detail": detail})
+    return seq
+
+
+def _blocks_under(program, idx: int) -> List:
+    """`idx`'s block plus every transitive sub-block."""
+    out = [program.blocks[idx]]
+    for b in program.blocks:
+        p = b
+        while p is not None:
+            if p.idx == idx:
+                if b is not out[0]:
+                    out.append(b)
+                break
+            p = p.parent_block
+    return out
+
+
+def _contains_collective(program, block_idx: int) -> bool:
+    for b in _blocks_under(program, block_idx):
+        if any(_op_is_collective(op) for op in b.ops):
+            return True
+    return False
+
+
+def check_collectives(program) -> List[Finding]:
+    findings: List[Finding] = []
+
+    # rank-varying taint: seeded by data vars (batch-sharded feeds), spread
+    # through op dataflow, stopped by rank-uniforming collectives. Iterated
+    # to a fixpoint: loop-carried vars and cross-block chains (a __while__
+    # body rewriting its own cond var from a feed-derived value) can need
+    # taint to flow against block/op index order.
+    tainted: Set[str] = set()
+    for b in program.blocks:
+        for v in b.vars.values():
+            if v.is_data:
+                tainted.add(v.name)
+
+    changed = True
+    while changed:
+        changed = False
+        for block in program.blocks:
+            for op in block.ops:
+                if op.type in _UNTAINTING_OPS:
+                    continue
+                if set(op.input_names()) & tainted:
+                    outs = {n for n in op.output_names() if n != EMPTY}
+                    if not outs <= tainted:
+                        tainted |= outs
+                        changed = True
+
+    for block in program.blocks:
+        for i, op in enumerate(block.ops):
+            sub_idxs = [op.attrs[k] for k in _SUB_BLOCK_ATTRS
+                        if isinstance(op.attrs.get(k), int)]
+            if not sub_idxs:
+                continue
+            cond_names = set(op.inputs.get("Cond", ())) - {EMPTY}
+            cond_tainted = bool(cond_names & tainted)
+            for idx in sub_idxs:
+                if not (0 <= idx < len(program.blocks)):
+                    continue   # verifier reports the broken index itself
+                if not _contains_collective(program, idx):
+                    continue
+                if cond_tainted:
+                    findings.append(Finding(
+                        check="rank_divergent_collective", severity="error",
+                        message=f"block {idx} contains collective ops and "
+                                f"executes under a condition derived from "
+                                f"feed data ({sorted(cond_names & tainted)}"
+                                f"): ranks can diverge and deadlock the "
+                                "collective", block=block.idx, op_index=i,
+                        op_type=op.type))
+                else:
+                    findings.append(Finding(
+                        check="collective_in_control_flow",
+                        severity="warning",
+                        message=f"block {idx} contains collective ops "
+                                "inside control flow; the condition is "
+                                "rank-uniform today, but any pass that "
+                                "makes it data-dependent creates a "
+                                "deadlock", block=block.idx, op_index=i,
+                        op_type=op.type))
+    return findings
+
+
+def dataflow_preserved(before_ops: Sequence, after_ops: Sequence,
+                       pass_name: str = "sink_op_to_producers") \
+        -> List[Finding]:
+    """Verify `after_ops` is a dataflow-preserving permutation of
+    `before_ops`: same op objects, and every dependent pair (write->read,
+    read->write, write->write on any shared var) keeps its relative
+    order. This is exactly the invariant `sink_op_to_producers` promises
+    ("position only fixes dataflow order")."""
+    findings: List[Finding] = []
+    if len(before_ops) != len(after_ops) \
+            or set(map(id, before_ops)) != set(map(id, after_ops)):
+        return [Finding(
+            check="motion_changed_ops", severity="error",
+            message=f"{pass_name}: op motion changed the op SET "
+                    f"({len(before_ops)} ops before, {len(after_ops)} "
+                    "after) — motion may only reorder")]
+    pos_after: Dict[int, int] = {id(op): i for i, op in enumerate(after_ops)}
+
+    reads: List[Set[str]] = []
+    writes: List[Set[str]] = []
+    for op in before_ops:
+        reads.append(set(op.input_names()) - {EMPTY})
+        writes.append(set(op.output_names()) - {EMPTY})
+
+    for i in range(len(before_ops)):
+        for j in range(i + 1, len(before_ops)):
+            dependent = bool(writes[i] & reads[j]) \
+                or bool(reads[i] & writes[j]) \
+                or bool(writes[i] & writes[j])
+            if not dependent:
+                continue
+            if pos_after[id(before_ops[i])] > pos_after[id(before_ops[j])]:
+                shared = sorted((writes[i] & reads[j])
+                                | (reads[i] & writes[j])
+                                | (writes[i] & writes[j]))[:4]
+                findings.append(Finding(
+                    check="motion_broke_dataflow", severity="error",
+                    message=f"{pass_name}: reordered dependent ops "
+                            f"{before_ops[i].type!r} (was {i}) and "
+                            f"{before_ops[j].type!r} (was {j}) sharing "
+                            f"{shared}",
+                    op_index=pos_after[id(before_ops[j])],
+                    op_type=before_ops[j].type))
+    return findings

@@ -1,0 +1,56 @@
+"""Typed findings shared by every analysis in this package.
+
+A Finding is deliberately flat and JSON-trivial: the lint CLI prints lists
+of them verbatim (`scripts/program_lint.py --json`), the verify-after-pass
+harness embeds them in PassVerificationError, and tests match on
+`check`/`severity` without parsing prose.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+# "error"  — the program is malformed / would misbehave: fails --assert and
+#            verify-after-pass.
+# "warning"— suspicious but legal (dead writes, unused outputs, donation
+#            copy taxes): reported, never fatal.
+SEVERITIES = ("error", "warning")
+
+
+@dataclass
+class Finding:
+    check: str                       # e.g. "def_before_use"
+    severity: str                    # "error" | "warning"
+    message: str
+    block: int = 0
+    op_index: Optional[int] = None   # index into block.ops, if op-anchored
+    op_type: Optional[str] = None
+    var: Optional[str] = None
+    pass_name: Optional[str] = None  # set by the verify-after-pass harness
+
+    def __post_init__(self):
+        assert self.severity in SEVERITIES, self.severity
+
+    def to_dict(self) -> dict:
+        return {k: v for k, v in self.__dict__.items() if v is not None}
+
+    def format(self) -> str:
+        where = f"block{self.block}"
+        if self.op_index is not None:
+            where += f" op{self.op_index}"
+        if self.op_type:
+            where += f"({self.op_type})"
+        if self.var:
+            where += f" var={self.var!r}"
+        head = f"[{self.severity}] {self.check} @ {where}: {self.message}"
+        if self.pass_name:
+            head += f" (after pass {self.pass_name!r})"
+        return head
+
+
+def errors_only(findings: List[Finding]) -> List[Finding]:
+    return [f for f in findings if f.severity == "error"]
+
+
+def format_findings(findings: List[Finding]) -> str:
+    return "\n".join(f.format() for f in findings)

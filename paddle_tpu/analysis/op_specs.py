@@ -1,0 +1,206 @@
+"""Op slot/attr metadata for the program verifier.
+
+The reference declares every op's slots and attrs up front (OpProto /
+OpMaker, `op_registry.h`) and validates op descs against them; this
+runtime's registry holds only lowerings (ops/registry.py), so slot names
+and attrs were historically checked by nothing until trace time. This
+module attaches OpSpec metadata to the registry (`registry.set_spec`) for
+the ops the pass pipeline emits or rewrites plus the high-traffic core —
+coverage is deliberately incremental: an op without a spec still gets the
+structural checks (def-before-use, dangling inputs, dtype rules), just not
+slot/attr validation. Add a spec here whenever the verifier's lint sweep
+surfaces an op whose malformed desc slipped through to a trace-time error.
+
+Spec semantics (validated by analysis/verifier.py):
+
+* inputs/outputs: {slot: (min_arity, max_arity|None)}; min >= 1 makes the
+  slot required. Slots not listed are "unknown_slot" errors unless
+  allow_extra_slots.
+* required_attrs: missing -> "missing_attr" error.
+* attr_types: {name: type | (types,)}; a present attr of the wrong type is
+  an "attr_type" error. list/tuple are interchangeable.
+* closed_attrs: attrs outside attr_types/required_attrs/COMMON_ATTRS are
+  "unknown_attr" warnings (only sensible for ops this repo fully emits —
+  the __dunder__ structural ops).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from ..ops import registry
+
+# Attrs any op may carry: role/bookkeeping markers set by builders and
+# program transforms, never consumed by a specific lowering.
+COMMON_ATTRS = frozenset({
+    "op_role", "__rng_seed__", "pipeline_stage", "is_test", "auto_selected",
+})
+
+
+class OpSpec:
+    __slots__ = ("inputs", "outputs", "required_attrs", "attr_types",
+                 "closed_attrs", "allow_extra_slots")
+
+    def __init__(self, inputs: Optional[Dict[str, Tuple]] = None,
+                 outputs: Optional[Dict[str, Tuple]] = None,
+                 required_attrs=(), attr_types: Optional[dict] = None,
+                 closed_attrs: bool = False, allow_extra_slots: bool = False):
+        self.inputs = dict(inputs or {})
+        self.outputs = dict(outputs or {})
+        self.required_attrs = tuple(required_attrs)
+        self.attr_types = dict(attr_types or {})
+        self.closed_attrs = closed_attrs
+        self.allow_extra_slots = allow_extra_slots
+
+
+_LIST = (list, tuple)
+_NUM = (int, float)
+
+# one required entry; "many" slots take 1..N; (0, ...) slots are optional
+ONE = (1, 1)
+MANY = (1, None)
+OPT = (0, 1)
+ANY = (0, None)
+
+SPECS: Dict[str, OpSpec] = {
+    # --- pass-pipeline structural ops (fully owned by this repo) ---------
+    "__segment__": OpSpec(
+        inputs={"X": ANY}, outputs={"Out": MANY},
+        required_attrs=("sub_ops", "in_names", "out_names"),
+        attr_types={"sub_ops": _LIST, "in_names": _LIST, "out_names": _LIST,
+                    "remat": bool},
+        closed_attrs=True),
+    "__layer_scan__": OpSpec(
+        inputs={"X": ONE, "Inv": ANY, "Stacked": ANY},
+        outputs={"Out": ONE},
+        required_attrs=("sub_ops", "num_layers", "carry_in", "carry_out",
+                        "inv_names", "stacked_names", "layer_seeds"),
+        attr_types={"sub_ops": _LIST, "num_layers": int, "carry_in": str,
+                    "carry_out": str, "inv_names": _LIST,
+                    "stacked_names": _LIST, "layer_seeds": _LIST,
+                    "remat": bool, "zero3_flat": _LIST},
+        closed_attrs=True),
+    "__bucket_sync__": OpSpec(
+        inputs={"X": MANY}, outputs={"Out": MANY},
+        required_attrs=("sizes", "shapes", "dtype"),
+        attr_types={"sizes": _LIST, "shapes": _LIST, "dtype": str},
+        closed_attrs=True),
+    "__zero_update__": OpSpec(
+        inputs={"Grad": MANY, "LearningRate": ONE, "FlatState": ANY,
+                "Param": ANY, "FlatParam": OPT,
+                "Beta1Pow": OPT, "Beta2Pow": OPT},
+        outputs={"ParamOut": ANY, "FlatStateOut": ANY, "FlatParamOut": OPT,
+                 "FlatGradOut": OPT},
+        required_attrs=("update_op", "update_attrs", "sizes", "shapes",
+                        "padded", "dtype", "state_kinds", "stage", "layout"),
+        attr_types={"update_op": str, "update_attrs": dict, "sizes": _LIST,
+                    "shapes": _LIST, "padded": int, "dtype": str,
+                    "state_kinds": _LIST, "stage": int, "layout": str,
+                    "pre_synced": bool, "num_layers": int},
+        closed_attrs=True),
+    "__zero_gather__": OpSpec(
+        inputs={"FlatParam": ONE}, outputs={"Out": MANY},
+        required_attrs=("sizes", "shapes", "dtypes", "padded"),
+        attr_types={"sizes": _LIST, "shapes": _LIST, "dtypes": _LIST,
+                    "padded": int},
+        closed_attrs=True),
+    "__zero_pack__": OpSpec(
+        inputs={"X": MANY}, outputs={"Out": ONE},
+        required_attrs=("padded", "dtype"),
+        attr_types={"padded": int, "dtype": str, "sizes": _LIST,
+                    "layout": str},
+        closed_attrs=True),
+    # --- control flow ----------------------------------------------------
+    "__cond__": OpSpec(
+        inputs={"Cond": ONE, "Free": ANY}, outputs={"Out": MANY},
+        required_attrs=("true_block", "false_block", "true_outs",
+                        "false_outs", "free_names"),
+        attr_types={"true_block": int, "false_block": int,
+                    "true_outs": _LIST, "false_outs": _LIST,
+                    "free_names": _LIST},
+        closed_attrs=True),
+    "__while__": OpSpec(
+        inputs={"Cond": ONE, "Carried": MANY, "Free": ANY},
+        outputs={"Out": MANY},
+        required_attrs=("sub_block", "carried_names", "free_names",
+                        "cond_name"),
+        attr_types={"sub_block": int, "carried_names": _LIST,
+                    "free_names": _LIST, "cond_name": str,
+                    "trip_bound": int},
+        closed_attrs=True),
+    "__scan__": OpSpec(
+        inputs={"X": ANY, "Init": ANY, "Free": ANY}, outputs={"Out": MANY},
+        required_attrs=("sub_block", "x_names", "mem_pre_names",
+                        "mem_upd_names", "out_names", "free_names"),
+        attr_types={"sub_block": int},
+        closed_attrs=True),
+    # --- optimizer update ops (the ZeRO pass rewrites these) -------------
+    "sgd": OpSpec(
+        inputs={"Param": ONE, "Grad": ONE, "LearningRate": ONE},
+        outputs={"ParamOut": ONE}),
+    "momentum": OpSpec(
+        inputs={"Param": ONE, "Grad": ONE, "Velocity": ONE,
+                "LearningRate": ONE},
+        outputs={"ParamOut": ONE, "VelocityOut": ONE},
+        attr_types={"mu": _NUM, "use_nesterov": bool}),
+    "adam": OpSpec(
+        inputs={"Param": ONE, "Grad": ONE, "LearningRate": ONE,
+                "Moment1": ONE, "Moment2": ONE, "Beta1Pow": ONE,
+                "Beta2Pow": ONE},
+        outputs={"ParamOut": ONE, "Moment1Out": ONE, "Moment2Out": ONE,
+                 "Beta1PowOut": OPT, "Beta2PowOut": OPT},
+        attr_types={"beta1": _NUM, "beta2": _NUM, "epsilon": _NUM}),
+    "adamw": OpSpec(
+        inputs={"Param": ONE, "Grad": ONE, "LearningRate": ONE,
+                "Moment1": ONE, "Moment2": ONE, "Beta1Pow": ONE,
+                "Beta2Pow": ONE},
+        outputs={"ParamOut": ONE, "Moment1Out": ONE, "Moment2Out": ONE,
+                 "Beta1PowOut": OPT, "Beta2PowOut": OPT},
+        attr_types={"beta1": _NUM, "beta2": _NUM, "epsilon": _NUM,
+                    "coeff": _NUM, "weight_decay": _NUM}),
+    # --- high-traffic core ops -------------------------------------------
+    "sum": OpSpec(inputs={"X": MANY}, outputs={"Out": ONE}),
+    "assign": OpSpec(inputs={"X": ONE}, outputs={"Out": ONE}),
+    "cast": OpSpec(inputs={"X": ONE}, outputs={"Out": ONE},
+                   attr_types={"out_dtype": str, "in_dtype": str}),
+    "fill_constant": OpSpec(
+        inputs={}, outputs={"Out": ONE},
+        attr_types={"shape": _LIST, "dtype": str, "value": _NUM}),
+    "concat": OpSpec(inputs={"X": MANY}, outputs={"Out": ONE},
+                     attr_types={"axis": int}),
+    "stack": OpSpec(inputs={"X": MANY}, outputs={"Y": ONE},
+                    attr_types={"axis": int}),
+    "where": OpSpec(inputs={"Condition": ONE, "X": ONE, "Y": ONE},
+                    outputs={"Out": ONE}),
+    "scale": OpSpec(inputs={"X": ONE}, outputs={"Out": ONE},
+                    attr_types={"scale": _NUM, "bias": _NUM,
+                                "bias_after_scale": bool}),
+    "mean": OpSpec(inputs={"X": ONE}, outputs={"Out": ONE}),
+    "matmul": OpSpec(inputs={"X": ONE, "Y": ONE}, outputs={"Out": ONE},
+                     attr_types={"transpose_X": bool, "transpose_Y": bool,
+                                 "alpha": _NUM}),
+    "mul": OpSpec(inputs={"X": ONE, "Y": ONE}, outputs={"Out": ONE},
+                  attr_types={"x_num_col_dims": int, "y_num_col_dims": int}),
+    "dropout": OpSpec(
+        inputs={"X": ONE}, outputs={"Out": ONE, "Mask": OPT},
+        attr_types={"dropout_prob": _NUM, "dropout_implementation": str,
+                    "seed": int, "fix_seed": bool}),
+    "softmax_with_cross_entropy": OpSpec(
+        inputs={"Logits": ONE, "Label": ONE},
+        outputs={"Softmax": OPT, "Loss": ONE},
+        attr_types={"soft_label": bool, "ignore_index": int, "axis": int}),
+}
+
+for _name in ("elementwise_add", "elementwise_sub", "elementwise_mul",
+              "elementwise_div", "elementwise_min", "elementwise_max",
+              "elementwise_pow", "elementwise_mod"):
+    SPECS[_name] = OpSpec(inputs={"X": ONE, "Y": ONE}, outputs={"Out": ONE},
+                          attr_types={"axis": int})
+
+
+def install() -> None:
+    """Idempotently attach the spec table to the op registry."""
+    for name, spec in SPECS.items():
+        registry.set_spec(name, spec)
+
+
+install()

@@ -1,0 +1,105 @@
+"""Verify-after-pass harness (FLAGS_verify_passes).
+
+The reference pairs every `ir::Graph` pass with a dedicated tester
+(`ir/*_tester.cc` + `pass_tester_helper.h`) that rebuilds a graph and
+asserts the rewrite left it sane. Here the same guarantee is a runtime
+mode: with `FLAGS_verify_passes=1`, every program pass in
+parallel/transforms.py / parallel/zero.py / fleet minimize runs inside
+`checked_pass(name, program)`, which
+
+* snapshots the op list before the pass,
+* runs the structural verifier (analysis/verifier.py) plus the collective
+  checker after it, and
+* on any error-severity finding raises PassVerificationError NAMING THE
+  OFFENDING PASS and carrying a unified before/after op diff — the
+  postmortem arrives at build time, in milliseconds, instead of as a
+  trace-time stack or a silent numeric drift a full compile later.
+
+The harness is read-only: it never mutates the program, so verified and
+unverified builds produce byte-identical program descs (pinned by
+tests/test_program_lint.py).
+
+A new pass opts in with:
+
+    from ..analysis.passes import checked_pass
+    def apply_my_pass(program, ...):
+        with checked_pass("my_pass", program):
+            ... rewrite program ...
+
+Code-motion passes additionally validate dataflow preservation via
+`analysis.collectives.dataflow_preserved` (see zero.apply_grad_bucketing's
+sink loop).
+"""
+from __future__ import annotations
+
+import contextlib
+import difflib
+from typing import List
+
+from .findings import Finding, errors_only, format_findings
+
+
+class PassVerificationError(RuntimeError):
+    """A program pass left the program malformed."""
+
+    def __init__(self, pass_name: str, findings: List[Finding],
+                 diff: str = ""):
+        self.pass_name = pass_name
+        self.findings = findings
+        self.diff = diff
+        msg = (f"pass {pass_name!r} left the program malformed "
+               f"({len(findings)} error finding(s), FLAGS_verify_passes):\n"
+               f"{format_findings(findings)}")
+        if diff:
+            msg += f"\nbefore/after op diff:\n{diff}"
+        super().__init__(msg)
+
+
+def verify_passes_enabled() -> bool:
+    from ..flags import flag
+    return bool(flag("FLAGS_verify_passes"))
+
+
+def _op_lines(program) -> List[str]:
+    """One stable line per op (the diff unit)."""
+    lines = []
+    for b in program.blocks:
+        for op in b.ops:
+            ins = {s: list(v) for s, v in sorted(op.inputs.items())}
+            outs = {s: list(v) for s, v in sorted(op.outputs.items())}
+            lines.append(f"b{b.idx} {op.type} {ins} -> {outs}")
+    return lines
+
+
+def _diff(before: List[str], after: List[str], limit: int = 60) -> str:
+    delta = list(difflib.unified_diff(before, after, lineterm="",
+                                      fromfile="before", tofile="after"))
+    if len(delta) > limit:
+        delta = delta[:limit] + [f"... ({len(delta) - limit} more lines)"]
+    return "\n".join(delta)
+
+
+@contextlib.contextmanager
+def checked_pass(pass_name: str, program,
+                 startup_program=None):
+    """Run the body (one program pass) and, under FLAGS_verify_passes,
+    verify the program(s) afterwards — raising PassVerificationError with
+    the pass name and a before/after op diff on any error finding. A no-op
+    (zero overhead beyond one flag read) when the flag is off."""
+    if not verify_passes_enabled():
+        yield
+        return
+    before = _op_lines(program)
+    yield
+    from .collectives import check_collectives
+    from .verifier import verify_program
+    findings = verify_program(program)
+    findings += check_collectives(program)
+    if startup_program is not None:
+        findings += verify_program(startup_program)
+    errs = errors_only(findings)
+    if errs:
+        for f in errs:
+            f.pass_name = pass_name
+        raise PassVerificationError(pass_name, errs,
+                                    _diff(before, _op_lines(program)))

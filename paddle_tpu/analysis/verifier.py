@@ -1,0 +1,547 @@
+"""Structural Program/Block verifier.
+
+Reference counterpart: the graph sanity layer under `framework/ir` — pass
+testers assert a rewritten `ir::Graph` is still well-formed
+(`pass_tester_helper.h`), and OpDesc validation happens against OpProto
+declarations at build time. Here one function, `verify_program`, checks a
+Program IR (framework/program.py) statically — no trace, no compile, no
+scope — and returns typed Findings (analysis/findings.py):
+
+* def-before-use in op order (feeds / data vars / persistables count as
+  defined; sub-blocks see their ancestors' names),
+* dangling inputs & undeclared outputs (names with no Variable anywhere),
+* duplicate definitions (a non-persistable var overwritten before any
+  read of the previous value — a dead write),
+* unused outputs (produced, never read, not fetched, not persistable),
+* op slot/attr validation against the registry spec table
+  (analysis/op_specs.py; ops without a spec skip only this check),
+* dtype propagation (cast out-dtype vs var, elementwise operand dtypes,
+  optimizer Param/Grad dtypes, `__vjp__` grad-var shape/dtype vs the
+  forward input),
+* sub-graph scoping for the fused/structural ops: `__segment__`,
+  `__layer_scan__`, `__bucket_sync__`, `__zero_update__`,
+  `__zero_gather__`, `__zero_pack__`, and the control-flow sub-block ops.
+
+Severity contract: "error" means the program is malformed (fails
+`--assert` and FLAGS_verify_passes); "warning" is advisory and never
+fatal. docs/static_analysis.md catalogs every check.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Set
+
+from ..framework.dtype import convert_dtype
+from ..ops import registry
+from . import op_specs  # noqa: F401  (installs the spec table on import)
+from .findings import Finding
+
+EMPTY = "@EMPTY@"
+
+# Aux output slots the reference declares AsIntermediate() in their
+# OpMakers: written for op-API parity (mask/shape/statistics side outputs)
+# and legitimately unread by the rest of the program — exempt from the
+# unused_output check so it reports actual dead values, not convention.
+_INTERMEDIATE_OUTPUT_SLOTS = frozenset({
+    "XShape", "Mask", "Mean", "Variance", "Softmax", "SavedMean",
+    "SavedVariance", "GateIdx", "AuxLoss", "BatchSize", "BatchSum",
+    "BatchSquareSum", "StatPos", "StatNeg", "SeedOut", "ReserveSpace",
+})
+
+_ELEMENTWISE_BINARY = frozenset({
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_min", "elementwise_max",
+    "elementwise_pow", "elementwise_mod"})
+
+# slots of control-flow ops that name a sub-block in attrs
+_SUB_BLOCK_ATTRS = ("sub_block", "true_block", "false_block")
+
+
+def _numel(shape) -> int:
+    n = 1
+    for d in shape:
+        n *= max(int(d), 1)
+    return n
+
+
+def verify_program(program, feed_names=(), fetch_names=()) -> List[Finding]:
+    """Statically verify every block of `program`; returns all Findings
+    (errors and warnings), empty when fully clean."""
+    findings: List[Finding] = []
+    feed_names = set(feed_names)
+    fetch_names = set(fetch_names)
+
+    # global read map (any block + inside sub_ops descs) for unused-output
+    reads_anywhere: Set[str] = set(fetch_names)
+    for b in program.blocks:
+        for op in b.ops:
+            reads_anywhere.update(n for n in op.input_names() if n != EMPTY)
+            _collect_sub_op_reads(op.attrs, reads_anywhere)
+
+    for block in program.blocks:
+        findings.extend(_verify_block(program, block, feed_names,
+                                      reads_anywhere))
+    return findings
+
+
+def _collect_sub_op_reads(attrs, acc: Set[str]) -> None:
+    for od in attrs.get("sub_ops") or ():
+        for names in od.get("inputs", {}).values():
+            acc.update(n for n in names if n != EMPTY)
+        _collect_sub_op_reads(od.get("attrs", {}), acc)
+
+
+def _ancestor_names(program, block) -> Set[str]:
+    """Names visible from ancestor blocks (sub-block ops execute inside a
+    parent op with the parent env mid-flight; fine-grained cross-block
+    ordering is intentionally out of scope)."""
+    names: Set[str] = set()
+    b = block.parent_block
+    while b is not None:
+        names.update(b.vars)
+        for op in b.ops:
+            names.update(n for n in op.output_names() if n != EMPTY)
+        b = b.parent_block
+    return names
+
+
+def _verify_block(program, block, feed_names, reads_anywhere) \
+        -> List[Finding]:
+    findings: List[Finding] = []
+    bidx = block.idx
+
+    def emit(check, severity, message, op_index=None, op_type=None,
+             var=None):
+        findings.append(Finding(check=check, severity=severity,
+                                message=message, block=bidx,
+                                op_index=op_index, op_type=op_type,
+                                var=var))
+
+    defined: Set[str] = set(feed_names)
+    for name in list(block.vars) + list(_iter_visible_parent_vars(block)):
+        v = block.find_var_recursive(name)
+        if v is not None and (v.persistable or v.is_data):
+            defined.add(name)
+    if block.parent_idx >= 0:
+        defined |= _ancestor_names(program, block)
+
+    last_write: Dict[str, int] = {}
+    read_since_write: Set[str] = set()
+
+    for i, op in enumerate(block.ops):
+        opdef = registry._REGISTRY.get(op.type)
+        if opdef is None:
+            emit("unregistered_op", "warning",
+                 f"op type {op.type!r} has no registered lowering; "
+                 "execution would fail loudly", i, op.type)
+
+        # ---- inputs: resolution + def-before-use ------------------------
+        for slot, names in op.inputs.items():
+            for n in names:
+                if n == EMPTY:
+                    continue
+                v = block.find_var_recursive(n)
+                if v is None and n not in defined and n not in last_write:
+                    emit("dangling_input", "error",
+                         f"input {slot}[{names.index(n)}] reads {n!r}, "
+                         "which no block declares and no feed or prior op "
+                         "defines", i, op.type, n)
+                    continue
+                if n not in defined and n not in last_write:
+                    emit("def_before_use", "error",
+                         f"input {slot} reads {n!r} before any op defines "
+                         "it (not a feed, data var, or persistable)",
+                         i, op.type, n)
+                read_since_write.add(n)
+
+        # ---- op-specific structural/dtype checks ------------------------
+        findings.extend(_check_spec(block, i, op))
+        findings.extend(_check_dtypes(block, i, op))
+        findings.extend(_check_sub_graphs(program, block, i, op))
+
+        # ---- outputs: resolution + duplicate definitions ----------------
+        for slot, names in op.outputs.items():
+            for n in names:
+                if n == EMPTY:
+                    continue
+                v = block.find_var_recursive(n)
+                if v is None:
+                    emit("undeclared_output", "error",
+                         f"output {slot} writes {n!r}, which no block "
+                         "declares as a Variable", i, op.type, n)
+                    # still record the definition: later readers are fine
+                    # — blaming each of them with a cascading
+                    # dangling_input would bury the one root-cause write
+                    last_write[n] = i
+                    defined.add(n)
+                    continue
+                if n in last_write and n not in read_since_write \
+                        and not v.persistable:
+                    emit("duplicate_definition", "warning",
+                         f"{n!r} is overwritten (previous write at op "
+                         f"{last_write[n]}) before any read — the first "
+                         "write is dead", i, op.type, n)
+                last_write[n] = i
+                read_since_write.discard(n)
+                defined.add(n)
+
+    # ---- unused outputs -------------------------------------------------
+    for i, op in enumerate(block.ops):
+        for slot, names in op.outputs.items():
+            if slot in _INTERMEDIATE_OUTPUT_SLOTS:
+                continue
+            for n in names:
+                if n == EMPTY or n in reads_anywhere:
+                    continue
+                v = block.find_var_recursive(n)
+                if v is None or v.persistable:
+                    continue   # persistables are observable state
+                emit("unused_output", "warning",
+                     f"output {slot} var {n!r} is never read by any op and "
+                     "is not a fetch target", i, op.type, n)
+    return findings
+
+
+def _iter_visible_parent_vars(block):
+    b = block.parent_block
+    while b is not None:
+        yield from b.vars
+        b = b.parent_block
+
+
+# ---------------------------------------------------------------------------
+# registry slot/attr validation
+# ---------------------------------------------------------------------------
+
+def _check_spec(block, i, op) -> List[Finding]:
+    spec = registry.get_spec(op.type)
+    if spec is None:
+        return []
+    out: List[Finding] = []
+
+    def emit(check, severity, message, var=None):
+        out.append(Finding(check=check, severity=severity, message=message,
+                           block=block.idx, op_index=i, op_type=op.type,
+                           var=var))
+
+    for kind, declared, actual in (("input", spec.inputs, op.inputs),
+                                   ("output", spec.outputs, op.outputs)):
+        for slot, names in actual.items():
+            if slot not in declared:
+                # __vjp__-style dynamic slots never get specs; any spec'd
+                # op with an undeclared slot is malformed
+                if not spec.allow_extra_slots:
+                    emit("unknown_slot", "error",
+                         f"{kind} slot {slot!r} is not declared for "
+                         f"{op.type!r} (declared: {sorted(declared)})")
+                continue
+            lo, hi = declared[slot]
+            if len(names) < lo or (hi is not None and len(names) > hi):
+                emit("slot_arity", "error",
+                     f"{kind} slot {slot!r} carries {len(names)} entries; "
+                     f"spec requires [{lo}, {hi if hi is not None else '∞'}]")
+        for slot, (lo, _hi) in declared.items():
+            if lo >= 1 and not actual.get(slot):
+                emit("missing_slot", "error",
+                     f"required {kind} slot {slot!r} is absent")
+
+    for name in spec.required_attrs:
+        if name not in op.attrs:
+            emit("missing_attr", "error",
+                 f"required attr {name!r} is absent")
+    for name, want in spec.attr_types.items():
+        if name not in op.attrs:
+            continue
+        val = op.attrs[name]
+        want_t = want if isinstance(want, tuple) else (want,)
+        # bool is an int subclass: an int-typed attr accepts bools only
+        # when bool is itself declared
+        if isinstance(val, bool) and bool not in want_t:
+            ok = False
+        else:
+            ok = isinstance(val, want_t)
+        if not ok:
+            emit("attr_type", "error",
+                 f"attr {name!r} is {type(val).__name__}, spec wants "
+                 f"{'/'.join(t.__name__ for t in want_t)}")
+    if spec.closed_attrs:
+        known = set(spec.required_attrs) | set(spec.attr_types) \
+            | op_specs.COMMON_ATTRS
+        for name in op.attrs:
+            if name not in known:
+                emit("unknown_attr", "warning",
+                     f"attr {name!r} is not declared for {op.type!r}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# dtype propagation checks
+# ---------------------------------------------------------------------------
+
+def _var(block, name):
+    return None if name == EMPTY else block.find_var_recursive(name)
+
+
+def _is_float(dtype) -> bool:
+    import numpy as np
+    try:
+        return np.issubdtype(np.dtype(dtype), np.floating)
+    except Exception:
+        return False
+
+
+def _check_dtypes(block, i, op) -> List[Finding]:
+    out: List[Finding] = []
+
+    def emit(check, severity, message, var=None):
+        out.append(Finding(check=check, severity=severity, message=message,
+                           block=block.idx, op_index=i, op_type=op.type,
+                           var=var))
+
+    if op.type == "cast" and "out_dtype" in op.attrs:
+        v = _var(block, (op.outputs.get("Out") or [EMPTY])[0])
+        if v is not None:
+            try:
+                want = convert_dtype(op.attrs["out_dtype"])
+            except Exception:
+                want = None
+            if want is not None and convert_dtype(v.dtype) != want:
+                emit("dtype_mismatch", "error",
+                     f"cast declares out_dtype={op.attrs['out_dtype']!r} "
+                     f"but output var records {v.dtype}", v.name)
+
+    elif op.type in _ELEMENTWISE_BINARY:
+        x = _var(block, (op.inputs.get("X") or [EMPTY])[0])
+        y = _var(block, (op.inputs.get("Y") or [EMPTY])[0])
+        if x is not None and y is not None \
+                and _is_float(x.dtype) and _is_float(y.dtype) \
+                and convert_dtype(x.dtype) != convert_dtype(y.dtype):
+            emit("dtype_mismatch", "warning",
+                 f"operands differ: X={x.dtype} vs Y={y.dtype} "
+                 "(implicit promotion at lowering)", x.name)
+
+    elif op.type == "__vjp__":
+        # grad vars mirror their forward inputs exactly (_vjp_infer)
+        for slot, names in op.outputs.items():
+            if not slot.startswith("IG:"):
+                continue
+            fwd_names = op.inputs.get(slot[3:], [])
+            for gn, fn in zip(names, fwd_names):
+                gv, fv = _var(block, gn), _var(block, fn)
+                if gv is None or fv is None:
+                    continue
+                if convert_dtype(gv.dtype) != convert_dtype(fv.dtype):
+                    emit("dtype_mismatch", "error",
+                         f"grad var {gn!r} is {gv.dtype} but forward input "
+                         f"{fn!r} is {fv.dtype}", gn)
+                gs, fs = tuple(gv.shape), tuple(fv.shape)
+                if gs and fs and -1 not in gs and -1 not in fs and gs != fs:
+                    emit("grad_shape", "error",
+                         f"grad var {gn!r} shape {gs} != forward input "
+                         f"{fn!r} shape {fs}", gn)
+
+    else:
+        from ..parallel.zero import _UPDATE_STATE_SLOTS
+        if op.type in _UPDATE_STATE_SLOTS:
+            p = _var(block, (op.inputs.get("Param") or [EMPTY])[0])
+            g = _var(block, (op.inputs.get("Grad") or [EMPTY])[0])
+            if p is not None and g is not None \
+                    and _is_float(p.dtype) and _is_float(g.dtype) \
+                    and convert_dtype(p.dtype) != convert_dtype(g.dtype):
+                emit("dtype_mismatch", "warning",
+                     f"update mixes Param dtype {p.dtype} with Grad dtype "
+                     f"{g.dtype}", p.name)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# sub-graph scoping (__segment__ / __layer_scan__ / __zero_*__ / control flow)
+# ---------------------------------------------------------------------------
+
+def _check_sub_ops_scope(emit, sub_ops, env0: Set[str], what: str) \
+        -> Set[str]:
+    """Def-before-use over a sub_ops desc list given the initial env;
+    returns the produced-name set."""
+    produced: Set[str] = set()
+    for j, od in enumerate(sub_ops):
+        for slot, names in od.get("inputs", {}).items():
+            for n in names:
+                if n == EMPTY or n in env0 or n in produced:
+                    continue
+                emit("sub_graph_scope", "error",
+                     f"{what} sub-op {j} ({od.get('type')}) reads {n!r}, "
+                     "which neither the body env nor an earlier sub-op "
+                     "defines", n)
+        for names in od.get("outputs", {}).values():
+            produced.update(n for n in names if n != EMPTY)
+    return produced
+
+
+def _check_sub_graphs(program, block, i, op) -> List[Finding]:
+    out: List[Finding] = []
+
+    def emit(check, severity, message, var=None):
+        out.append(Finding(check=check, severity=severity, message=message,
+                           block=block.idx, op_index=i, op_type=op.type,
+                           var=var))
+
+    t = op.type
+    a = op.attrs
+
+    if t == "__segment__":
+        sub_ops = a.get("sub_ops") or []
+        in_names = list(a.get("in_names") or ())
+        out_names = list(a.get("out_names") or ())
+        if list(op.inputs.get("X", ())) != in_names:
+            emit("sub_graph_scope", "error",
+                 "in_names attr does not match the X input list")
+        if list(op.outputs.get("Out", ())) != out_names:
+            emit("sub_graph_scope", "error",
+                 "out_names attr does not match the Out output list")
+        produced = _check_sub_ops_scope(
+            lambda c, s, m, v=None: emit(c, s, m, v),
+            sub_ops, set(in_names), "__segment__")
+        for n in out_names:
+            if n not in produced and n not in in_names:
+                emit("sub_graph_scope", "error",
+                     f"__segment__ output {n!r} is produced by no sub-op",
+                     n)
+
+    elif t == "__layer_scan__":
+        sub_ops = a.get("sub_ops") or []
+        stacked = list(a.get("stacked_names") or ())
+        inv = list(a.get("inv_names") or ())
+        carry_in, carry_out = a.get("carry_in"), a.get("carry_out")
+        n_layers = a.get("num_layers")
+        env0 = set(inv) | set(stacked) | ({carry_in} if carry_in else set())
+        produced = _check_sub_ops_scope(
+            lambda c, s, m, v=None: emit(c, s, m, v),
+            sub_ops, env0, "__layer_scan__")
+        if carry_out and carry_out not in produced \
+                and carry_out != carry_in:
+            emit("sub_graph_scope", "error",
+                 f"scan carry_out {carry_out!r} is produced by no sub-op",
+                 carry_out)
+        if len(op.inputs.get("Stacked", ())) != len(stacked):
+            emit("sub_graph_scope", "error",
+                 f"{len(op.inputs.get('Stacked', ()))} Stacked inputs vs "
+                 f"{len(stacked)} stacked_names")
+        if len(op.inputs.get("Inv", ())) != len(inv):
+            emit("sub_graph_scope", "error",
+                 f"{len(op.inputs.get('Inv', ()))} Inv inputs vs "
+                 f"{len(inv)} inv_names")
+        seeds = a.get("layer_seeds")
+        if isinstance(seeds, (list, tuple)):
+            if len(seeds) != len(sub_ops):
+                emit("sub_graph_scope", "error",
+                     f"layer_seeds has {len(seeds)} entries for "
+                     f"{len(sub_ops)} sub-ops")
+            for s in seeds:
+                if s is not None and isinstance(n_layers, int) \
+                        and len(s) != n_layers:
+                    emit("sub_graph_scope", "error",
+                         f"a layer_seeds entry has {len(s)} seeds for "
+                         f"num_layers={n_layers}")
+        z3 = a.get("zero3_flat")
+        if z3 is not None and len(z3) != len(stacked):
+            emit("sub_graph_scope", "error",
+                 f"zero3_flat has {len(z3)} entries for {len(stacked)} "
+                 "stacked params")
+
+    elif t == "__bucket_sync__":
+        xs = op.inputs.get("X", ())
+        sizes = a.get("sizes") or []
+        shapes = a.get("shapes") or []
+        if not (len(xs) == len(op.outputs.get("Out", ()))
+                == len(sizes) == len(shapes)):
+            emit("bucket_meta", "error",
+                 f"arity mismatch: {len(xs)} X / "
+                 f"{len(op.outputs.get('Out', ()))} Out / {len(sizes)} "
+                 f"sizes / {len(shapes)} shapes")
+        else:
+            for n, size, shape in zip(xs, sizes, shapes):
+                if _numel(shape) != int(size):
+                    emit("bucket_meta", "error",
+                         f"size {size} != prod(shape {list(shape)}) for "
+                         f"{n!r}", n)
+
+    elif t == "__zero_update__":
+        from ..parallel.zero import PAD_MULTIPLE, _UPDATE_STATE_SLOTS
+        upd = a.get("update_op")
+        if upd not in _UPDATE_STATE_SLOTS:
+            emit("bucket_meta", "error",
+                 f"update_op {upd!r} has no flat-shard update rule "
+                 f"(supported: {sorted(_UPDATE_STATE_SLOTS)})")
+        else:
+            kinds = list(a.get("state_kinds") or ())
+            legal = set(_UPDATE_STATE_SLOTS[upd])
+            if not set(kinds) <= legal:
+                emit("bucket_meta", "error",
+                     f"state_kinds {kinds} outside {sorted(legal)} for "
+                     f"update_op {upd!r}")
+            if len(op.inputs.get("FlatState", ())) != len(kinds):
+                emit("bucket_meta", "error",
+                     f"{len(op.inputs.get('FlatState', ()))} FlatState "
+                     f"inputs vs {len(kinds)} state_kinds")
+        sizes = a.get("sizes") or []
+        shapes = a.get("shapes") or []
+        padded = a.get("padded")
+        if len(sizes) != len(shapes):
+            emit("bucket_meta", "error",
+                 f"{len(sizes)} sizes vs {len(shapes)} shapes")
+        elif any(_numel(sh) != int(sz)
+                 for sz, sh in zip(sizes, shapes)):
+            emit("bucket_meta", "error", "a size != prod(its shape)")
+        if isinstance(padded, int):
+            if sum(int(s) for s in sizes) > padded:
+                emit("bucket_meta", "error",
+                     f"sum(sizes)={sum(sizes)} exceeds padded={padded}")
+            if a.get("layout") == "flat" and padded % PAD_MULTIPLE:
+                emit("bucket_meta", "error",
+                     f"padded={padded} is not a multiple of "
+                     f"{PAD_MULTIPLE} (mesh-independent layout contract)")
+        stage = a.get("stage")
+        if isinstance(stage, int):
+            if stage >= 3 and not op.inputs.get("FlatParam"):
+                emit("bucket_meta", "error",
+                     "stage>=3 update lacks the FlatParam input")
+            if stage < 3 and not op.inputs.get("Param"):
+                emit("bucket_meta", "error",
+                     "stage<3 update lacks the Param inputs")
+
+    elif t == "__zero_gather__":
+        sizes = a.get("sizes") or []
+        shapes = a.get("shapes") or []
+        dtypes = a.get("dtypes") or []
+        outs = op.outputs.get("Out", ())
+        if not (len(outs) == len(sizes) == len(shapes) == len(dtypes)):
+            emit("bucket_meta", "error",
+                 f"arity mismatch: {len(outs)} Out / {len(sizes)} sizes / "
+                 f"{len(shapes)} shapes / {len(dtypes)} dtypes")
+        elif isinstance(a.get("padded"), int) \
+                and sum(int(s) for s in sizes) > a["padded"]:
+            emit("bucket_meta", "error",
+                 f"sum(sizes)={sum(sizes)} exceeds padded={a['padded']}")
+
+    for attr in _SUB_BLOCK_ATTRS:
+        idx = a.get(attr)
+        if idx is None or not isinstance(idx, int):
+            continue
+        if not (0 <= idx < len(program.blocks)):
+            emit("sub_block_scope", "error",
+                 f"attr {attr}={idx} names no block (program has "
+                 f"{len(program.blocks)})")
+            continue
+        sub = program.blocks[idx]
+        # the sub-block must sit under the op's block in the parent chain
+        b = sub
+        ok = False
+        while b is not None:
+            if b.idx == block.idx:
+                ok = True
+                break
+            b = b.parent_block
+        if not ok:
+            emit("sub_block_scope", "error",
+                 f"block {idx} ({attr}) is not a descendant of the op's "
+                 f"block {block.idx}")
+    return out

@@ -536,6 +536,22 @@ class DistributedOptimizer:
             mesh=self._fleet._mesh, param_rules=rules,
             state_specs=dict(getattr(program, "_zero_state_specs", None)
                              or {})))
+
+        # FLAGS_verify_passes: each pass above already self-verified
+        # (checked_pass inside apply_layer_scan / apply_recompute /
+        # gradient merge / apply_grad_bucketing); this final gate verifies
+        # the COMPOSED result — backward + optimizer ops included — plus
+        # the collective-consistency check, so a bad pass INTERACTION
+        # fails here with the full op diff even when each pass was
+        # individually clean
+        from ...analysis.passes import checked_pass, verify_passes_enabled
+        if verify_passes_enabled():
+            from ...framework.program import default_startup_program
+            with checked_pass(
+                    "fleet_minimize", program,
+                    startup_program=startup_program
+                    or default_startup_program()):
+                pass
         return result
 
     def apply_gradients(self, params_grads):

@@ -50,6 +50,17 @@ _DEFS: Dict[str, tuple] = {
                             "0 keeps replicated state (grouped bucket "
                             "all-reduces still apply). Same switch as "
                             "DistributedStrategy.sharding_stage"),
+    "FLAGS_verify_passes": (False, "run the static program verifier "
+                            "(paddle_tpu/analysis/) after EVERY program "
+                            "pass — layer_scan, recompute, gradient merge, "
+                            "grad bucketing/ZeRO, sink code motion, fleet "
+                            "minimize. An error-severity finding raises "
+                            "PassVerificationError naming the offending "
+                            "pass with a before/after op diff; the sink "
+                            "motion additionally re-proves dataflow "
+                            "preservation. Read-only: verified and "
+                            "unverified builds produce byte-identical "
+                            "programs (docs/static_analysis.md)"),
     "FLAGS_layer_scan": (False, "roll isomorphic per-layer segments into "
                                 "one lax.scan at fleet minimize time "
                                 "(parallel/transforms.apply_layer_scan; "

@@ -62,6 +62,22 @@ class OpDef:
 
 _REGISTRY: Dict[str, OpDef] = {}
 
+# Optional per-op slot/attr metadata consumed by the program verifier
+# (paddle_tpu/analysis/verifier.py). Kept as an opaque side table so op
+# modules never pay an import or a construction cost for it; populated by
+# paddle_tpu/analysis/op_specs.py (the reference's OpProto/OpMaker
+# declarations, reduced to what static checking needs).
+_SPECS: Dict[str, object] = {}
+
+
+def set_spec(name: str, spec) -> None:
+    """Attach verifier metadata (an analysis.op_specs.OpSpec) to an op."""
+    _SPECS[name] = spec
+
+
+def get_spec(name: str):
+    return _SPECS.get(name)
+
 
 def register(name: str, *, infer=None, is_random=False, nondiff_slots=(),
              stateful_outputs=()):

@@ -303,6 +303,17 @@ def apply_layer_scan(program: Program, boundaries: List,
     the roll consumed (callers drop them from recompute checkpoint lists —
     `remat=True` already rematerializes per layer), or None on fallback.
     """
+    from ..analysis.passes import checked_pass
+    with checked_pass("layer_scan", program,
+                      startup_program=startup_program):
+        return _apply_layer_scan(program, boundaries, remat=remat,
+                                 startup_program=startup_program,
+                                 min_layers=min_layers)
+
+
+def _apply_layer_scan(program: Program, boundaries: List,
+                      remat: bool = False, startup_program=None,
+                      min_layers: int = 2) -> Optional[List[str]]:
     block = program.global_block()
     bounds = [b.name if hasattr(b, "name") else str(b) for b in boundaries]
     if len(bounds) < max(int(min_layers), 2):
@@ -472,6 +483,12 @@ def apply_recompute(program: Program, checkpoints: List[str]):
     Backward (__vjp__ of __segment__) then keeps only segment-boundary
     activations live; everything inside is recomputed.
     """
+    from ..analysis.passes import checked_pass
+    with checked_pass("recompute", program):
+        return _apply_recompute(program, checkpoints)
+
+
+def _apply_recompute(program: Program, checkpoints: List[str]):
     block = program.global_block()
     ck = set(checkpoints)
     fwd_ops = [op for op in block.ops
@@ -566,6 +583,11 @@ class GradientMergeWrapper:
         return [], params_grads
 
     def apply_gradients_merged(self, program, params_grads):
+        from ..analysis.passes import checked_pass
+        with checked_pass("gradient_merge", program):
+            return self._apply_gradients_merged(program, params_grads)
+
+    def _apply_gradients_merged(self, program, params_grads):
         from .. import layers
         from ..framework import unique_name
         block = program.global_block()

@@ -105,17 +105,24 @@ _UPDATE_EXTRA_SLOTS = {
 # Ops whose semantics couple examples ACROSS the batch beyond a trailing
 # mean-reduced loss: under GSPMD they see the global batch (sync-BN by
 # construction); a manual-dp shard would silently compute LOCAL statistics,
-# so their presence disables the manual path entirely.
-_CROSS_BATCH_OPS = frozenset({"batch_norm", "data_norm", "inplace_abn"})
+# so their presence disables the manual path entirely. switch_moe belongs
+# here too: expert capacity is FCFS over the token axis and the aux
+# balancing loss averages routing stats over it, so a per-shard run drops
+# different tokens and reports different aux than the global batch
+# (tests/test_moe.py ep-sharded parity pins this).
+_CROSS_BATCH_OPS = frozenset({"batch_norm", "data_norm", "inplace_abn",
+                              "switch_moe"})
 
 
 def count_fallback(cause: str) -> None:
     """Per-cause manual-dp fallback accounting (monitor): the total under
     `executor.zero_manual_fallbacks` plus a `.<cause>` breakdown — a silent
     fallback to GSPMD is diagnosable from monitor stats alone. Causes:
-    mixed_mesh, batch_norm, selected_rows, pipeline, grad_merge, localsgd,
-    ps_hooks, indivisible_batch, indivisible_padding, bucketing_disabled,
-    plan_failure, unsupported_rule."""
+    mixed_mesh, batch_norm, cross_batch (switch_moe: FCFS capacity + aux
+    stats are global-batch quantities), selected_rows, pipeline,
+    grad_merge, localsgd, ps_hooks, indivisible_batch,
+    indivisible_padding, bucketing_disabled, plan_failure,
+    unsupported_rule."""
     from .. import monitor
     from ..observability import trace as _trace
     monitor.stat_add("executor.zero_manual_fallbacks")
@@ -462,6 +469,17 @@ def apply_grad_bucketing(program: Program, startup_program: Program,
     on-demand `__zero_gather__` (per layer-scan iteration for `@LAYERS`
     stacked params).
     """
+    from ..analysis.passes import checked_pass
+    with checked_pass("grad_bucketing", program,
+                      startup_program=startup_program):
+        return _apply_grad_bucketing(program, startup_program,
+                                     params_grads, bucket_bytes,
+                                     stage=stage)
+
+
+def _apply_grad_bucketing(program: Program, startup_program: Program,
+                          params_grads, bucket_bytes: int,
+                          stage: int = 0) -> Optional[dict]:
     if getattr(program, "_grad_bucketing_unsafe", False):
         return None   # gated optimizer sections (gradient merge) opt out
     block = program.global_block()
@@ -593,10 +611,22 @@ def apply_grad_bucketing(program: Program, startup_program: Program,
     # collectives interleave with the remaining backward compute instead
     # of forming one wall after it.
     from .transforms import sink_op_to_producers
+    from ..analysis.passes import verify_passes_enabled
     bucket_ops = sync_ops + [op for op in block.ops
                              if op.type == "__zero_update__"]
+    before_motion = list(block.ops) if verify_passes_enabled() else None
     for op in bucket_ops:
         sink_op_to_producers(block, op)
+    if before_motion is not None:
+        # code motion gets the stronger invariant on top of the structural
+        # verifier: the sink may only REORDER ops, never swap a dependent
+        # pair (write->read / read->write / write->write on any var)
+        from ..analysis.collectives import dataflow_preserved
+        from ..analysis.passes import PassVerificationError
+        motion_errs = dataflow_preserved(before_motion, block.ops,
+                                         pass_name="sink_op_to_producers")
+        if motion_errs:
+            raise PassVerificationError("sink_op_to_producers", motion_errs)
 
     meta = {"stage": int(stage), "bucket_bytes": int(bucket_bytes),
             "sync_buckets": sync_meta, "zero_buckets": zero_meta}
@@ -892,6 +922,14 @@ def _build_zero3_stacked_bucket(program, startup_program, block, pv,
     # keep it in sync or backward would trace the un-gathered layout
     vjp_op.attrs["fwd_attrs"] = dict(vjp_op.attrs["fwd_attrs"])
     vjp_op.attrs["fwd_attrs"]["zero3_flat"] = zero3
+    # the gradient now differentiates the FLAT [L, padded] input (the
+    # gather sits inside the body), so the grad var's recorded metadata
+    # must follow — the program verifier pins grad vars to their forward
+    # input's shape/dtype (analysis/verifier.py grad_shape)
+    gvar = block.find_var_recursive(pv.grad_name())
+    if gvar is not None:
+        gvar.shape = (L, padded)
+        gvar.dtype = np.dtype(dtype)
 
     gname = upd_op.inputs["Grad"][0]
     pos = block.ops.index(upd_op)
@@ -1103,6 +1141,24 @@ def optimizer_state_bytes(program, dp: int = 1) -> dict:
             "zero_stage": int(meta.get("stage", 1)) if buckets else 0}
 
 
+def _iter_op_types(program):
+    """Every op type in the program, INCLUDING fused sub-graph bodies
+    (__segment__/__layer_scan__ sub_ops, and the __vjp__ twins' fwd_attrs
+    copies) — structural scans that gate execution paths must see through
+    the fusion passes."""
+    def walk(attrs):
+        for od in attrs.get("sub_ops") or ():
+            yield od.get("type")
+            yield from walk(od.get("attrs", {}))
+        fwd = attrs.get("fwd_attrs")
+        if isinstance(fwd, dict):
+            yield from walk(fwd)
+    for b in program.blocks:
+        for op in b.ops:
+            yield op.type
+            yield from walk(op.attrs)
+
+
 # ---------------------------------------------------------------------------
 # the manual-dp execution plan (hooked from executor._CompiledBlock)
 # ---------------------------------------------------------------------------
@@ -1175,11 +1231,15 @@ def plan_manual_dp(program, dist, mesh, block, fn, feed_meta, state_meta,
     if getattr(program, "_microbatch_k", 0) and program._microbatch_k > 1:
         count_fallback("pipeline")
         return None
+    for op_type in _iter_op_types(program):
+        # sub_ops descs included: recompute/layer_scan fuse forward ops
+        # into __segment__/__layer_scan__ bodies, and a cross-batch op
+        # hidden there shards just as wrongly as a top-level one
+        if op_type in _CROSS_BATCH_OPS:
+            count_fallback("batch_norm" if op_type != "switch_moe"
+                           else "cross_batch")
+            return None
     for b in program.blocks:
-        for op in b.ops:
-            if op.type in _CROSS_BATCH_OPS:
-                count_fallback("batch_norm")
-                return None
         for v in b.vars.values():
             if getattr(v, "_is_selected_rows", False):
                 count_fallback("selected_rows")

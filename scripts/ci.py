@@ -258,6 +258,39 @@ def collect_collective_audit(proc, timeout=1500) -> bool:
     return proc.returncode == 0
 
 
+# Program lint (ISSUE-10 CI satellite): scripts/program_lint.py --assert —
+# the static analysis sweep over the example-model program zoo (verifier +
+# donation/alias + collective-consistency, paddle_tpu/analysis/). Build-only
+# (no XLA compiles), so it is the cheapest overlapped check; a failing
+# assert prints the typed JSON findings report like the budget checks.
+def start_program_lint(env):
+    script = os.path.join(ROOT, "scripts", "program_lint.py")
+    child_env = dict(env)
+    child_env["PADDLE_TPU_AUDIT_CHILD"] = "1"  # env already is the CPU mesh
+    return subprocess.Popen([sys.executable, script, "--assert"],
+                            cwd=ROOT, env=child_env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def collect_program_lint(proc, timeout=900) -> bool:
+    try:
+        out_s, err_s = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        print(f"[program-lint] FAIL timed out after {timeout}s")
+        return False
+    lines = (out_s or "").strip().splitlines()
+    status = "OK " if proc.returncode == 0 else "FAIL"
+    body = "\n".join("    " + ln for ln in lines)
+    # stderr carries the typed JSON findings report (failing rows only) on
+    # a failing assert; 120 lines holds several rows' worth of findings
+    tail = (err_s or "").strip().splitlines()[-120:]
+    print(f"[program-lint] {status}\n{body}" + (
+        "\n" + "\n".join(tail) if proc.returncode != 0 else ""))
+    return proc.returncode == 0
+
+
 # Preemption drill (ISSUE-7 CI satellite): scripts/chaos_smoke.py
 # --preemption-drill — SIGTERM-mid-step restart parity plus the ZeRO
 # dp=4 -> dp=2 resharded resume, both bit-for-bit (docs/resilience.md
@@ -318,6 +351,9 @@ def main():
     ap.add_argument("--no-trace-smoke", action="store_true",
                     help="skip the trace-smoke check (capture + schema-"
                          "validate one step trace and a flight dump)")
+    ap.add_argument("--no-program-lint", action="store_true",
+                    help="skip the static program-lint sweep "
+                         "(scripts/program_lint.py --assert)")
     ap.add_argument("rest", nargs="*", help="extra pytest args")
     args = ap.parse_args()
 
@@ -339,6 +375,9 @@ def main():
     smoke_proc = None
     if not args.no_trace_smoke:
         smoke_proc = start_trace_smoke(env)        # overlaps the shards too
+    lint_proc = None
+    if not args.no_program_lint:
+        lint_proc = start_program_lint(env)        # overlaps the shards too
 
     files = sorted(glob.glob(os.path.join(ROOT, "tests", "test_*.py")))
     shards = shard(files, args.n)
@@ -388,6 +427,8 @@ def main():
         failed = failed or not collect_preemption_drill(drill_proc)
     if smoke_proc is not None:
         failed = failed or not collect_trace_smoke(smoke_proc)
+    if lint_proc is not None:
+        failed = failed or not collect_program_lint(lint_proc)
     print(f"CI total: {time.time() - t0:.0f}s over {len(shards)} shards -> "
           f"{'FAILED' if failed else 'PASSED'}")
     return 1 if failed else 0

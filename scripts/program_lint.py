@@ -1,0 +1,293 @@
+#!/usr/bin/env python
+"""Program linter: static analysis over the example-model program zoo.
+
+Reference counterpart: the `ir/*_tester.cc` pass testers + OpDesc/OpProto
+validation — every reference graph rewrite ships with a static check that
+the result is well-formed. This CLI is that check for THIS repo's program
+pipeline: it builds the model-program zoo (the examples/ model families,
+through fleet minimize with the real pass combinations — AMP, layer scan,
+recompute, gradient merge, ZeRO stages 1-3) and runs the full
+paddle_tpu/analysis suite over each program WITHOUT compiling anything:
+
+* structural verifier (analysis/verifier.py) over main + startup programs,
+* donation/alias prediction + hazards (analysis/alias.py),
+* collective-consistency + rank-divergence checks (analysis/collectives.py).
+
+Build-only: the zoo never runs an Executor, so the whole sweep is seconds
+of tracing, no XLA compiles. Wired into scripts/ci.py as an overlapped
+subprocess (--no-program-lint to skip).
+
+Usage (any machine; re-execs into a sanitized CPU child on axon hosts,
+the collective_audit recipe):
+
+  python scripts/program_lint.py                # table of findings
+  python scripts/program_lint.py --assert       # exit 1 on any error
+  python scripts/program_lint.py --json         # typed JSON report
+  python scripts/program_lint.py --only zero    # substring filter
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+# ---------------------------------------------------------------------------
+# the zoo: each builder returns (main, startup, feed_names, fetch_names)
+# ---------------------------------------------------------------------------
+
+def _fresh():
+    from paddle_tpu.testing import reset_programs
+    reset_programs(seed=0)
+
+
+def _programs():
+    import paddle_tpu.fluid as fluid
+    return fluid.default_main_program(), fluid.default_startup_program()
+
+
+def _data_names(program):
+    return sorted(v.name for b in program.blocks for v in b.vars.values()
+                  if v.is_data)
+
+
+def build_linreg_sgd():
+    import paddle_tpu as paddle
+    from paddle_tpu.fluid import layers
+    _fresh()
+    x = layers.data(name="x", shape=[13], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    loss = layers.mean(layers.square(layers.fc(x, 1) - y))
+    paddle.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    main, startup = _programs()
+    return main, startup, _data_names(main), [loss.name]
+
+
+def _mlp_loss():
+    from paddle_tpu.fluid import layers
+    x = layers.data(name="x", shape=[16], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    h1 = layers.fc(x, 32, act="tanh")
+    h2 = layers.fc(h1, 32, act="tanh")
+    loss = layers.mean(layers.square_error_cost(layers.fc(h2, 1), y))
+    return loss, [h1, h2]
+
+
+def build_mlp_recompute():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    _fresh()
+    loss, ckpts = _mlp_loss()
+    fleet.init(is_collective=True)
+    s = fleet.DistributedStrategy()
+    s.recompute = True
+    s.recompute_configs = {"checkpoints": [c.name for c in ckpts]}
+    fleet.distributed_optimizer(
+        paddle.optimizer.Adam(learning_rate=1e-3), s).minimize(loss)
+    main, startup = _programs()
+    return main, startup, _data_names(main), [loss.name]
+
+
+def build_mlp_gradient_merge():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    _fresh()
+    loss, _ = _mlp_loss()
+    fleet.init(is_collective=True)
+    s = fleet.DistributedStrategy()
+    s.gradient_merge = True
+    s.gradient_merge_configs = {"k_steps": 4, "avg": True}
+    fleet.distributed_optimizer(
+        paddle.optimizer.Adam(learning_rate=1e-3), s).minimize(loss)
+    main, startup = _programs()
+    return main, startup, _data_names(main), [loss.name]
+
+
+def build_moe_mlp():
+    import paddle_tpu as paddle
+    from paddle_tpu.fluid import layers
+    _fresh()
+    x = layers.data(name="x", shape=[16], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    h, aux = layers.switch_moe(x, num_experts=4, d_ff=32)
+    loss = layers.mean(layers.square_error_cost(layers.fc(h, 1), y)) \
+        + 0.01 * aux
+    paddle.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    main, startup = _programs()
+    return main, startup, _data_names(main), [loss.name]
+
+
+def _bert_builder(layer_scan=False, amp=True, zero_stage=0):
+    def build():
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.models import bert
+        _fresh()
+        cfg = bert.BertConfig(vocab_size=256, hidden_size=16, num_layers=4,
+                              num_heads=2, intermediate_size=32,
+                              max_position=32, seq_len=8,
+                              hidden_dropout=0.1, attention_dropout=0.1)
+        ids, labels, loss = bert.build_pretrain_program(cfg)
+        fleet.init(is_collective=True)
+        s = fleet.DistributedStrategy()
+        s.amp = amp
+        s.layer_scan = layer_scan
+        if zero_stage:
+            s.sharding = True
+            s.sharding_stage = zero_stage
+        fleet.distributed_optimizer(
+            paddle.optimizer.Adam(learning_rate=1e-4), s).minimize(loss)
+        main, startup = _programs()
+        return main, startup, _data_names(main), [loss.name]
+    return build
+
+
+def build_gpt_tiny():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.models import gpt
+    _fresh()
+    cfg = gpt.GPTConfig(vocab_size=256, hidden_size=16, num_layers=2,
+                        num_heads=2, intermediate_size=32, seq_len=16,
+                        max_position=32, hidden_dropout=0.0,
+                        attention_dropout=0.0)
+    tokens, loss = gpt.build_lm_program(cfg)
+    fleet.init(is_collective=True)
+    fleet.distributed_optimizer(
+        paddle.optimizer.Adam(learning_rate=1e-4),
+        fleet.DistributedStrategy()).minimize(loss)
+    main, startup = _programs()
+    return main, startup, _data_names(main), [loss.name]
+
+
+def build_wide_deep():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import wide_deep
+    _fresh()
+    feeds, predict, loss, auc = wide_deep.build_ctr(
+        sparse_slots=4, dense_dim=13, vocab_size=1001, emb_dim=8)
+    paddle.optimizer.SGD(learning_rate=1e-3).minimize(loss)
+    main, startup = _programs()
+    return main, startup, _data_names(main), [loss.name, auc.name]
+
+
+ZOO = [
+    ("linreg_sgd", build_linreg_sgd),
+    ("mlp_recompute", build_mlp_recompute),
+    ("mlp_gradient_merge", build_mlp_gradient_merge),
+    ("moe_mlp", build_moe_mlp),
+    ("bert_tiny_amp", _bert_builder()),
+    ("bert_tiny_layer_scan", _bert_builder(layer_scan=True)),
+    ("bert_tiny_zero1", _bert_builder(zero_stage=1)),
+    ("bert_tiny_zero2", _bert_builder(zero_stage=2)),
+    ("bert_tiny_zero3_rolled", _bert_builder(layer_scan=True,
+                                             zero_stage=3)),
+    ("gpt_tiny", build_gpt_tiny),
+    ("wide_deep_ctr", build_wide_deep),
+]
+
+
+def lint_one(name, build) -> dict:
+    from paddle_tpu.analysis import (analyze_donation, check_collectives,
+                                     collective_sequence, verify_program)
+    t0 = time.time()
+    main, startup, feed_names, fetch_names = build()
+    findings = verify_program(main, feed_names=feed_names,
+                              fetch_names=fetch_names)
+    findings += [_tag(f, "startup") for f in verify_program(startup)]
+    findings += check_collectives(main)
+    report = analyze_donation(main, feed_names=feed_names,
+                              fetch_names=fetch_names)
+    findings += report.findings
+    return {
+        "program": name,
+        "build_s": round(time.time() - t0, 2),
+        "ops": sum(len(b.ops) for b in main.blocks),
+        "collectives": len(collective_sequence(main)),
+        "donated": len(report.donated),
+        "errors": sum(f.severity == "error" for f in findings),
+        "warnings": sum(f.severity == "warning" for f in findings),
+        "findings": [f.to_dict() for f in findings],
+    }
+
+
+def _tag(finding, where):
+    finding.message = f"[{where}] {finding.message}"
+    return finding
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="static analysis over the example-model program zoo")
+    ap.add_argument("--assert", dest="assert_", action="store_true",
+                    help="exit 1 on any error-severity finding")
+    ap.add_argument("--json", action="store_true",
+                    help="print the typed JSON findings report")
+    ap.add_argument("--only", default="",
+                    help="substring filter on zoo program names")
+    args = ap.parse_args()
+
+    # axon hosts pin the TPU backend at interpreter start: re-exec once
+    # into a sanitized CPU child (the collective_audit/copy_audit recipe)
+    if os.environ.get("PADDLE_TPU_AUDIT_CHILD") != "1":
+        from paddle_tpu.testing import cpu_mesh_env, virtual_cpu_mesh_ready
+        if not virtual_cpu_mesh_ready(1):
+            import subprocess
+            env = cpu_mesh_env(1)
+            env["PADDLE_TPU_AUDIT_CHILD"] = "1"
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+                cwd=ROOT, env=env, timeout=3600)
+            sys.exit(proc.returncode)
+
+    rows = []
+    for name, build in ZOO:
+        if args.only and args.only not in name:
+            continue
+        try:
+            rows.append(lint_one(name, build))
+        except Exception as e:   # a broken build is itself a finding
+            rows.append({"program": name, "build_s": 0.0, "ops": 0,
+                         "collectives": 0, "donated": 0, "errors": 1,
+                         "warnings": 0,
+                         "findings": [{"check": "build_failed",
+                                       "severity": "error",
+                                       "message": repr(e)[:300]}]})
+
+    n_err = sum(r["errors"] for r in rows)
+    n_warn = sum(r["warnings"] for r in rows)
+    if args.json:
+        print(json.dumps({"programs": rows, "errors": n_err,
+                          "warnings": n_warn}, indent=1))
+    else:
+        for r in rows:
+            print(f"{r['program']:24s} ops {r['ops']:4d} "
+                  f"collectives {r['collectives']:2d} "
+                  f"donated {r['donated']:3d} errors {r['errors']:2d} "
+                  f"warnings {r['warnings']:3d} ({r['build_s']:.1f}s)")
+            for f in r["findings"]:
+                if f["severity"] == "error" or not args.assert_:
+                    print(f"    [{f['severity']}] {f['check']}: "
+                          f"{f['message'][:160]}")
+        print(f"program lint: {len(rows)} programs, {n_err} errors, "
+              f"{n_warn} warnings")
+    if args.assert_ and n_err:
+        # the typed report is the postmortem artifact — always ship it on
+        # a failing assert, like the CI budget checks do. Only the FAILING
+        # rows go to stderr: the CI collector tails stderr, and a clean
+        # row must never push a failing one out of the window.
+        if not args.json:
+            bad = [r for r in rows if r["errors"]]
+            print(json.dumps({"programs": bad, "errors": n_err},
+                             indent=1), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -133,6 +133,36 @@ print(json.dumps({"plain": plain, "sharded": sharded}))
     assert res["plain"][-1] < res["plain"][0]
 
 
+def test_manual_dp_declines_moe_cross_batch():
+    """switch_moe couples tokens ACROSS the batch (FCFS expert capacity +
+    the aux balancing loss average over the token axis), so the bucketed
+    manual-dp shard_map path must decline MoE programs — a per-shard run
+    silently computes LOCAL routing statistics, which was exactly the
+    standing ep-parity failure above (the ep=1 arm resolved to a dp-pure
+    mesh and took the manual path). Build-only regression guard; the
+    numeric contract is test_ep_sharded_matches_unsharded."""
+    from paddle_tpu.parallel.zero import _CROSS_BATCH_OPS, _iter_op_types
+    assert "switch_moe" in _CROSS_BATCH_OPS
+
+    # the detection must see through fused sub-graph bodies too: after
+    # recompute the switch_moe op lives inside a __segment__'s sub_ops
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.parallel.transforms import apply_recompute
+    from paddle_tpu.testing import reset_programs
+    reset_programs(seed=0)
+    x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+    h, aux = layers.switch_moe(x, num_experts=2, d_ff=8)
+    out = layers.mean(layers.fc(h, 1))
+    # one multi-op segment ending at the loss: switch_moe fuses inside it
+    apply_recompute(fluid.default_main_program(), [out.name])
+    prog = fluid.default_main_program()
+    gb = prog.global_block()
+    assert not any(op.type == "switch_moe" for op in gb.ops), \
+        "recompute should have fused switch_moe into a __segment__"
+    assert any(t in _CROSS_BATCH_OPS for t in _iter_op_types(prog))
+
+
 def test_top2_matches_dense_reference():
     """GShard top-2 with ample capacity == sum of the two best experts'
     FFNs weighted by pair-renormalized gates."""

@@ -1,0 +1,406 @@
+"""Static program analysis (paddle_tpu/analysis/): seeded-defect coverage.
+
+Contract under test: each verifier check class catches a minimal
+deliberately-broken program AND passes its clean twin; the donation
+analysis predicts the executor's donation set and flags the aliasing
+hazards; the collective checker rejects rank-divergent control dependence;
+sink motion validation catches dependent-pair reordering; and
+FLAGS_verify_passes over the real layer_scan / recompute / ZeRO-1/2/3
+pipelines reports ZERO findings while changing nothing — verified and
+unverified builds produce byte-identical program descs, and a short train
+run is bit-identical. Everything here is build-only except one tiny
+2-step parity run (the tier-1 wall-clock budget is tight)."""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.analysis import (analyze_donation, check_collectives,
+                                 dataflow_preserved, verify_program)
+from paddle_tpu.analysis.passes import PassVerificationError, checked_pass
+from paddle_tpu.flags import set_flags
+from paddle_tpu.fluid import layers
+from paddle_tpu.framework.program import Operator
+from paddle_tpu.testing import reset_programs
+
+
+def _checks(findings, severity=None):
+    return {f.check for f in findings
+            if severity is None or f.severity == severity}
+
+
+def _clean_linreg():
+    x = layers.data(name="x", shape=[13], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    loss = layers.mean(layers.square(layers.fc(x, 1) - y))
+    paddle.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    return loss
+
+
+# ---------------------------------------------------------------------------
+# verifier check classes: seeded defect caught, clean twin passes
+# ---------------------------------------------------------------------------
+
+def test_clean_program_verifies_empty():
+    loss = _clean_linreg()
+    prog = fluid.default_main_program()
+    assert verify_program(prog, fetch_names=[loss.name]) == []
+    assert verify_program(fluid.default_startup_program()) == []
+    assert check_collectives(prog) == []
+
+
+def test_def_before_use_caught():
+    gb = fluid.default_main_program().global_block()
+    gb.create_var(name="a", shape=(4,), dtype="float32")  # never written
+    gb.create_var(name="b", shape=(4,), dtype="float32")
+    gb.append_op("scale", {"X": ["a"]}, {"Out": ["b"]}, {"scale": 2.0})
+    fs = verify_program(fluid.default_main_program())
+    assert "def_before_use" in _checks(fs, "error")
+    # the clean twin: feeding 'a' makes the read legal
+    assert "def_before_use" not in _checks(
+        verify_program(fluid.default_main_program(), feed_names=["a"]),
+        "error")
+
+
+def test_dangling_input_and_undeclared_output_caught():
+    gb = fluid.default_main_program().global_block()
+    gb.create_var(name="ok", shape=(4,), dtype="float32", is_data=True)
+    gb.append_op("scale", {"X": ["nowhere"]}, {"Out": ["also_nowhere"]},
+                 {"scale": 1.0})
+    checks = _checks(verify_program(fluid.default_main_program()), "error")
+    assert "dangling_input" in checks
+    assert "undeclared_output" in checks
+
+
+def test_duplicate_definition_dead_write_warned():
+    gb = fluid.default_main_program().global_block()
+    gb.create_var(name="x", shape=(4,), dtype="float32", is_data=True)
+    gb.create_var(name="t", shape=(4,), dtype="float32")
+    gb.append_op("scale", {"X": ["x"]}, {"Out": ["t"]}, {"scale": 1.0})
+    gb.append_op("scale", {"X": ["x"]}, {"Out": ["t"]}, {"scale": 2.0})
+    gb.append_op("mean", {"X": ["t"]}, {"Out": ["m"]})
+    gb.create_var(name="m", shape=(), dtype="float32")
+    fs = verify_program(fluid.default_main_program(),
+                        fetch_names=["m"])
+    assert "duplicate_definition" in _checks(fs, "warning")
+
+
+def test_bad_attr_and_slot_validation_caught():
+    prog = fluid.default_main_program()
+    gb = prog.global_block()
+    gb.create_var(name="c", shape=(2,), dtype="float32")
+    # attr of the wrong type (shape must be a list)
+    gb.ops.append(Operator(gb, "fill_constant", {}, {"Out": ["c"]},
+                           {"shape": "oops", "dtype": "float32",
+                            "value": 0.0}))
+    # missing required attrs + slots on a structural op
+    gb.create_var(name="s", shape=(2,), dtype="float32")
+    gb.ops.append(Operator(gb, "__layer_scan__", {"X": ["c"]},
+                           {"Out": ["s"]}, {"num_layers": 2}))
+    # unknown slot on a spec'd op
+    gb.create_var(name="u", shape=(2,), dtype="float32")
+    gb.ops.append(Operator(gb, "sum", {"Bogus": ["c"]}, {"Out": ["u"]}))
+    prog.bump_version()
+    checks = _checks(verify_program(prog), "error")
+    assert {"attr_type", "missing_attr", "unknown_slot"} <= checks
+
+
+def test_dtype_propagation_caught():
+    prog = fluid.default_main_program()
+    gb = prog.global_block()
+    gb.create_var(name="x", shape=(4,), dtype="float32", is_data=True)
+    h = layers.cast(gb.program.global_block().var("x"), "float16")
+    # corrupt the recorded dtype: the cast op's declared out_dtype no
+    # longer matches its output var
+    gb.var(h.name).dtype = np.float32
+    prog.bump_version()
+    assert "dtype_mismatch" in _checks(verify_program(prog), "error")
+
+
+def test_grad_var_metadata_mismatch_caught():
+    loss = _clean_linreg()
+    prog = fluid.default_main_program()
+    gb = prog.global_block()
+    gvar = gb.var("fc_w_0@GRAD")
+    gvar.shape = (7, 7)          # corrupt: no longer the forward input's
+    prog.bump_version()
+    assert "grad_shape" in _checks(
+        verify_program(prog, fetch_names=[loss.name]), "error")
+
+
+def test_sub_graph_scope_caught():
+    prog = fluid.default_main_program()
+    gb = prog.global_block()
+    gb.create_var(name="x", shape=(4,), dtype="float32", is_data=True)
+    gb.create_var(name="o", shape=(4,), dtype="float32")
+    sub = [{"type": "scale", "inputs": {"X": ["ghost"]},
+            "outputs": {"Out": ["inner"]}, "attrs": {"scale": 1.0}}]
+    gb.ops.append(Operator(gb, "__segment__", {"X": ["x"]}, {"Out": ["o"]},
+                           {"sub_ops": sub, "in_names": ["x"],
+                            "out_names": ["o"]}))
+    prog.bump_version()
+    checks = _checks(verify_program(prog), "error")
+    assert "sub_graph_scope" in checks   # ghost read AND unproduced out
+
+
+# ---------------------------------------------------------------------------
+# donation/alias analysis
+# ---------------------------------------------------------------------------
+
+def test_donation_prediction_and_hazards():
+    prog = fluid.default_main_program()
+    gb = prog.global_block()
+    gb.create_var(name="x", shape=(4,), dtype="float32", is_data=True)
+    big = gb.create_parameter(name="big_w", shape=(256, 128),
+                              dtype="float32")          # 128 KiB >= floor
+    small = gb.create_parameter(name="small_b", shape=(4,),
+                                dtype="float32")        # under the floor
+    gb.create_var(name="t", shape=(256, 128), dtype="float32")
+    gb.append_op("scale", {"X": [big.name]}, {"Out": ["t"]}, {"scale": 0.9})
+    gb.append_op("assign", {"X": ["t"]}, {"Out": [big.name]})
+    gb.append_op("assign", {"X": ["t"]}, {"Out": [big.name]})  # 2nd write
+    gb.create_var(name="s2", shape=(4,), dtype="float32")
+    gb.append_op("scale", {"X": [small.name]}, {"Out": ["s2"]},
+                 {"scale": 0.5})
+    gb.append_op("assign", {"X": ["s2"]}, {"Out": [small.name]})
+
+    rep = analyze_donation(prog, feed_names=["x"],
+                           fetch_names=[big.name])
+    assert rep.donated == [big.name]          # floor keeps small_b out
+    assert small.name in rep.undonated_written
+    hazard_checks = _checks(rep.findings)
+    assert "fetch_of_donated" in hazard_checks
+    assert "write_after_donate" in hazard_checks
+    # the k-step scan path donates EVERYTHING written (floor off)
+    rep_k = analyze_donation(prog, feed_names=["x"], multi_k=8)
+    assert set(rep_k.donated) == {big.name, small.name}
+    # feeding a persistable var shadows (and un-donates) its state
+    rep_f = analyze_donation(prog, feed_names=[big.name])
+    assert "feed_shadows_state" in _checks(rep_f.findings)
+    assert big.name not in rep_f.donated
+
+
+def test_donation_prediction_matches_executor():
+    """The static prediction must mirror the executor's REAL donation
+    decision (_CompiledBlock.mut_names), floor included — this is the
+    parity pin that keeps analyze_donation from drifting when the
+    executor's donation rules next change."""
+    x = layers.data(name="x", shape=[64], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    h = layers.fc(x, 512, act="tanh")       # 64x512 w = 128 KiB >= floor
+    loss = layers.mean(layers.square_error_cost(layers.fc(h, 1), y))
+    paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    feed = {"x": np.zeros((4, 64), np.float32),
+            "y": np.zeros((4, 1), np.float32)}
+    exe.run(feed=feed, fetch_list=[loss])
+    compiled = list(exe._cache.values())[-1]   # the train step's block
+    rep = analyze_donation(fluid.default_main_program(),
+                           feed_names=["x", "y"], fetch_names=[loss.name])
+    assert sorted(rep.donated) == sorted(compiled.mut_names)
+    assert sorted(rep.state_names) == sorted(compiled.state_names)
+    assert set(rep.undonated_written) <= set(compiled.ro_names)
+
+
+# ---------------------------------------------------------------------------
+# collective consistency
+# ---------------------------------------------------------------------------
+
+def _cond_with_bucket_sync(cond_from_data):
+    prog = fluid.default_main_program()
+    gb = prog.global_block()
+    gb.create_var(name="x", shape=(4,), dtype="float32", is_data=True)
+    gb.create_var(name="g", shape=(4,), dtype="float32")
+    gb.append_op("scale", {"X": ["x"]}, {"Out": ["g"]}, {"scale": 1.0})
+    if cond_from_data:
+        gb.create_var(name="c", shape=(1,), dtype="float32")
+        gb.append_op("mean", {"X": ["x"]}, {"Out": ["c"]})
+    else:
+        gb.create_var(name="c", shape=(1,), dtype="float32",
+                      persistable=True)   # a rank-uniform step counter
+    sub = prog.create_block()
+    prog.rollback()
+    sub.ops.append(Operator(sub, "__bucket_sync__", {"X": ["g"]},
+                            {"Out": ["g"]},
+                            {"sizes": [4], "shapes": [[4]],
+                             "dtype": "float32"}))
+    gb.create_var(name="o", shape=(4,), dtype="float32")
+    gb.ops.append(Operator(gb, "__cond__",
+                           {"Cond": ["c"], "Free": ["g"]}, {"Out": ["o"]},
+                           {"true_block": sub.idx, "false_block": sub.idx,
+                            "true_outs": ["g"], "false_outs": ["g"],
+                            "free_names": ["g"]}))
+    prog.bump_version()
+    return prog
+
+
+def test_rank_divergent_collective_caught():
+    fs = check_collectives(_cond_with_bucket_sync(cond_from_data=True))
+    assert "rank_divergent_collective" in _checks(fs, "error")
+
+
+def test_while_body_recomputed_condition_caught():
+    """A __while__ seeded with a rank-uniform condition whose BODY
+    rewrites the cond var from a feed-derived value diverges just the
+    same — the taint fixpoint must flow through the loop-carried
+    rewrite."""
+    prog = fluid.default_main_program()
+    gb = prog.global_block()
+    gb.create_var(name="x", shape=(4,), dtype="float32", is_data=True)
+    gb.create_var(name="g", shape=(4,), dtype="float32")
+    gb.append_op("scale", {"X": ["x"]}, {"Out": ["g"]}, {"scale": 1.0})
+    gb.create_var(name="cond", shape=(1,), dtype="bool")
+    gb.append_op("fill_constant", {}, {"Out": ["cond"]},
+                 {"shape": [1], "dtype": "bool", "value": 1.0})
+    gb.create_var(name="m", shape=(1,), dtype="float32")
+    sub = prog.create_block()
+    prog.rollback()
+    sub.ops.append(Operator(sub, "mean", {"X": ["g"]}, {"Out": ["m"]}, {}))
+    sub.ops.append(Operator(sub, "less_than", {"X": ["m"], "Y": ["m"]},
+                            {"Out": ["cond"]}, {}))
+    sub.ops.append(Operator(sub, "__bucket_sync__", {"X": ["g"]},
+                            {"Out": ["g"]},
+                            {"sizes": [4], "shapes": [[4]],
+                             "dtype": "float32"}))
+    gb.ops.append(Operator(gb, "__while__",
+                           {"Cond": ["cond"], "Carried": ["cond", "g"],
+                            "Free": []},
+                           {"Out": ["cond", "g"]},
+                           {"sub_block": sub.idx,
+                            "carried_names": ["cond", "g"],
+                            "free_names": [], "cond_name": "cond"}))
+    prog.bump_version()
+    assert "rank_divergent_collective" in _checks(check_collectives(prog),
+                                                  "error")
+
+
+def test_rank_uniform_condition_only_warns():
+    fs = check_collectives(_cond_with_bucket_sync(cond_from_data=False))
+    assert "rank_divergent_collective" not in _checks(fs, "error")
+    assert "collective_in_control_flow" in _checks(fs, "warning")
+
+
+def test_sink_motion_dataflow_validation():
+    gb = fluid.default_main_program().global_block()
+    for n in ("x", "a", "b", "c"):
+        gb.create_var(name=n, shape=(4,), dtype="float32",
+                      is_data=(n == "x"))
+    gb.append_op("scale", {"X": ["x"]}, {"Out": ["a"]}, {"scale": 1.0})
+    gb.append_op("scale", {"X": ["a"]}, {"Out": ["b"]}, {"scale": 2.0})
+    gb.append_op("scale", {"X": ["x"]}, {"Out": ["c"]}, {"scale": 3.0})
+    ops = list(gb.ops)
+    # legal motion: c only depends on x — it may move before b
+    assert dataflow_preserved(ops, [ops[0], ops[2], ops[1]]) == []
+    # illegal motion: b reads a's output — swapping breaks the edge
+    bad = dataflow_preserved(ops, [ops[1], ops[0], ops[2]])
+    assert [f.check for f in bad] == ["motion_broke_dataflow"]
+    # a motion that drops an op is caught too
+    assert [f.check for f in dataflow_preserved(ops, ops[:2])] == \
+        ["motion_changed_ops"]
+
+
+# ---------------------------------------------------------------------------
+# verify-after-pass over the real pipelines
+# ---------------------------------------------------------------------------
+
+def _build_bert_pipeline(verify, layer_scan=False, stage=0,
+                         recompute=False):
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.models import bert
+    set_flags({"FLAGS_verify_passes": verify})
+    try:
+        reset_programs(seed=0)
+        cfg = bert.BertConfig(vocab_size=128, hidden_size=16, num_layers=2,
+                              num_heads=2, intermediate_size=32,
+                              max_position=32, seq_len=8,
+                              hidden_dropout=0.1, attention_dropout=0.1)
+        ids, labels, loss = bert.build_pretrain_program(cfg)
+        fleet.init(is_collective=True)
+        s = fleet.DistributedStrategy()
+        s.layer_scan = layer_scan
+        if recompute:
+            s.recompute = True
+            s.recompute_configs = {
+                "checkpoints": list(loss._layer_checkpoints)}
+        if stage:
+            s.sharding = True
+            s.sharding_stage = stage
+        fleet.distributed_optimizer(
+            paddle.optimizer.Adam(learning_rate=1e-4), s).minimize(loss)
+        main = fluid.default_main_program()
+        desc = json.dumps(main.to_desc(), sort_keys=True, default=str)
+        fs = verify_program(main, fetch_names=[loss.name]) \
+            + check_collectives(main)
+        return desc, [f for f in fs if f.severity == "error"]
+    finally:
+        set_flags({"FLAGS_verify_passes": False})
+
+
+@pytest.mark.parametrize("kw", [
+    dict(layer_scan=True),
+    dict(recompute=True),
+    dict(stage=1),
+    dict(stage=2),
+    dict(layer_scan=True, stage=3),   # the full rolled ZeRO-3 + sink path
+], ids=["layer_scan", "recompute", "zero1", "zero2", "zero3_rolled"])
+def test_verify_after_pass_zero_findings_and_identical_program(kw):
+    """FLAGS_verify_passes over each real pipeline: no PassVerificationError
+    raised, zero error findings on the final program, and the verified
+    build is byte-identical to the unverified one (the harness is
+    read-only — bit-parity of everything downstream follows)."""
+    plain, errs0 = _build_bert_pipeline(False, **kw)
+    assert errs0 == []
+    verified, errs1 = _build_bert_pipeline(True, **kw)
+    assert errs1 == []
+    assert plain == verified
+
+
+def test_verify_after_pass_run_parity():
+    """Belt and braces on 'changes no program output': two real train
+    steps with the flag on equal the flag-off run bit-for-bit."""
+    def run(verify):
+        from paddle_tpu.distributed import fleet
+        set_flags({"FLAGS_verify_passes": verify})
+        try:
+            reset_programs(seed=1)
+            x = layers.data(name="x", shape=[8], dtype="float32")
+            y = layers.data(name="y", shape=[1], dtype="float32")
+            loss = layers.mean(layers.square_error_cost(
+                layers.fc(layers.fc(x, 8, act="tanh"), 1), y))
+            fleet.init(is_collective=True)
+            s = fleet.DistributedStrategy()
+            s.sharding = True
+            fleet.distributed_optimizer(
+                paddle.optimizer.Adam(learning_rate=1e-2), s).minimize(loss)
+            exe = fluid.Executor()
+            exe.run(fluid.default_startup_program())
+            rng = np.random.RandomState(0)
+            feed = {"x": rng.randn(16, 8).astype(np.float32)}
+            feed["y"] = feed["x"].sum(1, keepdims=True).astype(np.float32)
+            return [float(np.asarray(
+                exe.run(feed=feed, fetch_list=[loss])[0]))
+                for _ in range(2)]
+        finally:
+            set_flags({"FLAGS_verify_passes": False})
+
+    assert run(False) == run(True)
+
+
+def test_checked_pass_names_offender_with_diff():
+    _clean_linreg()
+    prog = fluid.default_main_program()
+    set_flags({"FLAGS_verify_passes": True})
+    try:
+        with pytest.raises(PassVerificationError) as ei:
+            with checked_pass("evil_pass", prog):
+                del prog.global_block().ops[0]
+                prog.bump_version()
+        assert ei.value.pass_name == "evil_pass"
+        assert ei.value.findings
+        assert all(f.pass_name == "evil_pass" for f in ei.value.findings)
+        assert "-b0" in ei.value.diff or "before" in ei.value.diff
+    finally:
+        set_flags({"FLAGS_verify_passes": False})

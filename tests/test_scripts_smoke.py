@@ -43,3 +43,25 @@ def test_ci_driver_help():
                         os.path.join(ROOT, "scripts", "ci.py"), "--help"],
                        capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stderr[-300:]
+    assert "--no-program-lint" in r.stdout
+
+
+def test_program_lint_help_and_fast_row():
+    """--help answers, and one tiny zoo program lints green with --assert
+    (the full-zoo sweep runs overlapped in scripts/ci.py; this row keeps
+    the CLI contract — filter, assert exit code — under tier-1)."""
+    script = os.path.join(ROOT, "scripts", "program_lint.py")
+    r = subprocess.run([sys.executable, script, "--help"],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-300:]
+    assert "--assert" in r.stdout and "--only" in r.stdout
+    env = dict(os.environ)
+    env["PADDLE_TPU_AUDIT_CHILD"] = "1"   # tests already run the CPU mesh
+    r = subprocess.run([sys.executable, script, "--assert", "--json",
+                        "--only", "linreg"],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, (r.stdout or "")[-500:] + (r.stderr or "")[-500:]
+    import json
+    doc = json.loads(r.stdout)
+    assert doc["errors"] == 0
+    assert doc["programs"] and doc["programs"][0]["program"] == "linreg_sgd"
