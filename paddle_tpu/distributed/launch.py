@@ -36,6 +36,17 @@ SUPERVISOR — trainer loss is a first-class event (ROADMAP item 5):
   repacks for the new dp width — docs/resilience.md "Elasticity &
   preemption").
 
+* **Pod-scope observability** (docs/observability.md "Pod-scope"): every
+  worker inherits one shared `FLAGS_flight_dump_dir` for the gang, the
+  heartbeat file content is JSON that trainers extend with last-step /
+  step-duration fields (`observability/flight.py` `end_step`), and the
+  supervisor records a rendezvous-anchored wall-clock t0. On a gang
+  failure the supervisor snapshots the heartbeats and names the suspected
+  straggler LIVE in the failure message; on any failure — or a clean exit
+  with `--collect-dumps` — it gathers the per-rank flight dumps into one
+  pod dump dir and emits the merged cross-rank timeline + straggler report
+  (`observability/podscope.py`, also available as `scripts/pod_trace.py`).
+
 Chaos hook: `PADDLE_LAUNCH_STALL_RANKS="1,3"` in the launcher's env makes
 those ranks sleep before check-in (the deterministic straggler used by
 tests/test_launch.py and the drills).
@@ -43,6 +54,7 @@ tests/test_launch.py and the drills).
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import subprocess
 import sys
@@ -55,15 +67,19 @@ from typing import Dict, List, Optional, Tuple
 # and executing", independent of how long the training script's own imports
 # take afterwards.
 _BOOTSTRAP = r'''
-import os, runpy, sys, threading, time
+import json, os, runpy, sys, threading, time
 _stall = os.environ.get("PADDLE_LAUNCH_STALL_RANKS", "")
 if _stall and os.environ.get("PADDLE_TRAINER_ID") in \
         [r.strip() for r in _stall.split(",")]:
     time.sleep(3600)          # chaos hook: a rendezvous straggler
 _hb = os.environ.get("PADDLE_LAUNCH_HEARTBEAT_FILE")
 if _hb:
+    # heartbeat content is JSON: the bootstrap seeds {"pid": ...}; the
+    # trainer's flight recorder later overlays {"step", "step_ms"} per
+    # step (observability/flight.py), which the supervisor reads to name
+    # a suspected straggler in its gang-failure message
     with open(_hb, "w") as _f:
-        _f.write(str(os.getpid()))      # the rendezvous check-in
+        json.dump({"pid": os.getpid()}, _f)     # the rendezvous check-in
     _iv = float(os.environ.get("PADDLE_LAUNCH_HEARTBEAT_INTERVAL_S", "1"))
 
     def _beat():
@@ -74,9 +90,9 @@ if _hb:
             except OSError:
                 try:                      # unlinked by a tmp reaper: a
                     with open(_hb, "w") as _g:      # dead beat reads as a
-                        _g.write(str(os.getpid()))  # hung worker, so keep
-                except OSError:                     # beating, never exit
-                    pass
+                        json.dump({"pid": os.getpid()}, _g)  # hung worker,
+                except OSError:                  # so keep beating, never
+                    pass                         # exit
 
     threading.Thread(target=_beat, daemon=True,
                      name="launch-heartbeat").start()
@@ -110,6 +126,18 @@ def _parse_args(argv=None):
                    help="relaunch budget after a worker failure: the gang "
                         "restarts at the surviving world size, trainers "
                         "resume from their latest checkpoint")
+    p.add_argument("--collect-dumps", action="store_true",
+                   dest="collect_dumps",
+                   help="gather per-rank flight dumps into one pod dump "
+                        "dir on EVERY gang exit (clean included; failures "
+                        "always collect) and emit the merged cross-rank "
+                        "timeline + straggler report. Also sets "
+                        "PADDLE_FLIGHT_DUMP_AT_EXIT=1 so clean workers "
+                        "leave a dump")
+    p.add_argument("--pod_dump_dir", type=str, default=None,
+                   help="where the pod collection lands (default: "
+                        "pod_<restart>_<status> under the gang's shared "
+                        "flight dump dir)")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -165,6 +193,57 @@ class GangSupervisor:
             float(flag("FLAGS_launch_heartbeat_interval_ms")) / 1000.0
         self.heartbeat_timeout_s = args.heartbeat_timeout_ms / 1000.0
         self.grace_period_s = args.grace_period_s
+        self.collect_dumps = bool(getattr(args, "collect_dumps", False))
+        # ONE shared flight-dump dir for the whole gang: workers inherit it
+        # via the FLAGS_flight_dump_dir env (rank+pid-tagged filenames keep
+        # N ranks from colliding), and pod collection reads it back. An
+        # operator-set env/flag wins so dumps land where they asked.
+        self._flight_dir = (os.environ.get("FLAGS_flight_dump_dir")
+                            or str(flag("FLAGS_flight_dump_dir") or "")
+                            or tempfile.mkdtemp(prefix="paddle_pod_flight_"))
+        # rendezvous-anchored clock t0 (wall µs): the merged pod timeline
+        # re-zeroes every rank's clock-aligned events here
+        self._anchor_wall_us: Optional[float] = None
+        self._last_heartbeats: Dict[int, dict] = {}
+
+    # -- heartbeat content (JSON contract with bootstrap + flight.py) ------
+    @staticmethod
+    def _read_heartbeat(path: str) -> dict:
+        try:
+            with open(path) as f:
+                txt = f.read()
+        except OSError:
+            return {}
+        try:
+            rec = json.loads(txt)
+            return rec if isinstance(rec, dict) else {"pid": int(rec)}
+        except (ValueError, TypeError):
+            try:
+                return {"pid": int(txt.strip())}   # pre-JSON format
+            except ValueError:
+                return {}
+
+    def _snapshot_heartbeats(self, hb_files: Dict[int, str]) \
+            -> Dict[int, dict]:
+        return {rank: self._read_heartbeat(path)
+                for rank, path in hb_files.items()}
+
+    def _note_gang_failure(self, hb_files: Dict[int, str]) -> None:
+        """Snapshot the heartbeat files (they die with the hb tempdir) and
+        name the suspected straggler LIVE, while the failure message is
+        still scrolling past the operator."""
+        from ..observability import podscope
+        self._last_heartbeats = self._snapshot_heartbeats(hb_files)
+        missing = sorted(r for r, hb in self._last_heartbeats.items()
+                         if not hb)
+        if missing:
+            print(f"[launch] rank(s) {missing} never checked in "
+                  "(rendezvous stragglers)", flush=True)
+        suspect = podscope.suspect_from_heartbeats(self._last_heartbeats)
+        if suspect is not None:
+            rank, why = suspect
+            print(f"[launch] suspected straggler: rank {rank} ({why})",
+                  flush=True)
 
     # -- gang lifecycle ----------------------------------------------------
     def _spawn(self, world: int, restart_idx: int, hb_dir: str):
@@ -184,7 +263,17 @@ class GangSupervisor:
                 "PADDLE_LAUNCH_HEARTBEAT_INTERVAL_S":
                     str(self.heartbeat_interval_s),
                 "PADDLE_ELASTIC_RESTART": str(restart_idx),
+                # pod-scope contract: every rank dumps into the gang's
+                # shared dir (rank-tagged filenames), so --collect-dumps
+                # and failure collection know where to look; the launch
+                # wall time tells every rank when THIS gang life began
+                # (collection ignores dumps older than it)
+                "FLAGS_flight_dump_dir": self._flight_dir,
+                "PADDLE_LAUNCH_START_US":
+                    str(self._gang_start_wall * 1e6),
             })
+            if self.collect_dumps:
+                env["PADDLE_FLIGHT_DUMP_AT_EXIT"] = "1"
             log = None
             if args.log_dir:
                 log = open(os.path.join(args.log_dir,
@@ -310,23 +399,38 @@ class GangSupervisor:
             -> Tuple[str, int, int]:
         import shutil
         hb_dir = tempfile.mkdtemp(prefix="paddle_launch_hb_")
+        # pod-collection cutoff: the shared flight dir outlives elastic
+        # restarts, so dumps older than THIS life (removed ranks, previous
+        # failures) must not be merged into this life's report
+        self._gang_start_wall = time.time()
         procs, hb_files, logs = self._spawn(world, restart_idx, hb_dir)
         try:
             try:
                 self._rendezvous(procs, hb_files)
+                # everyone checked in: this instant is the pod timeline's
+                # t0 (podscope re-zeroes clock-aligned rank events here)
+                if self._anchor_wall_us is None:
+                    self._anchor_wall_us = time.time() * 1e6
             except self._WorkerFailed as e:
                 survivors = sum(1 for p in procs.values()
                                 if p.poll() is None)
                 print(f"[launch] {e}: fail-fast, terminating "
                       f"{survivors} sibling(s)", flush=True)
+                self._note_gang_failure(hb_files)
                 self._kill_gang(procs)
                 return ("failed", survivors, e.rc if e.rc > 0 else 1)
             except Exception:
                 # rendezvous deadline (DeadlineExceededError) or any other
                 # supervisor error: never leave a half-launched gang behind
+                self._note_gang_failure(hb_files)
                 self._kill_gang(procs)
                 raise
-            return self._monitor(procs, hb_files)
+            result = self._monitor(procs, hb_files)
+            if result[0] == "failed":
+                self._note_gang_failure(hb_files)
+            else:
+                self._last_heartbeats = self._snapshot_heartbeats(hb_files)
+            return result
         finally:
             for log in logs:
                 try:
@@ -335,6 +439,69 @@ class GangSupervisor:
                     pass
             shutil.rmtree(hb_dir, ignore_errors=True)
 
+    def collect_pod_dumps(self, status: str, world: int, rc: int,
+                          restart_idx: int) -> Optional[str]:
+        """Gather the gang's per-rank flight dumps into ONE pod dump dir
+        and emit the merged cross-rank timeline + straggler report next to
+        them (observability/podscope.py). Runs on every failure and, with
+        --collect-dumps, on clean exits too. Best-effort: collection must
+        never turn a diagnosed failure into a collection crash."""
+        import shutil as _shutil
+        from ..observability import podscope
+        try:
+            dumps = podscope.find_rank_dumps(self._flight_dir)
+            # only THIS life's gang: drop ranks outside the current world
+            # and dumps written before this launch (stale survivors of an
+            # elastic shrink or an earlier failure in the shared dir)
+            cutoff = getattr(self, "_gang_start_wall", None)
+            if cutoff is not None:
+                dumps = {r: d for r, d in dumps.items()
+                         if float(d.get("wall_time") or 0.0) >= cutoff - 1.0}
+            if world > 0:
+                dumps = {r: d for r, d in dumps.items() if r < world}
+            if not dumps and not self.collect_dumps:
+                return None            # nothing to say about this gang
+            pod_dir = self.args.pod_dump_dir or os.path.join(
+                self._flight_dir, f"pod_{restart_idx}_{status}")
+            os.makedirs(pod_dir, exist_ok=True)
+            for dump in dumps.values():
+                src = dump.get("_path")
+                if src and os.path.dirname(os.path.abspath(src)) \
+                        != os.path.abspath(pod_dir):
+                    _shutil.copy(src, pod_dir)
+            hb = self._last_heartbeats
+            with open(os.path.join(pod_dir, "heartbeats.json"), "w") as f:
+                json.dump({"status": status, "world": world, "rc": rc,
+                           "restart_idx": restart_idx,
+                           "anchor_us": self._anchor_wall_us,
+                           "heartbeats": {str(r): v
+                                          for r, v in sorted(hb.items())}},
+                          f, indent=1)
+            if not dumps:
+                print(f"[launch] pod dump dir {pod_dir}: no per-rank "
+                      "flight dumps found (workers exited before dumping "
+                      "or FLAGS_flight_recorder=0)", flush=True)
+                return pod_dir
+            res = podscope.write_pod_dump(
+                dumps, pod_dir, heartbeats=hb,
+                anchor_us=self._anchor_wall_us,
+                extra_meta={"status": status, "world": world, "rc": rc,
+                            "restart_idx": restart_idx})
+            summary = res["summary"]
+            suspect = ("none" if res["suspect"] is None
+                       else f"rank {res['suspect']}")
+            print(f"[launch] pod dump: {len(dumps)} rank dump(s) -> "
+                  f"{res['trace']} ({res['meta']['flow_pairs']} cross-rank "
+                  f"collective flow pair(s)); straggler report: "
+                  f"{res['report']} (suspect: {suspect}, step-time spread "
+                  f"{summary['step_time_spread_ms']:.1f} ms, collective "
+                  f"stall fraction {summary['collective_stall_fraction']})",
+                  flush=True)
+            return pod_dir
+        except Exception as e:
+            print(f"[launch] pod dump collection failed: {e!r}", flush=True)
+            return None
+
     def run(self) -> int:
         args = self.args
         world = len(self.ips) * max(args.nproc_per_node, 1)
@@ -342,16 +509,26 @@ class GangSupervisor:
         while True:
             status, survivors, rc = self.launch_once(world, restarts)
             if status == "ok":
+                if self.collect_dumps:
+                    self.collect_pod_dumps("ok", world, 0, restarts)
                 return 0
             # black-box the failed launch: the supervisor's own timeline
             # (rendezvous retry instants, heartbeat metrics) next to the
             # trainers' logs — same flight-dump format as a watchdog trip
             from ..observability import flight as _flight
-            path = _flight.dump("gang_failure",
-                                extra={"world": world, "survivors": survivors,
-                                       "rc": rc, "restart_idx": restarts})
+            from ..observability import podscope
+            suspect = podscope.suspect_from_heartbeats(self._last_heartbeats)
+            path = _flight.dump(
+                "gang_failure",
+                extra={"world": world, "survivors": survivors,
+                       "rc": rc, "restart_idx": restarts,
+                       "suspected_straggler":
+                           None if suspect is None else suspect[0],
+                       "heartbeats": {str(r): v for r, v in
+                                      sorted(self._last_heartbeats.items())}})
             if path:
                 print(f"[launch] flight-recorder dump: {path}", flush=True)
+            self.collect_pod_dumps("failed", world, rc, restarts)
             if restarts >= args.elastic_restarts or survivors < 1:
                 return rc
             restarts += 1
@@ -374,6 +551,7 @@ def launch(argv=None):
         print(f"[launch] FAILED: {e!r}" + (
             f" (flight-recorder dump: {path})" if path else ""),
             file=sys.stderr, flush=True)
+        sup.collect_pod_dumps("failed", 0, 1, 0)
         raise SystemExit(1)
     sys.exit(rc)
 

@@ -104,6 +104,16 @@ _DEFS: Dict[str, tuple] = {
     "FLAGS_flight_steps": (16, "flight-recorder step-ring depth"),
     "FLAGS_flight_dump_dir": ("", "where flight dumps land; empty = "
                               "<tmpdir>/paddle_tpu_flight"),
+    "FLAGS_collective_markers": (True, "stamp a correlation-key instant "
+                                 "(step, bucket, seq) per collective op on "
+                                 "every dispatch (framework/executor.py). "
+                                 "Matching keys across gang ranks become "
+                                 "the lane-crossing flow arrows and the "
+                                 "arrival-skew telemetry of the pod-scope "
+                                 "merge (observability/podscope.py, "
+                                 "scripts/pod_trace.py); costs a few "
+                                 "trace-ring appends per step, nothing "
+                                 "when FLAGS_trace_events=0"),
     # --- resilience tier (resilience/, docs/resilience.md) ---------------
     "FLAGS_fault_plan": ("", "fault-injection plan spec, e.g. "
                              "'kv.pull:error:every=3;ckpt.write:kill:at=2'"),

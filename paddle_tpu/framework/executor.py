@@ -899,6 +899,10 @@ class Executor:
         # spans attach the entry so every step in the trace carries its
         # program's XLA cost analysis
         self._step_costs: Dict[tuple, dict] = {}
+        # pod-scope collective correlation plan per compiled program
+        # (_emit_collective_markers): (program uid, version) -> ordered
+        # [(kind, bucket)] of the program's collective ops
+        self._coll_plans: Dict[tuple, list] = {}
 
     @staticmethod
     def _resolve_sync(sync: Optional[bool]) -> bool:
@@ -1247,6 +1251,7 @@ class Executor:
 
         benchmark = flag("FLAGS_benchmark")
         t0 = time.perf_counter()
+        self._emit_collective_markers(program, step_idx)
         with _trace.RecordEvent(f"executor_run#{op_count(program)}ops",
                                 args=self._dispatch_args(program, step_idx)):
             fetches, new_state = _dispatch()
@@ -1287,6 +1292,60 @@ class Executor:
                 step_deadline, "fetch materialization")
         return _package_fetches(fetches, user_names, return_numpy, sync,
                                 step=step_idx)
+
+    def _collective_marker_plan(self, program) -> list:
+        """Ordered [(kind, bucket_index)] of the program's collective ops —
+        the per-dispatch correlation plan for pod-scope tracing. Manual-dp
+        programs enumerate their explicit `__bucket_sync__` /
+        `__zero_update__` / `__zero_gather__` / `__zero_pack__` ops in
+        program order (identical across gang ranks, so (step, bucket, seq)
+        keys match rank-to-rank); a GSPMD multi-device program, whose
+        collectives are implicit in the lowering, gets one `__step_sync__`
+        marker per dispatch so cross-rank step arrows still link."""
+        key = (program._uid, program._version)
+        plan = self._coll_plans.get(key)
+        if plan is None:
+            from ..analysis.collectives import COLLECTIVE_OPS
+            plan = []
+            per_kind: Dict[str, int] = {}
+            for block in program.blocks:
+                for op in block.ops:
+                    if op.type in COLLECTIVE_OPS:
+                        b = per_kind.get(op.type, 0)
+                        per_kind[op.type] = b + 1
+                        plan.append((op.type, b))
+            if not plan:
+                dist = getattr(program, "_dist_config", None)
+                if dist is not None:
+                    try:
+                        shape = dist.resolve_mesh().shape
+                        ndev = 1
+                        for v in shape.values():
+                            ndev *= int(v)
+                    except Exception:
+                        ndev = 1
+                    if ndev > 1:
+                        plan = [("__step_sync__", 0)]
+            self._coll_plans[key] = plan
+        return plan
+
+    def _emit_collective_markers(self, program, step_idx, k=None):
+        """Stamp one correlation-key instant per collective op at dispatch
+        (cat "collective", args {kind, step, bucket, seq, key}). The ts is
+        the HOST DISPATCH time — the step is one XLA program, so this is
+        the rank's arrival at the step's collectives, the quantity the
+        pod-scope merge compares across ranks (who stalled whom). A few
+        trace-ring appends per step; nothing when tracing is off."""
+        from ..flags import flag
+        if not (_trace.enabled() and flag("FLAGS_collective_markers")):
+            return
+        for seq, (kind, bucket) in enumerate(
+                self._collective_marker_plan(program)):
+            args = {"kind": kind, "step": int(step_idx), "bucket": bucket,
+                    "seq": seq, "key": f"s{int(step_idx)}.b{bucket}.q{seq}"}
+            if k:
+                args["k"] = int(k)
+            _trace.instant("collective", args=args, cat="collective")
 
     def _dispatch_args(self, program, step_idx, k=None) -> dict:
         """Per-step phase annotations for the dispatch span: step index,
@@ -1476,6 +1535,7 @@ class Executor:
         step_idx = self._step_counter
         step_deadline = float(flag("FLAGS_step_deadline_ms") or 0.0)
         t0 = time.perf_counter()
+        self._emit_collective_markers(program, step_idx, k=k)
         with _trace.RecordEvent(f"executor_run_steps#{k}",
                                 args=self._dispatch_args(program, step_idx,
                                                          k=k)):

@@ -14,8 +14,14 @@ Layering:
   compat shim over it.
 * `flight` — the last N steps' spans + metric deltas, auto-dumped on step
   watchdog trips, gang failures, and degraded bench rows.
+* `podscope` — pod-scale aggregation: N per-rank flight dumps merged into
+  ONE clock-aligned Perfetto timeline (per-rank lanes, cross-rank
+  collective flow arrows) + collective arrival-skew telemetry and a
+  straggler report (the reference's tools/timeline.py multi-device merge,
+  at process scope).
 """
 from . import metrics  # noqa: F401
 from . import trace  # noqa: F401
 from . import flight  # noqa: F401
+from . import podscope  # noqa: F401
 from .trace import RecordEvent  # noqa: F401

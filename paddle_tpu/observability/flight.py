@@ -21,14 +21,29 @@ tests/test_observability.py. Disable entirely with FLAGS_flight_recorder=0
 (also the timing A/B's baseline arm).
 
 Dump location: FLAGS_flight_dump_dir, default <tmpdir>/paddle_tpu_flight;
-file name flight_<pid>_<reason>_<seq>.json. Format (docs/observability.md
-"Flight-recorder dumps"):
+file name flight_r<rank>_<pid>_<reason>_<seq>.json — rank AND pid ride in
+the name so N ranks of a gang dumping into one shared dir (the pod-scope
+collection contract, observability/podscope.py) can never overwrite each
+other. Format (docs/observability.md "Flight-recorder dumps"):
 
-    {"reason": ..., "pid": ..., "wall_time": ...,  "dropped_events": ...,
+    {"reason": ..., "rank": ..., "world": ..., "pid": ..., "wall_time": ...,
+     "clock": {"wall_time_us": ..., "trace_ts_us": ...},  # pod clock anchor
+     "dropped_events": ...,
      "steps":  [{"step": k, "exe": <executor id>, "t0_us": ..., "t1_us": ...,
                  "status": "ok", "metrics_delta": {...}}, ...],
      "trace_events": [...chrome-trace events covering those steps...],
      "metrics": {...full typed snapshot...}}
+
+`clock` is the trace-clock → wall-clock offset handshake: both clocks are
+read back-to-back at dump time, so a pod aggregator can place every rank's
+perf_counter-epoch events on one shared wall timeline (podscope.py;
+clock-skew caveats in docs/observability.md "Pod-scope").
+
+Under the gang launcher two extra contracts apply: `end_step` mirrors the
+last step index + duration into the worker's heartbeat file
+(PADDLE_LAUNCH_HEARTBEAT_FILE) so the supervisor can name a suspected
+straggler LIVE, and PADDLE_FLIGHT_DUMP_AT_EXIT=1 registers an atexit
+dump("exit") so clean workers still leave a black box for `--collect-dumps`.
 """
 from __future__ import annotations
 
@@ -79,6 +94,14 @@ def end_step(idx: int, status: str = "ok", owner: int = 0):
     # a phantom in-flight entry into every later dump()
     with _lock:
         opened = _open.pop((int(owner), int(idx)), None)
+    # liveness, not recording: the heartbeat step note flows even with the
+    # flight recorder off, so the supervisor's straggler naming never goes
+    # blind to a FLAGS_flight_recorder=0 trainer
+    hb = os.environ.get("PADDLE_LAUNCH_HEARTBEAT_FILE")
+    if hb:
+        dur_ms = (None if opened is None
+                  else (_trace.now_us() - opened[0]) / 1000.0)
+        _note_heartbeat_step(hb, idx, dur_ms)
     if opened is None or not enabled():
         return
     t0, snap0 = opened
@@ -88,6 +111,35 @@ def end_step(idx: int, status: str = "ok", owner: int = 0):
     with _lock:
         _steps.append(rec)
         del _steps[:-keep_steps()]
+
+
+def _note_heartbeat_step(path: str, idx: int, dur_ms: Optional[float]):
+    """Mirror (last step, step duration) into the launcher heartbeat file
+    (distributed/launch.py) — JSON content, written via atomic replace so
+    the supervisor never reads a torn record. The supervisor uses the
+    last-step spread across ranks to name the suspected straggler in its
+    gang-failure message. Never raises: a full disk must not fail a step."""
+    try:
+        rec = {"pid": os.getpid(), "step": int(idx),
+               "wall_us": time.time() * 1e6}
+        if dur_ms is not None:
+            rec["step_ms"] = round(float(dur_ms), 3)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def pod_identity() -> dict:
+    """This process's gang coordinates from the launcher env contract:
+    {"rank", "world", "role"} (rank 0 / world 1 / trainer outside a gang)."""
+    return {
+        "rank": int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0),
+        "world": int(os.environ.get("PADDLE_TRAINERS_NUM", "1") or 1),
+        "role": os.environ.get("TRAINING_ROLE", "TRAINER").lower(),
+    }
 
 
 def steps() -> list:
@@ -127,14 +179,25 @@ def dump(reason: str, path: Optional[str] = None,
             _dump_seq += 1
             seq = _dump_seq
         since = min((s["t0_us"] for s in step_recs), default=None)
+        ident = pod_identity()
+        # the clock handshake: both clocks read back-to-back, so the pair
+        # maps this process's trace (perf_counter) epoch onto the shared
+        # wall clock for pod-scope merging (podscope.align-events)
+        clock = {"wall_time_us": time.time() * 1e6,
+                 "trace_ts_us": _trace.now_us()}
         payload = {
             "format": 1,
             "reason": reason,
             "pid": os.getpid(),
+            "rank": ident["rank"],
+            "world": ident["world"],
+            "role": ident["role"],
             "wall_time": time.time(),
+            "clock": clock,
             "dropped_events": _trace.dropped_events(),
             "steps": step_recs,
-            "trace_events": (_trace.thread_metadata_events()
+            "trace_events": (_trace.process_metadata_events()
+                             + _trace.thread_metadata_events()
                              + _trace.events(since)),
             "metrics": _metrics.snapshot(),
         }
@@ -144,7 +207,8 @@ def dump(reason: str, path: Optional[str] = None,
             d = dump_dir()
             os.makedirs(d, exist_ok=True)
             path = os.path.join(
-                d, f"flight_{os.getpid()}_{reason}_{seq}.json")
+                d,
+                f"flight_r{ident['rank']}_{os.getpid()}_{reason}_{seq}.json")
         else:
             pd = os.path.dirname(path)
             if pd:
@@ -155,3 +219,13 @@ def dump(reason: str, path: Optional[str] = None,
         return path
     except Exception:
         return None
+
+
+# Clean-exit black box for the gang launcher's --collect-dumps: a worker
+# that finishes normally still leaves its flight dump for the supervisor's
+# pod aggregation. Opt-in via env (set by distributed/launch.py) so plain
+# local runs never write surprise files at interpreter exit.
+if os.environ.get("PADDLE_FLIGHT_DUMP_AT_EXIT") == "1":
+    import atexit
+
+    atexit.register(lambda: dump("exit"))

@@ -227,6 +227,28 @@ def thread_metadata_events() -> List[dict]:
              "args": {"name": name}} for tid, name in sorted(names.items())]
 
 
+def process_metadata_events() -> List[dict]:
+    """Process-lane metadata ("M" process_name / process_sort_index /
+    process_labels): rank, role, and world size from the launcher's env
+    contract (PADDLE_TRAINER_ID / TRAINING_ROLE / PADDLE_TRAINERS_NUM), so
+    even a single-rank trace opens in Perfetto with a labeled lane instead
+    of a bare pid — and a pod-merged trace (observability/podscope.py)
+    sorts its per-rank lanes in rank order."""
+    pid = os.getpid()
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1") or 1)
+    role = os.environ.get("TRAINING_ROLE", "TRAINER").lower()
+    return [
+        {"name": "process_name", "ph": "M", "pid": pid,
+         "args": {"name": f"rank {rank} ({role})"}},
+        {"name": "process_sort_index", "ph": "M", "pid": pid,
+         "args": {"sort_index": rank}},
+        {"name": "process_labels", "ph": "M", "pid": pid,
+         "args": {"labels": f"rank={rank},world={world},role={role},"
+                            f"pid={pid}"}},
+    ]
+
+
 def export_chrome_trace(path: str,
                         since_ts: Optional[float] = None,
                         extra_events: Optional[List[dict]] = None,
@@ -239,8 +261,8 @@ def export_chrome_trace(path: str,
     evs = (list(events_override) if events_override is not None
            else events(since_ts))
     payload = {
-        "traceEvents": thread_metadata_events() + evs
-        + list(extra_events or []),
+        "traceEvents": process_metadata_events() + thread_metadata_events()
+        + evs + list(extra_events or []),
         "displayTimeUnit": "ms",
         "otherData": {"dropped_events": dropped_events()},
     }

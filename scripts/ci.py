@@ -223,6 +223,36 @@ def collect_trace_smoke(proc, timeout=600) -> bool:
     return proc.returncode == 0
 
 
+# Pod-trace smoke (ISSUE-11 CI satellite): scripts/pod_trace.py --smoke —
+# a REAL 2-process supervised gang (launch.py --collect-dumps) of dp=2
+# trainers with an induced straggler; validates the merged pod timeline
+# (per-rank lanes, >= 1 cross-rank collective flow pair) and that the
+# straggler report names the stalled rank. Overlapped with the shards.
+def start_pod_trace_smoke(env):
+    script = os.path.join(ROOT, "scripts", "pod_trace.py")
+    return subprocess.Popen(
+        [sys.executable, script, "--smoke", "--smoke-port", "7461"],
+        cwd=ROOT, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+
+
+def collect_pod_trace_smoke(proc, timeout=900) -> bool:
+    try:
+        out_s, err_s = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        print(f"[pod-trace] FAIL timed out after {timeout}s")
+        return False
+    lines = (out_s or "").strip().splitlines()
+    status = "OK " if proc.returncode == 0 else "FAIL"
+    body = "\n".join("    " + ln for ln in lines[-6:])
+    tail = (err_s or "").strip().splitlines()[-25:]
+    print(f"[pod-trace] {status}\n{body}" + (
+        "\n" + "\n".join(tail) if proc.returncode != 0 else ""))
+    return proc.returncode == 0
+
+
 # Collective budget check (ISSUE-5 CI satellite): the per-mesh census of
 # scripts/collective_audit.py --assert — the dp rows must carry the
 # GROUPED bucket collectives (<= 4 per step, parallel/zero.py), not one
@@ -354,6 +384,10 @@ def main():
     ap.add_argument("--no-program-lint", action="store_true",
                     help="skip the static program-lint sweep "
                          "(scripts/program_lint.py --assert)")
+    ap.add_argument("--no-pod-trace", action="store_true",
+                    help="skip the pod-trace smoke (2-process supervised "
+                         "gang -> merged timeline + straggler report, "
+                         "scripts/pod_trace.py --smoke)")
     ap.add_argument("rest", nargs="*", help="extra pytest args")
     args = ap.parse_args()
 
@@ -378,6 +412,9 @@ def main():
     lint_proc = None
     if not args.no_program_lint:
         lint_proc = start_program_lint(env)        # overlaps the shards too
+    pod_proc = None
+    if not args.no_pod_trace:
+        pod_proc = start_pod_trace_smoke(env)      # overlaps the shards too
 
     files = sorted(glob.glob(os.path.join(ROOT, "tests", "test_*.py")))
     shards = shard(files, args.n)
@@ -429,6 +466,8 @@ def main():
         failed = failed or not collect_trace_smoke(smoke_proc)
     if lint_proc is not None:
         failed = failed or not collect_program_lint(lint_proc)
+    if pod_proc is not None:
+        failed = failed or not collect_pod_trace_smoke(pod_proc)
     print(f"CI total: {time.time() - t0:.0f}s over {len(shards)} shards -> "
           f"{'FAILED' if failed else 'PASSED'}")
     return 1 if failed else 0

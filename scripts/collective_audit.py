@@ -214,9 +214,37 @@ def check_budget(label, counts, byts, txt=None):
     return bad
 
 
+def stall_mode(argv) -> int:
+    """`--stall`: the pod-scope arrival-skew census for a dryrun gang.
+
+    Where the default mode audits WHAT collectives the compiled step runs
+    (static HLO census), this mode audits WHEN each rank arrives at them:
+    it drives the 2-process supervised-gang smoke (scripts/pod_trace.py —
+    dp=2 manual-dp workers with an induced straggler) and prints the
+    per-collective arrival-skew table + straggler scores from the merged
+    pod telemetry (observability/podscope.py; docs/perf_notes.md
+    "Collective audit" cross-links here). `--stall-s 0` drills a healthy
+    gang instead."""
+    stall_s = 0.4
+    if "--stall-s" in argv:
+        stall_s = float(argv[argv.index("--stall-s") + 1])
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import pod_trace
+    from paddle_tpu.observability import podscope
+    out = pod_trace.run_smoke(stall_s=stall_s, port=7471,
+                              stall_rank=1 if stall_s > 0 else -1)
+    dumps = podscope.find_rank_dumps(out["pod_dir"])
+    telemetry = podscope.collective_telemetry(dumps)
+    print("\nper-collective arrival skew (slowest stalls first):")
+    print(podscope.format_stall_table(telemetry, top_k=15))
+    return 0
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     assert_mode = "--assert" in argv
+    if "--stall" in argv:
+        return stall_mode(argv)
     # --skip-zero-rows (or PADDLE_TPU_AUDIT_SKIP_ZERO=1): drop the ZeRO
     # stage-2/3 + overlap rows (scripts/ci.py --no-zero-rows passes this)
     skip_zero = ("--skip-zero-rows" in argv

@@ -1,0 +1,272 @@
+#!/usr/bin/env python
+"""Pod-scope trace aggregation CLI (the reference's tools/timeline.py, at
+process scope — docs/migration.md §8, docs/observability.md "Pod-scope").
+
+Merge mode (default): point it at a directory of per-rank flight dumps
+(`flight_r<rank>_<pid>_*.json`, the shared `FLAGS_flight_dump_dir` a
+supervised gang writes into) and it emits ONE Perfetto/chrome timeline
+with a labeled process lane per rank, lane-crossing flow arrows linking
+each collective's (step, bucket, seq) correlation key across ranks, plus
+`straggler_report.json` and a printed per-collective arrival-skew table:
+
+    python scripts/pod_trace.py /tmp/paddle_pod_flight_x1 --out /tmp/pod
+    python scripts/pod_trace.py dumpdir --top-k 20
+
+Smoke mode (`--smoke`, run by scripts/ci.py): launches a REAL 2-process
+supervised gang (`distributed/launch.py --collect-dumps`) of tiny dp=2
+trainers with an induced straggler (one rank sleeps before every step),
+then schema-validates the collected pod artifacts: per-rank lanes, at
+least one cross-rank collective flow pair, and a straggler report naming
+the stalled rank. Each worker runs its own 2-virtual-device CPU mesh (a
+per-process replica of the dp=2 program): the machinery under test is the
+dispatch-marker → dump → clock-align → merge flow, which is identical on
+a real multi-host pod; only XLA's cross-host transport is out of scope.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# The smoke worker: a dp=2 manual-dp linreg step (the bucketed
+# `__bucket_sync__` path, so real collective correlation markers flow),
+# one warmup step to absorb compile jitter, then N measured steps with the
+# induced straggler sleeping ahead of each one.
+_SMOKE_WORKER = r'''
+import os, sys, time
+# strip the cross-process jax bootstrap the launcher's env contract sets
+# up: each rank runs its own per-process virtual CPU mesh instead (see
+# scripts/pod_trace.py docstring)
+for _k in ("PADDLE_TRAINER_ENDPOINTS", "JAX_COORDINATOR_ADDRESS",
+           "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"):
+    os.environ.pop(_k, None)
+rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+stall_rank = int(os.environ.get("POD_SMOKE_STALL_RANK", "-1"))
+stall_s = float(os.environ.get("POD_SMOKE_STALL_S", "0"))
+steps = int(os.environ.get("POD_SMOKE_STEPS", "8"))
+
+import numpy as np
+import jax
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.distributed import fleet
+from paddle_tpu.parallel import build_mesh, DistConfig, attach
+
+x = layers.data(name="x", shape=[4], dtype="float32")
+y = layers.data(name="y", shape=[1], dtype="float32")
+pred = layers.fc(x, 1)
+loss = layers.mean(layers.square(pred - y))
+fleet.init(is_collective=True)
+opt = fleet.distributed_optimizer(
+    paddle.optimizer.Adam(learning_rate=0.01), fleet.DistributedStrategy())
+opt.minimize(loss)
+prog = fluid.default_main_program()
+attach(prog, DistConfig(mesh=build_mesh(devices=jax.devices()[:2], dp=2)))
+exe = fluid.Executor()
+exe.run(fluid.default_startup_program())
+rng = np.random.RandomState(0)
+xs = rng.randn(8, 4).astype(np.float32)
+ys = rng.randn(8, 1).astype(np.float32)
+exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])   # warmup: compile here
+for _ in range(steps):
+    if rank == stall_rank and stall_s > 0:
+        time.sleep(stall_s)      # the induced straggler: arrives late at
+                                 # every subsequent step's collectives
+    exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+from paddle_tpu.observability import flight
+path = flight.dump("pod_smoke")
+print(f"[worker {rank}] flight dump: {path}", flush=True)
+'''
+
+
+def merge(dump_dir: str, out_dir: str, top_k: int = 10,
+          anchor_us=None, quiet: bool = False) -> dict:
+    from paddle_tpu.observability import podscope
+    dumps = podscope.find_rank_dumps(dump_dir)
+    if not dumps:
+        raise SystemExit(f"no flight dumps found in {dump_dir}")
+    heartbeats = None
+    hb_path = os.path.join(dump_dir, "heartbeats.json")
+    if os.path.exists(hb_path):
+        try:
+            with open(hb_path) as f:
+                meta = json.load(f)
+            heartbeats = {int(r): v
+                          for r, v in (meta.get("heartbeats") or {}).items()}
+            if anchor_us is None:
+                anchor_us = meta.get("anchor_us")
+        except (OSError, ValueError):
+            pass
+    res = podscope.write_pod_dump(dumps, out_dir, heartbeats=heartbeats,
+                                  anchor_us=anchor_us, top_k=top_k)
+    if not quiet:
+        telemetry = podscope.collective_telemetry(dumps)
+        report = json.load(open(res["report"]))
+        print(f"merged {len(dumps)} rank dump(s) (ranks "
+              f"{res['meta']['ranks']}) -> {res['trace']}")
+        print(f"cross-rank collective flow pairs: "
+              f"{res['meta']['flow_pairs']}")
+        print(f"straggler report: {res['report']}")
+        for r, info in report["ranks"].items():
+            print(f"  rank {r}: score {info['straggler_score']:.3f} "
+                  f"(last@{info['collectives_last']} collectives, "
+                  f"last step {info['last_step']}, "
+                  f"mean step {info['mean_step_ms']} ms)")
+        suspect = report["suspect"]
+        print(f"suspect: {'none' if suspect is None else f'rank {suspect}'}"
+              f"  step-time spread "
+              f"{report['summary']['step_time_spread_ms']} ms, "
+              f"collective stall fraction "
+              f"{report['summary']['collective_stall_fraction']}")
+        print("\nslowest collectives by stall:")
+        print(podscope.format_stall_table(telemetry, top_k))
+    return res
+
+
+def run_smoke(workdir=None, steps: int = 8, stall_rank: int = 1,
+              stall_s: float = 0.4, nproc: int = 2, port: int = 7411) -> dict:
+    """Launch the 2-process supervised gang, collect + merge its dumps,
+    validate the pod artifacts, and return the summary (the MULTICHIP
+    per-rank-spread / stall-fraction columns ride on this)."""
+    from paddle_tpu.testing import cpu_mesh_env
+    # workers inherit the launcher's os.environ: force the CPU mesh there
+    # (>= 2 virtual devices; an 8-device CI env passes through unchanged)
+    env = cpu_mesh_env(max(2, _current_device_count_hint()))
+    os.environ.update(env)
+    os.environ.update({
+        "POD_SMOKE_STALL_RANK": str(stall_rank),
+        "POD_SMOKE_STALL_S": str(stall_s),
+        "POD_SMOKE_STEPS": str(steps),
+    })
+    workdir = workdir or tempfile.mkdtemp(prefix="paddle_pod_smoke_")
+    os.makedirs(workdir, exist_ok=True)
+    worker = os.path.join(workdir, "smoke_worker.py")
+    with open(worker, "w") as f:
+        f.write(_SMOKE_WORKER)
+    flight_dir = os.path.join(workdir, "flight")
+    pod_dir = os.path.join(workdir, "pod")
+    os.environ["FLAGS_flight_dump_dir"] = flight_dir
+
+    from paddle_tpu.distributed.launch import launch
+    t0 = time.monotonic()
+    argv = ["--nproc_per_node", str(nproc), "--port", str(port),
+            "--rendezvous_deadline_ms", "180000",
+            "--grace_period_s", "5", "--collect-dumps",
+            "--pod_dump_dir", pod_dir, "--log_dir",
+            os.path.join(workdir, "logs"), worker]
+    rc = 0
+    try:
+        launch(argv)
+    except SystemExit as e:
+        rc = int(e.code or 0)
+    elapsed = time.monotonic() - t0
+    if rc != 0:
+        logs = ""
+        logdir = os.path.join(workdir, "logs")
+        for name in sorted(os.listdir(logdir)) if os.path.isdir(logdir) \
+                else []:
+            with open(os.path.join(logdir, name)) as f:
+                logs += f"--- {name} ---\n" + f.read()[-3000:] + "\n"
+        raise SystemExit(f"pod-trace smoke gang failed rc={rc} "
+                         f"after {elapsed:.0f}s\n{logs}")
+
+    # -- schema validation on the collected pod artifacts ------------------
+    trace_path = os.path.join(pod_dir, "pod_trace.json")
+    report_path = os.path.join(pod_dir, "straggler_report.json")
+    with open(trace_path) as f:
+        trace = json.load(f)
+    with open(report_path) as f:
+        report = json.load(f)
+    evs = trace["traceEvents"]
+    lanes = {e["pid"]: e["args"]["name"] for e in evs
+             if e.get("name") == "process_name"}
+    assert set(lanes) >= set(range(nproc)), \
+        f"expected {nproc} rank lanes, got {lanes}"
+    sorts = {e["pid"]: e["args"]["sort_index"] for e in evs
+             if e.get("name") == "process_sort_index"}
+    assert all(sorts.get(r) == r for r in range(nproc)), sorts
+    flows_s = [e for e in evs
+               if e.get("cat") == "pod_collective" and e.get("ph") == "s"]
+    flows_f = [e for e in evs
+               if e.get("cat") == "pod_collective" and e.get("ph") == "f"]
+    assert flows_s and flows_f, "no cross-rank collective flow pair"
+    assert {e["pid"] for e in flows_s} != {e["pid"] for e in flows_f} or \
+        len({e["pid"] for e in flows_s + flows_f}) > 1, \
+        "flow arrows never cross a lane"
+    if stall_rank >= 0 and stall_s > 0:
+        assert report["suspect"] == stall_rank, (
+            f"straggler report named {report['suspect']}, induced "
+            f"straggler was rank {stall_rank}: "
+            f"{json.dumps(report['ranks'], indent=1)}")
+    summary = report["summary"]
+    out = {
+        "world": nproc,
+        "steps": steps,
+        "elapsed_s": round(elapsed, 1),
+        "flow_pairs": len(flows_s),
+        "suspect": report["suspect"],
+        "step_time_spread_ms": summary["step_time_spread_ms"],
+        "collective_stall_fraction": summary["collective_stall_fraction"],
+        "pod_dir": pod_dir,
+    }
+    print(f"pod-trace smoke OK: world={nproc}, {len(flows_s)} cross-rank "
+          f"flow pair(s), suspect=rank {report['suspect']} (induced "
+          f"rank {stall_rank}), step_time_spread_ms="
+          f"{summary['step_time_spread_ms']}, collective_stall_fraction="
+          f"{summary['collective_stall_fraction']}, {elapsed:.0f}s")
+    return out
+
+
+def _current_device_count_hint() -> int:
+    """Honor an already-forced virtual device count (the CI env) without
+    importing jax in the launcher process."""
+    import re
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)",
+                  os.environ.get("XLA_FLAGS", ""))
+    return int(m.group(1)) if m else 2
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("pod_trace")
+    p.add_argument("dump_dir", nargs="?", default=None,
+                   help="directory of per-rank flight dumps (the gang's "
+                        "shared FLAGS_flight_dump_dir or a collected pod "
+                        "dump dir)")
+    p.add_argument("--out", default=None,
+                   help="output dir for pod_trace.json + "
+                        "straggler_report.json (default: <dump_dir>/pod)")
+    p.add_argument("--top-k", type=int, default=10,
+                   help="rows in the slowest-collectives-by-stall table")
+    p.add_argument("--anchor-us", type=float, default=None,
+                   help="wall-clock t0 (µs) to re-zero the merged "
+                        "timeline at (default: the supervisor's recorded "
+                        "rendezvous anchor, else the earliest event)")
+    p.add_argument("--smoke", action="store_true",
+                   help="run the 2-process supervised-gang smoke and "
+                        "validate the pod artifacts (scripts/ci.py)")
+    p.add_argument("--smoke-steps", type=int, default=8)
+    p.add_argument("--smoke-stall-rank", type=int, default=1)
+    p.add_argument("--smoke-stall-s", type=float, default=0.4)
+    p.add_argument("--smoke-port", type=int, default=7411)
+    args = p.parse_args(argv)
+
+    if args.smoke:
+        run_smoke(steps=args.smoke_steps, stall_rank=args.smoke_stall_rank,
+                  stall_s=args.smoke_stall_s, port=args.smoke_port)
+        return 0
+    if not args.dump_dir:
+        p.error("dump_dir is required outside --smoke")
+    merge(args.dump_dir, args.out or os.path.join(args.dump_dir, "pod"),
+          top_k=args.top_k, anchor_us=args.anchor_us)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,7 +4,13 @@ restart, and stale-heartbeat hang detection.
 
 The worker scripts are plain stdlib python (no jax import), so every test
 here is seconds, not minutes — the supervisor runs IN-PROCESS via
-launch(argv) and the gang members are real subprocesses."""
+launch(argv) and the gang members are real subprocesses. The pod-scope
+drills fabricate REAL-SCHEMA flight dumps + heartbeat JSON from stdlib
+(the dump/heartbeat formats are file contracts, not imports), so
+supervisor dump collection and straggler naming are tested in seconds;
+the jax-worker version of the same drill is scripts/pod_trace.py --smoke
+(CI)."""
+import json
 import os
 import signal
 import sys
@@ -189,3 +195,126 @@ time.sleep(600)
     t.join(timeout=5)
     assert rc != 0
     assert elapsed < 120, elapsed
+
+
+# --- pod-scope drills (stdlib workers writing the real file contracts) -----
+
+# Worker body: per "step", update the launcher heartbeat file with the
+# JSON step note (the observability/flight.py contract) and overwrite a
+# real-schema flight dump — each rank on its own fake trace-clock epoch,
+# so the drill exercises podscope's clock alignment too. The dump's
+# TIMELINE is fabricated deterministically (wall position = a fixed base +
+# this rank's cumulative step time), so cross-rank skew reflects only the
+# per-rank step_ms the drill chose — real spawn/scheduler jitter between
+# the worker processes cannot flake the suspect verdict; the real sleeps
+# below only pace the LIVE heartbeat behavior the supervisor watches.
+_POD_WORKER_BODY = """
+import json
+step_ms = {step_ms}
+nsteps = {nsteps}
+hb = os.environ.get("PADDLE_LAUNCH_HEARTBEAT_FILE")
+dump_dir = os.environ["FLAGS_flight_dump_dir"]
+os.makedirs(dump_dir, exist_ok=True)
+epoch = 7e9 * (rank + 1)                 # per-process trace-clock epoch
+# shared fabricated wall t0: the supervisor's launch instant — identical
+# across ranks AND recent enough for collection's staleness cutoff
+base_wall = float(os.environ["PADDLE_LAUNCH_START_US"])
+cum_us = 0.0
+steps, events = [], []
+for step in range(1, nsteps + 1):
+    dur_ms = step_ms[rank] if rank < len(step_ms) else step_ms[-1]
+    time.sleep(dur_ms / 1000.0)          # pace the live heartbeats
+    t0 = epoch + cum_us
+    cum_us += dur_ms * 1000.0
+    ts = epoch + cum_us                  # trace-clock arrival
+    events.append({{"name": "collective", "ph": "i", "cat": "collective",
+                    "ts": ts, "tid": 1, "pid": os.getpid(),
+                    "args": {{"kind": "__bucket_sync__", "step": step,
+                              "bucket": 0, "seq": 0,
+                              "key": "s%d.b0.q0" % step}}}})
+    steps.append({{"step": step, "exe": 1, "t0_us": t0, "t1_us": ts,
+                   "status": "ok", "metrics_delta": {{}}}})
+    if hb:
+        with open(hb + ".tmp", "w") as f:
+            json.dump({{"pid": os.getpid(), "step": step,
+                        "step_ms": dur_ms}}, f)
+        os.replace(hb + ".tmp", hb)
+    payload = {{"format": 1, "reason": "drill", "rank": rank,
+                "world": world, "role": "trainer", "pid": os.getpid(),
+                "wall_time": (base_wall + cum_us) / 1e6,
+                "clock": {{"wall_time_us": base_wall + cum_us,
+                           "trace_ts_us": epoch + cum_us}},
+                "steps": steps, "trace_events": events, "metrics": {{}}}}
+    path = os.path.join(dump_dir,
+                        "flight_r%d_%d_drill_1.json" % (rank, os.getpid()))
+    with open(path + ".tmp", "w") as f:
+        json.dump(payload, f)
+    os.replace(path + ".tmp", path)
+"""
+
+
+def test_gang_failure_names_straggler_live_and_in_report(tmp_path, capsys):
+    """Induced straggler drill: rank 1 crawls (400 ms/step) while rank 0
+    finishes its steps and exits non-zero. The supervisor must name rank 1
+    LIVE in the gang-failure output (heartbeat last-step spread) AND the
+    collected pod straggler report must score rank 1 as the suspect."""
+    pod_dir = str(tmp_path / "pod")
+    script = _worker(
+        tmp_path,
+        _POD_WORKER_BODY.format(step_ms=[10, 400], nsteps=8)
+        + "if rank == 0:\n"
+          "    time.sleep(2.0)   # let the crawling rank 1 record steps\n"
+          "    sys.exit(5)\n"
+          "time.sleep(600)\n")
+    rc = _launch(["--nproc_per_node", "2", "--port", "7341",
+                  "--rendezvous_deadline_ms", "20000",
+                  "--grace_period_s", "1", "--collect-dumps",
+                  "--pod_dump_dir", pod_dir, script])
+    assert rc == 5
+    out = capsys.readouterr().out
+    assert "suspected straggler: rank 1" in out, out
+    # post-hoc: the pod collection merged both ranks' dumps and the report
+    # names the same rank
+    with open(os.path.join(pod_dir, "straggler_report.json")) as f:
+        report = json.load(f)
+    assert report["suspect"] == 1, report["ranks"]
+    assert report["ranks"]["1"]["last_step"] < report["gang_max_step"]
+    # the heartbeat snapshot rode into the pod dir for postmortems
+    with open(os.path.join(pod_dir, "heartbeats.json")) as f:
+        hb = json.load(f)
+    assert hb["status"] == "failed" and hb["world"] == 2
+    assert hb["heartbeats"]["0"]["step"] == 8
+
+
+def test_collect_dumps_clean_exit_round_trip(tmp_path, capsys):
+    """--collect-dumps on a CLEAN gang exit: per-rank dumps gathered into
+    the pod dir, ONE merged timeline with both rank lanes and >= 1
+    cross-rank collective flow pair, and a straggler report that names
+    NOBODY (symmetric ranks)."""
+    pod_dir = str(tmp_path / "pod")
+    script = _worker(tmp_path,
+                     _POD_WORKER_BODY.format(step_ms=[10, 10], nsteps=3))
+    rc = _launch(["--nproc_per_node", "2", "--port", "7351",
+                  "--rendezvous_deadline_ms", "20000",
+                  "--grace_period_s", "1", "--collect-dumps",
+                  "--pod_dump_dir", pod_dir, script])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "pod dump: 2 rank dump(s)" in out, out
+    # raw per-rank dumps were copied in (rank-tagged names, no collision)
+    raw = sorted(f for f in os.listdir(pod_dir)
+                 if f.startswith("flight_r"))
+    assert len(raw) == 2 and raw[0].startswith("flight_r0_") \
+        and raw[1].startswith("flight_r1_"), raw
+    with open(os.path.join(pod_dir, "pod_trace.json")) as f:
+        merged = json.load(f)
+    evs = merged["traceEvents"]
+    lanes = {e["pid"] for e in evs if e.get("name") == "process_name"}
+    assert lanes == {0, 1}
+    flows = [e for e in evs if e.get("cat") == "pod_collective"]
+    assert {e["ph"] for e in flows} >= {"s", "f"}
+    assert len({e["pid"] for e in flows}) == 2, "flows never cross lanes"
+    with open(os.path.join(pod_dir, "straggler_report.json")) as f:
+        report = json.load(f)
+    assert report["suspect"] is None, report["ranks"]
+    assert report["summary"]["collective_keys_matched"] >= 1

@@ -1,0 +1,374 @@
+"""Pod-scope observability (observability/podscope.py + the trace/flight
+plumbing it rides on): clock alignment across per-process trace epochs,
+cross-rank collective flow arrows, arrival-skew telemetry, straggler
+scoring, rank-tagged dump filenames, and process-lane metadata.
+
+Everything here is fabricated-dump fast (no gangs, no compiles) — the real
+2-process supervised gang runs in scripts/pod_trace.py --smoke (CI) and
+tests/test_launch.py's stdlib drills."""
+import json
+import os
+
+import numpy as np  # noqa: F401  (conftest import parity)
+
+from paddle_tpu.observability import flight, podscope, trace
+
+
+def _mk_dump(rank, epoch_us, n_steps=3, step_ms=10.0, lag_ms=0.0,
+             world=2, wall0_us=1_000_000.0, reason="exit", pid=None):
+    """A fabricated flight dump: per-process trace epoch `epoch_us` (the
+    perf_counter arbitrariness podscope must align away), one collective
+    marker + one step record per step. `lag_ms` delays this rank's arrival
+    at step k's collective by k*lag_ms (a cumulative straggler)."""
+    events, steps = [], []
+    for s in range(1, n_steps + 1):
+        wall = wall0_us + (s - 1) * 20_000 + s * lag_ms * 1000.0
+        ts = epoch_us + (wall - wall0_us)          # trace clock of `wall`
+        events.append({"name": "collective", "ph": "i", "cat": "collective",
+                       "ts": ts, "tid": 11, "pid": pid or (4000 + rank),
+                       "args": {"kind": "__bucket_sync__", "step": s,
+                                "bucket": 0, "seq": 0,
+                                "key": f"s{s}.b0.q0"}})
+        steps.append({"step": s, "exe": 1, "t0_us": ts,
+                      "t1_us": ts + step_ms * 1000.0, "status": "ok",
+                      "metrics_delta": {}})
+    end_wall = wall0_us + n_steps * 20_000 + n_steps * lag_ms * 1000.0
+    return {"format": 1, "reason": reason, "rank": rank, "world": world,
+            "role": "trainer", "pid": pid or (4000 + rank),
+            "wall_time": end_wall / 1e6,
+            "clock": {"wall_time_us": end_wall,
+                      "trace_ts_us": epoch_us + (end_wall - wall0_us)},
+            "steps": steps, "trace_events": events, "metrics": {}}
+
+
+# --- clock alignment + merge -------------------------------------------------
+
+def test_merge_aligns_disjoint_trace_epochs():
+    """Two ranks with wildly different perf_counter epochs land on ONE
+    wall timeline: matching collective keys are microseconds apart after
+    alignment, not the 9e12 µs their raw ts differ by."""
+    dumps = {0: _mk_dump(0, epoch_us=5e9), 1: _mk_dump(1, epoch_us=9e12)}
+    events, meta = podscope.merge_timeline(dumps)
+    assert meta["ranks"] == [0, 1]
+    markers = [e for e in events if e.get("cat") == "collective"]
+    by_key = {}
+    for e in markers:
+        by_key.setdefault(e["args"]["key"], []).append(e)
+    for key, evs in by_key.items():
+        assert len(evs) == 2, key
+        assert abs(evs[0]["ts"] - evs[1]["ts"]) < 1.0, (key, evs)
+    # pids were rewritten to ranks; the anchor re-zeroed the timeline
+    assert {e["pid"] for e in markers} == {0, 1}
+    assert min(e["ts"] for e in markers) < 1.0
+
+
+def test_merge_emits_per_rank_lane_metadata_and_flows():
+    dumps = {0: _mk_dump(0, 1e9), 1: _mk_dump(1, 2e9, lag_ms=50.0)}
+    events, meta = podscope.merge_timeline(dumps)
+    names = {e["pid"]: e["args"]["name"] for e in events
+             if e.get("name") == "process_name"}
+    sorts = {e["pid"]: e["args"]["sort_index"] for e in events
+             if e.get("name") == "process_sort_index"}
+    labels = {e["pid"]: e["args"]["labels"] for e in events
+              if e.get("name") == "process_labels"}
+    assert names == {0: "rank 0 (trainer)", 1: "rank 1 (trainer)"}
+    assert sorts == {0: 0, 1: 1}
+    assert "world=2" in labels[0]
+    # one lane-crossing flow per matched key: "s" opens on the first
+    # arrival (rank 0), "f" closes on the straggler (rank 1)
+    starts = [e for e in events
+              if e.get("cat") == "pod_collective" and e["ph"] == "s"]
+    ends = [e for e in events
+            if e.get("cat") == "pod_collective" and e["ph"] == "f"]
+    assert meta["flow_pairs"] == 3 == len(starts) == len(ends)
+    assert {e["pid"] for e in starts} == {0}
+    assert {e["pid"] for e in ends} == {1}
+    assert all(e["bp"] == "e" for e in ends)
+    # chrome binds s/f by (cat, name, id): ids pair up 1:1
+    assert sorted(e["id"] for e in starts) == sorted(e["id"] for e in ends)
+    # the dumps' own process metadata must not leak original-pid lanes
+    assert all(e["pid"] in (0, 1) for e in events if e.get("ph") == "M")
+    # synthesized step bands ride along per rank
+    bands = [e for e in events if e.get("cat") == "flight_step"]
+    assert {e["pid"] for e in bands} == {0, 1}
+
+
+def test_collective_telemetry_skew_decomposition():
+    """Rank 1 arrives k*5ms late at step k: skew grows linearly, rank 1 is
+    last everywhere, and rank 0's wait equals the skew."""
+    dumps = {0: _mk_dump(0, 1e9), 1: _mk_dump(1, 7e10, lag_ms=5.0)}
+    rows = podscope.collective_telemetry(dumps)
+    assert len(rows) == 3
+    assert rows[0]["skew_us"] > rows[-1]["skew_us"]  # sorted, slowest first
+    for row in rows:
+        s = int(row["key"][1:].split(".")[0])
+        assert row["last_rank"] == 1 and row["first_rank"] == 0
+        assert abs(row["skew_us"] - s * 5000.0) < 1.0
+        assert abs(row["waits_us"]["0"] - row["skew_us"]) < 1e-6
+        assert row["waits_us"]["1"] == 0.0
+
+
+# --- straggler report --------------------------------------------------------
+
+def test_straggler_report_names_slow_rank():
+    dumps = {0: _mk_dump(0, 1e9, step_ms=10.0),
+             1: _mk_dump(1, 2e9, step_ms=40.0, lag_ms=30.0)}
+    rep = podscope.straggler_report(dumps)
+    assert rep["suspect"] == 1
+    r1 = rep["ranks"]["1"]
+    assert r1["collectives_last"] == 3
+    assert r1["straggler_score"] > rep["ranks"]["0"]["straggler_score"]
+    assert rep["summary"]["step_time_spread_ms"] == 30.0
+    assert rep["summary"]["collective_stall_fraction"] > 0
+    assert len(rep["top_stalls"]) == 3
+
+
+def test_straggler_report_healthy_gang_names_nobody():
+    """Symmetric ranks with µs-level skew: the stall floor keeps the
+    trivially-last rank from being branded a straggler."""
+    dumps = {0: _mk_dump(0, 1e9, step_ms=10.0),
+             1: _mk_dump(1, 2e9, step_ms=10.0, lag_ms=0.0001)}
+    rep = podscope.straggler_report(dumps)
+    assert rep["suspect"] is None
+    assert all(info["collectives_last"] == 0
+               for info in rep["ranks"].values())
+
+
+def test_straggler_report_step_lag_scores_killed_rank():
+    """A rank whose dump stops early (killed straggler) still scores via
+    step lag — with the heartbeat snapshot filling in its last step."""
+    dumps = {0: _mk_dump(0, 1e9, n_steps=8),
+             1: _mk_dump(1, 2e9, n_steps=2, step_ms=300.0)}
+    hb = {0: {"pid": 10, "step": 8, "step_ms": 10.0},
+          1: {"pid": 11, "step": 2, "step_ms": 300.0}}
+    rep = podscope.straggler_report(dumps, heartbeats=hb)
+    assert rep["suspect"] == 1
+    assert rep["gang_max_step"] == 8
+    assert rep["ranks"]["1"]["last_step"] == 2
+    assert rep["ranks"]["1"]["score_parts"]["step_lag_frac"] == 0.75
+
+
+def test_straggler_report_stepless_rank_scores_maximal_lag():
+    """A rank wedged before closing its FIRST step (dump with no closed
+    steps, heartbeat without a step note) must score maximal step lag —
+    not vanish from the report with a 0.0 score."""
+    stuck = _mk_dump(1, 2e9, n_steps=0)
+    dumps = {0: _mk_dump(0, 1e9, n_steps=6), 1: stuck}
+    rep = podscope.straggler_report(dumps)
+    assert rep["ranks"]["1"]["last_step"] is None
+    assert rep["ranks"]["1"]["score_parts"]["step_lag_frac"] == 1.0
+    assert rep["suspect"] == 1
+
+
+def test_merge_dedupes_intra_rank_restamps():
+    """A cached-window re-dispatch re-stamps the same key within one rank:
+    the flow arrow must still point at the cross-rank straggler, never at
+    an intra-rank re-stamp gap (the telemetry dedup, applied to the merge
+    too)."""
+    d0 = _mk_dump(0, 1e9, n_steps=1)
+    d1 = _mk_dump(1, 2e9, n_steps=1, lag_ms=20.0)
+    # rank 0 re-stamps s1.b0.q0 much later than rank 1's arrival
+    restamp = dict(d0["trace_events"][0])
+    restamp = dict(restamp, ts=restamp["ts"] + 500_000.0)
+    d0["trace_events"].append(restamp)
+    events, meta = podscope.merge_timeline({0: d0, 1: d1})
+    ends = [e for e in events
+            if e.get("cat") == "pod_collective" and e["ph"] == "f"]
+    assert meta["flow_pairs"] == 1 and len(ends) == 1
+    assert ends[0]["pid"] == 1, "arrow must end on the cross-rank straggler"
+    assert ends[0]["args"]["last_rank"] == 1
+    assert abs(ends[0]["args"]["skew_us"] - 20_000.0) < 1.0
+
+
+def test_suspect_from_heartbeats():
+    # step spread: the furthest-behind rank
+    assert podscope.suspect_from_heartbeats(
+        {0: {"step": 9, "step_ms": 10.0},
+         1: {"step": 3, "step_ms": 400.0}})[0] == 1
+    # equal steps, outlying duration
+    rank, why = podscope.suspect_from_heartbeats(
+        {0: {"step": 5, "step_ms": 10.0}, 1: {"step": 5, "step_ms": 99.0}})
+    assert rank == 1 and "99" in why
+    # healthy gang: nobody
+    assert podscope.suspect_from_heartbeats(
+        {0: {"step": 5, "step_ms": 10.0},
+         1: {"step": 5, "step_ms": 11.0}}) is None
+    # no data: nobody
+    assert podscope.suspect_from_heartbeats({0: {}, 1: {}}) is None
+
+
+# --- dump discovery ----------------------------------------------------------
+
+def test_find_rank_dumps_newest_per_rank_skips_supervisor(tmp_path):
+    d = str(tmp_path)
+
+    def write(name, payload):
+        with open(os.path.join(d, name), "w") as f:
+            json.dump(payload, f)
+
+    old = _mk_dump(0, 1e9)
+    old["wall_time"] = 100.0
+    new = _mk_dump(0, 1e9)
+    new["wall_time"] = 200.0
+    write("flight_r0_11_exit_1.json", old)
+    write("flight_r0_11_exit_2.json", new)
+    write("flight_r1_12_exit_1.json", _mk_dump(1, 2e9))
+    # the supervisor's own black box must not shadow worker rank 0
+    sup = _mk_dump(0, 3e9, reason="gang_failure")
+    sup["wall_time"] = 999.0
+    write("flight_r0_99_gang_failure_1.json", sup)
+    write("not_a_dump.json", {"hello": 1})
+    dumps = podscope.find_rank_dumps(d)
+    assert sorted(dumps) == [0, 1]
+    assert dumps[0]["wall_time"] == 200.0
+
+
+# --- flight/trace plumbing ---------------------------------------------------
+
+def test_flight_dump_filename_embeds_rank_and_pid(tmp_path, monkeypatch):
+    """Satellite: N ranks dumping into one shared dir never collide — the
+    filename carries rank AND pid, the payload carries rank/world/role and
+    the clock-offset handshake pair."""
+    from paddle_tpu.flags import set_flags
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "3")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+    set_flags({"FLAGS_flight_dump_dir": str(tmp_path)})
+    try:
+        path = flight.dump("unit")
+        assert path is not None
+        base = os.path.basename(path)
+        assert base.startswith(f"flight_r3_{os.getpid()}_unit_"), base
+        with open(path) as f:
+            payload = json.load(f)
+        assert payload["rank"] == 3 and payload["world"] == 4
+        assert payload["role"] == "trainer"
+        clock = payload["clock"]
+        # the pair was read back-to-back: offset maps trace ts onto wall µs
+        assert abs(clock["wall_time_us"] - payload["wall_time"] * 1e6) < 5e6
+        assert clock["trace_ts_us"] > 0
+        # process-lane metadata rides inside the dump's event list
+        names = [e for e in payload["trace_events"]
+                 if e.get("name") == "process_name"]
+        assert names and names[0]["args"]["name"] == "rank 3 (trainer)"
+    finally:
+        set_flags({"FLAGS_flight_dump_dir": ""})
+
+
+def test_process_metadata_events_label_single_rank(monkeypatch):
+    """Satellite: even a single-rank export opens with a labeled lane."""
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "8")
+    monkeypatch.setenv("TRAINING_ROLE", "TRAINER")
+    evs = trace.process_metadata_events()
+    by_name = {e["name"]: e for e in evs}
+    assert by_name["process_name"]["args"]["name"] == "rank 2 (trainer)"
+    assert by_name["process_sort_index"]["args"]["sort_index"] == 2
+    assert "world=8" in by_name["process_labels"]["args"]["labels"]
+    assert all(e["pid"] == os.getpid() for e in evs)
+
+
+def test_export_chrome_trace_carries_process_metadata(tmp_path):
+    out = str(tmp_path / "t.json")
+    trace.export_chrome_trace(out)
+    with open(out) as f:
+        payload = json.load(f)
+    kinds = {e["name"] for e in payload["traceEvents"]
+             if e.get("ph") == "M"}
+    assert {"process_name", "process_sort_index",
+            "process_labels"} <= kinds
+
+
+# --- executor correlation plan ----------------------------------------------
+
+class _StubOp:
+    def __init__(self, type_):
+        self.type = type_
+
+
+class _StubBlock:
+    def __init__(self, ops):
+        self.ops = ops
+
+
+class _StubMesh:
+    def __init__(self, shape):
+        self.shape = shape
+
+
+class _StubDist:
+    def __init__(self, shape):
+        self._shape = shape
+
+    def resolve_mesh(self):
+        return _StubMesh(self._shape)
+
+
+class _StubProgram:
+    _next_uid = 900000
+
+    def __init__(self, op_types, dist_shape=None):
+        _StubProgram._next_uid += 1
+        self._uid = _StubProgram._next_uid
+        self._version = 0
+        self.blocks = [_StubBlock([_StubOp(t) for t in op_types])]
+        if dist_shape is not None:
+            self._dist_config = _StubDist(dist_shape)
+
+
+def test_collective_marker_plan_and_emission():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.flags import set_flags
+    exe = fluid.Executor()
+    # manual-dp program: explicit collective ops enumerate in program
+    # order with per-kind bucket indices
+    prog = _StubProgram(["mul", "__bucket_sync__", "elementwise_add",
+                         "__zero_update__", "__bucket_sync__"])
+    plan = exe._collective_marker_plan(prog)
+    assert plan == [("__bucket_sync__", 0), ("__zero_update__", 0),
+                    ("__bucket_sync__", 1)]
+    # GSPMD multi-device program: no explicit ops -> one step_sync key
+    gspmd = _StubProgram(["mul"], dist_shape={"dp": 2, "tp": 2})
+    assert exe._collective_marker_plan(gspmd) == [("__step_sync__", 0)]
+    # single-device program: nothing to correlate
+    single = _StubProgram(["mul"], dist_shape={"dp": 1})
+    assert exe._collective_marker_plan(single) == []
+
+    # emission stamps one correlation-key instant per plan entry
+    trace.clear()
+    exe._emit_collective_markers(prog, 7)
+    keys = [e["args"]["key"] for e in trace.events()
+            if e.get("cat") == "collective"]
+    assert keys == ["s7.b0.q0", "s7.b0.q1", "s7.b1.q2"]
+    # and respects the flag
+    trace.clear()
+    set_flags({"FLAGS_collective_markers": 0})
+    try:
+        exe._emit_collective_markers(prog, 8)
+        assert [e for e in trace.events()
+                if e.get("cat") == "collective"] == []
+    finally:
+        set_flags({"FLAGS_collective_markers": 1})
+
+
+# --- end-to-end on fabricated artifacts -------------------------------------
+
+def test_write_pod_dump_round_trip(tmp_path):
+    dumps = {0: _mk_dump(0, 1e9), 1: _mk_dump(1, 2e9, lag_ms=40.0)}
+    res = podscope.write_pod_dump(
+        dumps, str(tmp_path / "pod"),
+        heartbeats={0: {"step": 3, "step_ms": 10.0},
+                    1: {"step": 3, "step_ms": 10.0}},
+        extra_meta={"status": "ok"})
+    assert res["suspect"] == 1
+    with open(res["trace"]) as f:
+        merged = json.load(f)
+    assert merged["otherData"]["status"] == "ok"
+    assert merged["otherData"]["flow_pairs"] == 3
+    with open(res["report"]) as f:
+        report = json.load(f)
+    assert report["suspect"] == 1
+    assert report["summary"]["collective_keys_matched"] == 3
+    # the stall table renders the telemetry rows
+    table = podscope.format_stall_table(
+        podscope.collective_telemetry(dumps))
+    assert "__bucket_sync__" in table and "r1" in table

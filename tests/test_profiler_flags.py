@@ -33,11 +33,14 @@ def test_profiler_collects_spans_and_exports_timeline(tmp_path):
     # thread-name metadata ("M") and instant/flow events (no dur)
     spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
     assert spans and all("ts" in e and "dur" in e for e in spans)
-    # lanes are labeled with REAL thread ids + name metadata
+    # lanes are labeled with REAL thread ids + name metadata (process_*
+    # metadata — rank/role lane labels — rides along without tids)
     metas = [e for e in trace["traceEvents"] if e.get("ph") == "M"]
     assert any(e["name"] == "thread_name" for e in metas)
+    assert any(e["name"] == "process_name" for e in metas)
     span_tids = {e["tid"] for e in spans}
-    assert span_tids <= {e["tid"] for e in metas}
+    assert span_tids <= {e["tid"] for e in metas
+                         if e["name"] == "thread_name"}
 
 
 def test_flags_set_get_and_env_rejects_unknown():
