@@ -24,9 +24,20 @@ milliseconds, at build time:
 * `passes`     — the FLAGS_verify_passes harness: verify after each
                  program pass, naming the offending pass and dumping a
                  before/after op diff on failure.
+* `sharding`   — static sharding-spec propagation under a (mesh × stage ×
+                 bucket) plan point: per-var ShardSpecs, implicit-reshard
+                 lint, the structural manual-dp fallback matrix promoted
+                 to build-time Findings, and illegal-plan rejection
+                 (stage3+tp) — the auto-parallel planner's front-end.
+* `cost`       — compile-free collective & memory prediction
+                 (`predict_cost`): per-step collective kind/count/bytes
+                 cross-validated against scripts/collective_audit.py's
+                 runtime census, per-device argument bytes against
+                 Executor.compiled_memory_analysis.
 
 CLI: `scripts/program_lint.py` lints the examples/ model-program zoo and
-runs in CI (`scripts/ci.py`). Docs: docs/static_analysis.md.
+runs in CI (`scripts/ci.py`); `--mesh dp=2,tp=2` adds the sharding lint,
+`--predict` the cost table. Docs: docs/static_analysis.md.
 """
 from .findings import Finding, errors_only, format_findings  # noqa: F401
 from .verifier import verify_program  # noqa: F401
@@ -35,3 +46,6 @@ from .collectives import (check_collectives, collective_sequence,  # noqa: F401
                           dataflow_preserved)
 from .passes import (PassVerificationError, checked_pass,  # noqa: F401
                      verify_passes_enabled)
+from .sharding import (PlanPoint, check_plan, parse_mesh,  # noqa: F401
+                       plan_mode, propagate_sharding)
+from .cost import CostReport, predict_cost, predict_memory  # noqa: F401

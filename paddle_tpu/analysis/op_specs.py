@@ -22,10 +22,20 @@ Spec semantics (validated by analysis/verifier.py):
 * closed_attrs: attrs outside attr_types/required_attrs/COMMON_ATTRS are
   "unknown_attr" warnings (only sensible for ops this repo fully emits —
   the __dunder__ structural ops).
+* sharding: the op's spec-propagation rule name (analysis/sharding.py
+  RULES) — the static analog of the reference auto_parallel completion
+  rules (elementwise-follows-input, matmul contraction, ...). Ops without
+  a rule propagate replicated outputs and draw an "unknown_sharding_rule"
+  warning from the sharding lint.
+* cross_batch: the op couples examples ACROSS the global batch beyond a
+  trailing mean-reduced loss (sync-BN semantics, MoE FCFS capacity /
+  routing stats) — the manual-dp shard_map path must decline such
+  programs. THE one table: parallel/zero.py's runtime decline and the
+  build-time sharding lint both read it via `cross_batch_ops()`.
 """
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Dict, FrozenSet, Optional, Tuple
 
 from ..ops import registry
 
@@ -38,18 +48,22 @@ COMMON_ATTRS = frozenset({
 
 class OpSpec:
     __slots__ = ("inputs", "outputs", "required_attrs", "attr_types",
-                 "closed_attrs", "allow_extra_slots")
+                 "closed_attrs", "allow_extra_slots", "sharding",
+                 "cross_batch")
 
     def __init__(self, inputs: Optional[Dict[str, Tuple]] = None,
                  outputs: Optional[Dict[str, Tuple]] = None,
                  required_attrs=(), attr_types: Optional[dict] = None,
-                 closed_attrs: bool = False, allow_extra_slots: bool = False):
+                 closed_attrs: bool = False, allow_extra_slots: bool = False,
+                 sharding: Optional[str] = None, cross_batch: bool = False):
         self.inputs = dict(inputs or {})
         self.outputs = dict(outputs or {})
         self.required_attrs = tuple(required_attrs)
         self.attr_types = dict(attr_types or {})
         self.closed_attrs = closed_attrs
         self.allow_extra_slots = allow_extra_slots
+        self.sharding = sharding
+        self.cross_batch = cross_batch
 
 
 _LIST = (list, tuple)
@@ -136,19 +150,21 @@ SPECS: Dict[str, OpSpec] = {
     # --- optimizer update ops (the ZeRO pass rewrites these) -------------
     "sgd": OpSpec(
         inputs={"Param": ONE, "Grad": ONE, "LearningRate": ONE},
-        outputs={"ParamOut": ONE}),
+        outputs={"ParamOut": ONE}, sharding="param_update"),
     "momentum": OpSpec(
         inputs={"Param": ONE, "Grad": ONE, "Velocity": ONE,
                 "LearningRate": ONE},
         outputs={"ParamOut": ONE, "VelocityOut": ONE},
-        attr_types={"mu": _NUM, "use_nesterov": bool}),
+        attr_types={"mu": _NUM, "use_nesterov": bool},
+        sharding="param_update"),
     "adam": OpSpec(
         inputs={"Param": ONE, "Grad": ONE, "LearningRate": ONE,
                 "Moment1": ONE, "Moment2": ONE, "Beta1Pow": ONE,
                 "Beta2Pow": ONE},
         outputs={"ParamOut": ONE, "Moment1Out": ONE, "Moment2Out": ONE,
                  "Beta1PowOut": OPT, "Beta2PowOut": OPT},
-        attr_types={"beta1": _NUM, "beta2": _NUM, "epsilon": _NUM}),
+        attr_types={"beta1": _NUM, "beta2": _NUM, "epsilon": _NUM},
+        sharding="param_update"),
     "adamw": OpSpec(
         inputs={"Param": ONE, "Grad": ONE, "LearningRate": ONE,
                 "Moment1": ONE, "Moment2": ONE, "Beta1Pow": ONE,
@@ -156,45 +172,179 @@ SPECS: Dict[str, OpSpec] = {
         outputs={"ParamOut": ONE, "Moment1Out": ONE, "Moment2Out": ONE,
                  "Beta1PowOut": OPT, "Beta2PowOut": OPT},
         attr_types={"beta1": _NUM, "beta2": _NUM, "epsilon": _NUM,
-                    "coeff": _NUM, "weight_decay": _NUM}),
+                    "coeff": _NUM, "weight_decay": _NUM},
+        sharding="param_update"),
     # --- high-traffic core ops -------------------------------------------
-    "sum": OpSpec(inputs={"X": MANY}, outputs={"Out": ONE}),
-    "assign": OpSpec(inputs={"X": ONE}, outputs={"Out": ONE}),
+    "sum": OpSpec(inputs={"X": MANY}, outputs={"Out": ONE},
+                  sharding="elementwise"),
+    "assign": OpSpec(inputs={"X": ONE}, outputs={"Out": ONE},
+                     sharding="follow_x"),
     "cast": OpSpec(inputs={"X": ONE}, outputs={"Out": ONE},
-                   attr_types={"out_dtype": str, "in_dtype": str}),
+                   attr_types={"out_dtype": str, "in_dtype": str},
+                   sharding="follow_x"),
     "fill_constant": OpSpec(
         inputs={}, outputs={"Out": ONE},
-        attr_types={"shape": _LIST, "dtype": str, "value": _NUM}),
+        attr_types={"shape": _LIST, "dtype": str, "value": _NUM},
+        sharding="replicated"),
     "concat": OpSpec(inputs={"X": MANY}, outputs={"Out": ONE},
-                     attr_types={"axis": int}),
+                     attr_types={"axis": int}, sharding="concat"),
     "stack": OpSpec(inputs={"X": MANY}, outputs={"Y": ONE},
-                    attr_types={"axis": int}),
+                    attr_types={"axis": int}, sharding="stack"),
     "where": OpSpec(inputs={"Condition": ONE, "X": ONE, "Y": ONE},
-                    outputs={"Out": ONE}),
+                    outputs={"Out": ONE}, sharding="elementwise"),
     "scale": OpSpec(inputs={"X": ONE}, outputs={"Out": ONE},
                     attr_types={"scale": _NUM, "bias": _NUM,
-                                "bias_after_scale": bool}),
-    "mean": OpSpec(inputs={"X": ONE}, outputs={"Out": ONE}),
+                                "bias_after_scale": bool},
+                    sharding="follow_x"),
+    "mean": OpSpec(inputs={"X": ONE}, outputs={"Out": ONE},
+                   sharding="reduce_all"),
     "matmul": OpSpec(inputs={"X": ONE, "Y": ONE}, outputs={"Out": ONE},
                      attr_types={"transpose_X": bool, "transpose_Y": bool,
-                                 "alpha": _NUM}),
+                                 "alpha": _NUM},
+                     sharding="matmul"),
     "mul": OpSpec(inputs={"X": ONE, "Y": ONE}, outputs={"Out": ONE},
-                  attr_types={"x_num_col_dims": int, "y_num_col_dims": int}),
+                  attr_types={"x_num_col_dims": int, "y_num_col_dims": int},
+                  sharding="matmul"),
     "dropout": OpSpec(
         inputs={"X": ONE}, outputs={"Out": ONE, "Mask": OPT},
         attr_types={"dropout_prob": _NUM, "dropout_implementation": str,
-                    "seed": int, "fix_seed": bool}),
+                    "seed": int, "fix_seed": bool},
+        sharding="follow_x"),
     "softmax_with_cross_entropy": OpSpec(
         inputs={"Logits": ONE, "Label": ONE},
         outputs={"Softmax": OPT, "Loss": ONE},
-        attr_types={"soft_label": bool, "ignore_index": int, "axis": int}),
+        attr_types={"soft_label": bool, "ignore_index": int, "axis": int},
+        sharding="softmax_ce"),
+    # --- zoo coverage: every op the 11-program lint zoo emits ------------
+    # (closing the unknown-op gap so the sharding lint can run with
+    # coverage-as-errors; see analysis/sharding.py RULES for the rule
+    # semantics)
+    "square": OpSpec(inputs={"X": ONE}, outputs={"Out": ONE},
+                     sharding="follow_x"),
+    "relu": OpSpec(inputs={"X": ONE}, outputs={"Out": ONE},
+                   sharding="follow_x"),
+    "sigmoid": OpSpec(inputs={"X": ONE}, outputs={"Out": ONE},
+                      sharding="follow_x"),
+    "tanh": OpSpec(inputs={"X": ONE}, outputs={"Out": ONE},
+                   sharding="follow_x"),
+    "gelu": OpSpec(inputs={"X": ONE}, outputs={"Out": ONE},
+                   attr_types={"approximate": bool}, sharding="follow_x"),
+    "increment": OpSpec(inputs={"X": ONE}, outputs={"Out": ONE},
+                        attr_types={"step": _NUM}, sharding="follow_x"),
+    "fill_zeros_like": OpSpec(inputs={"X": ONE}, outputs={"Out": ONE},
+                              sharding="follow_x"),
+    "fill_any_like": OpSpec(inputs={"X": ONE}, outputs={"Out": ONE},
+                            attr_types={"value": _NUM, "dtype": str},
+                            sharding="follow_x"),
+    "equal": OpSpec(inputs={"X": ONE, "Y": ONE}, outputs={"Out": ONE},
+                    sharding="elementwise"),
+    "square_error_cost": OpSpec(
+        inputs={"X": ONE, "Y": ONE}, outputs={"Out": ONE},
+        sharding="elementwise"),
+    "sigmoid_cross_entropy_with_logits": OpSpec(
+        inputs={"X": ONE, "Label": ONE}, outputs={"Out": ONE},
+        attr_types={"ignore_index": int, "normalize": bool},
+        sharding="elementwise"),
+    "reshape2": OpSpec(
+        inputs={"X": ONE, "Shape": OPT, "ShapeTensor": ANY},
+        outputs={"Out": ONE, "XShape": OPT},
+        attr_types={"shape": _LIST}, sharding="reshape"),
+    "transpose2": OpSpec(
+        inputs={"X": ONE}, outputs={"Out": ONE, "XShape": OPT},
+        attr_types={"axis": _LIST}, sharding="transpose"),
+    "unsqueeze2": OpSpec(
+        inputs={"X": ONE}, outputs={"Out": ONE, "XShape": OPT},
+        attr_types={"axes": _LIST}, sharding="unsqueeze"),
+    "slice": OpSpec(
+        inputs={"Input": ONE}, outputs={"Out": ONE},
+        attr_types={"axes": _LIST, "starts": _LIST, "ends": _LIST,
+                    "decrease_axis": _LIST},
+        sharding="slice"),
+    "split": OpSpec(
+        inputs={"X": ONE}, outputs={"Out": MANY},
+        attr_types={"axis": int, "num": int, "sections": _LIST},
+        sharding="split"),
+    "gather": OpSpec(
+        inputs={"X": ONE, "Index": ONE}, outputs={"Out": ONE},
+        attr_types={"axis": int}, sharding="gather"),
+    "layer_norm": OpSpec(
+        inputs={"X": ONE, "Scale": OPT, "Bias": OPT},
+        outputs={"Y": ONE, "Mean": OPT, "Variance": OPT},
+        attr_types={"epsilon": _NUM, "begin_norm_axis": int},
+        sharding="layer_norm"),
+    "lookup_table": OpSpec(
+        inputs={"W": ONE, "Ids": ONE}, outputs={"Out": ONE},
+        attr_types={"padding_idx": int, "is_sparse": bool},
+        sharding="lookup"),
+    "lookup_table_v2": OpSpec(
+        inputs={"W": ONE, "Ids": ONE}, outputs={"Out": ONE},
+        attr_types={"padding_idx": int, "is_sparse": bool},
+        sharding="lookup"),
+    "lookup_table_sparse_grad": OpSpec(
+        inputs={"W": ONE, "Ids": ONE, "OG:Out": ONE},
+        outputs={"IG:W": ONE},
+        attr_types={"padding_idx": int}, sharding="selected_rows"),
+    "fused_attention": OpSpec(
+        inputs={"Q": ONE, "K": ONE, "V": ONE, "Mask": OPT},
+        outputs={"Out": ONE},
+        attr_types={"scale": _NUM, "dropout": _NUM, "causal": bool,
+                    "sequence_parallel": bool, "sp_mode": str},
+        sharding="attention"),
+    "switch_moe": OpSpec(
+        inputs={"X": ONE, "GateW": ONE, "ExpertW1": ONE, "ExpertB1": OPT,
+                "ExpertW2": ONE, "ExpertB2": OPT},
+        outputs={"Out": ONE, "AuxLoss": OPT, "GateIdx": OPT},
+        attr_types={"capacity_factor": _NUM, "top_k": int},
+        sharding="moe", cross_batch=True),
+    "auc": OpSpec(
+        inputs={"Predict": ONE, "Label": ONE, "StatPos": ONE,
+                "StatNeg": ONE},
+        outputs={"AUC": ONE, "StatPosOut": ONE, "StatNegOut": ONE},
+        attr_types={"num_thresholds": int},
+        sharding="auc", cross_batch=True),
+    "batch_norm": OpSpec(
+        inputs={"X": ONE, "Scale": OPT, "Bias": OPT, "Mean": OPT,
+                "Variance": OPT},
+        outputs={"Y": ONE, "MeanOut": OPT, "VarianceOut": OPT,
+                 "SavedMean": OPT, "SavedVariance": OPT},
+        attr_types={"epsilon": _NUM, "momentum": _NUM, "is_test": bool},
+        sharding="follow_x", cross_batch=True),
 }
 
 for _name in ("elementwise_add", "elementwise_sub", "elementwise_mul",
               "elementwise_div", "elementwise_min", "elementwise_max",
               "elementwise_pow", "elementwise_mod"):
     SPECS[_name] = OpSpec(inputs={"X": ONE, "Y": ONE}, outputs={"Out": ONE},
-                          attr_types={"axis": int})
+                          attr_types={"axis": int}, sharding="elementwise")
+
+# Cross-batch ops WITHOUT a full slot spec yet (the remaining sync-BN
+# family): the fallback matrix must still know them. Grow a full OpSpec
+# (and drop the name here) when the lint zoo first emits one.
+_EXTRA_CROSS_BATCH: FrozenSet[str] = frozenset({"data_norm", "inplace_abn"})
+
+
+def cross_batch_ops() -> FrozenSet[str]:
+    """THE cross-batch op table (single source): op types whose semantics
+    couple examples across the global batch, so a manual-dp shard would
+    silently compute per-shard statistics. Consumed by parallel/zero.py
+    (runtime decline, counted under `zero_manual_fallbacks.<cause>`) and
+    by analysis/sharding.py (the build-time lint naming the op)."""
+    return frozenset(n for n, s in SPECS.items() if s.cross_batch) \
+        | _EXTRA_CROSS_BATCH
+
+
+# the normalization/batch-stats family keeps its historical dedicated
+# fallback counter; every other cross-batch op counts under the generic
+# cause. ONE mapping — the runtime counter (zero.count_fallback) and the
+# lint's predicted counter name come from here and cannot drift.
+_BATCH_STATS_OPS = frozenset({"batch_norm", "data_norm", "inplace_abn"})
+
+
+def cross_batch_cause(op_type: str) -> str:
+    """The `zero_manual_fallbacks.<cause>` suffix a cross-batch op counts
+    under at run time ("batch_norm" for the sync-BN family,
+    "cross_batch" otherwise)."""
+    return "batch_norm" if op_type in _BATCH_STATS_OPS else "cross_batch"
 
 
 def install() -> None:

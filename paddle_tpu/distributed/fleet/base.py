@@ -523,15 +523,10 @@ class DistributedOptimizer:
             # bucket (lamb/lars rules): keep the pre-pass GSPMD fallback —
             # per-param accumulator vars shard over dp by name pattern, so
             # `sharding=True` still buys the optimizer-state HBM saving
-            # instead of silently no-opping
-            import re
-            from jax.sharding import PartitionSpec as P
-            zero1 = (re.compile(r"_(moment\d?|velocity|mean_square|mean_grad"
-                                r"|momentum)_\d+$"), P("dp"))
-            merged = ShardingRules()
-            merged._rules = list(rules._rules) + [zero1]
-            merged._default = rules._default
-            rules = merged
+            # instead of silently no-opping (pattern table:
+            # parallel/spmd.py ZERO1_FALLBACK_STATE_RULES)
+            from ...parallel.spmd import zero1_fallback_rules
+            rules = zero1_fallback_rules(rules)
         attach(program, DistConfig(
             mesh=self._fleet._mesh, param_rules=rules,
             state_specs=dict(getattr(program, "_zero_state_specs", None)

@@ -63,10 +63,15 @@ class OpDef:
 _REGISTRY: Dict[str, OpDef] = {}
 
 # Optional per-op slot/attr metadata consumed by the program verifier
-# (paddle_tpu/analysis/verifier.py). Kept as an opaque side table so op
-# modules never pay an import or a construction cost for it; populated by
-# paddle_tpu/analysis/op_specs.py (the reference's OpProto/OpMaker
-# declarations, reduced to what static checking needs).
+# (paddle_tpu/analysis/verifier.py) and the static sharding/cost analysis
+# (analysis/sharding.py, analysis/cost.py). Kept as an opaque side table
+# so op modules never pay an import or a construction cost for it;
+# populated by paddle_tpu/analysis/op_specs.py (the reference's
+# OpProto/OpMaker declarations + auto_parallel SPMD completion rules,
+# reduced to what static checking needs). Each spec may carry a
+# `sharding` rule name (how var specs propagate through the op) and a
+# `cross_batch` flag (the op couples examples across the global batch —
+# the manual-dp decline table).
 _SPECS: Dict[str, object] = {}
 
 
@@ -77,6 +82,12 @@ def set_spec(name: str, spec) -> None:
 
 def get_spec(name: str):
     return _SPECS.get(name)
+
+
+def get_sharding_rule(name: str) -> Optional[str]:
+    """The op's declared spec-propagation rule name (None = uncovered)."""
+    spec = _SPECS.get(name)
+    return getattr(spec, "sharding", None)
 
 
 def register(name: str, *, infer=None, is_random=False, nondiff_slots=(),

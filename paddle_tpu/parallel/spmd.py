@@ -20,6 +20,24 @@ from .mesh import ShardingRules, REPLICATED, default_mesh
 
 P = PartitionSpec
 
+# Per-param optimizer-accumulator name pattern -> dp spec: the GSPMD
+# fallback when a sharding request could not take the flat-bucket path
+# (pipeline / gradient-merge / PS programs). ONE table consumed by
+# fleet.distributed_optimizer (the attach site) and readable by the
+# analysis layer — previously an inline regex in fleet/base.py.
+ZERO1_FALLBACK_STATE_RULES = (
+    (r"_(moment\d?|velocity|mean_square|mean_grad|momentum)_\d+$",
+     P("dp")),
+)
+
+
+def zero1_fallback_rules(base: ShardingRules) -> ShardingRules:
+    """`base` TP rules + the per-param accumulator dp rows above."""
+    merged = ShardingRules(ZERO1_FALLBACK_STATE_RULES)
+    merged._rules = list(base._rules) + list(merged._rules)
+    merged._default = base._default
+    return merged
+
 
 @dataclass
 class DistConfig:

@@ -110,8 +110,18 @@ _UPDATE_EXTRA_SLOTS = {
 # balancing loss averages routing stats over it, so a per-shard run drops
 # different tokens and reports different aux than the global batch
 # (tests/test_moe.py ep-sharded parity pins this).
-_CROSS_BATCH_OPS = frozenset({"batch_norm", "data_norm", "inplace_abn",
-                              "switch_moe"})
+#
+# THE table lives on the op specs (analysis/op_specs.py `cross_batch`
+# flag, read here via `cross_batch_ops()`): the static sharding lint
+# (analysis/sharding.py) and this runtime decline consume the same rows,
+# so a build-time "manual_dp_fallback" warning and the runtime
+# `zero_manual_fallbacks.<cause>` counter can never drift apart. Loaded
+# lazily — analysis imports parallel.zero for the update-rule table.
+
+
+def _cross_batch_ops() -> frozenset:
+    from ..analysis.op_specs import cross_batch_ops
+    return cross_batch_ops()
 
 
 def count_fallback(cause: str) -> None:
@@ -1231,13 +1241,14 @@ def plan_manual_dp(program, dist, mesh, block, fn, feed_meta, state_meta,
     if getattr(program, "_microbatch_k", 0) and program._microbatch_k > 1:
         count_fallback("pipeline")
         return None
+    cross_batch = _cross_batch_ops()
     for op_type in _iter_op_types(program):
         # sub_ops descs included: recompute/layer_scan fuse forward ops
         # into __segment__/__layer_scan__ bodies, and a cross-batch op
         # hidden there shards just as wrongly as a top-level one
-        if op_type in _CROSS_BATCH_OPS:
-            count_fallback("batch_norm" if op_type != "switch_moe"
-                           else "cross_batch")
+        if op_type in cross_batch:
+            from ..analysis.op_specs import cross_batch_cause
+            count_fallback(cross_batch_cause(op_type))
             return None
     for b in program.blocks:
         for v in b.vars.values():

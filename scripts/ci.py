@@ -37,6 +37,7 @@ WEIGHTS = {
     "test_detection_assign_ops.py": 40, "test_elastic.py": 55,
     "test_launch.py": 10,
     "test_strategies.py": 35, "test_collective_budget.py": 90,
+    "test_cost_parity.py": 45,
     "test_lod_ops.py": 30, "test_heter_ps.py": 30,
     "test_federated.py": 25, "test_tail_ops.py": 35, "test_dy2static.py": 25,
     "test_jit_inference.py": 30, "test_executor_basic.py": 30,
@@ -302,6 +303,40 @@ def start_program_lint(env):
                             stderr=subprocess.PIPE, text=True)
 
 
+# Sharding lint (ISSUE-13 CI satellite): the static sharding/plan sweep —
+# program_lint.py --sharding runs spec propagation + plan checking over
+# the zoo at the representative mesh points (dp=2; dp=2,tp=2) and gates
+# rule coverage (--assert-coverage: every zoo op must carry an OpSpec
+# sharding rule). Build-only like the base lint; overlapped with the
+# shards (--no-sharding-lint to skip).
+def start_sharding_lint(env):
+    script = os.path.join(ROOT, "scripts", "program_lint.py")
+    child_env = dict(env)
+    child_env["PADDLE_TPU_AUDIT_CHILD"] = "1"  # env already is the CPU mesh
+    return subprocess.Popen(
+        [sys.executable, script, "--sharding", "--assert",
+         "--assert-coverage"],
+        cwd=ROOT, env=child_env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+
+
+def collect_sharding_lint(proc, timeout=900) -> bool:
+    try:
+        out_s, err_s = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        print(f"[sharding-lint] FAIL timed out after {timeout}s")
+        return False
+    lines = (out_s or "").strip().splitlines()
+    status = "OK " if proc.returncode == 0 else "FAIL"
+    body = "\n".join("    " + ln for ln in lines[-14:])
+    tail = (err_s or "").strip().splitlines()[-120:]
+    print(f"[sharding-lint] {status}\n{body}" + (
+        "\n" + "\n".join(tail) if proc.returncode != 0 else ""))
+    return proc.returncode == 0
+
+
 def collect_program_lint(proc, timeout=900) -> bool:
     try:
         out_s, err_s = proc.communicate(timeout=timeout)
@@ -384,6 +419,10 @@ def main():
     ap.add_argument("--no-program-lint", action="store_true",
                     help="skip the static program-lint sweep "
                          "(scripts/program_lint.py --assert)")
+    ap.add_argument("--no-sharding-lint", action="store_true",
+                    help="skip the static sharding/plan lint sweep "
+                         "(scripts/program_lint.py --sharding --assert "
+                         "--assert-coverage)")
     ap.add_argument("--no-pod-trace", action="store_true",
                     help="skip the pod-trace smoke (2-process supervised "
                          "gang -> merged timeline + straggler report, "
@@ -412,6 +451,9 @@ def main():
     lint_proc = None
     if not args.no_program_lint:
         lint_proc = start_program_lint(env)        # overlaps the shards too
+    shard_lint_proc = None
+    if not args.no_sharding_lint:
+        shard_lint_proc = start_sharding_lint(env)  # overlaps the shards
     pod_proc = None
     if not args.no_pod_trace:
         pod_proc = start_pod_trace_smoke(env)      # overlaps the shards too
@@ -466,6 +508,8 @@ def main():
         failed = failed or not collect_trace_smoke(smoke_proc)
     if lint_proc is not None:
         failed = failed or not collect_program_lint(lint_proc)
+    if shard_lint_proc is not None:
+        failed = failed or not collect_sharding_lint(shard_lint_proc)
     if pod_proc is not None:
         failed = failed or not collect_pod_trace_smoke(pod_proc)
     print(f"CI total: {time.time() - t0:.0f}s over {len(shards)} shards -> "

@@ -24,15 +24,20 @@ What to expect (and what round-5 runs showed — docs/perf_notes.md
   parallelism rides ICI neighbor links, not global collectives.
 
 The `--assert` mode turns the census into a machine-checkable budget
-(BUDGETS below: per-mesh kind -> max count, max MB — CLOSED lists, an
-unbudgeted collective kind appearing is a failure too) and exits
-non-zero on any regression; scripts/ci.py runs it next to the
-host-stall check, so an ungrouping regression (back to one all-reduce
-per parameter) can never land silently.
+(per-mesh kind -> max count, max MB — CLOSED lists, an unbudgeted
+collective kind appearing is a failure too) and exits non-zero on any
+regression; scripts/ci.py runs it next to the host-stall check, so an
+ungrouping regression (back to one all-reduce per parameter) can never
+land silently. The dp / ZeRO rows DERIVE their expected counts from the
+compile-free predictor (`analysis.predict_cost` — see STATIC_BUDGETS
+comment), so the static cost model and the runtime census are pinned to
+each other and parameterize by world size automatically; the GSPMD
+tp/sp rows keep measured static budgets. `--predict` prints the
+predicted sequence next to each measured row.
 
 Usage: run under a virtual mesh (or a real one):
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-      python scripts/collective_audit.py [--assert]
+      python scripts/collective_audit.py [--assert] [--predict]
 """
 from __future__ import annotations
 
@@ -48,10 +53,12 @@ DT_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "f64": 8,
             "pred": 1, "s8": 1, "u8": 1, "s64": 8, "u64": 8}
 
 
-def compiled_text(axes, batch, sp_flag=False, sharding=False, stage=None,
-                  bucket_mb=None):
-    """Build + attach + compile the tiny-BERT train step; return HLO
-    (via the public Executor.compiled_hlo — no executor internals)."""
+def build_step(axes, batch, sp_flag=False, sharding=False, stage=None,
+               bucket_mb=None):
+    """Build + attach the tiny-BERT train step for one audit row; returns
+    {exe, feed, loss, program, plan} — `plan` is the analysis PlanPoint
+    mirroring the mesh the step will actually compile on, so the static
+    predictor and the HLO census look at the same point."""
     import numpy as np
     import jax
     import paddle_tpu as paddle
@@ -60,6 +67,7 @@ def compiled_text(axes, batch, sp_flag=False, sharding=False, stage=None,
     from paddle_tpu.distributed import fleet
     from paddle_tpu.parallel import build_mesh, DistConfig, attach
     from paddle_tpu.testing import reset_programs
+    from paddle_tpu import analysis
 
     reset_programs(seed=0)
     cfg = bert.BertConfig(vocab_size=512, hidden_size=64, num_layers=2,
@@ -93,7 +101,29 @@ def compiled_text(axes, batch, sp_flag=False, sharding=False, stage=None,
     exe.run(fluid.default_startup_program())
     feed = {"input_ids": np.zeros((batch, 32), np.int64),
             "mlm_labels": np.zeros((batch, 32, 1), np.int64)}
-    return exe.compiled_hlo(feed, [loss])
+    # the plan mirrors the ATTACHED mesh (the "dp=1" row really compiles
+    # on fleet.init's full default mesh), so world-size parameterization
+    # is automatic: the same derivation covers dp=2..N
+    dist = getattr(prog, "_dist_config", None)
+    mesh_axes = {}
+    if dist is not None:
+        for a, n in dist.resolve_mesh().shape.items():
+            if int(n) > 1:
+                mesh_axes[a] = int(n)
+    plan = analysis.PlanPoint(mesh_axes=mesh_axes,
+                              param_rules=bert.tp_sharding_rules(),
+                              batch=batch)
+    return {"exe": exe, "feed": feed, "loss": loss, "program": prog,
+            "plan": plan}
+
+
+def compiled_text(axes, batch, sp_flag=False, sharding=False, stage=None,
+                  bucket_mb=None):
+    """Compile one audit row; return optimized HLO (via the public
+    Executor.compiled_hlo — no executor internals)."""
+    row = build_step(axes, batch, sp_flag=sp_flag, sharding=sharding,
+                     stage=stage, bucket_mb=bucket_mb)
+    return row["exe"].compiled_hlo(row["feed"], [row["loss"]])
 
 
 def audit(txt):
@@ -149,35 +179,25 @@ def collective_segments(txt) -> int:
     return segments
 
 
-# --assert budgets: per-row kind -> (max count, max MB). CLOSED lists — a
-# kind not in a row's budget must not appear at all. Numbers are the
-# measured post-bucketing census (parallel/zero.py; docs/perf_notes.md
-# "Bucketed collectives & ZeRO-1") with headroom for XLA scheduling noise,
-# never enough to readmit the 31-ungrouped-AR state the bucketing pass
-# removed (count budget 4 << 31). The "dp=1" row compiles on fleet.init's
-# full default mesh (dp=8), so it carries the same budget as the dp rows.
-BUDGETS = {
-    "dp=1":        {"all-reduce": (4, 0.60)},
-    "dp=2":        {"all-reduce": (4, 0.60)},
-    "dp=4":        {"all-reduce": (4, 0.60)},
-    "dp=8":        {"all-reduce": (4, 0.60)},
-    # ZeRO-1: per-bucket reduce_scatter (half the AR bytes at dp=2) +
-    # parameter all_gather replace the gradient all-reduce entirely
-    "dp=2 zero1":  {"reduce-scatter": (2, 0.35), "all-gather": (2, 0.60),
-                    "all-reduce": (2, 0.10)},
-    # ZeRO-2 with a small bucket cap: K>1 buckets, each K x RS (grad
-    # shards stay RESIDENT — zero gradient all-gathers, so AG bytes are
-    # bounded by the PARAMETER volume alone) + K x param-AG + the scalar
-    # loss pmean. __min_segments__ is the overlap proof: the bucket
-    # collectives interleave with backward compute (collective_segments),
-    # never one post-backward wall.
-    "dp=2 zero2":  {"reduce-scatter": (14, 0.35), "all-gather": (14, 0.60),
-                    "all-reduce": (2, 0.10), "__min_segments__": 4},
-    # ZeRO-3: K x on-demand param-AG in FORWARD (gather-use-discard), K x
-    # RS in backward, NO post-update param all-gather; AG bytes still
-    # bounded by one parameter volume
-    "dp=2 zero3":  {"reduce-scatter": (14, 0.35), "all-gather": (14, 0.60),
-                    "all-reduce": (2, 0.10), "__min_segments__": 4},
+# --assert budgets. Two sources:
+#
+# 1. DERIVED (the dp / ZeRO rows): `analysis.predict_cost` predicts the
+#    manual-dp collective sequence EXACTLY from bucket metadata — the
+#    expected-count side of each budget row comes from that prediction
+#    (count = predicted count, bytes ceiling = predicted * 1.01), so the
+#    static model and the runtime census can never silently drift: a
+#    bucketing regression trips the count, a predictor regression trips
+#    the same row from the other side. Because the prediction takes the
+#    attached mesh as input, these rows are parameterized by world size
+#    for free — dp=2..N all derive their own budget (ROADMAP item 5).
+# 2. STATIC (tp / sp / mixed rows, below): GSPMD owns collective
+#    placement there, the analysis is an estimate (exact=False), so the
+#    budgets stay the measured round-6..8 census with headroom.
+#
+# CLOSED lists either way — an unbudgeted collective kind appearing is a
+# failure too. The overlap floors (__min_segments__) are structural
+# requirements on SCHEDULING, not on the collective set, and stay static.
+STATIC_BUDGETS = {
     # mixed/tp/sp meshes stay on the GSPMD lowering (measured round 6-8)
     "tp=2":        {"all-reduce": (40, 1.0), "all-gather": (55, 2.2),
                     "collective-permute": (16, 0.6)},
@@ -188,10 +208,32 @@ BUDGETS = {
                     "collective-permute": (45, 0.8)},
 }
 
+# ZeRO-2/3 overlap proof: the bucket collectives must interleave with
+# backward compute (collective_segments), never one post-backward wall
+MIN_SEGMENTS = {"dp=2 zero2": 4, "dp=2 zero3": 4}
 
-def check_budget(label, counts, byts, txt=None):
+def derive_budget(program, plan, loss_name, label):
+    """(budget-or-None, CostReport): the predict_cost-derived budget row
+    when the point is exactly predictable; GSPMD rows return None and
+    keep their static budgets. The report rides along so --predict does
+    not re-run the prediction."""
+    from paddle_tpu import analysis
+    report = analysis.predict_cost(program, plan, fetch_names=[loss_name],
+                                   with_findings=False)
+    if not report.exact:
+        return None, report
+    budget = {}
+    for kind, (n, b) in report.totals().items():
+        budget[kind] = (n, b * 1.01 / 1e6)
+    if label in MIN_SEGMENTS:
+        budget["__min_segments__"] = MIN_SEGMENTS[label]
+    return budget, report
+
+
+def check_budget(label, counts, byts, txt=None, budget=None):
     """List of violation strings (empty = within budget)."""
-    budget = BUDGETS.get(label)
+    if budget is None:
+        budget = STATIC_BUDGETS.get(label)
     if budget is None:
         return []
     bad = []
@@ -243,6 +285,7 @@ def stall_mode(argv) -> int:
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     assert_mode = "--assert" in argv
+    predict_mode = "--predict" in argv
     if "--stall" in argv:
         return stall_mode(argv)
     # --skip-zero-rows (or PADDLE_TPU_AUDIT_SKIP_ZERO=1): drop the ZeRO
@@ -291,14 +334,18 @@ def main(argv=None):
         if kw.get("stage"):
             desc += f" zero{kw['stage']}"
         try:
-            txt = compiled_text(
+            row = build_step(
                 axes, batch, sp_flag=kw.get("sp_flag", False),
                 sharding=kw.get("sharding", False),
                 stage=kw.get("stage"), bucket_mb=kw.get("bucket_mb"))
+            derived, rep = derive_budget(row["program"], row["plan"],
+                                         row["loss"].name, desc)
+            txt = row["exe"].compiled_hlo(row["feed"], [row["loss"]])
             counts, byts = audit(txt)
         except Exception as e:   # one broken config must not kill the audit
             print(f"{desc:12s} batch {batch:3d}: FAILED ({e!r:.120})")
-            if assert_mode and desc in BUDGETS:
+            if assert_mode and (desc in STATIC_BUDGETS
+                                or "tp" not in axes and "sp" not in axes):
                 failures += 1
             continue
         summary = ", ".join(
@@ -307,13 +354,30 @@ def main(argv=None):
         if kw.get("stage"):
             summary += f", {collective_segments(txt)} interleaved segments"
         verdict = ""
+        if predict_mode:
+            pt = rep.totals()
+            verdict = "  predicted[" + ("exact" if rep.exact else "est") \
+                + "]: " + (", ".join(
+                    f"{k} x{n} ({b / 1e6:.2f} MB)"
+                    for k, (n, b) in sorted(pt.items())) or "none")
         if assert_mode:
-            bad = check_budget(desc, counts, byts, txt)
+            bad = check_budget(desc, counts, byts, txt, budget=derived)
+            if derived is None and desc not in STATIC_BUDGETS:
+                # a dp/ZeRO row that derives no budget means the predictor
+                # lost exactness on a manual-dp point (bucketing pass or
+                # plan_mode regression) — the row would otherwise pass
+                # VACUOUSLY with zero checks, the exact failure mode the
+                # budget exists to catch
+                bad.append(f"no derived budget (prediction mode="
+                           f"{rep.mode}, exact={rep.exact}) — dp/ZeRO "
+                           "rows must be exactly predictable")
             if bad:
                 failures += 1
-                verdict = "  BUDGET FAIL: " + "; ".join(bad)
-            elif desc in BUDGETS:
-                verdict = "  budget OK"
+                verdict += "  BUDGET FAIL: " + "; ".join(bad)
+            elif derived is not None:
+                verdict += "  budget OK (predict-derived)"
+            elif desc in STATIC_BUDGETS:
+                verdict += "  budget OK"
         print(f"{desc:12s} batch {batch:3d}: {summary}{verdict}")
     if assert_mode:
         print(f"collective budget: {'FAILED' if failures else 'PASSED'} "

@@ -17,6 +17,14 @@ Build-only: the zoo never runs an Executor, so the whole sweep is seconds
 of tracing, no XLA compiles. Wired into scripts/ci.py as an overlapped
 subprocess (--no-program-lint to skip).
 
+With a mesh point the lint adds the STATIC SHARDING layer
+(paddle_tpu/analysis/sharding.py): spec propagation + plan checking —
+illegal compositions (stage3+tp), the manual-dp fallback matrix promoted
+to build-time warnings naming the op and the runtime counter it predicts,
+implicit-reshard/spec-conflict findings, and (--predict) the compile-free
+collective/memory cost table (analysis/cost.py). Still build-only: the
+whole sweep performs ZERO XLA compiles.
+
 Usage (any machine; re-execs into a sanitized CPU child on axon hosts,
 the collective_audit recipe):
 
@@ -24,6 +32,12 @@ the collective_audit recipe):
   python scripts/program_lint.py --assert       # exit 1 on any error
   python scripts/program_lint.py --json         # typed JSON report
   python scripts/program_lint.py --only zero    # substring filter
+  python scripts/program_lint.py --mesh dp=2,tp=2   # + sharding lint
+  python scripts/program_lint.py --sharding     # representative mesh sweep
+  python scripts/program_lint.py --mesh dp=2 --predict  # + cost table
+  python scripts/program_lint.py --stage 3      # extra bert arm @ stage 3
+  python scripts/program_lint.py --sharding --assert-coverage
+                                 # fail on sharding-rule coverage debt
 """
 from __future__ import annotations
 
@@ -192,7 +206,7 @@ ZOO = [
 ]
 
 
-def lint_one(name, build) -> dict:
+def lint_one(name, build, mesh_points=(), predict=False) -> dict:
     from paddle_tpu.analysis import (analyze_donation, check_collectives,
                                      collective_sequence, verify_program)
     t0 = time.time()
@@ -204,16 +218,46 @@ def lint_one(name, build) -> dict:
     report = analyze_donation(main, feed_names=feed_names,
                               fetch_names=fetch_names)
     findings += report.findings
+    # Plan-point diagnostics stay SEPARATE from program findings: an
+    # `illegal_plan` error against the dp=2,tp=2 point is the analysis
+    # CORRECTLY rejecting a plan (e.g. stage3+tp), not a defect in the
+    # program — --assert gates on program errors; plan errors are the
+    # planner's pruning signal and are reported per mesh point.
+    sharding_rows = []
+    for axes in mesh_points:
+        from paddle_tpu.analysis import PlanPoint, predict_cost
+        plan = PlanPoint(mesh_axes=dict(axes), batch=8 * plan_dp(axes))
+        rep = predict_cost(main, plan, fetch_names=fetch_names)
+        srow = {"mesh": dict(axes), "mode": rep.mode,
+                "errors": sum(f.severity == "error" for f in rep.findings),
+                "warnings": sum(f.severity == "warning"
+                                for f in rep.findings),
+                "findings": [_tag(f, plan.describe()).to_dict()
+                             for f in rep.findings]}
+        if predict:
+            srow["predicted"] = rep.to_dict()
+        sharding_rows.append(srow)
     return {
         "program": name,
         "build_s": round(time.time() - t0, 2),
         "ops": sum(len(b.ops) for b in main.blocks),
         "collectives": len(collective_sequence(main)),
         "donated": len(report.donated),
+        "sharding": sharding_rows,
         "errors": sum(f.severity == "error" for f in findings),
         "warnings": sum(f.severity == "warning" for f in findings),
         "findings": [f.to_dict() for f in findings],
     }
+
+
+def plan_dp(axes) -> int:
+    return max(int(axes.get("dp", 1)), 1)
+
+
+# findings that are COVERAGE DEBT (an op the analysis tables don't know),
+# not model findings: --assert-coverage promotes exactly these to fatal so
+# the zoo can gate "every op has a spec + sharding rule" in CI
+COVERAGE_CHECKS = ("unknown_sharding_rule", "unregistered_op")
 
 
 def _tag(finding, where):
@@ -230,7 +274,30 @@ def main():
                     help="print the typed JSON findings report")
     ap.add_argument("--only", default="",
                     help="substring filter on zoo program names")
+    ap.add_argument("--mesh", action="append", default=[],
+                    help="mesh point for the sharding lint, e.g. "
+                         "dp=2,tp=2 (repeatable)")
+    ap.add_argument("--sharding", action="store_true",
+                    help="sharding lint at the representative mesh sweep "
+                         "(dp=2; dp=2,tp=2) — what CI runs")
+    ap.add_argument("--stage", type=int, default=None,
+                    help="add a bert arm built at this ZeRO stage")
+    ap.add_argument("--predict", action="store_true",
+                    help="include the compile-free predict_cost table "
+                         "per mesh point (implies --sharding when no "
+                         "--mesh given)")
+    ap.add_argument("--assert-coverage", dest="assert_coverage",
+                    action="store_true",
+                    help="exit 1 on sharding-rule/spec coverage debt "
+                         "(unknown_sharding_rule / unregistered_op "
+                         "warnings) — keeps the op tables closed over "
+                         "the zoo")
     args = ap.parse_args()
+
+    from paddle_tpu.analysis.sharding import parse_mesh
+    mesh_points = [parse_mesh(m) for m in args.mesh]
+    if (args.sharding or args.predict) and not mesh_points:
+        mesh_points = [{"dp": 2}, {"dp": 2, "tp": 2}]
 
     # axon hosts pin the TPU backend at interpreter start: re-exec once
     # into a sanitized CPU child (the collective_audit/copy_audit recipe)
@@ -245,37 +312,86 @@ def main():
                 cwd=ROOT, env=env, timeout=3600)
             sys.exit(proc.returncode)
 
+    zoo = list(ZOO)
+    if args.stage is not None:
+        zoo.append((f"bert_tiny_stage{args.stage}",
+                    _bert_builder(layer_scan=args.stage >= 3,
+                                  zero_stage=args.stage)))
+
     rows = []
-    for name, build in ZOO:
+    for name, build in zoo:
         if args.only and args.only not in name:
             continue
         try:
-            rows.append(lint_one(name, build))
+            rows.append(lint_one(name, build, mesh_points=mesh_points,
+                                 predict=args.predict))
         except Exception as e:   # a broken build is itself a finding
             rows.append({"program": name, "build_s": 0.0, "ops": 0,
-                         "collectives": 0, "donated": 0, "errors": 1,
-                         "warnings": 0,
+                         "collectives": 0, "donated": 0, "sharding": [],
+                         "errors": 1, "warnings": 0,
                          "findings": [{"check": "build_failed",
                                        "severity": "error",
                                        "message": repr(e)[:300]}]})
 
     n_err = sum(r["errors"] for r in rows)
     n_warn = sum(r["warnings"] for r in rows)
+    n_cov = sum(f["check"] in COVERAGE_CHECKS
+                for r in rows
+                for f in (r["findings"]
+                          + [f for s in r.get("sharding", ())
+                             for f in s["findings"]]))
     if args.json:
         print(json.dumps({"programs": rows, "errors": n_err,
-                          "warnings": n_warn}, indent=1))
+                          "warnings": n_warn, "coverage_debt": n_cov},
+                         indent=1))
     else:
         for r in rows:
             print(f"{r['program']:24s} ops {r['ops']:4d} "
                   f"collectives {r['collectives']:2d} "
                   f"donated {r['donated']:3d} errors {r['errors']:2d} "
                   f"warnings {r['warnings']:3d} ({r['build_s']:.1f}s)")
+            for s in r.get("sharding", ()):
+                mesh = ",".join(f"{k}={v}" for k, v in s["mesh"].items())
+                line = (f"    sharding @{mesh}: mode={s['mode']} "
+                        f"plan-errors={s['errors']} "
+                        f"plan-warnings={s['warnings']}")
+                pred = s.get("predicted")
+                if pred:
+                    tot = ", ".join(
+                        f"{k} x{v['count']} ({v['bytes'] / 1e6:.2f} MB)"
+                        for k, v in sorted(pred["totals"].items())) \
+                        or "none"
+                    tag = "exact" if pred["exact"] else "est"
+                    arg_mb = (pred["memory"]["argument_bytes_per_device"]
+                              / 1e6)
+                    line += (f"\n      predicted[{tag}]: {tot}; "
+                             f"arg {arg_mb:.2f} MB/dev")
+                print(line)
+                for f in s["findings"]:
+                    if f["severity"] == "error" or not args.assert_:
+                        print(f"      [{f['severity']}] {f['check']}: "
+                              f"{f['message'][:150]}")
             for f in r["findings"]:
                 if f["severity"] == "error" or not args.assert_:
                     print(f"    [{f['severity']}] {f['check']}: "
                           f"{f['message'][:160]}")
         print(f"program lint: {len(rows)} programs, {n_err} errors, "
-              f"{n_warn} warnings")
+              f"{n_warn} warnings, {n_cov} coverage-debt")
+    if args.assert_coverage and n_cov:
+        # name every offending op on stderr: coverage findings are
+        # warnings, which the --assert stdout path suppresses — the CI
+        # log must still say exactly which op needs an OpSpec entry
+        print(f"sharding-rule coverage debt: {n_cov} finding(s) "
+              "(add OpSpec entries in analysis/op_specs.py):",
+              file=sys.stderr)
+        for r in rows:
+            for f in (r["findings"]
+                      + [f for s in r.get("sharding", ())
+                         for f in s["findings"]]):
+                if f["check"] in COVERAGE_CHECKS:
+                    print(f"  {r['program']}: [{f['check']}] "
+                          f"{f['message'][:160]}", file=sys.stderr)
+        return 1
     if args.assert_ and n_err:
         # the typed report is the postmortem artifact — always ship it on
         # a failing assert, like the CI budget checks do. Only the FAILING

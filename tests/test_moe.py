@@ -141,8 +141,9 @@ def test_manual_dp_declines_moe_cross_batch():
     standing ep-parity failure above (the ep=1 arm resolved to a dp-pure
     mesh and took the manual path). Build-only regression guard; the
     numeric contract is test_ep_sharded_matches_unsharded."""
-    from paddle_tpu.parallel.zero import _CROSS_BATCH_OPS, _iter_op_types
-    assert "switch_moe" in _CROSS_BATCH_OPS
+    from paddle_tpu.parallel.zero import _cross_batch_ops, _iter_op_types
+    cross_batch = _cross_batch_ops()   # one table: analysis/op_specs.py
+    assert "switch_moe" in cross_batch
 
     # the detection must see through fused sub-graph bodies too: after
     # recompute the switch_moe op lives inside a __segment__'s sub_ops
@@ -160,7 +161,7 @@ def test_manual_dp_declines_moe_cross_batch():
     gb = prog.global_block()
     assert not any(op.type == "switch_moe" for op in gb.ops), \
         "recompute should have fused switch_moe into a __segment__"
-    assert any(t in _CROSS_BATCH_OPS for t in _iter_op_types(prog))
+    assert any(t in cross_batch for t in _iter_op_types(prog))
 
 
 def test_top2_matches_dense_reference():

@@ -1,0 +1,301 @@
+"""Static sharding & cost analysis (ISSUE 13): build-only unit coverage.
+
+Contract under test: spec propagation infers the right per-var specs from
+the one OpSpec rule table; the plan checker rejects illegal compositions
+(stage3+tp) and promotes every structural manual-dp fallback cause to a
+build-time Finding naming the op/var AND the runtime counter it predicts;
+`plan_mode` mirrors the executor's manual-vs-GSPMD decision; and
+`predict_cost` derives the exact manual-dp collective sequence from
+bucket metadata — all WITHOUT creating an Executor or compiling anything
+(the census parity itself is tests/test_cost_parity.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu import analysis
+from paddle_tpu.analysis import PlanPoint, check_plan, plan_mode, \
+    predict_cost, propagate_sharding
+from paddle_tpu.analysis.sharding import FALLBACK_COUNTERS, parse_mesh
+from paddle_tpu.fluid import layers
+from paddle_tpu.testing import reset_programs
+
+
+def _build_bucketed_mlp(stage=1, layer_scan=False, bucket_mb=32):
+    from paddle_tpu.distributed import fleet
+    reset_programs(seed=0)
+    x = layers.data(name="x", shape=[16], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    h = layers.fc(x, 32, act="tanh")
+    loss = layers.mean(layers.square_error_cost(layers.fc(h, 1), y))
+    fleet.init(is_collective=True)
+    s = fleet.DistributedStrategy()
+    s.layer_scan = layer_scan
+    if stage:
+        s.sharding = True
+        s.sharding_stage = stage
+    s.fuse_grad_size_in_mb = bucket_mb
+    fleet.distributed_optimizer(
+        paddle.optimizer.Adam(learning_rate=1e-3), s).minimize(loss)
+    return fluid.default_main_program(), loss
+
+
+def _checks(findings, severity=None):
+    return {f.check for f in findings
+            if severity is None or f.severity == severity}
+
+
+# ---------------------------------------------------------------------------
+# spec propagation
+# ---------------------------------------------------------------------------
+
+def test_propagation_batch_spec_flows_and_params_stay_replicated():
+    reset_programs(seed=0)
+    x = layers.data(name="x", shape=[16], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    h = layers.fc(x, 32, act="tanh")
+    loss = layers.mean(layers.square_error_cost(layers.fc(h, 1), y))
+    paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    prog = fluid.default_main_program()
+    res = propagate_sharding(prog, PlanPoint(mesh_axes={"dp": 2},
+                                             batch=16))
+    assert res.spec("x") == ("dp", None)          # feed: batch over dp
+    assert res.spec(h.name)[0] == "dp"            # activation follows
+    assert res.spec(loss.name) == ()              # reduced scalar
+    # params replicated without TP rules; their grads mirror them
+    w = next(p for p in prog.all_parameters() if p.name.startswith("fc"))
+    assert not any(a for a in res.spec(w.name))
+    assert not any(a for a in res.spec(w.grad_name()))
+    assert not [f for f in res.findings if f.severity == "error"]
+
+
+def test_propagation_tp_rules_shard_params_and_matmul_contracts():
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.parallel.mesh import ShardingRules
+    reset_programs(seed=0)
+    x = layers.data(name="x", shape=[16], dtype="float32")
+    h = layers.fc(x, 32, act="tanh")                # fc_w_0: [16, 32]
+    out = layers.fc(h, 16)                          # fc_w_1: [32, 16]
+    loss = layers.mean(out)
+    paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    rules = ShardingRules([(r"^fc_w_0$", P(None, "tp")),
+                           (r"^fc_w_1$", P("tp", None))])
+    prog = fluid.default_main_program()
+    col_w, row_w = "fc_w_0", "fc_w_1"
+    plan = PlanPoint(mesh_axes={"dp": 2, "tp": 2}, param_rules=rules,
+                     batch=16)
+    res = propagate_sharding(prog, plan)
+    assert res.spec(col_w) == (None, "tp")
+    assert res.spec(row_w) == ("tp", None)
+    # column-parallel fc output carries the tp axis on its last dim
+    assert res.spec(h.name) == ("dp", "tp")
+    # row-parallel matmul contracts the tp-sharded dim: the propagation
+    # predicts the Megatron forward all-reduce
+    ar = [e for e in res.events if e["kind"] == "all-reduce"
+          and e["origin"] == "matmul_contraction"]
+    assert ar, res.events
+
+
+def test_divisibility_gates_param_sharding():
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.parallel.mesh import ShardingRules
+    reset_programs(seed=0)
+    x = layers.data(name="x", shape=[6], dtype="float32")
+    out = layers.fc(x, 3)                # fc_w_0: [6, 3] — 3 % 2 != 0
+    loss = layers.mean(out)
+    paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    rules = ShardingRules([(r"^fc_w_0$", P(None, "tp"))])
+    prog = fluid.default_main_program()
+    w = "fc_w_0"
+    res = propagate_sharding(prog, PlanPoint(
+        mesh_axes={"tp": 2}, param_rules=rules, batch=4))
+    assert res.spec(w) == (None, None)   # indivisible dim: replicated
+
+
+def test_zero_flat_state_specs_seed_dp():
+    prog, _ = _build_bucketed_mlp(stage=1)
+    res = propagate_sharding(prog, PlanPoint(mesh_axes={"dp": 2},
+                                             batch=16))
+    flat = [n for n in getattr(prog, "_zero_state_specs", {})]
+    assert flat
+    for n in flat:
+        assert "dp" in res.spec(n), (n, res.spec(n))
+
+
+# ---------------------------------------------------------------------------
+# plan checking: illegal compositions + the fallback matrix
+# ---------------------------------------------------------------------------
+
+def test_stage3_plus_tp_rejected_statically():
+    prog, _ = _build_bucketed_mlp(stage=3)
+    fs = check_plan(prog, PlanPoint(mesh_axes={"dp": 2, "tp": 2}))
+    illegal = [f for f in fs if f.check == "illegal_plan"]
+    assert illegal and illegal[0].severity == "error"
+    assert "stage3+tp" in illegal[0].message
+    # the same program on a dp-pure mesh is fine
+    fs2 = check_plan(prog, PlanPoint(mesh_axes={"dp": 2}))
+    assert not [f for f in fs2 if f.check == "illegal_plan"]
+
+
+def test_cross_batch_op_under_manual_dp_named_with_counter():
+    reset_programs(seed=0)
+    x = layers.data(name="x", shape=[16], dtype="float32")
+    h, aux = layers.switch_moe(x, num_experts=4, d_ff=32)
+    loss = layers.mean(layers.fc(h, 1)) + 0.01 * aux
+    paddle.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    prog = fluid.default_main_program()
+    fs = check_plan(prog, PlanPoint(mesh_axes={"dp": 2}))
+    hits = [f for f in fs if f.check == "manual_dp_fallback"
+            and f.op_type == "switch_moe"]
+    assert hits, fs
+    assert FALLBACK_COUNTERS["cross_batch"] in hits[0].message
+    assert hits[0].severity == "warning"
+    # strict mode: the planner's hard rejection of the plan point
+    strict = [f for f in check_plan(prog, PlanPoint(mesh_axes={"dp": 2}),
+                                    strict=True)
+              if f.check == "manual_dp_fallback"]
+    assert strict and all(f.severity == "error" for f in strict)
+    assert plan_mode(prog, PlanPoint(mesh_axes={"dp": 2})) == "gspmd"
+
+
+def test_selected_rows_fallback_named_with_counter():
+    reset_programs(seed=0)
+    ids = layers.data(name="ids", shape=[1], dtype="int64")
+    emb = layers.embedding(ids, size=(100, 8), is_sparse=True)
+    loss = layers.mean(layers.fc(emb, 1))
+    paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    prog = fluid.default_main_program()
+    fs = check_plan(prog, PlanPoint(mesh_axes={"dp": 2}))
+    hits = [f for f in fs if f.check == "manual_dp_fallback"
+            and f.var is not None]
+    assert hits, fs
+    assert FALLBACK_COUNTERS["selected_rows"] in hits[0].message
+
+
+def test_indivisible_padding_warned():
+    prog, _ = _build_bucketed_mlp(stage=1)
+    fs = check_plan(prog, PlanPoint(mesh_axes={"dp": 3}))
+    hits = [f for f in fs if f.check == "manual_dp_fallback"
+            and "indivisible" in f.message]
+    assert hits and FALLBACK_COUNTERS["indivisible_padding"] \
+        in hits[0].message
+    # pad-to-64 layout: dp=2 divides, no warning
+    fs2 = check_plan(prog, PlanPoint(mesh_axes={"dp": 2}))
+    assert not [f for f in fs2 if "indivisible" in f.message]
+
+
+def test_one_cross_batch_table():
+    """The runtime decline (parallel/zero.py) and the static lint read the
+    SAME cross-batch table — analysis/op_specs.py is the single source."""
+    from paddle_tpu.analysis.op_specs import cross_batch_ops
+    from paddle_tpu.parallel.zero import _cross_batch_ops
+    assert _cross_batch_ops() == cross_batch_ops()
+    assert {"switch_moe", "batch_norm", "data_norm",
+            "inplace_abn"} <= cross_batch_ops()
+
+
+def test_parse_mesh():
+    assert parse_mesh("dp=2,tp=4") == {"dp": 2, "tp": 4}
+    assert parse_mesh("dp=8") == {"dp": 8}
+
+
+# ---------------------------------------------------------------------------
+# plan_mode mirrors the executor's structural decision
+# ---------------------------------------------------------------------------
+
+def test_plan_mode_decisions():
+    prog, _ = _build_bucketed_mlp(stage=1)
+    assert plan_mode(prog, PlanPoint(mesh_axes={"dp": 2})) == "manual"
+    assert plan_mode(prog, PlanPoint(mesh_axes={"dp": 2, "tp": 2})) \
+        == "gspmd"
+    assert plan_mode(prog, PlanPoint(mesh_axes={})) == "single"
+    assert plan_mode(prog, PlanPoint(mesh_axes={"dp": 2}, batch=15)) \
+        == "gspmd"   # indivisible batch: nothing shards
+
+    reset_programs(seed=0)
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    loss = layers.mean(layers.fc(x, 1))
+    paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    unbucketed = fluid.default_main_program()
+    assert plan_mode(unbucketed, PlanPoint(mesh_axes={"dp": 2})) == "gspmd"
+
+
+# ---------------------------------------------------------------------------
+# predict_cost: structural collective derivation, zero compiles
+# ---------------------------------------------------------------------------
+
+def test_predict_cost_bucket_all_reduce_bytes():
+    prog, loss = _build_bucketed_mlp(stage=0)
+    rep = predict_cost(prog, PlanPoint(mesh_axes={"dp": 2}, batch=16),
+                       fetch_names=[loss.name])
+    assert rep.mode == "manual_dp" and rep.exact
+    tot = rep.totals()
+    assert set(tot) == {"all-reduce"}
+    grad_bytes = 4 * sum(
+        int(np.prod(p.shape)) for p in prog.all_parameters()
+        if p.trainable)
+    n, b = tot["all-reduce"]
+    assert n == len(prog._grad_buckets["sync_buckets"]) + 1  # + loss pmean
+    assert abs(b - (grad_bytes + 4)) <= 0.01 * grad_bytes
+
+
+def test_predict_cost_zero1_sequence():
+    prog, loss = _build_bucketed_mlp(stage=1)
+    rep = predict_cost(prog, PlanPoint(mesh_axes={"dp": 2}, batch=16),
+                       fetch_names=[loss.name])
+    tot = rep.totals()
+    assert set(tot) == {"all-reduce", "all-gather", "reduce-scatter"}
+    b = prog._zero_buckets[0]
+    assert tot["reduce-scatter"] == (1, b["padded"] * 4 // 2)
+    assert tot["all-gather"] == (1, b["padded"] * 4)
+    assert tot["all-reduce"] == (1, 4)            # the scalar loss pmean
+    # stage-1 memory: flat state halves per device
+    assert rep.memory["argument_bytes_per_device"] > 0
+
+
+def test_predict_cost_gspmd_flagged_inexact():
+    prog, loss = _build_bucketed_mlp(stage=1)
+    rep = predict_cost(prog, PlanPoint(mesh_axes={"dp": 2, "tp": 2},
+                                       batch=16),
+                       fetch_names=[loss.name])
+    assert rep.mode == "gspmd" and rep.exact is False
+
+
+def test_predict_cost_to_dict_schema():
+    prog, loss = _build_bucketed_mlp(stage=1)
+    d = predict_cost(prog, PlanPoint(mesh_axes={"dp": 2}, batch=16),
+                     fetch_names=[loss.name]).to_dict()
+    assert {"mode", "exact", "collectives", "totals", "memory",
+            "findings"} <= set(d)
+    for c in d["collectives"]:
+        assert {"kind", "count", "nbytes", "origin", "phase",
+                "exact"} <= set(c)
+    assert {"argument_bytes_per_device", "output_bytes_per_device",
+            "state_bytes_read", "state_bytes_written"} \
+        <= set(d["memory"])
+
+
+def test_rng_state_sync_counted_only_in_rolled_bodies():
+    from paddle_tpu.analysis.cost import _rng_sync_sites
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.models import bert
+
+    def build(layer_scan):
+        reset_programs(seed=0)
+        cfg = bert.BertConfig(vocab_size=64, hidden_size=16, num_layers=2,
+                              num_heads=2, intermediate_size=32,
+                              max_position=32, seq_len=8,
+                              hidden_dropout=0.1, attention_dropout=0.1)
+        ids, labels, loss = bert.build_pretrain_program(cfg)
+        fleet.init(is_collective=True)
+        s = fleet.DistributedStrategy()
+        s.layer_scan = layer_scan
+        fleet.distributed_optimizer(
+            paddle.optimizer.Adam(learning_rate=1e-4), s).minimize(loss)
+        return fluid.default_main_program()
+
+    # 3 dropout sites per transformer layer body (attention-prob dropout
+    # inside fused_attention + two hidden dropouts)
+    assert _rng_sync_sites(build(layer_scan=True)) == 3
+    assert _rng_sync_sites(build(layer_scan=False)) == 0
