@@ -460,6 +460,95 @@ def bench_gpt_decode(batch, prompt_len, new_tokens, iters):
     return batch * new_tokens * iters / dt
 
 
+def bench_serving(streams_levels=(1, 8, 32), dtypes=("bfloat16",),
+                  prompt_len=64, new_tokens=64, model="small"):
+    """Decode-SERVICE throughput (paddle_tpu/serving/): continuous
+    batching + paged KV cache under concurrent request streams. For each
+    (dtype, streams) arm: submit `streams` concurrent requests through
+    one engine and record aggregate tokens/s plus the p50/p99
+    time-to-first-token from the serving histogram — the three-level
+    concurrency sweep is the scaling story (1 stream = latency floor,
+    max_slots streams = saturated slot array). Weight arms: bf16 halves
+    the per-token weight bytes vs f32; int8 (abs-max, ops/int8_ops.py
+    scheme) halves them again. Returns a list of bench rows."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import gpt
+    from paddle_tpu.models.gpt_decode import params_from_scope
+    from paddle_tpu.observability import metrics as _obs_metrics
+    from paddle_tpu.serving import DecodeEngine, Request
+    from paddle_tpu.serving import audit as serving_audit
+
+    _log(f"serving: model={model}, prompt={prompt_len}, new={new_tokens}, "
+         f"streams={streams_levels}, dtypes={dtypes}")
+    _fresh_programs()
+    cfg = gpt.GPTConfig.tiny() if model == "tiny" else gpt.GPTConfig()
+    cfg.seq_len = prompt_len
+    cfg.max_position = max(cfg.max_position, prompt_len + new_tokens)
+    gpt.build_lm_program(cfg)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    params = params_from_scope(cfg)
+
+    max_slots = max(streams_levels)
+    block_size = int(os.environ.get("BENCH_SERVING_BLOCK", "16"))
+    max_len = prompt_len + new_tokens
+    if max_len % block_size:
+        max_len += block_size - max_len % block_size
+    blocks_per_slot = max_len // block_size
+    rng = np.random.RandomState(0)
+    rows = []
+    for dtype in dtypes:
+        engine = DecodeEngine(
+            params, cfg, max_slots=max_slots, block_size=block_size,
+            num_blocks=max_slots * blocks_per_slot + 1, max_len=max_len,
+            window=int(os.environ.get("BENCH_SERVING_WINDOW", "16")),
+            dtype=dtype)
+        # the zero-copy claim ships WITH the number: a row recorded from a
+        # window program that silently regressed into copying the cache
+        # would not be a serving benchmark at all
+        census = serving_audit.decode_copy_census(engine)
+        # warm: compile prefill + window before any timed arm
+        engine.generate([Request(
+            prompt=rng.randint(0, cfg.vocab_size, (prompt_len,)),
+            max_new_tokens=2)], timeout=600)
+        for streams in streams_levels:
+            _obs_metrics.reset("serving.ttft_ms")
+            _obs_metrics.reset("serving.tpot_ms")
+            reqs = [Request(
+                prompt=rng.randint(0, cfg.vocab_size, (prompt_len,)),
+                max_new_tokens=new_tokens, seed=i)
+                for i in range(streams)]
+            t0 = time.perf_counter()
+            comps = engine.generate(reqs, timeout=1200)
+            dt = time.perf_counter() - t0
+            n_tok = sum(len(c.tokens) for c in comps)
+            bad = sum(not c.ok for c in comps)
+            snap = _obs_metrics.snapshot()
+            ttft = snap.get("serving.ttft_ms", {})
+            tpot = snap.get("serving.tpot_ms", {})
+            row = {
+                "metric": "serving_decode_tokens_per_sec",
+                "value": round(n_tok / dt, 1), "unit": "tokens/s",
+                "streams": streams, "dtype": dtype,
+                "prompt_len": prompt_len, "new_tokens": new_tokens,
+                "ttft_p50_ms": (round(ttft["p50"], 2)
+                                if ttft.get("p50") is not None else None),
+                "ttft_p99_ms": (round(ttft["p99"], 2)
+                                if ttft.get("p99") is not None else None),
+                "tpot_p50_ms": (round(tpot["p50"], 2)
+                                if tpot.get("p50") is not None else None),
+                "per_token_kv_copies": census["per_token_kv_copies"],
+            }
+            if bad:
+                row["failed_requests"] = bad
+            rows.append(row)
+            _log(f"serving[{dtype}] streams={streams}: "
+                 f"{row['value']} tok/s, TTFT p50={row['ttft_p50_ms']} "
+                 f"p99={row['ttft_p99_ms']} ms")
+        engine.stop()
+    return rows
+
+
 def bench_resnet50(batch, steps):
     import paddle_tpu as paddle
     import paddle_tpu.fluid as fluid
@@ -1011,6 +1100,26 @@ def main():
         except Exception as e:  # pragma: no cover
             print(f"gpt-decode bench failed: {e!r}", file=sys.stderr)
             errors.append(f"gpt-decode: {e!r}")
+    if tokens_per_sec is not None and which in ("all", "serving") \
+            and _row_ok("serving"):
+        try:
+            # the serving table (ISSUE-14 acceptance row): tokens/s +
+            # p50/p99 TTFT across >= 3 concurrency levels, bf16 and int8
+            # weight arms, each stamped with the window program's KV copy
+            # census (must be 0)
+            streams = tuple(int(s) for s in os.environ.get(
+                "BENCH_SERVING_STREAMS", "1,8,32").split(","))
+            dts = tuple(os.environ.get(
+                "BENCH_SERVING_DTYPES", "bfloat16,int8").split(","))
+            extras.extend(bench_serving(
+                streams_levels=streams, dtypes=dts,
+                prompt_len=int(os.environ.get("BENCH_SERVING_PROMPT",
+                                              "64")),
+                new_tokens=int(os.environ.get("BENCH_SERVING_NEW", "64")),
+                model=os.environ.get("BENCH_SERVING_MODEL", "small")))
+        except Exception as e:  # pragma: no cover
+            print(f"serving bench failed: {e!r}", file=sys.stderr)
+            errors.append(f"serving: {e!r}")
     if tokens_per_sec is not None and which in ("all", "resnet") \
             and _row_ok("resnet"):
         try:

@@ -296,6 +296,50 @@ SPECS: Dict[str, OpSpec] = {
         outputs={"Out": ONE, "AuxLoss": OPT, "GateIdx": OPT},
         attr_types={"capacity_factor": _NUM, "top_k": int},
         sharding="moe", cross_batch=True),
+    # --- serving tier: paged KV-cache decode ops (ops/paged_ops.py) ------
+    # sharding "replicated": serving parallelism is whole-model replicas
+    # behind the round-robin frontend (serving/frontend.py) — the pools
+    # and page tables are per-replica state, never mesh-sharded.
+    "paged_cache_update": OpSpec(
+        inputs={"KPool": ONE, "VPool": ONE, "KNew": ONE, "VNew": ONE,
+                "PageTable": ONE, "Pos": ONE},
+        outputs={"KPoolOut": ONE, "VPoolOut": ONE},
+        required_attrs=("block_size",),
+        attr_types={"block_size": int},
+        closed_attrs=True, sharding="replicated"),
+    "paged_attention": OpSpec(
+        inputs={"Q": ONE, "KPool": ONE, "VPool": ONE, "PageTable": ONE,
+                "Pos": ONE},
+        outputs={"Out": ONE},
+        required_attrs=("block_size",),
+        attr_types={"block_size": int},
+        closed_attrs=True, sharding="replicated"),
+    # --- decode/search ops (ops/decode_ops.py) ---------------------------
+    "linear_chain_crf": OpSpec(
+        inputs={"Emission": ONE, "Transition": ONE, "Label": ONE,
+                "SeqLen": OPT},
+        outputs={"LogLikelihood": ONE, "Alpha": OPT, "EmissionExps": OPT,
+                 "TransitionExps": OPT},
+        sharding="follow_x"),
+    "crf_decoding": OpSpec(
+        inputs={"Emission": ONE, "Transition": ONE, "Label": OPT,
+                "SeqLen": OPT},
+        outputs={"ViterbiPath": ONE}, sharding="follow_x"),
+    "gather_tree": OpSpec(
+        inputs={"Ids": ONE, "Parents": ONE}, outputs={"Out": ONE},
+        sharding="follow_x"),
+    "beam_search": OpSpec(
+        inputs={"pre_ids": ONE, "pre_scores": ONE, "scores": ONE,
+                "ids": OPT},
+        outputs={"selected_ids": ONE, "selected_scores": ONE,
+                 "parent_idx": ONE},
+        required_attrs=("beam_size",),
+        attr_types={"beam_size": int, "end_id": int},
+        sharding="follow_x"),
+    "beam_search_decode": OpSpec(
+        inputs={"Ids": ONE, "Scores": ONE, "Parents": ONE},
+        outputs={"SentenceIds": ONE, "SentenceScores": ONE},
+        sharding="follow_x"),
     "auc": OpSpec(
         inputs={"Predict": ONE, "Label": ONE, "StatPos": ONE,
                 "StatNeg": ONE},

@@ -114,6 +114,22 @@ _DEFS: Dict[str, tuple] = {
                                  "scripts/pod_trace.py); costs a few "
                                  "trace-ring appends per step, nothing "
                                  "when FLAGS_trace_events=0"),
+    # --- serving tier (paddle_tpu/serving/, docs/serving.md) --------------
+    "FLAGS_serving_window": (8, "decode tokens per serving scan window "
+                             "(serving/engine.py): finished requests "
+                             "retire and queued requests admit BETWEEN "
+                             "windows, so this is the continuous-batching "
+                             "scheduling quantum — smaller = lower "
+                             "admission latency, larger = fewer host "
+                             "round-trips per token. FLAGS_step_deadline_"
+                             "ms bounds each window as the serving SLA "
+                             "watchdog"),
+    "FLAGS_serving_block_size": (16, "paged KV-cache block size in "
+                                 "positions (serving/cache.py): each "
+                                 "sequence owns ceil(len/block) pool "
+                                 "blocks via its page-table row; smaller "
+                                 "= less fragmentation, larger = smaller "
+                                 "page tables and fewer scatter targets"),
     # --- resilience tier (resilience/, docs/resilience.md) ---------------
     "FLAGS_fault_plan": ("", "fault-injection plan spec, e.g. "
                              "'kv.pull:error:every=3;ckpt.write:kill:at=2'"),

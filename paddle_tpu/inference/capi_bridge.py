@@ -3,8 +3,13 @@
 The C shim embeds CPython and drives this module: `create` / `io_names` /
 `run_raw` marshal tensors as (name, dtype, shape, bytes) tuples across the
 C ABI. Reference counterpart: paddle/fluid/inference/capi/pd_predictor.cc —
-there the marshalling targets the C++ AnalysisPredictor; here it targets
-the XLA Predictor (inference/__init__.py).
+there the marshalling targets the C++ AnalysisPredictor; here `create`
+mints a serving SESSION (paddle_tpu/serving/session.py): a model dir
+exported with `serving.export_decode_model` runs real continuous-batched
+decode through the shared DecodeEngine (clones share the engine, so
+concurrent C threads' requests interleave in one slot array), while any
+classic saved inference model keeps the Predictor feed-forward path —
+the pre-existing C/pthread contract is unchanged.
 """
 from __future__ import annotations
 
@@ -12,24 +17,26 @@ import numpy as np
 
 
 def create(model_dir: str):
-    from . import Config, Predictor
-    return Predictor(Config(model_dir))
+    from ..serving.session import create_session
+    return create_session(model_dir)
 
 
-def io_names(pred):
-    return (list(pred.get_input_names()), list(pred.get_output_names()))
+def io_names(sess):
+    return (list(sess.get_input_names()), list(sess.get_output_names()))
 
 
-def run_raw(pred, inputs):
+def run_raw(sess, inputs):
     """inputs: [(name, dtype_str, shape_tuple, raw_bytes)] -> same shape
     list for the outputs (contiguous buffers, library-owned on the C side).
+    Feed order follows the session's input-name order.
     """
+    by_name = {}
     for name, dt, shape, buf in inputs:
-        arr = np.frombuffer(buf, dtype=np.dtype(dt)).reshape(shape)
-        pred.get_input_handle(name).copy_from_cpu(arr)
-    outs = pred.run()
+        by_name[name] = np.frombuffer(buf, dtype=np.dtype(dt)).reshape(shape)
+    feeds = [by_name[n] for n in sess.get_input_names()]
+    outs = sess.run_list(feeds)
     res = []
-    for name, arr in zip(pred.get_output_names(), outs):
+    for name, arr in zip(sess.get_output_names(), outs):
         a = np.ascontiguousarray(arr)
         res.append((name, str(a.dtype), tuple(int(d) for d in a.shape),
                     a.tobytes()))

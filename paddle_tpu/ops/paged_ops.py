@@ -1,0 +1,128 @@
+"""Paged (block-granular) KV-cache ops for the decode service.
+
+Canonical design: PagedAttention (Kwon et al., SOSP '23) — the KV cache
+lives in a pool of fixed-size blocks, and each sequence owns a page table
+mapping its positions onto pool blocks. TPU-native formulation: the pool
+is ONE preallocated [L, num_blocks, nh, block_size, hd] array per k/v,
+per-token writes are batched scatters (`.at[...].set`, lowering to
+dynamic-update-slice) into DONATED buffers so the update happens in place
+in HBM, and the per-token read gathers a sequence's blocks back into the
+dense [nh, max_len, hd] view the attention einsum wants. Because gathered
+values are bit-identical to what a dense ring cache (models/gpt_decode.py)
+would hold — and masked positions contribute exactly-zero softmax weight —
+paged decode is bit-identical to dense decode, which tests/test_serving.py
+pins.
+
+Two consumers, ONE implementation:
+
+* the pure-jax decode engine (paddle_tpu/serving/engine.py) calls
+  `paged_update` / `paged_attend` directly inside its jitted window scan;
+* the registered `paged_cache_update` / `paged_attention` ops wrap the
+  same functions so the serving decode step exists as a static-graph
+  Program (paddle_tpu/serving/program.py) that the PR-9 analysis layer —
+  verifier, donation/alias prediction, sharding lint — checks exactly like
+  the training zoo (scripts/program_lint.py).
+
+Block 0 of the pool is the SCRATCH block: retired/inactive slots' page
+tables point at it and their (discarded) writes land there, so a frozen
+row can never corrupt a live sequence's blocks.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from .registry import register
+
+SCRATCH_BLOCK = 0
+
+
+def paged_update(k_pool, v_pool, k_new, v_new, page_table, pos,
+                 block_size: int, layer: int, active=None):
+    """Write one new position's k/v for every slot into the block pool.
+
+    k_pool/v_pool: [L, NB, nh, bs, hd]; k_new/v_new: [B, nh, hd];
+    page_table: [B, MB] int32 block ids; pos: [B] int32 write positions.
+    `active` ([B] bool, optional) redirects frozen rows' writes to the
+    scratch block. Returns the updated (k_pool, v_pool)."""
+    b = page_table.shape[0]
+    blk = page_table[jnp.arange(b), pos // block_size]
+    if active is not None:
+        blk = jnp.where(active, blk, SCRATCH_BLOCK)
+    off = pos % block_size
+    k_pool = k_pool.at[layer, blk, :, off].set(k_new.astype(k_pool.dtype))
+    v_pool = v_pool.at[layer, blk, :, off].set(v_new.astype(v_pool.dtype))
+    return k_pool, v_pool
+
+
+def paged_gather(pool, page_table, layer: int):
+    """Reassemble each slot's dense [nh, max_len, hd] cache view from its
+    blocks. pool: [L, NB, nh, bs, hd]; page_table: [B, MB] ->
+    [B, nh, MB*bs, hd]. Position p lives in block p//bs at offset p%bs —
+    the same mapping paged_update writes, so the gathered view is
+    bit-identical to a dense ring cache holding the same positions."""
+    blocks = pool[layer][page_table]            # [B, MB, nh, bs, hd]
+    b, mb, nh, bs, hd = blocks.shape
+    return blocks.transpose(0, 2, 1, 3, 4).reshape(b, nh, mb * bs, hd)
+
+
+def paged_attend(q, k_pool, v_pool, page_table, pos, block_size: int,
+                 layer: int = 0, scale=None):
+    """Single-token paged attention: q [B, nh, 1, hd] against each slot's
+    gathered cache, masked to positions <= pos. Bit-compatible with a
+    dense cache holding the same values by construction: the score/softmax
+    /context math IS models/gpt_decode._attend (imported, not copied),
+    and masked positions get exactly-zero softmax weight, so stale block
+    content cannot perturb the result."""
+    from ..models.gpt_decode import _attend  # lazy: avoid an import cycle
+    k = paged_gather(k_pool, page_table, layer)
+    v = paged_gather(v_pool, page_table, layer)
+    max_len = k.shape[2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    mask = jnp.where(jnp.arange(max_len)[None, :] <= pos[:, None],
+                     0.0, -jnp.inf).astype(jnp.float32)[:, None, None, :]
+    return _attend(q, k, v, mask, scale)
+
+
+# ---------------------------------------------------------------------------
+# static-graph op wrappers (the Program-expressible serving decode step)
+# ---------------------------------------------------------------------------
+
+def _split_heads_flat(t, nh):
+    b, h = t.shape
+    return t.reshape(b, nh, h // nh)
+
+
+@register("paged_cache_update",
+          stateful_outputs=("KPoolOut", "VPoolOut"),
+          nondiff_slots=("KPool", "VPool", "PageTable", "Pos"))
+def _paged_cache_update(ctx, ins, attrs):
+    """KNew/VNew [B, nh*hd] written at each slot's Pos into the pools
+    (in-place under executor donation — the pools are written persistable
+    state, so _CompiledBlock donates them and XLA aliases the update)."""
+    kp, vp = ins["KPool"][0], ins["VPool"][0]
+    pt = ins["PageTable"][0].astype(jnp.int32)
+    pos = ins["Pos"][0].reshape(-1).astype(jnp.int32)
+    nh = kp.shape[2]
+    k1 = _split_heads_flat(ins["KNew"][0], nh)
+    v1 = _split_heads_flat(ins["VNew"][0], nh)
+    kp, vp = paged_update(kp, vp, k1, v1, pt, pos,
+                          int(attrs["block_size"]), layer=0)
+    return {"KPoolOut": [kp], "VPoolOut": [vp]}
+
+
+@register("paged_attention",
+          nondiff_slots=("KPool", "VPool", "PageTable", "Pos"))
+def _paged_attention(ctx, ins, attrs):
+    """Q [B, nh*hd] attends each slot's paged cache (positions <= Pos);
+    returns the merged-head context [B, nh*hd]."""
+    kp, vp = ins["KPool"][0], ins["VPool"][0]
+    pt = ins["PageTable"][0].astype(jnp.int32)
+    pos = ins["Pos"][0].reshape(-1).astype(jnp.int32)
+    nh = kp.shape[2]
+    q = _split_heads_flat(ins["Q"][0], nh)[:, :, None, :]   # [B, nh, 1, hd]
+    ctx_ = paged_attend(q, kp, vp, pt, pos, int(attrs["block_size"]))
+    b, _, _, hd = ctx_.shape
+    return {"Out": [ctx_.transpose(0, 2, 1, 3).reshape(b, nh * hd)]}

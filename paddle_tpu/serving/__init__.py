@@ -1,0 +1,36 @@
+"""Production decode service: continuous batching over a paged KV cache.
+
+The reference dedicates a whole layer to serving (AnalysisPredictor / C
+API / Go bindings); this package is that layer rebuilt TPU-native around
+two canonical designs:
+
+* **continuous (iteration-level) batching** — Orca (Yu et al., OSDI '22):
+  a fixed-width slot array runs the decode scan in fixed windows; finished
+  requests retire and queued requests are admitted BETWEEN windows, so the
+  compiled program never retraces while the batch composition churns;
+* **paged KV cache** — PagedAttention (Kwon et al., SOSP '23): one
+  preallocated block pool per k/v with a slot->block page table, written
+  in place via donated scatters (zero per-token cache copies, proven
+  statically by the analysis layer and at runtime by the HLO copy census
+  in serving/audit.py).
+
+Composition with the existing subsystems (the point of this layer):
+window fetches ride the FetchHandle plumbing (framework/fetch.py),
+`FLAGS_step_deadline_ms` bounds each window as the SLA watchdog (a trip
+flight-dumps and fails in-flight requests), every request draws
+admit->prefill->first-token->retire flow events and TTFT/TPOT histograms
+through observability/, and distributed/launch.py supervises replicated
+decode workers behind the round-robin frontend (serving/frontend.py).
+"""
+from .request import (Completion, Request, RequestHandle, RequestState,
+                      ServingError)
+from .cache import BlockAllocator, CacheConfig, PagedKVCache
+from .engine import DecodeEngine, EngineConfig
+from .frontend import RoundRobinFrontend, replicated_engines
+
+__all__ = [
+    "BlockAllocator", "CacheConfig", "Completion", "DecodeEngine",
+    "EngineConfig", "PagedKVCache", "Request", "RequestHandle",
+    "RequestState", "RoundRobinFrontend", "ServingError",
+    "replicated_engines",
+]

@@ -1,0 +1,102 @@
+"""Zero-copy proof for the paged KV cache: the runtime half.
+
+The serving acceptance contract has two layers (docs/serving.md):
+
+* **static** — serving/program.py expresses the decode step as a Program
+  and the PR-9 donation/alias analysis (analysis/alias.py) proves the
+  pools are donated written state with no fetch_of_donated /
+  write_after_donate hazards, before any compile;
+* **runtime (this module)** — the engine's ACTUAL compiled window program
+  is lowered to optimized HLO and scanned for copy ops. A failed pool
+  donation has exactly one HLO signature: a value-preserving `copy` (or
+  copy-start/copy-done/async-done pair) of a POOL-SHAPED buffer — XLA
+  preserving the old cache because the in-place update's alias could not
+  be honored. Zero pool-shaped copies anywhere in the window program
+  means zero per-token KV-cache copies, the paged-cache analog of the
+  training-side census in scripts/copy_audit.py (which gains a --serving
+  mode delegating here).
+"""
+from __future__ import annotations
+
+import re
+from typing import List
+
+_COPY_RE = re.compile(
+    r"%?([\w\.\-]+)\s*=\s*(\(?\s*[\w\[\],\s{}]+?\)?)\s*"
+    r"(copy-start|copy-done|copy|async-done)\(")
+
+
+def _dims_of(type_str: str):
+    """First shaped element of an HLO result type ('f32[2,64,4,8,16]' or a
+    copy-start tuple '(f32[...], f32[...], u32[])') -> (dtype, dims)."""
+    m = re.search(r"(\w+)\[([\d,]*)\]", type_str)
+    if not m:
+        return None, ()
+    dt, dims = m.groups()
+    return dt, tuple(int(d) for d in dims.split(",") if d)
+
+
+def window_hlo(engine) -> str:
+    """Optimized HLO of the engine's decode-window program (AOT lower +
+    compile from abstract args — no real buffers consumed)."""
+    lowered = engine._window_jit.lower(*engine.window_abstract_args())
+    return lowered.compile().as_text()
+
+
+def kv_copy_findings(hlo_text: str, pool_shape) -> List[dict]:
+    """Every copy-family op whose payload is pool-shaped ([L, NB, nh, bs,
+    hd] or one layer's [NB, nh, bs, hd] slice of it). Each finding names
+    the instruction so a regression points at the op that lost its alias."""
+    pool_dims = tuple(int(d) for d in pool_shape)
+    layer_dims = pool_dims[1:]
+    findings = []
+    for line in hlo_text.splitlines():
+        m = _COPY_RE.search(line)
+        if not m:
+            continue
+        iname, ty, kind = m.groups()
+        _, dims = _dims_of(ty)
+        if dims == pool_dims or dims == layer_dims:
+            findings.append({"instruction": iname, "kind": kind,
+                             "dims": dims, "line": line.strip()[:200]})
+    return findings
+
+
+def copy_counts(hlo_text: str) -> dict:
+    """Total copy-family op population of the program (context for the
+    census row: the pool-shaped subset must be zero; small scheduling
+    copies of scalars/slot vectors are XLA residue, reported not gated)."""
+    counts = {"copy": 0, "copy-start": 0, "copy-done": 0, "async-done": 0}
+    for line in hlo_text.splitlines():
+        m = _COPY_RE.search(line)
+        if m:
+            counts[m.group(3)] += 1
+    return counts
+
+
+def decode_copy_census(engine) -> dict:
+    """The serving census row: compile the window program and report the
+    pool-shaped copy findings (must be empty) plus the total copy
+    population and program size."""
+    txt = window_hlo(engine)
+    findings = kv_copy_findings(txt, engine.cache.config.pool_shape())
+    n_instr = sum(1 for line in txt.splitlines() if " = " in line)
+    return {
+        "pool_shape": list(engine.cache.config.pool_shape()),
+        "window": engine.config.window,
+        "kv_copy_findings": findings,
+        "per_token_kv_copies": len(findings),
+        "copy_population": copy_counts(txt),
+        "instructions": n_instr,
+    }
+
+
+def assert_zero_kv_copies(engine) -> dict:
+    """Raise if any pool-shaped copy survives in the compiled window
+    program; returns the census row for logging."""
+    row = decode_copy_census(engine)
+    if row["per_token_kv_copies"]:
+        raise AssertionError(
+            "per-token KV-cache copies detected in the decode window "
+            f"program: {row['kv_copy_findings']}")
+    return row

@@ -1,0 +1,156 @@
+"""Paged KV cache: block pool + page tables + the host-side allocator.
+
+vLLM's core memory idea (PagedAttention, SOSP '23) mapped onto the static
+TPU idiom: HBM for the cache is ONE preallocated pool per k/v —
+[L, num_blocks, nh, block_size, hd] — and a sequence owns an ordered list
+of blocks recorded in its slot's page-table row. Allocation is host-side
+and happens only BETWEEN scan windows (admission/retirement), so the
+device program's shapes never change; the device only ever sees the pool
+plus an int32 [max_slots, max_blocks_per_slot] page table.
+
+Block 0 is reserved as the SCRATCH block (ops/paged_ops.SCRATCH_BLOCK):
+empty page-table rows point at it, and frozen slots' writes are redirected
+there, so a stale row can never touch a live sequence's memory. Admission
+reserves a request's WHOLE budget (prompt bucket + max_new_tokens) up
+front — there is no mid-flight allocation, hence no mid-flight OOM or
+preemption: a request that cannot be fully funded stays queued.
+
+Utilization rides the metrics registry: `serving.kv_blocks_used` /
+`serving.kv_blocks_total` gauges move on every alloc/free.
+"""
+from __future__ import annotations
+
+import dataclasses
+import weakref
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..observability import metrics as _metrics
+from ..ops.paged_ops import SCRATCH_BLOCK
+
+
+@dataclasses.dataclass
+class CacheConfig:
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    block_size: int
+    num_blocks: int            # pool blocks INCLUDING the scratch block
+    max_blocks_per_slot: int   # page-table width; max_len = this * block_size
+    dtype: str = "float32"
+
+    @property
+    def max_len(self) -> int:
+        return self.max_blocks_per_slot * self.block_size
+
+    def pool_shape(self):
+        return (self.num_layers, self.num_blocks, self.num_heads,
+                self.block_size, self.head_dim)
+
+
+class BlockAllocator:
+    """Free-list allocator over pool block ids (scratch block excluded).
+    All-or-nothing alloc: a request either gets its whole budget or
+    nothing (it stays queued) — partial grants would mean mid-flight
+    exhaustion, which the static admission contract forbids."""
+
+    # every live allocator, so the process-level gauges aggregate across
+    # engines (replicas, bench arms) instead of last-writer-wins
+    _live: "weakref.WeakSet" = weakref.WeakSet()
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is scratch)")
+        self.num_blocks = num_blocks
+        self._free = list(range(num_blocks - 1, SCRATCH_BLOCK, -1))
+        BlockAllocator._live.add(self)
+        self._gauge()
+
+    @classmethod
+    def _gauge(cls):
+        allocs = list(cls._live)
+        _metrics.set_gauge("serving.kv_blocks_total",
+                           sum(a.num_blocks - 1 for a in allocs))
+        _metrics.set_gauge(
+            "serving.kv_blocks_used",
+            sum((a.num_blocks - 1) - len(a._free) for a in allocs))
+
+    def close(self):
+        """Retire this allocator from the process gauges (engine.stop()).
+        Weakrefs alone are not enough: jit caches can keep a stopped
+        engine — and so its allocator — alive indefinitely."""
+        BlockAllocator._live.discard(self)
+        self._gauge()
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        if n > len(self._free):
+            return None
+        got = [self._free.pop() for _ in range(n)]
+        self._gauge()
+        return got
+
+    def free(self, blocks: List[int]):
+        for b in blocks:
+            if b == SCRATCH_BLOCK:
+                raise ValueError("freeing the scratch block")
+            self._free.append(b)
+        self._gauge()
+
+
+class PagedKVCache:
+    """Device pools + host page table + per-slot block ownership."""
+
+    def __init__(self, config: CacheConfig):
+        import jax.numpy as jnp
+        self.config = config
+        self.allocator = BlockAllocator(config.num_blocks)
+        dt = jnp.dtype(config.dtype)
+        self.k_pool = jnp.zeros(config.pool_shape(), dt)
+        self.v_pool = jnp.zeros(config.pool_shape(), dt)
+        self._slot_blocks: Dict[int, List[int]] = {}
+
+    def page_table_rows(self, max_slots: int) -> np.ndarray:
+        """[max_slots, max_blocks_per_slot] int32; unassigned entries point
+        at the scratch block."""
+        pt = np.full((max_slots, self.config.max_blocks_per_slot),
+                     SCRATCH_BLOCK, np.int32)
+        for slot, blocks in self._slot_blocks.items():
+            pt[slot, :len(blocks)] = blocks
+        return pt
+
+    def assign(self, slot: int, n_blocks: int) -> Optional[List[int]]:
+        """Reserve n_blocks for `slot` (its full request budget). None if
+        the pool cannot fund it — the caller keeps the request queued."""
+        if slot in self._slot_blocks:
+            raise ValueError(f"slot {slot} already holds blocks")
+        if n_blocks > self.config.max_blocks_per_slot:
+            raise ValueError(
+                f"request needs {n_blocks} blocks > max_blocks_per_slot "
+                f"{self.config.max_blocks_per_slot}")
+        blocks = self.allocator.alloc(n_blocks)
+        if blocks is None:
+            return None
+        self._slot_blocks[slot] = blocks
+        return blocks
+
+    def blocks_of(self, slot: int) -> List[int]:
+        return list(self._slot_blocks.get(slot, ()))
+
+    def release(self, slot: int):
+        blocks = self._slot_blocks.pop(slot, None)
+        if blocks:
+            self.allocator.free(blocks)
+
+    def update_pools(self, k_pool, v_pool):
+        """Adopt the window's donated-update results (the old device
+        buffers were consumed by the dispatch)."""
+        self.k_pool = k_pool
+        self.v_pool = v_pool
+
+    def close(self):
+        self.allocator.close()

@@ -1,0 +1,690 @@
+"""Continuous-batching decode engine over the paged KV cache.
+
+Iteration-level scheduling (Orca, OSDI '22) in the static-shape TPU
+idiom: the engine owns a FIXED slot array of width `max_slots` and runs
+decode in fixed `window`-token `lax.scan` dispatches — ONE compiled XLA
+program for the life of the engine. Between windows (and only between
+windows) the host retires finished slots and admits queued requests, so
+batch composition churns freely while the device program never retraces.
+
+Each admitted request is prefilled once (a dense causal forward over its
+padded prompt bucket — one compile per bucket size), its prompt k/v is
+scattered into freshly assigned pool blocks, and its slot joins the next
+window. Inside the window scan every step runs the SAME transformer block
+body as models/gpt_decode (`_block` is imported, not reimplemented) with a
+merge hook that writes the new position into the paged pool and gathers
+the dense per-slot cache view back (ops/paged_ops.py). That single-
+implementation rule is why paged continuous-batched decode is bit-
+identical per request to the dense single-request scan — pinned by
+tests/test_serving.py.
+
+Zero-copy contract: the pools are DONATED into the window/prompt-write
+dispatches (donate_argnums), so the per-token cache update aliases in
+place in HBM. serving/audit.py reads the compiled HLO and asserts no
+pool-shaped copy op exists anywhere in the window program; the static
+twin (serving/program.py) gets the same verdict from the PR-9
+donation/alias analysis without compiling anything.
+
+Subsystem composition:
+* window fetches come back as lazy FetchHandles (framework/fetch.py) —
+  materialization pays into the one executor.fetch_sync ledger and closes
+  a per-window trace flow;
+* `FLAGS_step_deadline_ms` bounds each window dispatch+drain (the SLA
+  watchdog): a trip raises the typed DeadlineExceededError, flight-dumps
+  (framework/executor._deadline_call), fails every in-flight request and
+  marks the engine dead;
+* every request is one trace flow (submit -> admit -> prefill ->
+  first_token -> retire) and feeds the `serving.ttft_ms` /
+  `serving.tpot_ms` histograms; windows are flight-recorder steps, so a
+  crash dump shows the serving timeline like a training run's.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..flags import flag
+from ..framework.fetch import FetchHandle
+from ..models.gpt import GPTConfig
+from ..models.gpt_decode import _block, _embed, _ln
+from ..observability import flight as _flight
+from ..observability import metrics as _metrics
+from ..observability import trace as _trace
+from ..ops.paged_ops import paged_gather, paged_update
+from .cache import CacheConfig, PagedKVCache
+from .request import Completion, Request, RequestHandle, RequestState
+from .weights import dequant_params, prepare_params
+
+_engine_ids = itertools.count(1)
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Serving geometry. Every field is STATIC for the engine's lifetime —
+    the continuous-batching contract is that admission/retirement never
+    changes a compiled shape. 0 means "take the flag default"
+    (FLAGS_serving_window / FLAGS_serving_block_size)."""
+    max_slots: int = 4
+    block_size: int = 0
+    num_blocks: int = 64
+    max_len: int = 128          # per-request prompt + generation budget
+    window: int = 0
+    dtype: str = "float32"      # "float32" | "bfloat16" | "int8"
+    # set by resolve(): the pre-rounding budget the caller asked for (the
+    # max_position guard compares THIS, so re-resolving an already-rounded
+    # config — engine clones — never trips it on rounding slack)
+    requested_max_len: Optional[int] = None
+
+    def resolve(self) -> "EngineConfig":
+        c = dataclasses.replace(self)
+        if c.requested_max_len is None:
+            c.requested_max_len = c.max_len
+        if not c.block_size:
+            c.block_size = int(flag("FLAGS_serving_block_size"))
+        if not c.window:
+            c.window = int(flag("FLAGS_serving_window"))
+        if c.max_len % c.block_size:
+            c.max_len += c.block_size - c.max_len % c.block_size
+        return c
+
+
+class _Slot:
+    __slots__ = ("handle", "pos", "gen", "token", "eos", "max_new",
+                 "temp", "top_k", "seed")
+
+    def __init__(self, handle, pos, gen, token, eos, max_new, temp,
+                 top_k, seed):
+        self.handle = handle
+        self.pos = pos
+        self.gen = gen
+        self.token = token
+        self.eos = eos
+        self.max_new = max_new
+        self.temp = temp
+        self.top_k = top_k
+        self.seed = seed
+
+
+class DecodeEngine:
+    """One decode worker: a slot array, a paged cache, compiled prefill /
+    prompt-write / window programs, and the service thread interleaving
+    admission with decode windows."""
+
+    def __init__(self, params: Dict, model_config: GPTConfig,
+                 config: Optional[EngineConfig] = None, **overrides):
+        import jax
+        import jax.numpy as jnp
+        self.model_config = model_config
+        if config is not None and overrides:
+            raise ValueError("pass EngineConfig or overrides, not both")
+        raw = config or EngineConfig(**overrides)
+        # guard on the REQUESTED budget; resolve() then rounds max_len up
+        # to a block multiple, which only widens the (masked) gather view
+        # — real positions are additionally bounded by request_budget, so
+        # the rounded width may legitimately exceed max_position
+        requested = (raw.requested_max_len
+                     if raw.requested_max_len is not None else raw.max_len)
+        if requested > model_config.max_position:
+            raise ValueError(
+                f"max_len {requested} exceeds model max_position "
+                f"{model_config.max_position}")
+        cfg = raw.resolve()
+        self.config = cfg
+        # per-request prompt+generation ceiling: every live position must
+        # have a real wpe row
+        self.request_budget = min(cfg.max_len, model_config.max_position)
+        self.params, self.scales, self.compute_dtype = prepare_params(
+            params, cfg.dtype)
+        nh = model_config.num_heads
+        hd = model_config.hidden_size // nh
+        self.cache = PagedKVCache(CacheConfig(
+            num_layers=model_config.num_layers, num_heads=nh, head_dim=hd,
+            block_size=cfg.block_size, num_blocks=cfg.num_blocks,
+            max_blocks_per_slot=cfg.max_len // cfg.block_size,
+            dtype=str(jnp.dtype(self.compute_dtype))))
+        # prompt buckets: block-aligned, doubling up to the bucket cap
+        # (each bucket is one prefill compile; serving loops stay hot
+        # because real prompt lengths collapse onto few buckets). The cap
+        # is additionally bounded by the largest block multiple inside
+        # max_position: a prefill over bucket positions reads wpe[0:bucket]
+        # densely, so unlike the (masked) decode gather width the bucket
+        # can never exceed the position table
+        bs = cfg.block_size
+        cap = min(cfg.max_len,
+                  (model_config.max_position // bs) * bs)
+        self.buckets = []
+        b = bs
+        while b < cap:
+            self.buckets.append(b)
+            b *= 2
+        self.buckets.append(cap)
+
+        self._id = next(_engine_ids)
+        self._queue: "List[tuple]" = []
+        self._slots: Dict[int, _Slot] = {}
+        self._cv = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        self._dead: Optional[str] = None
+        self._windows = 0
+        self._completed = 0
+        self._prefill_jits: Dict[int, object] = {}
+        self._write_jits: Dict[int, object] = {}
+        self._window_jit = jax.jit(self._window_fn, donate_argnums=(2, 3))
+
+    # ------------------------------------------------------------------
+    # compiled programs
+    # ------------------------------------------------------------------
+    def _model_params(self, payloads, scales):
+        if self.scales is None:
+            return payloads
+        return dequant_params(payloads, scales,
+                              compute_dtype=self.compute_dtype)
+
+    @staticmethod
+    def _sample_rows(logits, temps, top_ks, seeds, gen_idx):
+        """Per-slot sampling, greedy when temp == 0. Top-k filtering and
+        temperature scaling follow models/gpt_decode._sample exactly; the
+        key schedule fold_in(PRNGKey(seed), generated_index) makes every
+        token's draw a pure function of (request seed, token index) — the
+        property that makes continuous batching bit-reproducible."""
+        import jax
+        import jax.numpy as jnp
+        b, v = logits.shape
+        greedy = jnp.argmax(logits, axis=-1)
+        scaled = logits.astype(jnp.float32) / \
+            jnp.maximum(temps, 1e-6)[:, None]
+        srt = jnp.sort(scaled, axis=-1)
+        kth = srt[jnp.arange(b), v - jnp.clip(top_ks, 1, v)][:, None]
+        filtered = jnp.where(scaled < kth, -jnp.inf, scaled)
+        use = jnp.where((top_ks > 0)[:, None], filtered, scaled)
+        keys = jax.vmap(
+            lambda s, g: jax.random.fold_in(jax.random.PRNGKey(s), g)
+        )(seeds, gen_idx)
+        sampled = jax.vmap(
+            lambda k, l: jax.random.categorical(k, l))(keys, use)
+        return jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
+
+    def _window_fn(self, payloads, scales, k_pool, v_pool, page_table,
+                   tokens, pos, gen, live, temps, top_ks, seeds, eos_vec,
+                   max_new):
+        """W decode steps over the slot array (ONE lax.scan). Frozen rows
+        (retired/empty slots, eos/length-finished mid-window) keep
+        computing — static shapes — but their writes are redirected to the
+        scratch block and their emissions flagged inactive."""
+        import jax
+        import jax.numpy as jnp
+        cfg = self.model_config
+        p = self._model_params(payloads, scales)
+        bs = self.config.block_size
+        max_len = self.config.max_len
+        n_layers = cfg.num_layers
+
+        def step(carry, _):
+            k_pool, v_pool, tokens, pos, gen, done = carry
+            act = ~done
+            x = p["wte"][tokens[:, None]] + p["wpe"][pos][:, None]
+            mask = jnp.where(
+                jnp.arange(max_len)[None, :] <= pos[:, None],
+                0.0, -jnp.inf).astype(jnp.float32)[:, None, None, :]
+            pools = [k_pool, v_pool]
+            for i in range(n_layers):
+                def merge(k1, v1, _i=i):
+                    pools[0], pools[1] = paged_update(
+                        pools[0], pools[1], k1[:, :, 0, :], v1[:, :, 0, :],
+                        page_table, pos, bs, _i, active=act)
+                    return (paged_gather(pools[0], page_table, _i),
+                            paged_gather(pools[1], page_table, _i))
+                x, _ = _block(x, p, i, cfg, mask, merge)
+            k_pool, v_pool = pools
+            x = _ln(x, p["final_ln_scale"], p["final_ln_bias"])
+            logits = jnp.einsum(
+                "bsh,vh->bsv", x, p["wte"],
+                preferred_element_type=jnp.float32)[:, 0]
+            nxt = self._sample_rows(logits, temps, top_ks, seeds, gen)
+            hit_eos = (eos_vec >= 0) & (nxt == eos_vec)
+            gen2 = gen + act.astype(jnp.int32)
+            done2 = done | (act & (hit_eos | (gen2 >= max_new)))
+            tokens2 = jnp.where(act, nxt, tokens)
+            pos2 = pos + act.astype(jnp.int32)
+            return ((k_pool, v_pool, tokens2, pos2, gen2, done2),
+                    (nxt, act))
+
+        carry0 = (k_pool, v_pool, tokens, pos, gen, ~live)
+        (k_pool, v_pool, *_), (toks, acts) = jax.lax.scan(
+            step, carry0, None, length=self.config.window)
+        return k_pool, v_pool, toks, acts
+
+    def _prefill_fn(self, bucket: int):
+        """Dense causal forward over one padded prompt bucket -> per-layer
+        prompt k/v (pad positions zeroed) + the first sampled token. Same
+        block body as the window, so prefill-produced cache values are
+        bit-identical to what models/gpt_decode.prefill would hold."""
+        import jax
+        import jax.numpy as jnp
+        cfg = self.model_config
+
+        def run(payloads, scales, prompt, prompt_len, temp, top_k, seed):
+            p = self._model_params(payloads, scales)
+            x = _embed(p, prompt[None], 0)            # [1, bucket, H]
+            qpos = jnp.arange(bucket)[:, None]
+            kpos = jnp.arange(bucket)[None, :]
+            causal = jnp.where(qpos >= kpos, 0.0,
+                               -jnp.inf).astype(jnp.float32)
+            keep = (jnp.arange(bucket) < prompt_len)[None, None, :, None]
+            ks, vs = [], []
+            for i in range(cfg.num_layers):
+                x, (k, v) = _block(x, p, i, cfg, causal)
+                ks.append(jnp.where(keep, k, 0.0).astype(k.dtype))
+                vs.append(jnp.where(keep, v, 0.0).astype(v.dtype))
+            k_seq = jnp.stack(ks)[:, 0]               # [L, nh, bucket, hd]
+            v_seq = jnp.stack(vs)[:, 0]
+            x = _ln(x, p["final_ln_scale"], p["final_ln_bias"])
+            x_last = jax.lax.dynamic_slice_in_dim(x, prompt_len - 1, 1,
+                                                  axis=1)
+            logits = jnp.einsum(
+                "bsh,vh->bsv", x_last, p["wte"],
+                preferred_element_type=jnp.float32)[:, 0]   # [1, V]
+            first = self._sample_rows(
+                logits, temp[None], top_k[None], seed[None],
+                jnp.zeros((1,), jnp.int32))
+            return k_seq, v_seq, first[0]
+        return jax.jit(run)
+
+    def _write_fn(self, n_blocks: int):
+        """Scatter one prefilled prompt's k/v into its assigned blocks
+        (pools donated: the write aliases in place)."""
+        import jax
+
+        def run(k_pool, v_pool, k_seq, v_seq, blocks):
+            nh = self.cache.config.num_heads
+            bs = self.config.block_size
+            hd = self.cache.config.head_dim
+            L = self.model_config.num_layers
+            kb = k_seq.reshape(L, nh, n_blocks, bs, hd) \
+                .transpose(0, 2, 1, 3, 4)
+            vb = v_seq.reshape(L, nh, n_blocks, bs, hd) \
+                .transpose(0, 2, 1, 3, 4)
+            k_pool = k_pool.at[:, blocks].set(kb.astype(k_pool.dtype))
+            v_pool = v_pool.at[:, blocks].set(vb.astype(v_pool.dtype))
+            return k_pool, v_pool
+        return jax.jit(run, donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------
+    # submission API
+    # ------------------------------------------------------------------
+    def submit(self, request: Request) -> RequestHandle:
+        fid = _trace.new_flow()
+        handle = RequestHandle(request, flow_id=fid)
+        _metrics.inc("serving.requests")
+        reason = self._reject_reason(request)
+        if reason is not None:
+            _metrics.inc("serving.rejected")
+            handle._finish(RequestState.REJECTED, reason)
+            return handle
+        _trace.flow_start("serving.request", fid,
+                          args={"uid": request.uid})
+        with self._cv:
+            self._queue.append((request, handle))
+            _metrics.set_gauge("serving.queue_depth", len(self._queue))
+            self._ensure_thread()
+            self._cv.notify_all()
+        return handle
+
+    def _block_budget(self, plen: int, max_new: int) -> int:
+        bs = self.config.block_size
+        return max(self._bucket_for(plen) // bs, -(-(plen + max_new) // bs))
+
+    def _reject_reason(self, req: Request) -> Optional[str]:
+        if self._dead:
+            return f"engine dead: {self._dead}"
+        plen = int(req.prompt.shape[0])
+        if plen < 1:
+            return "empty prompt"
+        if req.max_new_tokens < 1:
+            return "max_new_tokens must be >= 1"
+        if req.temperature < 0.0:
+            return f"temperature must be >= 0, got {req.temperature}"
+        if req.top_k < 0:
+            return f"top_k must be >= 0, got {req.top_k}"
+        if plen + req.max_new_tokens > self.request_budget:
+            return (f"prompt {plen} + {req.max_new_tokens} new exceeds "
+                    f"engine budget {self.request_budget} "
+                    f"(max_len/max_position)")
+        if plen > self.buckets[-1]:
+            return (f"prompt {plen} exceeds the largest prefill bucket "
+                    f"{self.buckets[-1]} (block-aligned max_position)")
+        # a budget the pool could NEVER fund must reject now, not park at
+        # the FCFS head forever wedging every request behind it
+        usable = self.config.num_blocks - 1
+        need = self._block_budget(plen, req.max_new_tokens)
+        if need > usable:
+            return (f"request needs {need} cache blocks but the pool has "
+                    f"only {usable} (num_blocks={self.config.num_blocks} "
+                    "incl. scratch)")
+        return None
+
+    def generate(self, requests: List[Request],
+                 timeout: float = 300.0) -> List[Completion]:
+        """Continuous-batched: submit everything, wait for everything."""
+        handles = [self.submit(r) for r in requests]
+        return [h.result(timeout=timeout, raise_on_error=False)
+                for h in handles]
+
+    def generate_sequential(self, requests: List[Request],
+                            timeout: float = 300.0) -> List[Completion]:
+        """The parity baseline: one request at a time, each fully retired
+        before the next is submitted — same compiled programs, batch of
+        one live slot."""
+        return [self.submit(r).result(timeout=timeout,
+                                      raise_on_error=False)
+                for r in requests]
+
+    # ------------------------------------------------------------------
+    # service loop
+    # ------------------------------------------------------------------
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop = False
+            self._thread = threading.Thread(
+                target=self._service_loop, daemon=True,
+                name=f"serving-engine-{self._id}")
+            self._thread.start()
+
+    def start(self):
+        with self._cv:
+            self._ensure_thread()
+        return self
+
+    def stop(self):
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        t = self._thread
+        if t is not None and t.is_alive() \
+                and t is not threading.current_thread():
+            t.join(timeout=60)
+        if self._queue or self._slots:
+            # stop() abandons in-flight work: their callers must get a
+            # terminal FAILED completion, never block forever
+            self._fail_all("engine stopped")
+        self.cache.close()   # retire this pool from the process gauges
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *a):
+        self.stop()
+        return False
+
+    def _service_loop(self):
+        while True:
+            with self._cv:
+                while (not self._stop and not self._queue
+                       and not self._slots):
+                    self._cv.wait(0.05)
+                if self._stop:
+                    break
+            try:
+                self._admit()
+                if self._slots:
+                    self._run_window()
+            except BaseException as e:  # noqa: BLE001 — fail requests, die
+                self._fail_all(repr(e))
+                break
+
+    def _fail_all(self, why: str):
+        self._dead = why
+        _metrics.inc("serving.engine_failures")
+        with self._cv:
+            pending = list(self._queue)
+            self._queue.clear()
+            slots = dict(self._slots)
+            self._slots.clear()
+        for _, handle in pending:
+            handle._finish(RequestState.FAILED, "engine failed", error=why)
+        for idx, slot in slots.items():
+            self.cache.release(idx)
+            slot.handle._finish(RequestState.FAILED, "engine failed",
+                                error=why)
+
+    # ---- admission -------------------------------------------------------
+    def _bucket_for(self, plen: int) -> int:
+        for b in self.buckets:
+            if b >= plen:
+                return b
+        return self.buckets[-1]
+
+    def _admit(self):
+        while True:
+            with self._cv:
+                if not self._queue:
+                    return
+                req, handle = self._queue[0]
+            free = [i for i in range(self.config.max_slots)
+                    if i not in self._slots]
+            if not free:
+                return
+            plen = int(req.prompt.shape[0])
+            bucket = self._bucket_for(plen)
+            bs = self.config.block_size
+            n_blocks = max(bucket // bs,
+                           -(-(plen + req.max_new_tokens) // bs))
+            slot_idx = free[0]
+            blocks = self.cache.assign(slot_idx, n_blocks)
+            if blocks is None:
+                # pool cannot fund the head request: FCFS — wait for a
+                # retirement to free blocks rather than starving big
+                # requests behind small ones
+                return
+            with self._cv:
+                self._queue.pop(0)
+                _metrics.set_gauge("serving.queue_depth", len(self._queue))
+            try:
+                self._prefill_into(slot_idx, blocks, req, handle, plen,
+                                   bucket)
+            except Exception as e:  # noqa: BLE001 — isolate to the request
+                # a per-request admission failure (bad prompt content, a
+                # transient compile error) fails THAT request, not the
+                # engine and everything in flight; a failure inside a
+                # WINDOW still escalates (shared pool state is suspect)
+                self.cache.release(slot_idx)
+                self._slots.pop(slot_idx, None)
+                _metrics.inc("serving.prefill_failures")
+                handle._finish(RequestState.FAILED, "prefill failed",
+                               error=repr(e))
+
+    def _prefill_into(self, slot_idx, blocks, req, handle, plen, bucket):
+        import jax.numpy as jnp
+        handle._set_state(RequestState.PREFILL)
+        _trace.instant("serving.admit",
+                       args={"uid": req.uid, "slot": slot_idx})
+        _metrics.inc("serving.prefills")
+        fn = self._prefill_jits.get(bucket)
+        if fn is None:
+            fn = self._prefill_jits[bucket] = self._prefill_fn(bucket)
+        padded = np.zeros((bucket,), np.int32)
+        padded[:plen] = req.prompt
+        scales = self.scales if self.scales is not None else {}
+        with _trace.RecordEvent("serving.prefill",
+                                args={"uid": req.uid, "bucket": bucket}):
+            k_seq, v_seq, first = fn(
+                self.params, scales, jnp.asarray(padded),
+                jnp.int32(plen), jnp.float32(req.temperature),
+                jnp.int32(req.top_k), jnp.uint32(req.seed))
+            nb = bucket // self.config.block_size
+            wfn = self._write_jits.get(nb)
+            if wfn is None:
+                wfn = self._write_jits[nb] = self._write_fn(nb)
+            k_pool, v_pool = wfn(self.cache.k_pool, self.cache.v_pool,
+                                 k_seq, v_seq,
+                                 jnp.asarray(blocks[:nb], jnp.int32))
+            self.cache.update_pools(k_pool, v_pool)
+        # TTFT is measured at HOST materialization of the first token —
+        # through the FetchHandle ledger like every other fetch
+        tok = int(FetchHandle(first, name="serving.first_token").numpy())
+        handle._append_tokens([tok])
+        handle._set_state(RequestState.DECODE)
+        _metrics.observe("serving.ttft_ms", handle.ttft_ms())
+        _trace.instant("serving.first_token", args={"uid": req.uid})
+        eos = -1 if req.eos_token is None else int(req.eos_token)
+        if req.max_new_tokens == 1 or tok == eos:
+            self.cache.release(slot_idx)
+            self._retire(handle, "eos" if tok == eos else "length")
+            return
+        self._slots[slot_idx] = _Slot(
+            handle, pos=plen, gen=1, token=tok, eos=eos,
+            max_new=req.max_new_tokens, temp=float(req.temperature),
+            top_k=int(req.top_k), seed=int(req.seed))
+        _metrics.set_gauge("serving.active_slots", len(self._slots))
+
+    def _retire(self, handle, reason: str):
+        handle._finish(RequestState.DONE, reason)
+        self._completed += 1
+        _metrics.inc("serving.completed")
+        tpot = handle.tpot_ms()
+        if tpot is not None:
+            _metrics.observe("serving.tpot_ms", tpot)
+        if handle.flow_id is not None:
+            _trace.flow_end("serving.request", handle.flow_id,
+                            args={"uid": handle.request.uid,
+                                  "reason": reason})
+
+    # ---- decode window ---------------------------------------------------
+    def _window_args(self):
+        import jax.numpy as jnp
+        B = self.config.max_slots
+        tokens = np.zeros((B,), np.int32)
+        pos = np.zeros((B,), np.int32)
+        gen = np.zeros((B,), np.int32)
+        live = np.zeros((B,), bool)
+        temps = np.zeros((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        seeds = np.zeros((B,), np.uint32)
+        eos = np.full((B,), -1, np.int32)
+        max_new = np.full((B,), 1, np.int32)
+        for i, s in self._slots.items():
+            tokens[i], pos[i], gen[i] = s.token, s.pos, s.gen
+            live[i], temps[i], top_ks[i] = True, s.temp, s.top_k
+            seeds[i], eos[i], max_new[i] = s.seed, s.eos, s.max_new
+        pt = jnp.asarray(self.cache.page_table_rows(B))
+        return tuple(jnp.asarray(a) for a in
+                     (pt, tokens, pos, gen, live, temps, top_ks, seeds,
+                      eos, max_new))
+
+    def _run_window(self):
+        from ..framework.executor import _deadline_call
+        self._windows += 1
+        _metrics.inc("serving.windows")
+        owner = 0x5E0 + self._id   # flight-recorder lane per engine
+        _flight.begin_step(self._windows, owner=owner)
+        status = "ok"
+        scales = self.scales if self.scales is not None else {}
+        args = self._window_args()
+        fid = _trace.new_flow()
+        t0 = time.perf_counter()
+
+        def dispatch_and_drain():
+            with _trace.RecordEvent(
+                    "serving.window",
+                    args={"window": self._windows,
+                          "active": len(self._slots)}):
+                _trace.flow_start("serving.window_fetch", fid)
+                k_pool, v_pool, toks, acts = self._window_jit(
+                    self.params, scales, self.cache.k_pool,
+                    self.cache.v_pool, *args)
+                self.cache.update_pools(k_pool, v_pool)
+                h = FetchHandle(toks, name="serving.window_tokens",
+                                flow=fid)
+                return h.numpy(), np.asarray(acts)
+
+        from ..framework import errors as _errors
+        deadline = float(flag("FLAGS_step_deadline_ms") or 0.0)
+        try:
+            if deadline > 0:
+                toks, acts = _deadline_call(
+                    dispatch_and_drain, deadline,
+                    f"serving window ({len(self._slots)} active slots)")
+            else:
+                toks, acts = dispatch_and_drain()
+        except _errors.DeadlineExceededError:
+            status = "sla_trip"
+            _metrics.inc("serving.sla_trips")
+            raise
+        except BaseException:
+            status = "error"
+            raise
+        finally:
+            _flight.end_step(self._windows, status=status, owner=owner)
+        _metrics.observe("serving.window_ms",
+                         (time.perf_counter() - t0) * 1000.0)
+        self._apply_window(toks, acts)
+
+    def _apply_window(self, toks: np.ndarray, acts: np.ndarray):
+        n_tokens = 0
+        for idx in list(self._slots):
+            slot = self._slots[idx]
+            emitted = []
+            finished = None
+            for t in range(toks.shape[0]):
+                if not acts[t, idx]:
+                    break
+                tok = int(toks[t, idx])
+                emitted.append(tok)
+                slot.gen += 1
+                slot.pos += 1
+                slot.token = tok
+                if tok == slot.eos:
+                    finished = "eos"
+                    break
+                if slot.gen >= slot.max_new:
+                    finished = "length"
+                    break
+            if emitted:
+                slot.handle._append_tokens(emitted)
+                n_tokens += len(emitted)
+            if finished is not None:
+                self.cache.release(idx)
+                del self._slots[idx]
+                self._retire(slot.handle, finished)
+        _metrics.inc("serving.tokens_out", n_tokens)
+        _metrics.set_gauge("serving.active_slots", len(self._slots))
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+    def stats(self) -> dict:
+        return {
+            "windows": self._windows,
+            "completed": self._completed,
+            "active_slots": len(self._slots),
+            "queued": len(self._queue),
+            "free_blocks": self.cache.allocator.free_blocks,
+            "dead": self._dead,
+        }
+
+    def window_abstract_args(self):
+        """ShapeDtypeStructs of one window call (serving/audit.py lowers
+        the window program from these without consuming real buffers)."""
+        import jax
+        import jax.numpy as jnp
+        B = self.config.max_slots
+        sds = jax.ShapeDtypeStruct
+        tree_sds = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda a: sds(a.shape, a.dtype), t)
+        pool = sds(self.cache.config.pool_shape(),
+                   jnp.dtype(self.compute_dtype))
+        mb = self.cache.config.max_blocks_per_slot
+        return (tree_sds(self.params),
+                tree_sds(self.scales if self.scales is not None else {}),
+                pool, pool,
+                sds((B, mb), jnp.int32), sds((B,), jnp.int32),
+                sds((B,), jnp.int32), sds((B,), jnp.int32),
+                sds((B,), jnp.bool_), sds((B,), jnp.float32),
+                sds((B,), jnp.int32), sds((B,), jnp.uint32),
+                sds((B,), jnp.int32), sds((B,), jnp.int32))

@@ -1,0 +1,152 @@
+"""Replicated serving: N decode engines behind a round-robin frontend.
+
+The reference scales AnalysisPredictor by Clone()-per-thread; the TPU
+analog replicates the whole decode worker — each replica owns its slot
+array and paged cache while SHARING the device-resident weights (params
+are read-only to every window program). `replicated_engines` builds the
+replicas from one prepared parameter set; `RoundRobinFrontend` spreads
+submissions, skipping dead replicas, so one SLA-tripped engine degrades
+capacity instead of availability.
+
+Process-scale composition reuses the PR-7 supervisor: `worker_main` is a
+launchable decode worker (heartbeat liveness, flight dumps, rank-sharded
+request files) that `python -m paddle_tpu.distributed.launch
+--nproc_per_node N scripts/serving_smoke.py --worker ...` hosts as a
+supervised gang — the deadline-bounded rendezvous, fail-fast sibling
+kill, and straggler naming all apply to serving workers exactly as to
+trainers.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+from typing import List, Optional
+
+from ..observability import metrics as _metrics
+from .engine import DecodeEngine, EngineConfig
+from .request import Request, RequestHandle
+
+
+def replicated_engines(n: int, params, model_config,
+                       config: Optional[EngineConfig] = None,
+                       **overrides) -> List[DecodeEngine]:
+    """N engines over ONE weight set (prepare_params runs once inside the
+    first engine; the rest adopt its device arrays, so replicas add cache
+    HBM, not weight HBM)."""
+    first = DecodeEngine(params, model_config, config=config, **overrides)
+    return [first] + [_clone_engine(first) for _ in range(n - 1)]
+
+
+def _clone_engine(src: DecodeEngine) -> DecodeEngine:
+    """A replica sharing src's prepared params/scales (device arrays are
+    immutable to the window program) with its own cache + scheduler."""
+    clone = DecodeEngine.__new__(DecodeEngine)
+    DecodeEngine.__init__(
+        clone, params={k: v for k, v in src.params.items()},
+        model_config=src.model_config, config=src.config)
+    # __init__ re-prepared from already-prepared arrays (idempotent for
+    # f32/bf16; int8 payloads pass through _quantizable=False), but adopt
+    # src's exact buffers so HBM holds ONE weight copy
+    clone.params = src.params
+    clone.scales = src.scales
+    return clone
+
+
+class RoundRobinFrontend:
+    """Spread requests over replicas; skip dead ones; aggregate stats."""
+
+    def __init__(self, engines: List[DecodeEngine]):
+        if not engines:
+            raise ValueError("no engines")
+        self.engines = list(engines)
+        self._rr = itertools.count()
+        self._lock = threading.Lock()
+
+    def submit(self, request: Request) -> RequestHandle:
+        n = len(self.engines)
+        with self._lock:
+            start = next(self._rr)
+        for probe in range(n):
+            eng = self.engines[(start + probe) % n]
+            if eng._dead is None:
+                _metrics.inc("serving.frontend_dispatch")
+                return eng.submit(request)
+        # every replica dead: let the first one mint the rejection handle
+        return self.engines[start % n].submit(request)
+
+    def generate(self, requests: List[Request], timeout: float = 300.0):
+        handles = [self.submit(r) for r in requests]
+        return [h.result(timeout=timeout, raise_on_error=False)
+                for h in handles]
+
+    def stop(self):
+        for e in self.engines:
+            e.stop()
+
+    def stats(self) -> dict:
+        per = [e.stats() for e in self.engines]
+        return {
+            "replicas": len(per),
+            "live": sum(1 for s in per if not s["dead"]),
+            "completed": sum(s["completed"] for s in per),
+            "windows": sum(s["windows"] for s in per),
+            "per_replica": per,
+        }
+
+
+# ---------------------------------------------------------------------------
+# supervised worker entry (distributed/launch.py gang member)
+# ---------------------------------------------------------------------------
+
+def worker_main(requests_path: str, out_dir: str,
+                model: str = "tiny", dtype: str = "float32",
+                max_slots: int = 4, max_len: int = 128,
+                window: int = 0) -> int:
+    """One supervised decode worker: build the tiny GPT from seed 0, take
+    the rank-th shard of the request file (JSONL: {"uid", "prompt",
+    "max_new", "temperature"?, "top_k"?, "seed"?}), serve it through a
+    DecodeEngine, write completions to <out_dir>/rank<r>.jsonl. Heartbeat
+    + flight-dump plumbing is inherited from the launcher env contract."""
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+    from ..models.gpt import GPTConfig, build_lm_program
+    from ..models.gpt_decode import params_from_scope
+    from ..testing import reset_programs
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1") or 1)
+    reset_programs(seed=0)
+    cfg = GPTConfig.tiny() if model == "tiny" else GPTConfig()
+    cfg.max_position = max(cfg.max_position, max_len)
+    build_lm_program(cfg)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    params = params_from_scope(cfg)
+
+    with open(requests_path) as f:
+        rows = [json.loads(ln) for ln in f if ln.strip()]
+    mine = [r for i, r in enumerate(rows) if i % world == rank]
+
+    out_path = os.path.join(out_dir, f"rank{rank}.jsonl")
+    os.makedirs(out_dir, exist_ok=True)
+    with DecodeEngine(params, cfg, max_slots=max_slots, max_len=max_len,
+                      window=window, dtype=dtype) as eng:
+        completions = eng.generate([
+            Request(prompt=np.asarray(r["prompt"], np.int32),
+                    max_new_tokens=int(r["max_new"]),
+                    temperature=float(r.get("temperature", 0.0)),
+                    top_k=int(r.get("top_k", 0)),
+                    seed=int(r.get("seed", 0)),
+                    uid=str(r.get("uid", f"r{rank}-{i}")))
+            for i, r in enumerate(mine)])
+        with open(out_path, "w") as f:
+            for c in completions:
+                f.write(json.dumps({
+                    "uid": c.uid, "state": c.state, "tokens": c.tokens,
+                    "finish_reason": c.finish_reason,
+                    "ttft_ms": c.ttft_ms, "tpot_ms": c.tpot_ms,
+                    "rank": rank}) + "\n")
+    bad = [c for c in completions if not c.ok]
+    return 1 if bad else 0

@@ -1,0 +1,165 @@
+"""C-API serving sessions: the bridge between the C ABI and the engine.
+
+Rebases inference/capi_bridge.py on the serving layer: `create` now mints
+a SESSION, and the session decides how requests execute —
+
+* a model dir exported with `export_decode_model` (its `__model__` meta
+  carries a "serving" stanza) gets an ENGINE-backed session: every C
+  `PD_PredictorRun` becomes a batch of serving Requests through the
+  shared continuous-batching DecodeEngine, so C consumers drive real
+  batched decode — clones share the engine the way AnalysisPredictor
+  clones share weights, and concurrent C threads' requests interleave in
+  the same slot array;
+* any other model dir gets the classic Predictor-backed session (the
+  feed-forward path), keeping the existing C/pthread contract intact.
+
+Both session kinds expose the same surface capi_bridge / native/capi.cc
+consume: get_input_names / get_output_names / clone / run_list.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .request import Request
+
+
+def export_decode_model(dirname: str, cfg, params: Dict,
+                        max_new_tokens: int = 16, max_slots: int = 4,
+                        max_len: int = 0, dtype: str = "float32",
+                        eos_token: Optional[int] = None) -> str:
+    """Save a decode-service model dir: `__model__` JSON whose meta names
+    the serving contract (feed "tokens" [B, Sp] -> fetch "generated"
+    [B, Sp + max_new_tokens]) plus params.npz of the decode parameter set
+    (models/gpt_decode.params_from_scope naming)."""
+    import dataclasses
+    os.makedirs(dirname, exist_ok=True)
+    payload = {
+        "program": None,
+        "meta": {
+            "feed": ["tokens"], "fetch": ["generated"],
+            "serving": {
+                "type": "gpt_decode",
+                "config": dataclasses.asdict(cfg),
+                "max_new_tokens": int(max_new_tokens),
+                "max_slots": int(max_slots),
+                "max_len": int(max_len),
+                "dtype": dtype,
+                "eos_token": eos_token,
+            },
+        },
+    }
+    with open(os.path.join(dirname, "__model__"), "w") as f:
+        json.dump(payload, f)
+    np.savez(os.path.join(dirname, "params.npz"),
+             **{k: np.asarray(v) for k, v in params.items()})
+    return dirname
+
+
+class PredictorSession:
+    """Feed-forward session over the XLA Predictor (the pre-existing
+    AnalysisPredictor path; clone() = weight-sharing predictor clone)."""
+
+    def __init__(self, predictor):
+        self._pred = predictor
+
+    def get_input_names(self):
+        return list(self._pred.get_input_names())
+
+    def get_output_names(self):
+        return list(self._pred.get_output_names())
+
+    def clone(self):
+        return PredictorSession(self._pred.clone())
+
+    def run_list(self, inputs: List[np.ndarray]) -> List[np.ndarray]:
+        for n, a in zip(self._pred.get_input_names(), inputs):
+            self._pred.get_input_handle(n).copy_from_cpu(a)
+        return [np.asarray(o) for o in self._pred.run()]
+
+
+class DecodeSession:
+    """Engine-backed session: one shared DecodeEngine per model load;
+    clones share it (a clone is a handle, not a second engine), so N C
+    threads' batches interleave through one slot array — the continuous-
+    batching contract surfaced through the C ABI."""
+
+    def __init__(self, model_dir: str, meta: dict, params: Dict,
+                 _shared_engine=None):
+        from ..models.gpt import GPTConfig
+        from .engine import DecodeEngine
+        self._meta = meta
+        srv = meta["serving"]
+        self._max_new = int(srv["max_new_tokens"])
+        self._eos = srv.get("eos_token")
+        if _shared_engine is not None:
+            self._engine = _shared_engine
+            return
+        cfg = GPTConfig(**srv["config"])
+        import jax.numpy as jnp
+        jparams = {k: jnp.asarray(v) for k, v in params.items()}
+        max_len = int(srv.get("max_len") or 0) or min(
+            cfg.max_position, 4 * max(self._max_new, 16))
+        self._engine = DecodeEngine(
+            jparams, cfg, max_slots=int(srv.get("max_slots", 4)),
+            max_len=max_len, dtype=srv.get("dtype", "float32"))
+
+    def get_input_names(self):
+        return list(self._meta["feed"])
+
+    def get_output_names(self):
+        return list(self._meta["fetch"])
+
+    def clone(self):
+        return DecodeSession(None, self._meta, None,
+                             _shared_engine=self._engine)
+
+    def stop(self):
+        self._engine.stop()
+
+    def run_list(self, inputs: List[np.ndarray]) -> List[np.ndarray]:
+        """tokens [B, Sp] int -> generated [B, Sp + max_new] int64: each
+        row is one Request; rows of a call are served concurrently (and
+        interleaved with other clones' rows) by the shared engine. Early-
+        eos rows are right-padded with the eos token, static-shape style."""
+        tokens = np.asarray(inputs[0])
+        if tokens.ndim == 1:
+            tokens = tokens[None]
+        b, sp = tokens.shape
+        handles = [self._engine.submit(Request(
+            prompt=tokens[i], max_new_tokens=self._max_new,
+            eos_token=self._eos)) for i in range(b)]
+        out = np.zeros((b, sp + self._max_new), np.int64)
+        out[:, :sp] = tokens
+        for i, h in enumerate(handles):
+            c = h.result(timeout=300.0)
+            gen = list(c.tokens)
+            pad = self._eos if self._eos is not None else (
+                gen[-1] if gen else 0)
+            gen = gen + [pad] * (self._max_new - len(gen))
+            out[i, sp:] = gen[:self._max_new]
+        return [out]
+
+
+def create_session(model_dir: str):
+    """The capi_bridge `create` implementation: engine-backed when the
+    saved meta asks for serving, Predictor-backed otherwise."""
+    model_path = os.path.join(model_dir, "__model__")
+    serving_meta = None
+    try:
+        with open(model_path) as f:
+            payload = json.load(f)
+        serving_meta = payload.get("meta", {}).get("serving")
+    except (OSError, ValueError):
+        payload = None
+    if serving_meta is not None:
+        params = {}
+        with np.load(os.path.join(model_dir, "params.npz")) as d:
+            for n in d.files:
+                params[n] = d[n]
+        return DecodeSession(model_dir, payload["meta"], params)
+    from ..inference import Config, Predictor
+    return PredictorSession(Predictor(Config(model_dir)))

@@ -1,0 +1,95 @@
+"""Serving weight paths: f32 / bf16 / int8(abs-max) parameter sets.
+
+Decode is weight-bandwidth-bound — every generated token reads every
+weight — so the serving engine offers three resident formats:
+
+* float32 — the parity/reference arm;
+* bfloat16 — `gpt_decode.params_from_scope(dtype="bfloat16")` semantics
+  (LN params stay f32; matmuls accumulate f32): half the HBM bytes;
+* int8 — per-tensor abs-max quantization of the 2-D matmul weights
+  (wte/wpe and every qkv/proj/ffn matrix), the
+  `dequantize_abs_max` scheme from ops/int8_ops.py: payload int8 + one
+  f32 scale per tensor, dequantized INSIDE the jitted window/prefill
+  programs through the registered op lowering (one implementation — the
+  serving path literally runs the op the static graph would). Resident
+  bytes drop ~4x vs f32; the dequant materializes per window, amortized
+  over the window's tokens.
+
+`dequant_params` is traced into the compiled programs, so an int8 engine's
+executable takes the quantized payloads as runtime args.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+
+from ..ops import registry
+
+# params quantized per-tensor when floating, 2-D+, and not layernorm
+_MAX_RANGE = 127.0
+
+
+def _quantizable(name: str, arr) -> bool:
+    return ("_ln" not in name and "ln_" not in name.split("/")[-1][:3]
+            and jnp.issubdtype(arr.dtype, jnp.floating)
+            and arr.ndim >= 2)
+
+
+def quantize_params(params: Dict[str, jnp.ndarray]) \
+        -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+    """-> (payloads, scales): payloads hold int8 for quantized tensors and
+    the original array otherwise; scales has one f32 abs-max per quantized
+    name (dequant = int8 * scale / 127, dequantize_abs_max_op.cc)."""
+    payloads, scales = {}, {}
+    for n, a in params.items():
+        if _quantizable(n, a):
+            a32 = a.astype(jnp.float32)
+            scale = jnp.maximum(jnp.max(jnp.abs(a32)), 1e-8)
+            q = jnp.clip(jnp.round(a32 / scale * _MAX_RANGE),
+                         -_MAX_RANGE, _MAX_RANGE).astype(jnp.int8)
+            payloads[n] = q
+            scales[n] = scale.astype(jnp.float32)
+        else:
+            payloads[n] = a
+    return payloads, scales
+
+
+def dequant_params(payloads: Dict[str, jnp.ndarray],
+                   scales: Dict[str, jnp.ndarray],
+                   compute_dtype=jnp.float32) -> Dict[str, jnp.ndarray]:
+    """Rebuild the dense parameter dict inside a jitted program via the
+    registered dequantize_abs_max lowering (ops/int8_ops.py)."""
+    deq = registry.get("dequantize_abs_max").lower
+    out = {}
+    for n, a in payloads.items():
+        if n in scales:
+            d = deq(None, {"X": [a], "Scale": [scales[n]]},
+                    {"max_range": _MAX_RANGE})["Out"][0]
+            out[n] = d.astype(compute_dtype)
+        else:
+            out[n] = a
+    return out
+
+
+def prepare_params(params: Dict[str, jnp.ndarray], dtype: str):
+    """-> (payloads, scales, compute_dtype). dtype: "float32" | "bfloat16"
+    | "int8" (int8 computes in bf16 — the dequant target that keeps the
+    matmul MXU-shaped; accumulation stays f32 via preferred_element_type
+    in the model body)."""
+    import jax
+    if dtype in ("float32", "bfloat16"):
+        cast = {}
+        for n, a in params.items():
+            if (dtype == "bfloat16" and "_ln" not in n
+                    and jnp.issubdtype(a.dtype, jnp.floating)):
+                a = a.astype(jnp.bfloat16)
+            cast[n] = jax.device_put(a)
+        return cast, None, jnp.dtype(dtype)
+    if dtype != "int8":
+        raise ValueError(f"serving dtype {dtype!r} not in "
+                         "(float32, bfloat16, int8)")
+    payloads, scales = quantize_params(params)
+    payloads = {n: jax.device_put(a) for n, a in payloads.items()}
+    scales = {n: jax.device_put(a) for n, a in scales.items()}
+    return payloads, scales, jnp.dtype(jnp.bfloat16)

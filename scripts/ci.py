@@ -46,6 +46,7 @@ WEIGHTS = {
     "test_sequence_tail_ops.py": 20, "test_control_flow.py": 20,
     "test_backward_and_optimizers.py": 20, "test_lr_and_optimizers.py": 20,
     "test_dynamic_rnn.py": 20, "test_capi_serving.py": 20,
+    "test_serving.py": 40, "test_paged_ops.py": 10,
 }
 
 
@@ -386,6 +387,40 @@ def collect_preemption_drill(proc, timeout=1500) -> bool:
     return proc.returncode == 0
 
 
+# Serving smoke (ISSUE-14 CI satellite): scripts/serving_smoke.py — boot
+# the continuous-batching decode engine, stream 32 concurrent requests
+# with staggered arrivals and mixed lengths/sampling, assert all complete,
+# TTFT histogram non-empty, ZERO per-token KV-cache copies via the
+# compiled-HLO census (serving/audit.py) and zero findings on the static
+# donation twin — plus the supervised 2-worker decode gang
+# (launch.py-hosted). Overlapped with the shards (--no-serving-smoke).
+def start_serving_smoke(env):
+    script = os.path.join(ROOT, "scripts", "serving_smoke.py")
+    child_env = dict(env)
+    child_env["PADDLE_TPU_AUDIT_CHILD"] = "1"  # env already is the CPU mesh
+    return subprocess.Popen(
+        [sys.executable, script, "--supervised"],
+        cwd=ROOT, env=child_env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+
+
+def collect_serving_smoke(proc, timeout=1200) -> bool:
+    try:
+        out_s, err_s = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        print(f"[serving-smoke] FAIL timed out after {timeout}s")
+        return False
+    lines = (out_s or "").strip().splitlines()
+    status = "OK " if proc.returncode == 0 else "FAIL"
+    body = "\n".join("    " + ln for ln in lines[-6:])
+    tail = (err_s or "").strip().splitlines()[-25:]
+    print(f"[serving-smoke] {status}\n{body}" + (
+        "\n" + "\n".join(tail) if proc.returncode != 0 else ""))
+    return proc.returncode == 0
+
+
 def shard(files, n):
     """LPT bin packing by weight."""
     bins = [(0.0, []) for _ in range(n)]
@@ -423,6 +458,11 @@ def main():
                     help="skip the static sharding/plan lint sweep "
                          "(scripts/program_lint.py --sharding --assert "
                          "--assert-coverage)")
+    ap.add_argument("--no-serving-smoke", action="store_true",
+                    help="skip the serving smoke (continuous-batching "
+                         "engine + 32 streamed requests + KV copy census "
+                         "+ supervised decode gang, "
+                         "scripts/serving_smoke.py)")
     ap.add_argument("--no-pod-trace", action="store_true",
                     help="skip the pod-trace smoke (2-process supervised "
                          "gang -> merged timeline + straggler report, "
@@ -457,6 +497,9 @@ def main():
     pod_proc = None
     if not args.no_pod_trace:
         pod_proc = start_pod_trace_smoke(env)      # overlaps the shards too
+    serving_proc = None
+    if not args.no_serving_smoke:
+        serving_proc = start_serving_smoke(env)    # overlaps the shards too
 
     files = sorted(glob.glob(os.path.join(ROOT, "tests", "test_*.py")))
     shards = shard(files, args.n)
@@ -512,6 +555,8 @@ def main():
         failed = failed or not collect_sharding_lint(shard_lint_proc)
     if pod_proc is not None:
         failed = failed or not collect_pod_trace_smoke(pod_proc)
+    if serving_proc is not None:
+        failed = failed or not collect_serving_smoke(serving_proc)
     print(f"CI total: {time.time() - t0:.0f}s over {len(shards)} shards -> "
           f"{'FAILED' if failed else 'PASSED'}")
     return 1 if failed else 0

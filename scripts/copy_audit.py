@@ -220,6 +220,34 @@ def build_and_census(layers, hidden, heads, ffn, batch, seq, vocab,
     return counts, byte_tot, per_step, total, n_instr
 
 
+def serving_census(max_slots=4, block_size=8, num_blocks=64, max_len=64,
+                   window=8, dtype="float32"):
+    """Census of the serving decode-window program (the paged-KV analog of
+    the train-step census): build the tiny-GPT decode engine
+    (paddle_tpu/serving/), AOT-compile its window program, and count
+    pool-shaped copies — the HLO signature of a failed cache donation.
+    Zero is the acceptance bar (serving/audit.py); the full copy
+    population is reported for context."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models.gpt import GPTConfig, build_lm_program
+    from paddle_tpu.models.gpt_decode import params_from_scope
+    from paddle_tpu.serving import DecodeEngine
+    from paddle_tpu.serving import audit
+    from paddle_tpu.testing import reset_programs
+
+    reset_programs(seed=0)
+    cfg = GPTConfig.tiny()
+    cfg.max_position = max(cfg.max_position, max_len)
+    build_lm_program(cfg)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    engine = DecodeEngine(params_from_scope(cfg), cfg,
+                          max_slots=max_slots, block_size=block_size,
+                          num_blocks=num_blocks, max_len=max_len,
+                          window=window, dtype=dtype)
+    return audit.decode_copy_census(engine)
+
+
 def _fmt_row(tag, counts, byte_tot, per_step, total, n_instr):
     parts = ", ".join(f"{c} x{counts[c]} ({byte_tot[c] / 1e3:.1f} KB)"
                       for c in sorted(counts)) or "none"
@@ -245,6 +273,10 @@ def main():
     ap.add_argument("--bench", action="store_true",
                     help="audit the full bench geometry (BERT-base 12L/768H"
                          " batch 128 seq 128) — minutes of CPU XLA compile")
+    ap.add_argument("--serving", action="store_true",
+                    help="census the serving decode-window program instead "
+                         "(paddle_tpu/serving/): exit 1 if any pool-shaped "
+                         "copy — a per-token KV-cache copy — survives")
     args = ap.parse_args()
 
     # axon hosts pin the TPU backend at interpreter start: re-exec once into
@@ -259,6 +291,20 @@ def main():
                 [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
                 cwd=ROOT, env=env, timeout=3600)
             sys.exit(proc.returncode)
+
+    if args.serving:
+        row = serving_census()
+        pop = ", ".join(f"{k} x{v}" for k, v in
+                        sorted(row["copy_population"].items()) if v) \
+            or "none"
+        print(f"serving decode window (W={row['window']}, pool "
+              f"{row['pool_shape']}): per-token KV copies "
+              f"{row['per_token_kv_copies']} of {row['instructions']} "
+              f"instrs; copy population: {pop}")
+        for f in row["kv_copy_findings"]:
+            print(f"  KV COPY: {f['kind']} {f['instruction']} "
+                  f"{f['dims']}")
+        sys.exit(1 if row["per_token_kv_copies"] else 0)
 
     if args.bench:
         geo = dict(layers=12, hidden=768, heads=12, ffn=3072,

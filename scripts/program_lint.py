@@ -190,6 +190,19 @@ def build_wide_deep():
     return main, startup, _data_names(main), [loss.name, auc.name]
 
 
+def build_serving_decode():
+    """The serving decode step as a static program (the zero-copy twin of
+    paddle_tpu/serving/engine.py): paged_cache_update writes the donated
+    pools in place, paged_attention reads them — the donation analysis
+    must classify the pools as donated written state with NO
+    fetch_of_donated / write_after_donate hazard."""
+    from paddle_tpu.serving.program import build_decode_step_program
+    _fresh()
+    feed_names, fetch_names = build_decode_step_program()
+    main, startup = _programs()
+    return main, startup, feed_names, fetch_names
+
+
 ZOO = [
     ("linreg_sgd", build_linreg_sgd),
     ("mlp_recompute", build_mlp_recompute),
@@ -203,6 +216,7 @@ ZOO = [
                                              zero_stage=3)),
     ("gpt_tiny", build_gpt_tiny),
     ("wide_deep_ctr", build_wide_deep),
+    ("serving_decode", build_serving_decode),
 ]
 
 
