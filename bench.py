@@ -549,6 +549,98 @@ def bench_serving(streams_levels=(1, 8, 32), dtypes=("bfloat16",),
     return rows
 
 
+def bench_serving_degraded(streams=16, dtype="bfloat16", prompt_len=64,
+                           new_tokens=64, model="small", replicas=2):
+    """Degraded-capacity serving (ISSUE-15): N replicas behind the
+    resilient frontend, ONE killed mid-run — the row records the
+    throughput + tail-TTFT the service sustains while failover re-routes
+    the victim's in-flight requests and the survivors absorb the load.
+    The resilience contract rides the number: every request must still
+    complete (failover is bit-lossless), so a row with failed_requests
+    is a regression, not a slow day."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import gpt
+    from paddle_tpu.models.gpt_decode import params_from_scope
+    from paddle_tpu.observability import metrics as _obs_metrics
+    from paddle_tpu.serving import (Request, ServingFrontend,
+                                    replicated_engines)
+
+    _log(f"serving-degraded: model={model}, replicas={replicas} (1 killed "
+         f"mid-run), streams={streams}, dtype={dtype}")
+    _fresh_programs()
+    cfg = gpt.GPTConfig.tiny() if model == "tiny" else gpt.GPTConfig()
+    cfg.seq_len = prompt_len
+    cfg.max_position = max(cfg.max_position, prompt_len + new_tokens)
+    gpt.build_lm_program(cfg)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    params = params_from_scope(cfg)
+
+    block_size = int(os.environ.get("BENCH_SERVING_BLOCK", "16"))
+    max_len = prompt_len + new_tokens
+    if max_len % block_size:
+        max_len += block_size - max_len % block_size
+    per_slot = max_len // block_size
+    slots = max(streams // replicas, 1)
+    engines = replicated_engines(
+        replicas, params, cfg, max_slots=slots, block_size=block_size,
+        num_blocks=slots * per_slot + 1, max_len=max_len,
+        window=int(os.environ.get("BENCH_SERVING_WINDOW", "16")),
+        dtype=dtype)
+    # resurrect=False: the row measures capacity WITHOUT the dead replica
+    # for the whole run — a mid-measurement rejoin would blur the arm
+    fe = ServingFrontend(engines, resurrect=False)
+    rng = np.random.RandomState(0)
+    # warm every replica's prefill+window compile before the timed run
+    for eng in engines:
+        eng.generate([Request(
+            prompt=rng.randint(0, cfg.vocab_size, (prompt_len,)),
+            max_new_tokens=2)], timeout=600)
+    for name in ("serving.ttft_ms", "serving.failovers"):
+        _obs_metrics.reset(name)
+    reqs = [Request(prompt=rng.randint(0, cfg.vocab_size, (prompt_len,)),
+                    max_new_tokens=new_tokens, seed=i)
+            for i in range(streams)]
+    t0 = time.perf_counter()
+    handles = [fe.submit(r) for r in reqs]
+    victim = engines[-1]
+    # kill once the victim is mid-decode; bail out early if the whole
+    # stream finishes first (tiny runs) — otherwise an idle victim would
+    # hold the timed region open for the full poll deadline and record a
+    # garbage near-zero throughput row
+    kill_deadline = time.monotonic() + 30
+    while (victim.stats()["active_slots"] == 0
+           and not all(h.done() for h in handles)
+           and time.monotonic() < kill_deadline):
+        time.sleep(0.005)
+    victim.kill("bench: injected replica kill")
+    comps = [h.result(timeout=1200, raise_on_error=False)
+             for h in handles]
+    dt = time.perf_counter() - t0
+    fe.stop()
+    n_tok = sum(len(c.tokens) for c in comps)
+    bad = sum(not c.ok for c in comps)
+    snap = _obs_metrics.snapshot()
+    ttft = snap.get("serving.ttft_ms", {})
+    row = {
+        "metric": "serving_degraded_tokens_per_sec",
+        "value": round(n_tok / dt, 1), "unit": "tokens/s",
+        "serving_degraded_arm": True,
+        "replicas": replicas, "replicas_killed": 1,
+        "streams": streams, "dtype": dtype,
+        "prompt_len": prompt_len, "new_tokens": new_tokens,
+        "ttft_p99_ms": (round(ttft["p99"], 2)
+                        if ttft.get("p99") is not None else None),
+        "failovers": int(_obs_metrics.get("serving.failovers")),
+    }
+    if bad:
+        row["failed_requests"] = bad
+    _log(f"serving-degraded[{dtype}] {replicas - 1}/{replicas} replicas: "
+         f"{row['value']} tok/s, TTFT p99={row['ttft_p99_ms']} ms, "
+         f"{row['failovers']} failover(s), {bad} failed")
+    return row
+
+
 def bench_resnet50(batch, steps):
     import paddle_tpu as paddle
     import paddle_tpu.fluid as fluid
@@ -1120,6 +1212,27 @@ def main():
         except Exception as e:  # pragma: no cover
             print(f"serving bench failed: {e!r}", file=sys.stderr)
             errors.append(f"serving: {e!r}")
+        if os.environ.get("BENCH_SERVING_DEGRADED", "1") != "0":
+            try:
+                # degraded-capacity row (ISSUE-15): 1 of N replicas killed
+                # mid-run; failover must keep failed_requests at 0 while
+                # the row records what the survivors sustain
+                extras.append(bench_serving_degraded(
+                    streams=int(os.environ.get(
+                        "BENCH_SERVING_DEGRADED_STREAMS", "16")),
+                    dtype=os.environ.get("BENCH_SERVING_DTYPES",
+                                         "bfloat16,int8").split(",")[0],
+                    prompt_len=int(os.environ.get("BENCH_SERVING_PROMPT",
+                                                  "64")),
+                    new_tokens=int(os.environ.get("BENCH_SERVING_NEW",
+                                                  "64")),
+                    model=os.environ.get("BENCH_SERVING_MODEL", "small"),
+                    replicas=int(os.environ.get(
+                        "BENCH_SERVING_REPLICAS", "2"))))
+            except Exception as e:  # pragma: no cover
+                print(f"serving-degraded bench failed: {e!r}",
+                      file=sys.stderr)
+                errors.append(f"serving-degraded: {e!r}")
     if tokens_per_sec is not None and which in ("all", "resnet") \
             and _row_ok("resnet"):
         try:

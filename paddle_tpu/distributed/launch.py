@@ -26,8 +26,11 @@ SUPERVISOR — trainer loss is a first-class event (ROADMAP item 5):
   hung worker (SIGSTOP'd, OOM-thrashing) and fails it.
 * **Fail-fast sibling kill**: one worker exiting non-zero (or hanging)
   kills every sibling — SIGTERM first, so `PreemptionGuard` trainers write
-  a final checkpoint, SIGKILL past `--grace_period_s`. A dead peer must
-  never leave survivors blocked in a collective that cannot complete.
+  a final checkpoint and serving workers drain gracefully (finish
+  in-flight decode, hand back the unstarted queue — the exported
+  `PADDLE_LAUNCH_GRACE_S` tells them their budget), SIGKILL past
+  `--grace_period_s`. A dead peer must never leave survivors blocked in a
+  collective that cannot complete.
 * **Bounded elastic restart** (`--elastic_restarts N`): after a failure
   the gang relaunches at the SURVIVING world size (with
   `PADDLE_ELASTIC_RESTART` incremented), at most N times. Resuming from
@@ -262,6 +265,12 @@ class GangSupervisor:
                 "PADDLE_LAUNCH_HEARTBEAT_FILE": hb_files[rank],
                 "PADDLE_LAUNCH_HEARTBEAT_INTERVAL_S":
                     str(self.heartbeat_interval_s),
+                # the SIGTERM-to-SIGKILL grace, exported so workers can
+                # bound their own graceful teardown inside it: a serving
+                # worker drains (finish in-flight decode, hand back the
+                # unstarted queue — serving/resilience.py), a trainer
+                # writes its final PreemptionGuard checkpoint
+                "PADDLE_LAUNCH_GRACE_S": str(self.grace_period_s),
                 "PADDLE_ELASTIC_RESTART": str(restart_idx),
                 # pod-scope contract: every rank dumps into the gang's
                 # shared dir (rank-tagged filenames), so --collect-dumps

@@ -130,6 +130,42 @@ _DEFS: Dict[str, tuple] = {
                                  "blocks via its page-table row; smaller "
                                  "= less fragmentation, larger = smaller "
                                  "page tables and fewer scatter targets"),
+    "FLAGS_serving_max_queue": (256, "submit-queue bound per decode "
+                                "engine (admission control): a submit "
+                                "past it is SHED with typed reason "
+                                "queue_full instead of queueing toward "
+                                "an unmeetable deadline "
+                                "(serving/engine.py, counted in "
+                                "serving.shed_total / "
+                                "serving.shed.queue_full)"),
+    "FLAGS_serving_failover_budget": (2, "re-dispatches a single request "
+                                     "may consume after engine deaths "
+                                     "before it fails with the typed "
+                                     "RequestFailedError "
+                                     "(serving/resilience.py; each "
+                                     "re-dispatch replays the "
+                                     "deterministic decode bit-"
+                                     "identically on a healthy replica)"),
+    "FLAGS_serving_health_interval_ms": (200.0, "ServingFrontend health-"
+                                         "loop tick: suspect engines are "
+                                         "confirmed dead and dead "
+                                         "engines resurrected (cache "
+                                         "rebuild + canary gate) at "
+                                         "this cadence"),
+    "FLAGS_serving_resurrect_budget": (3, "canary-gated resurrection "
+                                      "attempts per engine death "
+                                      "(RetryPolicy max_attempts); "
+                                      "exhaustion parks the engine dead "
+                                      "permanently (serving."
+                                      "resurrect_gave_up)"),
+    "FLAGS_serving_drain_timeout_ms": (30000.0, "graceful-drain bound: "
+                                       "how long drain() waits for in-"
+                                       "flight slots to decode to "
+                                       "completion before stopping the "
+                                       "engine anyway (the launch.py "
+                                       "SIGTERM grace usually bounds it "
+                                       "tighter via PADDLE_LAUNCH_"
+                                       "GRACE_S)"),
     # --- resilience tier (resilience/, docs/resilience.md) ---------------
     "FLAGS_fault_plan": ("", "fault-injection plan spec, e.g. "
                              "'kv.pull:error:every=3;ckpt.write:kill:at=2'"),

@@ -26,6 +26,14 @@ Known sites (grep fault_point for ground truth):
     dataloader.worker                     per-batch, inside worker process
     ckpt.write                            before a checkpoint publishes
     hdfs.run                              every hadoop shell-out
+    serving.window                        before each decode-window
+                                          dispatch — an error here kills
+                                          the engine (the failover drill's
+                                          replica-kill site)
+    serving.prefill                       per admission, inside the
+                                          per-request isolation boundary
+    serving.admit                         at submit; an error sheds the
+                                          request (reason admit_fault)
 """
 from __future__ import annotations
 

@@ -84,10 +84,17 @@ class RetryPolicy:
         u = _hash01(self.seed, "backoff", attempt)
         return max(0.0, d * (1.0 + self.jitter * (2.0 * u - 1.0)))
 
-    def call(self, fn: Callable, *args, site: str = "?", **kwargs):
+    def call(self, fn: Callable, *args, site: str = "?",
+             abort: Optional[Callable[[], bool]] = None, **kwargs):
         """Run fn(*args, **kwargs), retrying transient failures under the
         policy. Raises DeadlineExceededError (chaining the last real error)
-        on exhaustion; non-retryable exceptions propagate untouched."""
+        on exhaustion; non-retryable exceptions propagate untouched.
+
+        `abort` (optional) is polled between attempts AND during backoff
+        sleeps (chunked): when it returns True the policy stops retrying
+        immediately and raises DeadlineExceededError noting the abort —
+        so a long backoff ladder (e.g. serving-engine resurrection) can
+        be cancelled by a shutting-down owner instead of outliving it."""
         start = time.monotonic()
         attempt = 0
         while True:
@@ -102,7 +109,8 @@ class RetryPolicy:
                                    and attempt >= self.max_attempts)
                 out_of_time = (self.deadline_s is not None
                                and elapsed >= self.deadline_s)
-                if out_of_attempts or out_of_time:
+                aborted = abort is not None and abort()
+                if out_of_attempts or out_of_time or aborted:
                     stat_add("resilience.gave_up")
                     _trace.instant("retry_gave_up",
                                    args={"site": site, "attempts": attempt},
@@ -110,7 +118,8 @@ class RetryPolicy:
                     raise DeadlineExceeded(
                         "%s: gave up after %d attempt(s) / %.2fs (%s); "
                         "last error: %r", site, attempt, elapsed,
-                        "deadline" if out_of_time else "max_attempts",
+                        "aborted" if aborted else
+                        ("deadline" if out_of_time else "max_attempts"),
                         e) from e
                 stat_add("resilience.retries")
                 _trace.instant("retry", args={"site": site,
@@ -121,7 +130,29 @@ class RetryPolicy:
                     delay = min(delay,
                                 max(0.0, self.deadline_s - elapsed))
                 if delay > 0:
-                    self._sleep(delay)
+                    if abort is None:
+                        self._sleep(delay)
+                    else:
+                        end = time.monotonic() + delay
+                        while True:
+                            if abort():
+                                # same telemetry as the attempt-boundary
+                                # exhaustion path: a give-up is a give-up
+                                # wherever in the sleep the abort landed
+                                stat_add("resilience.gave_up")
+                                _trace.instant(
+                                    "retry_gave_up",
+                                    args={"site": site,
+                                          "attempts": attempt},
+                                    cat="resilience")
+                                raise DeadlineExceeded(
+                                    "%s: aborted during backoff after %d "
+                                    "attempt(s); last error: %r",
+                                    site, attempt, e) from e
+                            remaining = end - time.monotonic()
+                            if remaining <= 0:
+                                break
+                            self._sleep(min(0.05, remaining))
 
     def wrap(self, fn: Callable, site: str = "?") -> Callable:
         def wrapped(*args, **kwargs):

@@ -22,15 +22,17 @@ admit->prefill->first-token->retire flow events and TTFT/TPOT histograms
 through observability/, and distributed/launch.py supervises replicated
 decode workers behind the round-robin frontend (serving/frontend.py).
 """
-from .request import (Completion, Request, RequestHandle, RequestState,
-                      ServingError)
+from .request import (Completion, Request, RequestFailedError,
+                      RequestHandle, RequestState, ServingError, ShedError)
 from .cache import BlockAllocator, CacheConfig, PagedKVCache
+from .resilience import Health, NoHealthyReplicaError, ServingFrontend
 from .engine import DecodeEngine, EngineConfig
 from .frontend import RoundRobinFrontend, replicated_engines
 
 __all__ = [
     "BlockAllocator", "CacheConfig", "Completion", "DecodeEngine",
-    "EngineConfig", "PagedKVCache", "Request", "RequestHandle",
-    "RequestState", "RoundRobinFrontend", "ServingError",
+    "EngineConfig", "Health", "NoHealthyReplicaError", "PagedKVCache",
+    "Request", "RequestFailedError", "RequestHandle", "RequestState",
+    "RoundRobinFrontend", "ServingError", "ServingFrontend", "ShedError",
     "replicated_engines",
 ]

@@ -56,8 +56,10 @@ from ..observability import flight as _flight
 from ..observability import metrics as _metrics
 from ..observability import trace as _trace
 from ..ops.paged_ops import paged_gather, paged_update
+from ..resilience.faults import FaultInjected, fault_point
 from .cache import CacheConfig, PagedKVCache
 from .request import Completion, Request, RequestHandle, RequestState
+from .resilience import Health, shed_handle
 from .weights import dequant_params, prepare_params
 
 _engine_ids = itertools.count(1)
@@ -75,6 +77,8 @@ class EngineConfig:
     max_len: int = 128          # per-request prompt + generation budget
     window: int = 0
     dtype: str = "float32"      # "float32" | "bfloat16" | "int8"
+    max_queue: int = 0          # submit-queue bound (admission control);
+                                # 0 = FLAGS_serving_max_queue
     # set by resolve(): the pre-rounding budget the caller asked for (the
     # max_position guard compares THIS, so re-resolving an already-rounded
     # config — engine clones — never trips it on rounding slack)
@@ -88,6 +92,8 @@ class EngineConfig:
             c.block_size = int(flag("FLAGS_serving_block_size"))
         if not c.window:
             c.window = int(flag("FLAGS_serving_window"))
+        if not c.max_queue:
+            c.max_queue = int(flag("FLAGS_serving_max_queue"))
         if c.max_len % c.block_size:
             c.max_len += c.block_size - c.max_len % c.block_size
         return c
@@ -116,9 +122,9 @@ class DecodeEngine:
     admission with decode windows."""
 
     def __init__(self, params: Dict, model_config: GPTConfig,
-                 config: Optional[EngineConfig] = None, **overrides):
+                 config: Optional[EngineConfig] = None,
+                 _prepared: Optional[tuple] = None, **overrides):
         import jax
-        import jax.numpy as jnp
         self.model_config = model_config
         if config is not None and overrides:
             raise ValueError("pass EngineConfig or overrides, not both")
@@ -138,15 +144,17 @@ class DecodeEngine:
         # per-request prompt+generation ceiling: every live position must
         # have a real wpe row
         self.request_budget = min(cfg.max_len, model_config.max_position)
-        self.params, self.scales, self.compute_dtype = prepare_params(
-            params, cfg.dtype)
-        nh = model_config.num_heads
-        hd = model_config.hidden_size // nh
-        self.cache = PagedKVCache(CacheConfig(
-            num_layers=model_config.num_layers, num_heads=nh, head_dim=hd,
-            block_size=cfg.block_size, num_blocks=cfg.num_blocks,
-            max_blocks_per_slot=cfg.max_len // cfg.block_size,
-            dtype=str(jnp.dtype(self.compute_dtype))))
+        if _prepared is not None:
+            # replica path (frontend._clone_engine): adopt the source
+            # engine's ALREADY-PREPARED device arrays verbatim — running
+            # prepare_params again would stage a second weight copy in HBM
+            # just to throw it away (one-weight-copy invariant, pinned by
+            # tests/test_serving_resilience.py)
+            self.params, self.scales, self.compute_dtype = _prepared
+        else:
+            self.params, self.scales, self.compute_dtype = prepare_params(
+                params, cfg.dtype)
+        self.cache = self._build_cache()
         # prompt buckets: block-aligned, doubling up to the bucket cap
         # (each bucket is one prefill compile; serving loops stay hot
         # because real prompt lengths collapse onto few buckets). The cap
@@ -166,16 +174,45 @@ class DecodeEngine:
 
         self._id = next(_engine_ids)
         self._queue: "List[tuple]" = []
+        self._admitting: Optional[tuple] = None   # popped, not yet slotted
         self._slots: Dict[int, _Slot] = {}
         self._cv = threading.Condition()
         self._thread: Optional[threading.Thread] = None
         self._stop = False
         self._dead: Optional[str] = None
+        self._kill: Optional[str] = None
+        self._draining = False
         self._windows = 0
         self._completed = 0
+        self._window_ms_ewma: Optional[float] = None
+        # health + failover (serving/resilience.py): a ServingFrontend
+        # installs its failover sink here; standalone engines keep the
+        # fail-hard semantics (sink is None)
+        self.health = Health.LIVE
+        self.health_history: List[str] = [Health.LIVE]
+        self._failover = None
         self._prefill_jits: Dict[int, object] = {}
         self._write_jits: Dict[int, object] = {}
         self._window_jit = jax.jit(self._window_fn, donate_argnums=(2, 3))
+
+    def _build_cache(self) -> PagedKVCache:
+        import jax.numpy as jnp
+        mc, cfg = self.model_config, self.config
+        nh = mc.num_heads
+        return PagedKVCache(CacheConfig(
+            num_layers=mc.num_layers, num_heads=nh,
+            head_dim=mc.hidden_size // nh,
+            block_size=cfg.block_size, num_blocks=cfg.num_blocks,
+            max_blocks_per_slot=cfg.max_len // cfg.block_size,
+            dtype=str(jnp.dtype(self.compute_dtype))))
+
+    def _set_health(self, state: str):
+        if state != self.health:
+            self.health = state
+            self.health_history.append(state)
+            del self.health_history[:-64]   # bounded: weeks of uptime
+            _trace.instant("serving.health",
+                           args={"engine": self._id, "state": state})
 
     # ------------------------------------------------------------------
     # compiled programs
@@ -318,31 +355,136 @@ class DecodeEngine:
     # ------------------------------------------------------------------
     # submission API
     # ------------------------------------------------------------------
-    def submit(self, request: Request) -> RequestHandle:
+    def submit(self, request: Request, _handle: Optional[RequestHandle]
+               = None, _failover: bool = False, _probe: bool = False,
+               bounded: bool = True) -> Optional[RequestHandle]:
+        """Admit or reject a request. The shed taxonomy (docs/serving.md
+        "Failure semantics") is typed: overload rejections finish the
+        handle with `shed:<reason>` (result() raises ShedError) and count
+        `serving.shed_total` + `serving.shed.<reason>`.
+
+        `bounded=False` skips the OVERLOAD sheds (queue_full /
+        deadline_unmeetable) while keeping validation and funding checks:
+        batch-style callers (`generate`, the C-API decode session) submit
+        a known, finite workload all at once and rely on FCFS queueing —
+        admission control is for open-ended online traffic.
+
+        `_failover=True` is the resilience re-dispatch path: the handle
+        is mid-flight work already admitted elsewhere, so admission
+        control is bypassed — a dead/draining engine returns None (handle
+        untouched) and the caller tries the next replica. `_probe=True`
+        (the frontend's routing path) likewise returns None on a
+        dead/draining engine instead of minting a shed handle, so a
+        routing retry that succeeds elsewhere does not pollute the shed
+        counters."""
+        if _failover:
+            if self._dead is not None or self._draining or self._stop:
+                return None
+            with self._cv:
+                entry = (request, _handle)
+                self._queue.append(entry)
+                _metrics.set_gauge("serving.queue_depth", len(self._queue))
+                self._ensure_thread()
+                self._cv.notify_all()
+            if (self._dead is not None or self._draining or self._stop) \
+                    and self._unqueue(entry):
+                return None     # died/drained between check and append
+            return _handle
+        if _probe and (self._dead is not None or self._draining
+                       or self._stop):
+            return None
         fid = _trace.new_flow()
         handle = RequestHandle(request, flow_id=fid)
         _metrics.inc("serving.requests")
+        if self._dead:
+            return self._shed(handle, "engine_dead",
+                              f"engine dead: {self._dead}")
+        if self._draining:
+            return self._shed(handle, "draining", "engine draining")
         reason = self._reject_reason(request)
         if reason is not None:
             _metrics.inc("serving.rejected")
             handle._finish(RequestState.REJECTED, reason)
             return handle
+        # a budget the pool could NEVER fund must shed now, not park at
+        # the FCFS head forever wedging every request behind it
+        plen = int(request.prompt.shape[0])
+        usable = self.config.num_blocks - 1
+        need = self._block_budget(plen, request.max_new_tokens)
+        if need > usable:
+            return self._shed(
+                handle, "unfundable",
+                f"request needs {need} cache blocks but the pool has "
+                f"only {usable} (num_blocks={self.config.num_blocks} "
+                "incl. scratch)")
+        if bounded:
+            with self._cv:
+                depth = len(self._queue)
+            if depth >= self.config.max_queue:
+                return self._shed(
+                    handle, "queue_full",
+                    f"submit queue at its bound "
+                    f"({self.config.max_queue})")
+            if request.deadline_ms is not None:
+                est = self.queue_wait_estimate_ms()
+                if est > request.deadline_ms:
+                    return self._shed(
+                        handle, "deadline_unmeetable",
+                        f"estimated queue wait {est:.0f} ms exceeds "
+                        f"request deadline {request.deadline_ms:.0f} ms")
+        try:
+            fault_point("serving.admit")
+        except FaultInjected as e:
+            return self._shed(handle, "admit_fault", repr(e))
         _trace.flow_start("serving.request", fid,
                           args={"uid": request.uid})
         with self._cv:
-            self._queue.append((request, handle))
+            entry = (request, handle)
+            self._queue.append(entry)
             _metrics.set_gauge("serving.queue_depth", len(self._queue))
             self._ensure_thread()
             self._cv.notify_all()
+        if (self._dead is not None or self._draining or self._stop) \
+                and self._unqueue(entry):
+            # the engine died/drained/stopped between the liveness checks
+            # and the append: the fail/drain snapshot missed this entry,
+            # so it would strand unfinished in a dead queue. A _probe
+            # caller (frontend routing) gets None so it retries a healthy
+            # sibling; a direct caller gets the typed shed
+            if _probe:
+                return None
+            reason = "engine_dead" if self._dead is not None \
+                else "draining"
+            return self._shed(handle, reason,
+                              f"engine {reason.replace('_', ' ')} during "
+                              f"submit: {self._dead or 'draining'}")
         return handle
+
+    def _unqueue(self, entry) -> bool:
+        """Remove a just-appended queue entry if it is still there (False
+        means the service/fail path already claimed it). Matches by
+        IDENTITY: `list.remove` would `==`-compare earlier entries, and
+        Request carries an ndarray whose ambiguous truth value raises."""
+        with self._cv:
+            for i, e in enumerate(self._queue):
+                if e is entry:
+                    del self._queue[i]
+                    _metrics.set_gauge("serving.queue_depth",
+                                       len(self._queue))
+                    return True
+            return False
+
+    def _shed(self, handle: RequestHandle, reason: str,
+              detail: str) -> RequestHandle:
+        return shed_handle(handle, reason, detail)
 
     def _block_budget(self, plen: int, max_new: int) -> int:
         bs = self.config.block_size
         return max(self._bucket_for(plen) // bs, -(-(plen + max_new) // bs))
 
     def _reject_reason(self, req: Request) -> Optional[str]:
-        if self._dead:
-            return f"engine dead: {self._dead}"
+        """Validation-only rejects (malformed requests); capacity-driven
+        rejections go through the shed taxonomy instead."""
         plen = int(req.prompt.shape[0])
         if plen < 1:
             return "empty prompt"
@@ -359,20 +501,41 @@ class DecodeEngine:
         if plen > self.buckets[-1]:
             return (f"prompt {plen} exceeds the largest prefill bucket "
                     f"{self.buckets[-1]} (block-aligned max_position)")
-        # a budget the pool could NEVER fund must reject now, not park at
-        # the FCFS head forever wedging every request behind it
-        usable = self.config.num_blocks - 1
-        need = self._block_budget(plen, req.max_new_tokens)
-        if need > usable:
-            return (f"request needs {need} cache blocks but the pool has "
-                    f"only {usable} (num_blocks={self.config.num_blocks} "
-                    "incl. scratch)")
         return None
+
+    def load(self) -> int:
+        """Pending decode tokens (queued + in-flight remaining): the
+        least-loaded routing key and the queue-wait estimator's input."""
+        with self._cv:
+            queued = sum(r.max_new_tokens for r, _ in self._queue)
+            active = sum(max(s.max_new - s.gen, 0)
+                         for s in self._slots.values())
+        return queued + active
+
+    def queue_full(self) -> bool:
+        """Whether a submit right now would shed queue_full — the routing
+        hint that lets the frontend prefer a replica with queue room over
+        a token-lighter one that would reject (load is token-weighted,
+        the queue bound is entry-counted; they can disagree)."""
+        with self._cv:
+            return len(self._queue) >= self.config.max_queue
+
+    def queue_wait_estimate_ms(self) -> float:
+        """Deadline-aware admission: pending tokens over the window
+        throughput, scaled by the measured window wall time (EWMA). 0.0
+        until the first window lands (no basis to shed on)."""
+        ewma = self._window_ms_ewma
+        if not ewma:
+            return 0.0
+        per_window = max(self.config.window * self.config.max_slots, 1)
+        return self.load() / per_window * ewma
 
     def generate(self, requests: List[Request],
                  timeout: float = 300.0) -> List[Completion]:
-        """Continuous-batched: submit everything, wait for everything."""
-        handles = [self.submit(r) for r in requests]
+        """Continuous-batched: submit everything, wait for everything.
+        Batch-style (`bounded=False`): a finite known workload queues
+        FCFS past the online admission bounds."""
+        handles = [self.submit(r, bounded=False) for r in requests]
         return [h.result(timeout=timeout, raise_on_error=False)
                 for h in handles]
 
@@ -381,14 +544,17 @@ class DecodeEngine:
         """The parity baseline: one request at a time, each fully retired
         before the next is submitted — same compiled programs, batch of
         one live slot."""
-        return [self.submit(r).result(timeout=timeout,
-                                      raise_on_error=False)
+        return [self.submit(r, bounded=False).result(
+                    timeout=timeout, raise_on_error=False)
                 for r in requests]
 
     # ------------------------------------------------------------------
     # service loop
     # ------------------------------------------------------------------
     def _ensure_thread(self):
+        if self._draining:
+            return      # a drain-racing submit must not revive the
+                        # service thread (its entry is unqueued + shed)
         if self._thread is None or not self._thread.is_alive():
             self._stop = False
             self._thread = threading.Thread(
@@ -401,14 +567,14 @@ class DecodeEngine:
             self._ensure_thread()
         return self
 
-    def stop(self):
+    def stop(self, join_timeout_s: float = 60.0):
         with self._cv:
             self._stop = True
             self._cv.notify_all()
         t = self._thread
         if t is not None and t.is_alive() \
                 and t is not threading.current_thread():
-            t.join(timeout=60)
+            t.join(timeout=join_timeout_s)
         if self._queue or self._slots:
             # stop() abandons in-flight work: their callers must get a
             # terminal FAILED completion, never block forever
@@ -425,11 +591,22 @@ class DecodeEngine:
     def _service_loop(self):
         while True:
             with self._cv:
-                while (not self._stop and not self._queue
-                       and not self._slots):
+                # proceed when there are slots to decode, queue to admit
+                # (unless draining — a draining engine only runs its
+                # in-flight slots down), or a kill request to honor
+                while (not self._stop and self._kill is None
+                       and not self._slots
+                       and (self._draining or not self._queue)):
                     self._cv.wait(0.05)
                 if self._stop:
                     break
+            if self._kill is not None:
+                # an external kill() lands HERE, between windows — the
+                # same boundary a real window fault dies at, so slot
+                # bookkeeping (emitted-token counts the failover replay
+                # skip relies on) is never snapshotted mid-window
+                self._fail_all(self._kill)
+                break
             try:
                 self._admit()
                 if self._slots:
@@ -438,20 +615,117 @@ class DecodeEngine:
                 self._fail_all(repr(e))
                 break
 
+    def kill(self, why: str):
+        """Kill the engine from ANY thread (tests, bench chaos arms, an
+        operator). If the service thread is running, death is deferred to
+        the next window boundary so it can never race the in-flight
+        window's slot accounting; otherwise it is immediate."""
+        with self._cv:
+            t = self._thread
+            if (t is not None and t.is_alive()
+                    and t is not threading.current_thread()):
+                self._kill = why
+                self._cv.notify_all()
+                return
+        self._fail_all(why)
+
     def _fail_all(self, why: str):
+        """The engine is dead. With a frontend failover sink installed the
+        in-flight work is SNAPSHOTTED (request + handle carrying the
+        tokens streamed so far) and handed over for re-dispatch — the
+        deterministic decode contract makes the replay bit-identical;
+        without one (standalone engine) every request fails typed."""
         self._dead = why
+        # self-report SUSPECT when a frontend is watching (it confirms
+        # DEAD on its next health tick); standalone engines go straight
+        # to DEAD — nobody will resurrect them
+        self._set_health(Health.SUSPECT if self._failover is not None
+                         else Health.DEAD)
         _metrics.inc("serving.engine_failures")
         with self._cv:
             pending = list(self._queue)
             self._queue.clear()
             slots = dict(self._slots)
             self._slots.clear()
-        for _, handle in pending:
-            handle._finish(RequestState.FAILED, "engine failed", error=why)
-        for idx, slot in slots.items():
+            _metrics.set_gauge("serving.queue_depth", 0)
+        for idx in slots:
             self.cache.release(idx)
-            slot.handle._finish(RequestState.FAILED, "engine failed",
-                                error=why)
+        victims = [(req, handle) for req, handle in pending]
+        victims += [(slot.handle.request, slot.handle)
+                    for slot in slots.values()]
+        if self._failover is not None:
+            self._failover(self, victims, why)
+            return
+        for _, handle in victims:
+            handle._finish(RequestState.FAILED, "engine failed", error=why)
+
+    # ---- drain + resurrection -------------------------------------------
+    def drain(self, timeout_s: Optional[float] = None) -> List[tuple]:
+        """Graceful drain: stop admitting, finish the in-flight slots,
+        hand back the NEVER-SERVED queue as [(Request, RequestHandle)].
+        Handed-back handles finish `shed:draining` (their callers stop
+        waiting); the Requests are the caller's to re-route. A queued
+        failover victim that already streamed tokens is NOT handed back —
+        it fails typed (RequestFailedError) instead, because "shed" and
+        "re-routable" both promise the request was never served. Stops
+        the engine afterwards; `timeout_s` bounds the WHOLE call,
+        including the service-thread join, so a wedged window cannot
+        push a SIGTERM drain past the supervisor's grace."""
+        if timeout_s is None:
+            timeout_s = float(flag("FLAGS_serving_drain_timeout_ms")) \
+                / 1000.0
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._cv:
+                # a request mid-admission (popped, not yet slotted) is
+                # in-flight work too: drain must wait for its prefill to
+                # land and its slot to decode out, not stop() under it
+                busy = bool(self._slots) or self._admitting is not None
+            t = self._thread
+            if (not busy or self._dead is not None
+                    or t is None or not t.is_alive()):
+                break
+            time.sleep(0.01)
+        with self._cv:
+            queued = list(self._queue)
+            self._queue.clear()
+            _metrics.set_gauge("serving.queue_depth", 0)
+        unstarted = []
+        for req, handle in queued:
+            if handle.tokens_so_far():
+                handle._finish(
+                    RequestState.FAILED, "drained mid-failover",
+                    error="engine drained while the request awaited its "
+                          "failover re-decode (tokens already streamed)")
+            else:
+                unstarted.append((req, handle))
+                self._shed(handle, "draining", "engine drained")
+        self.stop(join_timeout_s=max(deadline - time.monotonic(), 0.2))
+        return unstarted
+
+    def resurrect(self) -> "DecodeEngine":
+        """Rebuild the dead engine's cache pool against the SHARED weight
+        arrays and clear its death. The window/prefill jits survive (same
+        shapes — no recompile); the pools do not (they were donated into
+        the dispatch that died), so a fresh PagedKVCache replaces them.
+        The caller (ServingFrontend health loop) gates rejoin on a canary
+        decode."""
+        self._set_health(Health.RESURRECTING)
+        _metrics.inc("serving.resurrections")
+        self.cache.close()
+        self.cache = self._build_cache()
+        with self._cv:
+            self._queue.clear()
+            self._slots.clear()
+            self._admitting = None
+        self._dead = None
+        self._kill = None
+        self._draining = False
+        self._stop = False
+        return self
 
     # ---- admission -------------------------------------------------------
     def _bucket_for(self, plen: int) -> int:
@@ -463,18 +737,19 @@ class DecodeEngine:
     def _admit(self):
         while True:
             with self._cv:
-                if not self._queue:
+                if not self._queue or self._draining:
                     return
-                req, handle = self._queue[0]
+                entry = self._queue[0]
+                req, handle = entry
             free = [i for i in range(self.config.max_slots)
                     if i not in self._slots]
             if not free:
                 return
             plen = int(req.prompt.shape[0])
             bucket = self._bucket_for(plen)
-            bs = self.config.block_size
-            n_blocks = max(bucket // bs,
-                           -(-(plen + req.max_new_tokens) // bs))
+            # SAME formula as submit's unfundable shed: the two must
+            # agree or never-fundable heads wedge the FCFS queue again
+            n_blocks = self._block_budget(plen, req.max_new_tokens)
             slot_idx = free[0]
             blocks = self.cache.assign(slot_idx, n_blocks)
             if blocks is None:
@@ -483,8 +758,28 @@ class DecodeEngine:
                 # requests behind small ones
                 return
             with self._cv:
-                self._queue.pop(0)
-                _metrics.set_gauge("serving.queue_depth", len(self._queue))
+                # re-verify the head: a concurrent drain()/stop() may
+                # have cleared the queue (and claimed the entry) while
+                # the lock was released for the funding work — popping
+                # blind would IndexError and spuriously kill the engine
+                # in the middle of a graceful drain
+                if not self._queue or self._queue[0] is not entry:
+                    head_claimed = True
+                else:
+                    head_claimed = False
+                    self._queue.pop(0)
+                    # visible to drain()'s busy check while the entry is
+                    # neither queued nor slotted (the whole prefill)
+                    self._admitting = entry
+                    _metrics.set_gauge("serving.queue_depth",
+                                       len(self._queue))
+            if head_claimed:
+                self.cache.release(slot_idx)
+                return
+            if handle.failovers == 0:    # re-dispatches would skew it
+                _metrics.observe(
+                    "serving.queue_wait_ms",
+                    (time.perf_counter() - handle.t_submit) * 1000.0)
             try:
                 self._prefill_into(slot_idx, blocks, req, handle, plen,
                                    bucket)
@@ -492,15 +787,29 @@ class DecodeEngine:
                 # a per-request admission failure (bad prompt content, a
                 # transient compile error) fails THAT request, not the
                 # engine and everything in flight; a failure inside a
-                # WINDOW still escalates (shared pool state is suspect)
+                # WINDOW still escalates (shared pool state is suspect).
+                # With a failover sink installed the victim is re-
+                # dispatched (bounded by the failover budget) instead of
+                # failed — a flaky prefill on one replica should not kill
+                # the request.
                 self.cache.release(slot_idx)
-                self._slots.pop(slot_idx, None)
+                with self._cv:
+                    self._slots.pop(slot_idx, None)
                 _metrics.inc("serving.prefill_failures")
-                handle._finish(RequestState.FAILED, "prefill failed",
-                               error=repr(e))
+                if self._failover is not None:
+                    self._failover(self, [(req, handle)],
+                                   f"prefill failed: {e!r}",
+                                   charge_unserved=True)
+                else:
+                    handle._finish(RequestState.FAILED, "prefill failed",
+                                   error=repr(e))
+            finally:
+                with self._cv:
+                    self._admitting = None
 
     def _prefill_into(self, slot_idx, blocks, req, handle, plen, bucket):
         import jax.numpy as jnp
+        fault_point("serving.prefill")
         handle._set_state(RequestState.PREFILL)
         _trace.instant("serving.admit",
                        args={"uid": req.uid, "slot": slot_idx})
@@ -530,17 +839,20 @@ class DecodeEngine:
         tok = int(FetchHandle(first, name="serving.first_token").numpy())
         handle._append_tokens([tok])
         handle._set_state(RequestState.DECODE)
-        _metrics.observe("serving.ttft_ms", handle.ttft_ms())
+        if not handle._ttft_observed:   # a failover replay is not a TTFT
+            handle._ttft_observed = True
+            _metrics.observe("serving.ttft_ms", handle.ttft_ms())
         _trace.instant("serving.first_token", args={"uid": req.uid})
         eos = -1 if req.eos_token is None else int(req.eos_token)
         if req.max_new_tokens == 1 or tok == eos:
             self.cache.release(slot_idx)
             self._retire(handle, "eos" if tok == eos else "length")
             return
-        self._slots[slot_idx] = _Slot(
-            handle, pos=plen, gen=1, token=tok, eos=eos,
-            max_new=req.max_new_tokens, temp=float(req.temperature),
-            top_k=int(req.top_k), seed=int(req.seed))
+        with self._cv:    # load()/stats() iterate _slots cross-thread
+            self._slots[slot_idx] = _Slot(
+                handle, pos=plen, gen=1, token=tok, eos=eos,
+                max_new=req.max_new_tokens, temp=float(req.temperature),
+                top_k=int(req.top_k), seed=int(req.seed))
         _metrics.set_gauge("serving.active_slots", len(self._slots))
 
     def _retire(self, handle, reason: str):
@@ -579,6 +891,10 @@ class DecodeEngine:
 
     def _run_window(self):
         from ..framework.executor import _deadline_call
+        # the chaos-drill kill site: an injected error here escalates
+        # through the service loop to _fail_all — the same path a real
+        # mid-window crash takes — BEFORE the flight step opens
+        fault_point("serving.window")
         self._windows += 1
         _metrics.inc("serving.windows")
         owner = 0x5E0 + self._id   # flight-recorder lane per engine
@@ -621,14 +937,20 @@ class DecodeEngine:
             raise
         finally:
             _flight.end_step(self._windows, status=status, owner=owner)
-        _metrics.observe("serving.window_ms",
-                         (time.perf_counter() - t0) * 1000.0)
+        window_ms = (time.perf_counter() - t0) * 1000.0
+        _metrics.observe("serving.window_ms", window_ms)
+        # EWMA of window wall time: the queue-wait estimator's clock
+        self._window_ms_ewma = (
+            window_ms if self._window_ms_ewma is None
+            else 0.8 * self._window_ms_ewma + 0.2 * window_ms)
         self._apply_window(toks, acts)
 
     def _apply_window(self, toks: np.ndarray, acts: np.ndarray):
         n_tokens = 0
         for idx in list(self._slots):
-            slot = self._slots[idx]
+            slot = self._slots.get(idx)
+            if slot is None:    # defensively tolerate a concurrent clear
+                continue
             emitted = []
             finished = None
             for t in range(toks.shape[0]):
@@ -650,7 +972,8 @@ class DecodeEngine:
                 n_tokens += len(emitted)
             if finished is not None:
                 self.cache.release(idx)
-                del self._slots[idx]
+                with self._cv:    # load()/stats() iterate cross-thread
+                    self._slots.pop(idx, None)
                 self._retire(slot.handle, finished)
         _metrics.inc("serving.tokens_out", n_tokens)
         _metrics.set_gauge("serving.active_slots", len(self._slots))
@@ -666,6 +989,8 @@ class DecodeEngine:
             "queued": len(self._queue),
             "free_blocks": self.cache.allocator.free_blocks,
             "dead": self._dead,
+            "health": self.health,
+            "load": self.load(),
         }
 
     def window_abstract_args(self):

@@ -21,12 +21,16 @@ from __future__ import annotations
 import itertools
 import json
 import os
+import signal
 import threading
 from typing import List, Optional
 
 from ..observability import metrics as _metrics
 from .engine import DecodeEngine, EngineConfig
 from .request import Request, RequestHandle
+from .resilience import NoHealthyReplicaError, ServingFrontend  # noqa: F401
+                                            # (re-exported: the serving
+                                            # frontends live side by side)
 
 
 def replicated_engines(n: int, params, model_config,
@@ -41,17 +45,13 @@ def replicated_engines(n: int, params, model_config,
 
 def _clone_engine(src: DecodeEngine) -> DecodeEngine:
     """A replica sharing src's prepared params/scales (device arrays are
-    immutable to the window program) with its own cache + scheduler."""
-    clone = DecodeEngine.__new__(DecodeEngine)
-    DecodeEngine.__init__(
-        clone, params={k: v for k, v in src.params.items()},
-        model_config=src.model_config, config=src.config)
-    # __init__ re-prepared from already-prepared arrays (idempotent for
-    # f32/bf16; int8 payloads pass through _quantizable=False), but adopt
-    # src's exact buffers so HBM holds ONE weight copy
-    clone.params = src.params
-    clone.scales = src.scales
-    return clone
+    immutable to the window program) with its own cache + scheduler.
+    prepare_params NEVER runs for a clone — the _prepared fast path adopts
+    src's exact device buffers, so HBM holds ONE weight copy (identity
+    pinned per-array by tests/test_serving_resilience.py)."""
+    return DecodeEngine(
+        None, src.model_config, config=src.config,
+        _prepared=(src.params, src.scales, src.compute_dtype))
 
 
 class RoundRobinFrontend:
@@ -64,7 +64,8 @@ class RoundRobinFrontend:
         self._rr = itertools.count()
         self._lock = threading.Lock()
 
-    def submit(self, request: Request) -> RequestHandle:
+    def submit(self, request: Request,
+               bounded: bool = True) -> RequestHandle:
         n = len(self.engines)
         with self._lock:
             start = next(self._rr)
@@ -72,12 +73,17 @@ class RoundRobinFrontend:
             eng = self.engines[(start + probe) % n]
             if eng._dead is None:
                 _metrics.inc("serving.frontend_dispatch")
-                return eng.submit(request)
-        # every replica dead: let the first one mint the rejection handle
-        return self.engines[start % n].submit(request)
+                return eng.submit(request, bounded=bounded)
+        # every replica dead: a typed signal the caller can act on
+        # (restart the service, fail over to another pod) — silently
+        # minting rejection handles hid total outage inside per-request
+        # noise
+        raise NoHealthyReplicaError(f"all {n} replicas dead")
 
     def generate(self, requests: List[Request], timeout: float = 300.0):
-        handles = [self.submit(r) for r in requests]
+        """Batch-style: like every other batch caller, a finite known
+        workload queues FCFS past the online admission bounds."""
+        handles = [self.submit(r, bounded=False) for r in requests]
         return [h.result(timeout=timeout, raise_on_error=False)
                 for h in handles]
 
@@ -103,12 +109,19 @@ class RoundRobinFrontend:
 def worker_main(requests_path: str, out_dir: str,
                 model: str = "tiny", dtype: str = "float32",
                 max_slots: int = 4, max_len: int = 128,
-                window: int = 0) -> int:
+                window: int = 0, replicas: int = 1) -> int:
     """One supervised decode worker: build the tiny GPT from seed 0, take
     the rank-th shard of the request file (JSONL: {"uid", "prompt",
     "max_new", "temperature"?, "top_k"?, "seed"?}), serve it through a
-    DecodeEngine, write completions to <out_dir>/rank<r>.jsonl. Heartbeat
-    + flight-dump plumbing is inherited from the launcher env contract."""
+    ServingFrontend, write completions to <out_dir>/rank<r>.jsonl.
+    Heartbeat + flight-dump plumbing is inherited from the launcher env
+    contract.
+
+    SIGTERM (the supervisor's preemption signal) triggers a GRACEFUL
+    DRAIN bounded by the launcher-exported PADDLE_LAUNCH_GRACE_S budget:
+    in-flight requests finish, unstarted ones are handed back and written
+    to the output as state "handed_back" — the worker sheds cleanly and
+    exits 0 instead of failing its streams."""
     import numpy as np
     import paddle_tpu.fluid as fluid
     from ..models.gpt import GPTConfig, build_lm_program
@@ -131,22 +144,48 @@ def worker_main(requests_path: str, out_dir: str,
 
     out_path = os.path.join(out_dir, f"rank{rank}.jsonl")
     os.makedirs(out_dir, exist_ok=True)
-    with DecodeEngine(params, cfg, max_slots=max_slots, max_len=max_len,
-                      window=window, dtype=dtype) as eng:
-        completions = eng.generate([
+    kw = dict(max_slots=max_slots, max_len=max_len, window=window,
+              dtype=dtype)
+    engines = (replicated_engines(replicas, params, cfg, **kw)
+               if replicas > 1 else [DecodeEngine(params, cfg, **kw)])
+    fe = ServingFrontend(engines)
+    handed_back: List[Request] = []
+
+    def _on_term(signum, frame):
+        grace = float(os.environ.get("PADDLE_LAUNCH_GRACE_S", "10") or 10)
+        handed_back.extend(fe.drain(timeout_s=max(grace * 0.5, 1.0)))
+
+    prev_term = None
+    if threading.current_thread() is threading.main_thread():
+        prev_term = signal.signal(signal.SIGTERM, _on_term)
+    try:
+        completions = fe.generate([
             Request(prompt=np.asarray(r["prompt"], np.int32),
                     max_new_tokens=int(r["max_new"]),
                     temperature=float(r.get("temperature", 0.0)),
                     top_k=int(r.get("top_k", 0)),
                     seed=int(r.get("seed", 0)),
                     uid=str(r.get("uid", f"r{rank}-{i}")))
-            for i, r in enumerate(mine)])
+            for i, r in enumerate(mine)], timeout=600)
+        handed = {r.uid for r in handed_back}
         with open(out_path, "w") as f:
             for c in completions:
                 f.write(json.dumps({
-                    "uid": c.uid, "state": c.state, "tokens": c.tokens,
+                    "uid": c.uid,
+                    "state": ("handed_back" if c.uid in handed
+                              else c.state),
+                    "tokens": c.tokens,
                     "finish_reason": c.finish_reason,
                     "ttft_ms": c.ttft_ms, "tpot_ms": c.tpot_ms,
                     "rank": rank}) + "\n")
-    bad = [c for c in completions if not c.ok]
+    finally:
+        if prev_term is not None:
+            signal.signal(signal.SIGTERM, prev_term)
+        fe.stop()
+    # a drained worker sheds cleanly: handed-back / drain-shed requests
+    # are NOT failures — the supervisor (or its surviving workers) owns
+    # them now
+    bad = [c for c in completions
+           if not c.ok and c.uid not in handed
+           and c.finish_reason != "shed:draining"]
     return 1 if bad else 0

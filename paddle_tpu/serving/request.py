@@ -34,6 +34,28 @@ class ServingError(RuntimeError):
         self.completion = completion
 
 
+class ShedError(ServingError):
+    """The request was SHED by admission control (docs/serving.md
+    "Failure semantics"): the engine judged it could not serve it within
+    its capacity/deadline contract and rejected it typed-and-early rather
+    than queueing it to time out. `.reason` is the taxonomy key
+    (queue_full | deadline_unmeetable | unfundable | draining |
+    engine_dead | admit_fault); the same key lands in the
+    `serving.shed.<reason>` counter."""
+
+    def __init__(self, msg, completion=None, reason: str = ""):
+        super().__init__(msg, completion=completion)
+        self.reason = reason
+
+
+class RequestFailedError(ServingError):
+    """The request FAILED terminally — its engine died and it either
+    exhausted the per-request failover budget
+    (FLAGS_serving_failover_budget re-dispatches) or no healthy replica
+    remained to take it. Distinct from ShedError: shed requests were
+    never served; failed requests may have streamed tokens first."""
+
+
 class RequestState:
     QUEUED = "queued"
     PREFILL = "prefill"
@@ -57,6 +79,11 @@ class Request:
     seed: int = 0
     eos_token: Optional[int] = None
     uid: Optional[str] = None
+    # admission-control deadline: if the engine estimates the QUEUE WAIT
+    # alone already exceeds this, the request is shed at submit
+    # (reason deadline_unmeetable) instead of queueing to time out.
+    # None = no deadline (never deadline-shed).
+    deadline_ms: Optional[float] = None
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -101,15 +128,37 @@ class RequestHandle:
         self.t_submit = time.perf_counter()
         self.t_first_token: Optional[float] = None
         self.t_retire: Optional[float] = None
+        # failover bookkeeping (serving/resilience.py): how many times the
+        # request was re-dispatched after an engine death, and how many
+        # replayed tokens to swallow before appending resumes. Decode is
+        # deterministic (fold_in(seed, token_idx)), so the re-decode from
+        # the prompt REPLAYS exactly the tokens the caller already saw.
+        self.failovers = 0
+        self._skip = 0
+        self._ttft_observed = False
 
     # ---- engine side -----------------------------------------------------
     def _set_state(self, state: str):
         with self._lock:
             self._state = state
 
+    def _arm_resume(self) -> int:
+        """Prepare the handle for re-dispatch to another replica: tokens
+        appended next are a deterministic REPLAY of what was already
+        streamed, so swallow exactly that many before appending resumes.
+        Returns the replay length (for telemetry)."""
+        with self._lock:
+            self._skip = len(self._tokens)
+            self._state = RequestState.QUEUED
+            return self._skip
+
     def _append_tokens(self, toks):
         now = time.perf_counter()
         with self._lock:
+            if self._skip:
+                take = min(self._skip, len(toks))
+                self._skip -= take
+                toks = list(toks)[take:]
             if not self._tokens and toks:
                 self.t_first_token = now
             self._tokens.extend(int(t) for t in toks)
@@ -173,7 +222,12 @@ class RequestHandle:
                 f"(state={self.state})")
         c = self.completion()
         if raise_on_error and not c.ok:
-            raise ServingError(
-                f"request {c.uid} {c.state}: {c.error or c.finish_reason}",
-                completion=c)
+            msg = f"request {c.uid} {c.state}: {c.error or c.finish_reason}"
+            if (c.state == RequestState.REJECTED
+                    and c.finish_reason.startswith("shed:")):
+                raise ShedError(msg, completion=c,
+                                reason=c.finish_reason[len("shed:"):])
+            if c.state == RequestState.FAILED:
+                raise RequestFailedError(msg, completion=c)
+            raise ServingError(msg, completion=c)
         return c

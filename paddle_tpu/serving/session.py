@@ -131,7 +131,7 @@ class DecodeSession:
         b, sp = tokens.shape
         handles = [self._engine.submit(Request(
             prompt=tokens[i], max_new_tokens=self._max_new,
-            eos_token=self._eos)) for i in range(b)]
+            eos_token=self._eos), bounded=False) for i in range(b)]
         out = np.zeros((b, sp + self._max_new), np.int64)
         out[:, :sp] = tokens
         for i, h in enumerate(handles):

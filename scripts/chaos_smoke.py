@@ -33,9 +33,22 @@ overlapped subprocess, skippable with --no-preemption-drill):
      the repacked-flat-bucket path — and assert losses + final state
      bit-identical to a replicated dp=2 resume from the SAME checkpoint.
 
+`--serving-drill` runs the SERVING chaos drill (docs/serving.md "Failure
+semantics"; wired into scripts/ci.py as an overlapped subprocess,
+skippable with --no-serving-chaos): a 2-replica decode frontend serves a
+mixed greedy + seeded-top-k request stream while a FaultPlan
+(`serving.window:error:at=K`) kills one replica mid-decode. The drill
+asserts ZERO failed requests, every output BIT-IDENTICAL to an
+undisturbed single-engine oracle run (decode is a pure function of
+(prompt, seed, token_idx), so failover re-decode replays exactly), the
+shed/failover counters matching the injected plan exactly (1 engine
+failure, failovers == re-dispatched victims, 0 sheds), and the killed
+replica resurrecting through the canary gate and serving again.
+
 Usage: python scripts/chaos_smoke.py [--steps 50] [--seed 7]
        [--pull-error-p 0.25] [--ckpt-every 10] [--crash-at-save 2]
        [--preemption-drill] [--zero-stage 3] [--grace-s 30]
+       [--serving-drill] [--kill-window 3] [--serving-requests 12]
 """
 from __future__ import annotations
 
@@ -292,6 +305,152 @@ def dp_resize_drill(args) -> bool:
     return r.returncode == 0
 
 
+# --- serving drill -----------------------------------------------------
+
+def _serving_tiny_gpt():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models.gpt import GPTConfig, build_lm_program
+    from paddle_tpu.models.gpt_decode import params_from_scope
+    from paddle_tpu.testing import reset_programs
+    reset_programs(seed=0)
+    cfg = GPTConfig.tiny()
+    cfg.max_position = 64
+    build_lm_program(cfg)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    return cfg, params_from_scope(cfg)
+
+
+def _serving_requests(n, vocab, seed):
+    from paddle_tpu.serving import Request
+    rng = np.random.RandomState(seed)
+    reqs = []
+    for i in range(n):
+        sampled = i % 3 == 2        # greedy AND seeded top-k arms
+        reqs.append(Request(
+            prompt=rng.randint(0, vocab, (int(rng.randint(3, 14)),)),
+            max_new_tokens=int(rng.randint(4, 10)),
+            temperature=0.8 if sampled else 0.0,
+            top_k=16 if sampled else 0,
+            seed=500 + i, uid=f"drill-{i}"))
+    return reqs
+
+
+def serving_drill(args) -> bool:
+    """Replica killed mid-decode -> 0 failed requests, bit-parity vs the
+    undisturbed oracle, counters matching the fault plan exactly, and a
+    canary-gated resurrection."""
+    import time as _time
+    from paddle_tpu.flags import set_flags
+    from paddle_tpu.observability import metrics as m
+    from paddle_tpu.resilience import clear_plan, install_plan
+    from paddle_tpu.serving import (DecodeEngine, Health, ServingFrontend,
+                                    replicated_engines)
+
+    geo = dict(max_slots=4, block_size=8, num_blocks=64, max_len=48,
+               window=4)
+    cfg, params = _serving_tiny_gpt()
+    reqs = _serving_requests(args.serving_requests, cfg.vocab_size,
+                             args.seed)
+
+    print(f"[serving-drill] oracle: {len(reqs)} requests, single engine, "
+          "no faults")
+    clear_plan()
+    oracle_eng = DecodeEngine(params, cfg, **geo)
+    oracle = {c.uid: c for c in oracle_eng.generate(reqs, timeout=600)}
+    oracle_eng.stop()
+    bad = [c for c in oracle.values() if not c.ok]
+    assert not bad, f"oracle leg failed: {[(c.uid, c.state) for c in bad]}"
+
+    for name in ("serving.failovers", "serving.engine_failures",
+                 "serving.shed_total", "serving.resurrections"):
+        m.reset(name)
+    spec = f"serving.window:error:at={args.kill_window}"
+    print(f"[serving-drill] chaos: 2 replicas, plan {spec!r} "
+          f"(replica dies mid-decode at global window "
+          f"#{args.kill_window})")
+    plan = install_plan(spec, seed=args.seed)
+    set_flags({"FLAGS_serving_health_interval_ms": 50.0})
+    engines = replicated_engines(2, params, cfg, **geo)
+    fe = ServingFrontend(engines)
+    ok = True
+    try:
+        handles = []
+        for r in reqs:                      # staggered arrivals
+            handles.append(fe.submit(r))
+            _time.sleep(0.002)
+        comps = [h.result(timeout=600, raise_on_error=False)
+                 for h in handles]
+
+        failed = [c for c in comps if not c.ok]
+        if failed:
+            print(f"[serving-drill] FAIL: {len(failed)} request(s) not "
+                  f"done: {[(c.uid, c.state, c.error) for c in failed[:4]]}")
+            ok = False
+        for c in comps:
+            want = oracle[c.uid].tokens
+            if c.tokens != want:
+                print(f"[serving-drill] FAIL: {c.uid} diverged from "
+                      f"oracle: {c.tokens} != {want}")
+                ok = False
+
+        fired = sum(r.fired for r in plan.rules)
+        failures = int(m.get("serving.engine_failures"))
+        failovers = int(m.get("serving.failovers"))
+        shed = int(m.get("serving.shed_total"))
+        if fired != 1 or failures != 1:
+            print(f"[serving-drill] FAIL: expected exactly 1 injected "
+                  f"window fault -> 1 engine failure, got fired={fired} "
+                  f"failures={failures}")
+            ok = False
+        if failovers != len(fe.failover_log) or failovers < 1:
+            print(f"[serving-drill] FAIL: failover counter {failovers} != "
+                  f"re-dispatch log {len(fe.failover_log)} (or no victim "
+                  "was in flight at the kill)")
+            ok = False
+        if shed != 0:
+            print(f"[serving-drill] FAIL: {shed} request(s) shed — the "
+                  "drill load must ride failover, not load shedding")
+            ok = False
+
+        # resurrection: the killed replica must pass the canary gate and
+        # rejoin live (live -> suspect -> dead -> resurrecting -> live)
+        deadline = _time.monotonic() + 60
+        while _time.monotonic() < deadline and not all(
+                e.health == Health.LIVE and e._dead is None
+                for e in engines):
+            _time.sleep(0.05)
+        killed = [e for e in engines
+                  if Health.SUSPECT in e.health_history]
+        if not killed:
+            print("[serving-drill] FAIL: no engine records a "
+                  "suspect transition (nothing died?)")
+            ok = False
+        for e in killed:
+            want = [Health.LIVE, Health.SUSPECT, Health.DEAD,
+                    Health.RESURRECTING, Health.LIVE]
+            if e.health_history != want:
+                print(f"[serving-drill] FAIL: engine {e._id} health "
+                      f"history {e.health_history} != {want}")
+                ok = False
+        post = fe.generate([reqs[0]], timeout=300)[0]
+        if not (post.ok and post.tokens == oracle[reqs[0].uid].tokens):
+            print("[serving-drill] FAIL: post-resurrection request "
+                  f"diverged: {post.state} {post.tokens}")
+            ok = False
+        if ok:
+            print(f"[serving-drill] PASS: {len(comps)} requests bit-"
+                  f"identical to oracle across a mid-decode replica kill "
+                  f"({failovers} failover(s), "
+                  f"{int(m.get('serving.resurrections'))} resurrection "
+                  "attempt(s), 0 shed, 0 failed)")
+    finally:
+        clear_plan()
+        set_flags({"FLAGS_serving_health_interval_ms": 200.0})
+        fe.stop()
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="PS chaos smoke: seeded fault plan, bit-for-bit parity")
@@ -322,8 +481,23 @@ def main():
                     help="SIGTERM-to-SIGKILL grace for the preempted "
                          "trainer (past it, restore must fall back over "
                          "the torn save)")
+    ap.add_argument("--serving-drill", action="store_true",
+                    help="run the serving chaos drill instead: kill a "
+                         "decode replica mid-stream via FaultPlan and "
+                         "assert failover bit-parity + exact counters + "
+                         "canary-gated resurrection")
+    ap.add_argument("--kill-window", type=int, default=3,
+                    help="serving drill: inject the replica-killing "
+                         "fault at this global decode-window count")
+    ap.add_argument("--serving-requests", type=int, default=12,
+                    help="serving drill: request-stream size")
     args = ap.parse_args()
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    if args.serving_drill:
+        ok = serving_drill(args)
+        print("[chaos_smoke] serving drill " + ("PASS" if ok else "FAIL"))
+        return 0 if ok else 1
 
     if args.preemption_drill:
         if args.steps == 50:

@@ -47,6 +47,7 @@ WEIGHTS = {
     "test_backward_and_optimizers.py": 20, "test_lr_and_optimizers.py": 20,
     "test_dynamic_rnn.py": 20, "test_capi_serving.py": 20,
     "test_serving.py": 40, "test_paged_ops.py": 10,
+    "test_serving_resilience.py": 60,
 }
 
 
@@ -421,6 +422,37 @@ def collect_serving_smoke(proc, timeout=1200) -> bool:
     return proc.returncode == 0
 
 
+# Serving chaos drill (ISSUE-15 CI satellite): scripts/chaos_smoke.py
+# --serving-drill — a FaultPlan kills one of two decode replicas
+# mid-stream; the drill pins 0 failed requests, bit-parity vs the
+# undisturbed oracle run, exact shed/failover counters, and the killed
+# replica's canary-gated resurrection. Overlapped with the shards
+# (--no-serving-chaos to skip).
+def start_serving_chaos(env):
+    script = os.path.join(ROOT, "scripts", "chaos_smoke.py")
+    return subprocess.Popen(
+        [sys.executable, script, "--serving-drill"],
+        cwd=ROOT, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+
+
+def collect_serving_chaos(proc, timeout=1200) -> bool:
+    try:
+        out_s, err_s = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        print(f"[serving-chaos] FAIL timed out after {timeout}s")
+        return False
+    lines = (out_s or "").strip().splitlines()
+    status = "OK " if proc.returncode == 0 else "FAIL"
+    body = "\n".join("    " + ln for ln in lines[-8:])
+    tail = (err_s or "").strip().splitlines()[-25:]
+    print(f"[serving-chaos] {status}\n{body}" + (
+        "\n" + "\n".join(tail) if proc.returncode != 0 else ""))
+    return proc.returncode == 0
+
+
 def shard(files, n):
     """LPT bin packing by weight."""
     bins = [(0.0, []) for _ in range(n)]
@@ -463,6 +495,11 @@ def main():
                          "engine + 32 streamed requests + KV copy census "
                          "+ supervised decode gang, "
                          "scripts/serving_smoke.py)")
+    ap.add_argument("--no-serving-chaos", action="store_true",
+                    help="skip the serving chaos drill (replica killed "
+                         "mid-decode -> failover bit-parity + "
+                         "resurrection, scripts/chaos_smoke.py "
+                         "--serving-drill)")
     ap.add_argument("--no-pod-trace", action="store_true",
                     help="skip the pod-trace smoke (2-process supervised "
                          "gang -> merged timeline + straggler report, "
@@ -500,6 +537,9 @@ def main():
     serving_proc = None
     if not args.no_serving_smoke:
         serving_proc = start_serving_smoke(env)    # overlaps the shards too
+    chaos_proc = None
+    if not args.no_serving_chaos:
+        chaos_proc = start_serving_chaos(env)      # overlaps the shards too
 
     files = sorted(glob.glob(os.path.join(ROOT, "tests", "test_*.py")))
     shards = shard(files, args.n)
@@ -557,6 +597,8 @@ def main():
         failed = failed or not collect_pod_trace_smoke(pod_proc)
     if serving_proc is not None:
         failed = failed or not collect_serving_smoke(serving_proc)
+    if chaos_proc is not None:
+        failed = failed or not collect_serving_chaos(chaos_proc)
     print(f"CI total: {time.time() - t0:.0f}s over {len(shards)} shards -> "
           f"{'FAILED' if failed else 'PASSED'}")
     return 1 if failed else 0

@@ -76,7 +76,7 @@ def _mixed_requests(n, vocab, seed=0):
 
 def run_smoke(n_requests: int, replicas: int, window: int) -> int:
     from paddle_tpu.observability import metrics as _metrics
-    from paddle_tpu.serving import (DecodeEngine, RoundRobinFrontend,
+    from paddle_tpu.serving import (DecodeEngine, ServingFrontend,
                                     replicated_engines)
     from paddle_tpu.serving import audit
     from paddle_tpu.serving.program import analyze_decode_step
@@ -86,8 +86,8 @@ def run_smoke(n_requests: int, replicas: int, window: int) -> int:
               window=window)
     if replicas > 1:
         engines = replicated_engines(replicas, params, cfg, **kw)
-        target = RoundRobinFrontend(engines)
-        census_engine = engines[0]
+        target = ServingFrontend(engines)   # the production frontend:
+        census_engine = engines[0]          # least-loaded + failover
     else:
         census_engine = target = DecodeEngine(params, cfg, **kw)
 

@@ -322,6 +322,46 @@ def test_round_robin_frontend(tiny_gpt):
     assert all(s["completed"] > 0 for s in st["per_replica"])
 
 
+def test_round_robin_skips_dead_replica(tiny_gpt):
+    """ISSUE-15 satellite: the dead-replica skip path, pinned — a killed
+    replica degrades capacity, the survivor takes the whole stream."""
+    cfg, params = tiny_gpt
+    engines = replicated_engines(2, params, cfg, max_slots=2, block_size=8,
+                                 num_blocks=16, max_len=32, window=4)
+    fe = RoundRobinFrontend(engines)
+    engines[0].kill("induced death")
+    rng = np.random.RandomState(8)
+    try:
+        comps = fe.generate(
+            [Request(prompt=rng.randint(0, cfg.vocab_size, (6,)),
+                     max_new_tokens=3) for _ in range(4)], timeout=240)
+    finally:
+        fe.stop()
+    assert all(c.ok for c in comps), [(c.uid, c.state) for c in comps]
+    assert engines[0].stats()["completed"] == 0
+    assert engines[1].stats()["completed"] == 4
+    assert fe.stats()["live"] == 1
+
+
+def test_round_robin_all_dead_raises_typed(tiny_gpt):
+    """ISSUE-15 satellite: every replica dead used to silently mint
+    rejection handles (total outage hidden in per-request noise) — now a
+    typed NoHealthyReplicaError."""
+    from paddle_tpu.serving import NoHealthyReplicaError
+    cfg, params = tiny_gpt
+    engines = replicated_engines(2, params, cfg, max_slots=2, block_size=8,
+                                 num_blocks=16, max_len=32, window=4)
+    fe = RoundRobinFrontend(engines)
+    for e in engines:
+        e.kill("induced death")
+    try:
+        with pytest.raises(NoHealthyReplicaError, match="2 replicas"):
+            fe.submit(Request(prompt=np.arange(4) % cfg.vocab_size,
+                              max_new_tokens=2))
+    finally:
+        fe.stop()
+
+
 def test_capi_decode_session_runs_batched_decode(tiny_gpt, tmp_path):
     """ISSUE-14 satellite: the C-API create/run/fetch contract drives real
     batched decode — the session output is bit-identical to
