@@ -129,6 +129,14 @@ def _parse_args(argv=None):
                    help="relaunch budget after a worker failure: the gang "
                         "restarts at the surviving world size, trainers "
                         "resume from their latest checkpoint")
+    p.add_argument("--elastic_full_world", action="store_true",
+                   help="elastic restarts keep the ORIGINAL world size "
+                        "(replacement-host semantics) instead of shrinking "
+                        "to the survivors: a relaunched rank whose host "
+                        "died recovers its state from the snapshot its "
+                        "ring buddy flushed for it during the grace "
+                        "window (resilience/snapshot.py recovery ladder, "
+                        "'peer' rung)")
     p.add_argument("--collect-dumps", action="store_true",
                    dest="collect_dumps",
                    help="gather per-rank flight dumps into one pod dump "
@@ -204,6 +212,13 @@ class GangSupervisor:
         self._flight_dir = (os.environ.get("FLAGS_flight_dump_dir")
                             or str(flag("FLAGS_flight_dump_dir") or "")
                             or tempfile.mkdtemp(prefix="paddle_pod_flight_"))
+        # ONE shared snapshot dir per gang, same ownership rule as the
+        # flight dir: workers flush SIGTERM snapshots (own + held peer
+        # payloads) here, restarted workers climb the recovery ladder from
+        # it, and the supervisor reads back the per-rank rung stamps
+        self._snapshot_dir = (os.environ.get("PADDLE_SNAPSHOT_DIR")
+                              or str(flag("FLAGS_snapshot_dir") or "")
+                              or tempfile.mkdtemp(prefix="paddle_pod_snap_"))
         # rendezvous-anchored clock t0 (wall µs): the merged pod timeline
         # re-zeroes every rank's clock-aligned events here
         self._anchor_wall_us: Optional[float] = None
@@ -278,6 +293,7 @@ class GangSupervisor:
                 # wall time tells every rank when THIS gang life began
                 # (collection ignores dumps older than it)
                 "FLAGS_flight_dump_dir": self._flight_dir,
+                "PADDLE_SNAPSHOT_DIR": self._snapshot_dir,
                 "PADDLE_LAUNCH_START_US":
                     str(self._gang_start_wall * 1e6),
             })
@@ -511,12 +527,26 @@ class GangSupervisor:
             print(f"[launch] pod dump collection failed: {e!r}", flush=True)
             return None
 
+    def _log_recovery_rungs(self) -> None:
+        """Stamp each rank's chosen recovery-ladder rung (peer / local /
+        disk — resilience/snapshot.py writes the records at restore time)
+        into the gang log, scoped to THIS gang life."""
+        from ..resilience.snapshot import read_recovery_stamps
+        since = getattr(self, "_gang_start_wall", 0.0) or 0.0
+        for rec in read_recovery_stamps(self._snapshot_dir,
+                                        since=since - 1.0):
+            print(f"[launch] recovery: rank {rec.get('rank')} "
+                  f"rung={rec.get('rung')} step={rec.get('step')}",
+                  flush=True)
+
     def run(self) -> int:
         args = self.args
         world = len(self.ips) * max(args.nproc_per_node, 1)
+        full_world = world
         restarts = 0
         while True:
             status, survivors, rc = self.launch_once(world, restarts)
+            self._log_recovery_rungs()
             if status == "ok":
                 if self.collect_dumps:
                     self.collect_pod_dumps("ok", world, 0, restarts)
@@ -541,11 +571,23 @@ class GangSupervisor:
             if restarts >= args.elastic_restarts or survivors < 1:
                 return rc
             restarts += 1
-            world = survivors
-            print(f"[launch] elastic restart {restarts}/"
-                  f"{args.elastic_restarts}: relaunching at world size "
-                  f"{world}; trainers resume from their latest checkpoint "
-                  "(PreemptionGuard)", flush=True)
+            if args.elastic_full_world:
+                # replacement-host semantics: relaunch every rank; a rank
+                # whose process died finds its state on the recovery
+                # ladder's "peer" rung (the payload its ring buddy flushed
+                # during the grace window)
+                world = full_world
+                print(f"[launch] elastic restart {restarts}/"
+                      f"{args.elastic_restarts}: relaunching at FULL world "
+                      f"size {world}; replaced rank(s) recover from peer "
+                      "snapshots (resilience/snapshot.py ladder)",
+                      flush=True)
+            else:
+                world = survivors
+                print(f"[launch] elastic restart {restarts}/"
+                      f"{args.elastic_restarts}: relaunching at world size "
+                      f"{world}; trainers resume from their latest "
+                      "checkpoint (PreemptionGuard)", flush=True)
 
 
 def launch(argv=None):

@@ -181,6 +181,35 @@ _DEFS: Dict[str, tuple] = {
     "FLAGS_dataloader_max_respawns": (0, "respawn budget for abnormally-"
                                          "dead dataloader workers "
                                          "(0 = fail fast, seed behavior)"),
+    # --- training integrity tier (resilience/snapshot.py, integrity.py) ---
+    "FLAGS_snapshot_steps": (0, "async in-memory snapshot cadence: capture "
+                                "a double-buffered device->host copy of "
+                                "the portable training state every N steps "
+                                "off the hot path (0 = disabled). SIGTERM "
+                                "flushes the newest snapshot to "
+                                "FLAGS_snapshot_dir inside the launcher "
+                                "grace window"),
+    "FLAGS_snapshot_dir": ("", "root for flushed snapshots + recovery "
+                               "stamps; empty resolves PADDLE_SNAPSHOT_DIR "
+                               "(exported per-gang by distributed/"
+                               "launch.py) then a per-pid tmp dir"),
+    "FLAGS_fingerprint_steps": (0, "cross-replica divergence sentinel "
+                                   "cadence: sha256-fingerprint the "
+                                   "dp-replicated state and all-gather/"
+                                   "compare across ranks every N steps "
+                                   "(0 = disabled); mismatch raises "
+                                   "ReplicaDivergenceError naming the "
+                                   "minority rank or heals from the "
+                                   "quorum's snapshot"),
+    "FLAGS_loss_spike_factor": (10.0, "TrainingGuard poison-batch rule: a "
+                                "loss above this multiple of the trailing-"
+                                "window median (or any NaN/Inf) triggers "
+                                "rollback to the last good snapshot, "
+                                "skipping the batch (0 disables the spike "
+                                "rule; NaN/Inf always fires)"),
+    "FLAGS_rollback_budget": (2, "how many poison-batch rollbacks "
+                                 "TrainingGuard performs before giving up "
+                                 "and raising RollbackExhausted"),
     # --- elasticity / preemption tier (docs/resilience.md) ----------------
     "FLAGS_step_deadline_ms": (0.0, "hang watchdog for the executor's "
                                "SYNCHRONOUS step path: bound dispatch and "

@@ -903,6 +903,11 @@ class Executor:
         # (_emit_collective_markers): (program uid, version) -> ordered
         # [(kind, bucket)] of the program's collective ops
         self._coll_plans: Dict[tuple, list] = {}
+        # lazily-created async in-memory snapshotter (resilience/
+        # snapshot.py), active only with FLAGS_snapshot_steps > 0;
+        # snapshot tags count runs PER PROGRAM (id-keyed)
+        self._snapshot_mgr = None
+        self._snapshot_prog_steps: Dict[int, int] = {}
 
     @staticmethod
     def _resolve_sync(sync: Optional[bool]) -> bool:
@@ -1126,6 +1131,35 @@ class Executor:
             if idx == flag("FLAGS_profile_stop_step"):
                 _prof.stop_profiler()
 
+    def _maybe_snapshot(self, program, scope):
+        """Post-step snapshot hook (FLAGS_snapshot_steps cadence). Grabs
+        array REFERENCES on the hot path — jax arrays are immutable, so
+        the device->host copy itself runs on the snapshotter's thread —
+        and installs the SIGTERM grace-window flush on first use."""
+        from ..flags import flag
+        interval = int(flag("FLAGS_snapshot_steps") or 0)
+        if interval <= 0:
+            return
+        if self._snapshot_mgr is None:
+            from ..resilience.snapshot import SnapshotManager
+            self._snapshot_mgr = SnapshotManager(interval=interval)
+            self._snapshot_mgr.install_sigterm_flush()
+        # Tag with THIS program's run count, not the executor-wide step
+        # counter: that counter also ticks for the startup program and
+        # any eval program, so its value is shifted against the trainer's
+        # own step indexing — and a recover()ed tag must map onto the
+        # batch schedule for restore-and-replay to be bit-identical.
+        counts = self._snapshot_prog_steps
+        key = id(program)
+        counts[key] = counts.get(key, 0) + 1
+        self._snapshot_mgr.maybe_capture(program, scope, counts[key])
+
+    @property
+    def snapshots(self):
+        """The live SnapshotManager (None until the first snapshotted
+        step) — trainers hand it to TrainingGuard / DivergenceSentinel."""
+        return self._snapshot_mgr
+
     def _run_impl(self, program, feed, fetch_list, scope, return_numpy,
                   use_program_cache, sync):
         program = program or default_main_program()
@@ -1264,6 +1298,7 @@ class Executor:
                   f"{(time.perf_counter() - t0) * 1000:.3f} ms")
         for n, v in new_state.items():
             scope.set(n, v)
+        self._maybe_snapshot(program, scope)
         if flag("FLAGS_check_nan_inf"):
             _check_nan_inf(dict(zip(fetch_names, fetches)), new_state)
         if ps_hooks:
@@ -1551,6 +1586,7 @@ class Executor:
                          (time.perf_counter() - t0) * 1000.0)
         for n, v in new_state.items():
             scope.set(n, v)
+        self._maybe_snapshot(program, scope)
         if ps_hooks:
             fetched_by_name = dict(zip(fetch_names, fetches))
             for h in ps_hooks:
@@ -1825,6 +1861,9 @@ class Executor:
         with self._staged_lock:
             self._staged.clear()
             monitor.stat_set("executor.dispatch_queue_depth", 0)
+        if self._snapshot_mgr is not None:
+            self._snapshot_mgr.close()
+            self._snapshot_mgr = None
 
 
 def op_count(program) -> int:

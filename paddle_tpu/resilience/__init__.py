@@ -10,12 +10,22 @@ dataloader/dataloader.py, io.py and incubate/hdfs.py:
               DeadlineExceededError instead of hanging
   checkpoint  crash-safe CheckpointManager: temp dir + checksummed manifest
               + atomic rename + keep-N + fallback-to-last-complete
+  snapshot    async double-buffered in-memory snapshots + ring-buddy peer
+              replication + SIGTERM grace-window flush + the
+              peer -> local -> disk recovery ladder
+  integrity   cross-replica divergence sentinel (exact sha256 fingerprints
+              all-gathered and compared) + NaN/loss-spike TrainingGuard
+              with bounded rollback-to-last-good-snapshot
 """
 from .faults import (FaultPlan, FaultRule, FaultInjected, fault_point,
                      install_plan, clear_plan, current_plan)
 from .retry import RetryPolicy, DEFAULT_RETRYABLE
 from .checkpoint import (CheckpointManager, validate_manifest,
                          write_manifest, sha256_file)
+from .snapshot import (Snapshot, SnapshotManager, recover,
+                       read_recovery_stamps, snapshot_dir)
+from .integrity import (DivergenceSentinel, ReplicaDivergenceError,
+                        RollbackExhausted, TrainingGuard, fingerprint)
 
 __all__ = [
     "FaultPlan", "FaultRule", "FaultInjected", "fault_point",
@@ -23,4 +33,8 @@ __all__ = [
     "RetryPolicy", "DEFAULT_RETRYABLE",
     "CheckpointManager", "validate_manifest", "write_manifest",
     "sha256_file",
+    "Snapshot", "SnapshotManager", "recover", "read_recovery_stamps",
+    "snapshot_dir",
+    "DivergenceSentinel", "ReplicaDivergenceError", "RollbackExhausted",
+    "TrainingGuard", "fingerprint",
 ]

@@ -1,0 +1,289 @@
+"""Cross-replica divergence sentinel + poison-batch rollback.
+
+dp-replicated training state is bit-identical across ranks BY CONSTRUCTION
+(every step is a pure function of state + batch + seed — the determinism
+contract the serving failover already exploits), which turns silent data
+corruption and replica desync from an approximate-drift judgment call into
+an exact test: fingerprint the state, all-gather the digests, and any rank
+whose digest differs from the quorum's is corrupted — full stop.
+
+Two sentinels:
+
+* **DivergenceSentinel** — every `FLAGS_fingerprint_steps` steps, each
+  rank hashes its portable state (sha256 over the flat buckets/params in
+  name order — one pass over host-visible bytes, no tolerance math) and
+  all-gathers the hex digests over the gloo transport. A mismatch counts
+  `integrity.fingerprint_mismatch`, attaches a flight-recorder dump, and
+  either raises the typed `ReplicaDivergenceError` NAMING the minority
+  rank(s), or — given a `SnapshotManager` — heals in place: the lowest
+  quorum rank broadcasts its newest clean snapshot, EVERY rank restores
+  it (quorum ranks from their own identical copy), and the trainer
+  replays from the snapshot step in lockstep (`integrity.quorum_restores`).
+  Detection latency is bounded by one fingerprint interval.
+
+* **TrainingGuard** — a NaN/Inf + loss-spike sentinel wrapping the train
+  loop. A poisoned step triggers a bounded rollback: restore the last
+  good snapshot (state AND `__rng_state__`), replay the intervening
+  clean batches, and SKIP the poison batch — bit-identical to a run that
+  never saw it, because replay from identical state over identical
+  batches reproduces identical arithmetic. Budgeted by
+  `FLAGS_rollback_budget` (`integrity.rollbacks`); exhaustion re-raises
+  so a genuinely divergent model still fails loudly.
+
+Tests: tests/test_integrity.py; drill: scripts/chaos_smoke.py
+--integrity-drill legs (b)/(c) (docs/resilience.md "Snapshots &
+integrity").
+"""
+from __future__ import annotations
+
+import hashlib
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..framework.errors import EnforceNotMet, ErrorCode
+from ..observability import metrics as _metrics
+from ..observability import trace as _trace
+from .snapshot import RNG_KEY, Snapshot, SnapshotManager, rng_to_host
+
+
+class ReplicaDivergenceError(EnforceNotMet):
+    """A rank's dp-replicated state diverged from the quorum's (SDC, lost
+    update, desync). Carries the minority rank(s), the detection step,
+    the per-rank digests, and the flight dump written at detection."""
+
+    code = ErrorCode.PRECONDITION_NOT_MET
+
+    def __init__(self, minority_ranks: List[int], step: int,
+                 digests: Dict[int, str], dump_path: Optional[str] = None):
+        self.minority_ranks = list(minority_ranks)
+        self.step = int(step)
+        self.digests = dict(digests)
+        self.dump_path = dump_path
+        super().__init__(
+            "replica state diverged at step %d: minority rank(s) %s "
+            "disagree with the quorum fingerprint (per-rank digests %s)%s"
+            % (step, self.minority_ranks,
+               {r: d[:12] for r, d in sorted(self.digests.items())},
+               f"; flight dump: {dump_path}" if dump_path else ""))
+
+
+def fingerprint(program, scope) -> str:
+    """Cheap exact checksum of the training state: sha256 over every
+    persistable array's raw bytes (plus dtype/shape and the RNG state) in
+    name order. Flat ZeRO buckets hash AS the flat storage — no unbucket
+    pass; two replicas agree iff their resident state is bit-identical."""
+    from ..io import _persistable_names
+    h = hashlib.sha256()
+    names = sorted(_persistable_names(program, scope))
+    if scope.has(RNG_KEY):
+        names.append(RNG_KEY)
+    for n in names:
+        v = scope.find(n)
+        a = rng_to_host(v) if n == RNG_KEY else np.asarray(v)
+        h.update(n.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
+def _split_quorum(digests: Dict[int, str]) -> Tuple[str, List[int]]:
+    """(quorum digest, minority ranks). Quorum = the largest digest
+    group; ties break toward the group containing the lowest rank (with
+    no majority there is no ground truth — the tie-break at least makes
+    every rank's verdict identical, which the heal round requires)."""
+    groups: Dict[str, List[int]] = {}
+    for rank in sorted(digests):
+        groups.setdefault(digests[rank], []).append(rank)
+    quorum = max(groups.values(), key=lambda rs: (len(rs), -min(rs)))
+    minority = sorted(r for rs in groups.values() if rs is not quorum
+                      for r in rs)
+    return digests[quorum[0]], minority
+
+
+class DivergenceSentinel:
+    """Periodic cross-replica fingerprint comparison over a gloo group.
+
+        sentinel = DivergenceSentinel(gloo, interval=16)
+        for step in ...:
+            exe.run(...)
+            healed = sentinel.check(program, scope, step, snapshots=mgr)
+            if healed is not None:
+                step = healed        # rewind: replay from snapshot step
+
+    `check` is a COLLECTIVE on the fingerprint cadence — every rank must
+    call it with the same step sequence. Without a SnapshotManager (or
+    with heal=False) a mismatch raises ReplicaDivergenceError on every
+    rank, minority named, flight dump attached.
+    """
+
+    def __init__(self, gloo, interval: Optional[int] = None,
+                 heal: bool = True):
+        from ..flags import flag
+        self.gloo = gloo
+        self.interval = (int(flag("FLAGS_fingerprint_steps"))
+                         if interval is None else int(interval))
+        self.heal = heal
+        self.last_minority: List[int] = []
+
+    def check(self, program, scope, step: int,
+              snapshots: Optional[SnapshotManager] = None) \
+            -> Optional[int]:
+        """On the cadence: fingerprint, all-gather, compare. Returns None
+        when replicas agree (or off-cadence), the snapshot step to replay
+        from after a quorum heal, or raises ReplicaDivergenceError."""
+        if self.interval <= 0 or step % self.interval != 0:
+            return None
+        digest = fingerprint(program, scope)
+        rank, world = self.gloo.rank, self.gloo.world
+        gathered = self.gloo.all_gather((rank, digest))
+        digests = {int(r): d for r, d in gathered}
+        quorum_digest, minority = _split_quorum(digests)
+        if not minority:
+            return None
+        _metrics.inc("integrity.fingerprint_mismatch")
+        self.last_minority = minority
+        from ..observability import flight as _flight
+        dump = _flight.dump("replica_divergence",
+                            extra={"step": int(step), "rank": rank,
+                                   "minority_ranks": minority,
+                                   "digests": {str(r): d for r, d
+                                               in digests.items()}})
+        _trace.instant("replica_divergence",
+                       args={"step": int(step),
+                             "minority": ",".join(map(str, minority))},
+                       cat="resilience")
+        err = ReplicaDivergenceError(minority, step, digests,
+                                     dump_path=dump)
+        if not self.heal or snapshots is None:
+            raise err
+        return self._quorum_restore(scope, snapshots, digests,
+                                    quorum_digest, err)
+
+    def _quorum_restore(self, scope, snapshots: SnapshotManager,
+                        digests: Dict[int, str], quorum_digest: str,
+                        err: ReplicaDivergenceError) -> int:
+        """Heal round: the lowest quorum rank broadcasts its newest clean
+        snapshot; EVERY rank restores it, so the whole group replays from
+        the same bit-identical state (a minority-only restore would leave
+        the group skewed across later collective rounds). Raises the
+        original error when the quorum holds no snapshot to restore."""
+        rank = self.gloo.rank
+        root = min(r for r, d in digests.items() if d == quorum_digest)
+        snapshots.wait()
+        snap = snapshots.latest()
+        mine = (None if snap is None
+                else (snap.step, {n: np.asarray(a)
+                                  for n, a in snap.arrays.items()}))
+        payload = self.gloo.broadcast(mine, root=root)
+        if payload is None:
+            raise err
+        step, arrays = int(payload[0]), payload[1]
+        Snapshot(step, arrays, rank=root).restore(scope)
+        _metrics.inc("integrity.quorum_restores")
+        _trace.instant("quorum_restore",
+                       args={"from_rank": root, "step": step,
+                             "rank": rank}, cat="resilience")
+        return step
+
+
+class RollbackExhausted(EnforceNotMet):
+    """The poison-batch rollback budget ran out — the instability is not
+    a transient bad batch; fail loudly with the history."""
+
+    code = ErrorCode.PRECONDITION_NOT_MET
+
+
+class TrainingGuard:
+    """NaN/Inf + loss-spike sentinel with bounded snapshot rollback.
+
+        guard = TrainingGuard(mgr, program=prog, scope=scope)
+        for step in guard.steps(total):
+            out, = exe.run(feed=feed(step), fetch_list=[loss])
+            guard.observe(step, float(np.asarray(out).ravel()[0]))
+
+    `steps` yields the batch schedule; when `observe` flags a poisoned
+    step k, the guard restores the last good snapshot (step s0 <= k),
+    and the generator rewinds to s0+1 — REPLAYING the clean batches
+    s0+1..k-1 and SKIPPING batch k. Determinism makes the net effect
+    bit-identical to a schedule that never contained batch k. Spike
+    rule: loss > spike_factor x trailing-window median (NaN/Inf always
+    fires); skipped/replayed losses never enter the window twice.
+    """
+
+    def __init__(self, snapshots: SnapshotManager, program=None, scope=None,
+                 spike_factor: Optional[float] = None, window: int = 8,
+                 budget: Optional[int] = None):
+        from ..flags import flag
+        from ..framework.program import default_main_program
+        from ..framework.scope import global_scope
+        self.snapshots = snapshots
+        self.program = program or default_main_program()
+        self.scope = scope or global_scope()
+        self.spike_factor = (float(flag("FLAGS_loss_spike_factor"))
+                             if spike_factor is None else float(spike_factor))
+        self.budget = (int(flag("FLAGS_rollback_budget"))
+                       if budget is None else int(budget))
+        self.window: deque = deque(maxlen=max(2, int(window)))
+        self.skip: set = set()
+        self.rollbacks = 0
+        self._rewind_to: Optional[int] = None
+        self._history: list = []
+
+    def _poisoned(self, loss: float) -> Optional[str]:
+        if not np.isfinite(loss):
+            return "non-finite"
+        if self.spike_factor > 0 and len(self.window) >= 2:
+            med = float(np.median(self.window))
+            if med > 0 and loss > self.spike_factor * med:
+                return (f"spike {loss:.6g} > {self.spike_factor:g} x "
+                        f"median {med:.6g}")
+        return None
+
+    def observe(self, step: int, loss: float) -> bool:
+        """Feed the sentinel the step's loss. Returns True when the step
+        was poisoned (the generator will rewind); clean losses enter the
+        spike window."""
+        why = self._poisoned(float(loss))
+        if why is None:
+            self.window.append(float(loss))
+            return False
+        self._history.append((int(step), float(loss), why))
+        if self.rollbacks >= self.budget:
+            raise RollbackExhausted(
+                "poisoned step %d (%s) but the rollback budget (%d) is "
+                "exhausted; poison history: %s"
+                % (step, why, self.budget, self._history))
+        self.snapshots.wait()
+        snap = self.snapshots.latest()
+        if snap is None or snap.step > step:
+            raise RollbackExhausted(
+                "poisoned step %d (%s) with no snapshot at or before it "
+                "(newest: %s) — raise FLAGS_snapshot_steps cadence"
+                % (step, why, None if snap is None else snap.step))
+        snap.restore(self.scope)
+        self.skip.add(int(step))
+        self.rollbacks += 1
+        self._rewind_to = snap.step
+        _metrics.inc("integrity.rollbacks")
+        _trace.instant("rollback", args={"poison_step": int(step),
+                                         "to_step": snap.step,
+                                         "why": why}, cat="resilience")
+        return True
+
+    def steps(self, total: int, start: int = 0):
+        """The rollback-aware schedule: yields step indices [start,
+        total), rewinding past a rollback and skipping poisoned steps."""
+        step = start
+        while step < total:
+            if step in self.skip:
+                step += 1
+                continue
+            yield step
+            if self._rewind_to is not None:
+                step = self._rewind_to + 1
+                self._rewind_to = None
+                continue
+            step += 1

@@ -453,6 +453,42 @@ def collect_serving_chaos(proc, timeout=1200) -> bool:
     return proc.returncode == 0
 
 
+# Integrity drill (ISSUE-16 CI satellite): scripts/chaos_smoke.py
+# --integrity-drill — four legs over resilience/snapshot.py +
+# integrity.py (docs/resilience.md "Snapshots & integrity"): (A) a
+# 2-rank gang loses rank 1 mid-run and the full-world relaunch resumes
+# it from its buddy's peer-replicated snapshot bit-identically, no disk
+# checkpoint; (B) a silent bit flip in one rank's Adam moment is named
+# by the divergence sentinel within one fingerprint interval and
+# quorum-healed; (C) a NaN batch rolls back + skips bit-identically to
+# the never-poisoned schedule; (D) async snapshot capture stays within
+# 5% mean step-time overhead. Overlapped with the shards
+# (--no-integrity-drill to skip).
+def start_integrity_drill(env):
+    script = os.path.join(ROOT, "scripts", "chaos_smoke.py")
+    return subprocess.Popen(
+        [sys.executable, script, "--integrity-drill"],
+        cwd=ROOT, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+
+
+def collect_integrity_drill(proc, timeout=1200) -> bool:
+    try:
+        out_s, err_s = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        print(f"[integrity-drill] FAIL timed out after {timeout}s")
+        return False
+    lines = (out_s or "").strip().splitlines()
+    status = "OK " if proc.returncode == 0 else "FAIL"
+    body = "\n".join("    " + ln for ln in lines[-10:])
+    tail = (err_s or "").strip().splitlines()[-25:]
+    print(f"[integrity-drill] {status}\n{body}" + (
+        "\n" + "\n".join(tail) if proc.returncode != 0 else ""))
+    return proc.returncode == 0
+
+
 def shard(files, n):
     """LPT bin packing by weight."""
     bins = [(0.0, []) for _ in range(n)]
@@ -500,6 +536,11 @@ def main():
                          "mid-decode -> failover bit-parity + "
                          "resurrection, scripts/chaos_smoke.py "
                          "--serving-drill)")
+    ap.add_argument("--no-integrity-drill", action="store_true",
+                    help="skip the integrity drill (peer-snapshot "
+                         "recovery + divergence sentinel + poison-batch "
+                         "rollback + snapshot overhead budget, "
+                         "scripts/chaos_smoke.py --integrity-drill)")
     ap.add_argument("--no-pod-trace", action="store_true",
                     help="skip the pod-trace smoke (2-process supervised "
                          "gang -> merged timeline + straggler report, "
@@ -540,6 +581,9 @@ def main():
     chaos_proc = None
     if not args.no_serving_chaos:
         chaos_proc = start_serving_chaos(env)      # overlaps the shards too
+    integrity_proc = None
+    if not args.no_integrity_drill:
+        integrity_proc = start_integrity_drill(env)   # overlaps the shards
 
     files = sorted(glob.glob(os.path.join(ROOT, "tests", "test_*.py")))
     shards = shard(files, args.n)
@@ -599,6 +643,8 @@ def main():
         failed = failed or not collect_serving_smoke(serving_proc)
     if chaos_proc is not None:
         failed = failed or not collect_serving_chaos(chaos_proc)
+    if integrity_proc is not None:
+        failed = failed or not collect_integrity_drill(integrity_proc)
     print(f"CI total: {time.time() - t0:.0f}s over {len(shards)} shards -> "
           f"{'FAILED' if failed else 'PASSED'}")
     return 1 if failed else 0

@@ -3,10 +3,16 @@ semantic roles (reference book/test_recommender_system.py,
 notest_understand_sentiment.py, test_label_semantic_roles.py) — with these,
 every reference book chapter has a training test."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.fluid as fluid
 from paddle_tpu.fluid import layers
+
+# Tier-1 rebalance (ISSUE 16): heaviest suite in the sweep (~170s) and the
+# layer/training surface it covers is already exercised by test_book_models
+# + the op-level suites; ci.py shards still run it on every CI pass.
+pytestmark = pytest.mark.slow
 
 
 def test_recommender_system_dual_tower():

@@ -29,6 +29,11 @@ from conftest import cpu_mesh_env
 import paddle_tpu as paddle
 import paddle_tpu.fluid as fluid
 
+# Tier-1 rebalance (ISSUE 16): ~87s of CPU-mesh subprocesses whose budget
+# assertions are re-run by ci.py's collective-audit drill
+# (scripts/collective_audit.py --assert) on every CI pass.
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -13,6 +13,11 @@ import paddle_tpu.fluid as fluid
 from paddle_tpu.fluid import layers
 from paddle_tpu.testing import reset_programs
 
+# Tier-1 rebalance (ISSUE 16): ~42s of end-to-end detection training whose
+# constituent ops are pinned cheaply by test_detection_assign_ops +
+# test_detection_train_ops; ci.py shards still run it on every CI pass.
+pytestmark = pytest.mark.slow
+
 
 def _feed_rcnn(rng, b=2):
     gt = np.zeros((b, 3, 4), np.float32)

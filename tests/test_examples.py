@@ -11,6 +11,11 @@ import pytest
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLES = sorted(glob.glob(os.path.join(ROOT, "examples", "0*.py")))
 
+# Tier-1 rebalance (ISSUE 16): ~51s of real-subprocess example runs; each
+# example's API surface is unit-covered, and ci.py shards (which run the
+# slow tier) keep the front door green on every CI pass.
+pytestmark = pytest.mark.slow
+
 
 @pytest.mark.parametrize("script", EXAMPLES,
                          ids=[os.path.basename(p) for p in EXAMPLES])

@@ -1,0 +1,268 @@
+"""Divergence sentinel + poison-batch rollback (resilience/integrity.py).
+
+The dp-replication determinism contract makes every check exact: a
+fingerprint mismatch IS corruption, and a rollback's resumed trajectory
+must match the skip-oracle bit-for-bit.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.observability import metrics
+from paddle_tpu.resilience import (DivergenceSentinel, ReplicaDivergenceError,
+                                   RollbackExhausted, Snapshot,
+                                   SnapshotManager, TrainingGuard,
+                                   fingerprint)
+from paddle_tpu.resilience.integrity import _split_quorum
+
+
+def _build_net():
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    h = layers.fc(x, 8, act="tanh")
+    p = layers.fc(h, 1)
+    loss = layers.reduce_mean(layers.square_error_cost(p, y))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    return exe, fluid.default_main_program(), paddle.global_scope(), loss
+
+
+def _feed(step, poison=False):
+    x = np.random.RandomState(100 + step).randn(8, 4).astype(np.float32)
+    y = np.random.RandomState(200 + step).randn(8, 1).astype(np.float32)
+    if poison:
+        x = x.copy()
+        x[0, 0] = np.nan
+    return {"x": x, "y": y}
+
+
+# ---------------------------------------------------------------------------
+# fingerprint
+# ---------------------------------------------------------------------------
+
+def test_fingerprint_exact_sensitivity():
+    _, prog, scope, _ = _build_net()
+    base = fingerprint(prog, scope)
+    assert fingerprint(prog, scope) == base      # deterministic
+    name = next(n for n in scope._vars if n.endswith("w_0"))
+    orig = np.asarray(scope.find(name))
+    flipped = orig.copy()
+    flipped.flat[0] = np.nextafter(flipped.flat[0], np.inf)  # 1-ulp SDC
+    scope.set(name, flipped)
+    assert fingerprint(prog, scope) != base      # any bit flips the digest
+    scope.set(name, orig)
+    assert fingerprint(prog, scope) == base      # and it is pure
+
+
+def test_split_quorum_majority_and_lowest_rank_tiebreak():
+    assert _split_quorum({0: "a", 1: "a", 2: "b"}) == ("a", [2])
+    assert _split_quorum({0: "a", 1: "b", 2: "b"}) == ("b", [0])
+    # 1-vs-1 tie: the group holding the lowest rank is the quorum, so
+    # every rank computes the SAME verdict (required for the heal round)
+    assert _split_quorum({0: "a", 1: "b"}) == ("a", [1])
+    assert _split_quorum({0: "a", 1: "a"}) == ("a", [])
+
+
+# ---------------------------------------------------------------------------
+# divergence sentinel (stub transport; real gloo in the chaos drill)
+# ---------------------------------------------------------------------------
+
+class _StubGloo:
+    def __init__(self, rank, world, gathered, bcast=None):
+        self.rank, self.world = rank, world
+        self._gathered = gathered
+        self._bcast = bcast
+
+    def all_gather(self, value):
+        return self._gathered(value)
+
+    def broadcast(self, value, root=0):
+        return self._bcast(value, root) if self._bcast else value
+
+
+def test_sentinel_names_minority_rank_with_typed_error():
+    _, prog, scope, _ = _build_net()
+    metrics.reset()
+    mine = fingerprint(prog, scope)
+    gloo = _StubGloo(0, 3, lambda v: [(0, mine), (1, mine),
+                                      (2, "0" * 64)])
+    sent = DivergenceSentinel(gloo, interval=2, heal=False)
+    assert sent.check(prog, scope, 3) is None    # off-cadence: no round
+    with pytest.raises(ReplicaDivergenceError) as exc:
+        sent.check(prog, scope, 4)
+    assert exc.value.minority_ranks == [2]
+    assert exc.value.step == 4
+    assert "rank" in str(exc.value) and "[2]" in str(exc.value)
+    assert metrics.get("integrity.fingerprint_mismatch") == 1
+
+
+def test_sentinel_agreement_is_silent():
+    _, prog, scope, _ = _build_net()
+    mine = fingerprint(prog, scope)
+    gloo = _StubGloo(1, 2, lambda v: [(0, mine), (1, mine)])
+    sent = DivergenceSentinel(gloo, interval=1, heal=False)
+    assert sent.check(prog, scope, 1) is None
+    assert sent.last_minority == []
+
+
+def test_quorum_heal_restores_every_rank_bit_identically(tmp_path):
+    """On mismatch with a SnapshotManager, check() broadcasts the lowest
+    quorum rank's newest snapshot and restores it locally, returning the
+    replay-from step."""
+    exe, prog, scope, loss = _build_net()
+    metrics.reset()
+    mgr = SnapshotManager(interval=2, root=str(tmp_path), rank=1, world=2)
+    try:
+        for s in range(1, 5):
+            exe.run(prog, feed=_feed(s), fetch_list=[loss])
+            mgr.maybe_capture(prog, scope, s, sync=True)
+        clean = fingerprint(prog, scope)
+        # corrupt THIS rank (rank 1): one ulp in one optimizer moment
+        name = next(n for n in scope._vars if "moment" in n or
+                    n.endswith("w_0"))
+        bad = np.asarray(scope.find(name)).copy()
+        bad.flat[0] = np.nextafter(bad.flat[0], np.inf)
+        scope.set(name, bad)
+        assert fingerprint(prog, scope) != clean
+
+        # the quorum (rank 0) broadcasts its own snapshot — in a real gang
+        # it is bit-identical to this rank's, so reuse mgr's payload
+        def bcast(value, root):
+            assert root == 0               # lowest quorum rank
+            snap = mgr.latest()
+            return (snap.step, {n: np.asarray(a)
+                                for n, a in snap.arrays.items()})
+
+        gloo = _StubGloo(1, 2,
+                         lambda v: [(0, clean), (1, v[1])], bcast=bcast)
+        sent = DivergenceSentinel(gloo, interval=2)
+        healed = sent.check(prog, scope, 4, snapshots=mgr)
+        assert healed == 4                 # newest snapshot step
+        assert sent.last_minority == [1]
+        assert metrics.get("integrity.quorum_restores") == 1
+        # replaying from the healed snapshot reconverges bit-identically
+        assert fingerprint(prog, scope) == clean
+    finally:
+        mgr.close()
+
+
+def test_heal_without_quorum_snapshot_raises_original_error():
+    _, prog, scope, _ = _build_net()
+    gloo = _StubGloo(0, 2, lambda v: [(0, v[1]), (1, "f" * 64)],
+                     bcast=lambda value, root: None)
+    sent = DivergenceSentinel(gloo, interval=1)
+    mgr = SnapshotManager(rank=0, world=2)   # empty: nothing to heal from
+    try:
+        with pytest.raises(ReplicaDivergenceError):
+            sent.check(prog, scope, 1, snapshots=mgr)
+    finally:
+        mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# TrainingGuard: poison-batch rollback
+# ---------------------------------------------------------------------------
+
+def test_nan_rollback_is_bit_identical_to_skipping_the_batch(tmp_path):
+    poison = 5
+    # run A: batch 5 NaN-poisoned; the guard rolls back + skips it
+    exe, prog, scope, loss = _build_net()
+    metrics.reset()
+    mgr = SnapshotManager(interval=2, root=str(tmp_path), rank=0, world=1)
+    guard = TrainingGuard(mgr, program=prog, scope=scope, budget=2)
+    losses_a = {}
+    try:
+        for s in guard.steps(9, start=1):
+            out, = exe.run(prog, feed=_feed(s, poison=(s == poison)),
+                           fetch_list=[loss])
+            lv = float(np.asarray(out).ravel()[0])
+            if not guard.observe(s, lv):
+                losses_a[s] = lv
+                mgr.maybe_capture(prog, scope, s, sync=True)
+        fp_a = fingerprint(prog, scope)
+    finally:
+        mgr.close()
+    assert guard.rollbacks == 1 and guard.skip == {poison}
+    assert metrics.get("integrity.rollbacks") == 1
+
+    # run B: the oracle that never saw batch 5
+    from paddle_tpu.framework import program as prog_mod
+    from paddle_tpu.framework import scope as scope_mod
+    from paddle_tpu.framework import unique_name
+    prog_mod._main_program = prog_mod.Program()
+    prog_mod._startup_program = prog_mod.Program()
+    scope_mod._reset_global_scope()
+    unique_name.switch()
+    np.random.seed(0)
+    exe, prog, scope, loss = _build_net()
+    losses_b = {}
+    for s in range(1, 9):
+        if s == poison:
+            continue
+        out, = exe.run(prog, feed=_feed(s), fetch_list=[loss])
+        losses_b[s] = float(np.asarray(out).ravel()[0])
+    fp_b = fingerprint(prog, scope)
+
+    post_a = {s: v for s, v in losses_a.items() if s > poison}
+    post_b = {s: v for s, v in losses_b.items() if s > poison}
+    assert post_a == post_b                # losses bit-identical after skip
+    assert fp_a == fp_b                    # and so is the final state
+
+
+def test_loss_spike_triggers_rollback():
+    mgr = SnapshotManager(rank=0, world=1)
+    guard = TrainingGuard(mgr, spike_factor=10.0, budget=1)
+    try:
+        snap_holder = Snapshot(2, {})
+        mgr._buffers[0] = snap_holder
+        mgr._newest = 0
+        for s, lv in [(1, 1.0), (2, 0.9)]:
+            assert not guard.observe(s, lv)
+        assert guard.observe(3, 50.0)      # 50 > 10 x median(~0.95)
+        assert guard.skip == {3}
+    finally:
+        mgr.close()
+
+
+def test_rollback_budget_exhaustion_raises():
+    mgr = SnapshotManager(rank=0, world=1)
+    mgr._buffers[0] = Snapshot(1, {})
+    mgr._newest = 0
+    guard = TrainingGuard(mgr, budget=0)
+    try:
+        with pytest.raises(RollbackExhausted):
+            guard.observe(2, float("nan"))
+    finally:
+        mgr.close()
+
+
+def test_rollback_without_snapshot_raises():
+    mgr = SnapshotManager(rank=0, world=1)   # never captured
+    guard = TrainingGuard(mgr, budget=3)
+    try:
+        with pytest.raises(RollbackExhausted):
+            guard.observe(2, float("inf"))
+    finally:
+        mgr.close()
+
+
+def test_steps_generator_rewinds_and_skips():
+    mgr = SnapshotManager(rank=0, world=1)
+    guard = TrainingGuard(mgr, budget=3)
+    mgr._buffers[0] = Snapshot(2, {})
+    mgr._newest = 0
+    visited = []
+    try:
+        for s in guard.steps(7, start=1):
+            visited.append(s)
+            if s == 4 and 4 not in guard.skip:
+                guard.observe(4, float("nan"))
+            else:
+                guard.observe(s, 1.0)
+    finally:
+        mgr.close()
+    # 1,2,3,4 then rollback-to-2 -> replay 3, skip 4, continue 5,6
+    assert visited == [1, 2, 3, 4, 3, 5, 6]

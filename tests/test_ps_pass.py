@@ -11,6 +11,12 @@ import paddle_tpu as paddle
 import paddle_tpu.fluid as fluid
 from paddle_tpu.fluid import layers
 from paddle_tpu.framework.program import OpRole
+
+# Tier-1 rebalance (ISSUE 16): the ~53s live-server end-to-end test
+# dominates this file; the pass-pipeline op assertions it rides on are
+# cheap but the kvstore wire surface is already pinned by test_ps_kvstore.
+# ci.py shards still run it on every CI pass.
+pytestmark = pytest.mark.slow
 from paddle_tpu.testing import reset_programs
 
 VOCAB, DIM, SLOTS, B = 50, 4, 3, 16

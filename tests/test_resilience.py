@@ -250,8 +250,18 @@ def test_gloo_exchange_retries_injected_faults():
 
 
 def test_gloo_round_deadline_raises_typed_error():
-    from paddle_tpu.distributed.gloo import Gloo
-    g = Gloo(rank=0, world_size=2, op_timeout_s=0.3)   # rank 1 never comes
+    from paddle_tpu.distributed.gloo import Gloo, _Store
+    # Host the store with a generous round timeout and dial it as a
+    # non-root rank whose op timeout is tight: the CLIENT deadline always
+    # fires first. (A rank-0 Gloo with op_timeout_s=0.3 gives its embedded
+    # store the same 0.3s round timeout, and when the store's timer wins
+    # the race it closes the socket — the client then sees a raw
+    # ConnectionError instead of the typed deadline. Timing flake, not the
+    # contract under test.)
+    store = _Store(world_size=2, round_timeout_s=5.0)
+    g = Gloo(rank=1, world_size=2,
+             store_addr=f"127.0.0.1:{store.port}",
+             op_timeout_s=0.3)                 # rank 0 never joins the round
     t0 = time.monotonic()
     try:
         with pytest.raises(DeadlineExceededError):
@@ -259,6 +269,7 @@ def test_gloo_round_deadline_raises_typed_error():
         assert time.monotonic() - t0 < 10.0
     finally:
         g.close()
+        store.stop()
 
 
 # ---------------------------------------------------------------------------
@@ -281,7 +292,14 @@ class _SquaresDS(paddle.io.Dataset):
 def test_dataloader_worker_kill_is_respawned_bounded_counted():
     from paddle_tpu.dataloader.dataloader import (_MultiprocessIter,
                                                   default_collate_fn)
-    install_plan("dataloader.worker:kill:at=3")
+    # The delay rule fires before the kill on the same call: a bare kill
+    # can os._exit while the worker's mp.Queue feeder thread is mid-flush
+    # HOLDING the data queue's shared write lock, which orphans the lock
+    # and wedges every later incarnation's put() — a real SIGKILL hazard,
+    # but not the respawn path under test. The pre-kill delay lets the
+    # feeder drain + release so the kill only ever costs owed batches.
+    install_plan("dataloader.worker:delay=0.25:at=3;"
+                 "dataloader.worker:kill:at=3")
     batches = [[i, i + 1] for i in range(0, 10, 2)]
     # budget > worst case: a kill can outrun the dead worker's queue-feeder
     # flush, losing its delivered-but-unflushed batches too, so one at=3

@@ -1,0 +1,282 @@
+"""Async in-memory snapshots + recovery ladder (resilience/snapshot.py).
+
+Everything here is exact: restore-and-replay must land bit-identically on
+the uninterrupted run's state (np.array_equal / fingerprint equality, no
+tolerances) — the determinism contract that makes just-in-time
+checkpointing verifiable rather than approximate.
+"""
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+from paddle_tpu.observability import metrics
+from paddle_tpu.resilience import (CheckpointManager, FaultInjected,
+                                   Snapshot, SnapshotManager, clear_plan,
+                                   install_plan, read_recovery_stamps,
+                                   recover)
+from paddle_tpu.resilience.integrity import fingerprint
+
+
+def _build_sgd_net():
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    h = layers.fc(x, 8, act="tanh")
+    p = layers.fc(h, 1)
+    loss = layers.reduce_mean(layers.square_error_cost(p, y))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    return exe, fluid.default_main_program(), paddle.global_scope(), loss
+
+
+def _feed(step):
+    return {"x": np.random.RandomState(100 + step).randn(8, 4)
+            .astype(np.float32),
+            "y": np.random.RandomState(200 + step).randn(8, 1)
+            .astype(np.float32)}
+
+
+def test_capture_cadence_and_double_buffer(tmp_path):
+    exe, prog, scope, loss = _build_sgd_net()
+    metrics.reset()
+    mgr = SnapshotManager(interval=2, root=str(tmp_path), rank=0, world=1)
+    try:
+        seen = []
+        for s in range(1, 7):
+            exe.run(prog, feed=_feed(s), fetch_list=[loss])
+            if mgr.maybe_capture(prog, scope, s, sync=True):
+                seen.append(mgr.latest().step)
+        assert seen == [2, 4, 6]          # cadence, newest always complete
+        assert metrics.get("resilience.snapshots") == 3
+        snap = mgr.latest()
+        assert snap.step == 6
+        assert "__rng_state__" in snap.arrays   # replay needs the key chain
+        # the buffers hold the two newest — the standby is the previous one
+        steps = sorted(b.step for b in mgr._buffers if b is not None)
+        assert steps == [4, 6]
+    finally:
+        mgr.close()
+
+
+def test_restore_and_replay_is_bit_identical(tmp_path):
+    exe, prog, scope, loss = _build_sgd_net()
+    mgr = SnapshotManager(interval=3, root=str(tmp_path), rank=0, world=1)
+    try:
+        for s in range(1, 6):
+            exe.run(prog, feed=_feed(s), fetch_list=[loss])
+            mgr.maybe_capture(prog, scope, s, sync=True)
+        oracle = fingerprint(prog, scope)
+        snap = mgr.latest()
+        assert snap.step == 3
+        snap.restore(scope)               # rewind to step 3 ...
+        for s in range(4, 6):             # ... and replay 4..5
+            exe.run(prog, feed=_feed(s), fetch_list=[loss])
+        assert fingerprint(prog, scope) == oracle
+    finally:
+        mgr.close()
+
+
+def test_executor_drives_capture_via_flag(tmp_path):
+    from paddle_tpu.flags import set_flags
+    exe, prog, scope, loss = _build_sgd_net()
+    set_flags({"FLAGS_snapshot_steps": 2,
+               "FLAGS_snapshot_dir": str(tmp_path)})
+    try:
+        for s in range(5):
+            exe.run(prog, feed=_feed(s), fetch_list=[loss])
+        assert exe.snapshots is not None
+        exe.snapshots.wait()
+        assert exe.snapshots.latest() is not None
+        # the executor's own step counter tags the snapshot
+        assert exe.snapshots.latest().step % 2 == 0
+    finally:
+        set_flags({"FLAGS_snapshot_steps": 0, "FLAGS_snapshot_dir": ""})
+        exe.close()                        # uninstalls the SIGTERM hook
+
+
+def test_flag_driven_tags_count_program_runs_not_executor_steps(tmp_path):
+    """The snapshot tag must equal the TRAINING program's own run count —
+    the executor-wide step counter also ticks for the startup program (and
+    any eval program), and a recover()ed tag that is shifted against the
+    trainer's batch schedule makes bit-identical replay impossible."""
+    from paddle_tpu.flags import set_flags
+    set_flags({"FLAGS_snapshot_steps": 3,
+               "FLAGS_snapshot_dir": str(tmp_path)})
+    try:
+        # flags on BEFORE startup: the startup run goes through the same
+        # executor and must NOT consume a snapshot-step tick
+        exe, prog, scope, loss = _build_sgd_net()
+        for s in range(1, 5):
+            exe.run(prog, feed=_feed(s), fetch_list=[loss])
+        exe.snapshots.wait()
+        snap = exe.snapshots.latest()
+        assert snap.step == 3                  # run count, not counter=5
+        want = {n: np.asarray(scope.find(n))
+                for n in snap.arrays if n != "__rng_state__"}
+        # replaying step 4 from the tag-3 snapshot reconverges exactly
+        snap.restore(scope)
+        exe.run(prog, feed=_feed(4), fetch_list=[loss])
+        for n, a in want.items():
+            np.testing.assert_array_equal(np.asarray(scope.find(n)), a)
+    finally:
+        set_flags({"FLAGS_snapshot_steps": 0, "FLAGS_snapshot_dir": ""})
+        exe.close()
+
+
+def test_flush_recover_ladder_local_rung(tmp_path):
+    exe, prog, scope, loss = _build_sgd_net()
+    mgr = SnapshotManager(interval=2, root=str(tmp_path), rank=0, world=1)
+    try:
+        for s in range(1, 5):
+            exe.run(prog, feed=_feed(s), fetch_list=[loss])
+            mgr.maybe_capture(prog, scope, s, sync=True)
+        want = {n: np.asarray(a) for n, a in mgr.latest().arrays.items()}
+        assert mgr.flush("test") is not None
+    finally:
+        mgr.close()
+    from paddle_tpu.framework import scope as scope_mod
+    scope_mod._reset_global_scope()
+    scope2 = paddle.global_scope()
+    rung, step = recover(scope2, root=str(tmp_path), rank=0)
+    assert (rung, step) == ("local", 4)
+    for n, a in want.items():
+        got = scope2.find(n)
+        from paddle_tpu.resilience.snapshot import rng_to_host
+        np.testing.assert_array_equal(rng_to_host(got), a)
+    stamps = read_recovery_stamps(str(tmp_path))
+    assert [(r["rank"], r["rung"], r["step"]) for r in stamps] \
+        == [(0, "local", 4)]
+
+
+def test_peer_rung_wins_over_local_and_disk(tmp_path):
+    """The ladder prefers the buddy-flushed payload — the only rung with
+    zero checkpoint-interval loss for a REPLACED host."""
+    arrays_peer = {"w": np.full(3, 7.0, np.float32)}
+    arrays_local = {"w": np.zeros(3, np.float32)}
+    # buddy (rank 1) flushed rank 0's payload before dying
+    holder = SnapshotManager(root=str(tmp_path), rank=1, world=2)
+    holder._peer = Snapshot(9, arrays_peer, rank=0)
+    holder.flush("buddy_sigterm")
+    holder.close()
+    # rank 0 also has an (older) local flush
+    own = SnapshotManager(root=str(tmp_path), rank=0, world=2)
+    own._buffers[0] = Snapshot(5, arrays_local, rank=0)
+    own._newest = 0
+    own.flush("local")
+    own.close()
+    scope = paddle.global_scope()
+    rung, step = recover(scope, root=str(tmp_path), rank=0)
+    assert (rung, step) == ("peer", 9)
+    np.testing.assert_array_equal(np.asarray(scope.find("w")),
+                                  arrays_peer["w"])
+
+
+def test_replicate_retains_ring_buddy_payload(tmp_path):
+    """replicate() is one all-gather: rank r keeps (r-1) % world's
+    snapshot. Exercised with a stub transport (the drill covers real
+    gloo at world 2)."""
+    payloads = {0: (4, {"w": np.float32([1, 2])}),
+                1: (4, {"w": np.float32([3, 4])})}
+
+    class StubGloo:
+        def all_gather(self, value):
+            return [payloads[0], payloads[1]]
+
+    mgr = SnapshotManager(root=str(tmp_path), rank=0, world=2)
+    try:
+        assert mgr.replicate(StubGloo()) == 4
+        peer = mgr.peer_payload()
+        assert peer.rank == 1             # ring buddy of rank 0 at world 2
+        np.testing.assert_array_equal(peer.arrays["w"],
+                                      payloads[1][1]["w"])
+    finally:
+        mgr.close()
+
+
+def test_sigterm_flushes_newest_snapshot(tmp_path):
+    mgr = SnapshotManager(root=str(tmp_path), rank=0, world=1)
+    mgr._buffers[0] = Snapshot(3, {"w": np.float32([1, 2, 3])})
+    mgr._newest = 0
+    mgr.install_sigterm_flush()
+    try:
+        with pytest.raises(SystemExit) as exc:
+            os.kill(os.getpid(), signal.SIGTERM)
+        assert exc.value.code == 128 + signal.SIGTERM
+    finally:
+        mgr.close()                        # also restores prev handler
+    scope = paddle.global_scope()
+    rung, step = recover(scope, root=str(tmp_path), rank=0, stamp=False)
+    assert (rung, step) == ("local", 3)
+    np.testing.assert_array_equal(np.asarray(scope.find("w")),
+                                  np.float32([1, 2, 3]))
+
+
+def test_torn_flush_keeps_previous_snapshot_bit_for_bit(tmp_path):
+    """SIGTERM-during-snapshot contract: a flush killed mid-write (here:
+    injected fault at the ckpt.write site, which fires after the data
+    bytes but before the manifest publishes) must leave the PREVIOUS
+    flushed snapshot restorable, bit-for-bit."""
+    good = {"w": np.float32([[1.5, -2.5], [3.5, 4.5]]),
+            "m": np.arange(6, dtype=np.float32)}
+    mgr = SnapshotManager(root=str(tmp_path), rank=0, world=1)
+    try:
+        mgr._buffers[0] = Snapshot(2, good)
+        mgr._newest = 0
+        assert mgr.flush("clean") is not None
+        # newer snapshot, but its flush tears mid-write
+        mgr._buffers[1] = Snapshot(4, {"w": np.zeros((2, 2), np.float32),
+                                       "m": np.zeros(6, np.float32)})
+        mgr._newest = 1
+        install_plan("ckpt.write:error:at=1")
+        with pytest.raises(FaultInjected):
+            mgr.flush("torn")
+    finally:
+        clear_plan()
+        mgr.close()
+    scope = paddle.global_scope()
+    rung, step = recover(scope, root=str(tmp_path), rank=0, stamp=False)
+    assert (rung, step) == ("local", 2)    # torn step-4 flush skipped
+    for n, a in good.items():
+        np.testing.assert_array_equal(np.asarray(scope.find(n)), a)
+
+
+def test_sigterm_mid_write_falls_back_via_handler(tmp_path):
+    """Same contract driven through the SIGNAL path: the handler's flush
+    tears, the handler still chains + exits, and recovery restores the
+    previous good snapshot."""
+    good = {"w": np.float32([9, 8, 7])}
+    mgr = SnapshotManager(root=str(tmp_path), rank=0, world=1)
+    mgr._buffers[0] = Snapshot(1, good)
+    mgr._newest = 0
+    mgr.flush("clean")
+    mgr._buffers[1] = Snapshot(3, {"w": np.zeros(3, np.float32)})
+    mgr._newest = 1
+    mgr.install_sigterm_flush()
+    install_plan("ckpt.write:error:at=1")
+    try:
+        with pytest.raises(SystemExit):
+            os.kill(os.getpid(), signal.SIGTERM)
+    finally:
+        clear_plan()
+        mgr.close()
+    scope = paddle.global_scope()
+    rung, step = recover(scope, root=str(tmp_path), rank=0, stamp=False)
+    assert (rung, step) == ("local", 1)
+    np.testing.assert_array_equal(np.asarray(scope.find("w")), good["w"])
+
+
+def test_recover_disk_rung_and_empty_ladder(tmp_path):
+    ckpt = CheckpointManager(str(tmp_path / "ckpt"), max_keep=2)
+    ckpt.save(7, arrays={"w": np.float32([1, 1])})
+    scope = paddle.global_scope()
+    rung, step = recover(scope, root=str(tmp_path / "snap"), rank=0,
+                         ckpt_manager=ckpt, stamp=False)
+    assert (rung, step) == ("disk", 7)
+    rung, step = recover(scope, root=str(tmp_path / "nothing"), rank=0,
+                         stamp=False)
+    assert (rung, step) == (None, None)    # fresh start, no rung
