@@ -30,6 +30,32 @@ def _peak_flops():
     return float(os.environ.get("BENCH_PEAK_TFLOPS", "197")) * 1e12
 
 
+def _peak_hbm_bw():
+    """HBM bandwidth peak in bytes/s (BENCH_PEAK_HBM_GBPS, default
+    v5e=819): the roofline denominator for bandwidth-bound rows — decode
+    reads every cache/weight byte per token, the fused optimizer update
+    reads each bucket once — mirroring _peak_flops for compute-bound
+    ones."""
+    return float(os.environ.get("BENCH_PEAK_HBM_GBPS", "819")) * 1e9
+
+
+def _roofline(cost: dict, step_time_s) -> dict:
+    """Per-kernel roofline evidence (docs/perf_notes.md 'Pallas kernels'):
+    XLA's own cost-analysis flops/bytes denominators over the measured
+    step time, as fractions of the chip peaks. Fields the backend didn't
+    report are absent, never fabricated."""
+    out = {}
+    if not cost or not step_time_s or step_time_s <= 0:
+        return out
+    if cost.get("device_flops"):
+        out["pct_of_peak_flops"] = round(
+            cost["device_flops"] / step_time_s / _peak_flops(), 4)
+    if cost.get("device_bytes_accessed"):
+        out["pct_of_peak_hbm_bw"] = round(
+            cost["device_bytes_accessed"] / step_time_s / _peak_hbm_bw(), 4)
+    return out
+
+
 def _fresh_programs():
     from paddle_tpu.testing import reset_programs
     reset_programs(seed=0)
@@ -373,7 +399,13 @@ def bench_bert(batch, seq_len, steps, masked=False, large=False,
     mfu = tokens_per_sec * 6.0 * n_params / peak
     _stash_opt_state_report(fluid.default_main_program(), exe, np_feed,
                             loss)
-    return tokens_per_sec, mfu
+    try:
+        # measured roofline row for the compiled train step (device
+        # flops/bytes from XLA cost analysis over the per-step time)
+        cost = exe.annotate_step_cost(feed=np_feed, fetch_list=[loss])
+    except Exception:
+        cost = {}
+    return tokens_per_sec, mfu, _roofline(cost, dt / steps)
 
 
 def bench_gpt(batch, seq_len, steps):
@@ -497,55 +529,85 @@ def bench_serving(streams_levels=(1, 8, 32), dtypes=("bfloat16",),
     blocks_per_slot = max_len // block_size
     rng = np.random.RandomState(0)
     rows = []
+    # the fused-kernel A/B arm: PADDLE_TPU_PALLAS_DECODE pins one arm
+    # when set, else every dtype runs the fallback AND the Pallas kernel
+    # so the table carries the comparison directly
+    if "PADDLE_TPU_PALLAS_DECODE" in os.environ:
+        kernel_arms = (os.environ["PADDLE_TPU_PALLAS_DECODE"] == "1",)
+    else:
+        kernel_arms = (False, True)
     for dtype in dtypes:
-        engine = DecodeEngine(
-            params, cfg, max_slots=max_slots, block_size=block_size,
-            num_blocks=max_slots * blocks_per_slot + 1, max_len=max_len,
-            window=int(os.environ.get("BENCH_SERVING_WINDOW", "16")),
-            dtype=dtype)
-        # the zero-copy claim ships WITH the number: a row recorded from a
-        # window program that silently regressed into copying the cache
-        # would not be a serving benchmark at all
-        census = serving_audit.decode_copy_census(engine)
-        # warm: compile prefill + window before any timed arm
-        engine.generate([Request(
-            prompt=rng.randint(0, cfg.vocab_size, (prompt_len,)),
-            max_new_tokens=2)], timeout=600)
-        for streams in streams_levels:
-            _obs_metrics.reset("serving.ttft_ms")
-            _obs_metrics.reset("serving.tpot_ms")
-            reqs = [Request(
+        for use_kernel in kernel_arms:
+            engine = DecodeEngine(
+                params, cfg, max_slots=max_slots, block_size=block_size,
+                num_blocks=max_slots * blocks_per_slot + 1, max_len=max_len,
+                window=int(os.environ.get("BENCH_SERVING_WINDOW", "16")),
+                dtype=dtype, decode_kernel=use_kernel)
+            # the zero-copy claim ships WITH the number (fallback arm: a
+            # window program that silently regressed into copying the
+            # cache would not be a serving benchmark at all) and so does
+            # the kernel proof (kernel arm: the dense cache-view census
+            # must be empty — serving/audit.py)
+            gather = serving_audit.decode_gather_census(engine)
+            census = (None if use_kernel
+                      else serving_audit.decode_copy_census(engine))
+            # warm: compile prefill + window before any timed arm
+            engine.generate([Request(
                 prompt=rng.randint(0, cfg.vocab_size, (prompt_len,)),
-                max_new_tokens=new_tokens, seed=i)
-                for i in range(streams)]
-            t0 = time.perf_counter()
-            comps = engine.generate(reqs, timeout=1200)
-            dt = time.perf_counter() - t0
-            n_tok = sum(len(c.tokens) for c in comps)
-            bad = sum(not c.ok for c in comps)
-            snap = _obs_metrics.snapshot()
-            ttft = snap.get("serving.ttft_ms", {})
-            tpot = snap.get("serving.tpot_ms", {})
-            row = {
-                "metric": "serving_decode_tokens_per_sec",
-                "value": round(n_tok / dt, 1), "unit": "tokens/s",
-                "streams": streams, "dtype": dtype,
-                "prompt_len": prompt_len, "new_tokens": new_tokens,
-                "ttft_p50_ms": (round(ttft["p50"], 2)
-                                if ttft.get("p50") is not None else None),
-                "ttft_p99_ms": (round(ttft["p99"], 2)
-                                if ttft.get("p99") is not None else None),
-                "tpot_p50_ms": (round(tpot["p50"], 2)
-                                if tpot.get("p50") is not None else None),
-                "per_token_kv_copies": census["per_token_kv_copies"],
-            }
-            if bad:
-                row["failed_requests"] = bad
-            rows.append(row)
-            _log(f"serving[{dtype}] streams={streams}: "
-                 f"{row['value']} tok/s, TTFT p50={row['ttft_p50_ms']} "
-                 f"p99={row['ttft_p99_ms']} ms")
-        engine.stop()
+                max_new_tokens=2)], timeout=600)
+            try:
+                ca = serving_audit.window_cost(engine)
+            except Exception:
+                ca = {}
+            for streams in streams_levels:
+                _obs_metrics.reset("serving.ttft_ms")
+                _obs_metrics.reset("serving.tpot_ms")
+                _obs_metrics.reset("serving.window_ms")
+                reqs = [Request(
+                    prompt=rng.randint(0, cfg.vocab_size, (prompt_len,)),
+                    max_new_tokens=new_tokens, seed=i)
+                    for i in range(streams)]
+                t0 = time.perf_counter()
+                comps = engine.generate(reqs, timeout=1200)
+                dt = time.perf_counter() - t0
+                n_tok = sum(len(c.tokens) for c in comps)
+                bad = sum(not c.ok for c in comps)
+                snap = _obs_metrics.snapshot()
+                ttft = snap.get("serving.ttft_ms", {})
+                tpot = snap.get("serving.tpot_ms", {})
+                wms = snap.get("serving.window_ms", {})
+                row = {
+                    "metric": "serving_decode_tokens_per_sec",
+                    "value": round(n_tok / dt, 1), "unit": "tokens/s",
+                    "streams": streams, "dtype": dtype,
+                    "prompt_len": prompt_len, "new_tokens": new_tokens,
+                    "pallas_decode": use_kernel,
+                    "dense_gathers": gather["dense_gathers"],
+                    "ttft_p50_ms": (round(ttft["p50"], 2)
+                                    if ttft.get("p50") is not None
+                                    else None),
+                    "ttft_p99_ms": (round(ttft["p99"], 2)
+                                    if ttft.get("p99") is not None
+                                    else None),
+                    "tpot_p50_ms": (round(tpot["p50"], 2)
+                                    if tpot.get("p50") is not None
+                                    else None),
+                }
+                if census is not None:
+                    row["per_token_kv_copies"] = \
+                        census["per_token_kv_copies"]
+                # per-window roofline: decode is HBM-bound, so the
+                # %-of-peak-BW row is the one that moves with the kernel
+                if wms.get("p50"):
+                    row.update(_roofline(ca, wms["p50"] / 1e3))
+                if bad:
+                    row["failed_requests"] = bad
+                rows.append(row)
+                _log(f"serving[{dtype} kernel={int(use_kernel)}] "
+                     f"streams={streams}: {row['value']} tok/s, "
+                     f"TTFT p50={row['ttft_p50_ms']} "
+                     f"p99={row['ttft_p99_ms']} ms")
+            engine.stop()
     return rows
 
 
@@ -1003,6 +1065,7 @@ def main():
         errors.append(f"backend init: {init_err!r}")
 
     tokens_per_sec = mfu = None
+    step_roofline = {}
     health_tflops = None
     hbm_gbps = None
 
@@ -1095,7 +1158,8 @@ def main():
         # (device grant revoked) shouldn't zero the round either
         for attempt in (1, 2):
             try:
-                tokens_per_sec, mfu = bench_bert(batch, seq_len, steps)
+                tokens_per_sec, mfu, step_roofline = bench_bert(
+                    batch, seq_len, steps)
                 break
             except Exception as e:
                 print(f"bert bench attempt {attempt} failed: {e!r}",
@@ -1122,7 +1186,7 @@ def main():
     if tokens_per_sec is not None and which in ("all", "masked") \
             and _row_ok("masked"):
         try:
-            tps_m, mfu_m = bench_bert(batch, seq_len, steps, masked=True)
+            tps_m, mfu_m, _ = bench_bert(batch, seq_len, steps, masked=True)
             extras.append({
                 "metric": "bert_base_masked_pretrain_tokens_per_sec_per_chip",
                 "value": round(tps_m, 1), "unit": "tokens/s",
@@ -1137,9 +1201,9 @@ def main():
             # (gated off below PADDLE_TPU_FLASH_MIN_SEQ=512 where dense XLA
             # wins) — this row certifies the in-kernel mask+dropout flash
             # path on hardware at the seq lengths it exists for
-            tps_l, mfu_l = bench_bert(int(os.environ.get("BENCH_LONG_BATCH",
-                                                         "16")),
-                                      1024, max(steps // 2, 5), masked=True)
+            tps_l, mfu_l, _ = bench_bert(
+                int(os.environ.get("BENCH_LONG_BATCH", "16")),
+                1024, max(steps // 2, 5), masked=True)
             extras.append({
                 "metric": "bert_base_seq1024_flash_tokens_per_sec_per_chip",
                 "value": round(tps_l, 1), "unit": "tokens/s",
@@ -1153,7 +1217,7 @@ def main():
             # BERT/ERNIE-large geometry (BASELINE config 4 / the named
             # 'BERT-large tokens/sec/chip' metric): per-layer remat keeps
             # batch 64 resident, see docs/perf_notes.md
-            tps_xl, mfu_xl = bench_bert(
+            tps_xl, mfu_xl, _ = bench_bert(
                 int(os.environ.get("BENCH_LARGE_BATCH", "64")),
                 seq_len, max(steps // 2, 5), large=True,
                 recompute=os.environ.get("BENCH_LARGE_REMAT", "1") == "1")
@@ -1303,6 +1367,15 @@ def main():
     rec["async_dispatch"] = os.environ.get("PADDLE_TPU_ASYNC", "0") == "1"
     # ... and so is the ZeRO arm (PADDLE_TPU_ZERO=0|1|2|3 -> zero_stage)
     rec["zero_stage"] = _zero_stage()
+    # ... and the Pallas kernel arms (ops/pallas/): the fused
+    # paged-attention decode and fused ZeRO optimizer-update toggles
+    rec["pallas_decode"] = os.environ.get(
+        "PADDLE_TPU_PALLAS_DECODE", "0") == "1"
+    rec["pallas_opt"] = os.environ.get("PADDLE_TPU_PALLAS_OPT", "0") == "1"
+    # measured roofline of the primary train step (XLA cost-analysis
+    # flops/bytes over per-step time vs chip peaks): with pallas_opt on,
+    # the optimizer's bytes term drops to one pass per flat bucket
+    rec.update(step_roofline)
     if skipped_rows:
         rec["skipped_rows"] = skipped_rows
     if health_tflops is not None:

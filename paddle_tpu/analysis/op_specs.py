@@ -300,19 +300,24 @@ SPECS: Dict[str, OpSpec] = {
     # sharding "replicated": serving parallelism is whole-model replicas
     # behind the round-robin frontend (serving/frontend.py) — the pools
     # and page tables are per-replica state, never mesh-sharded.
+    # kv_scale (static dequant scale) flips the pools to int8 KV;
+    # use_kernel / max_blocks pick the fused-Pallas read path and bound
+    # the page-table walk (ops/pallas/paged_attention.py) — all three are
+    # trace-time-static attrs, so the specs stay closed.
     "paged_cache_update": OpSpec(
         inputs={"KPool": ONE, "VPool": ONE, "KNew": ONE, "VNew": ONE,
                 "PageTable": ONE, "Pos": ONE},
         outputs={"KPoolOut": ONE, "VPoolOut": ONE},
         required_attrs=("block_size",),
-        attr_types={"block_size": int},
+        attr_types={"block_size": int, "kv_scale": _NUM},
         closed_attrs=True, sharding="replicated"),
     "paged_attention": OpSpec(
         inputs={"Q": ONE, "KPool": ONE, "VPool": ONE, "PageTable": ONE,
                 "Pos": ONE},
         outputs={"Out": ONE},
         required_attrs=("block_size",),
-        attr_types={"block_size": int},
+        attr_types={"block_size": int, "use_kernel": bool,
+                    "max_blocks": int, "kv_scale": _NUM},
         closed_attrs=True, sharding="replicated"),
     # --- decode/search ops (ops/decode_ops.py) ---------------------------
     "linear_chain_crf": OpSpec(

@@ -166,6 +166,20 @@ _DEFS: Dict[str, tuple] = {
                                        "SIGTERM grace usually bounds it "
                                        "tighter via PADDLE_LAUNCH_"
                                        "GRACE_S)"),
+    # --- Pallas kernel tier (ops/pallas/, docs/perf_notes.md) ------------
+    "FLAGS_pallas_decode": (False, "serve decode attention through the "
+                            "fused paged-attention Pallas kernel "
+                            "(ops/pallas/paged_attention.py): page-table "
+                            "walk in-kernel, no dense cache-view "
+                            "materialization, bit-identical to the "
+                            "paged_attend fallback. Env twin for A/B "
+                            "benching: PADDLE_TPU_PALLAS_DECODE=0|1"),
+    "FLAGS_pallas_opt": (False, "run the shard-local ZeRO bucket update "
+                         "through the fused optimizer kernel "
+                         "(ops/pallas/zero_update.py): one HBM pass per "
+                         "bucket, bit-identical to the registry rules, "
+                         "checkpoint-portable both directions. Env twin "
+                         "for A/B benching: PADDLE_TPU_PALLAS_OPT=0|1"),
     # --- resilience tier (resilience/, docs/resilience.md) ---------------
     "FLAGS_fault_plan": ("", "fault-injection plan spec, e.g. "
                              "'kv.pull:error:every=3;ckpt.write:kill:at=2'"),

@@ -111,7 +111,13 @@ def _block(x, p, i, cfg, mask, merge=None):
     keys/values to the pair attention runs against: prefill passes None
     (attend against this pass's own k/v); decode passes a hook that
     writes the new position into the running cache and returns the
-    merged cache. Returns (x_out, (k, v)) with the attended pair."""
+    merged cache. A merge may instead return a CALLABLE attend
+    override ctx_fn(q) -> ctx — the paged decode path uses this so the
+    fused paged-attention kernel (and the int8-KV folded read) can
+    attend straight off the block pool without a dense merged view;
+    `mask` is then the override's responsibility. Returns
+    (x_out, (k, v)) with the attended pair (the fresh pair under an
+    override)."""
     nh, h = cfg.num_heads, cfg.hidden_size
     hd = h // nh
     a = _ln(x, p[f"dec{i}_ln1_scale"], p[f"dec{i}_ln1_bias"])
@@ -120,8 +126,13 @@ def _block(x, p, i, cfg, mask, merge=None):
     q = _split_heads(q, nh)
     k_new = _split_heads(k_new, nh)
     v_new = _split_heads(v_new, nh)
-    k, v = (k_new, v_new) if merge is None else merge(k_new, v_new)
-    ctx = _attend(q, k, v, mask, 1.0 / math.sqrt(hd))
+    merged = (k_new, v_new) if merge is None else merge(k_new, v_new)
+    if callable(merged):
+        k, v = k_new, v_new
+        ctx = merged(q)
+    else:
+        k, v = merged
+        ctx = _attend(q, k, v, mask, 1.0 / math.sqrt(hd))
     proj = _merge_heads(ctx) @ p[f"dec{i}_attn_proj_w"] \
         + p[f"dec{i}_attn_proj_b"]
     x = x + proj

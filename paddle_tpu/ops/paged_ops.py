@@ -26,6 +26,22 @@ Two consumers, ONE implementation:
 Block 0 of the pool is the SCRATCH block: retired/inactive slots' page
 tables point at it and their (discarded) writes land there, so a frozen
 row can never corrupt a live sequence's blocks.
+
+Two decode-read implementations, ONE contract:
+
+* the dense fallback below (`paged_gather` + `paged_attend`) — the
+  bit-parity ORACLE, optionally bounded to the first `max_blocks` page
+  columns (never-written tail blocks carry exactly-zero softmax weight,
+  so the bound is bit-neutral);
+* the fused Pallas kernel (`ops/pallas/paged_attention.py`) — walks the
+  page table inside the kernel, no dense view, selected per-call via the
+  `use_kernel` attr / PADDLE_TPU_PALLAS_DECODE. tests/test_pallas_kernels
+  pins the two bit-identical.
+
+int8-KV pools store abs-max-quantized blocks (`quantize_kv`); BOTH read
+paths fold the dequant multiplier outside the contractions (see
+ops/pallas/paged_attention.kv_dequant_scale for the bit-stability
+argument), and `kv_scale` is a static engine knob, not per-tensor state.
 """
 from __future__ import annotations
 
@@ -36,54 +52,106 @@ import jax.numpy as jnp
 from .registry import register
 
 SCRATCH_BLOCK = 0
+_KV_MAX_RANGE = 127.0   # int8 abs-max range, = int8_ops dequantize default
+
+
+def quantize_kv(x, kv_scale) -> jnp.ndarray:
+    """Abs-max int8 KV quantization (serving/weights.quantize_params
+    math with a STATIC scale): values are clipped to [-kv_scale,
+    kv_scale] and rounded onto the 255-level grid."""
+    q = jnp.round(x.astype(jnp.float32) * (_KV_MAX_RANGE / float(kv_scale)))
+    return jnp.clip(q, -_KV_MAX_RANGE, _KV_MAX_RANGE).astype(jnp.int8)
+
+
+def dequant_kv(x, kv_scale) -> jnp.ndarray:
+    """Materialized int8-KV dequant (the dequantize_abs_max math) — the
+    reference form for tests; the attention paths fold the multiplier
+    post-dot instead of calling this per element."""
+    return x.astype(jnp.float32) * (float(kv_scale) / _KV_MAX_RANGE)
 
 
 def paged_update(k_pool, v_pool, k_new, v_new, page_table, pos,
-                 block_size: int, layer: int, active=None):
+                 block_size: int, layer: int, active=None, kv_scale=None):
     """Write one new position's k/v for every slot into the block pool.
 
     k_pool/v_pool: [L, NB, nh, bs, hd]; k_new/v_new: [B, nh, hd];
     page_table: [B, MB] int32 block ids; pos: [B] int32 write positions.
     `active` ([B] bool, optional) redirects frozen rows' writes to the
-    scratch block. Returns the updated (k_pool, v_pool)."""
+    scratch block. int8 pools quantize on write with the static
+    `kv_scale`. Returns the updated (k_pool, v_pool)."""
     b = page_table.shape[0]
     blk = page_table[jnp.arange(b), pos // block_size]
     if active is not None:
         blk = jnp.where(active, blk, SCRATCH_BLOCK)
     off = pos % block_size
+    if k_pool.dtype == jnp.int8:
+        if kv_scale is None:
+            raise ValueError("int8 KV pools need a static kv_scale")
+        k_new = quantize_kv(k_new, kv_scale)
+        v_new = quantize_kv(v_new, kv_scale)
     k_pool = k_pool.at[layer, blk, :, off].set(k_new.astype(k_pool.dtype))
     v_pool = v_pool.at[layer, blk, :, off].set(v_new.astype(v_pool.dtype))
     return k_pool, v_pool
 
 
-def paged_gather(pool, page_table, layer: int):
+def paged_gather(pool, page_table, layer: int, max_blocks=None):
     """Reassemble each slot's dense [nh, max_len, hd] cache view from its
     blocks. pool: [L, NB, nh, bs, hd]; page_table: [B, MB] ->
     [B, nh, MB*bs, hd]. Position p lives in block p//bs at offset p%bs —
     the same mapping paged_update writes, so the gathered view is
-    bit-identical to a dense ring cache holding the same positions."""
-    blocks = pool[layer][page_table]            # [B, MB, nh, bs, hd]
+    bit-identical to a dense ring cache holding the same positions.
+
+    `max_blocks` (static int) bounds the gather to the first max_blocks
+    page columns — the engine passes ceil((max(pos)+1)/bs) so the
+    fallback stops reading blocks no slot has ever written."""
+    if max_blocks is not None:
+        page_table = page_table[:, :int(max_blocks)]
+    blocks = pool[layer][page_table]            # [B, MB', nh, bs, hd]
     b, mb, nh, bs, hd = blocks.shape
     return blocks.transpose(0, 2, 1, 3, 4).reshape(b, nh, mb * bs, hd)
 
 
 def paged_attend(q, k_pool, v_pool, page_table, pos, block_size: int,
-                 layer: int = 0, scale=None):
+                 layer: int = 0, scale=None, max_blocks=None,
+                 kv_scale=None):
     """Single-token paged attention: q [B, nh, 1, hd] against each slot's
     gathered cache, masked to positions <= pos. Bit-compatible with a
     dense cache holding the same values by construction: the score/softmax
     /context math IS models/gpt_decode._attend (imported, not copied),
     and masked positions get exactly-zero softmax weight, so stale block
-    content cannot perturb the result."""
+    content cannot perturb the result. `max_blocks` bounds the gather
+    (bit-neutral — see paged_gather); int8 pools take the folded-dequant
+    read path and return an f32 context."""
     from ..models.gpt_decode import _attend  # lazy: avoid an import cycle
-    k = paged_gather(k_pool, page_table, layer)
-    v = paged_gather(v_pool, page_table, layer)
+    k = paged_gather(k_pool, page_table, layer, max_blocks=max_blocks)
+    v = paged_gather(v_pool, page_table, layer, max_blocks=max_blocks)
     max_len = k.shape[2]
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     mask = jnp.where(jnp.arange(max_len)[None, :] <= pos[:, None],
                      0.0, -jnp.inf).astype(jnp.float32)[:, None, None, :]
+    if k_pool.dtype == jnp.int8:
+        if kv_scale is None:
+            raise ValueError("int8 KV pools need a static kv_scale")
+        # folded int8 contract: exact convert, dequant multiplier applied
+        # post-dot (scores via the scale argument, context afterwards) —
+        # bit-identical to the fused kernel's int8 arm by construction
+        c = float(kv_scale) / _KV_MAX_RANGE
+        ctx = _attend(q, k.astype(jnp.float32), v.astype(jnp.float32),
+                      mask, scale * c)
+        return ctx * c
     return _attend(q, k, v, mask, scale)
+
+
+def fused_attend(q, k_pool, v_pool, page_table, pos, block_size: int,
+                 layer: int = 0, scale=None, max_blocks=None,
+                 kv_scale=None):
+    """The fused-kernel twin of `paged_attend` (same signature, same
+    bits): one Pallas kernel walking the page table — no dense view."""
+    from .pallas.paged_attention import fused_paged_attention
+    return fused_paged_attention(
+        q, k_pool, v_pool, page_table, pos, block_size=block_size,
+        layer=layer, scale=scale, max_blocks=max_blocks, kv_scale=kv_scale)
 
 
 # ---------------------------------------------------------------------------
@@ -108,8 +176,10 @@ def _paged_cache_update(ctx, ins, attrs):
     nh = kp.shape[2]
     k1 = _split_heads_flat(ins["KNew"][0], nh)
     v1 = _split_heads_flat(ins["VNew"][0], nh)
+    kv_scale = attrs.get("kv_scale")
     kp, vp = paged_update(kp, vp, k1, v1, pt, pos,
-                          int(attrs["block_size"]), layer=0)
+                          int(attrs["block_size"]), layer=0,
+                          kv_scale=kv_scale)
     return {"KPoolOut": [kp], "VPoolOut": [vp]}
 
 
@@ -117,12 +187,26 @@ def _paged_cache_update(ctx, ins, attrs):
           nondiff_slots=("KPool", "VPool", "PageTable", "Pos"))
 def _paged_attention(ctx, ins, attrs):
     """Q [B, nh*hd] attends each slot's paged cache (positions <= Pos);
-    returns the merged-head context [B, nh*hd]."""
+    returns the merged-head context [B, nh*hd].
+
+    Optional attrs: `use_kernel` (bool; default = the
+    PADDLE_TPU_PALLAS_DECODE / FLAGS_pallas_decode toggle) picks the
+    fused Pallas kernel over the dense-gather fallback — same bits
+    either way; `max_blocks` (int) bounds the page-table walk;
+    `kv_scale` (float) is the static int8-KV dequant scale."""
     kp, vp = ins["KPool"][0], ins["VPool"][0]
     pt = ins["PageTable"][0].astype(jnp.int32)
     pos = ins["Pos"][0].reshape(-1).astype(jnp.int32)
     nh = kp.shape[2]
     q = _split_heads_flat(ins["Q"][0], nh)[:, :, None, :]   # [B, nh, 1, hd]
-    ctx_ = paged_attend(q, kp, vp, pt, pos, int(attrs["block_size"]))
+    max_blocks = attrs.get("max_blocks")
+    kv_scale = attrs.get("kv_scale")
+    use_kernel = attrs.get("use_kernel")
+    if use_kernel is None:
+        from .pallas.paged_attention import decode_kernel_enabled
+        use_kernel = decode_kernel_enabled()
+    attend = fused_attend if use_kernel else paged_attend
+    ctx_ = attend(q, kp, vp, pt, pos, int(attrs["block_size"]),
+                  max_blocks=max_blocks, kv_scale=kv_scale)
     b, _, _, hd = ctx_.shape
     return {"Out": [ctx_.transpose(0, 2, 1, 3).reshape(b, nh * hd)]}

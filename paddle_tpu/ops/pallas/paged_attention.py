@@ -1,0 +1,259 @@
+"""Fused paged-attention decode kernel (Pallas, TPU).
+
+The jnp oracle (ops/paged_ops.paged_attend) re-materializes every slot's
+FULL dense cache view per layer per token — `paged_gather` reshapes the
+pool into [B, nh, MB*bs, hd] in HBM before the attention einsums ever run.
+Decode is memory-bandwidth-bound, so that gather IS the tokens/s tax
+(PagedAttention, Kwon et al. SOSP '23; the kernel design follows the
+jax/vLLM TPU formulation).
+
+This kernel fuses gather + score + softmax + context into ONE pallas_call
+that walks each slot's page-table row with scalar prefetch:
+
+* grid (B, nh, MB): the page table and positions ride SMEM ahead of the
+  body, so the k/v BlockSpec index_map picks each step's POOL BLOCK
+  directly — the dense view never exists, in HBM or anywhere else;
+* blocks past a slot's write frontier (j*bs > pos) clamp their index map
+  to the previous block — consecutive identical indices make the Mosaic
+  pipeline ELIDE the DMA, so out-of-range blocks cost no HBM traffic —
+  and skip compute via pl.when;
+* scores land in a VMEM row initialized to -inf; masked lanes keep the
+  oracle's exact -inf, so the final full-row jax.nn.softmax + context
+  matmul run over bit-identical values at bit-identical width. The
+  softmax is deliberately the full-row form rather than a cross-block
+  online rescale: rescaling reorders the f32 sums, and the serving
+  contract (docs/serving.md) pins BITWISE parity against the oracle —
+  exp/sum over rows whose extra lanes are exactly 0.0 is bit-stable, a
+  cross-block alpha-weighted accumulation is not. The VMEM row costs
+  max_len*4 + max_len*hd*dtype bytes per (slot, head) step — ~1 MB at
+  max_len 2048 / hd 128 — well inside the 16 MB budget;
+* the int8-KV arm converts blocks to f32 IN-KERNEL (exact) and folds
+  the dequantize_abs_max multiplier (scale/127, ops/int8_ops.py) to the
+  post-dot position — the form that is bit-stable across XLA fusion
+  contexts; see kv_dequant_scale for why the naive per-element dequant
+  is not.
+
+Runs under interpret=True on CPU (jax.default_backend() == "cpu" or
+PADDLE_TPU_PALLAS_INTERPRET=1) so the tier-1 parity matrix
+(tests/test_pallas_kernels.py) pins the kernel bit-for-bit against
+paged_attend on every suite run.
+"""
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# API drift shim shared with flash_attention.py
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
+_INT8_MAX_RANGE = 127.0   # dequantize_abs_max max_range (ops/int8_ops.py)
+
+
+def _interpret():
+    return (os.environ.get("PADDLE_TPU_PALLAS_INTERPRET") == "1"
+            or jax.default_backend() == "cpu")
+
+
+def decode_kernel_enabled() -> bool:
+    """The serving A/B toggle: PADDLE_TPU_PALLAS_DECODE=1 (bench arm /
+    env) or FLAGS_pallas_decode (programmatic). Read at engine build /
+    trace time — flipping it invalidates nothing already compiled."""
+    if os.environ.get("PADDLE_TPU_PALLAS_DECODE", "") == "1":
+        return True
+    try:
+        from ...flags import flag
+        return bool(flag("FLAGS_pallas_decode"))
+    except Exception:
+        return False
+
+
+def kv_dequant_scale(kv_scale) -> float:
+    """The int8-KV dequant multiplier — the dequantize_abs_max math
+    (ops/int8_ops.py): payload * scale / 127.
+
+    The int8-KV attention CONTRACT (shared with paged_ops.paged_attend's
+    int8 arm) folds this multiplier to the OUTSIDE of both contractions:
+
+        scores = dot(q, int8->f32(K)) * (attn_scale * c)
+        ctx    = dot(probs, int8->f32(V)) * c
+
+    rather than dequantizing per element before the dot. int8->f32 is
+    exact, so the dot runs over exactly-representable values, and a
+    post-dot scalar multiply is XLA's canonical form — the algebraic
+    simplifier has nothing to reassociate. The naive per-element form is
+    NOT bit-stable across fusion contexts: XLA hoists `dot(q, k * c)` to
+    `dot(q, k) * c` when the dequant fuses into the score dot, drifting
+    1 ulp between kernel and oracle (and optimization_barrier has no
+    Mosaic lowering, so it cannot pin the naive form on real TPU)."""
+    return float(kv_scale) / _INT8_MAX_RANGE
+
+
+def _paged_decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                         scores_ref, v_ref_acc, *, block_size, num_blocks,
+                         grid_blocks, scale, kv_scale):
+    """One (slot, head, block) grid step — float-pool arm.
+
+    pt_ref/pos_ref: SMEM scalar-prefetch ([B, MB] / [B] int32);
+    q_ref [1, hd]; k_ref/v_ref [bs, hd] (this step's pool block);
+    o_ref [1, hd]; scratch: scores_ref [1, MB*bs] f32 (persists across
+    the block dimension), v_ref_acc [MB*bs, hd] (the VMEM-resident value
+    row — never HBM)."""
+    del kv_scale
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    p = pos_ref[b]
+    bs = block_size
+
+    @pl.when(j == 0)
+    def _init():
+        # -inf scores == the oracle's additive mask at full width: lanes
+        # never written (masked or out-of-range) contribute exp(-inf)=0
+        # to the softmax sum, bit-identical to paged_attend's masked row
+        scores_ref[...] = jnp.full_like(scores_ref, -jnp.inf)
+        v_ref_acc[...] = jnp.zeros_like(v_ref_acc)
+
+    @pl.when(j * bs <= p)
+    def _block():
+        k = k_ref[...]
+        v = v_ref[...]
+        q = q_ref[...]
+        # same contraction as the oracle's score einsum: f32 accumulate
+        s = jnp.einsum("qd,kd->qk", q, k,
+                       preferred_element_type=jnp.float32) * scale
+        kpos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
+        s = jnp.where(kpos <= p, s, -jnp.inf)
+        scores_ref[0, pl.ds(j * bs, bs)] = s[0]
+        v_ref_acc[pl.ds(j * bs, bs), :] = v.astype(v_ref_acc.dtype)
+
+    @pl.when(j == grid_blocks - 1)
+    def _finish():
+        row = scores_ref[...]                                  # [1, K]
+        probs = jax.nn.softmax(row, axis=-1)
+        vals = v_ref_acc[...]
+        # the oracle's context einsum: probs cast to the value dtype
+        out = jnp.einsum("qk,kd->qd", probs.astype(vals.dtype), vals)
+        o_ref[...] = out.astype(o_ref.dtype)
+
+
+def _paged_decode_kernel_int8(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                              k_ref_acc, v_ref_acc, *, block_size,
+                              num_blocks, grid_blocks, scale, kv_scale):
+    """int8-pool arm. Block steps only STAGE the exact int8->f32 converts
+    into VMEM scratch; the score dot, mask, softmax and context all run
+    at the final step over the materialized rows. Deferral is what makes
+    the arm bit-stable: a convert feeding a dot in the same fusion
+    context lets XLA re-order the contraction (1-ulp drift vs the
+    oracle), while a scratch round-trip across grid steps pins the
+    converted values before any contraction sees them."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    p = pos_ref[b]
+    bs = block_size
+
+    @pl.when(j == 0)
+    def _init():
+        # zeros (not garbage) so masked lanes stay finite pre-mask
+        k_ref_acc[...] = jnp.zeros_like(k_ref_acc)
+        v_ref_acc[...] = jnp.zeros_like(v_ref_acc)
+
+    @pl.when(j * bs <= p)
+    def _block():
+        k_ref_acc[pl.ds(j * bs, bs), :] = k_ref[...].astype(jnp.float32)
+        v_ref_acc[pl.ds(j * bs, bs), :] = v_ref[...].astype(jnp.float32)
+
+    @pl.when(j == grid_blocks - 1)
+    def _finish():
+        q = q_ref[...]
+        krow = k_ref_acc[...]                                  # [K, hd]
+        # folded int8 contract (kv_dequant_scale): dequant multiplier
+        # rides the post-dot scale, mirroring paged_attend's int8 arm
+        c = kv_scale / _INT8_MAX_RANGE
+        s = jnp.einsum("qd,kd->qk", q, krow,
+                       preferred_element_type=jnp.float32) * (scale * c)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kpos <= p, s, -jnp.inf)
+        probs = jax.nn.softmax(s, axis=-1)
+        vals = v_ref_acc[...]
+        out = jnp.einsum("qk,kd->qd", probs.astype(vals.dtype), vals) * c
+        o_ref[...] = out.astype(o_ref.dtype)
+
+
+def fused_paged_attention(q, k_pool, v_pool, page_table, pos, *,
+                          block_size: int, layer: int = 0, scale=None,
+                          max_blocks=None, kv_scale=None, interpret=None):
+    """Fused single-token paged attention.
+
+    q [B, nh, 1, hd]; k_pool/v_pool [L, NB, nh, bs, hd] (float, or int8
+    with `kv_scale` set); page_table [B, MB] int32; pos [B] int32.
+    Returns the context [B, nh, 1, hd] bit-identical (f32 path) to
+    `paged_attend(q, k_pool, v_pool, page_table, pos, ...)`.
+
+    `max_blocks` (static) bounds the page-table WALK — the scratch row
+    stays full width so the softmax denominators match the oracle at any
+    hint, while blocks >= max_blocks are never visited at all."""
+    b, nh, one, hd = q.shape
+    if one != 1:
+        raise ValueError(f"decode kernel takes a single query token, "
+                         f"got q {q.shape}")
+    mb = page_table.shape[1]
+    bs = int(block_size)
+    if k_pool.shape[3] != bs:
+        raise ValueError(f"pool block dim {k_pool.shape[3]} != "
+                         f"block_size {bs}")
+    if (kv_scale is None) != (k_pool.dtype != jnp.int8):
+        raise ValueError("int8 pools need kv_scale (and only int8 do)")
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+    grid_blocks = mb if max_blocks is None else max(1, min(mb,
+                                                           int(max_blocks)))
+    out_dtype = (jnp.float32 if kv_scale is not None else k_pool.dtype)
+    page_table = page_table.astype(jnp.int32)
+    pos = pos.astype(jnp.int32)
+
+    def block_idx(bi, hi, ji, pt_ref, pos_ref):
+        # clamp the walk to this slot's write frontier: past it the index
+        # repeats the frontier block, so the pipeline skips the DMA
+        jc = jnp.minimum(ji, pos_ref[bi] // bs)
+        return (layer, pt_ref[bi, jc], hi, 0, 0)
+
+    body = (_paged_decode_kernel_int8 if kv_scale is not None
+            else _paged_decode_kernel)
+    kernel = functools.partial(
+        body, block_size=bs, num_blocks=mb, grid_blocks=grid_blocks,
+        scale=scale, kv_scale=None if kv_scale is None else float(kv_scale))
+    if kv_scale is not None:
+        # int8 arm stages BOTH converted rows (see the deferred kernel)
+        scratch = [pltpu.VMEM((mb * bs, hd), jnp.float32),
+                   pltpu.VMEM((mb * bs, hd), jnp.float32)]
+    else:
+        scratch = [pltpu.VMEM((1, mb * bs), jnp.float32),
+                   pltpu.VMEM((mb * bs, hd), out_dtype)]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nh, grid_blocks),
+        in_specs=[
+            pl.BlockSpec((None, None, 1, hd),
+                         lambda bi, hi, ji, pt, ps: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, None, bs, hd), block_idx),
+            pl.BlockSpec((None, None, None, bs, hd), block_idx),
+        ],
+        out_specs=pl.BlockSpec((None, None, 1, hd),
+                               lambda bi, hi, ji, pt, ps: (bi, hi, 0, 0)),
+        scratch_shapes=scratch,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nh, 1, hd), out_dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret() if interpret is None else interpret,
+    )(page_table, pos, q, k_pool, v_pool)
+    return out

@@ -143,6 +143,22 @@ def count_fallback(cause: str) -> None:
                    cat="parallel")
 
 
+def _apply_update_rule(ctx, op_type: str, inner_ins, update_attrs):
+    """The ONE funnel for the shard-local parameter update (both the flat
+    and the @LAYERS-stacked lowerings route through here): dispatch to
+    the fused Pallas bucket kernel (ops/pallas/zero_update.py, one HBM
+    pass per bucket) when PADDLE_TPU_PALLAS_OPT / FLAGS_pallas_opt is on
+    and the op has a fused body, else the registry rule. The two are
+    bit-identical (tests/test_pallas_kernels.py), so flipping the toggle
+    mid-training is checkpoint-portable in both directions."""
+    from ..ops.pallas import zero_update as _zk
+    if _zk.opt_kernel_enabled() and _zk.supports(op_type, inner_ins):
+        from .. import monitor
+        monitor.stat_add("executor.pallas_opt_fused")
+        return _zk.fused_flat_update(op_type, inner_ins, update_attrs)
+    return registry.get(op_type).lower(ctx, inner_ins, update_attrs)
+
+
 # ---------------------------------------------------------------------------
 # manual-mode trace context (set by the shard_map body; read by lowerings)
 # ---------------------------------------------------------------------------
@@ -345,8 +361,8 @@ def _lower_zero_update(ctx, ins, attrs):
     slot_map = _UPDATE_STATE_SLOTS[op_type]
     for kind, val in zip(kinds, state_vals):
         inner_ins[slot_map[kind][0]] = [val]
-    res = registry.get(op_type).lower(ctx, inner_ins,
-                                      dict(attrs["update_attrs"]))
+    res = _apply_update_rule(ctx, op_type, inner_ins,
+                             dict(attrs["update_attrs"]))
 
     p_new = res["ParamOut"][0]
     outs = {}
@@ -404,8 +420,8 @@ def _zero_update_stacked(ctx, ins, attrs):
     slot_map = _UPDATE_STATE_SLOTS[op_type]
     for kind, val in zip(kinds, ins["FlatState"]):
         inner_ins[slot_map[kind][0]] = [val]
-    res = registry.get(op_type).lower(ctx, inner_ins,
-                                      dict(attrs["update_attrs"]))
+    res = _apply_update_rule(ctx, op_type, inner_ins,
+                             dict(attrs["update_attrs"]))
     outs = {"FlatParamOut": [res["ParamOut"][0]],
             "FlatStateOut": [res[slot_map[kind][1]][0] for kind in kinds]}
     if int(attrs.get("stage", 3)) >= 2:

@@ -43,6 +43,28 @@ def window_hlo(engine) -> str:
     return lowered.compile().as_text()
 
 
+def window_cost(engine) -> dict:
+    """XLA cost analysis of the compiled window program — the roofline
+    numerators (device flops / bytes accessed) bench.py divides by the
+    measured window time and the chip peaks. Fields the backend cannot
+    report are absent (same contract as Executor.annotate_step_cost)."""
+    lowered = engine._window_jit.lower(*engine.window_abstract_args())
+    compiled = lowered.compile()
+    cost: dict = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        for src, dst in (("flops", "device_flops"),
+                         ("bytes accessed", "device_bytes_accessed")):
+            v = ca.get(src)
+            if v is not None:
+                cost[dst] = float(v)
+    except Exception:
+        pass
+    return cost
+
+
 def kv_copy_findings(hlo_text: str, pool_shape) -> List[dict]:
     """Every copy-family op whose payload is pool-shaped ([L, NB, nh, bs,
     hd] or one layer's [NB, nh, bs, hd] slice of it). Each finding names
@@ -99,4 +121,83 @@ def assert_zero_kv_copies(engine) -> dict:
         raise AssertionError(
             "per-token KV-cache copies detected in the decode window "
             f"program: {row['kv_copy_findings']}")
+    return row
+
+
+# ---------------------------------------------------------------------------
+# dense-gather census (the fused-kernel proof)
+# ---------------------------------------------------------------------------
+# The fallback attention read (ops/paged_ops.paged_gather + dense attend)
+# has two unmistakable HLO signatures: the 5-D gather intermediate
+# [B, mb', nh, bs, hd] (pool rows pulled per page-table entry) and its
+# reshaped dense cache view [B, nh, mb'*bs, hd]. The fused Pallas kernel
+# never forms either — it walks pool blocks in place — so with the kernel
+# on the compiled window program must carry ZERO instructions producing
+# those shapes. (The kernel's own buffers — q [B, nh, 1, hd], per-block
+# [bs, hd] refs, VMEM scratch rows — match neither pattern, including
+# under interpret-mode lowering, which this census is exercised on in CI.)
+#
+# Census scoping: with the kernel ON under interpret mode (CPU), the
+# emulation lowers pallas_call to an HLO while loop whose carry takes the
+# pool BY VALUE — pool-shaped copies appear that do not exist on real
+# TPU, where the kernel is a custom-call reading the pool in place. The
+# zero-KV-copy pin (assert_zero_kv_copies) therefore gates the fallback /
+# default path, and the kernel-on pin is assert_no_dense_gather.
+
+_RESULT_RE = re.compile(
+    r"^\s*%?[\w\.\-]+\s*=\s*((?:\([^=]*?\))|(?:\S+))\s+[\w\-]+\(")
+
+
+def _result_shapes(line: str):
+    """All shaped elements of an instruction's RESULT type (operand types
+    on the right-hand side are deliberately not scanned)."""
+    m = _RESULT_RE.match(line)
+    if not m:
+        return []
+    return [tuple(int(d) for d in dims.split(",") if d)
+            for _, dims in re.findall(r"(\w+)\[([\d,]*)\]", m.group(1))]
+
+
+def dense_gather_findings(hlo_text: str, engine) -> List[dict]:
+    """Every instruction whose result materializes a dense cache view or
+    its 5-D gather intermediate, at any page-table walk width mb'."""
+    cc = engine.cache.config
+    B = engine.config.max_slots
+    nh, bs, hd = cc.num_heads, cc.block_size, cc.head_dim
+    mb = cc.max_blocks_per_slot
+    dense = {(B, nh, k * bs, hd) for k in range(1, mb + 1)}
+    gather5 = {(B, k, nh, bs, hd) for k in range(1, mb + 1)}
+    findings = []
+    for line in hlo_text.splitlines():
+        for dims in _result_shapes(line):
+            if dims in dense or dims in gather5:
+                findings.append({"dims": dims,
+                                 "line": line.strip()[:200]})
+                break
+    return findings
+
+
+def decode_gather_census(engine) -> dict:
+    """The kernel-proof census row: compile the window program and count
+    dense cache-view materializations. Zero with the fused kernel on;
+    nonzero (the gather + reshape chain) on the fallback path."""
+    txt = window_hlo(engine)
+    findings = dense_gather_findings(txt, engine)
+    return {
+        "decode_kernel": bool(engine.config.decode_kernel),
+        "kv_dtype": engine.config.kv_dtype or "float",
+        "dense_gather_findings": findings,
+        "dense_gathers": len(findings),
+    }
+
+
+def assert_no_dense_gather(engine) -> dict:
+    """Raise if the compiled window program still materializes a dense
+    cache view (the fused kernel is supposed to have replaced it);
+    returns the census row for logging."""
+    row = decode_gather_census(engine)
+    if row["dense_gathers"]:
+        raise AssertionError(
+            "dense cache-view materializations survive in the decode "
+            f"window program: {row['dense_gather_findings'][:4]}")
     return row

@@ -55,7 +55,8 @@ from ..models.gpt_decode import _block, _embed, _ln
 from ..observability import flight as _flight
 from ..observability import metrics as _metrics
 from ..observability import trace as _trace
-from ..ops.paged_ops import paged_gather, paged_update
+from ..ops.paged_ops import (paged_attend, paged_update, fused_attend,
+                             quantize_kv)
 from ..resilience.faults import FaultInjected, fault_point
 from .cache import CacheConfig, PagedKVCache
 from .request import Completion, Request, RequestHandle, RequestState
@@ -79,6 +80,14 @@ class EngineConfig:
     dtype: str = "float32"      # "float32" | "bfloat16" | "int8"
     max_queue: int = 0          # submit-queue bound (admission control);
                                 # 0 = FLAGS_serving_max_queue
+    kv_dtype: str = ""          # "" = compute dtype; "int8" = quantized
+                                # KV pools (abs-max grid, static kv_scale)
+    kv_scale: float = 8.0       # int8-KV abs-max clip range: cache values
+                                # land on the 255-level [-kv_scale,
+                                # kv_scale] grid
+    # None = resolve from PADDLE_TPU_PALLAS_DECODE / FLAGS_pallas_decode
+    # at engine build; True/False pin the attention read path explicitly
+    decode_kernel: Optional[bool] = None
     # set by resolve(): the pre-rounding budget the caller asked for (the
     # max_position guard compares THIS, so re-resolving an already-rounded
     # config — engine clones — never trips it on rounding slack)
@@ -96,6 +105,12 @@ class EngineConfig:
             c.max_queue = int(flag("FLAGS_serving_max_queue"))
         if c.max_len % c.block_size:
             c.max_len += c.block_size - c.max_len % c.block_size
+        if c.kv_dtype not in ("", "int8"):
+            raise ValueError(f"kv_dtype must be '' or 'int8', "
+                             f"got {c.kv_dtype!r}")
+        if c.decode_kernel is None:
+            from ..ops.pallas.paged_attention import decode_kernel_enabled
+            c.decode_kernel = decode_kernel_enabled()
         return c
 
 
@@ -193,18 +208,56 @@ class DecodeEngine:
         self._failover = None
         self._prefill_jits: Dict[int, object] = {}
         self._write_jits: Dict[int, object] = {}
-        self._window_jit = jax.jit(self._window_fn, donate_argnums=(2, 3))
+        # max_blocks (the page-table walk bound) is STATIC: each distinct
+        # hint is one compile, and the hint ladder is power-of-two
+        # bucketed so the compile count is log(max_blocks)-bounded
+        self._window_jit = jax.jit(self._window_fn, donate_argnums=(2, 3),
+                                   static_argnums=(14,))
+
+    def _kv_scale(self) -> Optional[float]:
+        """Static int8-KV dequant scale, None for float pools."""
+        if self.config.kv_dtype == "int8":
+            return float(self.config.kv_scale)
+        return None
+
+    # narrowest page table the bounded-walk hint ladder engages on
+    _LADDER_MIN_BLOCKS = 16
+
+    def _window_max_blocks(self) -> int:
+        """Static hint: the furthest page-table column any slot can touch
+        this window. Both window read paths honor it — the fused kernel
+        bounds its grid, the fallback slices its gather — so short
+        contexts never pay full-`max_len` cache traffic. Rounded up to a
+        power of two (capped at the table width) to bound recompiles:
+        each distinct hint is a new window compile, so the ladder only
+        engages past _LADDER_MIN_BLOCKS columns — below that the bounded
+        walk saves less than one recompile costs and the engine always
+        reads the full (still tiny) table with ONE compiled window."""
+        cfg = self.config
+        mb = cfg.max_len // cfg.block_size
+        if mb <= self._LADDER_MIN_BLOCKS:
+            return mb
+        mx = max((s.pos for s in self._slots.values()), default=None)
+        if mx is None:
+            return mb
+        need = (mx + cfg.window - 1) // cfg.block_size + 1
+        hint = 1
+        while hint < need:
+            hint *= 2
+        return min(mb, hint)
 
     def _build_cache(self) -> PagedKVCache:
         import jax.numpy as jnp
         mc, cfg = self.model_config, self.config
         nh = mc.num_heads
+        pool_dtype = ("int8" if cfg.kv_dtype == "int8"
+                      else str(jnp.dtype(self.compute_dtype)))
         return PagedKVCache(CacheConfig(
             num_layers=mc.num_layers, num_heads=nh,
             head_dim=mc.hidden_size // nh,
             block_size=cfg.block_size, num_blocks=cfg.num_blocks,
             max_blocks_per_slot=cfg.max_len // cfg.block_size,
-            dtype=str(jnp.dtype(self.compute_dtype))))
+            dtype=pool_dtype))
 
     def _set_health(self, state: str):
         if state != self.health:
@@ -249,35 +302,42 @@ class DecodeEngine:
 
     def _window_fn(self, payloads, scales, k_pool, v_pool, page_table,
                    tokens, pos, gen, live, temps, top_ks, seeds, eos_vec,
-                   max_new):
+                   max_new, max_blocks):
         """W decode steps over the slot array (ONE lax.scan). Frozen rows
         (retired/empty slots, eos/length-finished mid-window) keep
         computing — static shapes — but their writes are redirected to the
-        scratch block and their emissions flagged inactive."""
+        scratch block and their emissions flagged inactive.
+
+        `max_blocks` (STATIC, from _window_max_blocks) bounds the
+        page-table walk to blocks any live slot can reach this window —
+        both read paths are bit-identical at any sufficient hint. The
+        attention read itself is an attend override handed to _block:
+        the fused Pallas kernel (config.decode_kernel) or the bounded
+        dense-gather oracle (ops/paged_ops.paged_attend)."""
         import jax
         import jax.numpy as jnp
         cfg = self.model_config
         p = self._model_params(payloads, scales)
         bs = self.config.block_size
-        max_len = self.config.max_len
         n_layers = cfg.num_layers
+        kv_scale = self._kv_scale()
+        attend = fused_attend if self.config.decode_kernel else paged_attend
 
         def step(carry, _):
             k_pool, v_pool, tokens, pos, gen, done = carry
             act = ~done
             x = p["wte"][tokens[:, None]] + p["wpe"][pos][:, None]
-            mask = jnp.where(
-                jnp.arange(max_len)[None, :] <= pos[:, None],
-                0.0, -jnp.inf).astype(jnp.float32)[:, None, None, :]
             pools = [k_pool, v_pool]
             for i in range(n_layers):
                 def merge(k1, v1, _i=i):
                     pools[0], pools[1] = paged_update(
                         pools[0], pools[1], k1[:, :, 0, :], v1[:, :, 0, :],
-                        page_table, pos, bs, _i, active=act)
-                    return (paged_gather(pools[0], page_table, _i),
-                            paged_gather(pools[1], page_table, _i))
-                x, _ = _block(x, p, i, cfg, mask, merge)
+                        page_table, pos, bs, _i, active=act,
+                        kv_scale=kv_scale)
+                    return lambda q: attend(
+                        q, pools[0], pools[1], page_table, pos, bs,
+                        layer=_i, max_blocks=max_blocks, kv_scale=kv_scale)
+                x, _ = _block(x, p, i, cfg, None, merge)
             k_pool, v_pool = pools
             x = _ln(x, p["final_ln_scale"], p["final_ln_bias"])
             logits = jnp.einsum(
@@ -347,6 +407,9 @@ class DecodeEngine:
                 .transpose(0, 2, 1, 3, 4)
             vb = v_seq.reshape(L, nh, n_blocks, bs, hd) \
                 .transpose(0, 2, 1, 3, 4)
+            kv = self._kv_scale()
+            if kv is not None:
+                kb, vb = quantize_kv(kb, kv), quantize_kv(vb, kv)
             k_pool = k_pool.at[:, blocks].set(kb.astype(k_pool.dtype))
             v_pool = v_pool.at[:, blocks].set(vb.astype(v_pool.dtype))
             return k_pool, v_pool
@@ -913,7 +976,8 @@ class DecodeEngine:
                 _trace.flow_start("serving.window_fetch", fid)
                 k_pool, v_pool, toks, acts = self._window_jit(
                     self.params, scales, self.cache.k_pool,
-                    self.cache.v_pool, *args)
+                    self.cache.v_pool, *args,
+                    self._window_max_blocks())
                 self.cache.update_pools(k_pool, v_pool)
                 h = FetchHandle(toks, name="serving.window_tokens",
                                 flow=fid)
@@ -1003,7 +1067,7 @@ class DecodeEngine:
         tree_sds = lambda t: jax.tree_util.tree_map(  # noqa: E731
             lambda a: sds(a.shape, a.dtype), t)
         pool = sds(self.cache.config.pool_shape(),
-                   jnp.dtype(self.compute_dtype))
+                   self.cache.k_pool.dtype)
         mb = self.cache.config.max_blocks_per_slot
         return (tree_sds(self.params),
                 tree_sds(self.scales if self.scales is not None else {}),
@@ -1012,4 +1076,5 @@ class DecodeEngine:
                 sds((B,), jnp.int32), sds((B,), jnp.int32),
                 sds((B,), jnp.bool_), sds((B,), jnp.float32),
                 sds((B,), jnp.int32), sds((B,), jnp.uint32),
-                sds((B,), jnp.int32), sds((B,), jnp.int32))
+                sds((B,), jnp.int32), sds((B,), jnp.int32),
+                mb)

@@ -27,12 +27,19 @@ from ..initializer import Constant
 def build_decode_step_program(num_layers: int = 2, num_blocks: int = 64,
                               num_heads: int = 2, block_size: int = 8,
                               head_dim: int = 8, max_slots: int = 4,
-                              max_blocks_per_slot: int = 4):
+                              max_blocks_per_slot: int = 4,
+                              use_kernel: bool = False,
+                              max_blocks=None):
     """Append one serving decode step to the current default program:
     paged_cache_update (the donated in-place pool write) followed by
     paged_attention (the gather + masked attend). Returns
     (feed_names, fetch_names) — main/startup come from the fluid
-    defaults, zoo-builder style."""
+    defaults, zoo-builder style.
+
+    `use_kernel=True` stamps the fused-Pallas read path onto the
+    paged_attention op (same donation/alias profile — the kernel reads
+    the pools without consuming them, so the static proof is one proof
+    for both read implementations); `max_blocks` bounds the walk."""
     import paddle_tpu.fluid as fluid
 
     gb = fluid.default_main_program().global_block()
@@ -67,13 +74,16 @@ def build_decode_step_program(num_layers: int = 2, num_blocks: int = 64,
 
     ctx = gb.create_var(name="dec_context", shape=(max_slots, h),
                         dtype="float32", stop_gradient=True)
+    attn_attrs = {"block_size": block_size, "use_kernel": bool(use_kernel)}
+    if max_blocks is not None:
+        attn_attrs["max_blocks"] = int(max_blocks)
     gb.append_op(
         "paged_attention",
         inputs={"Q": ["dec_q"], "KPool": ["serving_k_pool"],
                 "VPool": ["serving_v_pool"],
                 "PageTable": ["dec_page_table"], "Pos": ["dec_pos"]},
         outputs={"Out": ["dec_context"]},
-        attrs={"block_size": block_size})
+        attrs=attn_attrs)
 
     return sorted(feeds), ["dec_context"]
 

@@ -422,6 +422,40 @@ def collect_serving_smoke(proc, timeout=1200) -> bool:
     return proc.returncode == 0
 
 
+# Pallas kernel smoke (ISSUE-17 CI satellite): scripts/kernel_smoke.py —
+# interpret-mode BITWISE parity of the fused paged-attention decode
+# kernel vs the dense-gather oracle (f32/bf16/int8 x block sizes) and of
+# the fused flat-bucket optimizer update vs the jitted registry rules,
+# plus the decode-window HLO census: zero dense cache-view
+# materializations with the kernel on. Overlapped with the shards
+# (--no-kernel-smoke to skip).
+def start_kernel_smoke(env):
+    script = os.path.join(ROOT, "scripts", "kernel_smoke.py")
+    child_env = dict(env)
+    child_env["PADDLE_TPU_AUDIT_CHILD"] = "1"  # env already is the CPU mesh
+    return subprocess.Popen(
+        [sys.executable, script],
+        cwd=ROOT, env=child_env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+
+
+def collect_kernel_smoke(proc, timeout=1200) -> bool:
+    try:
+        out_s, err_s = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        print(f"[kernel-smoke] FAIL timed out after {timeout}s")
+        return False
+    lines = (out_s or "").strip().splitlines()
+    status = "OK " if proc.returncode == 0 else "FAIL"
+    body = "\n".join("    " + ln for ln in lines[-4:])
+    tail = (err_s or "").strip().splitlines()[-25:]
+    print(f"[kernel-smoke] {status}\n{body}" + (
+        "\n" + "\n".join(tail) if proc.returncode != 0 else ""))
+    return proc.returncode == 0
+
+
 # Serving chaos drill (ISSUE-15 CI satellite): scripts/chaos_smoke.py
 # --serving-drill — a FaultPlan kills one of two decode replicas
 # mid-stream; the drill pins 0 failed requests, bit-parity vs the
@@ -531,6 +565,11 @@ def main():
                          "engine + 32 streamed requests + KV copy census "
                          "+ supervised decode gang, "
                          "scripts/serving_smoke.py)")
+    ap.add_argument("--no-kernel-smoke", action="store_true",
+                    help="skip the Pallas kernel smoke (fused decode + "
+                         "optimizer-update interpret parity and the "
+                         "dense-gather HLO census, "
+                         "scripts/kernel_smoke.py)")
     ap.add_argument("--no-serving-chaos", action="store_true",
                     help="skip the serving chaos drill (replica killed "
                          "mid-decode -> failover bit-parity + "
@@ -578,6 +617,9 @@ def main():
     serving_proc = None
     if not args.no_serving_smoke:
         serving_proc = start_serving_smoke(env)    # overlaps the shards too
+    kernel_proc = None
+    if not args.no_kernel_smoke:
+        kernel_proc = start_kernel_smoke(env)      # overlaps the shards too
     chaos_proc = None
     if not args.no_serving_chaos:
         chaos_proc = start_serving_chaos(env)      # overlaps the shards too
@@ -641,6 +683,8 @@ def main():
         failed = failed or not collect_pod_trace_smoke(pod_proc)
     if serving_proc is not None:
         failed = failed or not collect_serving_smoke(serving_proc)
+    if kernel_proc is not None:
+        failed = failed or not collect_kernel_smoke(kernel_proc)
     if chaos_proc is not None:
         failed = failed or not collect_serving_chaos(chaos_proc)
     if integrity_proc is not None:

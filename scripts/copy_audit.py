@@ -241,11 +241,23 @@ def serving_census(max_slots=4, block_size=8, num_blocks=64, max_len=64,
     build_lm_program(cfg)
     exe = fluid.Executor()
     exe.run(fluid.default_startup_program())
-    engine = DecodeEngine(params_from_scope(cfg), cfg,
+    params = params_from_scope(cfg)
+    engine = DecodeEngine(params, cfg,
                           max_slots=max_slots, block_size=block_size,
                           num_blocks=num_blocks, max_len=max_len,
                           window=window, dtype=dtype)
-    return audit.decode_copy_census(engine)
+    row = audit.decode_copy_census(engine)
+    row["dense_gathers_fallback"] = \
+        audit.decode_gather_census(engine)["dense_gathers"]
+    # the fused-kernel twin: same geometry, decode_kernel on — the dense
+    # cache-view census must come back EMPTY (serving/audit.py)
+    kengine = DecodeEngine(params, cfg,
+                           max_slots=max_slots, block_size=block_size,
+                           num_blocks=num_blocks, max_len=max_len,
+                           window=window, dtype=dtype, decode_kernel=True)
+    row["dense_gathers_kernel"] = \
+        audit.decode_gather_census(kengine)["dense_gathers"]
+    return row
 
 
 def _fmt_row(tag, counts, byte_tot, per_step, total, n_instr):
@@ -304,7 +316,11 @@ def main():
         for f in row["kv_copy_findings"]:
             print(f"  KV COPY: {f['kind']} {f['instruction']} "
                   f"{f['dims']}")
-        sys.exit(1 if row["per_token_kv_copies"] else 0)
+        print(f"dense cache-view census: fallback "
+              f"{row['dense_gathers_fallback']} materializations, fused "
+              f"kernel {row['dense_gathers_kernel']} (bar: 0)")
+        sys.exit(1 if (row["per_token_kv_copies"]
+                       or row["dense_gathers_kernel"]) else 0)
 
     if args.bench:
         geo = dict(layers=12, hidden=768, heads=12, ffn=3072,

@@ -441,17 +441,25 @@ def test_bf16_and_int8_weight_arms(tiny_gpt):
 
 def test_bench_serving_rows(tiny_gpt):
     """The bench-table acceptance shape: rows exist with tokens/s + p50/
-    p99 TTFT across >= 3 concurrency levels (tiny geometry here; hardware
-    rounds run the GPT-2-small geometry via bench.py main)."""
+    p99 TTFT across >= 3 concurrency levels, for BOTH decode-kernel A/B
+    arms with their census stamps (tiny geometry here; hardware rounds
+    run the GPT-2-small geometry via bench.py main)."""
     import bench
     rows = bench.bench_serving(streams_levels=(1, 2, 3),
                                dtypes=("float32",),
                                prompt_len=8, new_tokens=4, model="tiny")
-    assert len(rows) == 3
-    assert [r["streams"] for r in rows] == [1, 2, 3]
-    for r in rows:
-        assert r["metric"] == "serving_decode_tokens_per_sec"
-        assert r["value"] > 0
-        assert r["ttft_p50_ms"] is not None
-        assert r["ttft_p99_ms"] is not None
-        assert r["per_token_kv_copies"] == 0
+    assert len(rows) == 6       # 3 stream levels x kernel off/on
+    by_arm = {k: [r for r in rows if r["pallas_decode"] is k]
+              for k in (False, True)}
+    for arm, arm_rows in by_arm.items():
+        assert [r["streams"] for r in arm_rows] == [1, 2, 3]
+        for r in arm_rows:
+            assert r["metric"] == "serving_decode_tokens_per_sec"
+            assert r["value"] > 0
+            assert r["ttft_p50_ms"] is not None
+            assert r["ttft_p99_ms"] is not None
+            if arm:
+                assert r["dense_gathers"] == 0
+            else:
+                assert r["dense_gathers"] > 0
+                assert r["per_token_kv_copies"] == 0
