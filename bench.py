@@ -592,6 +592,12 @@ def bench_serving(streams_levels=(1, 8, 32), dtypes=("bfloat16",),
                     "tpot_p50_ms": (round(tpot["p50"], 2)
                                     if tpot.get("p50") is not None
                                     else None),
+                    # every serving row carries the prefix-cache state +
+                    # hit rate (None when the cache is off) so the table
+                    # reads unambiguously next to the A/B rows below
+                    "prefix_cache": bool(engine.config.prefix_cache),
+                    "prefix_hit_rate": engine.stats().get(
+                        "prefix_cache_hit_rate"),
                 }
                 if census is not None:
                     row["per_token_kv_copies"] = \
@@ -608,6 +614,152 @@ def bench_serving(streams_levels=(1, 8, 32), dtypes=("bfloat16",),
                      f"TTFT p50={row['ttft_p50_ms']} "
                      f"p99={row['ttft_p99_ms']} ms")
             engine.stop()
+    return rows
+
+
+def bench_serving_prefix(streams=16, dtype="bfloat16", prompt_len=64,
+                         new_tokens=32, model="small", shared_frac=0.75):
+    """Shared-prefix traffic A/B (the radix prefix cache's headline):
+    `shared_frac` of the streams open with ONE long common system prompt
+    (~70% of prompt_len, ending mid-block so the copy-on-write tail path
+    is on the measured path); the identical traffic runs twice through
+    identically-sized engines — prefix cache OFF, then ON — and the two
+    rows carry tokens/s, TTFT p50/p99, the cache hit rate and prefill
+    tokens saved. Bit-parity of the two arms is asserted inline: a cache
+    that changed a single token would not be a benchmark but a bug."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import gpt
+    from paddle_tpu.models.gpt_decode import params_from_scope
+    from paddle_tpu.observability import metrics as _obs_metrics
+    from paddle_tpu.serving import DecodeEngine, Request
+
+    _log(f"serving-prefix: model={model}, streams={streams} "
+         f"({shared_frac:.0%} shared), prompt={prompt_len}, "
+         f"new={new_tokens}")
+    _fresh_programs()
+    cfg = gpt.GPTConfig.tiny() if model == "tiny" else gpt.GPTConfig()
+    cfg.seq_len = prompt_len
+    cfg.max_position = max(cfg.max_position, prompt_len + new_tokens)
+    gpt.build_lm_program(cfg)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    params = params_from_scope(cfg)
+
+    block_size = int(os.environ.get("BENCH_SERVING_BLOCK", "16"))
+    max_len = prompt_len + new_tokens
+    if max_len % block_size:
+        max_len += block_size - max_len % block_size
+    blocks_per_slot = max_len // block_size
+    max_slots = min(streams, 32)
+
+    rng = np.random.RandomState(7)
+    # long system prompt ending MID-BLOCK (exercises the CoW tail)
+    sys_len = (prompt_len * 7) // 10
+    if sys_len % block_size == 0:
+        sys_len -= 3
+    sysp = rng.randint(0, cfg.vocab_size, (sys_len,))
+    n_shared = max(1, int(round(streams * shared_frac)))
+    reqs = []
+    for i in range(streams):
+        if i < n_shared:
+            tail = rng.randint(0, cfg.vocab_size, (prompt_len - sys_len,))
+            prompt = np.concatenate([sysp, tail])
+        else:
+            prompt = rng.randint(0, cfg.vocab_size, (prompt_len,))
+        reqs.append(Request(prompt=prompt, max_new_tokens=new_tokens,
+                            seed=i, uid=f"px-{i}"))
+    # warm pair: px-warm0 publishes the system prompt's chain; px-warm1
+    # (same shape as the shared streams: sysp + a tail NOT reused in the
+    # timed wave) then hits it, compiling the suffix program at the
+    # exact (p_pad, sbucket) key the timed shared streams will use
+    # px-warm2 is a random full-length prompt: on the ON arm, px-warm1
+    # hits the cache, so without it the COLD full-prompt bucket would
+    # first compile inside the timed wave (the non-shared streams)
+    warm = [Request(prompt=sysp, max_new_tokens=2, seed=999983,
+                    uid="px-warm0"),
+            Request(prompt=np.concatenate(
+                        [sysp, rng.randint(0, cfg.vocab_size,
+                                           (prompt_len - sys_len,))]),
+                    max_new_tokens=2, seed=999979, uid="px-warm1"),
+            Request(prompt=rng.randint(0, cfg.vocab_size, (prompt_len,)),
+                    max_new_tokens=2, seed=999961, uid="px-warm2")]
+
+    rows = []
+    tokens_by_arm = {}
+    off_p50 = None
+    for cache_on in (False, True):
+        engine = DecodeEngine(
+            params, cfg, max_slots=max_slots, block_size=block_size,
+            num_blocks=max_slots * blocks_per_slot + 16 + 1,
+            max_len=max_len,
+            window=int(os.environ.get("BENCH_SERVING_WINDOW", "16")),
+            dtype=dtype, prefix_cache=cache_on)
+        try:
+            # warm compiles prefill/window (+ the suffix program on the
+            # ON arm) and publishes the system prompt's chain, so the
+            # timed wave measures steady-state cache behavior. The two
+            # warm calls are SEQUENTIAL on purpose: px-warm1 can only
+            # hit (and so compile the suffix program) after px-warm0 has
+            # retired and published its chain
+            engine.generate([warm[0]], timeout=600)
+            engine.generate([warm[1]], timeout=600)
+            engine.generate([warm[2]], timeout=600)
+            st0 = engine.stats()
+            _obs_metrics.reset("serving.ttft_ms")
+            t0 = time.perf_counter()
+            comps = engine.generate(reqs, timeout=1200)
+            dt = time.perf_counter() - t0
+            st1 = engine.stats()
+        finally:
+            engine.stop()
+        bad = [c for c in comps if not c.ok]
+        if bad:
+            raise RuntimeError(
+                f"prefix bench arm cache={cache_on}: {len(bad)} failed "
+                f"request(s): {[(c.uid, c.state) for c in bad[:4]]}")
+        tokens_by_arm[cache_on] = {c.uid: c.tokens for c in comps}
+        hits = st1.get("prefix_cache_hits", 0) - st0.get(
+            "prefix_cache_hits", 0)
+        misses = st1.get("prefix_cache_misses", 0) - st0.get(
+            "prefix_cache_misses", 0)
+        saved = st1.get("prefill_tokens_saved", 0) - st0.get(
+            "prefill_tokens_saved", 0)
+        ttft = _obs_metrics.snapshot().get("serving.ttft_ms", {})
+        n_tok = sum(len(c.tokens) for c in comps)
+        row = {
+            "metric": "serving_prefix_shared_tokens_per_sec",
+            "value": round(n_tok / dt, 1), "unit": "tokens/s",
+            "streams": streams, "shared_streams": n_shared,
+            "dtype": dtype, "prompt_len": prompt_len,
+            "sys_prompt_len": sys_len, "new_tokens": new_tokens,
+            "prefix_cache": cache_on,
+            "prefix_hit_rate": (round(hits / (hits + misses), 3)
+                                if hits + misses else None),
+            "prefill_tokens_saved": saved,
+            "ttft_p50_ms": (round(ttft["p50"], 2)
+                            if ttft.get("p50") is not None else None),
+            "ttft_p99_ms": (round(ttft["p99"], 2)
+                            if ttft.get("p99") is not None else None),
+        }
+        if cache_on:
+            if row["ttft_p50_ms"] and off_p50:
+                row["ttft_p50_off_ms"] = off_p50
+                row["ttft_p50_speedup"] = round(
+                    off_p50 / row["ttft_p50_ms"], 2)
+        else:
+            off_p50 = row["ttft_p50_ms"]
+        rows.append(row)
+        _log(f"serving-prefix[cache={'on' if cache_on else 'off'}]: "
+             f"{row['value']} tok/s, TTFT p50={row['ttft_p50_ms']} "
+             f"p99={row['ttft_p99_ms']} ms, hit_rate="
+             f"{row['prefix_hit_rate']}, saved={saved}")
+    # the determinism contract IS the product: cache on == cache off
+    diverged = [u for u in tokens_by_arm[False]
+                if tokens_by_arm[False][u] != tokens_by_arm[True][u]]
+    if diverged:
+        raise RuntimeError(
+            f"prefix cache broke bit-parity on {len(diverged)} "
+            f"request(s): {diverged[:4]}")
     return rows
 
 
@@ -1276,6 +1428,25 @@ def main():
         except Exception as e:  # pragma: no cover
             print(f"serving bench failed: {e!r}", file=sys.stderr)
             errors.append(f"serving: {e!r}")
+        if os.environ.get("BENCH_SERVING_PREFIX", "1") != "0":
+            try:
+                # shared-prefix A/B rows (ISSUE-18): the same traffic
+                # with the radix cache off then on — TTFT p50 must drop
+                # and the arms must stay bit-identical (asserted inline)
+                extras.extend(bench_serving_prefix(
+                    streams=int(os.environ.get(
+                        "BENCH_SERVING_PREFIX_STREAMS", "16")),
+                    dtype=os.environ.get("BENCH_SERVING_DTYPES",
+                                         "bfloat16,int8").split(",")[0],
+                    prompt_len=int(os.environ.get("BENCH_SERVING_PROMPT",
+                                                  "64")),
+                    new_tokens=int(os.environ.get("BENCH_SERVING_NEW",
+                                                  "64")),
+                    model=os.environ.get("BENCH_SERVING_MODEL", "small")))
+            except Exception as e:  # pragma: no cover
+                print(f"serving-prefix bench failed: {e!r}",
+                      file=sys.stderr)
+                errors.append(f"serving-prefix: {e!r}")
         if os.environ.get("BENCH_SERVING_DEGRADED", "1") != "0":
             try:
                 # degraded-capacity row (ISSUE-15): 1 of N replicas killed

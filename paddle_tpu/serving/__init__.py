@@ -12,7 +12,13 @@ two canonical designs:
   preallocated block pool per k/v with a slot->block page table, written
   in place via donated scatters (zero per-token cache copies, proven
   statically by the analysis layer and at runtime by the HLO copy census
-  in serving/audit.py).
+  in serving/audit.py);
+* **radix prefix cache** — RadixAttention (SGLang) over the same pool:
+  blocks are refcounted, retired prompts publish their block chains into
+  a token-prefix trie, and admission maps the longest cached prefix
+  read-only (copy-on-write for the partial tail block), prefilling only
+  the uncovered suffix — bit-identical to cache-off decoding
+  (EngineConfig.prefix_cache, docs/serving.md "Prefix caching").
 
 Composition with the existing subsystems (the point of this layer):
 window fetches ride the FetchHandle plumbing (framework/fetch.py),
@@ -24,7 +30,8 @@ decode workers behind the round-robin frontend (serving/frontend.py).
 """
 from .request import (Completion, Request, RequestFailedError,
                       RequestHandle, RequestState, ServingError, ShedError)
-from .cache import BlockAllocator, CacheConfig, PagedKVCache
+from .cache import (BlockAllocator, CacheConfig, PagedKVCache,
+                    RadixPrefixCache)
 from .resilience import Health, NoHealthyReplicaError, ServingFrontend
 from .engine import DecodeEngine, EngineConfig
 from .frontend import RoundRobinFrontend, replicated_engines
@@ -32,7 +39,8 @@ from .frontend import RoundRobinFrontend, replicated_engines
 __all__ = [
     "BlockAllocator", "CacheConfig", "Completion", "DecodeEngine",
     "EngineConfig", "Health", "NoHealthyReplicaError", "PagedKVCache",
-    "Request", "RequestFailedError", "RequestHandle", "RequestState",
+    "RadixPrefixCache", "Request", "RequestFailedError", "RequestHandle",
+    "RequestState",
     "RoundRobinFrontend", "ServingError", "ServingFrontend", "ShedError",
     "replicated_engines",
 ]

@@ -125,6 +125,63 @@ def assert_zero_kv_copies(engine) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# suffix-prefill census (the prefix-cache path)
+# ---------------------------------------------------------------------------
+# The prefix cache adds ONE new compiled program touching the pools: the
+# suffix prefill (DecodeEngine._suffix_prefill_fn), which gathers the
+# shared prefix blocks, scatters the suffix k/v, and makes the slot's
+# private copy-on-write copy of the partial tail block. The pools are
+# donated into it exactly like the window program, so the same census
+# applies: zero POOL-shaped copies. (The CoW copy itself is one BLOCK —
+# [L, 1, nh, bs, hd] — gathered and re-scattered in place; it matches
+# neither the pool nor the per-layer pool-slice pattern, by design: one
+# block per admission is the copy-on-write contract, not a regression.)
+# The decode WINDOW program is untouched by the prefix cache — shared
+# blocks enter it only as page-table entries — so the per-token census
+# above holds verbatim with the cache on.
+
+def suffix_prefill_hlo(engine, p_pad: int = 2, sbucket=None,
+                       width=None) -> str:
+    """Optimized HLO of the suffix-prefill program at one compile key
+    (AOT lower from abstract args — no real buffers consumed). `width`
+    is the pinned COLD attention width of the production key
+    (p_pad, sbucket, width); None censuses the natural buffer width."""
+    fn = engine._suffix_prefill_fn(p_pad, sbucket if sbucket is not None
+                                   else engine.buckets[0], width)
+    lowered = fn.lower(*engine.suffix_abstract_args(p_pad, sbucket))
+    return lowered.compile().as_text()
+
+
+def suffix_copy_census(engine, p_pad: int = 2, sbucket=None,
+                       width=None) -> dict:
+    """Census row for the suffix-prefill program: pool-shaped copy
+    findings (must be empty — the donation held) plus the total copy
+    population."""
+    txt = suffix_prefill_hlo(engine, p_pad, sbucket, width)
+    findings = kv_copy_findings(txt, engine.cache.config.pool_shape())
+    return {
+        "pool_shape": list(engine.cache.config.pool_shape()),
+        "p_pad": p_pad,
+        "width": width,
+        "kv_copy_findings": findings,
+        "pool_copies": len(findings),
+        "copy_population": copy_counts(txt),
+    }
+
+
+def assert_zero_suffix_kv_copies(engine, p_pad: int = 2,
+                                 sbucket=None, width=None) -> dict:
+    """Raise if the compiled suffix-prefill program carries a pool-shaped
+    copy (a lost donation alias); returns the census row for logging."""
+    row = suffix_copy_census(engine, p_pad, sbucket, width)
+    if row["pool_copies"]:
+        raise AssertionError(
+            "pool-shaped copies detected in the suffix-prefill "
+            f"program: {row['kv_copy_findings']}")
+    return row
+
+
+# ---------------------------------------------------------------------------
 # dense-gather census (the fused-kernel proof)
 # ---------------------------------------------------------------------------
 # The fallback attention read (ops/paged_ops.paged_gather + dense attend)

@@ -15,14 +15,25 @@ reserves a request's WHOLE budget (prompt bucket + max_new_tokens) up
 front — there is no mid-flight allocation, hence no mid-flight OOM or
 preemption: a request that cannot be fully funded stays queued.
 
+Blocks are REFCOUNTED so concurrent sequences sharing a prompt prefix can
+share the prefix's KV blocks (SGLang's RadixAttention reuse on top of the
+paged pool): `share` takes an extra reference, `free` drops one, and a
+block returns to the free list only at refcount zero. Shared blocks are
+never written — the last, partially-filled prefix block is copy-on-write
+(the slot gets a private copy before its first write; see
+DecodeEngine._suffix_prefill_fn). `RadixPrefixCache` maps token-id
+prefixes to immutable refcounted block chains at block_size granularity,
+with LRU eviction of refcount-1 chains when admission needs blocks.
+
 Utilization rides the metrics registry: `serving.kv_blocks_used` /
-`serving.kv_blocks_total` gauges move on every alloc/free.
+`serving.kv_blocks_total` / `serving.prefix_cache.shared_blocks` gauges
+move on every alloc/share/free.
 """
 from __future__ import annotations
 
 import dataclasses
 import weakref
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -50,10 +61,19 @@ class CacheConfig:
 
 
 class BlockAllocator:
-    """Free-list allocator over pool block ids (scratch block excluded).
-    All-or-nothing alloc: a request either gets its whole budget or
-    nothing (it stays queued) — partial grants would mean mid-flight
-    exhaustion, which the static admission contract forbids."""
+    """Refcounted free-list allocator over pool block ids (scratch block
+    excluded). All-or-nothing alloc: a request either gets its whole
+    budget or nothing (it stays queued) — partial grants would mean
+    mid-flight exhaustion, which the static admission contract forbids.
+
+    Refcounts implement prefix sharing: `alloc` hands out blocks at
+    refcount 1, `share` takes an extra reference on live blocks (a slot
+    mapping a cached prefix, the radix cache pinning a published chain),
+    and `free` drops one reference, returning the block to the free list
+    only when the count hits zero. Freeing a block that is not live
+    (double-free, out-of-range id, scratch) raises — a block on the free
+    list twice would be handed to two slots.
+    """
 
     # every live allocator, so the process-level gauges aggregate across
     # engines (replicas, bench arms) instead of last-writer-wins
@@ -64,6 +84,7 @@ class BlockAllocator:
             raise ValueError("need >= 2 blocks (block 0 is scratch)")
         self.num_blocks = num_blocks
         self._free = list(range(num_blocks - 1, SCRATCH_BLOCK, -1))
+        self._refs: Dict[int, int] = {}
         BlockAllocator._live.add(self)
         self._gauge()
 
@@ -75,6 +96,9 @@ class BlockAllocator:
         _metrics.set_gauge(
             "serving.kv_blocks_used",
             sum((a.num_blocks - 1) - len(a._free) for a in allocs))
+        _metrics.set_gauge(
+            "serving.prefix_cache.shared_blocks",
+            sum(a.shared_blocks for a in allocs))
 
     def close(self):
         """Retire this allocator from the process gauges (engine.stop()).
@@ -87,23 +111,221 @@ class BlockAllocator:
     def free_blocks(self) -> int:
         return len(self._free)
 
+    @property
+    def shared_blocks(self) -> int:
+        """Live blocks with more than one owner (refcount >= 2)."""
+        return sum(1 for r in self._refs.values() if r >= 2)
+
+    def refcount(self, block: int) -> int:
+        """Current reference count of `block` (0 if not live)."""
+        return self._refs.get(block, 0)
+
     def alloc(self, n: int) -> Optional[List[int]]:
         if n > len(self._free):
             return None
         got = [self._free.pop() for _ in range(n)]
+        for b in got:
+            self._refs[b] = 1
         self._gauge()
         return got
 
+    def share(self, blocks: List[int]):
+        """Take an extra reference on already-live blocks. Sharing a block
+        nobody owns raises: a shared block must be pinned by its current
+        owner for the whole handoff, or eviction could recycle it."""
+        for b in blocks:
+            if b not in self._refs:
+                raise ValueError(f"sharing block {b} that is not live")
+        for b in blocks:
+            self._refs[b] += 1
+        self._gauge()
+
     def free(self, blocks: List[int]):
+        """Drop one reference per block; a block returns to the free list
+        only at refcount zero. Raises on double-free / unknown ids."""
         for b in blocks:
             if b == SCRATCH_BLOCK:
                 raise ValueError("freeing the scratch block")
-            self._free.append(b)
+            if b not in self._refs:
+                raise ValueError(
+                    f"double-free or unknown block id {b} (live blocks "
+                    f"hold refcount >= 1; this one holds none)")
+        for b in blocks:
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                del self._refs[b]
+                self._free.append(b)
         self._gauge()
 
 
+class _RadixNode:
+    """One cached block: `chunk` is the token-id tuple the block holds
+    (len == block_size for interior/full nodes, shorter for a partial
+    tail leaf, which is always terminal), `block` the pool block id."""
+
+    __slots__ = ("chunk", "block", "parent", "children", "last_used")
+
+    def __init__(self, chunk: Tuple[int, ...], block: int,
+                 parent: Optional["_RadixNode"]):
+        self.chunk = chunk
+        self.block = block
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_RadixNode"] = {}
+        self.last_used = 0
+
+
+class RadixPrefixCache:
+    """Token-id prefix -> immutable refcounted block chain, at block_size
+    granularity (SGLang RadixAttention over the vLLM block pool).
+
+    The trie's edges are token chunks: interior nodes hold exactly
+    block_size tokens and one full KV block; a node with fewer tokens is
+    a PARTIAL tail (the last, partially-filled block of some published
+    prompt) and is always a leaf. The cache owns one allocator reference
+    per stored block (taken at insert, dropped at evict), so a chain
+    survives its publisher; a slot that maps a chain takes its own
+    references via PagedKVCache.assign_with_prefix.
+
+    Eviction is LRU over leaves whose block has refcount 1 (only the
+    cache holds it — nothing mapped by a live slot is ever evicted),
+    cascading upward as interior nodes become childless.
+    """
+
+    def __init__(self, block_size: int):
+        self.block_size = block_size
+        self._root = _RadixNode((), SCRATCH_BLOCK, None)
+        self._clock = 0
+        self._nodes = 0
+
+    def __len__(self) -> int:
+        return self._nodes
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def lookup(self, prompt: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest cached prefix of `prompt`: returns (blocks, matched)
+        where `blocks` is the chain (full blocks, possibly ending in one
+        partial tail) and `matched` the token count it covers. At most
+        len(prompt) - 1 tokens match — at least one suffix token is
+        always prefilled so the first sampled token has a query row.
+        The caller must pin the chain (allocator.share) before the next
+        eviction can run."""
+        bs = self.block_size
+        plen = len(prompt)
+        toks = tuple(int(t) for t in prompt)
+        now = self._tick()
+        node = self._root
+        blocks: List[int] = []
+        matched = 0
+        max_full = (plen - 1) // bs   # full chunks usable, keeping >= 1 suffix tok
+        while matched // bs < max_full:
+            chunk = toks[matched:matched + bs]
+            child = node.children.get(chunk)
+            if child is None or len(child.chunk) < bs:
+                break
+            node = child
+            node.last_used = now
+            blocks.append(node.block)
+            matched += bs
+        # longest partial tail that is a prefix of the remainder
+        best = None
+        for chunk, child in node.children.items():
+            if len(chunk) >= bs:
+                continue
+            m = matched + len(chunk)
+            if m > plen - 1:
+                continue
+            if chunk == toks[matched:matched + len(chunk)]:
+                if best is None or len(chunk) > len(best.chunk):
+                    best = child
+        if best is not None:
+            best.last_used = now
+            blocks.append(best.block)
+            matched += len(best.chunk)
+        return blocks, matched
+
+    def insert(self, prompt: Sequence[int], blocks: Sequence[int],
+               allocator: BlockAllocator):
+        """Publish a retired request's prompt chain: `blocks` is the
+        slot's block list covering `prompt` in order. Full blocks
+        (len(prompt) // block_size of them) become interior nodes; a
+        remainder becomes a partial tail leaf. Chunks already cached
+        keep their existing blocks (first publisher wins — the bits are
+        identical by the determinism contract); only newly stored blocks
+        get a cache-owned reference."""
+        bs = self.block_size
+        toks = tuple(int(t) for t in prompt)
+        plen = len(toks)
+        now = self._tick()
+        node = self._root
+        for i in range(plen // bs):
+            chunk = toks[i * bs:(i + 1) * bs]
+            child = node.children.get(chunk)
+            if child is None:
+                child = _RadixNode(chunk, int(blocks[i]), node)
+                allocator.share([child.block])
+                node.children[chunk] = child
+                self._nodes += 1
+            child.last_used = now
+            node = child
+        rem = plen % bs
+        if rem:
+            chunk = toks[plen - rem:]
+            child = node.children.get(chunk)
+            if child is None:
+                child = _RadixNode(chunk, int(blocks[plen // bs]), node)
+                allocator.share([child.block])
+                node.children[chunk] = child
+                self._nodes += 1
+            child.last_used = now
+
+    def evict(self, allocator: BlockAllocator, need: int) -> int:
+        """Free least-recently-used refcount-1 leaf chains until `need`
+        blocks have been returned to the free list (or nothing more is
+        evictable). Returns the number of blocks actually freed."""
+        freed = 0
+        while freed < need:
+            victim = None
+            stack = [self._root]
+            while stack:
+                n = stack.pop()
+                for c in n.children.values():
+                    if c.children:
+                        stack.append(c)
+                    elif allocator.refcount(c.block) == 1:
+                        if victim is None or c.last_used < victim.last_used:
+                            victim = c
+            if victim is None:
+                break
+            parent = victim.parent
+            del parent.children[victim.chunk]
+            self._nodes -= 1
+            allocator.free([victim.block])
+            freed += 1
+            _metrics.inc("serving.prefix_cache.evictions")
+        return freed
+
+    def clear(self, allocator: BlockAllocator):
+        """Drop every cached chain (engine stop / failover teardown)."""
+        stack = list(self._root.children.values())
+        self._root.children = {}
+        while stack:
+            n = stack.pop()
+            allocator.free([n.block])
+            stack.extend(n.children.values())
+        self._nodes = 0
+
+
 class PagedKVCache:
-    """Device pools + host page table + per-slot block ownership."""
+    """Device pools + host page table + per-slot block ownership.
+
+    Ownership contract (symmetric): `assign` / `assign_with_prefix` on a
+    slot that already holds blocks raises, and `release` on a slot that
+    holds none raises — a release that silently no-ops would mask a
+    double-release or a retire/admit race, exactly the bug class the
+    refcounted allocator exists to catch."""
 
     def __init__(self, config: CacheConfig):
         import jax.numpy as jnp
@@ -138,13 +360,41 @@ class PagedKVCache:
         self._slot_blocks[slot] = blocks
         return blocks
 
+    def assign_with_prefix(self, slot: int, shared: List[int],
+                           n_private: int) -> Optional[List[int]]:
+        """Map `shared` (a pinnable cached prefix chain) read-only into
+        `slot`'s row and reserve n_private fresh blocks after it. The
+        shared blocks get a slot-owned reference FIRST — so a concurrent
+        eviction can never recycle the matched chain — then the private
+        tail is funded all-or-nothing. Returns the private blocks, or
+        None (with the share undone) if the pool cannot fund them."""
+        if slot in self._slot_blocks:
+            raise ValueError(f"slot {slot} already holds blocks")
+        total = len(shared) + n_private
+        if total > self.config.max_blocks_per_slot:
+            raise ValueError(
+                f"request needs {total} blocks > max_blocks_per_slot "
+                f"{self.config.max_blocks_per_slot}")
+        self.allocator.share(shared)
+        private = self.allocator.alloc(n_private)
+        if private is None:
+            self.allocator.free(shared)
+            return None
+        self._slot_blocks[slot] = list(shared) + private
+        return private
+
     def blocks_of(self, slot: int) -> List[int]:
         return list(self._slot_blocks.get(slot, ()))
 
     def release(self, slot: int):
-        blocks = self._slot_blocks.pop(slot, None)
-        if blocks:
-            self.allocator.free(blocks)
+        """Return one reference on every block in `slot`'s row (shared
+        prefix blocks survive in the radix cache / other slots; private
+        blocks return to the free list) and clear the row. Raises
+        KeyError if the slot holds no blocks — symmetric with `assign`,
+        which raises on an occupied slot."""
+        if slot not in self._slot_blocks:
+            raise KeyError(f"release of slot {slot} which holds no blocks")
+        self.allocator.free(self._slot_blocks.pop(slot))
 
     def update_pools(self, k_pool, v_pool):
         """Adopt the window's donated-update results (the old device

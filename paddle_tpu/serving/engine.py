@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import math
 import threading
 import time
 from typing import Dict, List, Optional
@@ -51,14 +52,14 @@ import numpy as np
 from ..flags import flag
 from ..framework.fetch import FetchHandle
 from ..models.gpt import GPTConfig
-from ..models.gpt_decode import _block, _embed, _ln
+from ..models.gpt_decode import _attend, _block, _embed, _ln
 from ..observability import flight as _flight
 from ..observability import metrics as _metrics
 from ..observability import trace as _trace
-from ..ops.paged_ops import (paged_attend, paged_update, fused_attend,
-                             quantize_kv)
+from ..ops.paged_ops import (SCRATCH_BLOCK, paged_attend, paged_update,
+                             fused_attend, quantize_kv)
 from ..resilience.faults import FaultInjected, fault_point
-from .cache import CacheConfig, PagedKVCache
+from .cache import CacheConfig, PagedKVCache, RadixPrefixCache
 from .request import Completion, Request, RequestHandle, RequestState
 from .resilience import Health, shed_handle
 from .weights import dequant_params, prepare_params
@@ -88,6 +89,14 @@ class EngineConfig:
     # None = resolve from PADDLE_TPU_PALLAS_DECODE / FLAGS_pallas_decode
     # at engine build; True/False pin the attention read path explicitly
     decode_kernel: Optional[bool] = None
+    # radix prefix cache (serving/cache.RadixPrefixCache): retired
+    # requests publish their prompt block chains, admission maps the
+    # longest cached prefix read-only and prefills only the suffix.
+    # Bit-parity contract: cache-on tokens == cache-off (docs/serving.md
+    # "Prefix caching"); incompatible with kv_dtype="int8" (quantize-on-
+    # write pools re-read a cached prefix through dequant — different
+    # bits than the f32 values the cold prefill attended with)
+    prefix_cache: bool = False
     # set by resolve(): the pre-rounding budget the caller asked for (the
     # max_position guard compares THIS, so re-resolving an already-rounded
     # config — engine clones — never trips it on rounding slack)
@@ -108,6 +117,12 @@ class EngineConfig:
         if c.kv_dtype not in ("", "int8"):
             raise ValueError(f"kv_dtype must be '' or 'int8', "
                              f"got {c.kv_dtype!r}")
+        if c.prefix_cache and c.kv_dtype == "int8":
+            raise ValueError(
+                "prefix_cache requires float KV pools: int8 pools "
+                "quantize on write, so a shared prefix would be re-read "
+                "through dequant and break the cache-on == cache-off "
+                "bit-parity contract")
         if c.decode_kernel is None:
             from ..ops.pallas.paged_attention import decode_kernel_enabled
             c.decode_kernel = decode_kernel_enabled()
@@ -208,6 +223,14 @@ class DecodeEngine:
         self._failover = None
         self._prefill_jits: Dict[int, object] = {}
         self._write_jits: Dict[int, object] = {}
+        # radix prefix cache: None when off. Chains reference pool blocks,
+        # so the cache is rebuilt with the pool (resurrect/_build_cache).
+        self.prefix_cache = (RadixPrefixCache(cfg.block_size)
+                             if cfg.prefix_cache else None)
+        self._suffix_jits: Dict[tuple, object] = {}
+        self._prefix_hits = 0
+        self._prefix_misses = 0
+        self._prefill_tokens_saved = 0
         # max_blocks (the page-table walk bound) is STATIC: each distinct
         # hint is one compile, and the hint ladder is power-of-two
         # bucketed so the compile count is log(max_blocks)-bounded
@@ -414,6 +437,182 @@ class DecodeEngine:
             v_pool = v_pool.at[:, blocks].set(vb.astype(v_pool.dtype))
             return k_pool, v_pool
         return jax.jit(run, donate_argnums=(0, 1))
+
+    def _suffix_prefill_fn(self, p_pad: int, sbucket: int,
+                           width: Optional[int] = None):
+        """Causal forward over ONLY the uncovered suffix of a prefix-
+        cache hit: the matched prefix's k/v is GATHERED from the shared
+        pool blocks instead of recomputed, the suffix's k/v is scattered
+        into the slot's chain positions, and the first token is sampled
+        from the last real suffix row — one jit per (padded prefix
+        width, suffix bucket, attention width), pools donated.
+
+        Bit-parity with the cold prefill needs TWO invariants:
+
+        * position-indexed layout — column j of the merged attention
+          k/v IS absolute position j (prefix gather at cols < m, suffix
+          dynamically placed at offset m), so every real key sits at
+          the index the cold prefill puts it at and carries the same
+          bits (the pool write is a dtype-preserving astype);
+        * exact COLD attention width — `width` is pinned to the cold
+          prompt bucket, bucket(plen), NOT the natural buffer width
+          p_pad*bs + sbucket. Reduction grouping is width-dependent in
+          low precision: softmax sums and the attn@V contraction at a
+          different width round differently (one bf16 ulp is enough to
+          flip an argmax knife-edge tokens later), so end-padding is
+          only bit-neutral at the SAME width. With the width equal,
+          masked columns contribute exact zeros at identical tree
+          positions in both programs and every reduction is
+          bit-identical.
+
+        Copy-on-write: the partially-filled tail block's rows are
+        copied bit-exactly out of the prefix GATHER into the slot's
+        private block as part of the suffix scatter itself, so shared
+        blocks are never written AND the donated pool stays a single
+        gather-then-scatter chain. (A separate block-copy write before
+        the gathers' consumers would interleave a pool write inside the
+        pool reads' live range — XLA then abandons the donation alias
+        and re-copies the whole pool, which serving/audit.py's suffix
+        census would flag.)"""
+        import jax
+        import jax.numpy as jnp
+        cfg = self.model_config
+        bs = self.config.block_size
+        nh = cfg.num_heads
+        hd = cfg.hidden_size // nh
+        W_buf = p_pad * bs + sbucket    # merged-buffer width (>= plen)
+        W = W_buf if width is None else width
+        scale = 1.0 / math.sqrt(hd)
+
+        def run(payloads, scales, k_pool, v_pool, prefix_blocks, m,
+                suffix, suffix_len, slot_row, cow_dst, temp,
+                top_k, seed):
+            p = self._model_params(payloads, scales)
+            # ONE gather per pool for all layers' prefix k/v, read from
+            # the pre-write pool (the CoW copy below never touches a
+            # prefix block, so gathering first is value-identical and
+            # keeps the donated pool a single read-then-write chain —
+            # scattering per-layer gathers around the writes costs the
+            # donation alias and re-copies the whole pool)
+            L = cfg.num_layers
+            kp_all = k_pool[:, prefix_blocks].transpose(0, 2, 1, 3, 4) \
+                .reshape(L, nh, p_pad * bs, hd)
+            vp_all = v_pool[:, prefix_blocks].transpose(0, 2, 1, 3, 4) \
+                .reshape(L, nh, p_pad * bs, hd)
+            # positions via the SAME op shape cold prefill's _embed
+            # uses — dynamic_slice of the wpe table. Under XLA's
+            # default excess-precision rules the bf16 embedding add may
+            # be kept in f32 where it fuses into the first LayerNorm,
+            # and whether that rounding is elided follows the
+            # surrounding op pattern: an explicit wpe ROW GATHER here
+            # fused differently from _embed's dynamic_slice and shifted
+            # every suffix activation by one bf16 ulp, silently
+            # breaking cache-on/cache-off bit-parity at low precision.
+            # The table is extended by sbucket zero rows so the traced
+            # start never clamps near the table end (pad rows past the
+            # real suffix are masked out and never scattered).
+            wpe_ext = jnp.concatenate(
+                [p["wpe"],
+                 jnp.zeros((sbucket, cfg.hidden_size), p["wpe"].dtype)],
+                axis=0)
+            pos = jax.lax.dynamic_slice_in_dim(wpe_ext, m, sbucket, 0)
+            x = p["wte"][suffix[None]] + pos[None]
+            cols = jnp.arange(W)
+            qpos = m + jnp.arange(sbucket)
+            mask = jnp.where(cols[None, :] <= qpos[:, None], 0.0,
+                             -jnp.inf).astype(jnp.float32)
+            ks, vs = [], []
+            for i in range(cfg.num_layers):
+                def merge(k1, v1, _i=i):
+                    kp = kp_all[_i][None]           # [1, nh, P*bs, hd]
+                    vp = vp_all[_i][None]
+
+                    def ctx(q):
+                        pad = jnp.zeros((1, nh, sbucket, hd), k1.dtype)
+                        k_all = jax.lax.dynamic_update_slice_in_dim(
+                            jnp.concatenate([kp, pad], axis=2), k1, m,
+                            axis=2)
+                        v_all = jax.lax.dynamic_update_slice_in_dim(
+                            jnp.concatenate([vp, pad], axis=2), v1, m,
+                            axis=2)
+                        # resize to the COLD bucket width W: real cols
+                        # (< plen <= W) always survive; width-changing
+                        # pad/slice only touches masked columns
+                        if W_buf > W:
+                            k_all = jax.lax.slice_in_dim(k_all, 0, W,
+                                                         axis=2)
+                            v_all = jax.lax.slice_in_dim(v_all, 0, W,
+                                                         axis=2)
+                        elif W_buf < W:
+                            wpad = jnp.zeros((1, nh, W - W_buf, hd),
+                                             k1.dtype)
+                            k_all = jnp.concatenate([k_all, wpad],
+                                                    axis=2)
+                            v_all = jnp.concatenate([v_all, wpad],
+                                                    axis=2)
+                        return _attend(q, k_all, v_all, mask, scale)
+                    return ctx
+                x, (k1, v1) = _block(x, p, i, cfg, None, merge)
+                ks.append(k1)
+                vs.append(v1)
+            x = _ln(x, p["final_ln_scale"], p["final_ln_bias"])
+            x_last = jax.lax.dynamic_slice_in_dim(x, suffix_len - 1, 1,
+                                                  axis=1)
+            logits = jnp.einsum(
+                "bsh,vh->bsv", x_last, p["wte"],
+                preferred_element_type=jnp.float32)[:, 0]   # [1, V]
+            first = self._sample_rows(
+                logits, temp[None], top_k[None], seed[None],
+                jnp.zeros((1,), jnp.int32))
+            # ONE block-granular scatter per pool — the _write_fn idiom.
+            # A per-(block, offset) element scatter here serializes on
+            # CPU (every scattered row is a separate [nh, hd] update)
+            # and cost more than the whole suffix forward; indexing
+            # whole blocks keeps each update slice a contiguous
+            # [nh, bs, hd] run. The written span is the n_w blocks
+            # from the tail block onward: per layer, a position-indexed
+            # buffer starts with the tail block's CoW rows lifted
+            # bit-exact from the prefix gather, then the suffix k/v is
+            # dynamically placed at its in-block offset (suffix rows
+            # overwrite the gather's garbage tail, CoW rows < m % bs
+            # survive in front). Blocks with no real row are redirected
+            # to the scratch block; rows past the real suffix inside a
+            # written block carry pad-token k/v exactly like the cold
+            # write's bucket padding (never read: decode masks by pos).
+            n_w = (bs - 1 + sbucket + bs - 1) // bs
+            span = n_w * bs
+            nf = m // bs
+            nfbs = nf * bs             # tail block's gather column base
+            wq = nf + jnp.arange(n_w)
+            covers = wq * bs < m + suffix_len
+            wblocks = jnp.where(
+                covers,
+                slot_row[jnp.clip(wq, 0, slot_row.shape[0] - 1)],
+                SCRATCH_BLOCK)
+            off0 = m - nfbs            # suffix offset in the tail block
+            kw, vw = [], []
+            for i in range(cfg.num_layers):
+                cow_k = jax.lax.dynamic_slice(
+                    kp_all[i], (0, nfbs, 0), (nh, bs, hd))
+                cow_v = jax.lax.dynamic_slice(
+                    vp_all[i], (0, nfbs, 0), (nh, bs, hd))
+                zpad = jnp.zeros((nh, span - bs, hd), cow_k.dtype)
+                kbuf = jax.lax.dynamic_update_slice_in_dim(
+                    jnp.concatenate([cow_k, zpad], axis=1),
+                    ks[i][0].astype(cow_k.dtype), off0, axis=1)
+                vbuf = jax.lax.dynamic_update_slice_in_dim(
+                    jnp.concatenate([cow_v, zpad], axis=1),
+                    vs[i][0].astype(cow_v.dtype), off0, axis=1)
+                kw.append(kbuf)
+                vw.append(vbuf)
+            kb = jnp.stack(kw).reshape(L, nh, n_w, bs, hd) \
+                .transpose(0, 2, 1, 3, 4)
+            vb = jnp.stack(vw).reshape(L, nh, n_w, bs, hd) \
+                .transpose(0, 2, 1, 3, 4)
+            k_pool = k_pool.at[:, wblocks].set(kb.astype(k_pool.dtype))
+            v_pool = v_pool.at[:, wblocks].set(vb.astype(v_pool.dtype))
+            return k_pool, v_pool, first[0]
+        return jax.jit(run, donate_argnums=(2, 3))
 
     # ------------------------------------------------------------------
     # submission API
@@ -642,6 +841,10 @@ class DecodeEngine:
             # stop() abandons in-flight work: their callers must get a
             # terminal FAILED completion, never block forever
             self._fail_all("engine stopped")
+        if self.prefix_cache is not None:
+            # drop the cache-owned chain references so the shared-block
+            # gauge returns to zero before the allocator retires
+            self.prefix_cache.clear(self.cache.allocator)
         self.cache.close()   # retire this pool from the process gauges
 
     def __enter__(self):
@@ -780,6 +983,11 @@ class DecodeEngine:
         _metrics.inc("serving.resurrections")
         self.cache.close()
         self.cache = self._build_cache()
+        if self.prefix_cache is not None:
+            # cached chains pointed into the pool that died with the
+            # failed dispatch — start cold (the suffix jits survive:
+            # same shapes, no recompile)
+            self.prefix_cache = RadixPrefixCache(self.config.block_size)
         with self._cv:
             self._queue.clear()
             self._slots.clear()
@@ -797,6 +1005,66 @@ class DecodeEngine:
                 return b
         return self.buckets[-1]
 
+    def _assign_evicting(self, slot_idx: int,
+                         n_blocks: int) -> Optional[List[int]]:
+        """cache.assign with one eviction retry: idle refcount-1 prefix
+        chains are reclaimable pool space, so admission pressure evicts
+        them LRU-first before giving up and parking the FCFS head."""
+        blocks = self.cache.assign(slot_idx, n_blocks)
+        if blocks is None and self.prefix_cache is not None:
+            need = n_blocks - self.cache.allocator.free_blocks
+            if self.prefix_cache.evict(self.cache.allocator, need) > 0:
+                blocks = self.cache.assign(slot_idx, n_blocks)
+        return blocks
+
+    def _fund(self, slot_idx: int, req: Request, plen: int):
+        """Fund the head request's blocks, all-or-nothing. Returns
+        (blocks, matched_prefix_tokens, cow_src_block | None), or None
+        if the pool cannot fund it (the request stays queued, FCFS).
+
+        Cold path: the full budget from the free list — the SAME
+        `_block_budget` formula as submit's unfundable shed (the two must
+        agree or never-fundable heads wedge the FCFS queue; the shed
+        check stays on the conservative cold formula because a cache hit
+        is not guaranteed at admission time). Prefix path: look up the
+        longest cached prefix, pin the whole chain, map its full blocks
+        read-only into the slot row (assign_with_prefix takes the row's
+        own references) and fund only the uncovered chain suffix. A
+        partially-filled tail block stays OUT of the row — the suffix
+        prefill copies it into the slot's first private block before any
+        write (copy-on-write) — and remains pinned until the prefill
+        lands (_prefill_into releases it). Either path may evict LRU
+        refcount-1 chains to find room; the pin is what keeps the
+        eviction retry from recycling the very chain just matched."""
+        bs = self.config.block_size
+        n_cold = self._block_budget(plen, req.max_new_tokens)
+        if self.prefix_cache is None:
+            blocks = self.cache.assign(slot_idx, n_cold)
+            return None if blocks is None else (blocks, 0, None)
+        alloc = self.cache.allocator
+        chain, matched = self.prefix_cache.lookup(req.prompt)
+        if not matched:
+            blocks = self._assign_evicting(slot_idx, n_cold)
+            return None if blocks is None else (blocks, 0, None)
+        alloc.share(chain)                       # pin across eviction
+        nf = matched // bs
+        shared = chain[:nf]
+        cow_src = chain[-1] if matched % bs else None
+        n_chain = -(-(plen + req.max_new_tokens) // bs)
+        n_private = n_chain - nf                 # >= 1: matched < plen
+        private = self.cache.assign_with_prefix(slot_idx, shared,
+                                                n_private)
+        if private is None:
+            self.prefix_cache.evict(alloc,
+                                    n_private - alloc.free_blocks)
+            private = self.cache.assign_with_prefix(slot_idx, shared,
+                                                    n_private)
+        if private is None:
+            alloc.free(chain)                    # unpin, stay queued
+            return None
+        alloc.free(shared)   # row holds its own refs; keep cow_src pinned
+        return self.cache.blocks_of(slot_idx), matched, cow_src
+
     def _admit(self):
         while True:
             with self._cv:
@@ -810,16 +1078,15 @@ class DecodeEngine:
                 return
             plen = int(req.prompt.shape[0])
             bucket = self._bucket_for(plen)
-            # SAME formula as submit's unfundable shed: the two must
-            # agree or never-fundable heads wedge the FCFS queue again
-            n_blocks = self._block_budget(plen, req.max_new_tokens)
             slot_idx = free[0]
-            blocks = self.cache.assign(slot_idx, n_blocks)
-            if blocks is None:
-                # pool cannot fund the head request: FCFS — wait for a
-                # retirement to free blocks rather than starving big
-                # requests behind small ones
+            funding = self._fund(slot_idx, req, plen)
+            if funding is None:
+                # pool cannot fund the head request (even after evicting
+                # idle prefix chains): FCFS — wait for a retirement to
+                # free blocks rather than starving big requests behind
+                # small ones
                 return
+            blocks, matched, cow_src = funding
             with self._cv:
                 # re-verify the head: a concurrent drain()/stop() may
                 # have cleared the queue (and claimed the entry) while
@@ -838,14 +1105,25 @@ class DecodeEngine:
                                        len(self._queue))
             if head_claimed:
                 self.cache.release(slot_idx)
+                if cow_src is not None:
+                    self.cache.allocator.free([cow_src])   # drop the pin
                 return
+            if self.prefix_cache is not None:
+                if matched:
+                    self._prefix_hits += 1
+                    self._prefill_tokens_saved += matched
+                    _metrics.inc("serving.prefix_cache.hits")
+                    _metrics.inc("serving.prefill_tokens_saved", matched)
+                else:
+                    self._prefix_misses += 1
+                    _metrics.inc("serving.prefix_cache.misses")
             if handle.failovers == 0:    # re-dispatches would skew it
                 _metrics.observe(
                     "serving.queue_wait_ms",
                     (time.perf_counter() - handle.t_submit) * 1000.0)
             try:
                 self._prefill_into(slot_idx, blocks, req, handle, plen,
-                                   bucket)
+                                   bucket, matched, cow_src)
             except Exception as e:  # noqa: BLE001 — isolate to the request
                 # a per-request admission failure (bad prompt content, a
                 # transient compile error) fails THAT request, not the
@@ -855,7 +1133,8 @@ class DecodeEngine:
                 # dispatched (bounded by the failover budget) instead of
                 # failed — a flaky prefill on one replica should not kill
                 # the request.
-                self.cache.release(slot_idx)
+                if self.cache.blocks_of(slot_idx):   # early-retire may
+                    self.cache.release(slot_idx)     # have released it
                 with self._cv:
                     self._slots.pop(slot_idx, None)
                 _metrics.inc("serving.prefill_failures")
@@ -870,13 +1149,50 @@ class DecodeEngine:
                 with self._cv:
                     self._admitting = None
 
-    def _prefill_into(self, slot_idx, blocks, req, handle, plen, bucket):
-        import jax.numpy as jnp
+    def _prefill_into(self, slot_idx, blocks, req, handle, plen, bucket,
+                      matched=0, cow_src=None):
         fault_point("serving.prefill")
         handle._set_state(RequestState.PREFILL)
         _trace.instant("serving.admit",
                        args={"uid": req.uid, "slot": slot_idx})
         _metrics.inc("serving.prefills")
+        try:
+            if matched:
+                first = self._suffix_prefill(slot_idx, req, plen,
+                                             matched, cow_src)
+            else:
+                first = self._cold_prefill(req, plen, bucket, blocks)
+        finally:
+            if cow_src is not None:
+                # drop the CoW-source pin (_fund): the private copy is
+                # in the dispatch; the radix cache keeps its own ref
+                self.cache.allocator.free([cow_src])
+        # TTFT is measured at HOST materialization of the first token —
+        # through the FetchHandle ledger like every other fetch
+        tok = int(FetchHandle(first, name="serving.first_token").numpy())
+        handle._append_tokens([tok])
+        handle._set_state(RequestState.DECODE)
+        if not handle._ttft_observed:   # a failover replay is not a TTFT
+            handle._ttft_observed = True
+            _metrics.observe("serving.ttft_ms", handle.ttft_ms())
+        _trace.instant("serving.first_token", args={"uid": req.uid})
+        eos = -1 if req.eos_token is None else int(req.eos_token)
+        if req.max_new_tokens == 1 or tok == eos:
+            self._publish_prefix(slot_idx, req)
+            self.cache.release(slot_idx)
+            self._retire(handle, "eos" if tok == eos else "length")
+            return
+        with self._cv:    # load()/stats() iterate _slots cross-thread
+            self._slots[slot_idx] = _Slot(
+                handle, pos=plen, gen=1, token=tok, eos=eos,
+                max_new=req.max_new_tokens, temp=float(req.temperature),
+                top_k=int(req.top_k), seed=int(req.seed))
+        _metrics.set_gauge("serving.active_slots", len(self._slots))
+
+    def _cold_prefill(self, req, plen, bucket, blocks):
+        """Dense prefill over the whole padded prompt bucket + block
+        scatter (the no-cache / cache-miss path)."""
+        import jax.numpy as jnp
         fn = self._prefill_jits.get(bucket)
         if fn is None:
             fn = self._prefill_jits[bucket] = self._prefill_fn(bucket)
@@ -897,26 +1213,73 @@ class DecodeEngine:
                                  k_seq, v_seq,
                                  jnp.asarray(blocks[:nb], jnp.int32))
             self.cache.update_pools(k_pool, v_pool)
-        # TTFT is measured at HOST materialization of the first token —
-        # through the FetchHandle ledger like every other fetch
-        tok = int(FetchHandle(first, name="serving.first_token").numpy())
-        handle._append_tokens([tok])
-        handle._set_state(RequestState.DECODE)
-        if not handle._ttft_observed:   # a failover replay is not a TTFT
-            handle._ttft_observed = True
-            _metrics.observe("serving.ttft_ms", handle.ttft_ms())
-        _trace.instant("serving.first_token", args={"uid": req.uid})
-        eos = -1 if req.eos_token is None else int(req.eos_token)
-        if req.max_new_tokens == 1 or tok == eos:
-            self.cache.release(slot_idx)
-            self._retire(handle, "eos" if tok == eos else "length")
+        return first
+
+    def _suffix_prefill(self, slot_idx, req, plen, matched, cow_src):
+        """Prefill only the uncovered suffix of a prefix-cache hit: the
+        shared full blocks are already in the slot's row; a partial tail
+        (cow_src, pinned by _fund) is copied into the slot's first
+        private block inside the dispatch before any write."""
+        import jax.numpy as jnp
+        bs = self.config.block_size
+        mb = self.cache.config.max_blocks_per_slot
+        row = self.cache.blocks_of(slot_idx)
+        nf = matched // bs
+        has_partial = bool(matched % bs)
+        src = int(cow_src) if has_partial else SCRATCH_BLOCK
+        dst = int(row[nf]) if has_partial else SCRATCH_BLOCK
+        chain = row[:nf] + ([src] if has_partial else [])
+        # pow2-padded prefix width: one compile per (p_pad, sbucket).
+        # Floor of 2: at the degenerate single-block gather width XLA
+        # refuses the pool donation alias and copies both pools (census-
+        # verified); one extra SCRATCH block of gather is fully masked
+        # (bit-neutral) and keeps the alias at every key.
+        p_pad = 2
+        while p_pad < len(chain):
+            p_pad *= 2
+        pb = np.full((p_pad,), SCRATCH_BLOCK, np.int32)
+        pb[:len(chain)] = chain
+        s_len = plen - matched
+        sbucket = self._bucket_for(s_len)
+        suffix = np.zeros((sbucket,), np.int32)
+        suffix[:s_len] = req.prompt[matched:]
+        slot_row = np.full((mb,), SCRATCH_BLOCK, np.int32)
+        slot_row[:len(row)] = row
+        # attention width = the COLD prompt bucket: bit-parity requires
+        # the suffix program's reductions to run at exactly the width
+        # the cold prefill would have used for this prompt
+        width = self._bucket_for(plen)
+        key = (p_pad, sbucket, width)
+        fn = self._suffix_jits.get(key)
+        if fn is None:
+            fn = self._suffix_jits[key] = self._suffix_prefill_fn(
+                p_pad, sbucket, width)
+        scales = self.scales if self.scales is not None else {}
+        with _trace.RecordEvent(
+                "serving.suffix_prefill",
+                args={"uid": req.uid, "matched": matched,
+                      "suffix_bucket": sbucket}):
+            k_pool, v_pool, first = fn(
+                self.params, scales, self.cache.k_pool,
+                self.cache.v_pool, jnp.asarray(pb), jnp.int32(matched),
+                jnp.asarray(suffix), jnp.int32(s_len),
+                jnp.asarray(slot_row), jnp.int32(dst),
+                jnp.float32(req.temperature), jnp.int32(req.top_k),
+                jnp.uint32(req.seed))
+            self.cache.update_pools(k_pool, v_pool)
+        return first
+
+    def _publish_prefix(self, slot_idx: int, req: Request):
+        """Publish a retiring slot's prompt chain into the radix cache.
+        The cache takes its own block references (insert -> share), so
+        the chain survives the release that follows; chunks already
+        cached keep their existing blocks."""
+        if self.prefix_cache is None:
             return
-        with self._cv:    # load()/stats() iterate _slots cross-thread
-            self._slots[slot_idx] = _Slot(
-                handle, pos=plen, gen=1, token=tok, eos=eos,
-                max_new=req.max_new_tokens, temp=float(req.temperature),
-                top_k=int(req.top_k), seed=int(req.seed))
-        _metrics.set_gauge("serving.active_slots", len(self._slots))
+        blocks = self.cache.blocks_of(slot_idx)
+        if blocks:
+            self.prefix_cache.insert(req.prompt, blocks,
+                                     self.cache.allocator)
 
     def _retire(self, handle, reason: str):
         handle._finish(RequestState.DONE, reason)
@@ -1035,6 +1398,7 @@ class DecodeEngine:
                 slot.handle._append_tokens(emitted)
                 n_tokens += len(emitted)
             if finished is not None:
+                self._publish_prefix(idx, slot.handle.request)
                 self.cache.release(idx)
                 with self._cv:    # load()/stats() iterate cross-thread
                     self._slots.pop(idx, None)
@@ -1046,7 +1410,7 @@ class DecodeEngine:
     # introspection
     # ------------------------------------------------------------------
     def stats(self) -> dict:
-        return {
+        row = {
             "windows": self._windows,
             "completed": self._completed,
             "active_slots": len(self._slots),
@@ -1056,6 +1420,18 @@ class DecodeEngine:
             "health": self.health,
             "load": self.load(),
         }
+        if self.prefix_cache is not None:
+            looked = self._prefix_hits + self._prefix_misses
+            row.update({
+                "prefix_cache_nodes": len(self.prefix_cache),
+                "prefix_cache_hits": self._prefix_hits,
+                "prefix_cache_misses": self._prefix_misses,
+                "prefix_cache_hit_rate": (
+                    self._prefix_hits / looked if looked else 0.0),
+                "prefill_tokens_saved": self._prefill_tokens_saved,
+                "shared_blocks": self.cache.allocator.shared_blocks,
+            })
+        return row
 
     def window_abstract_args(self):
         """ShapeDtypeStructs of one window call (serving/audit.py lowers
@@ -1078,3 +1454,27 @@ class DecodeEngine:
                 sds((B,), jnp.int32), sds((B,), jnp.uint32),
                 sds((B,), jnp.int32), sds((B,), jnp.int32),
                 mb)
+
+    def suffix_abstract_args(self, p_pad: int = 2,
+                             sbucket: Optional[int] = None):
+        """ShapeDtypeStructs of one suffix-prefill call at the given
+        compile key (serving/audit.py lowers the suffix program from
+        these to extend the zero-copy census to the prefix-cache path)."""
+        import jax
+        import jax.numpy as jnp
+        if sbucket is None:
+            sbucket = self.buckets[0]
+        sds = jax.ShapeDtypeStruct
+        tree_sds = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda a: sds(a.shape, a.dtype), t)
+        pool = sds(self.cache.config.pool_shape(),
+                   self.cache.k_pool.dtype)
+        mb = self.cache.config.max_blocks_per_slot
+        return (tree_sds(self.params),
+                tree_sds(self.scales if self.scales is not None else {}),
+                pool, pool,
+                sds((p_pad,), jnp.int32), sds((), jnp.int32),
+                sds((sbucket,), jnp.int32), sds((), jnp.int32),
+                sds((mb,), jnp.int32), sds((), jnp.int32),
+                sds((), jnp.float32), sds((), jnp.int32),
+                sds((), jnp.uint32))

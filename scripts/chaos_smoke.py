@@ -35,14 +35,16 @@ overlapped subprocess, skippable with --no-preemption-drill):
 
 `--serving-drill` runs the SERVING chaos drill (docs/serving.md "Failure
 semantics"; wired into scripts/ci.py as an overlapped subprocess,
-skippable with --no-serving-chaos): a 2-replica decode frontend serves a
+skippable with --no-serving-chaos): a 2-replica decode frontend (radix
+prefix cache ON; half the stream shares one long system prompt) serves a
 mixed greedy + seeded-top-k request stream while a FaultPlan
 (`serving.window:error:at=K`) kills one replica mid-decode. The drill
 asserts ZERO failed requests, every output BIT-IDENTICAL to an
 undisturbed single-engine oracle run (decode is a pure function of
 (prompt, seed, token_idx), so failover re-decode replays exactly), the
 shed/failover counters matching the injected plan exactly (1 engine
-failure, failovers == re-dispatched victims, 0 sheds), and the killed
+failure, failovers == re-dispatched victims, 0 sheds), the prefix cache
+actually hitting (hits >= 1, prefill tokens saved >= 1), and the killed
 replica resurrecting through the canary gate and serving again.
 
 `--integrity-drill` runs the TRAINING-INTEGRITY drill (docs/
@@ -348,13 +350,22 @@ def _serving_tiny_gpt():
 
 
 def _serving_requests(n, vocab, seed):
+    """Mixed drill load: greedy + seeded top-k, and every other request
+    shares one long system prompt (mid-block at block_size=8) so the
+    chaos leg exercises the radix prefix cache — failover re-dispatch
+    must re-fund the suffix against the TARGET replica's own cache and
+    still replay bit-identically."""
     from paddle_tpu.serving import Request
     rng = np.random.RandomState(seed)
+    sysp = rng.randint(0, vocab, (13,))
     reqs = []
     for i in range(n):
         sampled = i % 3 == 2        # greedy AND seeded top-k arms
+        prompt = rng.randint(0, vocab, (int(rng.randint(3, 14)),))
+        if i % 2 == 0:              # shared-prefix arm
+            prompt = np.concatenate([sysp, prompt])
         reqs.append(Request(
-            prompt=rng.randint(0, vocab, (int(rng.randint(3, 14)),)),
+            prompt=prompt,
             max_new_tokens=int(rng.randint(4, 10)),
             temperature=0.8 if sampled else 0.0,
             top_k=16 if sampled else 0,
@@ -397,7 +408,10 @@ def serving_drill(args) -> bool:
           f"#{args.kill_window})")
     plan = install_plan(spec, seed=args.seed)
     set_flags({"FLAGS_serving_health_interval_ms": 50.0})
-    engines = replicated_engines(2, params, cfg, **geo)
+    # chaos replicas run WITH the radix prefix cache (the oracle above is
+    # cache-off): the parity check below therefore also pins the cache's
+    # bit-identity contract across a mid-decode kill + failover re-fund
+    engines = replicated_engines(2, params, cfg, prefix_cache=True, **geo)
     fe = ServingFrontend(engines)
     ok = True
     try:
@@ -464,12 +478,21 @@ def serving_drill(args) -> bool:
             print("[serving-drill] FAIL: post-resurrection request "
                   f"diverged: {post.state} {post.tokens}")
             ok = False
+        hits = sum(e.stats().get("prefix_cache_hits", 0) for e in engines)
+        saved = sum(e.stats().get("prefill_tokens_saved", 0)
+                    for e in engines)
+        if hits < 1 or saved < 1:
+            print(f"[serving-drill] FAIL: prefix cache never hit "
+                  f"(hits={hits}, tokens_saved={saved}) — the shared-"
+                  "prefix arm did not exercise the radix cache")
+            ok = False
         if ok:
             print(f"[serving-drill] PASS: {len(comps)} requests bit-"
                   f"identical to oracle across a mid-decode replica kill "
                   f"({failovers} failover(s), "
                   f"{int(m.get('serving.resurrections'))} resurrection "
-                  "attempt(s), 0 shed, 0 failed)")
+                  "attempt(s), 0 shed, 0 failed; prefix cache: "
+                  f"{hits} hit(s), {saved} prefill token(s) saved)")
     finally:
         clear_plan()
         set_flags({"FLAGS_serving_health_interval_ms": 200.0})

@@ -9,8 +9,11 @@ with the test shards; --no-serving-smoke skips). Default mode:
 * stream N (default 32) concurrent requests with STAGGERED arrivals and
   mixed prompt/generation lengths plus mixed sampling (greedy and seeded
   top-k) from submitter threads — the admission/retire churn the slot
-  array exists for;
+  array exists for; every third request shares one system prompt and the
+  radix prefix cache is ON, so the shared-prefix admission path (prefix
+  share + CoW + suffix prefill) is exercised under the same churn;
 * assert every request completes, the TTFT histogram saw every request,
+  the prefix cache actually hit (hits >= 1, prefill tokens saved > 0),
   and the compiled decode-window program contains ZERO per-token KV-cache
   copies (serving/audit.py census) while the static twin
   (serving/program.py) carries zero donation/alias findings;
@@ -60,13 +63,19 @@ def _mixed_requests(n, vocab, seed=0):
     import numpy as np
     from paddle_tpu.serving import Request
     rng = np.random.RandomState(seed)
+    # one shared system prompt (mid-block at block_size=8: exercises the
+    # partial-tail copy-on-write path) carried by every third request
+    sysp = rng.randint(0, vocab, (13,))
     reqs = []
     for i in range(n):
         plen = int(rng.randint(3, 24))
         new = int(rng.randint(2, 12))
         sampled = i % 3 == 2
+        prompt = rng.randint(0, vocab, (plen,))
+        if i % 3 == 0:
+            prompt = np.concatenate([sysp, prompt])
         reqs.append(Request(
-            prompt=rng.randint(0, vocab, (plen,)),
+            prompt=prompt,
             max_new_tokens=new,
             temperature=0.8 if sampled else 0.0,
             top_k=16 if sampled else 0,
@@ -83,7 +92,7 @@ def run_smoke(n_requests: int, replicas: int, window: int) -> int:
 
     cfg, params = _build_tiny_params()
     kw = dict(max_slots=4, block_size=8, num_blocks=96, max_len=64,
-              window=window)
+              window=window, prefix_cache=True)
     if replicas > 1:
         engines = replicated_engines(replicas, params, cfg, **kw)
         target = ServingFrontend(engines)   # the production frontend:
@@ -130,6 +139,19 @@ def run_smoke(n_requests: int, replicas: int, window: int) -> int:
         failures.append(f"TTFT histogram count {ttft.get('count')} < "
                         f"{len(reqs)}")
 
+    if replicas > 1:
+        hits = sum(e.stats().get("prefix_cache_hits", 0) for e in engines)
+        saved = sum(e.stats().get("prefill_tokens_saved", 0)
+                    for e in engines)
+    else:
+        stats = target.stats()
+        hits = stats.get("prefix_cache_hits", 0)
+        saved = stats.get("prefill_tokens_saved", 0)
+    if hits < 1 or saved < 1:
+        failures.append(
+            f"prefix cache never hit (hits={hits}, saved={saved}) — "
+            "the shared-prefix leg did not exercise the cache")
+
     census = audit.decode_copy_census(census_engine)
     if census["per_token_kv_copies"]:
         failures.append(
@@ -143,6 +165,7 @@ def run_smoke(n_requests: int, replicas: int, window: int) -> int:
           f"TTFT p50={ttft.get('p50')} p99={ttft.get('p99')} ms, "
           f"kv-copies={census['per_token_kv_copies']} "
           f"(copy population {sum(census['copy_population'].values())}), "
+          f"prefix cache {hits} hit(s) / {saved} token(s) saved, "
           f"twin findings={twin['errors'] + twin['warnings']}")
     for f in failures:
         print(f"FAIL: {f}", file=sys.stderr)

@@ -1,0 +1,403 @@
+"""Radix prefix cache + copy-on-write KV blocks (ISSUE-18 acceptance).
+
+* REFCOUNTED allocator: share/free move a refcount; a block returns to
+  the free list only at zero; double-free / unknown ids raise (satellite
+  bugfix) and release on a never-assigned slot raises (symmetric
+  ownership contract, satellite bugfix);
+* RADIX cache maps token prefixes to immutable block chains at
+  block_size granularity (full chunks + one partial tail), LRU-evicting
+  refcount-1 chains under admission pressure;
+* BIT-PARITY: cache-on tokens == cache-off tokens for shared-prefix
+  mixes (greedy AND seeded top-k), including divergent tails after a
+  mid-block shared prefix (CoW isolation), after eviction, and after
+  replica failover (the re-dispatch re-funds the suffix against the
+  target replica's own cache);
+* ZERO-COPY: the suffix-prefill program keeps its pool donation (no
+  pool-shaped copy ops) and the decode window program is untouched by
+  the cache.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.flags import set_flags
+from paddle_tpu.models.gpt import GPTConfig, build_lm_program
+from paddle_tpu.models import gpt_decode
+from paddle_tpu.observability import metrics as m
+from paddle_tpu.resilience import clear_plan, install_plan
+from paddle_tpu.serving import (BlockAllocator, DecodeEngine, PagedKVCache,
+                                RadixPrefixCache, Request, ServingFrontend,
+                                replicated_engines)
+from paddle_tpu.serving import audit as serving_audit
+from paddle_tpu.serving.cache import CacheConfig
+from paddle_tpu.testing import reset_programs
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    reset_programs(seed=0)
+    cfg = GPTConfig.tiny()
+    cfg.max_position = 64
+    build_lm_program(cfg)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    return cfg, gpt_decode.params_from_scope(cfg)
+
+
+GEO = dict(max_slots=3, block_size=8, num_blocks=32, max_len=32, window=4)
+
+
+def _engine(cfg, params, **kw):
+    base = dict(GEO)
+    base.update(kw)
+    return DecodeEngine(params, cfg, **base)
+
+
+def _shared_prefix_requests(cfg, n=6, seed=5, prefix_len=13):
+    """n requests sharing one prefix_len-token system prompt (mid-block
+    at block_size=8 -> exercises the partial-tail CoW path), divergent
+    tails, greedy and seeded top-k alternating."""
+    rng = np.random.RandomState(seed)
+    sysp = rng.randint(0, cfg.vocab_size, (prefix_len,))
+    reqs = []
+    for i in range(n):
+        tail = rng.randint(0, cfg.vocab_size, (2 + i % 3,))
+        sampled = i % 2 == 1
+        reqs.append(Request(prompt=np.concatenate([sysp, tail]),
+                            max_new_tokens=4 + i % 3,
+                            temperature=0.8 if sampled else 0.0,
+                            top_k=8 if sampled else 0,
+                            seed=50 + i, uid=f"p{i}"))
+    return sysp, reqs
+
+
+@pytest.fixture(scope="module")
+def shared_prefix_oracle(tiny_gpt):
+    """Cache-OFF tokens for the canonical shared-prefix mix — the
+    bit-parity reference every cache-ON arm is compared against."""
+    cfg, params = tiny_gpt
+    sysp, reqs = _shared_prefix_requests(cfg)
+    eng = _engine(cfg, params)
+    try:
+        comps = eng.generate(reqs, timeout=240)
+    finally:
+        eng.stop()
+    assert all(c.ok for c in comps), [(c.uid, c.state) for c in comps]
+    return sysp, reqs, {c.uid: c.tokens for c in comps}
+
+
+# ---------------------------------------------------------------------------
+# satellite bugfixes: allocator refcounts + symmetric slot ownership
+# ---------------------------------------------------------------------------
+
+def test_allocator_double_free_and_unknown_id_raise():
+    a = BlockAllocator(8)
+    got = a.alloc(2)
+    a.free([got[0]])
+    with pytest.raises(ValueError, match="double-free or unknown"):
+        a.free([got[0]])            # double-free
+    with pytest.raises(ValueError, match="double-free or unknown"):
+        a.free([99])                # out-of-range id, never allocated
+    with pytest.raises(ValueError, match="scratch"):
+        a.free([0])
+    a.free([got[1]])
+    a.close()
+
+
+def test_allocator_refcounts_gate_the_free_list():
+    a = BlockAllocator(8)
+    (b,) = a.alloc(1)
+    a.share([b])                    # refcount 2
+    assert a.refcount(b) == 2
+    assert a.shared_blocks == 1
+    free_before = a.free_blocks
+    a.free([b])                     # 2 -> 1: stays live
+    assert a.free_blocks == free_before
+    assert a.refcount(b) == 1 and a.shared_blocks == 0
+    a.free([b])                     # 1 -> 0: back on the free list
+    assert a.free_blocks == free_before + 1
+    with pytest.raises(ValueError, match="not live"):
+        a.share([b])                # sharing a dead block
+    a.close()
+
+
+def test_release_unassigned_slot_raises():
+    cache = PagedKVCache(CacheConfig(
+        num_layers=1, num_heads=1, head_dim=4, block_size=4,
+        num_blocks=6, max_blocks_per_slot=3))
+    with pytest.raises(KeyError):
+        cache.release(0)            # never assigned
+    cache.assign(0, 2)
+    with pytest.raises(ValueError, match="already holds"):
+        cache.assign(0, 1)
+    cache.release(0)
+    with pytest.raises(KeyError):
+        cache.release(0)            # double release
+    cache.close()
+
+
+def test_assign_with_prefix_shares_then_funds_all_or_nothing():
+    cache = PagedKVCache(CacheConfig(
+        num_layers=1, num_heads=1, head_dim=4, block_size=4,
+        num_blocks=8, max_blocks_per_slot=6))
+    a = cache.allocator
+    chain = a.alloc(2)              # stands in for a cached chain
+    got = cache.assign_with_prefix(1, chain, 2)
+    assert got is not None and len(got) == 2
+    assert cache.blocks_of(1) == chain + got
+    assert all(a.refcount(b) == 2 for b in chain)
+    # an unfundable private tail undoes the share (all-or-nothing)
+    before = {b: a.refcount(b) for b in chain}
+    assert cache.assign_with_prefix(2, chain, a.free_blocks + 1) is None
+    assert {b: a.refcount(b) for b in chain} == before
+    cache.release(1)                # drops one ref per block, row cleared
+    assert all(a.refcount(b) == 1 for b in chain)
+    a.free(chain)
+    cache.close()
+
+
+# ---------------------------------------------------------------------------
+# radix trie unit behavior (host-side, no device)
+# ---------------------------------------------------------------------------
+
+def test_radix_lookup_matches_longest_prefix_and_keeps_one_suffix_token():
+    a = BlockAllocator(16)
+    rc = RadixPrefixCache(block_size=4)
+    prompt = list(range(10))            # 2 full chunks + 2-token tail
+    blocks = a.alloc(3)
+    rc.insert(prompt, blocks, a)
+    assert len(rc) == 3
+    # identical prompt: the full chain matches but >= 1 suffix token is
+    # always left uncovered, so the partial tail caps at plen - 1
+    chain, matched = rc.lookup(prompt)
+    assert matched == 8 and chain == blocks[:2]
+    # longer prompt with the same prefix: full chunks + the partial tail
+    chain, matched = rc.lookup(prompt + [77, 78])
+    assert matched == 10 and chain == blocks[:3]
+    # diverging tail: only the full-chunk walk matches
+    chain, matched = rc.lookup(prompt[:8] + [99, 98])
+    assert matched == 8 and chain == blocks[:2]
+    # unrelated prompt: no match
+    chain, matched = rc.lookup([42] * 9)
+    assert matched == 0 and chain == []
+    rc.clear(a)
+    a.free(blocks)
+    a.close()
+
+
+def test_radix_eviction_is_lru_over_refcount1_leaves():
+    a = BlockAllocator(16)
+    rc = RadixPrefixCache(block_size=4)
+    b1 = a.alloc(1)
+    b2 = a.alloc(1)
+    rc.insert([1, 2, 3, 4], b1, a)      # older
+    rc.insert([5, 6, 7, 8], b2, a)      # newer
+    for b in (b1, b2):
+        a.free(b)                       # cache holds the only refs now
+    rc.lookup([1, 2, 3, 4, 9])          # touch the older chain -> MRU
+    free_before = a.free_blocks
+    assert rc.evict(a, 1) == 1
+    assert a.free_blocks == free_before + 1
+    assert a.refcount(b2[0]) == 0       # LRU victim was the untouched one
+    assert a.refcount(b1[0]) == 1
+    # a pinned (refcount >= 2) chain is never evicted
+    a.share(b1)
+    assert rc.evict(a, 1) == 0
+    a.free(b1)
+    assert rc.evict(a, 1) == 1
+    assert len(rc) == 0
+    a.close()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: bit parity (cache on == cache off)
+# ---------------------------------------------------------------------------
+
+def test_shared_prefix_bit_parity_and_counters(tiny_gpt,
+                                               shared_prefix_oracle):
+    """Warm cache, concurrent shared-prefix mix (greedy + seeded top-k):
+    tokens bit-identical to cache-off; hits/saved counters move."""
+    cfg, params = tiny_gpt
+    sysp, reqs, want = shared_prefix_oracle
+    for name in ("serving.prefix_cache.hits", "serving.prefix_cache.misses",
+                 "serving.prefill_tokens_saved"):
+        m.reset(name)
+    eng = _engine(cfg, params, prefix_cache=True)
+    try:
+        warm = eng.generate([reqs[0]], timeout=240)
+        assert warm[0].ok
+        comps = eng.generate(reqs, timeout=240)
+        st = eng.stats()
+    finally:
+        eng.stop()
+    assert all(c.ok for c in comps), [(c.uid, c.state) for c in comps]
+    for c in comps:
+        assert c.tokens == want[c.uid], (c.uid, c.tokens, want[c.uid])
+    # every post-warm admission shares >= the full first block
+    assert st["prefix_cache_hits"] >= len(reqs)
+    assert st["prefill_tokens_saved"] >= 8 * len(reqs)
+    assert st["prefix_cache_hit_rate"] > 0.5
+    assert m.get("serving.prefix_cache.hits") == st["prefix_cache_hits"]
+    assert m.get("serving.prefill_tokens_saved") == \
+        st["prefill_tokens_saved"]
+
+
+def test_cow_isolation_divergent_tails(tiny_gpt):
+    """Two requests diverging right after a MID-BLOCK shared prefix run
+    concurrently; the partial tail block is copy-on-write, so neither
+    sees the other's tokens — both match the cache-off oracle."""
+    cfg, params = tiny_gpt
+    rng = np.random.RandomState(17)
+    sysp = rng.randint(0, cfg.vocab_size, (13,))     # 1 full + 5 partial
+    reqs = [Request(prompt=np.concatenate(
+                [sysp, rng.randint(0, cfg.vocab_size, (4,))]),
+            max_new_tokens=6, seed=3 + i, uid=f"d{i}") for i in range(3)]
+    off = _engine(cfg, params)
+    try:
+        want = {c.uid: c.tokens for c in off.generate(reqs, timeout=240)}
+    finally:
+        off.stop()
+    on = _engine(cfg, params, prefix_cache=True)
+    try:
+        # publish the bare prefix (partial tail block) first, then the
+        # divergent trio decodes concurrently against it
+        assert on.generate([Request(prompt=sysp, max_new_tokens=1,
+                                    seed=99)], timeout=240)[0].ok
+        comps = on.generate(reqs, timeout=240)
+        st = on.stats()
+    finally:
+        on.stop()
+    assert all(c.ok for c in comps)
+    for c in comps:
+        assert c.tokens == want[c.uid], (c.uid, c.tokens, want[c.uid])
+    assert st["prefix_cache_hits"] >= len(reqs)   # the partial tail hit
+
+
+def test_eviction_under_pressure_funds_admission_and_keeps_parity(
+        tiny_gpt):
+    """A pool too small to cache everything: admission evicts LRU idle
+    chains instead of wedging, every request completes, and a re-run of
+    an evicted prompt is still bit-identical (cold refill)."""
+    cfg, params = tiny_gpt
+    rng = np.random.RandomState(23)
+    prompts = [rng.randint(0, cfg.vocab_size, (9,)) for _ in range(6)]
+    geo = dict(GEO, max_slots=2, num_blocks=10)
+    off = _engine(cfg, params, max_slots=2)
+    try:
+        want = [off.generate([Request(prompt=p, max_new_tokens=3,
+                                      seed=7)], timeout=240)[0].tokens
+                for p in prompts]
+    finally:
+        off.stop()
+    m.reset("serving.prefix_cache.evictions")
+    eng = _engine(cfg, params, prefix_cache=True, **geo)
+    try:
+        got = [eng.generate([Request(prompt=p, max_new_tokens=3,
+                                     seed=7)], timeout=240)[0].tokens
+               for p in prompts]
+        # replay the FIRST prompt: its chain was LRU-evicted to fund the
+        # later admissions; the refill must stay bit-identical
+        again = eng.generate([Request(prompt=prompts[0], max_new_tokens=3,
+                                      seed=7)], timeout=240)[0].tokens
+    finally:
+        eng.stop()
+    assert got == want and again == want[0]
+    assert m.get("serving.prefix_cache.evictions") > 0
+
+
+def test_failover_replay_with_cache_hit_replays_bit_identically(
+        tiny_gpt, shared_prefix_oracle):
+    """A replica killed mid-decode while serving prefix-cache hits: the
+    failover re-dispatch re-funds the suffix against the TARGET
+    replica's own cache and the replay is bit-identical to the
+    cache-off oracle."""
+    cfg, params = tiny_gpt
+    sysp, reqs, want = shared_prefix_oracle
+    set_flags({"FLAGS_serving_health_interval_ms": 30.0})
+    engines = replicated_engines(2, params, cfg, prefix_cache=True, **GEO)
+    fe = ServingFrontend(engines, resurrect=False)
+    try:
+        # warm both replicas' radix caches (no faults yet)
+        for eng in engines:
+            assert eng.generate([reqs[0]], timeout=240)[0].ok
+        install_plan("serving.window:error:at=2", seed=0)
+        handles = []
+        for r in reqs:
+            handles.append(fe.submit(r))
+            time.sleep(0.002)
+        comps = [h.result(timeout=240, raise_on_error=False)
+                 for h in handles]
+    finally:
+        clear_plan()
+        fe.stop()
+        set_flags({"FLAGS_serving_health_interval_ms": 200.0})
+    assert all(c.ok for c in comps), \
+        [(c.uid, c.state, c.error) for c in comps if not c.ok]
+    for c in comps:
+        assert c.tokens == want[c.uid], (c.uid, c.tokens, want[c.uid])
+    assert len(fe.failover_log) >= 1
+    hits = sum(e.stats().get("prefix_cache_hits", 0) for e in engines)
+    assert hits >= len(reqs)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: zero-copy + config gates
+# ---------------------------------------------------------------------------
+
+def test_suffix_prefill_census_zero_pool_copies(tiny_gpt):
+    """The suffix-prefill program keeps its pool donation at every
+    exercised compile key, and the decode window census is unchanged
+    with the cache on (shared blocks are page-table entries only)."""
+    cfg, params = tiny_gpt
+    eng = _engine(cfg, params, prefix_cache=True)
+    try:
+        for p_pad in (2, 4):   # p_pad floors at 2 (see _suffix_prefill)
+            row = serving_audit.assert_zero_suffix_kv_copies(eng, p_pad)
+            assert row["pool_copies"] == 0
+            # the PRODUCTION compile key pins the attention width to the
+            # cold prompt bucket (bit-parity) — census that program too,
+            # at both resize directions (W < W_buf and W > W_buf)
+            for width in (eng.buckets[0], eng.buckets[-1]):
+                row = serving_audit.assert_zero_suffix_kv_copies(
+                    eng, p_pad, width=width)
+                assert row["pool_copies"] == 0
+        serving_audit.assert_zero_kv_copies(eng)
+    finally:
+        eng.stop()
+
+
+@pytest.mark.slow   # bf16 compiles ~20s; CI shards run it, and the bf16
+                    # contract is re-pinned at REAL scale (where the ulp
+                    # traps actually bite — tiny-scale bf16 passed even
+                    # with them) by the chaos drill and the bench's
+                    # inline parity check
+def test_shared_prefix_bit_parity_bfloat16(tiny_gpt):
+    """Cache ON == cache OFF at bf16 — the precision where fusion-level
+    excess-precision differences between the cold and suffix prefill
+    programs show up as 1-ulp activation shifts (the suffix program pins
+    the cold program's embedding op shape and attention width precisely
+    so this holds; see _suffix_prefill_fn)."""
+    cfg, params = tiny_gpt
+    _, reqs = _shared_prefix_requests(cfg)
+    outs = {}
+    for on in (False, True):
+        eng = _engine(cfg, params, dtype="bfloat16", prefix_cache=on)
+        try:
+            comps = eng.generate(reqs, timeout=240)
+        finally:
+            if on:
+                stats = eng.stats()
+            eng.stop()
+        assert all(c.ok for c in comps)
+        outs[on] = {c.uid: c.tokens for c in comps}
+    assert outs[True] == outs[False]
+    assert stats["prefix_cache_hits"] >= 1
+    assert stats["prefill_tokens_saved"] > 0
+
+
+def test_prefix_cache_rejects_int8_kv(tiny_gpt):
+    cfg, params = tiny_gpt
+    with pytest.raises(ValueError, match="prefix_cache requires float"):
+        _engine(cfg, params, prefix_cache=True, kv_dtype="int8")
