@@ -598,6 +598,11 @@ def bench_serving(streams_levels=(1, 8, 32), dtypes=("bfloat16",),
                     "prefix_cache": bool(engine.config.prefix_cache),
                     "prefix_hit_rate": engine.stats().get(
                         "prefix_cache_hit_rate"),
+                    # every serving row states its speculation arm too
+                    # (the A/B rows live in bench_serving_spec)
+                    "spec_decode": engine.config.spec is not None,
+                    "spec_accept_rate": engine.stats().get(
+                        "spec_accept_rate"),
                 }
                 if census is not None:
                     row["per_token_kv_copies"] = \
@@ -740,6 +745,8 @@ def bench_serving_prefix(streams=16, dtype="bfloat16", prompt_len=64,
                             if ttft.get("p50") is not None else None),
             "ttft_p99_ms": (round(ttft["p99"], 2)
                             if ttft.get("p99") is not None else None),
+            "spec_decode": st1.get("spec_decode", False),
+            "spec_accept_rate": st1.get("spec_accept_rate"),
         }
         if cache_on:
             if row["ttft_p50_ms"] and off_p50:
@@ -846,6 +853,8 @@ def bench_serving_degraded(streams=16, dtype="bfloat16", prompt_len=64,
         "ttft_p99_ms": (round(ttft["p99"], 2)
                         if ttft.get("p99") is not None else None),
         "failovers": int(_obs_metrics.get("serving.failovers")),
+        "spec_decode": engines[0].config.spec is not None,
+        "spec_accept_rate": None,
     }
     if bad:
         row["failed_requests"] = bad
@@ -853,6 +862,130 @@ def bench_serving_degraded(streams=16, dtype="bfloat16", prompt_len=64,
          f"{row['value']} tok/s, TTFT p99={row['ttft_p99_ms']} ms, "
          f"{row['failovers']} failover(s), {bad} failed")
     return row
+
+
+def bench_serving_spec(streams_levels=(1, 8, 32), dtype="bfloat16",
+                       prompt_len=64, new_tokens=64, model="small"):
+    """Speculative-decoding A/B (ISSUE-19 headline): the same mixed
+    greedy + seeded top-k traffic runs through a spec-OFF engine and a
+    spec-ON twin (int8 weight arm of the SAME checkpoint drafting
+    FLAGS_serving_spec_tokens per round, one batched verify window over
+    the paged cache) at each concurrency level. Every spec-on row
+    records the acceptance rate measured over that level's run and its
+    tokens/s speedup vs the spec-off twin. Bit-parity is asserted
+    inline per level: a spec-on row that disagrees with spec-off on a
+    single token is REFUSED (RuntimeError), never published — the
+    construction contract rides the number."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.models import gpt
+    from paddle_tpu.models.gpt_decode import params_from_scope
+    from paddle_tpu.observability import metrics as _obs_metrics
+    from paddle_tpu.serving import DecodeEngine, Request
+
+    _log(f"serving-spec: model={model}, prompt={prompt_len}, "
+         f"new={new_tokens}, streams={streams_levels}, dtype={dtype}")
+    _fresh_programs()
+    cfg = gpt.GPTConfig.tiny() if model == "tiny" else gpt.GPTConfig()
+    cfg.seq_len = prompt_len
+    cfg.max_position = max(cfg.max_position, prompt_len + new_tokens)
+    gpt.build_lm_program(cfg)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    params = params_from_scope(cfg)
+
+    max_slots = max(streams_levels)
+    block_size = int(os.environ.get("BENCH_SERVING_BLOCK", "16"))
+    max_len = prompt_len + new_tokens
+    if max_len % block_size:
+        max_len += block_size - max_len % block_size
+    blocks_per_slot = max_len // block_size
+    rng = np.random.RandomState(11)
+    # one request set per level, shared by BOTH arms (the parity check
+    # compares token streams uid-for-uid); odd streams sample seeded
+    # top-k so acceptance is measured on both sampling arms
+    level_reqs = {
+        s: [Request(prompt=rng.randint(0, cfg.vocab_size, (prompt_len,)),
+                    max_new_tokens=new_tokens,
+                    temperature=0.8 if i % 2 else 0.0,
+                    top_k=16 if i % 2 else 0,
+                    seed=i, uid=f"spec-{s}-{i}")
+            for i in range(s)]
+        for s in streams_levels}
+
+    rows = []
+    off_arm = {}
+    for spec_on in (False, True):
+        engine = DecodeEngine(
+            params, cfg, max_slots=max_slots, block_size=block_size,
+            num_blocks=max_slots * blocks_per_slot + 1, max_len=max_len,
+            window=int(os.environ.get("BENCH_SERVING_WINDOW", "16")),
+            dtype=dtype, spec=spec_on)
+        try:
+            # warm: compiles prefill + window, and on the spec arm the
+            # draft window + verify program, before any timed level
+            engine.generate([Request(
+                prompt=rng.randint(0, cfg.vocab_size, (prompt_len,)),
+                max_new_tokens=4, seed=999999)], timeout=600)
+            for streams in streams_levels:
+                reqs = level_reqs[streams]
+                st0 = engine.stats()
+                _obs_metrics.reset("serving.ttft_ms")
+                t0 = time.perf_counter()
+                comps = engine.generate(reqs, timeout=1200)
+                dt = time.perf_counter() - t0
+                st1 = engine.stats()
+                bad = [c for c in comps if not c.ok]
+                if bad:
+                    raise RuntimeError(
+                        f"spec bench arm spec={spec_on} "
+                        f"streams={streams}: {len(bad)} failed "
+                        f"request(s): {[(c.uid, c.state) for c in bad[:4]]}")
+                toks = {c.uid: c.tokens for c in comps}
+                n_tok = sum(len(t) for t in toks.values())
+                tps = round(n_tok / dt, 1)
+                ttft = _obs_metrics.snapshot().get("serving.ttft_ms", {})
+                row = {
+                    "metric": "serving_spec_tokens_per_sec",
+                    "value": tps, "unit": "tokens/s",
+                    "streams": streams, "dtype": dtype,
+                    "prompt_len": prompt_len, "new_tokens": new_tokens,
+                    "spec_decode": spec_on,
+                    "spec_tokens": (engine.config.spec.tokens
+                                    if spec_on else None),
+                    "ttft_p50_ms": (round(ttft["p50"], 2)
+                                    if ttft.get("p50") is not None
+                                    else None),
+                }
+                if spec_on:
+                    prop = (st1.get("spec_proposed", 0)
+                            - st0.get("spec_proposed", 0))
+                    acc = (st1.get("spec_accepted", 0)
+                           - st0.get("spec_accepted", 0))
+                    row["spec_accept_rate"] = (round(acc / prop, 3)
+                                               if prop else None)
+                    base = off_arm[streams]
+                    diverged = [u for u in base["tokens"]
+                                if base["tokens"][u] != toks[u]]
+                    if diverged:
+                        raise RuntimeError(
+                            f"speculative decoding broke bit-parity at "
+                            f"streams={streams} on {len(diverged)} "
+                            f"request(s): {diverged[:4]} — spec-on row "
+                            "refused")
+                    row["speedup_vs_off"] = (round(tps / base["tps"], 3)
+                                             if base["tps"] else None)
+                else:
+                    row["spec_accept_rate"] = None
+                    off_arm[streams] = {"tps": tps, "tokens": toks}
+                rows.append(row)
+                _log(f"serving-spec[spec={'on' if spec_on else 'off'}] "
+                     f"streams={streams}: {tps} tok/s"
+                     + (f", accept_rate={row['spec_accept_rate']}, "
+                        f"speedup={row.get('speedup_vs_off')}x"
+                        if spec_on else ""))
+        finally:
+            engine.stop()
+    return rows
 
 
 def bench_resnet50(batch, steps):
@@ -1468,6 +1601,25 @@ def main():
                 print(f"serving-degraded bench failed: {e!r}",
                       file=sys.stderr)
                 errors.append(f"serving-degraded: {e!r}")
+        if os.environ.get("BENCH_SERVING_SPEC", "1") != "0":
+            try:
+                # speculative-decoding A/B rows (ISSUE-19): the same
+                # traffic spec-off then spec-on per concurrency level;
+                # each on-row carries the measured acceptance rate and
+                # refuses to publish if it broke bit-parity
+                extras.extend(bench_serving_spec(
+                    streams_levels=streams,
+                    dtype=os.environ.get("BENCH_SERVING_DTYPES",
+                                         "bfloat16,int8").split(",")[0],
+                    prompt_len=int(os.environ.get("BENCH_SERVING_PROMPT",
+                                                  "64")),
+                    new_tokens=int(os.environ.get("BENCH_SERVING_NEW",
+                                                  "64")),
+                    model=os.environ.get("BENCH_SERVING_MODEL", "small")))
+            except Exception as e:  # pragma: no cover
+                print(f"serving-spec bench failed: {e!r}",
+                      file=sys.stderr)
+                errors.append(f"serving-spec: {e!r}")
     if tokens_per_sec is not None and which in ("all", "resnet") \
             and _row_ok("resnet"):
         try:

@@ -302,14 +302,16 @@ SPECS: Dict[str, OpSpec] = {
     # and page tables are per-replica state, never mesh-sharded.
     # kv_scale (static dequant scale) flips the pools to int8 KV;
     # use_kernel / max_blocks pick the fused-Pallas read path and bound
-    # the page-table walk (ops/pallas/paged_attention.py) — all three are
+    # the page-table walk (ops/pallas/paged_attention.py); span (> 1, the
+    # speculative-decoding verify step) makes KNew/VNew/Q position-major
+    # [B, span*nh*hd] runs written/scored at Pos..Pos+span-1 — all
     # trace-time-static attrs, so the specs stay closed.
     "paged_cache_update": OpSpec(
         inputs={"KPool": ONE, "VPool": ONE, "KNew": ONE, "VNew": ONE,
                 "PageTable": ONE, "Pos": ONE},
         outputs={"KPoolOut": ONE, "VPoolOut": ONE},
         required_attrs=("block_size",),
-        attr_types={"block_size": int, "kv_scale": _NUM},
+        attr_types={"block_size": int, "kv_scale": _NUM, "span": int},
         closed_attrs=True, sharding="replicated"),
     "paged_attention": OpSpec(
         inputs={"Q": ONE, "KPool": ONE, "VPool": ONE, "PageTable": ONE,
@@ -317,7 +319,7 @@ SPECS: Dict[str, OpSpec] = {
         outputs={"Out": ONE},
         required_attrs=("block_size",),
         attr_types={"block_size": int, "use_kernel": bool,
-                    "max_blocks": int, "kv_scale": _NUM},
+                    "max_blocks": int, "kv_scale": _NUM, "span": int},
         closed_attrs=True, sharding="replicated"),
     # --- decode/search ops (ops/decode_ops.py) ---------------------------
     "linear_chain_crf": OpSpec(

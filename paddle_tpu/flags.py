@@ -166,6 +166,19 @@ _DEFS: Dict[str, tuple] = {
                                        "SIGTERM grace usually bounds it "
                                        "tighter via PADDLE_LAUNCH_"
                                        "GRACE_S)"),
+    "FLAGS_serving_spec_tokens": (4, "speculative-decoding draft depth "
+                                  "gamma (serving/spec.py): tokens the "
+                                  "draft engine proposes per slot per "
+                                  "round; the target engine scores all "
+                                  "gamma+1 positions in ONE batched "
+                                  "verify program and accepts the "
+                                  "longest agreeing prefix, so spec-on "
+                                  "output is bit-identical to spec-off. "
+                                  "Higher gamma = more tokens per "
+                                  "target pass when acceptance is high, "
+                                  "more wasted draft work when it is "
+                                  "low (docs/serving.md 'Speculative "
+                                  "decoding')"),
     # --- Pallas kernel tier (ops/pallas/, docs/perf_notes.md) ------------
     "FLAGS_pallas_decode": (False, "serve decode attention through the "
                             "fused paged-attention Pallas kernel "

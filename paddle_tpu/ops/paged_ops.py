@@ -155,6 +155,58 @@ def fused_attend(q, k_pool, v_pool, page_table, pos, block_size: int,
 
 
 # ---------------------------------------------------------------------------
+# span variants (the speculative-decoding verify program)
+# ---------------------------------------------------------------------------
+# Verification scores a short RUN of candidate positions [pos, pos+span)
+# per slot in one program (serving/spec.py). Both span ops are statically
+# unrolled loops of the single-position ops above — span is tiny (gamma+1,
+# default 5) and the per-position attend keeps EXACTLY the decode window's
+# op shapes ([B, nh, 1, hd] query, mask <= pos+s), which is what makes the
+# verify pass bit-identical to `span` sequential window steps: row s sees
+# the same gathered values and the same masked softmax as the window would
+# at position pos+s, and positions written beyond s carry exactly-zero
+# softmax weight. The unrolled writes also preserve the donation alias
+# chain through the pools (each .at[].set consumes the previous), so the
+# zero-pool-copy census holds on the verify program too.
+
+def paged_update_span(k_pool, v_pool, k_new, v_new, page_table, pos,
+                      block_size: int, layer: int, active=None,
+                      valid=None, kv_scale=None):
+    """Write `span` consecutive positions' k/v per slot: k_new/v_new are
+    [B, nh, span, hd], written at pos..pos+span-1. `valid` ([B, span]
+    bool, optional) redirects per-position invalid writes (a slot whose
+    clamped draft run is shorter than span) to the scratch block, on top
+    of the row-level `active` mask."""
+    span = k_new.shape[2]
+    for s in range(span):
+        act = active
+        if valid is not None:
+            act = valid[:, s] if act is None else (act & valid[:, s])
+        k_pool, v_pool = paged_update(
+            k_pool, v_pool, k_new[:, :, s, :], v_new[:, :, s, :],
+            page_table, pos + s, block_size, layer, active=act,
+            kv_scale=kv_scale)
+    return k_pool, v_pool
+
+
+def paged_attend_span(q, k_pool, v_pool, page_table, pos,
+                      block_size: int, layer: int = 0, scale=None,
+                      max_blocks=None, kv_scale=None, use_kernel=False):
+    """Span attention: q [B, nh, span, hd], row s masked to positions
+    <= pos+s. Unrolled per-position calls into `paged_attend` /
+    `fused_attend` — the window's exact attend shape per row — so each
+    row is bit-identical to the decode window's attend at that position.
+    Returns [B, nh, span, hd] contexts."""
+    attend = fused_attend if use_kernel else paged_attend
+    span = q.shape[2]
+    outs = [attend(q[:, :, s:s + 1, :], k_pool, v_pool, page_table,
+                   pos + s, block_size, layer=layer, scale=scale,
+                   max_blocks=max_blocks, kv_scale=kv_scale)
+            for s in range(span)]
+    return jnp.concatenate(outs, axis=2)
+
+
+# ---------------------------------------------------------------------------
 # static-graph op wrappers (the Program-expressible serving decode step)
 # ---------------------------------------------------------------------------
 
@@ -163,23 +215,41 @@ def _split_heads_flat(t, nh):
     return t.reshape(b, nh, h // nh)
 
 
+def _split_heads_span(t, nh, span):
+    """[B, span*nh*hd] (position-major) -> [B, nh, span, hd]."""
+    b, h = t.shape
+    return t.reshape(b, span, nh, h // (nh * span)).transpose(0, 2, 1, 3)
+
+
 @register("paged_cache_update",
           stateful_outputs=("KPoolOut", "VPoolOut"),
           nondiff_slots=("KPool", "VPool", "PageTable", "Pos"))
 def _paged_cache_update(ctx, ins, attrs):
     """KNew/VNew [B, nh*hd] written at each slot's Pos into the pools
     (in-place under executor donation — the pools are written persistable
-    state, so _CompiledBlock donates them and XLA aliases the update)."""
+    state, so _CompiledBlock donates them and XLA aliases the update).
+
+    Optional attr `span` (int > 1, the speculative verify step): KNew/
+    VNew are [B, span*nh*hd] position-major runs written at Pos..
+    Pos+span-1 via the unrolled paged_update_span."""
     kp, vp = ins["KPool"][0], ins["VPool"][0]
     pt = ins["PageTable"][0].astype(jnp.int32)
     pos = ins["Pos"][0].reshape(-1).astype(jnp.int32)
     nh = kp.shape[2]
-    k1 = _split_heads_flat(ins["KNew"][0], nh)
-    v1 = _split_heads_flat(ins["VNew"][0], nh)
     kv_scale = attrs.get("kv_scale")
-    kp, vp = paged_update(kp, vp, k1, v1, pt, pos,
-                          int(attrs["block_size"]), layer=0,
-                          kv_scale=kv_scale)
+    span = int(attrs.get("span", 1))
+    if span > 1:
+        k1 = _split_heads_span(ins["KNew"][0], nh, span)
+        v1 = _split_heads_span(ins["VNew"][0], nh, span)
+        kp, vp = paged_update_span(kp, vp, k1, v1, pt, pos,
+                                   int(attrs["block_size"]), layer=0,
+                                   kv_scale=kv_scale)
+    else:
+        k1 = _split_heads_flat(ins["KNew"][0], nh)
+        v1 = _split_heads_flat(ins["VNew"][0], nh)
+        kp, vp = paged_update(kp, vp, k1, v1, pt, pos,
+                              int(attrs["block_size"]), layer=0,
+                              kv_scale=kv_scale)
     return {"KPoolOut": [kp], "VPoolOut": [vp]}
 
 
@@ -193,18 +263,30 @@ def _paged_attention(ctx, ins, attrs):
     PADDLE_TPU_PALLAS_DECODE / FLAGS_pallas_decode toggle) picks the
     fused Pallas kernel over the dense-gather fallback — same bits
     either way; `max_blocks` (int) bounds the page-table walk;
-    `kv_scale` (float) is the static int8-KV dequant scale."""
+    `kv_scale` (float) is the static int8-KV dequant scale; `span`
+    (int > 1, the speculative verify step) makes Q a [B, span*nh*hd]
+    position-major run, row s masked to positions <= Pos+s."""
     kp, vp = ins["KPool"][0], ins["VPool"][0]
     pt = ins["PageTable"][0].astype(jnp.int32)
     pos = ins["Pos"][0].reshape(-1).astype(jnp.int32)
     nh = kp.shape[2]
-    q = _split_heads_flat(ins["Q"][0], nh)[:, :, None, :]   # [B, nh, 1, hd]
     max_blocks = attrs.get("max_blocks")
     kv_scale = attrs.get("kv_scale")
     use_kernel = attrs.get("use_kernel")
     if use_kernel is None:
         from .pallas.paged_attention import decode_kernel_enabled
         use_kernel = decode_kernel_enabled()
+    span = int(attrs.get("span", 1))
+    if span > 1:
+        q = _split_heads_span(ins["Q"][0], nh, span)
+        ctx_ = paged_attend_span(q, kp, vp, pt, pos,
+                                 int(attrs["block_size"]),
+                                 max_blocks=max_blocks, kv_scale=kv_scale,
+                                 use_kernel=use_kernel)
+        b, _, _, hd = ctx_.shape
+        out = ctx_.transpose(0, 2, 1, 3).reshape(b, span * nh * hd)
+        return {"Out": [out]}
+    q = _split_heads_flat(ins["Q"][0], nh)[:, :, None, :]   # [B, nh, 1, hd]
     attend = fused_attend if use_kernel else paged_attend
     ctx_ = attend(q, kp, vp, pt, pos, int(attrs["block_size"]),
                   max_blocks=max_blocks, kv_scale=kv_scale)

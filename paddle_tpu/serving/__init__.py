@@ -18,7 +18,14 @@ two canonical designs:
   a token-prefix trie, and admission maps the longest cached prefix
   read-only (copy-on-write for the partial tail block), prefilling only
   the uncovered suffix — bit-identical to cache-off decoding
-  (EngineConfig.prefix_cache, docs/serving.md "Prefix caching").
+  (EngineConfig.prefix_cache, docs/serving.md "Prefix caching");
+* **speculative decoding** — Leviathan et al. '23 over the same engine:
+  a draft arm (int8 weight arm of the same checkpoint by default)
+  proposes gamma tokens per slot, the target verifies all of them in ONE
+  batched window-shaped program, and deterministic sampling makes
+  accept/reject EXACT — spec-on output is bit-identical to spec-off
+  (EngineConfig.spec, serving/spec.py, docs/serving.md "Speculative
+  decoding").
 
 Composition with the existing subsystems (the point of this layer):
 window fetches ride the FetchHandle plumbing (framework/fetch.py),
@@ -35,6 +42,7 @@ from .cache import (BlockAllocator, CacheConfig, PagedKVCache,
 from .resilience import Health, NoHealthyReplicaError, ServingFrontend
 from .engine import DecodeEngine, EngineConfig
 from .frontend import RoundRobinFrontend, replicated_engines
+from .spec import SpecConfig, SpecDecoder
 
 __all__ = [
     "BlockAllocator", "CacheConfig", "Completion", "DecodeEngine",
@@ -42,5 +50,5 @@ __all__ = [
     "RadixPrefixCache", "Request", "RequestFailedError", "RequestHandle",
     "RequestState",
     "RoundRobinFrontend", "ServingError", "ServingFrontend", "ShedError",
-    "replicated_engines",
+    "SpecConfig", "SpecDecoder", "replicated_engines",
 ]

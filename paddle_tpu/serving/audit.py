@@ -21,6 +21,8 @@ from __future__ import annotations
 import re
 from typing import List
 
+from ..flags import flag
+
 _COPY_RE = re.compile(
     r"%?([\w\.\-]+)\s*=\s*(\(?\s*[\w\[\],\s{}]+?\)?)\s*"
     r"(copy-start|copy-done|copy|async-done)\(")
@@ -178,6 +180,94 @@ def assert_zero_suffix_kv_copies(engine, p_pad: int = 2,
         raise AssertionError(
             "pool-shaped copies detected in the suffix-prefill "
             f"program: {row['kv_copy_findings']}")
+    return row
+
+
+# ---------------------------------------------------------------------------
+# speculative-verify census (the spec-decoding path)
+# ---------------------------------------------------------------------------
+# Speculative decoding adds ONE new compiled program touching the pools:
+# the batched verify (DecodeEngine._verify_fn), which writes gamma+1
+# candidate positions per slot via the window's paged_update and attends
+# each with the window's exact per-position op shape. The pools are
+# donated into it exactly like the window program, so both censuses
+# extend verbatim: zero POOL-shaped copies (fallback arm), zero dense
+# cache-view materializations (kernel-on arm — with the same interpret-
+# mode scoping caveat as the window, see the dense-gather notes below).
+# The draft arm needs no census of its own: the draft engine runs the
+# SAME window program these censuses already cover, just at window =
+# gamma over its private pool.
+
+def _verify_span(engine, span) -> int:
+    """Default census span = the engine's production key, gamma + 1."""
+    if span is not None:
+        return int(span)
+    gamma = (engine.config.spec.tokens if engine.config.spec is not None
+             else int(flag("FLAGS_serving_spec_tokens")))
+    return gamma + 1
+
+
+def verify_hlo(engine, span=None) -> str:
+    """Optimized HLO of the speculative verify program (AOT lower +
+    compile from abstract args — no real buffers consumed). `span`
+    defaults to the engine's production key, gamma + 1."""
+    span = _verify_span(engine, span)
+    mb = engine.cache.config.max_blocks_per_slot
+    fn = engine._verify_jit_for(span, mb)
+    lowered = fn.lower(*engine.verify_abstract_args(span))
+    return lowered.compile().as_text()
+
+
+def verify_copy_census(engine, span=None) -> dict:
+    """Census row for the verify program: pool-shaped copy findings
+    (must be empty — the donation held) plus the total copy population."""
+    span = _verify_span(engine, span)
+    txt = verify_hlo(engine, span)
+    findings = kv_copy_findings(txt, engine.cache.config.pool_shape())
+    return {
+        "pool_shape": list(engine.cache.config.pool_shape()),
+        "span": span,
+        "kv_copy_findings": findings,
+        "pool_copies": len(findings),
+        "copy_population": copy_counts(txt),
+    }
+
+
+def assert_zero_verify_kv_copies(engine, span=None) -> dict:
+    """Raise if the compiled verify program carries a pool-shaped copy
+    (a lost donation alias); returns the census row for logging."""
+    row = verify_copy_census(engine, span)
+    if row["pool_copies"]:
+        raise AssertionError(
+            "pool-shaped copies detected in the speculative verify "
+            f"program: {row['kv_copy_findings']}")
+    return row
+
+
+def verify_gather_census(engine, span=None) -> dict:
+    """The kernel-proof census row for the verify program: dense
+    cache-view materializations must be zero with the fused kernel on
+    (the span attend is per-position fused_attend calls)."""
+    span = _verify_span(engine, span)
+    txt = verify_hlo(engine, span)
+    findings = dense_gather_findings(txt, engine)
+    return {
+        "decode_kernel": bool(engine.config.decode_kernel),
+        "span": span,
+        "dense_gather_findings": findings,
+        "dense_gathers": len(findings),
+    }
+
+
+def assert_no_verify_dense_gather(engine, span=None) -> dict:
+    """Raise if the compiled verify program still materializes a dense
+    cache view; returns the census row for logging."""
+    row = verify_gather_census(engine, span)
+    if row["dense_gathers"]:
+        raise AssertionError(
+            "dense cache-view materializations survive in the "
+            f"speculative verify program: "
+            f"{row['dense_gather_findings'][:4]}")
     return row
 
 

@@ -325,7 +325,21 @@ class PagedKVCache:
     slot that already holds blocks raises, and `release` on a slot that
     holds none raises — a release that silently no-ops would mask a
     double-release or a retire/admit race, exactly the bug class the
-    refcounted allocator exists to catch."""
+    refcounted allocator exists to catch.
+
+    Speculative decoding adds a MAPPED / RESERVED split on top of the
+    same all-or-nothing funding: a spec-enabled engine still funds the
+    request's whole budget at admission (no mid-flight OOM, allocator
+    refcounts identical to plain decode), but only the blocks covering
+    committed positions appear in the slot's page-table row; the rest
+    wait in an ordered per-slot reserve. Each round `extend_mapped` maps
+    enough reserve blocks to cover the speculative span, and a rejection
+    `truncate_mapped`s the row back past the accepted position — the
+    rolled-back blocks return to the FRONT of the reserve so block order
+    (and therefore the position -> block mapping) is stable across
+    rollback/re-extend cycles. Plain engines never touch the split: the
+    reserve stays empty and every funded block is mapped, exactly the
+    pre-spec behavior."""
 
     def __init__(self, config: CacheConfig):
         import jax.numpy as jnp
@@ -335,6 +349,7 @@ class PagedKVCache:
         self.k_pool = jnp.zeros(config.pool_shape(), dt)
         self.v_pool = jnp.zeros(config.pool_shape(), dt)
         self._slot_blocks: Dict[int, List[int]] = {}
+        self._slot_reserve: Dict[int, List[int]] = {}
 
     def page_table_rows(self, max_slots: int) -> np.ndarray:
         """[max_slots, max_blocks_per_slot] int32; unassigned entries point
@@ -386,15 +401,68 @@ class PagedKVCache:
     def blocks_of(self, slot: int) -> List[int]:
         return list(self._slot_blocks.get(slot, ()))
 
+    def reserved_of(self, slot: int) -> List[int]:
+        return list(self._slot_reserve.get(slot, ()))
+
+    def reserve_tail(self, slot: int, keep: int):
+        """Move every mapped block past the first `keep` into the slot's
+        reserve (spec-enabled admission: fund everything, map only what
+        covers committed positions). Ownership/refcounts are untouched —
+        reserved blocks are still the slot's funded budget."""
+        row = self._slot_blocks[slot]
+        if keep < 1:
+            raise ValueError(f"reserve_tail keep={keep} must map >= 1 block")
+        if len(row) > keep:
+            self._slot_reserve[slot] = (
+                row[keep:] + self._slot_reserve.get(slot, []))
+            del row[keep:]
+
+    def extend_mapped(self, slot: int, n_needed: int) -> int:
+        """Map reserve blocks (in order) into `slot`'s row until it holds
+        at least `n_needed` blocks — called before a window or a verify
+        round so every position it may write is covered. Raises if the
+        reserve cannot cover the span: admission funded the full budget,
+        so a shortfall is a bookkeeping bug, not an OOM."""
+        row = self._slot_blocks[slot]
+        resv = self._slot_reserve.get(slot, [])
+        moved = 0
+        while len(row) < n_needed:
+            if not resv:
+                raise ValueError(
+                    f"slot {slot} needs {n_needed} mapped blocks but only "
+                    f"{len(row)} mapped + {moved} extended are funded")
+            row.append(resv.pop(0))
+            moved += 1
+        return moved
+
+    def truncate_mapped(self, slot: int, keep: int) -> List[int]:
+        """Roll back speculation: unmap every row block past the first
+        `keep` (those covering only rejected positions), returning them to
+        the FRONT of the reserve so a later extend restores the identical
+        position -> block mapping. Returns the truncated block ids. The
+        allocator is untouched: the blocks remain the slot's funded
+        budget, they just leave the device-visible page-table row."""
+        if keep < 1:
+            raise ValueError(f"truncate_mapped keep={keep} must keep >= 1")
+        row = self._slot_blocks[slot]
+        cut = row[keep:]
+        if cut:
+            del row[keep:]
+            self._slot_reserve[slot] = cut + self._slot_reserve.get(slot, [])
+        return cut
+
     def release(self, slot: int):
         """Return one reference on every block in `slot`'s row (shared
         prefix blocks survive in the radix cache / other slots; private
-        blocks return to the free list) and clear the row. Raises
-        KeyError if the slot holds no blocks — symmetric with `assign`,
-        which raises on an occupied slot."""
+        blocks return to the free list) and clear the row. Reserved
+        (funded but unmapped) blocks are freed with it. Raises KeyError
+        if the slot holds no blocks — symmetric with `assign`, which
+        raises on an occupied slot."""
         if slot not in self._slot_blocks:
             raise KeyError(f"release of slot {slot} which holds no blocks")
-        self.allocator.free(self._slot_blocks.pop(slot))
+        blocks = self._slot_blocks.pop(slot)
+        blocks += self._slot_reserve.pop(slot, [])
+        self.allocator.free(blocks)
 
     def update_pools(self, k_pool, v_pool):
         """Adopt the window's donated-update results (the old device

@@ -57,6 +57,7 @@ from ..observability import flight as _flight
 from ..observability import metrics as _metrics
 from ..observability import trace as _trace
 from ..ops.paged_ops import (SCRATCH_BLOCK, paged_attend, paged_update,
+                             paged_attend_span, paged_update_span,
                              fused_attend, quantize_kv)
 from ..resilience.faults import FaultInjected, fault_point
 from .cache import CacheConfig, PagedKVCache, RadixPrefixCache
@@ -97,6 +98,12 @@ class EngineConfig:
     # write pools re-read a cached prefix through dequant — different
     # bits than the f32 values the cold prefill attended with)
     prefix_cache: bool = False
+    # speculative decoding (serving/spec.py): None/False = off; True =
+    # default SpecConfig (int8 draft arm of the same checkpoint, gamma =
+    # FLAGS_serving_spec_tokens); a SpecConfig instance pins the draft
+    # explicitly. Spec-on output is bit-identical to spec-off by
+    # construction (docs/serving.md "Speculative decoding")
+    spec: Optional[object] = None
     # set by resolve(): the pre-rounding budget the caller asked for (the
     # max_position guard compares THIS, so re-resolving an already-rounded
     # config — engine clones — never trips it on rounding slack)
@@ -126,6 +133,11 @@ class EngineConfig:
         if c.decode_kernel is None:
             from ..ops.pallas.paged_attention import decode_kernel_enabled
             c.decode_kernel = decode_kernel_enabled()
+        if c.spec is False:
+            c.spec = None
+        if c.spec is not None:
+            from .spec import SpecConfig
+            c.spec = (SpecConfig() if c.spec is True else c.spec).resolve()
         return c
 
 
@@ -153,7 +165,8 @@ class DecodeEngine:
 
     def __init__(self, params: Dict, model_config: GPTConfig,
                  config: Optional[EngineConfig] = None,
-                 _prepared: Optional[tuple] = None, **overrides):
+                 _prepared: Optional[tuple] = None,
+                 _draft_prepared: Optional[tuple] = None, **overrides):
         import jax
         self.model_config = model_config
         if config is not None and overrides:
@@ -236,6 +249,15 @@ class DecodeEngine:
         # bucketed so the compile count is log(max_blocks)-bounded
         self._window_jit = jax.jit(self._window_fn, donate_argnums=(2, 3),
                                    static_argnums=(14,))
+        # speculative-decoding verify programs, keyed (span, max_blocks):
+        # span is gamma+1 (fixed per engine) and max_blocks rides the same
+        # power-of-two hint ladder, so the compile-key count stays bounded
+        self._verify_jits: Dict[tuple, object] = {}
+        self.spec = None
+        if cfg.spec is not None:
+            from .spec import SpecDecoder
+            self.spec = SpecDecoder(self, cfg.spec, raw_params=params,
+                                    _draft_prepared=_draft_prepared)
 
     def _kv_scale(self) -> Optional[float]:
         """Static int8-KV dequant scale, None for float pools."""
@@ -246,16 +268,17 @@ class DecodeEngine:
     # narrowest page table the bounded-walk hint ladder engages on
     _LADDER_MIN_BLOCKS = 16
 
-    def _window_max_blocks(self) -> int:
+    def _max_blocks_hint(self, horizon: int) -> int:
         """Static hint: the furthest page-table column any slot can touch
-        this window. Both window read paths honor it — the fused kernel
-        bounds its grid, the fallback slices its gather — so short
-        contexts never pay full-`max_len` cache traffic. Rounded up to a
-        power of two (capped at the table width) to bound recompiles:
-        each distinct hint is a new window compile, so the ladder only
-        engages past _LADDER_MIN_BLOCKS columns — below that the bounded
-        walk saves less than one recompile costs and the engine always
-        reads the full (still tiny) table with ONE compiled window."""
+        over the next `horizon` positions. Both read paths honor it — the
+        fused kernel bounds its grid, the fallback slices its gather — so
+        short contexts never pay full-`max_len` cache traffic. Rounded up
+        to a power of two (capped at the table width) to bound
+        recompiles: each distinct hint is a new compile, so the ladder
+        only engages past _LADDER_MIN_BLOCKS columns — below that the
+        bounded walk saves less than one recompile costs and the engine
+        always reads the full (still tiny) table with ONE compiled
+        program."""
         cfg = self.config
         mb = cfg.max_len // cfg.block_size
         if mb <= self._LADDER_MIN_BLOCKS:
@@ -263,11 +286,14 @@ class DecodeEngine:
         mx = max((s.pos for s in self._slots.values()), default=None)
         if mx is None:
             return mb
-        need = (mx + cfg.window - 1) // cfg.block_size + 1
+        need = (mx + horizon - 1) // cfg.block_size + 1
         hint = 1
         while hint < need:
             hint *= 2
         return min(mb, hint)
+
+    def _window_max_blocks(self) -> int:
+        return self._max_blocks_hint(self.config.window)
 
     def _build_cache(self) -> PagedKVCache:
         import jax.numpy as jnp
@@ -379,6 +405,85 @@ class DecodeEngine:
         (k_pool, v_pool, *_), (toks, acts) = jax.lax.scan(
             step, carry0, None, length=self.config.window)
         return k_pool, v_pool, toks, acts
+
+    def _verify_fn(self, span: int, max_blocks: int):
+        """The speculative-decoding verify program (serving/spec.py): ONE
+        batched forward scoring `span` = gamma+1 candidate positions per
+        slot over the paged cache — pos..pos+span-1 hold the slot's
+        current token followed by the draft's proposals. Converts gamma
+        sequential bandwidth-bound window steps into one compute-shaped
+        pass: the weights are read once for span tokens.
+
+        Bit-parity with the window is BY CONSTRUCTION, not by luck:
+
+        * the k/v writes are the unrolled per-position paged_update the
+          window step uses (paged_update_span), quantizing/masking
+          identically — invalid rows (a slot whose clamped draft run is
+          shorter than span) land on the scratch block;
+        * the attend is span per-position calls with the window's EXACT
+          op shape — q [B, nh, 1, hd], mask <= pos+s — so every
+          reduction runs at the same width and tree position as the
+          window's at that step (paged_attend_span). Positions written
+          beyond s carry exactly-zero softmax weight, the same argument
+          that makes stale blocks bit-neutral;
+        * row s samples with the window's sample rule at generated index
+          gen+s — fold_in(PRNGKey(seed), gen+s) — so the target token at
+          every candidate position is the token spec-off decode would
+          emit there, for greedy AND seeded top-k.
+
+        The device also computes the per-slot accepted count: the length
+        of the longest prefix where the draft's candidate equals the
+        target's deterministic token. The round then emits v_0..v_A —
+        accepted agreements plus the target's own correction/bonus token
+        — which is exactly the spec-off stream. Pools are donated; the
+        census (serving/audit.py verify_copy_census) pins zero
+        pool-shaped copies on this program like the window."""
+        import jax
+        import jax.numpy as jnp
+        cfg = self.model_config
+        bs = self.config.block_size
+        n_layers = cfg.num_layers
+        kv_scale = self._kv_scale()
+        use_kernel = bool(self.config.decode_kernel)
+
+        def run(payloads, scales, k_pool, v_pool, page_table, cand, pos,
+                live, valid, gen, temps, top_ks, seeds):
+            p = self._model_params(payloads, scales)
+            offs = jnp.arange(span, dtype=jnp.int32)
+            # the window's embedding op family (row gathers); invalid
+            # rows' wpe indices clamp in-bounds under jnp gather rules
+            # and their outputs are ignored host-side
+            x = p["wte"][cand] + p["wpe"][pos[:, None] + offs[None, :]]
+            pools = [k_pool, v_pool]
+            for i in range(n_layers):
+                def merge(k1, v1, _i=i):
+                    pools[0], pools[1] = paged_update_span(
+                        pools[0], pools[1], k1, v1, page_table, pos, bs,
+                        _i, active=live, valid=valid, kv_scale=kv_scale)
+                    return lambda q: paged_attend_span(
+                        q, pools[0], pools[1], page_table, pos, bs,
+                        layer=_i, max_blocks=max_blocks,
+                        kv_scale=kv_scale, use_kernel=use_kernel)
+                x, _ = _block(x, p, i, cfg, None, merge)
+            k_pool, v_pool = pools
+            x = _ln(x, p["final_ln_scale"], p["final_ln_bias"])
+            logits = jnp.einsum("bsh,vh->bsv", x, p["wte"],
+                                preferred_element_type=jnp.float32)
+            vtok = jnp.stack(
+                [self._sample_rows(logits[:, s], temps, top_ks, seeds,
+                                   gen + s) for s in range(span)], axis=1)
+            agree = (cand[:, 1:] == vtok[:, :-1]) & valid[:, 1:]
+            n_acc = jnp.sum(jnp.cumprod(agree.astype(jnp.int32), axis=1),
+                            axis=1)
+            return k_pool, v_pool, vtok, n_acc
+        return jax.jit(run, donate_argnums=(2, 3))
+
+    def _verify_jit_for(self, span: int, max_blocks: int):
+        key = (span, max_blocks)
+        fn = self._verify_jits.get(key)
+        if fn is None:
+            fn = self._verify_jits[key] = self._verify_fn(span, max_blocks)
+        return fn
 
     def _prefill_fn(self, bucket: int):
         """Dense causal forward over one padded prompt bucket -> per-layer
@@ -845,6 +950,8 @@ class DecodeEngine:
             # drop the cache-owned chain references so the shared-block
             # gauge returns to zero before the allocator retires
             self.prefix_cache.clear(self.cache.allocator)
+        if self.spec is not None:
+            self.spec.close()   # retire the draft arm's pool too
         self.cache.close()   # retire this pool from the process gauges
 
     def __enter__(self):
@@ -876,7 +983,15 @@ class DecodeEngine:
             try:
                 self._admit()
                 if self._slots:
-                    self._run_window()
+                    # speculative rounds replace plain windows while the
+                    # draft arm is healthy; a dead/suspect draft degrades
+                    # to plain decode (zero failed requests — spec-on is
+                    # bit-identical to spec-off, so the stream just
+                    # continues at one token per step)
+                    if self.spec is not None and self.spec.armed:
+                        self.spec.run_round()
+                    else:
+                        self._run_window()
             except BaseException as e:  # noqa: BLE001 — fail requests, die
                 self._fail_all(repr(e))
                 break
@@ -916,6 +1031,8 @@ class DecodeEngine:
             _metrics.set_gauge("serving.queue_depth", 0)
         for idx in slots:
             self.cache.release(idx)
+        if self.spec is not None:
+            self.spec.release_all()
         victims = [(req, handle) for req, handle in pending]
         victims += [(slot.handle.request, slot.handle)
                     for slot in slots.values()]
@@ -988,6 +1105,11 @@ class DecodeEngine:
             # failed dispatch — start cold (the suffix jits survive:
             # same shapes, no recompile)
             self.prefix_cache = RadixPrefixCache(self.config.block_size)
+        if self.spec is not None:
+            # the draft arm's pool was dispatched alongside the target's:
+            # rebuild it and re-arm speculation — the caller's canary
+            # gate then validates the WHOLE spec-on path before rejoin
+            self.spec.reset()
         with self._cv:
             self._queue.clear()
             self._slots.clear()
@@ -1188,6 +1310,15 @@ class DecodeEngine:
                 max_new=req.max_new_tokens, temp=float(req.temperature),
                 top_k=int(req.top_k), seed=int(req.seed))
         _metrics.set_gauge("serving.active_slots", len(self._slots))
+        if self.spec is not None:
+            # mapped/reserve split (cache.py): keep only the blocks the
+            # prefill actually wrote in the page-table row; the rest of
+            # the funded budget waits in the reserve so a rejected round
+            # can truncate the row back without touching the allocator
+            bs = self.config.block_size
+            covered = (-(-plen // bs)) if matched else bucket // bs
+            self.cache.reserve_tail(slot_idx, covered)
+            self.spec.on_admit(slot_idx, req, plen, tok)
 
     def _cold_prefill(self, req, plen, bucket, blocks):
         """Dense prefill over the whole padded prompt bucket + block
@@ -1327,6 +1458,15 @@ class DecodeEngine:
         _flight.begin_step(self._windows, owner=owner)
         status = "ok"
         scales = self.scales if self.scales is not None else {}
+        if self.spec is not None:
+            # degraded-to-plain path on a spec engine: the mapped row may
+            # lag the reserve split, so map enough blocks to cover every
+            # position this window can write for each slot
+            bs = self.config.block_size
+            for idx, s in list(self._slots.items()):
+                last = s.pos + min(self.config.window,
+                                   s.max_new - s.gen) - 1
+                self.cache.extend_mapped(idx, last // bs + 1)
         args = self._window_args()
         fid = _trace.new_flow()
         t0 = time.perf_counter()
@@ -1372,39 +1512,132 @@ class DecodeEngine:
             else 0.8 * self._window_ms_ewma + 0.2 * window_ms)
         self._apply_window(toks, acts)
 
+    def _apply_slot_tokens(self, idx: int, slot: _Slot, tokens) -> tuple:
+        """Host-side walk of one slot's emitted tokens (eos/length
+        truncation), shared by the plain window and the speculative
+        verify round. Appends to the handle, retires the slot when it
+        finishes. Returns (n_emitted, finish_reason | None)."""
+        emitted = []
+        finished = None
+        for tok in tokens:
+            tok = int(tok)
+            emitted.append(tok)
+            slot.gen += 1
+            slot.pos += 1
+            slot.token = tok
+            if tok == slot.eos:
+                finished = "eos"
+                break
+            if slot.gen >= slot.max_new:
+                finished = "length"
+                break
+        if emitted:
+            slot.handle._append_tokens(emitted)
+        if finished is not None:
+            self._publish_prefix(idx, slot.handle.request)
+            self.cache.release(idx)
+            with self._cv:    # load()/stats() iterate cross-thread
+                self._slots.pop(idx, None)
+            if self.spec is not None:
+                self.spec.on_release(idx)
+            self._retire(slot.handle, finished)
+        return len(emitted), finished
+
     def _apply_window(self, toks: np.ndarray, acts: np.ndarray):
         n_tokens = 0
         for idx in list(self._slots):
             slot = self._slots.get(idx)
             if slot is None:    # defensively tolerate a concurrent clear
                 continue
-            emitted = []
-            finished = None
+            run = []
             for t in range(toks.shape[0]):
                 if not acts[t, idx]:
                     break
-                tok = int(toks[t, idx])
-                emitted.append(tok)
-                slot.gen += 1
-                slot.pos += 1
-                slot.token = tok
-                if tok == slot.eos:
-                    finished = "eos"
-                    break
-                if slot.gen >= slot.max_new:
-                    finished = "length"
-                    break
-            if emitted:
-                slot.handle._append_tokens(emitted)
-                n_tokens += len(emitted)
-            if finished is not None:
-                self._publish_prefix(idx, slot.handle.request)
-                self.cache.release(idx)
-                with self._cv:    # load()/stats() iterate cross-thread
-                    self._slots.pop(idx, None)
-                self._retire(slot.handle, finished)
+                run.append(int(toks[t, idx]))
+            n, _ = self._apply_slot_tokens(idx, slot, run)
+            n_tokens += n
         _metrics.inc("serving.tokens_out", n_tokens)
         _metrics.set_gauge("serving.active_slots", len(self._slots))
+
+    # ---- speculative verify round (serving/spec.py drives this) ---------
+    def _verify_args(self, cand: np.ndarray, valid: np.ndarray):
+        import jax.numpy as jnp
+        B = self.config.max_slots
+        pos = np.zeros((B,), np.int32)
+        gen = np.zeros((B,), np.int32)
+        live = np.zeros((B,), bool)
+        temps = np.zeros((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        seeds = np.zeros((B,), np.uint32)
+        for i, s in self._slots.items():
+            pos[i], gen[i] = s.pos, s.gen
+            live[i], temps[i] = True, s.temp
+            top_ks[i], seeds[i] = s.top_k, s.seed
+        pt = jnp.asarray(self.cache.page_table_rows(B))
+        return tuple(jnp.asarray(a) for a in
+                     (pt, cand, pos, live, valid, gen, temps, top_ks,
+                      seeds))
+
+    def _run_verify(self, cand: np.ndarray, valid: np.ndarray):
+        """Dispatch ONE speculative verify round: the batched program
+        from _verify_fn scoring span candidate positions per slot.
+        Mirrors _run_window's envelope — same serving.window fault site
+        (a chaos kill lands at the identical boundary whether speculation
+        is armed or not), same flight step / SLA deadline / EWMA clock.
+        Returns (vtok [B, span], n_acc [B]) as host arrays; the caller
+        (SpecDecoder.run_round) applies them."""
+        from ..framework.executor import _deadline_call
+        fault_point("serving.window")
+        span = int(cand.shape[1])
+        self._windows += 1
+        _metrics.inc("serving.windows")
+        owner = 0x5E0 + self._id
+        _flight.begin_step(self._windows, owner=owner)
+        status = "ok"
+        scales = self.scales if self.scales is not None else {}
+        fn = self._verify_jit_for(span, self._max_blocks_hint(span))
+        args = self._verify_args(cand, valid)
+        fid = _trace.new_flow()
+        t0 = time.perf_counter()
+
+        def dispatch_and_drain():
+            with _trace.RecordEvent(
+                    "serving.spec_verify",
+                    args={"window": self._windows, "span": span,
+                          "active": len(self._slots)}):
+                _trace.flow_start("serving.window_fetch", fid)
+                k_pool, v_pool, vtok, n_acc = fn(
+                    self.params, scales, self.cache.k_pool,
+                    self.cache.v_pool, *args)
+                self.cache.update_pools(k_pool, v_pool)
+                h = FetchHandle(vtok, name="serving.verify_tokens",
+                                flow=fid)
+                return h.numpy(), np.asarray(n_acc)
+
+        from ..framework import errors as _errors
+        deadline = float(flag("FLAGS_step_deadline_ms") or 0.0)
+        try:
+            if deadline > 0:
+                vtok, n_acc = _deadline_call(
+                    dispatch_and_drain, deadline,
+                    f"serving verify ({len(self._slots)} active slots)")
+            else:
+                vtok, n_acc = dispatch_and_drain()
+        except _errors.DeadlineExceededError:
+            status = "sla_trip"
+            _metrics.inc("serving.sla_trips")
+            raise
+        except BaseException:
+            status = "error"
+            raise
+        finally:
+            _flight.end_step(self._windows, status=status, owner=owner)
+        window_ms = (time.perf_counter() - t0) * 1000.0
+        _metrics.observe("serving.window_ms", window_ms)
+        self._window_ms_ewma = (
+            window_ms if self._window_ms_ewma is None
+            else 0.8 * self._window_ms_ewma + 0.2 * window_ms)
+        return vtok, n_acc
 
     # ------------------------------------------------------------------
     # introspection
@@ -1431,6 +1664,8 @@ class DecodeEngine:
                 "prefill_tokens_saved": self._prefill_tokens_saved,
                 "shared_blocks": self.cache.allocator.shared_blocks,
             })
+        if self.spec is not None:
+            row.update(self.spec.stats())
         return row
 
     def window_abstract_args(self):
@@ -1454,6 +1689,28 @@ class DecodeEngine:
                 sds((B,), jnp.int32), sds((B,), jnp.uint32),
                 sds((B,), jnp.int32), sds((B,), jnp.int32),
                 mb)
+
+    def verify_abstract_args(self, span: int):
+        """ShapeDtypeStructs of one verify call (serving/audit.py lowers
+        the speculative verify program from these to extend the zero-copy
+        and dense-gather censuses to the new compiled surface)."""
+        import jax
+        import jax.numpy as jnp
+        B = self.config.max_slots
+        sds = jax.ShapeDtypeStruct
+        tree_sds = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda a: sds(a.shape, a.dtype), t)
+        pool = sds(self.cache.config.pool_shape(),
+                   self.cache.k_pool.dtype)
+        mb = self.cache.config.max_blocks_per_slot
+        return (tree_sds(self.params),
+                tree_sds(self.scales if self.scales is not None else {}),
+                pool, pool,
+                sds((B, mb), jnp.int32), sds((B, span), jnp.int32),
+                sds((B,), jnp.int32), sds((B,), jnp.bool_),
+                sds((B, span), jnp.bool_), sds((B,), jnp.int32),
+                sds((B,), jnp.float32), sds((B,), jnp.int32),
+                sds((B,), jnp.uint32))
 
     def suffix_abstract_args(self, p_pad: int = 2,
                              sbucket: Optional[int] = None):

@@ -48,10 +48,14 @@ def _clone_engine(src: DecodeEngine) -> DecodeEngine:
     immutable to the window program) with its own cache + scheduler.
     prepare_params NEVER runs for a clone — the _prepared fast path adopts
     src's exact device buffers, so HBM holds ONE weight copy (identity
-    pinned per-array by tests/test_serving_resilience.py)."""
+    pinned per-array by tests/test_serving_resilience.py). A spec-enabled
+    source hands its draft arm's prepared arrays over the same way: one
+    draft weight copy across replicas."""
     return DecodeEngine(
         None, src.model_config, config=src.config,
-        _prepared=(src.params, src.scales, src.compute_dtype))
+        _prepared=(src.params, src.scales, src.compute_dtype),
+        _draft_prepared=(src.spec.draft_prepared
+                         if src.spec is not None else None))
 
 
 class RoundRobinFrontend:

@@ -29,7 +29,7 @@ def build_decode_step_program(num_layers: int = 2, num_blocks: int = 64,
                               head_dim: int = 8, max_slots: int = 4,
                               max_blocks_per_slot: int = 4,
                               use_kernel: bool = False,
-                              max_blocks=None):
+                              max_blocks=None, span: int = 1):
     """Append one serving decode step to the current default program:
     paged_cache_update (the donated in-place pool write) followed by
     paged_attention (the gather + masked attend). Returns
@@ -39,11 +39,17 @@ def build_decode_step_program(num_layers: int = 2, num_blocks: int = 64,
     `use_kernel=True` stamps the fused-Pallas read path onto the
     paged_attention op (same donation/alias profile — the kernel reads
     the pools without consuming them, so the static proof is one proof
-    for both read implementations); `max_blocks` bounds the walk."""
+    for both read implementations); `max_blocks` bounds the walk.
+
+    `span > 1` builds the SPECULATIVE VERIFY step instead: both ops
+    carry gamma+1 positions per slot ([B, span*nh*hd], position-major)
+    and the `span` attr, unrolling to the same per-position update/
+    attend the window step runs — so the verify program's static
+    donation/alias proof is the decode step's proof at a wider feed."""
     import paddle_tpu.fluid as fluid
 
     gb = fluid.default_main_program().global_block()
-    h = num_heads * head_dim
+    h = num_heads * head_dim * span
     pool_shape = (num_layers, num_blocks, num_heads, block_size, head_dim)
 
     pools = []
@@ -63,6 +69,9 @@ def build_decode_step_program(num_layers: int = 2, num_blocks: int = 64,
         feeds[nm] = gb.create_var(name=nm, shape=shape, dtype=dtype,
                                   is_data=True, stop_gradient=True)
 
+    upd_attrs = {"block_size": block_size}
+    if span > 1:
+        upd_attrs["span"] = int(span)
     gb.append_op(
         "paged_cache_update",
         inputs={"KPool": ["serving_k_pool"], "VPool": ["serving_v_pool"],
@@ -70,11 +79,13 @@ def build_decode_step_program(num_layers: int = 2, num_blocks: int = 64,
                 "PageTable": ["dec_page_table"], "Pos": ["dec_pos"]},
         outputs={"KPoolOut": ["serving_k_pool"],
                  "VPoolOut": ["serving_v_pool"]},
-        attrs={"block_size": block_size})
+        attrs=upd_attrs)
 
     ctx = gb.create_var(name="dec_context", shape=(max_slots, h),
                         dtype="float32", stop_gradient=True)
     attn_attrs = {"block_size": block_size, "use_kernel": bool(use_kernel)}
+    if span > 1:
+        attn_attrs["span"] = int(span)
     if max_blocks is not None:
         attn_attrs["max_blocks"] = int(max_blocks)
     gb.append_op(

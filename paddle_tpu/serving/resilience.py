@@ -255,6 +255,22 @@ class ServingFrontend:
         elif (h == Health.DEAD and self._resurrect_enabled
                 and id(eng) not in self._gave_up):
             self._try_resurrect(eng)
+            return
+        # the DRAFT arm walks the same ladder, one level down: a degraded
+        # draft only costs speculation (the target keeps serving plain
+        # decode, zero failed requests), so its resurrection runs behind
+        # a LIVE target and re-arms only after the canary passes WITH
+        # speculation armed — a valid gate because spec-on == spec-off
+        # bitwise
+        spec = getattr(eng, "spec", None)
+        if spec is None or eng.health != Health.LIVE \
+                or eng._dead is not None:
+            return
+        if spec.health == Health.SUSPECT:
+            spec._set_health(Health.DEAD)   # frontend-confirmed
+        elif (spec.health == Health.DEAD and self._resurrect_enabled
+                and ("draft", id(eng)) not in self._gave_up):
+            self._try_resurrect_draft(eng)
 
     def _try_resurrect(self, eng):
         policy = RetryPolicy(
@@ -316,6 +332,51 @@ class ServingFrontend:
         # engine's lifetime would permanently disable its resurrection
         self._unexpected_errors.pop(id(eng), None)
         _trace.instant("serving.resurrected", args={"engine": eng._id})
+
+    def _try_resurrect_draft(self, eng):
+        policy = RetryPolicy(
+            max_attempts=int(flag("FLAGS_serving_resurrect_budget")),
+            base_delay_s=0.05, max_delay_s=1.0, deadline_s=None,
+            retry_on=(_errors.UnavailableError,))
+        try:
+            policy.call(self._resurrect_draft_once, eng,
+                        site="serving.spec.resurrect",
+                        abort=lambda: self._stopped or self._draining)
+        except _errors.DeadlineExceededError:
+            eng.spec._set_health(Health.DEAD)
+            if self._stopped or self._draining:
+                return
+            self._gave_up.add(("draft", id(eng)))
+            _metrics.inc("serving.resurrect_gave_up")
+
+    def _resurrect_draft_once(self, eng):
+        if self._stopped or self._draining:
+            raise _errors.Unavailable(
+                "frontend stopping — draft resurrection of engine %d "
+                "aborted", eng._id)
+        spec = eng.spec
+        spec.resurrect_draft()
+        # provisional re-arm: the canary must decode THROUGH speculation
+        # to vouch for the draft path, and the bit-parity contract makes
+        # its expectation identical either way
+        spec.rearm()
+        expected = self._canary_expected()
+        comp = self._run_canary(eng)
+        if eng._dead is not None:
+            # the TARGET died during the spec-armed canary: the draft
+            # cannot be vouched for, and the engine's own ladder owns
+            # the recovery now
+            spec._set_health(Health.DEAD)
+            raise _errors.Unavailable(
+                "engine %d died during the spec-armed canary (%s)",
+                eng._id, eng._dead)
+        if not comp.ok or (expected is not None
+                           and comp.tokens != expected):
+            spec._set_health(Health.DEAD)
+            raise _errors.Unavailable(
+                "spec-armed canary mismatch on engine %d", eng._id)
+        _metrics.inc("serving.spec.rearmed")
+        _trace.instant("serving.spec.rearmed", args={"engine": eng._id})
 
     def _canary_expected(self) -> Optional[List[int]]:
         """The canary's expected tokens, derived (once) from a LIVE

@@ -500,6 +500,192 @@ def serving_drill(args) -> bool:
     return ok
 
 
+def spec_drill(args) -> bool:
+    """Speculative-decoding chaos (docs/serving.md "Speculative
+    decoding"): the bf16 arm of the bit-parity contract under faults.
+
+    Leg A kills the DRAFT engine mid-stream: speculation must degrade
+    to plain decode at the next round boundary with zero failed
+    requests and every completion bit-identical to the spec-off
+    oracle, then the frontend health loop resurrects the draft behind
+    the canary gate and re-arms it (the canary decodes THROUGH
+    speculation — a valid gate because spec-on == spec-off by
+    construction).
+
+    Leg B kills a whole spec-on replica mid-window (the injected
+    serving.window fault fires in the verify dispatch too): failover
+    must replay the victim's requests on the peer replica — through
+    the peer's own speculation — bit-identically."""
+    import time as _time
+    from paddle_tpu.flags import set_flags
+    from paddle_tpu.observability import metrics as m
+    from paddle_tpu.resilience import clear_plan, install_plan
+    from paddle_tpu.serving import (DecodeEngine, Health, ServingFrontend,
+                                    replicated_engines)
+
+    geo = dict(max_slots=4, block_size=8, num_blocks=64, max_len=48,
+               window=4, dtype="bfloat16")
+    cfg, params = _serving_tiny_gpt()
+    reqs = _serving_requests(args.serving_requests, cfg.vocab_size,
+                             args.seed + 1)
+
+    print(f"[spec-drill] oracle: {len(reqs)} requests, spec-off bf16 "
+          "engine, no faults")
+    clear_plan()
+    oracle_eng = DecodeEngine(params, cfg, **geo)
+    oracle = {c.uid: c for c in oracle_eng.generate(reqs, timeout=600)}
+    oracle_eng.stop()
+    bad = [c for c in oracle.values() if not c.ok]
+    assert not bad, f"oracle leg failed: {[(c.uid, c.state) for c in bad]}"
+
+    for name in ("serving.spec.degraded", "serving.spec.rearmed",
+                 "serving.failovers", "serving.engine_failures",
+                 "serving.shed_total"):
+        m.reset(name)
+    set_flags({"FLAGS_serving_health_interval_ms": 50.0})
+    ok = True
+
+    # ------ leg A: draft dies mid-stream -> degrade, canary re-arm ------
+    print("[spec-drill] leg A: 1 spec-on replica, draft killed "
+          "mid-stream")
+    engines = replicated_engines(1, params, cfg, prefix_cache=True,
+                                 spec=True, **geo)
+    fe = ServingFrontend(engines)
+    try:
+        half = max(len(reqs) // 2, 1)
+        handles = []
+        for r in reqs[:half]:
+            handles.append(fe.submit(r))
+            _time.sleep(0.002)
+        # let speculation commit at least one accepted draft token, then
+        # kill the draft while the second wave keeps the stream alive
+        deadline = _time.monotonic() + 30
+        while (_time.monotonic() < deadline
+               and engines[0].stats().get("spec_accepted", 0) < 1):
+            _time.sleep(0.01)
+        spec_live = engines[0].stats().get("spec_accepted", 0) >= 1
+        engines[0].spec.kill_draft("spec drill: draft dies mid-stream")
+        for r in reqs[half:]:
+            handles.append(fe.submit(r))
+            _time.sleep(0.002)
+        comps = [h.result(timeout=600, raise_on_error=False)
+                 for h in handles]
+
+        if not spec_live:
+            print("[spec-drill] FAIL: speculation never accepted a "
+                  "draft token before the kill — leg A killed a draft "
+                  "that was not speculating")
+            ok = False
+        failed = [c for c in comps if not c.ok]
+        if failed:
+            print(f"[spec-drill] FAIL: {len(failed)} request(s) not done "
+                  f"after the draft kill: "
+                  f"{[(c.uid, c.state, c.error) for c in failed[:4]]}")
+            ok = False
+        for c in comps:
+            if c.tokens != oracle[c.uid].tokens:
+                print(f"[spec-drill] FAIL: {c.uid} diverged from the "
+                      f"spec-off oracle across the draft kill: "
+                      f"{c.tokens} != {oracle[c.uid].tokens}")
+                ok = False
+        degraded = int(m.get("serving.spec.degraded"))
+        if degraded < 1:
+            print(f"[spec-drill] FAIL: serving.spec.degraded == "
+                  f"{degraded} — the kill never degraded speculation")
+            ok = False
+
+        # the frontend health loop must walk the draft down the ladder
+        # (suspect -> dead) and back up (resurrect -> canary -> re-arm)
+        # wait on the counter, not spec.armed: rearm() is provisional
+        # (set BEFORE the canary so the canary decodes through
+        # speculation); the counter lands only after the gate passes
+        deadline = _time.monotonic() + 60
+        while (_time.monotonic() < deadline
+               and int(m.get("serving.spec.rearmed")) < 1):
+            _time.sleep(0.05)
+        if int(m.get("serving.spec.rearmed")) < 1:
+            print("[spec-drill] FAIL: serving.spec.rearmed never "
+                  "counted — the canary gate did not pass "
+                  f"(health {engines[0].spec.health})")
+            ok = False
+        elif not engines[0].spec.armed:
+            print("[spec-drill] FAIL: draft re-armed then dropped "
+                  f"(health {engines[0].spec.health})")
+            ok = False
+        post = fe.generate([reqs[0]], timeout=300)[0]
+        if not (post.ok and post.tokens == oracle[reqs[0].uid].tokens):
+            print("[spec-drill] FAIL: post-re-arm request diverged: "
+                  f"{post.state} {post.tokens}")
+            ok = False
+        if ok:
+            print(f"[spec-drill] leg A PASS: {len(comps)} requests "
+                  "bit-identical across a mid-stream draft kill "
+                  f"(degraded x{degraded}, re-armed "
+                  f"x{int(m.get('serving.spec.rearmed'))}, 0 failed)")
+    finally:
+        fe.stop()
+
+    # ------ leg B: spec-on replica dies mid-window -> failover replay --
+    spec_plan = f"serving.window:error:at={args.kill_window}"
+    print(f"[spec-drill] leg B: 2 spec-on replicas, plan {spec_plan!r} "
+          "(replica dies mid-decode; the fault fires in draft/verify "
+          "dispatch too)")
+    plan = install_plan(spec_plan, seed=args.seed)
+    engines2 = replicated_engines(2, params, cfg, prefix_cache=True,
+                                  spec=True, **geo)
+    fe2 = ServingFrontend(engines2)
+    try:
+        handles = []
+        for r in reqs:
+            handles.append(fe2.submit(r))
+            _time.sleep(0.002)
+        comps = [h.result(timeout=600, raise_on_error=False)
+                 for h in handles]
+        failed = [c for c in comps if not c.ok]
+        if failed:
+            print(f"[spec-drill] FAIL: {len(failed)} request(s) not done "
+                  f"across the replica kill: "
+                  f"{[(c.uid, c.state, c.error) for c in failed[:4]]}")
+            ok = False
+        for c in comps:
+            if c.tokens != oracle[c.uid].tokens:
+                print(f"[spec-drill] FAIL: {c.uid} failover replay "
+                      f"diverged: {c.tokens} != {oracle[c.uid].tokens}")
+                ok = False
+        fired = sum(r.fired for r in plan.rules)
+        failovers = int(m.get("serving.failovers"))
+        if fired != 1 or failovers < 1:
+            print(f"[spec-drill] FAIL: expected 1 injected window fault "
+                  f"-> >=1 failover, got fired={fired} "
+                  f"failovers={failovers}")
+            ok = False
+        deadline = _time.monotonic() + 60
+        while _time.monotonic() < deadline and not all(
+                e.health == Health.LIVE and e._dead is None
+                for e in engines2):
+            _time.sleep(0.05)
+        if not all(e.health == Health.LIVE for e in engines2):
+            print("[spec-drill] FAIL: killed spec-on replica never "
+                  "resurrected")
+            ok = False
+        accepted = sum(e.stats().get("spec_accepted", 0)
+                       for e in engines2)
+        if accepted < 1:
+            print("[spec-drill] FAIL: no draft token accepted in leg B "
+                  "— the failover replay never rode speculation")
+            ok = False
+        if ok:
+            print(f"[spec-drill] leg B PASS: {len(comps)} requests "
+                  "bit-identical across a spec-on replica kill "
+                  f"({failovers} failover(s), {accepted} draft tokens "
+                  "accepted, 0 failed)")
+    finally:
+        clear_plan()
+        set_flags({"FLAGS_serving_health_interval_ms": 200.0})
+        fe2.stop()
+    return ok
+
+
 # --- training-integrity drill ------------------------------------------
 # Leg A trainer: runs under distributed.launch (gang mode) or standalone
 # (oracle mode). Each rank trains its OWN deterministic schedule; gang
@@ -1042,6 +1228,12 @@ def main():
                          "decode replica mid-stream via FaultPlan and "
                          "assert failover bit-parity + exact counters + "
                          "canary-gated resurrection")
+    ap.add_argument("--spec-drill", action="store_true",
+                    help="run the speculative-decoding chaos drill: kill "
+                         "the draft mid-stream (degrade to plain decode, "
+                         "bit-parity, canary re-arm) and a spec-on "
+                         "replica mid-window (failover replay parity), "
+                         "both on the bf16 arm")
     ap.add_argument("--kill-window", type=int, default=3,
                     help="serving drill: inject the replica-killing "
                          "fault at this global decode-window count")
@@ -1062,9 +1254,17 @@ def main():
         print("[chaos_smoke] integrity drill " + ("PASS" if ok else "FAIL"))
         return 0 if ok else 1
 
-    if args.serving_drill:
-        ok = serving_drill(args)
-        print("[chaos_smoke] serving drill " + ("PASS" if ok else "FAIL"))
+    if args.serving_drill or args.spec_drill:
+        ok = True
+        if args.serving_drill:
+            ok = serving_drill(args)
+            print("[chaos_smoke] serving drill "
+                  + ("PASS" if ok else "FAIL"))
+        if args.spec_drill:
+            sok = spec_drill(args)
+            print("[chaos_smoke] spec drill "
+                  + ("PASS" if sok else "FAIL"))
+            ok = ok and sok
         return 0 if ok else 1
 
     if args.preemption_drill:

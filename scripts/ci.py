@@ -422,6 +422,39 @@ def collect_serving_smoke(proc, timeout=1200) -> bool:
     return proc.returncode == 0
 
 
+# Speculative-decoding smoke (ISSUE-19 CI satellite):
+# scripts/serving_smoke.py --spec — run the same mixed greedy + seeded
+# top-k traffic through a spec-off and a spec-on engine and assert
+# token-for-token bit-parity, acceptance over >= 1 round, zero
+# pool-shaped copies in the verify program, and a clean span>1 static
+# twin. Overlapped with the shards (--no-spec-smoke to skip).
+def start_spec_smoke(env):
+    script = os.path.join(ROOT, "scripts", "serving_smoke.py")
+    child_env = dict(env)
+    child_env["PADDLE_TPU_AUDIT_CHILD"] = "1"  # env already is the CPU mesh
+    return subprocess.Popen(
+        [sys.executable, script, "--spec"],
+        cwd=ROOT, env=child_env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+
+
+def collect_spec_smoke(proc, timeout=1200) -> bool:
+    try:
+        out_s, err_s = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        print(f"[spec-smoke] FAIL timed out after {timeout}s")
+        return False
+    lines = (out_s or "").strip().splitlines()
+    status = "OK " if proc.returncode == 0 else "FAIL"
+    body = "\n".join("    " + ln for ln in lines[-4:])
+    tail = (err_s or "").strip().splitlines()[-25:]
+    print(f"[spec-smoke] {status}\n{body}" + (
+        "\n" + "\n".join(tail) if proc.returncode != 0 else ""))
+    return proc.returncode == 0
+
+
 # Pallas kernel smoke (ISSUE-17 CI satellite): scripts/kernel_smoke.py —
 # interpret-mode BITWISE parity of the fused paged-attention decode
 # kernel vs the dense-gather oracle (f32/bf16/int8 x block sizes) and of
@@ -461,11 +494,14 @@ def collect_kernel_smoke(proc, timeout=1200) -> bool:
 # mid-stream; the drill pins 0 failed requests, bit-parity vs the
 # undisturbed oracle run, exact shed/failover counters, and the killed
 # replica's canary-gated resurrection. Overlapped with the shards
-# (--no-serving-chaos to skip).
+# (--no-serving-chaos to skip). ISSUE-19 chains the speculative drill
+# onto the same run: draft killed mid-stream (degrade + canary re-arm)
+# and a spec-on replica killed mid-window (failover replay parity),
+# both bf16 bit-parity vs the spec-off oracle.
 def start_serving_chaos(env):
     script = os.path.join(ROOT, "scripts", "chaos_smoke.py")
     return subprocess.Popen(
-        [sys.executable, script, "--serving-drill"],
+        [sys.executable, script, "--serving-drill", "--spec-drill"],
         cwd=ROOT, env=env, stdout=subprocess.PIPE,
         stderr=subprocess.PIPE, text=True)
 
@@ -565,6 +601,10 @@ def main():
                          "engine + 32 streamed requests + KV copy census "
                          "+ supervised decode gang, "
                          "scripts/serving_smoke.py)")
+    ap.add_argument("--no-spec-smoke", action="store_true",
+                    help="skip the speculative-decoding smoke (spec-on "
+                         "vs spec-off bit-parity + acceptance + verify "
+                         "copy census, scripts/serving_smoke.py --spec)")
     ap.add_argument("--no-kernel-smoke", action="store_true",
                     help="skip the Pallas kernel smoke (fused decode + "
                          "optimizer-update interpret parity and the "
@@ -617,6 +657,9 @@ def main():
     serving_proc = None
     if not args.no_serving_smoke:
         serving_proc = start_serving_smoke(env)    # overlaps the shards too
+    spec_proc = None
+    if not args.no_spec_smoke:
+        spec_proc = start_spec_smoke(env)          # overlaps the shards too
     kernel_proc = None
     if not args.no_kernel_smoke:
         kernel_proc = start_kernel_smoke(env)      # overlaps the shards too
@@ -683,6 +726,8 @@ def main():
         failed = failed or not collect_pod_trace_smoke(pod_proc)
     if serving_proc is not None:
         failed = failed or not collect_serving_smoke(serving_proc)
+    if spec_proc is not None:
+        failed = failed or not collect_spec_smoke(spec_proc)
     if kernel_proc is not None:
         failed = failed or not collect_kernel_smoke(kernel_proc)
     if chaos_proc is not None:

@@ -257,6 +257,18 @@ def serving_census(max_slots=4, block_size=8, num_blocks=64, max_len=64,
                            window=window, dtype=dtype, decode_kernel=True)
     row["dense_gathers_kernel"] = \
         audit.decode_gather_census(kengine)["dense_gathers"]
+    # the speculative verify program (serving/spec.py): BOTH census arms
+    # extend to the second pool-touching compiled surface — zero
+    # pool-shaped copies on the fallback arm, zero dense cache-view
+    # materializations on the fused-kernel arm (the kernel-on pool-copy
+    # census is skipped for the same interpret-mode reason as the window's)
+    vrow = audit.verify_copy_census(engine)
+    row["verify_span"] = vrow["span"]
+    row["verify_pool_copies"] = vrow["pool_copies"]
+    row["verify_dense_gathers_fallback"] = \
+        audit.verify_gather_census(engine)["dense_gathers"]
+    row["verify_dense_gathers_kernel"] = \
+        audit.verify_gather_census(kengine)["dense_gathers"]
     return row
 
 
@@ -319,8 +331,14 @@ def main():
         print(f"dense cache-view census: fallback "
               f"{row['dense_gathers_fallback']} materializations, fused "
               f"kernel {row['dense_gathers_kernel']} (bar: 0)")
+        print(f"speculative verify (span={row['verify_span']}): pool "
+              f"copies {row['verify_pool_copies']}; dense gathers "
+              f"fallback {row['verify_dense_gathers_fallback']}, fused "
+              f"kernel {row['verify_dense_gathers_kernel']} (bar: 0)")
         sys.exit(1 if (row["per_token_kv_copies"]
-                       or row["dense_gathers_kernel"]) else 0)
+                       or row["dense_gathers_kernel"]
+                       or row["verify_pool_copies"]
+                       or row["verify_dense_gathers_kernel"]) else 0)
 
     if args.bench:
         geo = dict(layers=12, hidden=768, heads=12, ffn=3072,

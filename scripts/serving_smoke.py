@@ -173,6 +173,92 @@ def run_smoke(n_requests: int, replicas: int, window: int) -> int:
 
 
 # ---------------------------------------------------------------------------
+# speculative-decoding leg (scripts/ci.py runs this overlapped as its own
+# process: serving_smoke.py --spec)
+# ---------------------------------------------------------------------------
+
+def run_spec_smoke(n_requests: int) -> int:
+    """Boot one spec-OFF and one spec-ON engine over the same tiny model
+    and the same mixed traffic (greedy + seeded top-k, shared prefixes —
+    the prefix cache stays ON so speculation is exercised over prefix
+    hits too) and assert:
+
+    * bit-parity — every spec-on completion equals its spec-off twin
+      token-for-token (the construction contract, docs/serving.md
+      "Speculative decoding");
+    * speculation actually ran — rounds >= 1 and accepted >= 1 (a draft
+      arm of the SAME checkpoint agrees with the target far more often
+      than not);
+    * the verify program passes both audit arms (zero pool-shaped
+      copies, fallback attend) and its static twin (span > 1) carries
+      zero donation/alias findings.
+    """
+    from paddle_tpu.serving import DecodeEngine
+    from paddle_tpu.serving import audit
+    from paddle_tpu.serving.program import analyze_decode_step
+
+    cfg, params = _build_tiny_params()
+    kw = dict(max_slots=4, block_size=8, num_blocks=96, max_len=64,
+              window=4, prefix_cache=True)
+    reqs = _mixed_requests(n_requests, cfg.vocab_size, seed=7)
+
+    base = DecodeEngine(params, cfg, **kw)
+    t0 = time.perf_counter()
+    ref = base.generate(reqs, timeout=600)
+    base_wall = time.perf_counter() - t0
+    base.stop()
+
+    spec_eng = DecodeEngine(params, cfg, spec=True, **kw)
+    t0 = time.perf_counter()
+    got = spec_eng.generate(reqs, timeout=600)
+    spec_wall = time.perf_counter() - t0
+    stats = spec_eng.stats()
+
+    failures = []
+    bad = [c for c in ref + got if not c.ok]
+    if bad:
+        failures.append(f"{len(bad)} requests not done: "
+                        f"{[(c.uid, c.state, c.error) for c in bad[:5]]}")
+    mismatched = [r.uid for r, g in zip(ref, got) if r.tokens != g.tokens]
+    if mismatched:
+        failures.append(
+            f"spec-on != spec-off for {len(mismatched)} request(s): "
+            f"{mismatched[:5]} — the bit-parity contract is broken")
+    if stats.get("spec_rounds", 0) < 1:
+        failures.append("speculation never ran a round "
+                        f"(stats: {stats.get('spec_rounds')})")
+    if stats.get("spec_accepted", 0) < 1:
+        failures.append(
+            "the draft arm never had a proposal accepted "
+            f"(proposed={stats.get('spec_proposed')}) — speculation is "
+            "running but pure overhead")
+
+    vrow = audit.verify_copy_census(spec_eng)
+    if vrow["pool_copies"]:
+        failures.append(f"verify KV copy census: "
+                        f"{vrow['kv_copy_findings']}")
+    spec_eng.stop()
+    span = vrow["span"]
+    twin = analyze_decode_step(span=span)
+    if twin["errors"] or twin["warnings"]:
+        failures.append(
+            f"static verify twin findings: {twin['findings']}")
+
+    n_tok = sum(len(c.tokens) for c in got)
+    rate = stats.get("spec_accept_rate", 0.0)
+    print(f"spec smoke: {len(got)} requests, {n_tok} tokens; "
+          f"accept rate {rate:.2f} over {stats.get('spec_rounds')} "
+          f"round(s) ({stats.get('spec_accepted')}/"
+          f"{stats.get('spec_proposed')} tokens), "
+          f"off {base_wall:.1f}s vs on {spec_wall:.1f}s, "
+          f"verify kv-copies={vrow['pool_copies']} (span {span}), "
+          f"twin findings={twin['errors'] + twin['warnings']}")
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+# ---------------------------------------------------------------------------
 # supervised gang leg
 # ---------------------------------------------------------------------------
 
@@ -230,6 +316,10 @@ def main():
     ap.add_argument("--window", type=int, default=4)
     ap.add_argument("--supervised", action="store_true",
                     help="add the launch.py-hosted 2-worker gang leg")
+    ap.add_argument("--spec", action="store_true",
+                    help="run ONLY the speculative-decoding leg (spec-on "
+                         "vs spec-off bit-parity + acceptance + verify "
+                         "censuses); ci.py overlaps this as its own run")
     ap.add_argument("--worker", action="store_true",
                     help="internal: run as a supervised gang member")
     ap.add_argument("--requests-file", default="")
@@ -252,6 +342,9 @@ def main():
                 [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
                 cwd=ROOT, env=env, timeout=3600)
             return proc.returncode
+
+    if args.spec:
+        return run_spec_smoke(args.requests)
 
     rc = run_smoke(args.requests, args.replicas, args.window)
     if args.supervised:

@@ -1,0 +1,367 @@
+"""Speculative decoding (paddle_tpu/serving/spec.py): the ISSUE-19 pins.
+
+* spec-on output is BIT-IDENTICAL to spec-off — greedy AND seeded
+  top-k, continuous batching, prefix-cache hits, all-rejected drafts:
+  deterministic sampling (fold_in(seed, gen_idx)) degenerates
+  rejection sampling to exact-match, so the draft can only move the
+  ACCEPTANCE RATE, never a token;
+* rejected speculative KV blocks roll back via the mapped/reserve
+  split on the page-table row — refcount-exact, zero leaks across
+  many rounds, shared prefix blocks untouched;
+* a dead draft degrades to plain decode mid-stream with zero failed
+  requests, and the frontend health loop re-arms it behind the canary
+  gate;
+* the verify program passes the zero-pool-copy census (fallback arm)
+  and its static twin (span > 1) carries zero donation findings.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.flags import set_flags
+from paddle_tpu.models.gpt import GPTConfig, build_lm_program
+from paddle_tpu.models import gpt_decode
+from paddle_tpu.serving import (DecodeEngine, Request, ServingFrontend,
+                                SpecConfig, replicated_engines)
+from paddle_tpu.serving import audit as serving_audit
+from paddle_tpu.serving.cache import CacheConfig, PagedKVCache
+from paddle_tpu.serving.program import analyze_decode_step
+from paddle_tpu.serving.resilience import Health
+from paddle_tpu.testing import reset_programs
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    reset_programs(seed=0)
+    cfg = GPTConfig.tiny()
+    cfg.max_position = 64
+    build_lm_program(cfg)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    return cfg, gpt_decode.params_from_scope(cfg)
+
+
+def _engine(cfg, params, **kw):
+    base = dict(max_slots=3, block_size=8, num_blocks=32, max_len=32,
+                window=4)
+    base.update(kw)
+    return DecodeEngine(params, cfg, **base)
+
+
+def _mixed_reqs(cfg, seed=3, n=6, shared=False):
+    """Greedy + seeded top-k mix; `shared` threads one system prompt
+    through half the requests so the radix cache participates."""
+    rng = np.random.RandomState(seed)
+    sysp = rng.randint(0, cfg.vocab_size, (11,))
+    reqs = []
+    for i in range(n):
+        prompt = rng.randint(0, cfg.vocab_size, (int(rng.randint(3, 13)),))
+        if shared and i % 2 == 0:
+            prompt = np.concatenate([sysp, prompt])
+        reqs.append(Request(
+            prompt=prompt, max_new_tokens=int(rng.randint(3, 9)),
+            temperature=0.0 if i % 2 == 0 else 0.8,
+            top_k=0 if i % 2 == 0 else 16,
+            seed=100 + i, uid=f"s{i}"))
+    return reqs
+
+
+@pytest.fixture(scope="module")
+def spec_off_oracle(tiny_gpt):
+    """One spec-off reference run of the standard mixed batch."""
+    cfg, params = tiny_gpt
+    eng = _engine(cfg, params)
+    try:
+        comps = eng.generate(_mixed_reqs(cfg), timeout=240)
+    finally:
+        eng.stop()
+    assert all(c.ok for c in comps), [(c.uid, c.state) for c in comps]
+    return {c.uid: c.tokens for c in comps}
+
+
+# ---------------------------------------------------------------------------
+# acceptance: bit parity (f32 tier-1 pin; bf16 rides the chaos drill)
+# ---------------------------------------------------------------------------
+
+def test_spec_on_bit_identical_mixed_continuous(tiny_gpt, spec_off_oracle):
+    """Greedy AND seeded top-k, continuous-batched, spec-on == spec-off
+    token for token — and speculation actually ran (accepted >= 1)."""
+    cfg, params = tiny_gpt
+    eng = _engine(cfg, params, spec=True)
+    try:
+        comps = eng.generate(_mixed_reqs(cfg), timeout=240)
+        st = eng.stats()
+    finally:
+        eng.stop()
+    for c in comps:
+        assert c.ok, (c.uid, c.state, c.error)
+        assert c.tokens == spec_off_oracle[c.uid], c.uid
+    assert st["spec_decode"] and st["spec_rounds"] >= 1
+    assert st["spec_accepted"] >= 1, st
+    # stats consistency rides the same engine (no extra build):
+    assert st["spec_proposed"] == st["spec_accepted"] + st["spec_rejected"]
+    assert 0.0 <= st["spec_accept_rate"] <= 1.0
+    assert st["spec_gamma"] >= 1 and st["spec_draft_health"] == "live"
+
+
+def test_spec_with_prefix_cache_parity_and_shared_block_safety(tiny_gpt):
+    """Speculation over radix-cache hits: parity holds, the cache hits,
+    and rollback never touches the shared prefix blocks (they live
+    strictly below the reserve split, so truncate can't reach them)."""
+    cfg, params = tiny_gpt
+    reqs = _mixed_reqs(cfg, seed=9, shared=True)
+    ref_eng = _engine(cfg, params, prefix_cache=True)
+    try:
+        ref = {c.uid: c.tokens for c in ref_eng.generate(reqs, timeout=240)}
+    finally:
+        ref_eng.stop()
+    eng = _engine(cfg, params, prefix_cache=True, spec=True)
+    try:
+        comps = eng.generate(reqs, timeout=240)
+        st = eng.stats()
+        # the radix chain keeps exactly its published reference alive
+        # after every slot released: nothing leaked, nothing freed twice
+        shared_live = eng.cache.allocator.shared_blocks
+    finally:
+        eng.stop()
+    for c in comps:
+        assert c.ok and c.tokens == ref[c.uid], (c.uid, c.tokens)
+    assert st["prefix_cache_hits"] >= 1, st
+    assert st["spec_accepted"] >= 1, st
+    assert shared_live == 0      # all slots retired -> no double refs
+
+
+def test_all_rejected_drafts_still_bit_identical(tiny_gpt,
+                                                 spec_off_oracle):
+    """Sabotage the draft to propose garbage every round: acceptance
+    drops to ~0 but output must stay bit-identical (the verify emits
+    the target's own token at the first disagreement) and every
+    speculative block must roll back — no leak across the stream."""
+    cfg, params = tiny_gpt
+    eng = _engine(cfg, params, spec=True)
+    orig = eng.spec._propose
+
+    def garbage():
+        props = orig()
+        return {i: [(t + 1) % cfg.vocab_size for t in chain]
+                for i, chain in props.items()}
+
+    eng.spec._propose = garbage
+    try:
+        comps = eng.generate(_mixed_reqs(cfg), timeout=240)
+        st = eng.stats()
+        free = eng.cache.allocator.free_blocks
+        total = eng.cache.config.num_blocks - 1   # block 0 = scratch
+    finally:
+        eng.stop()
+    for c in comps:
+        assert c.ok and c.tokens == spec_off_oracle[c.uid], c.uid
+    assert st["spec_rejected"] >= 1, st
+    assert free == total, f"leaked {total - free} blocks after rollback"
+
+
+# ---------------------------------------------------------------------------
+# rollback: the mapped/reserve split, refcount-exact
+# ---------------------------------------------------------------------------
+
+def test_mapped_reserve_split_contract():
+    """Cache-level unit pins: reserve_tail moves the funded tail out of
+    the device row; extend maps in order; truncate returns blocks to
+    the FRONT of the reserve (identical position -> block mapping on
+    re-extend); release frees mapped + reserved in one step."""
+    cache = PagedKVCache(CacheConfig(
+        num_layers=1, num_blocks=8, num_heads=1, block_size=4,
+        head_dim=4, max_blocks_per_slot=6, dtype="float32"))
+    got = cache.assign(0, 6)
+    assert got is not None and len(got) == 6
+    blocks = list(got)      # assign returns the live row (reserve_tail
+                            # mutates it); pin a copy for the asserts
+    cache.reserve_tail(0, 2)
+    assert cache.blocks_of(0) == blocks[:2]
+    assert cache.reserved_of(0) == blocks[2:]
+    # verify pre-extend: map 2 more, in funded order
+    assert cache.extend_mapped(0, 4) == 2
+    assert cache.blocks_of(0) == blocks[:4]
+    # all-rejected rollback: both come back, to the FRONT of the reserve
+    assert cache.truncate_mapped(0, 2) == blocks[2:4]
+    assert cache.reserved_of(0) == blocks[2:]
+    # partial re-extend maps the SAME block at the same position
+    cache.extend_mapped(0, 3)
+    assert cache.blocks_of(0) == blocks[:3]
+    with pytest.raises(ValueError):
+        cache.extend_mapped(0, 7)      # beyond the funded budget
+    with pytest.raises(ValueError):
+        cache.truncate_mapped(0, 0)    # row must keep >= 1 block
+    cache.release(0)
+    assert cache.allocator.free_blocks == 7   # block 0 = scratch
+    cache.close()
+
+
+def test_rollback_refcount_exact_across_many_rounds(tiny_gpt):
+    """50+ speculative rounds (several waves, prefix-cache hits in the
+    mix): after every wave the allocator holds exactly the radix
+    cache's published chains — rejected-block rollback leaks nothing
+    and never frees a shared block."""
+    cfg, params = tiny_gpt
+    eng = _engine(cfg, params, prefix_cache=True, spec=True)
+    try:
+        wave = 0
+        while eng.stats()["spec_rounds"] < 50:
+            assert wave < 40, "50 rounds never accumulated"
+            comps = eng.generate(
+                _mixed_reqs(cfg, seed=20 + wave, shared=True),
+                timeout=240)
+            wave += 1
+            assert all(c.ok for c in comps)
+            alloc = eng.cache.allocator
+            # every live reference after a wave belongs to the radix
+            # cache (refcount 1 published chains); nothing shared,
+            # nothing held by retired slots
+            assert alloc.shared_blocks == 0
+            in_radix = len(eng.prefix_cache) if eng.prefix_cache else 0
+            live = (eng.cache.config.num_blocks - 1) - alloc.free_blocks
+            assert live == in_radix, (wave, live, in_radix)
+        assert eng.stats()["spec_rounds"] >= 50, eng.stats()
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# failure semantics: degrade + re-arm
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow   # ~11s; chaos_smoke --spec-drill leg A re-pins the
+def test_draft_kill_degrades_to_plain_decode(tiny_gpt, spec_off_oracle):
+    """kill_draft mid-stream: zero failed requests, bit-parity, the
+    degraded counter moves, and the engine keeps serving spec-off."""
+    from paddle_tpu.observability import metrics as m
+    cfg, params = tiny_gpt
+    m.reset("serving.spec.degraded")
+    eng = _engine(cfg, params, spec=True)
+    try:
+        reqs = _mixed_reqs(cfg)
+        handles = [eng.submit(r, bounded=False) for r in reqs[:3]]
+        deadline = time.monotonic() + 30
+        while (time.monotonic() < deadline
+               and eng.stats().get("spec_rounds", 0) < 1):
+            time.sleep(0.005)
+        eng.spec.kill_draft("test: draft dies mid-stream")
+        handles += [eng.submit(r, bounded=False) for r in reqs[3:]]
+        comps = [h.result(timeout=240, raise_on_error=False)
+                 for h in handles]
+        st = eng.stats()
+    finally:
+        eng.stop()
+    for c in comps:
+        assert c.ok, (c.uid, c.state, c.error)
+        assert c.tokens == spec_off_oracle[c.uid], c.uid
+    assert int(m.get("serving.spec.degraded")) >= 1
+    assert not st["spec_armed"] and st["spec_decode"]
+
+
+@pytest.mark.slow   # ~10s; kill->degrade->canary re-arm runs at bf16
+def test_frontend_health_loop_rearms_draft(tiny_gpt):
+    """Draft dead -> the ServingFrontend ladder resurrects it behind
+    the canary gate and re-arms speculation."""
+    from paddle_tpu.observability import metrics as m
+    cfg, params = tiny_gpt
+    m.reset("serving.spec.rearmed")
+    set_flags({"FLAGS_serving_health_interval_ms": 50.0})
+    engines = replicated_engines(1, params, cfg, max_slots=3,
+                                 block_size=8, num_blocks=32, max_len=32,
+                                 window=4, spec=True)
+    fe = ServingFrontend(engines)
+    try:
+        ref = fe.generate(_mixed_reqs(cfg, seed=31, n=2), timeout=240)
+        assert all(c.ok for c in ref)
+        engines[0].spec.kill_draft("test: kill for re-arm")
+        # a request forces the round boundary where the kill lands
+        post = fe.generate(_mixed_reqs(cfg, seed=32, n=1), timeout=240)
+        assert post[0].ok
+        deadline = time.monotonic() + 60
+        while (time.monotonic() < deadline
+               and int(m.get("serving.spec.rearmed")) < 1):
+            time.sleep(0.05)
+        assert int(m.get("serving.spec.rearmed")) >= 1
+        assert engines[0].spec.armed
+        assert engines[0].spec.health == Health.LIVE
+        again = fe.generate(_mixed_reqs(cfg, seed=31, n=2), timeout=240)
+        for a, b in zip(ref, again):
+            assert b.ok and a.tokens == b.tokens
+    finally:
+        set_flags({"FLAGS_serving_health_interval_ms": 200.0})
+        fe.stop()
+
+
+# ---------------------------------------------------------------------------
+# edges: short requests, eos inside the speculative span
+# ---------------------------------------------------------------------------
+
+def test_max_new_one_and_eos_mid_span(tiny_gpt):
+    cfg, params = tiny_gpt
+    rng = np.random.RandomState(17)
+    prompt = rng.randint(0, cfg.vocab_size, (7,))
+    off = _engine(cfg, params)
+    try:
+        ref1 = off.generate([Request(prompt=prompt, max_new_tokens=1)],
+                            timeout=240)[0]
+        ref6 = off.generate([Request(prompt=prompt, max_new_tokens=6)],
+                           timeout=240)[0]
+        # eos = a token whose FIRST occurrence is past position 0, so
+        # the latch lands inside a speculative span (the tiny random
+        # model repeats itself; an early duplicate would latch at 0)
+        eos = next((t for j, t in enumerate(ref6.tokens)
+                    if j >= 1 and t not in ref6.tokens[:j]), None)
+        if eos is None:
+            pytest.skip("tiny model emitted a pure cycle in 6 tokens")
+        want_len = ref6.tokens.index(eos) + 1
+        ref_eos = off.generate(
+            [Request(prompt=prompt, max_new_tokens=6, eos_token=eos)],
+            timeout=240)[0]
+    finally:
+        off.stop()
+    eng = _engine(cfg, params, spec=True)
+    try:
+        got1 = eng.generate([Request(prompt=prompt, max_new_tokens=1)],
+                            timeout=240)[0]
+        got_eos = eng.generate(
+            [Request(prompt=prompt, max_new_tokens=6, eos_token=eos)],
+            timeout=240)[0]
+    finally:
+        eng.stop()
+    assert got1.ok and got1.tokens == ref1.tokens
+    assert got_eos.ok and got_eos.tokens == ref_eos.tokens
+    assert len(got_eos.tokens) == want_len     # latched AT the eos token
+
+
+# ---------------------------------------------------------------------------
+# config + stats + censuses
+# ---------------------------------------------------------------------------
+
+def test_spec_config_validation():
+    assert SpecConfig().resolve().tokens >= 1       # flag default
+    assert SpecConfig(tokens=6).resolve().tokens == 6
+    with pytest.raises(ValueError):
+        SpecConfig(tokens=17).resolve()
+    with pytest.raises(ValueError):
+        SpecConfig(draft_dtype="int4").resolve()
+    with pytest.raises(ValueError):
+        SpecConfig(draft_params={"x": 1}).resolve()  # params w/o config
+
+
+def test_verify_census_zero_pool_copies_and_clean_twin(tiny_gpt):
+    """The fallback verify program carries no pool-shaped copy, and the
+    span>1 static twin reports no donation/alias findings."""
+    cfg, params = tiny_gpt
+    eng = _engine(cfg, params, spec=True)
+    try:
+        serving_audit.assert_zero_verify_kv_copies(eng)
+        row = serving_audit.verify_copy_census(eng)
+        span = row["span"]
+    finally:
+        eng.stop()
+    assert row["pool_copies"] == 0 and span >= 2
+    twin = analyze_decode_step(span=span)
+    assert twin["errors"] == 0 and twin["warnings"] == 0, twin["findings"]
